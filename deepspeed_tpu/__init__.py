@@ -1,0 +1,26 @@
+"""deepspeed_tpu — TPU-native distributed training/inference framework.
+
+Re-implements the capability surface of DeepSpeed (reference:
+``deepspeed/__init__.py`` [K]) as an idiomatic JAX/XLA/Pallas stack: ZeRO
+stages are GSPMD sharding policies, parallelism modes are mesh axes, the hot
+path is one jitted train step.
+"""
+
+from .version import __version__
+from . import comm
+from .parallel import MeshLayout, build_mesh
+from .utils import logger
+
+__all__ = ["__version__", "comm", "MeshLayout", "build_mesh", "logger",
+           "initialize"]
+
+
+def initialize(*args, **kwargs):
+    """Public factory — mirrors ``deepspeed.initialize`` [L ACC:2358-2439].
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)``.  Imported
+    lazily so light uses (comm/mesh only) don't pay engine import cost.
+    """
+    from .runtime.entry import initialize as _initialize
+
+    return _initialize(*args, **kwargs)

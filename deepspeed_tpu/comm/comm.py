@@ -1,0 +1,390 @@
+"""Communication backend — DeepSpeed-verb API over XLA collectives.
+
+Capability parity with the reference ``deepspeed/comm/comm.py`` [K]: the
+module-level verbs (``all_reduce``, ``all_gather``, ``reduce_scatter``,
+``all_to_all_single``, ``broadcast``, ``barrier``, ``init_distributed``,
+``get_rank``/``get_world_size``) plus the ``comms_logger`` timing wrapper that
+the reference installs around every collective.
+
+Design (TPU-first, NOT a NCCL translation):
+
+* **In-graph collectives** (``psum``/``all_gather``/``psum_scatter``/
+  ``all_to_all``/``ppermute``) are the real data plane.  They are thin named
+  wrappers over ``jax.lax`` usable inside ``shard_map``; the wrapper exists so
+  the comms logger can count/annotate them and so group handles
+  (:class:`~deepspeed_tpu.utils.groups.MeshAxisGroup`) can be passed instead
+  of raw axis names.  Inside ``jit`` XLA schedules and overlaps these on ICI —
+  there is no bucketing/stream machinery to port because GSPMD owns it.
+
+* **Eager verbs** mirror the reference's host-called API for code that is not
+  inside a jitted step (checkpoint consolidation, debugging, tests).  They jit
+  a ``shard_map`` of the matching lax collective over the group's mesh on the
+  fly (cached per shape/dtype/group).
+
+* **Control plane**: ``init_distributed`` maps to ``jax.distributed.initialize``
+  (multi-host rendezvous — the NCCL/TCP-store equivalent); ``barrier`` uses a
+  tiny device all-reduce, falling back to ``multihost_utils.sync_global_devices``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils import groups as groups_mod
+from ..utils.groups import MeshAxisGroup
+from ..utils.logging import logger
+
+AxisName = Union[str, Tuple[str, ...]]
+
+# ---------------------------------------------------------------------------
+# ReduceOp — mirror of the reference's torch.distributed.ReduceOp surface.
+# ---------------------------------------------------------------------------
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+# ---------------------------------------------------------------------------
+# comms logger (reference: deepspeed/comm/comm.py comms_logger + utils)
+# ---------------------------------------------------------------------------
+
+
+class CommsLogger:
+    """Counts collective calls and (eager path) wall time per op name.
+
+    Honesty note on the two paths: eager verbs record at *execution* time
+    (count/bytes/seconds are real).  The in-graph wrappers record at *trace*
+    time — a structural census of collectives per compiled program, not
+    per-step execution counts (XLA runs the compiled program without Python).
+    Use ``jax.profiler`` / xprof for true in-graph collective timing.
+    """
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.verbose = False
+        self.stats: dict[str, dict[str, float]] = {}
+
+    def configure(self, enabled: bool = True, verbose: bool = False) -> None:
+        self.enabled = enabled
+        self.verbose = verbose
+
+    def record(self, name: str, nbytes: int, seconds: float = 0.0) -> None:
+        if not self.enabled:
+            return
+        entry = self.stats.setdefault(name, {"count": 0, "bytes": 0, "seconds": 0.0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes
+        entry["seconds"] += seconds
+        if self.verbose:
+            logger.info(f"comm: {name} bytes={nbytes} time={seconds * 1e3:.3f}ms")
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return self.stats
+
+    def reset(self) -> None:
+        self.stats = {}
+
+
+comms_logger = CommsLogger()
+
+
+def _nbytes(x: Any) -> int:
+    try:
+        return int(np.prod(np.shape(x))) * jnp.dtype(jnp.result_type(x)).itemsize
+    except Exception:
+        return 0
+
+
+def _axis(group: Union[MeshAxisGroup, AxisName, None]) -> AxisName:
+    if group is None:
+        return groups_mod.get_data_parallel_group().axis_name()
+    if isinstance(group, MeshAxisGroup):
+        return group.axis_name()
+    return group
+
+
+# ---------------------------------------------------------------------------
+# In-graph collectives — call these inside shard_map/jit.
+# ---------------------------------------------------------------------------
+
+
+def psum(x, group: Union[MeshAxisGroup, AxisName, None] = None):
+    axis = _axis(group)
+    comms_logger.record("psum", _nbytes(x))
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def pmean(x, group: Union[MeshAxisGroup, AxisName, None] = None):
+    axis = _axis(group)
+    comms_logger.record("pmean", _nbytes(x))
+    return jax.lax.pmean(x, axis_name=axis)
+
+
+def pmax(x, group=None):
+    comms_logger.record("pmax", _nbytes(x))
+    return jax.lax.pmax(x, axis_name=_axis(group))
+
+
+def all_gather_in_graph(x, group=None, axis: int = 0, tiled: bool = True):
+    comms_logger.record("all_gather", _nbytes(x))
+    return jax.lax.all_gather(x, axis_name=_axis(group), axis=axis, tiled=tiled)
+
+
+def reduce_scatter_in_graph(x, group=None, scatter_dimension: int = 0, tiled: bool = True):
+    comms_logger.record("reduce_scatter", _nbytes(x))
+    return jax.lax.psum_scatter(
+        x, axis_name=_axis(group), scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_to_all_in_graph(x, group=None, split_axis: int = 0, concat_axis: int = 0,
+                        tiled: bool = True):
+    """Ulysses/MoE workhorse — first-class on ICI."""
+    comms_logger.record("all_to_all", _nbytes(x))
+    return jax.lax.all_to_all(
+        x, axis_name=_axis(group), split_axis=split_axis,
+        concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, perm: Sequence[Tuple[int, int]], group=None):
+    """Pipeline P2P: send/recv pairs as a collective-permute (ICI-native)."""
+    comms_logger.record("ppermute", _nbytes(x))
+    return jax.lax.ppermute(x, axis_name=_axis(group), perm=list(perm))
+
+
+def axis_index(group=None):
+    return jax.lax.axis_index(_axis(group))
+
+
+# ---------------------------------------------------------------------------
+# Eager verbs — the reference's host-called API shape.
+# ---------------------------------------------------------------------------
+
+
+def _group_or_dp(group) -> MeshAxisGroup:
+    if isinstance(group, MeshAxisGroup):
+        return group
+    if group is None:
+        return groups_mod.get_data_parallel_group()
+    if isinstance(group, str):
+        return MeshAxisGroup(mesh=groups_mod.get_mesh(), axes=(group,))
+    return MeshAxisGroup(mesh=groups_mod.get_mesh(), axes=tuple(group))
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_collective(kind: str, mesh: Mesh, axes: Tuple[str, ...],
+                      shape: Tuple[int, ...], dtype: Any, extra: Any = None):
+    """Build+cache a jitted shard_map collective over `axes` of `mesh`.
+
+    The input is treated as sharded on its leading dim over `axes` (gather /
+    reduce_scatter / all_to_all).  ``all_reduce`` shards the leading dim when
+    it divides the group size; otherwise (scalars, odd shapes — e.g. the
+    reference's loss averaging) it falls back to replicated semantics: the
+    value is taken to be each rank's identical local tensor, so SUM returns
+    value × group_size, matching ``torch.distributed.all_reduce`` of a
+    replicated value."""
+    axis_name = axes if len(axes) > 1 else axes[0]
+    group_size = int(np.prod([mesh.shape[a] for a in axes]))
+    sharded = PartitionSpec(axes)
+    replicated = PartitionSpec()
+
+    if kind == "all_reduce":
+        op = extra
+        divisible = len(shape) > 0 and shape[0] % group_size == 0
+        spec = sharded if divisible else replicated
+
+        def fn(x):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(x, axis_name)
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(x, axis_name)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(x, axis_name)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(x, axis_name)
+            if op == ReduceOp.PROD:
+                gathered = jax.lax.all_gather(x, axis_name, axis=0)
+                return jnp.prod(gathered, axis=0)
+            raise ValueError(f"unsupported reduce op {op}")
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec, check_vma=False))
+    if kind == "all_gather":
+        def fn(x):
+            return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(sharded,),
+                                 out_specs=replicated, check_vma=False))
+    if kind == "reduce_scatter":
+        def fn(x):
+            return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(replicated,),
+                                 out_specs=sharded, check_vma=False))
+    if kind == "all_to_all":
+        # torch all_to_all_single semantics: global leading dim indexes the
+        # rank; each rank's local row is split into |group| chunks along the
+        # next dim, chunk j goes to rank j. Globally: out[i, j·k:(j+1)·k] =
+        # in[j, i·k:(i+1)·k].
+        def fn(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=1,
+                                      tiled=True)
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(sharded,),
+                                 out_specs=sharded, check_vma=False))
+    raise ValueError(kind)
+
+
+def _timed(name: str, fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    if comms_logger.enabled:
+        out = jax.block_until_ready(out)
+        comms_logger.record(name, _nbytes(x), time.perf_counter() - t0)
+    return out
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None):
+    """Eager all-reduce across the group; returns the reduced array
+    (functional — JAX arrays are immutable, unlike the reference's in-place)."""
+    g = _group_or_dp(group)
+    x = jnp.asarray(tensor)
+    fn = _eager_collective("all_reduce", g.mesh, g.axes, x.shape,
+                           jnp.result_type(x), op)
+    return _timed("all_reduce", fn, x)
+
+
+def all_gather(tensor, group=None):
+    """Gather leading-dim shards across the group → replicated concat."""
+    g = _group_or_dp(group)
+    x = jnp.asarray(tensor)
+    fn = _eager_collective("all_gather", g.mesh, g.axes, x.shape, jnp.result_type(x))
+    return _timed("all_gather", fn, x)
+
+
+# reference name: all_gather_into_tensor
+all_gather_into_tensor = all_gather
+
+
+def reduce_scatter(tensor, group=None):
+    """Reduce a replicated tensor and scatter leading-dim shards."""
+    g = _group_or_dp(group)
+    x = jnp.asarray(tensor)
+    fn = _eager_collective("reduce_scatter", g.mesh, g.axes, x.shape, jnp.result_type(x))
+    return _timed("reduce_scatter", fn, x)
+
+
+reduce_scatter_tensor = reduce_scatter
+
+
+def all_to_all_single(tensor, group=None):
+    g = _group_or_dp(group)
+    x = jnp.asarray(tensor)
+    fn = _eager_collective("all_to_all", g.mesh, g.axes, x.shape, jnp.result_type(x))
+    return _timed("all_to_all_single", fn, x)
+
+
+def broadcast(tensor, src: int = 0, group=None):
+    """Replicate ``tensor``'s value from group-rank ``src`` to every rank.
+
+    In single-controller JAX a host value is already consistent across the
+    mesh; for multihost process-level broadcast we use multihost_utils."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            jnp.asarray(tensor), is_source=jax.process_index() == src)
+    return jnp.asarray(tensor)
+
+
+def barrier(group=None) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deepspeed_tpu.comm.barrier")
+    else:
+        jax.effects_barrier()
+
+
+# ---------------------------------------------------------------------------
+# init / rank queries (reference: init_distributed + launcher env discovery)
+# ---------------------------------------------------------------------------
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(dist_backend: str = "xla",
+                     coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     timeout: Optional[int] = None,
+                     auto_mpi_discovery: bool = True) -> None:
+    """Multi-host rendezvous. Single-process (one TPU VM or local dev) is a
+    no-op: all local chips are already visible to this controller.
+
+    Env discovery mirrors the reference launcher contract: honors
+    ``COORDINATOR_ADDRESS``/``MASTER_ADDR:MASTER_PORT``, ``WORLD_SIZE`` (as
+    process count), ``RANK``.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None and os.environ.get("MASTER_ADDR"):
+        coordinator_address = (f"{os.environ['MASTER_ADDR']}:"
+                               f"{os.environ.get('MASTER_PORT', '12355')}")
+    num_processes = num_processes or int(os.environ.get("WORLD_SIZE", "0")) or None
+    process_id = process_id if process_id is not None else (
+        int(os.environ["RANK"]) if "RANK" in os.environ else None)
+    if coordinator_address and num_processes and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    """Global rank of this controller within [0, get_world_size()).
+
+    JAX is single-controller-per-host: one process drives many chips, so a
+    per-chip rank does not exist on the host side.  We return the global id
+    of the first local device — rank 0 on the lead host, a contiguous range
+    start elsewhere — which keeps ``rank == 0`` gating (the dominant use)
+    and ``0 <= rank < world_size`` correct.  In-graph code wanting a true
+    per-shard rank must use :func:`axis_index`.
+    """
+    if group is None:
+        return int(jax.local_devices()[0].id)
+    return _group_or_dp(group).rank_of_process()
+
+
+def get_world_size(group=None) -> int:
+    if group is None:
+        return jax.device_count()
+    return _group_or_dp(group).size
+
+
+def get_local_rank() -> int:
+    return 0  # single controller per host; local chips are not separate ranks
+
+
+def new_group(axes: Sequence[str]) -> MeshAxisGroup:
+    """A 'new group' is just a named view over mesh axes — zero-cost."""
+    return MeshAxisGroup(mesh=groups_mod.get_mesh(), axes=tuple(axes))

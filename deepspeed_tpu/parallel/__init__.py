@@ -1,0 +1,7 @@
+from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR,
+                   DP_AXES, MESH_AXIS_ORDER, MeshLayout, ProcessTopology,
+                   batch_sharding, build_mesh, replicated, single_device_mesh)
+
+__all__ = ["AXIS_DATA", "AXIS_EXPERT", "AXIS_PIPE", "AXIS_SEQ", "AXIS_TENSOR",
+           "DP_AXES", "MESH_AXIS_ORDER", "MeshLayout", "ProcessTopology",
+           "batch_sharding", "build_mesh", "replicated", "single_device_mesh"]

@@ -1,0 +1,5 @@
+from .logging import log_dist, logger, set_log_level
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["logger", "log_dist", "set_log_level",
+           "SynchronizedWallClockTimer", "ThroughputTimer"]

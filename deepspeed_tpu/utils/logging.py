@@ -1,0 +1,61 @@
+"""Rank-aware logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` [K]:
+``logger`` (module-level, level settable externally), ``log_dist`` (log only on
+selected ranks), plus ``should_log_rank0``.  On TPU the "rank" is the JAX
+process index (one process per TPU-VM host), not a per-chip rank: inside a
+single process all local chips share one Python logger.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+        lg.addHandler(handler)
+        lg.propagate = False
+    env_level = os.environ.get("DS_TPU_LOG_LEVEL")
+    if env_level is not None:
+        level = int(env_level) if env_level.isdigit() else env_level.upper()
+    lg.setLevel(level)
+    return lg
+
+
+logger = create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def should_log_rank0() -> bool:
+    return _process_index() == 0
+
+
+def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every process. Mirrors the reference ``log_dist``.
+    """
+    my_rank = _process_index()
+    ranks = ranks if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def set_log_level(level: int | str) -> None:
+    logger.setLevel(level)

@@ -1,0 +1,152 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py`` [K]:
+``SynchronizedWallClockTimer`` (named timers; on GPU the reference uses CUDA
+events — here synchronization is ``jax.block_until_ready`` on a token array)
+and ``ThroughputTimer`` (samples/sec + TFLOPS given a per-step FLOP count).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _sync() -> None:
+    """Drain all outstanding device work so host wall-clock is meaningful."""
+    try:
+        import jax
+
+        # effects_barrier waits for all dispatched computations on all devices.
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self._count = 0
+
+    def start(self, sync: bool = False) -> None:
+        if sync:
+            _sync()
+        self._start = time.perf_counter()
+
+    def stop(self, sync: bool = False) -> None:
+        if self._start is None:
+            return
+        if sync:
+            _sync()
+        self._elapsed += time.perf_counter() - self._start
+        self._count += 1
+        self._start = None
+
+    def reset(self) -> None:
+        self._start = None
+        self._elapsed = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self._elapsed
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self._elapsed / max(self._count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry. ``timer(name).start()/stop()``; ``log([names])``."""
+
+    def __init__(self) -> None:
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @contextmanager
+    def record(self, name: str, sync: bool = False):
+        t = self(name)
+        t.start(sync=sync)
+        try:
+            yield t
+        finally:
+            t.stop(sync=sync)
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True,
+            log_fn: Optional[Callable[[str], Any]] = None) -> str:
+        names = names or list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                parts.append(f"{name}: {self.timers[name].elapsed(reset=reset) * 1000:.2f}ms")
+        msg = " | ".join(parts)
+        if log_fn is None:
+            from .logging import log_dist
+
+            log_dist(f"time: {msg}")
+        else:
+            log_fn(msg)
+        return msg
+
+
+class ThroughputTimer:
+    """Tracks samples/sec, tokens/sec and TFLOPS across steps.
+
+    ``batch_size`` is the global train batch; ``flops_per_step`` (optional) is
+    the model FLOPs for one optimizer step (fwd+bwd), used for TFLOPS/MFU.
+    """
+
+    def __init__(self, batch_size: int, seq_length: int = 0,
+                 flops_per_step: float = 0.0, start_step: int = 2):
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.flops_per_step = flops_per_step
+        self.start_step = start_step  # skip compile/warmup steps
+        self.step_count = 0
+        self.total_time = 0.0
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync: bool = True) -> None:
+        if self._t0 is None:
+            return
+        if sync:
+            _sync()
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.step_count += 1
+        if self.step_count > self.start_step:
+            self.total_time += dt
+
+    @property
+    def counted_steps(self) -> int:
+        return max(self.step_count - self.start_step, 0)
+
+    def avg_step_time(self) -> float:
+        return self.total_time / max(self.counted_steps, 1)
+
+    def samples_per_sec(self) -> float:
+        if self.total_time == 0:
+            return 0.0
+        return self.counted_steps * self.batch_size / self.total_time
+
+    def tokens_per_sec(self) -> float:
+        return self.samples_per_sec() * self.seq_length
+
+    def tflops(self) -> float:
+        if self.total_time == 0 or not self.flops_per_step:
+            return 0.0
+        return self.counted_steps * self.flops_per_step / self.total_time / 1e12

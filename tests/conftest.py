@@ -1,0 +1,44 @@
+"""Test harness: a virtual 8-device CPU mesh in one process.
+
+The reference's keystone fixture (``tests/unit/common.py:DistributedTest`` [K])
+forks N processes over localhost NCCL.  The TPU-native equivalent is
+``--xla_force_host_platform_device_count=8`` — real mesh, real XLA collectives,
+single process (SURVEY §4).
+"""
+
+import os
+
+# XLA_FLAGS must be set before the CPU backend is created. The axon
+# sitecustomize imports jax at interpreter start with JAX_PLATFORMS=axon, so
+# the platform override must go through jax.config, not the env var.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    from deepspeed_tpu.utils import groups
+
+    groups.reset_mesh()
+    yield
+    groups.reset_mesh()
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    layout = MeshLayout.infer(8, dp=8)
+    return groups.initialize_mesh(layout)
+
+
+def require_devices(n: int):
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices, have {jax.device_count()}")

@@ -1,0 +1,86 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.parallel import MeshLayout
+from deepspeed_tpu.utils import groups
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    yield
+
+
+def test_all_reduce_sum():
+    x = jnp.arange(8, dtype=jnp.float32)  # one element per dp rank
+    out = comm.all_reduce(x, op=comm.ReduceOp.SUM)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_all_reduce_avg_max():
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(comm.all_reduce(x, comm.ReduceOp.AVG)),
+                               np.full(8, 3.5))
+    np.testing.assert_allclose(np.asarray(comm.all_reduce(x, comm.ReduceOp.MAX)),
+                               np.full(8, 7.0))
+
+
+def test_all_gather_identity():
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = comm.all_gather(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8, dtype=np.float32))
+
+
+def test_reduce_scatter():
+    x = jnp.ones(8, dtype=jnp.float32)  # replicated input
+    out = comm.reduce_scatter(x)
+    # each of 8 shards holds sum over 8 replicas of its slice
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+
+def test_all_to_all_transpose():
+    # 8 ranks, chunk k=1: all_to_all_single is exactly a transpose.
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    out = comm.all_to_all_single(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(64, dtype=np.float32).reshape(8, 8).T)
+
+
+def test_in_graph_collectives_shard_map():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = groups.get_mesh()
+
+    def fn(x):
+        total = comm.psum(x, group=("expert", "data"))
+        idx = comm.axis_index(group="data")
+        return total + 0 * idx
+
+    f = jax.jit(shard_map(fn, mesh=mesh,
+                          in_specs=(P(("expert", "data")),),
+                          out_specs=P(("expert", "data")), check_vma=False))
+    out = f(jnp.ones(8, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+
+def test_rank_world_size():
+    assert comm.get_rank() == 0
+    assert comm.get_world_size() == 8
+    assert comm.get_world_size(groups.get_data_parallel_group()) == 8
+
+
+def test_comms_logger_counts():
+    comm.comms_logger.configure(enabled=True)
+    comm.comms_logger.reset()
+    comm.all_reduce(jnp.ones(8))
+    stats = comm.comms_logger.summary()
+    assert stats["all_reduce"]["count"] == 1
+    comm.comms_logger.configure(enabled=False)
+
+
+def test_barrier_noop():
+    comm.barrier()
