@@ -1,0 +1,104 @@
+"""Monitor fan-out: (tag, value, step) events → TensorBoard / W&B / CSV.
+
+Capability parity with the reference ``deepspeed/monitor/`` [K]:
+``MonitorMaster`` dispatches to every enabled backend; config groups
+``tensorboard``, ``wandb``, ``csv_monitor`` (§5.5).  Comet/nebula are
+documented gaps (SURVEY §7 non-ported list).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]  # (tag, value, global_step)
+
+
+class TensorBoardMonitor:
+    def __init__(self, cfg) -> None:
+        self.enabled = cfg.enabled
+        self.writer = None
+        if self.enabled:
+            try:
+                from tensorflow.summary import create_file_writer  # type: ignore
+
+                path = os.path.join(cfg.output_path or "runs", cfg.job_name)
+                self.writer = create_file_writer(path)
+            except Exception as e:  # tf absent or broken — degrade, don't die
+                logger.warning(f"tensorboard monitor disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.writer:
+            return
+        import tensorflow as tf  # type: ignore
+
+        with self.writer.as_default():
+            for tag, value, step in events:
+                tf.summary.scalar(tag, float(value), step=step)
+
+
+class WandbMonitor:
+    def __init__(self, cfg) -> None:
+        self.enabled = cfg.enabled
+        self.run = None
+        if self.enabled:
+            try:
+                import wandb  # type: ignore
+
+                self.run = wandb.init(project=cfg.project, group=cfg.group,
+                                      entity=cfg.team)
+            except Exception as e:
+                logger.warning(f"wandb monitor disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.run:
+            return
+        for tag, value, step in events:
+            self.run.log({tag: float(value)}, step=step)
+
+
+class CSVMonitor:
+    def __init__(self, cfg) -> None:
+        self.enabled = cfg.enabled
+        self.path = None
+        if self.enabled:
+            base = os.path.join(cfg.output_path or "csv_logs", cfg.job_name)
+            os.makedirs(base, exist_ok=True)
+            self.path = os.path.join(base, "metrics.csv")
+            if not os.path.exists(self.path):
+                with open(self.path, "w", newline="") as fh:
+                    csv.writer(fh).writerow(["tag", "value", "step"])
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.path:
+            return
+        with open(self.path, "a", newline="") as fh:
+            w = csv.writer(fh)
+            for tag, value, step in events:
+                w.writerow([tag, float(value), step])
+
+
+class MonitorMaster:
+    """Fans every event out to all enabled backends (reference name)."""
+
+    def __init__(self, ds_config) -> None:
+        self.backends = []
+        self.tb = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb = WandbMonitor(ds_config.wandb)
+        self.csv = CSVMonitor(ds_config.csv_monitor)
+        for backend in (self.tb, self.wandb, self.csv):
+            if backend.enabled:
+                self.backends.append(backend)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.backends)
+
+    def write_events(self, events: List[Event]) -> None:
+        for backend in self.backends:
+            backend.write_events(events)

@@ -1,0 +1,4 @@
+from .config import DeepSpeedConfig
+from .config_utils import AUTO, DeepSpeedConfigModel, is_auto
+
+__all__ = ["DeepSpeedConfig", "DeepSpeedConfigModel", "AUTO", "is_auto"]

@@ -1,0 +1,114 @@
+"""Checkpoint save/load.
+
+Capability parity with the reference engine checkpointing + checkpoint-engine
+backends (SURVEY §5.4): ``engine.save_checkpoint(dir, tag?)`` writes
+``<dir>/<tag=global_step{N}>/`` plus a ``latest`` tag file
+[L HF-DS:492, ACC:3665-3669]; ``engine.load_checkpoint`` restores
+module+optimizer+scheduler+client state; resume tolerates a DIFFERENT
+mesh/world size (the reference needs the separate universal-checkpoint
+pipeline for that — orbax gives reshard-on-load natively, which is exactly
+SURVEY §5.4's TPU mapping).
+
+Layout per tag directory:
+    state/            orbax sharded pytree (params, opt_state, step, scaler)
+    client_state.json user + engine bookkeeping (global_steps, skipped, …)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ..utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _tag_for(engine, tag: Optional[str]) -> str:
+    return tag if tag is not None else f"global_step{engine.global_steps}"
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict[str, Any]] = None) -> str:
+    tag = _tag_for(engine, tag)
+    ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    with ocp.StandardCheckpointer() as saver:
+        saver.save(os.path.join(ckpt_dir, "state"), engine.state, force=True)
+
+    meta = {
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "lr_scheduler": engine.lr_scheduler.state_dict(),
+        "client_state": client_state or {},
+        "ds_config_stage": engine.config.zero_optimization.stage,
+    }
+    with open(os.path.join(ckpt_dir, "client_state.json"), "w") as fh:
+        json.dump(meta, fh, default=str)
+
+    # reference writes a `latest` file naming the newest tag [K]
+    with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
+        fh.write(tag)
+    log_dist(f"saved checkpoint {ckpt_dir}")
+    return ckpt_dir
+
+
+def _resolve_tag(load_dir: str, tag: Optional[str]) -> Optional[str]:
+    if tag is not None:
+        return tag
+    latest = os.path.join(load_dir, LATEST_FILE)
+    if os.path.exists(latest):
+        with open(latest) as fh:
+            return fh.read().strip()
+    # fall back to newest global_step* dir (reference glob [L HF-DS:492])
+    candidates = [d for d in os.listdir(load_dir)
+                  if d.startswith("global_step")
+                  and os.path.isdir(os.path.join(load_dir, d))]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda d: int(d.replace("global_step", "") or 0))
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_module_only: bool = False
+                    ) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
+    tag = _resolve_tag(load_dir, tag)
+    if tag is None:
+        logger.warning(f"no checkpoint found under {load_dir}")
+        return None, None
+    ckpt_dir = os.path.abspath(os.path.join(load_dir, tag))
+
+    # Restore INTO the engine's current sharded layout: orbax reshards on
+    # load, so a checkpoint written on a different mesh/world restores
+    # correctly (the reference's universal-checkpoint capability).
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                       sharding=getattr(x, "sharding", None)),
+        engine.state)
+    with ocp.StandardCheckpointer() as loader:
+        restored = loader.restore(os.path.join(ckpt_dir, "state"), target)
+
+    if load_module_only or not load_optimizer_states:
+        engine.state = engine.state._replace(params=restored.params)
+    else:
+        engine.state = restored
+
+    meta_path = os.path.join(ckpt_dir, "client_state.json")
+    client_state: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        engine.global_steps = int(meta.get("global_steps", 0))
+        engine.micro_steps = int(meta.get("micro_steps", 0))
+        if meta.get("lr_scheduler"):
+            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        client_state = meta.get("client_state", {})
+    log_dist(f"loaded checkpoint {ckpt_dir}")
+    return ckpt_dir, client_state

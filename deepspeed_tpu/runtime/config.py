@@ -1,0 +1,404 @@
+"""ds_config parsing + validation.
+
+Capability parity with the reference ``deepspeed/runtime/config.py`` [K]; key
+inventory and batch-size invariant from SURVEY §5.6.  Accepts the same JSON
+documents (path, dict, or base64 string [L ACC-DS:145-156]) an HF/accelerate
+user would pass to DeepSpeed, including ``"auto"`` placeholders.
+
+Batch math [L HF-DS:139-140, ACC:2223-2228]:
+
+    train_batch_size = micro_batch × gradient_accumulation_steps × dp_world
+
+where ``dp_world = world_size / (tp × pp × sp)`` — sequence-parallel ranks
+consume the SAME batch shards (they split the sequence dim), so sp divides
+out exactly like tp/pp.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import AUTO, DeepSpeedConfigModel, is_auto
+from .zero.config import DeepSpeedZeroConfig
+
+
+# ---------------------------------------------------------------------------
+# precision
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: Union[bool, str] = False  # may be "auto"
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 → dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: Union[bool, str] = False
+    # reference: bf16 grad accumulation dtype option
+    immediate_grad_update: bool = True
+
+
+class AMPConfig(DeepSpeedConfigModel):
+    enabled: Union[bool, str] = False
+    opt_level: str = "O1"
+
+
+# ---------------------------------------------------------------------------
+# optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+
+class OptimizerParams(DeepSpeedConfigModel):
+    lr: Union[float, str] = 1e-3
+    betas: Union[List[float], str] = Field(default_factory=lambda: [0.9, 0.999])
+    eps: Union[float, str] = 1e-8
+    weight_decay: Union[float, str] = 0.0
+    momentum: float = 0.0  # sgd
+    # onebit/compression extras accepted via extra="allow"
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "AdamW"
+    params: OptimizerParams = Field(default_factory=OptimizerParams)
+    legacy_fusion: bool = False
+
+
+class SchedulerParams(DeepSpeedConfigModel):
+    # WarmupLR / WarmupDecayLR / WarmupCosineLR
+    warmup_min_lr: Union[float, str] = 0.0
+    warmup_max_lr: Union[float, str] = 1e-3
+    warmup_num_steps: Union[int, str] = 1000
+    warmup_type: str = "log"
+    total_num_steps: Union[int, str, None] = None
+    # WarmupCosineLR
+    warmup_min_ratio: float = 0.0
+    cos_min_ratio: float = 1e-4
+    # OneCycle / LRRangeTest take their own keys via extra="allow"
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: str = "WarmupLR"
+    params: SchedulerParams = Field(default_factory=SchedulerParams)
+
+
+# ---------------------------------------------------------------------------
+# feature subsystems (schema parity; behavior lives in their modules)
+# ---------------------------------------------------------------------------
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``activation_checkpointing`` group.  On TPU these map onto
+    ``jax.checkpoint`` policies: ``partition_activations`` → remat with
+    sharded residuals; ``cpu_checkpointing`` → offload policy."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class AioConfig(DeepSpeedConfigModel):
+    """NVMe async-IO engine knobs (ZeRO-Infinity) [L ACC-DC:1187-1194]."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = Field(default_factory=list)
+    debug: bool = False
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False  # [L HF-DS:179-182]
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    writer: Optional[Dict[str, Any]] = None
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    """``tensor_parallel`` group (AutoTP training) [L HF-DS:464]."""
+
+    autotp_size: int = 1
+    tp_overlap_comm: bool = False
+
+
+class SequenceParallelConfig(DeepSpeedConfigModel):
+    """TPU-native grouping of the fork's ALST/Ulysses knobs."""
+
+    sp_size: int = 1
+    seq_length_is_variable: bool = True
+    attention_backend: str = "auto"  # auto|splash|dot
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"
+    num_micro_batches: Optional[int] = None
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.1
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CompileConfig(DeepSpeedConfigModel):
+    """torch.compile interop group — on TPU everything is compiled; kept so
+    configs round-trip and so `deepcompile` flags are visible."""
+
+    deepcompile: bool = False
+    offload_activation: bool = False
+    offload_opt_states: bool = False
+
+
+# ---------------------------------------------------------------------------
+# top-level
+# ---------------------------------------------------------------------------
+
+
+def _load_config_payload(config: Union[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Accept dict, JSON file path, or base64-encoded JSON [L ACC-DS:145-156]."""
+    if isinstance(config, dict):
+        return dict(config)
+    if isinstance(config, (str, os.PathLike)):
+        path = os.fspath(config)
+        if os.path.exists(path):
+            with open(path) as fh:
+                return json.load(fh)
+        try:
+            return json.loads(base64.urlsafe_b64decode(path).decode())
+        except Exception:
+            try:
+                return json.loads(path)
+            except Exception:
+                raise ValueError(
+                    f"Expected a dict, JSON file path, JSON string, or base64 "
+                    f"payload; got {path!r} (file does not exist)")
+    raise TypeError(f"unsupported config type {type(config)}")
+
+
+class DeepSpeedConfig(DeepSpeedConfigModel):
+    """The validated top-level config (reference class of the same name)."""
+
+    train_batch_size: Union[int, str, None] = None
+    train_micro_batch_size_per_gpu: Union[int, str, None] = None
+    gradient_accumulation_steps: Union[int, str, None] = None
+    steps_per_print: Union[int, float] = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: Union[float, str] = 0.0
+    memory_breakdown: bool = False
+    disable_allgather: bool = False
+    sparse_gradients: bool = False
+    zero_allow_untested_optimizer: bool = False  # [L HF-DS:392]
+    zero_force_ds_cpu_optimizer: bool = True  # [L ACC:2365-2367]
+    seed: int = 1234
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    amp: AMPConfig = Field(default_factory=AMPConfig)
+    zero_optimization: DeepSpeedZeroConfig = Field(default_factory=DeepSpeedZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+    sequence_parallel: SequenceParallelConfig = Field(
+        default_factory=SequenceParallelConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    compile: CompileConfig = Field(default_factory=CompileConfig)
+    compression_training: Dict[str, Any] = Field(default_factory=dict)
+    curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_dict_or_path(cls, config: Union[str, Dict[str, Any]],
+                          world_size: Optional[int] = None,
+                          tp: int = 1, pp: int = 1, sp: int = 1) -> "DeepSpeedConfig":
+        payload = _load_config_payload(config)
+        cfg = cls.model_validate(payload)
+        cfg.resolve_batch_sizes(world_size=world_size, tp=tp, pp=pp, sp=sp)
+        return cfg
+
+    # ------------------------------------------------------------------
+    # batch math — the reference invariant
+    # ------------------------------------------------------------------
+
+    def resolve_batch_sizes(self, world_size: Optional[int] = None,
+                            tp: int = 1, pp: int = 1, sp: int = 1) -> None:
+        """Given any subset of (train_batch, micro_batch, grad_accum), infer
+        the rest and validate  train = micro × gas × dp_world.
+        """
+        if world_size is None:
+            import jax
+
+            world_size = jax.device_count()
+        denom = tp * pp * sp
+        if world_size % denom:
+            raise ValueError(f"world_size={world_size} not divisible by "
+                             f"tp*pp*sp={denom}")
+        dp_world = world_size // denom
+
+        tb = None if is_auto(self.train_batch_size) else self.train_batch_size
+        mb = (None if is_auto(self.train_micro_batch_size_per_gpu)
+              else self.train_micro_batch_size_per_gpu)
+        gas = (None if is_auto(self.gradient_accumulation_steps)
+               else self.gradient_accumulation_steps)
+
+        if tb is not None and mb is not None and gas is None:
+            if tb % (mb * dp_world):
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by micro_batch×dp "
+                    f"({mb}×{dp_world})")
+            gas = tb // (mb * dp_world)
+        elif tb is not None and gas is not None and mb is None:
+            if tb % (gas * dp_world):
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by grad_accum×dp "
+                    f"({gas}×{dp_world})")
+            mb = tb // (gas * dp_world)
+        elif mb is not None:
+            gas = gas or 1
+            tb = tb or mb * gas * dp_world
+        elif tb is not None:
+            gas = 1
+            if tb % dp_world:
+                raise ValueError(f"train_batch_size={tb} not divisible by "
+                                 f"dp_world={dp_world}")
+            mb = tb // dp_world
+        else:
+            tb, mb, gas = dp_world, 1, 1  # reference default micro=1,gas=1
+
+        if tb != mb * gas * dp_world:
+            raise ValueError(
+                f"Batch invariant violated: train_batch_size={tb} != "
+                f"micro={mb} × grad_accum={gas} × dp_world={dp_world}. "
+                f"(world={world_size}, tp={tp}, pp={pp}, sp={sp})")
+
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    # ------------------------------------------------------------------
+    # convenience
+    # ------------------------------------------------------------------
+
+    def dtype(self):
+        """Precedence: bf16 > fp16 > fp32 (TPU-first: bf16 needs no scaler)."""
+        import jax.numpy as jnp
+
+        if self.bf16.enabled is True:
+            return jnp.bfloat16
+        if self.fp16.enabled is True:
+            return jnp.float16
+        return jnp.float32
+
+    def resolve_auto_precision(self, default: str = "bf16") -> None:
+        if is_auto(self.bf16.enabled):
+            self.bf16.enabled = default == "bf16"
+        if is_auto(self.fp16.enabled):
+            self.fp16.enabled = default == "fp16"
+        if is_auto(self.amp.enabled):
+            self.amp.enabled = False
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self.model_dump(mode="json"), indent=2, default=str))

@@ -1,0 +1,69 @@
+"""Config-model base machinery.
+
+Capability parity with the reference ``deepspeed/runtime/config_utils.py`` [K]:
+``DeepSpeedConfigModel`` — a pydantic base that (a) tolerates unknown keys,
+(b) supports deprecated-field aliasing with warnings, and (c) understands the
+``"auto"`` placeholder convention (every key may be the literal string
+``"auto"``, resolved late — part of the public contract, SURVEY §5.6
+[L HF-DS:105-131]).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, TypeVar, Union
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from ..utils.logging import logger
+
+AUTO = "auto"
+
+T = TypeVar("T")
+#: Field type for keys that accept the "auto" placeholder.
+AutoOr = Union  # use as AutoOr[Literal["auto"], int] — kept for readability
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value == AUTO
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for every subsystem config.
+
+    ``deprecated_aliases`` on a subclass maps old key → new key; old keys are
+    accepted with a warning (the reference's deprecated-field machinery).
+    """
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True,
+                              validate_assignment=True)
+
+    #: old-name → new-name mapping, overridden by subclasses.
+    deprecated_aliases: Dict[str, str] = {}
+
+    @model_validator(mode="before")
+    @classmethod
+    def _apply_deprecated_aliases(cls, data: Any) -> Any:
+        if not isinstance(data, dict):
+            return data
+        aliases = {}
+        # class-var default, possibly overridden
+        default = cls.model_fields.get("deprecated_aliases")
+        if default is not None and default.default:
+            aliases = default.default
+        for old, new in aliases.items():
+            if old in data:
+                logger.warning(
+                    f"{cls.__name__}: config key '{old}' is deprecated, use '{new}'")
+                data.setdefault(new, data.pop(old))
+        return data
+
+    def resolve_auto(self, **resolved: Any) -> None:
+        """Replace ``"auto"`` fields with supplied values (late resolution)."""
+        for key, value in resolved.items():
+            if hasattr(self, key) and is_auto(getattr(self, key)):
+                setattr(self, key, value)
+
+
+def get_scalar_param(config_dict: Dict[str, Any], name: str, default: Any) -> Any:
+    """Reference helper name: fetch a top-level scalar with default."""
+    return config_dict.get(name, default)

@@ -1,0 +1,38 @@
+"""Config key names + defaults.
+
+Capability parity with the reference ``deepspeed/runtime/constants.py`` [K].
+Only the names that form the public ds_config contract are spelled out; the
+pydantic models in ``config.py`` are the source of truth for defaults.
+"""
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+GRADIENT_CLIPPING = "gradient_clipping"
+STEPS_PER_PRINT = "steps_per_print"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+
+OPTIMIZER = "optimizer"
+SCHEDULER = "scheduler"
+FP16 = "fp16"
+BF16 = "bf16"
+AMP = "amp"
+ZERO_OPTIMIZATION = "zero_optimization"
+
+# Optimizer type names accepted by config["optimizer"]["type"] (case-insens.).
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+LION_OPTIMIZER = "lion"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+MUON_OPTIMIZER = "muon"
+
+DEFAULT_LOSS_SCALE_POWER = 16
+PIPE_REPLICATED = "ds_pipe_replicated"
+
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"

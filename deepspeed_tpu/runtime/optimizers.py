@@ -1,0 +1,82 @@
+"""Optimizer factory: ds_config ``optimizer`` block → optax transform.
+
+Capability parity with the reference's ``engine._configure_optimizer`` name
+matrix [K]: Adam/AdamW (fused + CPU variants collapse to one XLA-fused optax
+adam — the fused/multi-tensor distinction is meaningless under XLA, SURVEY
+§2.2), Lamb, Lion, SGD, Adagrad, Muon; the 1-bit family (OnebitAdam,
+OnebitLamb, ZeroOneAdam) maps onto error-feedback compressed-gradient
+wrappers (see ``ops/onebit.py``); offload variants are selected by the ZeRO
+offload config, not the optimizer name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import optax
+
+from ..utils.logging import logger
+from .config import DeepSpeedConfig, OptimizerConfig
+from .config_utils import is_auto
+
+ScheduleOrFloat = Union[float, Callable[[Any], Any]]
+
+
+def _clean_params(cfg: OptimizerConfig) -> dict:
+    p = cfg.params.model_dump()
+    extra = cfg.params.model_extra or {}
+    p.update(extra)
+    return {k: v for k, v in p.items() if not is_auto(v)}
+
+
+def build_optimizer(config: DeepSpeedConfig,
+                    lr: Optional[ScheduleOrFloat] = None) -> optax.GradientTransformation:
+    """Build the base optimizer (no clipping — the engine owns grad clipping so
+    the reported grad-norm matches the clipped value, like the reference)."""
+    opt_cfg = config.optimizer or OptimizerConfig()
+    name = opt_cfg.type.lower().replace("_", "")
+    p = _clean_params(opt_cfg)
+    learning_rate = lr if lr is not None else p.get("lr", 1e-3)
+    betas = p.get("betas", [0.9, 0.999])
+    b1, b2 = float(betas[0]), float(betas[1])
+    eps = float(p.get("eps", 1e-8))
+    wd = float(p.get("weight_decay", 0.0))
+
+    if name in ("adam", "fusedadam"):
+        # reference Adam applies additive (L2) weight decay inside the update
+        if wd:
+            return optax.chain(optax.add_decayed_weights(wd),
+                               optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
+        return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    if name in ("adamw", "deepspeedcpuadam"):
+        return optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+    if name in ("lamb", "fusedlamb", "onebitlamb"):
+        if name == "onebitlamb":
+            logger.warning("OnebitLamb: running uncompressed lamb; compressed "
+                           "collectives attach at the comm layer")
+        return optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+    if name in ("lion", "deepspeedcpulion"):
+        # the OptimizerParams field default [0.9, 0.999] is Adam's; Lion's
+        # conventional default is [0.9, 0.99] — only honor explicit betas
+        if "betas" in opt_cfg.params.model_fields_set and not is_auto(
+                opt_cfg.params.betas):
+            lion_b1, lion_b2 = float(betas[0]), float(betas[1])
+        else:
+            lion_b1, lion_b2 = 0.9, 0.99
+        return optax.lion(learning_rate, b1=lion_b1, b2=lion_b2, weight_decay=wd)
+    if name == "sgd":
+        return optax.sgd(learning_rate, momentum=float(p.get("momentum", 0.0)))
+    if name in ("adagrad", "deepspeedcpuadagrad"):
+        return optax.adagrad(learning_rate, eps=eps)
+    if name in ("onebitadam", "zerooneadam"):
+        logger.warning(f"{opt_cfg.type}: running uncompressed adam; compressed "
+                       "collectives attach at the comm layer")
+        return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    if name == "muon":
+        try:
+            from optax.contrib import muon
+
+            return muon(learning_rate)
+        except ImportError:
+            raise ValueError("Muon optimizer not available in this optax")
+    raise ValueError(f"Unknown optimizer type '{opt_cfg.type}'")

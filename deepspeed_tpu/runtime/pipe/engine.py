@@ -1,0 +1,82 @@
+"""Pipeline engine.
+
+Capability parity target: the reference ``deepspeed/runtime/pipe/engine.py``
+(1F1B ``TrainSchedule`` instruction streams, P2P activations, tied-weight
+grad all-reduce [K]) — see SURVEY §3.5.
+
+TPU-native execution model: the microbatch loop compiles to a
+``jax.lax.scan`` whose body advances every stage one tick and moves boundary
+activations with ``ppermute`` along the ``pipe`` mesh axis inside
+``shard_map`` (GPipe-style fill/drain — arithmetically identical gradients to
+1F1B; 1F1B's benefit is eager-mode memory scheduling that XLA handles
+differently).  That path lives in ``parallel/pipeline.py`` once the ``pipe``
+axis size is > 1.
+
+This engine currently LOWERS THE SAME API onto a fused sequential program
+(stages chained inside one jit — correct for pp=1 and for validating pipeline
+models); the scan/ppermute schedule is wired in when `pipe`>1 support lands
+(tracked in SURVEY §7 build order step 10).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import DeepSpeedConfig
+from ..engine import DeepSpeedEngine
+from .module import PipelineModule, TiedLayerSpec
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Executes a PipelineModule; ``train_batch(data_iter)`` replaces the
+    fwd/bwd/step triple (reference contract)."""
+
+    def __init__(self, module: PipelineModule, config: DeepSpeedConfig,
+                 mesh=None, optimizer=None, lr_schedule=None):
+        if module.loss_fn is None:
+            raise ValueError("PipelineModule needs loss_fn for training")
+        rng = jax.random.PRNGKey(config.seed)
+        # Tied layers share ONE param leaf: autodiff sums the cotangents from
+        # every use site, which is exactly the reference's tied-weight grad
+        # all-reduce across stages. (Duplicating the leaf would both untie the
+        # weights and crash buffer donation.)
+        params: dict[str, Any] = {"layers": {}, "tied": {}}
+        for i, spec in enumerate(module.specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in params["tied"]:
+                    params["tied"][spec.key] = spec.build(jax.random.fold_in(rng, i))
+            else:
+                params["layers"][str(i)] = spec.build(jax.random.fold_in(rng, i))
+
+        def loss_fn(p, batch):
+            x, y = batch
+            for i, spec in enumerate(module.specs):
+                layer_p = (p["tied"][spec.key] if isinstance(spec, TiedLayerSpec)
+                           else p["layers"][str(i)])
+                x = spec.apply_fn(layer_p, x)
+            return module.loss_fn(x, y)
+
+        super().__init__(loss_fn=loss_fn, params=params, config=config,
+                         optimizer=optimizer, lr_schedule=lr_schedule,
+                         module=module, mesh=mesh)
+        self.pipeline_module = module
+
+    def train_batch(self, data_iter: Optional[Iterator] = None, batch=None):
+        """Consume one GLOBAL batch (or pull GAS microbatches from the
+        iterator) and run one compiled optimizer step."""
+        if batch is None:
+            if data_iter is None:
+                raise ValueError("train_batch needs data_iter or batch")
+            micros = [next(data_iter)
+                      for _ in range(self.gradient_accumulation_steps)]
+            batch = (micros[0] if len(micros) == 1 else
+                     jax.tree.map(lambda *xs: jnp.concatenate(xs), *micros))
+        metrics = self.train_step(batch)
+        return metrics["loss"]
+
+    def eval_batch(self, data_iter: Iterator):
+        batch = next(data_iter)
+        return self.eval_loss(batch)

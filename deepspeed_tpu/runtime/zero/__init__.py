@@ -1,0 +1,5 @@
+from .config import (DeepSpeedZeroConfig, DeepSpeedZeroOffloadOptimizerConfig,
+                     DeepSpeedZeroOffloadParamConfig, OffloadDeviceEnum)
+
+__all__ = ["DeepSpeedZeroConfig", "DeepSpeedZeroOffloadOptimizerConfig",
+           "DeepSpeedZeroOffloadParamConfig", "OffloadDeviceEnum"]

@@ -1,0 +1,122 @@
+"""ZeRO config schema.
+
+Capability parity with the reference ``deepspeed/runtime/zero/config.py`` and
+``offload_config.py`` [K]; key inventory from SURVEY §5.6 [L ACC-DC:1136-1171,
+HF-DS:216-255].  On TPU most knobs that tune the reference's hand-rolled
+gather/prefetch machinery (bucket sizes, prefetch, persistence thresholds,
+overlap_comm) are accepted for config compatibility but are advisory: GSPMD
+schedules the equivalent collectives.  They are still recorded and surfaced so
+configs round-trip, and a few (e.g. offload devices) change real behavior.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Literal, Optional, Union
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """``zero_optimization.offload_param`` (stage 3)."""
+
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """``zero_optimization.offload_optimizer`` (stages 1-3)."""
+
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0  # fraction of optimizer computed on offload device
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization.*``"""
+
+    stage: Literal[0, 1, 2, 3] = 0
+
+    # stage 1/2 machinery — advisory on TPU (GSPMD owns comm scheduling).
+    allgather_partitions: bool = True
+    allgather_bucket_size: Union[int, str] = 500_000_000
+    overlap_comm: Optional[bool] = None  # reference default depends on stage
+    reduce_scatter: bool = True
+    reduce_bucket_size: Union[int, str] = 500_000_000  # may be "auto"
+    contiguous_gradients: bool = True
+    round_robin_gradients: bool = False
+
+    # stage 3
+    stage3_prefetch_bucket_size: Union[int, str] = 50_000_000  # may be "auto"
+    stage3_param_persistence_threshold: Union[int, str] = 100_000  # may be "auto"
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    stage3_module_granularity_threshold: int = 0
+
+    # offload
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    # ZeRO++ (qwZ / hpZ / qgZ)
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+
+    # MiCS (hybrid shard)
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+
+    # misc parity knobs
+    sub_group_size: int = 1_000_000_000
+    elastic_checkpoint: bool = False
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    cpu_offload: Optional[bool] = Field(default=None, deprecated=True)
+    param_persistence_threshold: Optional[int] = None
+    model_persistence_threshold: Optional[int] = None
+    zeropp_loco_param: Optional[dict] = None
+    log_trace_cache_warnings: bool = False
+
+    def offload_optimizer_device(self) -> OffloadDeviceEnum:
+        if self.cpu_offload:  # deprecated bool form
+            return OffloadDeviceEnum.cpu
+        if self.offload_optimizer is None:
+            return OffloadDeviceEnum.none
+        return self.offload_optimizer.device
+
+    def offload_param_device(self) -> OffloadDeviceEnum:
+        if self.offload_param is None:
+            return OffloadDeviceEnum.none
+        return self.offload_param.device
+
+    def resolve_auto_from_hidden_size(self, hidden_size: int) -> None:
+        """The reference's ``"auto"`` heuristics [L HF-DS:216-255]:
+        reduce_bucket_size = hidden², prefetch = 0.9·hidden²,
+        persistence threshold = 10·hidden."""
+        from ..config_utils import is_auto
+
+        if is_auto(self.reduce_bucket_size):
+            self.reduce_bucket_size = hidden_size * hidden_size
+        if is_auto(self.stage3_prefetch_bucket_size):
+            self.stage3_prefetch_bucket_size = int(0.9 * hidden_size * hidden_size)
+        if is_auto(self.stage3_param_persistence_threshold):
+            self.stage3_param_persistence_threshold = 10 * hidden_size

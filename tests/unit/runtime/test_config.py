@@ -1,0 +1,127 @@
+import base64
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import OffloadDeviceEnum
+
+HF_STYLE_CONFIG = {
+    "train_batch_size": "auto",
+    "train_micro_batch_size_per_gpu": "auto",
+    "gradient_accumulation_steps": "auto",
+    "gradient_clipping": "auto",
+    "bf16": {"enabled": "auto"},
+    "fp16": {"enabled": "auto"},
+    "optimizer": {
+        "type": "AdamW",
+        "params": {"lr": "auto", "betas": "auto", "eps": "auto",
+                   "weight_decay": "auto"},
+    },
+    "scheduler": {
+        "type": "WarmupLR",
+        "params": {"warmup_min_lr": "auto", "warmup_max_lr": "auto",
+                   "warmup_num_steps": "auto"},
+    },
+    "zero_optimization": {
+        "stage": 3,
+        "offload_optimizer": {"device": "cpu", "pin_memory": True},
+        "offload_param": {"device": "cpu", "pin_memory": True},
+        "overlap_comm": True,
+        "contiguous_gradients": True,
+        "reduce_bucket_size": "auto",
+        "stage3_prefetch_bucket_size": "auto",
+        "stage3_param_persistence_threshold": "auto",
+        "stage3_max_live_parameters": 1e9,
+        "stage3_max_reuse_distance": 1e9,
+        "stage3_gather_16bit_weights_on_model_save": True,
+    },
+    "steps_per_print": 2000,
+    "wall_clock_breakdown": False,
+}
+
+
+def test_parse_hf_style_config_verbatim():
+    """The exact shape of an HF Trainer auto config must parse (§5.6)."""
+    cfg = DeepSpeedConfig.model_validate(HF_STYLE_CONFIG)
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == OffloadDeviceEnum.cpu
+    assert cfg.zero_optimization.stage3_gather_16bit_weights_on_model_save
+
+
+def test_auto_resolution_hidden_size_heuristics():
+    cfg = DeepSpeedConfig.model_validate(HF_STYLE_CONFIG)
+    cfg.zero_optimization.resolve_auto_from_hidden_size(1024)
+    assert cfg.zero_optimization.reduce_bucket_size == 1024 * 1024
+    assert cfg.zero_optimization.stage3_prefetch_bucket_size == int(0.9 * 1024 * 1024)
+    assert cfg.zero_optimization.stage3_param_persistence_threshold == 10 * 1024
+
+
+def test_batch_math_infer_gas():
+    cfg = DeepSpeedConfig.model_validate(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_math_infer_micro():
+    cfg = DeepSpeedConfig.model_validate(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 4})
+    cfg.resolve_batch_sizes(world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_math_sp_divides_world():
+    # sp ranks share batch shards: dp_world = 8/(sp=2) = 4 [L ACC:2223-2228]
+    cfg = DeepSpeedConfig.model_validate({"train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(world_size=8, sp=2)
+    assert cfg.train_batch_size == 2 * 4
+
+
+def test_batch_math_violation_raises():
+    cfg = DeepSpeedConfig.model_validate(
+        {"train_batch_size": 30, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2})
+    with pytest.raises(ValueError, match="invariant|divisible"):
+        cfg.resolve_batch_sizes(world_size=8)
+
+
+def test_base64_and_path_loading(tmp_path):
+    payload = {"train_micro_batch_size_per_gpu": 4, "zero_optimization": {"stage": 1}}
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(payload))
+    cfg = DeepSpeedConfig.from_dict_or_path(str(p), world_size=8)
+    assert cfg.zero_optimization.stage == 1
+    assert cfg.train_batch_size == 32
+
+    b64 = base64.urlsafe_b64encode(json.dumps(payload).encode()).decode()
+    cfg2 = DeepSpeedConfig.from_dict_or_path(b64, world_size=8)
+    assert cfg2.zero_optimization.stage == 1
+
+
+def test_dtype_precedence():
+    cfg = DeepSpeedConfig.model_validate({"bf16": {"enabled": True},
+                                          "fp16": {"enabled": True}})
+    assert cfg.dtype() == jnp.bfloat16
+    cfg2 = DeepSpeedConfig.model_validate({"fp16": {"enabled": True}})
+    assert cfg2.dtype() == jnp.float16
+    assert DeepSpeedConfig().dtype() == jnp.float32
+
+
+def test_resolve_auto_precision_defaults_bf16():
+    cfg = DeepSpeedConfig.model_validate(HF_STYLE_CONFIG)
+    cfg.resolve_auto_precision()
+    assert cfg.bf16.enabled is True
+    assert cfg.fp16.enabled is False
+
+
+def test_unknown_keys_tolerated():
+    cfg = DeepSpeedConfig.model_validate({"some_future_key": {"x": 1}})
+    assert cfg.some_future_key == {"x": 1}
+
+
+def test_deprecated_cpu_offload_bool():
+    cfg = DeepSpeedConfig.model_validate(
+        {"zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert cfg.zero_optimization.offload_optimizer_device() == OffloadDeviceEnum.cpu
