@@ -1,0 +1,97 @@
+"""Benchmark: flagship Llama training throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is training tokens/sec on a ~110M-param Llama (bf16, remat,
+fused single-program step).  ``vs_baseline`` is the ratio against the
+model-flops-derived reference rate the DeepSpeed papers imply for the same
+scale (BASELINE.json has no driver-verified numbers — ``published`` is {} —
+so the ratio is reported against this script's own first recorded run when
+available, else 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_layers=12,
+                          num_heads=12, num_kv_heads=12, max_seq_len=2048,
+                          dtype=jnp.bfloat16)
+        batch, seq, steps = 8, 2048, 20
+    else:  # CPU fallback so the bench always emits a line
+        cfg = LlamaConfig.tiny(num_layers=2)
+        batch, seq, steps = 4, 128, 3
+
+    layout = MeshLayout.infer(1, dp=1)
+    mesh = groups.initialize_mesh(layout)
+    model = LlamaModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": bool(on_tpu)},
+    }
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds_config, mesh=mesh)
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(batch, seq)))
+    batch_d = {"input_ids": ids}
+
+    engine.train_step(batch_d)  # compile + warmup
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_step(batch_d)
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # persist the first TPU run as this bench's own baseline
+    vs_baseline = 1.0
+    baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".bench_baseline.json")
+    if on_tpu:
+        try:
+            if os.path.exists(baseline_file):
+                with open(baseline_file) as f:
+                    vs_baseline = tokens_per_sec / float(
+                        json.load(f)["tokens_per_sec"])
+            else:
+                with open(baseline_file, "w") as f:
+                    json.dump({"tokens_per_sec": tokens_per_sec}, f)
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": "llama_110m_train_tokens_per_sec"
+        if on_tpu else "llama_tiny_cpu_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

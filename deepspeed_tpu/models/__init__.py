@@ -1,0 +1,13 @@
+"""Model zoo — TPU-native reference models for the driver config ladder.
+
+The reference ships no models of its own for training (users bring torch
+modules); its inference-v2 tree carries llama/mistral/mixtral implementations
+(``deepspeed/inference/v2/model_implementations/`` [K]).  Here the model zoo
+is first-class because the JAX engine consumes pure loss functions: each
+model exposes ``init_params``, ``forward``, ``loss`` and partition-spec rules
+that compose with the ZeRO sharding policy.
+"""
+
+from .llama import LlamaConfig, LlamaModel
+
+__all__ = ["LlamaConfig", "LlamaModel"]

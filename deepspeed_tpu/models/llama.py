@@ -1,0 +1,308 @@
+"""Llama-family decoder — the flagship train/bench model, TPU-first.
+
+Capability anchor: the reference trains HF torch Llama through its engine and
+ships llama model implementations for inference
+(``deepspeed/inference/v2/model_implementations/llama_v2/`` [K]); the driver
+ladder names Llama-3-8B (ZeRO-3) and Llama-3-70B (Infinity + Ulysses SP) as
+headline configs [D BASELINE.json].
+
+TPU-first design, none of which mirrors the reference's torch modules:
+
+* **Stacked-layer params + ``lax.scan``** — one compiled layer body regardless
+  of depth: compile time O(1) in num_layers, and the layout pipeline/layer-
+  streaming (ZeRO-Infinity) needs is the native one.
+* **GSPMD Ulysses** — sequence parallelism is expressed as sharding
+  constraints: activations ride sequence-sharded ``[B, S/sp, H]`` everywhere
+  except attention, where Q/K/V are constrained to head-sharded
+  ``[B, S, h/(sp·tp), D]``; XLA inserts the all-to-alls the reference issues
+  by hand in ``ulysses_sp.py`` (SURVEY §5.7).
+* **Tensor parallelism** — Megatron-style column/row sharding is a
+  PartitionSpec on the weights (``tensor`` axis) + the same activation
+  constraints; no module surgery (reference: ``module_inject/auto_tp.py``).
+* **Remat** — ``jax.checkpoint`` on the layer body with a dots-saveable
+  policy ≈ reference ``activation_checkpointing`` with partitioned
+  activations for free (saved residuals inherit their shardings).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
+
+P = PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim if self.head_dim is not None else (
+            self.hidden_size // self.num_heads)
+
+    # ------------------------------------------------------------------
+    # presets (sizes follow the public Llama/Llama-3 configs)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test/CI model — small enough for an 8-device CPU mesh."""
+        d = dict(vocab_size=512, hidden_size=128, intermediate_size=352,
+                 num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=256)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        d = dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                 num_layers=32, num_heads=32, num_kv_heads=8,
+                 max_seq_len=8192, rope_theta=500000.0)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> "LlamaConfig":
+        d = dict(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+                 num_layers=80, num_heads=64, num_kv_heads=8,
+                 max_seq_len=8192, rope_theta=500000.0)
+        d.update(kw)
+        return cls(**d)
+
+    def num_params(self) -> int:
+        H, I, V, L = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_layers)
+        hd, nh, nkv = self.hd, self.num_heads, self.num_kv_heads
+        per_layer = (H * nh * hd + 2 * H * nkv * hd + nh * hd * H  # attn
+                     + 3 * H * I  # swiglu (gate, up, down)
+                     + 2 * H)  # norms
+        head = H if self.tie_embeddings else H + H * V
+        return V * H + L * per_layer + head
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(dt) * w
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding on [..., S, h, D] with positions [..., S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, mask):
+    """Reference attention: fp32 softmax; [B, S, h, D] layout.
+
+    Swapped for the Pallas flash kernel on TPU via ops.attention once the
+    kernel path lands (SURVEY §7 phase 11) — the caller controls that.
+    """
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class LlamaModel:
+    """Functional model: params are a plain pytree, forward is pure.
+
+    ``mesh=None`` (single device) skips all sharding constraints; with a mesh,
+    the constraints express ZeRO/TP/SP placement and GSPMD inserts the
+    collectives.
+    """
+
+    def __init__(self, config: LlamaConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        H, I, V, L = c.hidden_size, c.intermediate_size, c.vocab_size, c.num_layers
+        hd, nh, nkv = c.hd, c.num_heads, c.num_kv_heads
+        k = iter(jax.random.split(rng, 9))
+
+        def normal(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (1.0 / np.sqrt(fan_in))).astype(jnp.float32)
+
+        params = {
+            "embed": normal(next(k), (V, H), H),
+            "layers": {
+                "attn": {
+                    "wq": normal(next(k), (L, H, nh, hd), H),
+                    "wk": normal(next(k), (L, H, nkv, hd), H),
+                    "wv": normal(next(k), (L, H, nkv, hd), H),
+                    "wo": normal(next(k), (L, nh, hd, H), nh * hd),
+                },
+                "mlp": {
+                    "w_gate": normal(next(k), (L, H, I), H),
+                    "w_up": normal(next(k), (L, H, I), H),
+                    "w_down": normal(next(k), (L, I, H), I),
+                },
+                "attn_norm": jnp.ones((L, H), jnp.float32),
+                "mlp_norm": jnp.ones((L, H), jnp.float32),
+            },
+            "final_norm": jnp.ones((H,), jnp.float32),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = normal(next(k), (H, V), H)
+        return params
+
+    # ------------------------------------------------------------------
+    # partition specs (composed with ZeRO by the engine's sharding policy)
+    # ------------------------------------------------------------------
+
+    def param_specs(self, params: Optional[Any] = None) -> Dict[str, Any]:
+        """Megatron-style TP specs on the ``tensor`` axis; DP/ZeRO axes are
+        layered on top by ``ZeroShardingPolicy.compose`` (reference analogue:
+        AutoTP column/row policy, ``module_inject/auto_tp.py`` [K])."""
+        t = AXIS_TENSOR
+        specs = {
+            "embed": P(None, None),  # vocab gather stays local; H replicated
+            "layers": {
+                "attn": {
+                    "wq": P(None, None, t, None),   # column (head) split
+                    "wk": P(None, None, t, None),
+                    "wv": P(None, None, t, None),
+                    "wo": P(None, t, None, None),   # row split
+                },
+                "mlp": {
+                    "w_gate": P(None, None, t),     # column split
+                    "w_up": P(None, None, t),
+                    "w_down": P(None, t, None),     # row split
+                },
+                "attn_norm": P(None, None),
+                "mlp_norm": P(None, None),
+            },
+            "final_norm": P(None),
+        }
+        if not self.config.tie_embeddings:
+            specs["lm_head"] = P(None, t)  # vocab-sharded output projection
+        return specs
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+
+    def _constrain(self, x: jnp.ndarray, *spec) -> jnp.ndarray:
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(*spec)))
+
+    def forward(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """[B, S] token ids → [B, S, V] logits (compute dtype, fp32 logits)."""
+        c = self.config
+        x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
+        # activations ride batch-sharded + sequence-sharded (Ulysses home
+        # layout; a 1-sized seq axis makes this a no-op)
+        x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
+
+        B, S = input_ids.shape
+        positions = jnp.arange(S)[None, :]
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+
+        n_rep = c.num_heads // c.num_kv_heads
+
+        def layer(x, lp):
+            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+            q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
+            kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
+            vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
+            # Ulysses boundary: full sequence, heads sharded over (seq, tensor)
+            q = self._constrain(q, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
+            kk = self._constrain(kk, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
+            vv = self._constrain(vv, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
+            q = _rope(q, positions, c.rope_theta)
+            kk = _rope(kk, positions, c.rope_theta)
+            if n_rep > 1:  # GQA: repeat KV heads
+                kk = jnp.repeat(kk, n_rep, axis=2)
+                vv = jnp.repeat(vv, n_rep, axis=2)
+            attn = _attention(q, kk, vv, causal)
+            attn = self._constrain(attn, DP_AXES, None,
+                                   (AXIS_SEQ, AXIS_TENSOR), None)
+            out = jnp.einsum("bshd,hdH->bsH", attn,
+                             lp["attn"]["wo"].astype(c.dtype))
+            # back to the sequence-sharded home layout
+            x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
+
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            gate = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_gate"].astype(c.dtype))
+            up = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_up"].astype(c.dtype))
+            act = self._constrain(jax.nn.silu(gate) * up,
+                                  DP_AXES, AXIS_SEQ, AXIS_TENSOR)
+            down = jnp.einsum("bsI,IH->bsH", act,
+                              lp["mlp"]["w_down"].astype(c.dtype))
+            x = self._constrain(x + down, DP_AXES, AXIS_SEQ, None)
+            return x, None
+
+        body = layer
+        if c.remat:
+            body = jax.checkpoint(
+                layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp),
+                            x, params["layers"])
+
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = jnp.einsum("bsH,HV->bsV", x, head.astype(c.dtype))
+        return logits.astype(jnp.float32)
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------
+    # loss
+    # ------------------------------------------------------------------
+
+    def loss(self, params: Any, batch: Any) -> jnp.ndarray:
+        """Next-token cross entropy.  ``batch`` is ``{"input_ids": [B, S]}``
+        (labels = shifted inputs) or ``{"input_ids", "labels"}`` with -100
+        ignore positions (HF convention)."""
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        logits = self.forward(params, input_ids)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+            jnp.sum(valid), 1)

@@ -112,7 +112,14 @@ def build_mesh(layout: Optional[MeshLayout] = None,
     (CPU) device sets where there is no topology to exploit.
     """
     layout = layout or MeshLayout.infer()
-    devices = list(devices) if devices is not None else jax.devices()
+    if devices is None:
+        devices = jax.devices()
+        # A single-device layout on a multi-device host is an explicit ask
+        # (tests/bench baselines); any other undercount stays a hard error so
+        # misconfigured layouts don't silently train on a device subset.
+        if layout.world_size == 1 and len(devices) > 1:
+            devices = devices[:1]
+    devices = list(devices)
     if len(devices) != layout.world_size:
         raise ValueError(f"{len(devices)} devices != layout world {layout.world_size}")
     shape = tuple(layout.axis_sizes[a] for a in MESH_AXIS_ORDER)

@@ -42,6 +42,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     with ocp.StandardCheckpointer() as saver:
         saver.save(os.path.join(ckpt_dir, "state"), engine.state, force=True)
 
+    # sync the scheduler to the APPLIED step (excludes fp16 overflow skips;
+    # the per-step fast path tracks global_steps to avoid a device sync)
+    engine.lr_scheduler.last_step = int(engine.state.step)
     meta = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
@@ -88,17 +91,28 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # Restore INTO the engine's current sharded layout: orbax reshards on
     # load, so a checkpoint written on a different mesh/world restores
     # correctly (the reference's universal-checkpoint capability).
-    target = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype,
-                                       sharding=getattr(x, "sharding", None)),
-        engine.state)
-    with ocp.StandardCheckpointer() as loader:
-        restored = loader.restore(os.path.join(ckpt_dir, "state"), target)
+    def abstract(x):
+        return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                    sharding=getattr(x, "sharding", None))
 
-    if load_module_only or not load_optimizer_states:
-        engine.state = engine.state._replace(params=restored.params)
-    else:
-        engine.state = restored
+    params_only = load_module_only or not load_optimizer_states
+    state_path = os.path.join(ckpt_dir, "state")
+    with ocp.StandardCheckpointer() as loader:
+        if params_only:
+            # Build the non-params target from the SAVED metadata so a
+            # module-only load works against a DIFFERENT optimizer than the
+            # one that saved (reference: load_module_only skips optimizer
+            # state [K]); only the params subtree binds to engine shardings.
+            meta = loader.metadata(state_path).item_metadata.tree
+            target = jax.tree.map(
+                lambda am: jax.ShapeDtypeStruct(tuple(am.shape), am.dtype),
+                meta)
+            target["params"] = jax.tree.map(abstract, engine.state.params)
+            restored = loader.restore(state_path, target)
+            engine.state = engine.state._replace(params=restored["params"])
+        else:
+            target = jax.tree.map(abstract, engine.state)
+            engine.state = loader.restore(state_path, target)
 
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state: Dict[str, Any] = {}

@@ -70,6 +70,11 @@ class DeepSpeedEngine:
         self.mesh = mesh if mesh is not None else groups_mod.get_mesh()
         self.policy = ZeroShardingPolicy.from_config(self.mesh,
                                                      config.zero_optimization)
+        # Model-provided TP/SP placement (reference analogue: AutoTP policy);
+        # ZeRO DP sharding is composed on top by the policy.
+        self.base_specs = (module.param_specs()
+                          if callable(getattr(module, "param_specs", None))
+                          else None)
         from .zero.config import OffloadDeviceEnum
 
         if (config.zero_optimization.offload_optimizer_device()
@@ -117,7 +122,9 @@ class DeepSpeedEngine:
             initial_scale_power=min(fp16.initial_scale_power, 15),
             loss_scale_window=fp16.loss_scale_window,
             hysteresis=fp16.hysteresis, min_loss_scale=fp16.min_loss_scale,
-            static_scale=fp16.loss_scale) if self.fp16_enabled else None
+            static_scale=fp16.loss_scale,
+            consecutive_hysteresis=fp16.consecutive_hysteresis
+        ) if self.fp16_enabled else None
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -143,11 +150,12 @@ class DeepSpeedEngine:
 
     def _init_state(self, params: Any) -> TrainState:
         params = jax.tree.map(jnp.asarray, params)
-        param_shardings = self.policy.param_shardings(params)
+        param_shardings = self.policy.param_shardings(params, self.base_specs)
         params = jax.device_put(params, param_shardings)
 
         opt_shapes = jax.eval_shape(self.optimizer.init, params)
-        opt_shardings = self.policy.opt_state_shardings(opt_shapes)
+        opt_shardings = self.policy.opt_state_shardings(
+            opt_shapes, tx=self.optimizer, base_specs=self.base_specs)
         opt_state = jax.jit(self.optimizer.init,
                             out_shardings=opt_shardings)(params)
 
@@ -217,7 +225,7 @@ class DeepSpeedEngine:
                 mean_loss = loss_sum
 
             # ZeRO stage >= 2: pin grads to their reduce-scattered layout.
-            grads = policy.apply_grad_constraints(grads)
+            grads = policy.apply_grad_constraints(grads, self.base_specs)
 
             overflow = has_overflow(grads) if fp16 else jnp.bool_(False)
             grads = jax.tree.map(lambda g: jnp.where(overflow, 0.0, g), grads)
@@ -342,21 +350,20 @@ class DeepSpeedEngine:
         buffered = self._microbatch_buffer
         self._microbatch_buffer = []
         n = len(buffered)
-        if n != self.gradient_accumulation_steps:
-            # partial accumulation (forced boundary): rebuild step for n
-            logger.warning(f"stepping with {n} buffered microbatches "
-                           f"(configured GAS={self.gradient_accumulation_steps})")
-            saved_gas, saved_fn = self.gradient_accumulation_steps, self._train_step_fn
-            self.gradient_accumulation_steps, self._train_step_fn = n, None
-            try:
-                batch = jax.tree.map(lambda *xs: jnp.concatenate(xs), *buffered)
-                return self.train_step(batch)
-            finally:
-                self.gradient_accumulation_steps = saved_gas
-                self._train_step_fn = saved_fn
         batch = (buffered[0] if n == 1 else
                  jax.tree.map(lambda *xs: jnp.concatenate(xs), *buffered))
-        return self.train_step(batch)
+        if n == self.gradient_accumulation_steps:
+            return self.train_step(batch)
+        # partial accumulation (forced boundary): rebuild the step for n
+        logger.warning(f"stepping with {n} buffered microbatches "
+                       f"(configured GAS={self.gradient_accumulation_steps})")
+        saved_gas, saved_fn = self.gradient_accumulation_steps, self._train_step_fn
+        self.gradient_accumulation_steps, self._train_step_fn = n, None
+        try:
+            return self.train_step(batch)
+        finally:
+            self.gradient_accumulation_steps = saved_gas
+            self._train_step_fn = saved_fn
 
     # ------------------------------------------------------------------
     # introspection parity
@@ -368,7 +375,12 @@ class DeepSpeedEngine:
         return float(self.last_metrics["grad_norm"])
 
     def get_lr(self) -> List[float]:
-        return [float(self._schedule(self.global_steps))]
+        # state.step excludes overflow-skipped steps — it is the step the
+        # compiled program actually fed to the schedule (global_steps counts
+        # skips too and would drift ahead after any fp16 overflow).
+        applied_step = int(self.state.step)
+        self.lr_scheduler.last_step = applied_step
+        return [float(self._schedule(applied_step))]
 
     def get_loss_scale(self) -> float:
         return float(self.state.loss_scale.scale)

@@ -64,19 +64,25 @@ def initialize(args: Any = None,
     cfg = _resolve_config(config, config_params)
 
     # Build/adopt the mesh from the parallel dims in config (+ mpu hints).
-    tp = int(cfg.tensor_parallel.autotp_size or 1)
-    sp = int(cfg.sequence_parallel.sp_size or 1)
-    pp = int(cfg.pipeline.stages or 1)
-    ep = 1
-    if mpu is not None and hasattr(mpu, "get_sequence_parallel_world_size"):
-        sp = int(mpu.get_sequence_parallel_world_size())
     if mesh is None:
+        tp = int(cfg.tensor_parallel.autotp_size or 1)
+        sp = int(cfg.sequence_parallel.sp_size or 1)
+        pp = int(cfg.pipeline.stages or 1)
+        ep = 1
+        if mpu is not None and hasattr(mpu, "get_sequence_parallel_world_size"):
+            sp = int(mpu.get_sequence_parallel_world_size())
         layout = MeshLayout.infer(jax.device_count(), tp=tp, pp=pp, sp=sp, ep=ep)
         mesh = groups_mod.initialize_mesh(layout)
+        world = jax.device_count()
     else:
+        # an explicit mesh is authoritative for every parallel dim
         groups_mod.initialize_mesh(mesh=mesh)
+        tp = int(mesh.shape.get("tensor", 1))
+        sp = int(mesh.shape.get("seq", 1))
+        pp = int(mesh.shape.get("pipe", 1))
+        world = int(mesh.devices.size)
 
-    cfg.resolve_batch_sizes(world_size=jax.device_count(), tp=tp, pp=pp, sp=sp)
+    cfg.resolve_batch_sizes(world_size=world, tp=tp, pp=pp, sp=sp)
     cfg.resolve_auto_precision()
 
     if cfg.comms_logger.enabled:

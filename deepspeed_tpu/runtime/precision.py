@@ -32,12 +32,13 @@ class DynamicLossScaler:
 
     def __init__(self, initial_scale_power: int = 16, loss_scale_window: int = 1000,
                  hysteresis: int = 2, min_loss_scale: float = 1.0,
-                 static_scale: float = 0.0):
+                 static_scale: float = 0.0, consecutive_hysteresis: bool = False):
         self.init_scale = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
         self.window = loss_scale_window
         self.hysteresis = hysteresis
         self.min_scale = min_loss_scale
         self.static = static_scale > 0
+        self.consecutive_hysteresis = consecutive_hysteresis
 
     def init_state(self) -> LossScaleState:
         return LossScaleState(scale=jnp.float32(self.init_scale),
@@ -45,15 +46,23 @@ class DynamicLossScaler:
                               hysteresis=jnp.int32(self.hysteresis))
 
     def update(self, state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState:
+        """Reference semantics (``fp16/loss_scaler.py:update_scale`` [K]):
+        overflow with hysteresis left → decrement only; at hysteresis 1 →
+        halve.  Hysteresis restores on every clean step only under
+        ``consecutive_hysteresis``; otherwise at the growth window."""
         if self.static:
             return state
-        hyst = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0),
-                         jnp.int32(self.hysteresis))
         cut = overflow & (state.hysteresis <= 1)
+        hyst = jnp.where(overflow & (state.hysteresis > 1),
+                         state.hysteresis - 1, state.hysteresis)
         new_scale = jnp.where(
             cut, jnp.maximum(state.scale / 2.0, self.min_scale), state.scale)
+        if self.consecutive_hysteresis:
+            hyst = jnp.where(overflow, hyst, jnp.int32(self.hysteresis))
         counter = jnp.where(overflow, 0, state.growth_counter + 1)
         grow = (~overflow) & (counter >= self.window)
+        if not self.consecutive_hysteresis:
+            hyst = jnp.where(grow, jnp.int32(self.hysteresis), hyst)
         new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
         counter = jnp.where(grow, 0, counter)
         return LossScaleState(scale=new_scale, growth_counter=counter,

@@ -71,56 +71,109 @@ class ZeroShardingPolicy:
     # per-leaf spec rules
     # ------------------------------------------------------------------
 
-    def _shard_spec_for_shape(self, shape: Tuple[int, ...]) -> PartitionSpec:
-        """Largest dim divisible by dp_size gets the DP axes; else replicated."""
+    def _shard_spec_for_shape(
+            self, shape: Tuple[int, ...],
+            base: Optional[PartitionSpec] = None) -> PartitionSpec:
+        """Largest free dim divisible by dp_size gets the DP axes.
+
+        ``base`` carries model-provided specs (TP ``tensor`` axis, etc. —
+        reference analogue: AutoTP's column/row decision); ZeRO composes by
+        claiming a dim the base left unsharded.  With no eligible dim the
+        tensor stays in its base placement (replicated over DP) — the
+        reference's same fallback for unpartitionable tensors.
+        """
+        entries = list(base) if base is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        base_spec = PartitionSpec(*entries) if any(
+            e is not None for e in entries) else PartitionSpec()
         if self.dp_size == 1 or not shape:
-            return PartitionSpec()
+            return base_spec
         if int(np.prod(shape)) <= self.persistence_threshold:
-            return PartitionSpec()  # persisted small param — stay replicated
+            return base_spec  # persisted small param — stay replicated over DP
         candidates = [(dim, i) for i, dim in enumerate(shape)
-                      if dim % self.dp_size == 0]
+                      if entries[i] is None and dim % self.dp_size == 0]
         if not candidates:
-            return PartitionSpec()
+            return base_spec
         _, best = max(candidates, key=lambda t: (t[0], -t[1]))
-        spec = [None] * len(shape)
-        spec[best] = self.shard_axes
-        return PartitionSpec(*spec)
+        entries[best] = self.shard_axes
+        return PartitionSpec(*entries)
 
-    def param_spec(self, leaf: Any) -> PartitionSpec:
+    def _base_or_empty(self, base: Optional[PartitionSpec],
+                       shape: Tuple[int, ...]) -> PartitionSpec:
+        if base is None:
+            return PartitionSpec()
+        entries = list(base) + [None] * (len(shape) - len(base))
+        return PartitionSpec(*entries)
+
+    def param_spec(self, leaf: Any,
+                   base: Optional[PartitionSpec] = None) -> PartitionSpec:
+        shape = tuple(np.shape(leaf))
         if self.stage < 3:
-            return PartitionSpec()
-        return self._shard_spec_for_shape(tuple(np.shape(leaf)))
+            return self._base_or_empty(base, shape)
+        return self._shard_spec_for_shape(shape, base)
 
-    def grad_spec(self, leaf: Any) -> PartitionSpec:
+    def grad_spec(self, leaf: Any,
+                  base: Optional[PartitionSpec] = None) -> PartitionSpec:
         # stage >= 2: grads live reduce-scattered; in-jit this is a constraint.
+        shape = tuple(np.shape(leaf))
         if self.stage < 2:
-            return PartitionSpec()
-        return self._shard_spec_for_shape(tuple(np.shape(leaf)))
+            return self._base_or_empty(base, shape)
+        return self._shard_spec_for_shape(shape, base)
 
-    def opt_state_spec(self, leaf: Any) -> PartitionSpec:
+    def opt_state_spec(self, leaf: Any,
+                       base: Optional[PartitionSpec] = None) -> PartitionSpec:
         # stage >= 1: optimizer states (incl. fp32 master copies) sharded.
+        shape = tuple(np.shape(leaf))
         if self.stage < 1:
-            return PartitionSpec()
-        return self._shard_spec_for_shape(tuple(np.shape(leaf)))
+            return self._base_or_empty(base, shape)
+        return self._shard_spec_for_shape(shape, base)
 
     # ------------------------------------------------------------------
-    # pytree-level helpers
+    # pytree-level helpers — ``base_specs`` is a matching pytree of
+    # PartitionSpecs from the model (TP/SP placement) or None
     # ------------------------------------------------------------------
 
-    def param_shardings(self, params: Any) -> Any:
-        return jax.tree.map(
-            lambda p: NamedSharding(self.mesh, self.param_spec(p)), params)
+    def _map_with_base(self, fn, tree: Any, base_specs: Any) -> Any:
+        if base_specs is None:
+            return jax.tree.map(lambda p: fn(p, None), tree)
+        return jax.tree.map(fn, tree, base_specs)
 
-    def param_specs(self, params: Any) -> Any:
-        return jax.tree.map(lambda p: self.param_spec(p), params)
+    def param_shardings(self, params: Any, base_specs: Any = None) -> Any:
+        return self._map_with_base(
+            lambda p, b: NamedSharding(self.mesh, self.param_spec(p, b)),
+            params, base_specs)
 
-    def grad_specs(self, params: Any) -> Any:
-        return jax.tree.map(lambda p: self.grad_spec(p), params)
+    def param_specs(self, params: Any, base_specs: Any = None) -> Any:
+        return self._map_with_base(
+            lambda p, b: self.param_spec(p, b), params, base_specs)
 
-    def opt_state_shardings(self, opt_state: Any, params_reference: Any = None) -> Any:
+    def grad_specs(self, params: Any, base_specs: Any = None) -> Any:
+        return self._map_with_base(
+            lambda p, b: self.grad_spec(p, b), params, base_specs)
+
+    def opt_state_shardings(self, opt_state: Any, tx: Any = None,
+                            base_specs: Any = None) -> Any:
         """Shardings for an optax state pytree.  Leaves that mirror a param
         shape (mu/nu/master copies) shard like params-at-stage≥1; scalar
-        counters replicate."""
+        counters replicate.  With model ``base_specs`` the param↔state
+        correspondence comes from ``optax.tree_map_params`` so TP axes carry
+        into the mirrored moments."""
+        if base_specs is not None and tx is not None:
+            import optax
+
+            def for_param_leaf(leaf, base):
+                return NamedSharding(
+                    self.mesh, self.opt_state_spec(leaf, base)
+                    if np.ndim(leaf) > 0 else PartitionSpec())
+
+            def for_other_leaf(leaf):
+                return NamedSharding(
+                    self.mesh, self.opt_state_spec(leaf)
+                    if np.ndim(leaf) > 0 else PartitionSpec())
+
+            return optax.tree_map_params(
+                tx, for_param_leaf, opt_state, base_specs,
+                transform_non_params=for_other_leaf)
 
         def leaf_sharding(leaf):
             return NamedSharding(
@@ -129,14 +182,15 @@ class ZeroShardingPolicy:
 
         return jax.tree.map(leaf_sharding, opt_state)
 
-    def apply_grad_constraints(self, grads: Any) -> Any:
+    def apply_grad_constraints(self, grads: Any, base_specs: Any = None) -> Any:
         """Inside-jit: force reduce-scatter placement of grads (stage ≥ 2)."""
         if self.stage < 2:
             return grads
-        return jax.tree.map(
-            lambda g: jax.lax.with_sharding_constraint(
-                g, NamedSharding(self.mesh, self._shard_spec_for_shape(g.shape))),
-            grads)
+        return self._map_with_base(
+            lambda g, b: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh,
+                                 self._shard_spec_for_shape(g.shape, b))),
+            grads, base_specs)
 
 
 def sharded_zeros_like(policy: ZeroShardingPolicy, tree: Any, kind: str = "param"):
