@@ -54,6 +54,9 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: >1 → chunk final projection+loss over the sequence so the [B,S,V]
+    #: logits are never materialized (ALST sequence-tiled loss)
+    loss_tiles: int = 1
 
     @property
     def hd(self) -> int:
@@ -222,37 +225,44 @@ class LlamaModel:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(*spec)))
 
-    def forward(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """[B, S] token ids → [B, S, V] logits (compute dtype, fp32 logits)."""
+    def _forward_trunk(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """[B, S] token ids → final-norm hidden states [B, S, H]."""
+        from ..runtime.sequence_parallel.ulysses_sp import ulysses_attention
+
         c = self.config
         x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
         # activations ride batch-sharded + sequence-sharded (Ulysses home
         # layout; a 1-sized seq axis makes this a no-op)
         x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
 
-        B, S = input_ids.shape
-        positions = jnp.arange(S)[None, :]
-        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
-
         n_rep = c.num_heads // c.num_kv_heads
+
+        def attn_fn(q, kk, vv):
+            """Position-exact attention on [b, S, h_local, d] blocks — runs
+            under shard_map with the FULL sequence after the Ulysses
+            all-to-all (heads local), or directly when unsharded."""
+            S = q.shape[1]
+            positions = jnp.arange(S)[None, :]
+            q = _rope(q, positions, c.rope_theta)
+            kk = _rope(kk, positions, c.rope_theta)
+            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+            return _attention(q, kk, vv, causal)
 
         def layer(x, lp):
             h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
             q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
             kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
             vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
-            # Ulysses boundary: full sequence, heads sharded over (seq, tensor)
-            q = self._constrain(q, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
-            kk = self._constrain(kk, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
-            vv = self._constrain(vv, DP_AXES, None, (AXIS_SEQ, AXIS_TENSOR), None)
-            q = _rope(q, positions, c.rope_theta)
-            kk = _rope(kk, positions, c.rope_theta)
-            if n_rep > 1:  # GQA: repeat KV heads
+            if n_rep > 1:  # GQA: repeat KV heads so every rank holds a slice
                 kk = jnp.repeat(kk, n_rep, axis=2)
                 vv = jnp.repeat(vv, n_rep, axis=2)
-            attn = _attention(q, kk, vv, causal)
-            attn = self._constrain(attn, DP_AXES, None,
-                                   (AXIS_SEQ, AXIS_TENSOR), None)
+            q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+            kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+            vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+            if self.mesh is not None:
+                attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
+            else:
+                attn = attn_fn(q, kk, vv)
             out = jnp.einsum("bshd,hdH->bsH", attn,
                              lp["attn"]["wo"].astype(c.dtype))
             # back to the sequence-sharded home layout
@@ -276,9 +286,18 @@ class LlamaModel:
         x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp),
                             x, params["layers"])
 
-        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
-        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-        logits = jnp.einsum("bsH,HV->bsV", x, head.astype(c.dtype))
+        return _rms_norm(x, params["final_norm"].astype(c.dtype),
+                         c.rms_norm_eps)
+
+    def _head(self, params: Any) -> jnp.ndarray:
+        return (params["embed"].T if self.config.tie_embeddings
+                else params["lm_head"])
+
+    def forward(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """[B, S] token ids → [B, S, V] logits (fp32)."""
+        x = self._forward_trunk(params, input_ids)
+        logits = jnp.einsum("bsH,HV->bsV", x,
+                            self._head(params).astype(self.config.dtype))
         return logits.astype(jnp.float32)
 
     __call__ = forward
@@ -299,6 +318,16 @@ class LlamaModel:
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        c = self.config
+        if c.loss_tiles > 1:
+            from ..runtime.sequence_parallel.ulysses_sp import \
+                sequence_tiled_loss
+
+            hidden = self._forward_trunk(params, input_ids)
+            head = self._head(params).astype(c.dtype)
+            return sequence_tiled_loss(
+                lambda h: jnp.einsum("bsH,HV->bsV", h, head),
+                hidden, labels, c.loss_tiles)
         logits = self.forward(params, input_ids)
         valid = labels != -100
         safe = jnp.where(valid, labels, 0)

@@ -1,0 +1,16 @@
+"""Sequence parallelism (Ulysses / ALST) — the fork's flagship subsystem.
+
+Reference: ``deepspeed/runtime/sequence_parallel/ulysses_sp.py``
+[L ACC:2398-2437] (UlyssesSPAttentionHF, UlyssesSPDataLoaderAdapter,
+SequenceTiledCompute/TiledMLP) and the legacy
+``deepspeed/sequence/layer.py:DistributedAttention`` [K].
+"""
+
+from .ulysses_sp import (SequenceTiledCompute, TiledMLP, UlyssesSPAttentionHF,
+                         UlyssesSPDataLoaderAdapter, sequence_tiled_loss,
+                         ulysses_attention)
+
+__all__ = [
+    "ulysses_attention", "UlyssesSPAttentionHF", "UlyssesSPDataLoaderAdapter",
+    "SequenceTiledCompute", "TiledMLP", "sequence_tiled_loss",
+]

@@ -1,0 +1,5 @@
+"""Legacy Ulysses module (reference ``deepspeed/sequence/`` [K])."""
+
+from .layer import DistributedAttention
+
+__all__ = ["DistributedAttention"]

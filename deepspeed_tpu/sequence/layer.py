@@ -1,0 +1,47 @@
+"""Legacy Ulysses ``DistributedAttention`` (reference
+``deepspeed/sequence/layer.py`` [K]: ``_SeqAllToAll`` + ``DistributedAttention``
+— the Megatron-DeepSpeed sequence-parallel path).
+
+TPU-native: the scatter/gather pair is ``jax.lax.all_to_all`` over the ``seq``
+mesh axis; the wrapper matches the reference's call shape
+``DistributedAttention(local_attn, sp_group)(q, k, v, *args)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import AXIS_SEQ
+from ..runtime.sequence_parallel.ulysses_sp import ulysses_attention
+from ..utils import groups as groups_mod
+
+
+class DistributedAttention:
+    """seq-scatter → local attention over full sequence → seq-gather.
+
+    ``local_attn(q, k, v, *args)`` computes attention on ``[B, S, h_local, d]``
+    blocks.  With sp == 1 this is a passthrough.
+    """
+
+    def __init__(self, local_attn: Callable[..., jnp.ndarray],
+                 sp_group: Any = None,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        if (scatter_idx, gather_idx) != (2, 1):
+            raise NotImplementedError(
+                "only the [B, S, h, d] layout (scatter heads, gather seq) "
+                "is supported on TPU")
+        self.local_attn = local_attn
+        self.sp_group = sp_group
+
+    def __call__(self, query: jnp.ndarray, key: jnp.ndarray,
+                 value: jnp.ndarray, *args: Any, **kwargs: Any) -> jnp.ndarray:
+        mesh = (self.sp_group.mesh if self.sp_group is not None
+                else groups_mod.get_mesh())
+
+        def attn(q, k, v):
+            return self.local_attn(q, k, v, *args, **kwargs)
+
+        return ulysses_attention(attn, query, key, value, mesh=mesh)
