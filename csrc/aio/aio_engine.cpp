@@ -1,0 +1,152 @@
+// Async file I/O engine — the ZeRO-Infinity NVMe tier.
+//
+// Role parity with the reference csrc/aio/ [K] (deepspeed_aio_thread.cpp,
+// py_lib bindings): an aio_handle with a worker-thread pool draining a
+// submission queue of pread/pwrite ops against O_DIRECT-friendly block
+// files, with wait/drain semantics the swap layer builds on
+// (aio_handle(block_size, queue_depth, single_submit, overlap_events,
+// thread_count) ctor keys [L ACC-DC:1187-1194]).
+//
+// TPU-first adaptation: plain pthread/std::thread pool + pread/pwrite with a
+// C ABI for ctypes. (io_uring/libaio would pin this to specific kernels; the
+// thread-pool engine saturates TPU-VM NVMe with queue_depth×thread_count
+// in-flight ops, and the interface leaves room to swap the backend.)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Op {
+  enum Kind { READ, WRITE } kind;
+  void* buf;
+  int64_t nbytes;
+  std::string path;
+  int64_t offset;
+};
+
+struct Handle {
+  int block_size;
+  int queue_depth;
+  int thread_count;
+  std::vector<std::thread> workers;
+  std::deque<Op> queue;
+  std::mutex mu;
+  std::condition_variable cv_submit;
+  std::condition_variable cv_done;
+  std::atomic<int64_t> inflight{0};
+  std::atomic<int64_t> errors{0};
+  bool shutdown = false;
+
+  void worker() {
+    for (;;) {
+      Op op;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_submit.wait(lk, [&] { return shutdown || !queue.empty(); });
+        if (shutdown && queue.empty()) return;
+        op = queue.front();
+        queue.pop_front();
+      }
+      if (run_one(op) != 0) errors.fetch_add(1);
+      if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+    }
+  }
+
+  int run_one(const Op& op) {
+    int flags = (op.kind == Op::READ) ? O_RDONLY : (O_WRONLY | O_CREAT);
+    int fd = ::open(op.path.c_str(), flags, 0644);
+    if (fd < 0) return -1;
+    char* p = (char*)op.buf;
+    int64_t remaining = op.nbytes;
+    int64_t off = op.offset;
+    int64_t chunk = block_size > 0 ? (int64_t)block_size : (1 << 20);
+    int rc = 0;
+    while (remaining > 0) {
+      int64_t n = remaining < chunk ? remaining : chunk;
+      ssize_t done = (op.kind == Op::READ) ? ::pread(fd, p, n, off)
+                                           : ::pwrite(fd, p, n, off);
+      if (done <= 0) {
+        rc = -1;
+        break;
+      }
+      p += done;
+      off += done;
+      remaining -= done;
+    }
+    ::close(fd);
+    return rc;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_new(int block_size, int queue_depth, int single_submit,
+                 int overlap_events, int thread_count) {
+  (void)single_submit;
+  (void)overlap_events;
+  Handle* h = new Handle();
+  h->block_size = block_size;
+  h->queue_depth = queue_depth > 0 ? queue_depth : 32;
+  h->thread_count = thread_count > 0 ? thread_count : 1;
+  for (int i = 0; i < h->thread_count; ++i)
+    h->workers.emplace_back([h] { h->worker(); });
+  return h;
+}
+
+void ds_aio_free(void* hp) {
+  Handle* h = (Handle*)hp;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->shutdown = true;
+  }
+  h->cv_submit.notify_all();
+  for (auto& t : h->workers) t.join();
+  delete h;
+}
+
+static void submit(Handle* h, Op op) {
+  h->inflight.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->queue.push_back(std::move(op));
+  }
+  h->cv_submit.notify_one();
+}
+
+// async submit; pair with ds_aio_wait
+void ds_aio_pread(void* hp, void* buf, int64_t nbytes, const char* path,
+                  int64_t offset) {
+  submit((Handle*)hp, Op{Op::READ, buf, nbytes, path, offset});
+}
+
+void ds_aio_pwrite(void* hp, const void* buf, int64_t nbytes, const char* path,
+                   int64_t offset) {
+  submit((Handle*)hp, Op{Op::WRITE, (void*)buf, nbytes, path, offset});
+}
+
+// Block until every submitted op completes; returns count of failed ops
+// since the last wait (and resets the error counter).
+int64_t ds_aio_wait(void* hp) {
+  Handle* h = (Handle*)hp;
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->cv_done.wait(lk, [&] { return h->inflight.load() == 0; });
+  return h->errors.exchange(0);
+}
+
+int64_t ds_aio_inflight(void* hp) { return ((Handle*)hp)->inflight.load(); }
+
+}  // extern "C"

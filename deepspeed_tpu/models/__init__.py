@@ -9,5 +9,6 @@ that compose with the ZeRO sharding policy.
 """
 
 from .llama import LlamaConfig, LlamaModel
+from .mixtral import MixtralConfig, MixtralModel
 
-__all__ = ["LlamaConfig", "LlamaModel"]
+__all__ = ["LlamaConfig", "LlamaModel", "MixtralConfig", "MixtralModel"]

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
+from ..parallel.mesh import AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, DP_AXES
 
 P = PartitionSpec
 
@@ -57,6 +57,9 @@ class LlamaConfig:
     #: >1 → chunk final projection+loss over the sequence so the [B,S,V]
     #: logits are never materialized (ALST sequence-tiled loss)
     loss_tiles: int = 1
+    #: pipeline microbatch count (0 → pipe axis size); used when the mesh has
+    #: a pipe axis > 1
+    pp_microbatches: int = 0
 
     @property
     def hd(self) -> int:
@@ -142,6 +145,9 @@ class LlamaModel:
     collectives.
     """
 
+    #: weight on the router load-balancing aux loss (dense model: no-op)
+    aux_loss_coef: float = 0.0
+
     def __init__(self, config: LlamaConfig, mesh: Optional[Mesh] = None):
         self.config = config
         self.mesh = mesh
@@ -188,26 +194,29 @@ class LlamaModel:
     # ------------------------------------------------------------------
 
     def param_specs(self, params: Optional[Any] = None) -> Dict[str, Any]:
-        """Megatron-style TP specs on the ``tensor`` axis; DP/ZeRO axes are
-        layered on top by ``ZeroShardingPolicy.compose`` (reference analogue:
-        AutoTP column/row policy, ``module_inject/auto_tp.py`` [K])."""
+        """Megatron-style TP specs on the ``tensor`` axis; the layer-stack
+        dim shards over ``pipe`` when pipeline parallelism is active; DP/ZeRO
+        axes are layered on top by ``ZeroShardingPolicy.compose`` (reference
+        analogue: AutoTP column/row policy, ``module_inject/auto_tp.py`` [K])."""
         t = AXIS_TENSOR
+        pipe = (AXIS_PIPE if self.mesh is not None
+                and int(self.mesh.shape.get(AXIS_PIPE, 1)) > 1 else None)
         specs = {
             "embed": P(None, None),  # vocab gather stays local; H replicated
             "layers": {
                 "attn": {
-                    "wq": P(None, None, t, None),   # column (head) split
-                    "wk": P(None, None, t, None),
-                    "wv": P(None, None, t, None),
-                    "wo": P(None, t, None, None),   # row split
+                    "wq": P(pipe, None, t, None),   # column (head) split
+                    "wk": P(pipe, None, t, None),
+                    "wv": P(pipe, None, t, None),
+                    "wo": P(pipe, t, None, None),   # row split
                 },
                 "mlp": {
-                    "w_gate": P(None, None, t),     # column split
-                    "w_up": P(None, None, t),
-                    "w_down": P(None, t, None),     # row split
+                    "w_gate": P(pipe, None, t),     # column split
+                    "w_up": P(pipe, None, t),
+                    "w_down": P(pipe, t, None),     # row split
                 },
-                "attn_norm": P(None, None),
-                "mlp_norm": P(None, None),
+                "attn_norm": P(pipe, None),
+                "mlp_norm": P(pipe, None),
             },
             "final_norm": P(None),
         }
@@ -225,8 +234,9 @@ class LlamaModel:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(*spec)))
 
-    def _forward_trunk(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """[B, S] token ids → final-norm hidden states [B, S, H]."""
+    def _forward_trunk(self, params: Any, input_ids: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """[B, S] token ids → (final-norm hidden [B, S, H], aux loss)."""
         from ..runtime.sequence_parallel.ulysses_sp import ulysses_attention
 
         c = self.config
@@ -248,7 +258,8 @@ class LlamaModel:
             causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
             return _attention(q, kk, vv, causal)
 
-        def layer(x, lp):
+        def layer(carry, lp):
+            x, aux = carry
             h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
             q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
             kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
@@ -269,25 +280,58 @@ class LlamaModel:
             x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
 
             h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
-            gate = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_gate"].astype(c.dtype))
-            up = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_up"].astype(c.dtype))
-            act = self._constrain(jax.nn.silu(gate) * up,
-                                  DP_AXES, AXIS_SEQ, AXIS_TENSOR)
-            down = jnp.einsum("bsI,IH->bsH", act,
-                              lp["mlp"]["w_down"].astype(c.dtype))
-            x = self._constrain(x + down, DP_AXES, AXIS_SEQ, None)
-            return x, None
+            ffn_out, l_aux = self._ffn(h, lp)
+            x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
+            return (x, aux + l_aux), None
 
         body = layer
         if c.remat:
             body = jax.checkpoint(
                 layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
-        x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp),
-                            x, params["layers"])
+        pp = (int(self.mesh.shape.get(AXIS_PIPE, 1))
+              if self.mesh is not None else 1)
+        if pp > 1:
+            from ..parallel.pipeline import pipeline_apply
 
-        return _rms_norm(x, params["final_norm"].astype(c.dtype),
-                         c.rms_norm_eps)
+            B, S = input_ids.shape
+            M = c.pp_microbatches or pp
+            if B % M:
+                raise ValueError(
+                    f"batch {B} not divisible by pipeline microbatches {M}")
+            if c.num_layers % pp:
+                raise ValueError(
+                    f"num_layers {c.num_layers} not divisible by pp={pp}")
+            micro = (x.reshape(M, B // M, S, -1),
+                     jnp.zeros((M,), jnp.float32))
+
+            def pipe_layer(lp, act):
+                (nx, naux), _ = body(act, lp)
+                return (nx, naux)
+
+            out_x, out_aux = pipeline_apply(pipe_layer, params["layers"],
+                                            micro, self.mesh)
+            x = out_x.reshape(B, S, -1)
+            aux = out_aux.mean()
+        else:
+            (x, aux), _ = jax.lax.scan(lambda carry, lp: body(carry, lp),
+                                       (x, jnp.float32(0.0)),
+                                       params["layers"])
+
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        return x, aux
+
+    def _ffn(self, h: jnp.ndarray, lp: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Dense SwiGLU FFN; Mixtral overrides with the MoE block.  Returns
+        (output, aux_loss)."""
+        c = self.config
+        gate = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_up"].astype(c.dtype))
+        act = self._constrain(jax.nn.silu(gate) * up,
+                              DP_AXES, AXIS_SEQ, AXIS_TENSOR)
+        down = jnp.einsum("bsI,IH->bsH", act,
+                          lp["mlp"]["w_down"].astype(c.dtype))
+        return down, jnp.float32(0.0)
 
     def _head(self, params: Any) -> jnp.ndarray:
         return (params["embed"].T if self.config.tie_embeddings
@@ -295,7 +339,7 @@ class LlamaModel:
 
     def forward(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
         """[B, S] token ids → [B, S, V] logits (fp32)."""
-        x = self._forward_trunk(params, input_ids)
+        x, _ = self._forward_trunk(params, input_ids)
         logits = jnp.einsum("bsH,HV->bsV", x,
                             self._head(params).astype(self.config.dtype))
         return logits.astype(jnp.float32)
@@ -319,19 +363,22 @@ class LlamaModel:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
         c = self.config
+        hidden, aux = self._forward_trunk(params, input_ids)
+        head = self._head(params).astype(c.dtype)
         if c.loss_tiles > 1:
             from ..runtime.sequence_parallel.ulysses_sp import \
                 sequence_tiled_loss
 
-            hidden = self._forward_trunk(params, input_ids)
-            head = self._head(params).astype(c.dtype)
-            return sequence_tiled_loss(
+            ce = sequence_tiled_loss(
                 lambda h: jnp.einsum("bsH,HV->bsV", h, head),
                 hidden, labels, c.loss_tiles)
-        logits = self.forward(params, input_ids)
-        valid = labels != -100
-        safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
-            jnp.sum(valid), 1)
+        else:
+            logits = jnp.einsum("bsH,HV->bsV", hidden, head).astype(
+                jnp.float32)
+            valid = labels != -100
+            safe = jnp.where(valid, labels, 0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            ce = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+                jnp.sum(valid), 1)
+        return ce + self.aux_loss_coef * aux

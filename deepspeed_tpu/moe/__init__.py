@@ -1,0 +1,10 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: ``deepspeed/moe/`` [K] — ``layer.py:MoE``, ``sharded_moe.py``
+(TopKGate, MOELayer, all-to-all token dispatch), ``experts.py``.
+"""
+
+from .layer import MoE
+from .sharded_moe import MOELayer, TopKGate, top_k_gating
+
+__all__ = ["MoE", "MOELayer", "TopKGate", "top_k_gating"]

@@ -1,0 +1,118 @@
+"""MoE wrapper with the reference ctor surface (``deepspeed/moe/layer.py:MoE``
+[K]: hidden_size, expert, num_experts, ep_size, k, capacity_factor,
+eval_capacity_factor, min_capacity, noisy_gate_policy, drop_tokens,
+enable_expert_tensor_parallelism).
+
+TPU adaptation: ``expert`` is a functional ``(params, [E,C,H]) → [E,C,H]``
+callable (or None for the built-in SwiGLU expert); params live in the
+caller's pytree with expert-stacked leading dim E, sharded over the
+``expert`` mesh axis by ``param_specs``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..parallel.mesh import AXIS_EXPERT, AXIS_TENSOR
+from ..utils import groups as groups_mod
+from .sharded_moe import MOELayer, TopKGate
+
+P = PartitionSpec
+
+
+def swiglu_expert_fn(params: Any, x: jnp.ndarray,
+                     constrain_act: Optional[Callable] = None) -> jnp.ndarray:
+    """Default expert: SwiGLU FFN with expert-stacked params
+    ``{w_gate [E,H,I], w_up [E,H,I], w_down [E,I,H]}``.  ``constrain_act``
+    optionally pins the inner activation's sharding (expert-TP)."""
+    dt = x.dtype
+    gate = jnp.einsum("ech,ehi->eci", x, params["w_gate"].astype(dt))
+    up = jnp.einsum("ech,ehi->eci", x, params["w_up"].astype(dt))
+    act = jax.nn.silu(gate) * up
+    if constrain_act is not None:
+        act = constrain_act(act)
+    return jnp.einsum("eci,eih->ech", act, params["w_down"].astype(dt))
+
+
+class MoE:
+    """Reference-shaped MoE block."""
+
+    def __init__(self, hidden_size: int,
+                 expert: Optional[Callable[[Any, jnp.ndarray], jnp.ndarray]] = None,
+                 num_experts: int = 1, ep_size: int = 1, k: int = 1,
+                 capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4, use_residual: bool = False,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, use_rts: bool = True,
+                 use_tutel: bool = False,
+                 enable_expert_tensor_parallelism: bool = False,
+                 mesh: Any = None):
+        if num_experts % max(ep_size, 1):
+            raise ValueError(
+                f"num_experts({num_experts}) % ep_size({ep_size}) != 0")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.use_residual = use_residual
+        self.enable_expert_tensor_parallelism = enable_expert_tensor_parallelism
+        self.gate = TopKGate(num_experts=num_experts, k=k,
+                             capacity_factor=capacity_factor,
+                             eval_capacity_factor=eval_capacity_factor,
+                             min_capacity=min_capacity,
+                             noisy_gate_policy=noisy_gate_policy,
+                             drop_tokens=drop_tokens)
+        try:
+            mesh = mesh if mesh is not None else groups_mod.get_mesh()
+        except Exception:
+            mesh = None
+        self.moe_layer = MOELayer(self.gate, expert or swiglu_expert_fn,
+                                  mesh=mesh)
+
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng: jax.Array, intermediate_size: int) -> Any:
+        """Params for the built-in SwiGLU expert + router."""
+        E, H, I = self.num_experts, self.hidden_size, intermediate_size
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        import numpy as np
+
+        def normal(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    / np.sqrt(fan_in))
+
+        return {
+            "wg": normal(k1, (H, E), H),
+            "experts": {
+                "w_gate": normal(k2, (E, H, I), H),
+                "w_up": normal(k3, (E, H, I), H),
+                "w_down": normal(k4, (E, I, H), I),
+            },
+        }
+
+    def param_specs(self) -> Any:
+        """Expert-stacked dims shard over the ``expert`` axis (+ optional TP
+        on the FFN inner dim — reference enable_expert_tensor_parallelism)."""
+        t = AXIS_TENSOR if self.enable_expert_tensor_parallelism else None
+        return {
+            "wg": P(None, None),
+            "experts": {
+                "w_gate": P(AXIS_EXPERT, None, t),
+                "w_up": P(AXIS_EXPERT, None, t),
+                "w_down": P(AXIS_EXPERT, t, None),
+            },
+        }
+
+    def __call__(self, params: Any, x: jnp.ndarray, train: bool = True,
+                 noise_rng: Optional[jax.Array] = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, Any]:
+        """x: [B, S, H] → (y, l_aux, exp_counts) — reference return shape."""
+        y, l_aux, meta = self.moe_layer(params["wg"], params["experts"], x,
+                                        train=train, noise_rng=noise_rng)
+        if self.use_residual:
+            # reference residual-MoE: average with the dense path output
+            y = 0.5 * (y + x)
+        return y, l_aux, meta["exp_counts"]

@@ -1,0 +1,5 @@
+"""Native + Pallas ops (reference ``deepspeed/ops/`` [K])."""
+
+from . import op_builder
+
+__all__ = ["op_builder"]

@@ -1,0 +1,5 @@
+from .cpu_adam import DeepSpeedCPUAdam
+from .cpu_adagrad import DeepSpeedCPUAdagrad
+from .cpu_lion import DeepSpeedCPULion
+
+__all__ = ["DeepSpeedCPUAdam", "DeepSpeedCPUAdagrad", "DeepSpeedCPULion"]

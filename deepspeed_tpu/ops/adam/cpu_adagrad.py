@@ -1,0 +1,39 @@
+"""DeepSpeedCPUAdagrad (reference ``deepspeed.ops.adagrad.DeepSpeedCPUAdagrad``
+[L ACC-DS:79-81])."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, model_params: Sequence[np.ndarray], lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0):
+        self.lib = CPUAdamBuilder.load()
+        self.lib.ds_adagrad_step.argtypes = [
+            _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        self.params: List[np.ndarray] = [
+            np.array(p, dtype=np.float32, order="C") for p in model_params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.state_step = 0
+
+    def step(self, grads: Sequence[np.ndarray],
+             lr: Optional[float] = None) -> None:
+        self.state_step += 1
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            self.lib.ds_adagrad_step(
+                p.ctypes.data_as(_f32p), g.ctypes.data_as(_f32p),
+                self.exp_avg_sq[i].ctypes.data_as(_f32p),
+                ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
+                ctypes.c_float(float(lr if lr is not None else self.lr)),
+                ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay))

@@ -1,0 +1,80 @@
+"""DeepSpeedCPUAdam — host-RAM optimizer for ZeRO-Offload.
+
+API parity with the reference ``deepspeed.ops.adam.DeepSpeedCPUAdam``
+[L ACC-DS:41-47]: ctor ``(model_params, lr, betas, eps, weight_decay,
+adamw_mode, ...)``, ``step()``.  TPU adaptation: ``model_params`` is a list
+of numpy fp32 arrays (the host master shards); gradients arrive per-step as
+matching numpy arrays (streamed d2h by the offload engine); the fused C++
+kernel updates master + moments in place and can emit bf16 wire copies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(_f32p)
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, model_params: Sequence[np.ndarray], lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, bias_correction: bool = True,
+                 amsgrad: bool = False, adamw_mode: bool = True,
+                 fp32_optimizer_states: bool = True):
+        if amsgrad:
+            raise NotImplementedError("amsgrad not supported (reference parity)")
+        self.lib = CPUAdamBuilder.load()
+        self.lib.ds_adam_step.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        self.lib.ds_adam_step_bf16.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, _u16p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        # force writable owned copies: jax.device_get hands out read-only
+        # views that ascontiguousarray would pass through unchanged
+        self.params: List[np.ndarray] = [
+            np.array(p, dtype=np.float32, order="C") for p in model_params]
+        self.exp_avg = [np.zeros_like(p) for p in self.params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+        self.defaults: Dict[str, Any] = dict(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            bias_correction=bias_correction, adamw_mode=adamw_mode)
+        self.state_step = 0
+
+    def step(self, grads: Sequence[np.ndarray],
+             bf16_out: Optional[Sequence[np.ndarray]] = None,
+             lr: Optional[float] = None) -> None:
+        """One fused step over every shard. ``grads[i]`` matches
+        ``self.params[i]``; optional ``bf16_out[i]`` (uint16 view) receives
+        the updated params in bf16."""
+        d = self.defaults
+        self.state_step += 1
+        use_lr = float(lr if lr is not None else d["lr"])
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            args = [_fp(p), _fp(g), _fp(self.exp_avg[i]),
+                    _fp(self.exp_avg_sq[i])]
+            common = [ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
+                      ctypes.c_float(use_lr), ctypes.c_float(d["betas"][0]),
+                      ctypes.c_float(d["betas"][1]), ctypes.c_float(d["eps"]),
+                      ctypes.c_float(d["weight_decay"]),
+                      ctypes.c_int(int(d["adamw_mode"])),
+                      ctypes.c_int(int(d["bias_correction"]))]
+            if bf16_out is not None:
+                out = bf16_out[i]
+                self.lib.ds_adam_step_bf16(
+                    *args, out.ctypes.data_as(_u16p), *common)
+            else:
+                self.lib.ds_adam_step(*args, *common)

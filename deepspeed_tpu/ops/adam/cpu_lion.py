@@ -1,0 +1,41 @@
+"""DeepSpeedCPULion (reference ``deepspeed.ops.lion.DeepSpeedCPULion``
+[L ACC-DS:93-95])."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+class DeepSpeedCPULion:
+    def __init__(self, model_params: Sequence[np.ndarray], lr: float = 1e-4,
+                 betas: Tuple[float, float] = (0.9, 0.99),
+                 weight_decay: float = 0.0):
+        self.lib = CPUAdamBuilder.load()
+        self.lib.ds_lion_step.argtypes = [
+            _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        self.params: List[np.ndarray] = [
+            np.array(p, dtype=np.float32, order="C") for p in model_params]
+        self.exp_avg = [np.zeros_like(p) for p in self.params]
+        self.lr, self.betas, self.weight_decay = lr, betas, weight_decay
+        self.state_step = 0
+
+    def step(self, grads: Sequence[np.ndarray],
+             lr: Optional[float] = None) -> None:
+        self.state_step += 1
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            self.lib.ds_lion_step(
+                p.ctypes.data_as(_f32p), g.ctypes.data_as(_f32p),
+                self.exp_avg[i].ctypes.data_as(_f32p),
+                ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
+                ctypes.c_float(float(lr if lr is not None else self.lr)),
+                ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+                ctypes.c_float(self.weight_decay))

@@ -1,0 +1,3 @@
+from .aio_handle import AIOHandle, aio_handle
+
+__all__ = ["AIOHandle", "aio_handle"]

@@ -1,0 +1,7 @@
+"""Op builder registry (reference ``op_builder/`` [K], shrunk per SURVEY §2.2:
+the ~40-builder JIT matrix reduces to the two real native ops + Pallas
+kernels, which are plain Python)."""
+
+from .builder import CPUAdamBuilder, AsyncIOBuilder, OpBuilder, get_op_builder
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder", "get_op_builder"]

@@ -1,0 +1,96 @@
+"""JIT builder for the native C++ host ops.
+
+Reference parity: ``op_builder/builder.py:OpBuilder`` [K] — sources list,
+``is_compatible()`` probe, ``load()`` that compiles on first use and caches.
+TPU adaptation: no torch cpp_extension — a direct ``g++ -shared`` invocation
+producing a plain C-ABI ``.so`` loaded with ctypes (pybind11 is not in the
+image; SURVEY environment notes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Type
+
+from ...utils.logging import logger
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_CACHE_DIR = os.environ.get(
+    "DS_TPU_OP_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops"))
+
+
+class OpBuilder:
+    NAME = "op"
+    SOURCES: List[str] = []  # repo-relative
+    EXTRA_FLAGS: List[str] = []
+
+    _loaded: Dict[str, ctypes.CDLL] = {}
+
+    @classmethod
+    def absolute_sources(cls) -> List[str]:
+        return [os.path.join(_REPO_ROOT, s) for s in cls.SOURCES]
+
+    @classmethod
+    def is_compatible(cls) -> bool:
+        return shutil.which("g++") is not None and all(
+            os.path.exists(s) for s in cls.absolute_sources())
+
+    @classmethod
+    def _so_path(cls) -> str:
+        h = hashlib.sha1()
+        for s in cls.absolute_sources():
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(cls.EXTRA_FLAGS).encode())
+        return os.path.join(_CACHE_DIR, f"{cls.NAME}_{h.hexdigest()[:12]}.so")
+
+    @classmethod
+    def build(cls) -> str:
+        so = cls._so_path()
+        if os.path.exists(so):
+            return so
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        cmd = (["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+               + cls.EXTRA_FLAGS + cls.absolute_sources() + ["-o", so + ".tmp"])
+        logger.info(f"building native op {cls.NAME}: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build of {cls.NAME} failed:\n{e.stderr}") from e
+        os.replace(so + ".tmp", so)
+        return so
+
+    @classmethod
+    def load(cls) -> ctypes.CDLL:
+        if cls.NAME not in cls._loaded:
+            cls._loaded[cls.NAME] = ctypes.CDLL(cls.build())
+        return cls._loaded[cls.NAME]
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+    SOURCES = ["csrc/adam/cpu_adam.cpp"]
+    EXTRA_FLAGS = ["-fopenmp"]
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+    SOURCES = ["csrc/aio/aio_engine.cpp"]
+    EXTRA_FLAGS = ["-pthread"]
+
+
+_BUILDERS: Dict[str, Type[OpBuilder]] = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+
+def get_op_builder(name: str) -> Optional[Type[OpBuilder]]:
+    return _BUILDERS.get(name)

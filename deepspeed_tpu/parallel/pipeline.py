@@ -1,0 +1,117 @@
+"""Pipeline parallelism as a scanned collective-permute loop.
+
+Reference: ``deepspeed/runtime/pipe/`` [K] — ``PipelineEngine`` executes a
+1F1B instruction stream (LoadMicroBatch / ForwardPass / SendActivation /
+RecvActivation / BackwardPass / SendGrad / RecvGrad / ReduceGrads /
+OptimizerStep) with explicit torch P2P between stage ranks (SURVEY §3.5).
+
+TPU-native: none of that instruction machinery survives.  Stage params are
+the layer-stacked pytree ``[L, ...]`` sharded over the ``pipe`` mesh axis
+(each rank holds its L/P layer slice); the microbatch loop is ONE
+``lax.scan`` whose body runs every stage in lockstep and moves boundary
+activations with ``lax.ppermute`` (collective-permute is ICI-native).  The
+whole schedule — forward fill/drain AND its exact transpose for backward —
+is differentiated by jax.grad through the scan, so SendGrad/RecvGrad is the
+autodiff of ppermute and "ReduceGrads" is GSPMD's reduction over ``data``.
+GPipe-style scheduling; gradients are bit-identical to 1F1B (1F1B only
+reorders eager-mode memory traffic, which XLA schedules itself).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from .mesh import AXIS_PIPE
+
+P = PartitionSpec
+
+
+def pipeline_spec(n_dims_map: Any) -> Any:
+    """PartitionSpecs putting the leading (layer-stack) dim on ``pipe``."""
+    return jax.tree.map(
+        lambda nd: P(*((AXIS_PIPE,) + (None,) * (int(nd) - 1))), n_dims_map)
+
+
+def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stacked_params: Any,
+                   microbatches: jnp.ndarray,
+                   mesh: Mesh) -> Any:
+    """Run ``microbatches [M, b, ...]`` through the stage pipeline.
+
+    ``layer_fn(layer_params, x) -> x`` applies ONE layer (leaf shapes =
+    ``stacked_params`` minus the leading layer dim); stages apply their local
+    slice with an inner scan.  Returns outputs ``[M, b, ...]`` (replicated
+    over pipe).  M must be ≥ the pipe size to keep bubbles sane (M < P still
+    computes correctly).
+
+    The function must be called inside jit (it builds a shard_map over the
+    ``pipe`` axis; every other mesh axis stays in GSPMD "auto" mode so
+    ZeRO/TP/SP sharding constraints inside ``layer_fn`` keep working).
+    """
+    pp = int(mesh.shape[AXIS_PIPE])
+    if pp == 1:
+        def scan_all(x):
+            def body(h, lp):
+                return layer_fn(lp, h), None
+            out, _ = jax.lax.scan(body, x, stacked_params)
+            return out
+
+        return jax.lax.map(scan_all, microbatches)
+
+    M = jax.tree.leaves(microbatches)[0].shape[0]
+    T = M + pp - 1  # fill + steady + drain ticks
+
+    def stage_fn(params_local, x):
+        """Apply this stage's L/P layers (inner scan over the local slice)."""
+        def body(h, lp):
+            return layer_fn(lp, h), None
+
+        out, _ = jax.lax.scan(body, x, params_local)
+        return out
+
+    def per_stage(params_local, xs):
+        stage = jax.lax.axis_index(AXIS_PIPE)
+        tmap = jax.tree.map
+        zero = tmap(lambda a: jnp.zeros_like(a[0]), xs)
+        outs0 = tmap(jnp.zeros_like, xs)
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 pulls microbatch t (clipped; garbage beyond M is
+            # dropped at write time), others consume the permuted input
+            mb = tmap(lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.clip(t, 0, M - 1), 0, keepdims=False), xs)
+            inp = tmap(lambda m, r: jnp.where(stage == 0, m, r), mb, recv)
+            out = stage_fn(params_local, inp)
+            # last stage owns microbatch t-(pp-1) once t >= pp-1
+            idx = t - (pp - 1)
+            write = (stage == pp - 1) & (idx >= 0)
+            outs = tmap(
+                lambda acc, o: jnp.where(
+                    write,
+                    jax.lax.dynamic_update_index_in_dim(
+                        acc, o, jnp.clip(idx, 0, M - 1), 0),
+                    acc),
+                outs, out)
+            nxt = tmap(lambda o: jax.lax.ppermute(
+                o, AXIS_PIPE, [(i, (i + 1) % pp) for i in range(pp)]), out)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (zero, outs0), jnp.arange(T))
+        # replicate the last stage's outputs across the pipe axis
+        outs = tmap(lambda o: jax.lax.psum(
+            jnp.where(stage == pp - 1, o, jnp.zeros_like(o)), AXIS_PIPE),
+            outs)
+        return outs
+
+    in_specs = (pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)),
+                jax.tree.map(lambda _: P(), microbatches))
+    return jax.shard_map(per_stage, mesh=mesh,
+                         in_specs=in_specs, out_specs=jax.tree.map(
+                             lambda _: P(), microbatches),
+                         check_vma=False,
+                         axis_names={AXIS_PIPE})(stacked_params, microbatches)

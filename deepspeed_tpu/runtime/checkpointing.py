@@ -41,6 +41,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     with ocp.StandardCheckpointer() as saver:
         saver.save(os.path.join(ckpt_dir, "state"), engine.state, force=True)
+        if getattr(engine, "offload_opt", None) is not None:
+            # ZeRO-Offload: moments live host-side in the C++ optimizer;
+            # the attribute set varies per optimizer (Adam: both moments,
+            # Adagrad: sq only, Lion: avg only)
+            moments = {k: list(v) for k, v in
+                       engine.offload_opt.state_dict_arrays().items()
+                       if k != "step"}
+            saver.save(os.path.join(ckpt_dir, "offload_state"), moments,
+                       force=True)
 
     # sync the scheduler to the APPLIED step (excludes fp16 overflow skips;
     # the per-step fast path tracks global_steps to avoid a device sync)
@@ -48,6 +57,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     meta = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
+        "offload_step": (engine.offload_opt.opt.state_step
+                         if getattr(engine, "offload_opt", None) else 0),
         "lr_scheduler": engine.lr_scheduler.state_dict(),
         "client_state": client_state or {},
         "ds_config_stage": engine.config.zero_optimization.stage,
@@ -114,6 +125,22 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             target = jax.tree.map(abstract, engine.state)
             engine.state = loader.restore(state_path, target)
 
+    offload = getattr(engine, "offload_opt", None)
+    if offload is not None:
+        offload_path = os.path.join(ckpt_dir, "offload_state")
+        if os.path.exists(offload_path) and not params_only:
+            with ocp.StandardCheckpointer() as loader:
+                target = {k: [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                              for a in v]
+                          for k, v in offload.state_dict_arrays().items()
+                          if k != "step"}
+                restored_off = loader.restore(offload_path, target)
+            offload.load_state_arrays(restored_off)
+        # re-seed host fp32 masters from the restored params
+        for dst, src in zip(offload.opt.params,
+                            jax.tree.leaves(engine.state.params)):
+            np.copyto(dst, np.asarray(jax.device_get(src), dtype=np.float32))
+
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state: Dict[str, Any] = {}
     if os.path.exists(meta_path):
@@ -121,6 +148,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             meta = json.load(fh)
         engine.global_steps = int(meta.get("global_steps", 0))
         engine.micro_steps = int(meta.get("micro_steps", 0))
+        if offload is not None and not params_only:
+            offload.opt.state_step = int(meta.get("offload_step", 0))
         if meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         client_state = meta.get("client_state", {})

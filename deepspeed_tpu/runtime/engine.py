@@ -77,13 +77,29 @@ class DeepSpeedEngine:
                           else None)
         from .zero.config import OffloadDeviceEnum
 
-        if (config.zero_optimization.offload_optimizer_device()
-                != OffloadDeviceEnum.none
-                or config.zero_optimization.offload_param_device()
+        self.offload_enabled = (config.zero_optimization.offload_optimizer_device()
+                                != OffloadDeviceEnum.none)
+        if self.offload_enabled and optimizer is not None:
+            # reference behavior [L ACC:2365-2367]: offload requires the DS
+            # CPU optimizer unless zero_force_ds_cpu_optimizer is disabled
+            if config.zero_force_ds_cpu_optimizer:
+                raise ValueError(
+                    "a client optimizer cannot be combined with "
+                    "offload_optimizer; remove it or set "
+                    "zero_force_ds_cpu_optimizer: false to acknowledge the "
+                    "config-derived CPU optimizer will be used instead")
+            logger.warning("offload_optimizer active: ignoring the client "
+                           "optimizer, using the config-derived CPU optimizer")
+            optimizer = None
+        self.offload_opt = None  # built after state init (needs placed params)
+        if (config.zero_optimization.offload_param_device()
                 != OffloadDeviceEnum.none):
             logger.warning(
-                "ZeRO offload configured but host/NVMe tiering is not wired "
-                "up yet (SURVEY §7 phases 6-7); training proceeds on-device")
+                "ZeRO param offload (Infinity) not wired into the engine yet "
+                "(SURVEY §7 phase 7); optimizer offload IS active" if
+                self.offload_enabled else
+                "ZeRO param offload (Infinity) not wired up yet; "
+                "training proceeds on-device")
         self.compute_dtype = config.dtype()
         self.fp16_enabled = config.fp16.enabled is True
         self.bf16_enabled = config.bf16.enabled is True
@@ -153,11 +169,26 @@ class DeepSpeedEngine:
         param_shardings = self.policy.param_shardings(params, self.base_specs)
         params = jax.device_put(params, param_shardings)
 
-        opt_shapes = jax.eval_shape(self.optimizer.init, params)
-        opt_shardings = self.policy.opt_state_shardings(
-            opt_shapes, tx=self.optimizer, base_specs=self.base_specs)
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=opt_shardings)(params)
+        if self.offload_enabled:
+            # optimizer states live on the HOST (ZeRO-Offload): fp32 master +
+            # moments in numpy, updated by the fused C++ kernel
+            from .zero.offload import CPUOffloadOptimizer
+
+            opt_cfg = self.config.optimizer
+            self.offload_opt = CPUOffloadOptimizer(
+                params,
+                optimizer_name=(opt_cfg.type if opt_cfg is not None
+                                else "AdamW"),
+                optimizer_params=(dict(opt_cfg.params.model_dump())
+                                  if opt_cfg is not None else {}),
+                schedule=self._schedule)
+            opt_state = ()
+        else:
+            opt_shapes = jax.eval_shape(self.optimizer.init, params)
+            opt_shardings = self.policy.opt_state_shardings(
+                opt_shapes, tx=self.optimizer, base_specs=self.base_specs)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=opt_shardings)(params)
 
         scale_state = (self.loss_scaler.init_state() if self.loss_scaler
                        else LossScaleState(jnp.float32(1.0), jnp.int32(0),
@@ -178,18 +209,19 @@ class DeepSpeedEngine:
     # the compiled train step
     # ------------------------------------------------------------------
 
-    def _build_train_step(self):
+    def _grad_core(self):
+        """Shared microbatch-scan gradient computation: accumulation, loss
+        (un)scaling, ZeRO grad constraints, overflow screen, clipping.  Used
+        by BOTH the fused on-device step and the offload grad-only step so
+        the two paths cannot drift."""
         gas = self.gradient_accumulation_steps
         fp16 = self.fp16_enabled
         dtype = self.compute_dtype
         clip = self.gradient_clipping
         policy = self.policy
         loss_fn = self.loss_fn
-        schedule = self._schedule
-        scaler = self.loss_scaler
-        tx = self.optimizer
 
-        def step_fn(state: TrainState, batch):
+        def compute(state: TrainState, batch):
             compute_params = (cast_tree(state.params, dtype)
                               if dtype != jnp.float32 else state.params)
             scale = state.loss_scale.scale
@@ -234,6 +266,19 @@ class DeepSpeedEngine:
                 grads, grad_norm = clip_grads_by_global_norm(grads, clip)
             else:
                 grad_norm = global_grad_norm(grads)
+            return grads, mean_loss, overflow, grad_norm
+
+        return compute
+
+    def _build_train_step(self):
+        fp16 = self.fp16_enabled
+        schedule = self._schedule
+        scaler = self.loss_scaler
+        tx = self.optimizer
+        core = self._grad_core()
+
+        def step_fn(state: TrainState, batch):
+            grads, mean_loss, overflow, grad_norm = core(state, batch)
 
             updates, new_opt_state = tx.update(grads, state.opt_state,
                                                state.params)
@@ -270,6 +315,48 @@ class DeepSpeedEngine:
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
 
+    def _build_grad_step(self):
+        """Offload mode: the device program ends at clipped grads + metrics;
+        the optimizer update happens on the host (C++ CPU Adam)."""
+        fp16 = self.fp16_enabled
+        schedule = self._schedule
+        scaler = self.loss_scaler
+        core = self._grad_core()
+
+        def grad_fn(state: TrainState, batch):
+            grads, mean_loss, overflow, grad_norm = core(state, batch)
+            new_scale = (scaler.update(state.loss_scale, overflow)
+                         if fp16 else state.loss_scale)
+            metrics = {
+                "loss": mean_loss,
+                "grad_norm": grad_norm,
+                "lr": jnp.asarray(schedule(state.step), jnp.float32),
+                "loss_scale": state.loss_scale.scale,
+                "overflow": overflow,
+            }
+            return grads, metrics, new_scale
+
+        state_shardings = self._state_shardings(self.state)
+        batch_sharding = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
+        return jax.jit(grad_fn,
+                       in_shardings=(state_shardings, batch_sharding))
+
+    def _offload_train_step(self, batch) -> Dict[str, Any]:
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_grad_step()
+        grads, metrics, new_scale = self._train_step_fn(self.state, batch)
+        overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
+        st = self.state
+        if overflow:
+            self.state = st._replace(
+                loss_scale=new_scale,
+                skipped_steps=st.skipped_steps + 1)
+        else:
+            new_params = self.offload_opt.step(grads, int(st.step))
+            self.state = st._replace(params=new_params, step=st.step + 1,
+                                     loss_scale=new_scale)
+        return metrics
+
     # ------------------------------------------------------------------
     # idiomatic API — one call per optimizer step
     # ------------------------------------------------------------------
@@ -278,10 +365,13 @@ class DeepSpeedEngine:
         """Run ONE full optimizer step (fwd+bwd over all microbatches + update)
         as a single compiled program.  ``batch`` holds the full global batch
         (micro × gas × dp_world leading dim)."""
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
         self.tput_timer.start()
-        self.state, metrics = self._train_step_fn(self.state, batch)
+        if self.offload_enabled:
+            metrics = self._offload_train_step(batch)
+        else:
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            self.state, metrics = self._train_step_fn(self.state, batch)
         self.tput_timer.stop(sync=False)
         self.global_steps += 1
         self.lr_scheduler.last_step = self.global_steps

@@ -50,10 +50,15 @@ def ulysses_attention(attn_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
         # still shard heads over tensor via ordinary GSPMD; no seq comm needed
         return attn_fn(q, k, v)
 
-    spec_in = P(DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+    # Manualize ONLY the seq axis: batch/head sharding stays with GSPMD, and
+    # the partial-manual form composes under an enclosing pipeline shard_map
+    # (whose context mesh must be reused — a concrete Mesh would mismatch).
+    ctx = jax.sharding.get_abstract_mesh()
+    sm_mesh = ctx if ctx is not None and ctx.shape else mesh
+    spec = P(None, AXIS_SEQ, None, None)
 
     def inner(ql, kl, vl):
-        # local [b, S/sp, h/tp, d] → [b, S, h/(tp·sp), d]
+        # local [B, S/sp, h, d] → [B, S, h/sp, d]
         ql = jax.lax.all_to_all(ql, AXIS_SEQ, split_axis=2, concat_axis=1,
                                 tiled=True)
         kl = jax.lax.all_to_all(kl, AXIS_SEQ, split_axis=2, concat_axis=1,
@@ -61,13 +66,14 @@ def ulysses_attention(attn_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
         vl = jax.lax.all_to_all(vl, AXIS_SEQ, split_axis=2, concat_axis=1,
                                 tiled=True)
         ol = attn_fn(ql, kl, vl)
-        # back: [b, S, h/(tp·sp), d] → [b, S/sp, h/tp, d]
+        # back: [B, S, h/sp, d] → [B, S/sp, h, d]
         return jax.lax.all_to_all(ol, AXIS_SEQ, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    return jax.shard_map(inner, mesh=mesh,
-                         in_specs=(spec_in, spec_in, spec_in),
-                         out_specs=spec_in, check_vma=False)(q, k, v)
+    return jax.shard_map(inner, mesh=sm_mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={AXIS_SEQ},
+                         check_vma=False)(q, k, v)
 
 
 # ----------------------------------------------------------------------

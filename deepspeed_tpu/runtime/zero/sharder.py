@@ -86,16 +86,27 @@ class ZeroShardingPolicy:
         entries += [None] * (len(shape) - len(entries))
         base_spec = PartitionSpec(*entries) if any(
             e is not None for e in entries) else PartitionSpec()
-        if self.dp_size == 1 or not shape:
+        if not shape:
+            return base_spec
+        # never reuse a mesh axis the base already occupies (e.g. MoE expert-
+        # stacked weights carry 'expert', which is also a ZeRO DP axis)
+        used_axes = set()
+        for e in entries:
+            if e is not None:
+                used_axes.update(e if isinstance(e, tuple) else (e,))
+        free_axes = tuple(a for a in self.shard_axes if a not in used_axes)
+        free_size = int(np.prod([dict(self.mesh.shape)[a]
+                                 for a in free_axes])) if free_axes else 1
+        if free_size == 1:
             return base_spec
         if int(np.prod(shape)) <= self.persistence_threshold:
             return base_spec  # persisted small param — stay replicated over DP
         candidates = [(dim, i) for i, dim in enumerate(shape)
-                      if entries[i] is None and dim % self.dp_size == 0]
+                      if entries[i] is None and dim % free_size == 0]
         if not candidates:
             return base_spec
         _, best = max(candidates, key=lambda t: (t[0], -t[1]))
-        entries[best] = self.shard_axes
+        entries[best] = free_axes
         return PartitionSpec(*entries)
 
     def _base_or_empty(self, base: Optional[PartitionSpec],

@@ -1,0 +1,71 @@
+"""Pipeline collective-permute schedule: forward + gradient numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import MeshLayout
+from deepspeed_tpu.parallel.pipeline import pipeline_apply
+from deepspeed_tpu.utils import groups
+
+
+def layer_fn(lp, x):
+    return jnp.tanh(x @ lp["w"] + lp["b"])
+
+
+def make_params(L=4, H=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(L, H, H) * 0.5, jnp.float32),
+            "b": jnp.asarray(rng.randn(L, H) * 0.1, jnp.float32)}
+
+
+def ref_apply(params, micro):
+    def scan_all(x):
+        def body(h, lp):
+            return layer_fn(lp, h), None
+        out, _ = jax.lax.scan(body, x, params)
+        return out
+    return jax.lax.map(scan_all, micro)
+
+
+@pytest.mark.parametrize("pp,M", [(2, 4), (4, 4), (4, 2), (2, 8)])
+def test_pipeline_forward_matches_sequential(pp, M):
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=pp))
+    params = make_params()
+    micro = jnp.asarray(np.random.RandomState(1).randn(M, 2, 8), jnp.float32)
+    out = jax.jit(lambda p, x: pipeline_apply(layer_fn, p, x, mesh))(
+        params, micro)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_apply(params, micro)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    pp, M = 4, 4
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=pp))
+    params = make_params()
+    micro = jnp.asarray(np.random.RandomState(2).randn(M, 2, 8), jnp.float32)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(layer_fn, p, micro, mesh) ** 2)
+
+    def loss_ref(p):
+        return jnp.sum(ref_apply(p, micro) ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_composes_with_dp():
+    """pipe × data hybrid: batch sharded over data, layers over pipe."""
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=2, dp=4))
+    params = make_params()
+    micro = jnp.asarray(np.random.RandomState(3).randn(4, 8, 8), jnp.float32)
+    out = jax.jit(lambda p, x: pipeline_apply(layer_fn, p, x, mesh))(
+        params, micro)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref_apply(params, micro)),
+                               rtol=1e-5, atol=1e-5)
