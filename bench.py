@@ -32,7 +32,7 @@ def main() -> None:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
                           intermediate_size=2048, num_layers=12,
                           num_heads=12, num_kv_heads=12, max_seq_len=2048,
-                          dtype=jnp.bfloat16)
+                          dtype=jnp.bfloat16, attn_impl="flash")
         batch, seq, steps = 8, 2048, 20
     else:  # CPU fallback so the bench always emits a line
         cfg = LlamaConfig.tiny(num_layers=2)
@@ -60,13 +60,16 @@ def main() -> None:
     engine.train_step(batch_d)  # compile + warmup
     jax.block_until_ready(engine.state.params)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        engine.train_step(batch_d)
-    jax.block_until_ready(engine.state.params)
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
+    # median of 3 segments: robust to the tunneled chip's throughput noise
+    # without inflating the number the way a max would
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_step(batch_d)
+        jax.block_until_ready(engine.state.params)
+        rates.append(batch * seq * steps / (time.perf_counter() - t0))
+    tokens_per_sec = sorted(rates)[1]
 
     # persist the first TPU run as this bench's own baseline
     vs_baseline = 1.0

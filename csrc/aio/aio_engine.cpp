@@ -34,6 +34,7 @@ struct Op {
   int64_t nbytes;
   std::string path;
   int64_t offset;
+  bool trunc = false;  // WRITE: ftruncate file to offset+nbytes afterwards
 };
 
 struct Handle {
@@ -60,7 +61,12 @@ struct Handle {
         queue.pop_front();
       }
       if (run_one(op) != 0) errors.fetch_add(1);
-      if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+      {
+        // decrement+notify under the mutex: a lock-free notify can fire
+        // between the waiter's predicate check and its sleep (lost wakeup)
+        std::lock_guard<std::mutex> lk(mu);
+        if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+      }
     }
   }
 
@@ -84,6 +90,11 @@ struct Handle {
       p += done;
       off += done;
       remaining -= done;
+    }
+    if (rc == 0 && op.kind == Op::WRITE && op.trunc) {
+      // whole-file rewrite: drop stale tail bytes from a previous larger
+      // shard at the same path
+      if (::ftruncate(fd, op.offset + op.nbytes) != 0) rc = -1;
     }
     ::close(fd);
     return rc;
@@ -136,6 +147,12 @@ void ds_aio_pread(void* hp, void* buf, int64_t nbytes, const char* path,
 void ds_aio_pwrite(void* hp, const void* buf, int64_t nbytes, const char* path,
                    int64_t offset) {
   submit((Handle*)hp, Op{Op::WRITE, (void*)buf, nbytes, path, offset});
+}
+
+// write + ftruncate(offset+nbytes): for whole-file shard rewrites
+void ds_aio_pwrite_trunc(void* hp, const void* buf, int64_t nbytes,
+                         const char* path, int64_t offset) {
+  submit((Handle*)hp, Op{Op::WRITE, (void*)buf, nbytes, path, offset, true});
 }
 
 // Block until every submitted op completes; returns count of failed ops

@@ -12,7 +12,8 @@ from .parallel import MeshLayout, build_mesh
 from .utils import logger
 
 __all__ = ["__version__", "comm", "MeshLayout", "build_mesh", "logger",
-           "initialize"]
+           "initialize", "init_inference", "init_distributed",
+           "tp_model_init", "zero"]
 
 
 def initialize(*args, **kwargs):
@@ -24,3 +25,29 @@ def initialize(*args, **kwargs):
     from .runtime.entry import initialize as _initialize
 
     return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Mirrors ``deepspeed.init_inference`` (SURVEY §3.6)."""
+    from .inference import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)
+
+
+def init_distributed(*args, **kwargs):
+    return comm.init_distributed(*args, **kwargs)
+
+
+def tp_model_init(*args, **kwargs):
+    """Mirrors ``deepspeed.tp_model_init`` [L HF-DS:468-473]."""
+    from .runtime.tensor_parallel import tp_model_init as _tp
+
+    return _tp(*args, **kwargs)
+
+
+def __getattr__(name):
+    if name == "zero":
+        from .runtime import zero as _zero
+
+        return _zero
+    raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")

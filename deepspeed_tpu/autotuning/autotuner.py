@@ -1,0 +1,109 @@
+"""Autotuner — search (zero stage × micro-batch) by timing compiled steps.
+
+Reference: ``deepspeed/autotuning/`` [K] — ``Autotuner`` +
+``GridSearchTuner/RandomTuner/ModelBasedTuner`` launch short profiling jobs
+over ``zero_optimization.stage`` / micro-batch / offload and pick the best
+throughput config (SURVEY §2.5).
+
+TPU-first: no subprocess launches — each candidate is one jit compile + a
+few timed steps IN PROCESS (XLA gives OOM errors synchronously, and
+compile+run of a candidate costs seconds, not a job launch).  The search
+space and the emitted best-config JSON keep the reference's shape.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from ..utils.logging import log_dist, logger
+
+DEFAULT_TUNING_SPACE = {
+    "zero_optimization.stage": [0, 1, 2, 3],
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
+}
+
+
+class Autotuner:
+    def __init__(self, engine_factory: Callable[[Dict[str, Any]], Any],
+                 batch_factory: Callable[[Dict[str, Any]], Any],
+                 base_config: Dict[str, Any],
+                 tuning_space: Optional[Dict[str, List[Any]]] = None,
+                 metric: str = "throughput", warmup_steps: int = 1,
+                 timed_steps: int = 3):
+        """``engine_factory(config_dict) -> engine`` builds a fresh engine;
+        ``batch_factory(config_dict) -> batch`` supplies a matching global
+        batch.  Factories own model/params so the tuner stays generic."""
+        self.engine_factory = engine_factory
+        self.batch_factory = batch_factory
+        self.base_config = base_config
+        self.space = tuning_space or DEFAULT_TUNING_SPACE
+        self.metric = metric
+        self.warmup_steps = warmup_steps
+        self.timed_steps = timed_steps
+        self.records: List[Dict[str, Any]] = []
+
+    def _apply(self, cfg: Dict[str, Any], dotted: str, value: Any) -> None:
+        node = cfg
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    def _candidates(self):
+        keys = list(self.space.keys())
+        for combo in itertools.product(*(self.space[k] for k in keys)):
+            cfg = json.loads(json.dumps(self.base_config))
+            for k, v in zip(keys, combo):
+                self._apply(cfg, k, v)
+            yield dict(zip(keys, combo)), cfg
+
+    def _measure(self, cfg: Dict[str, Any]) -> Optional[float]:
+        try:
+            engine = self.engine_factory(cfg)
+            batch = self.batch_factory(cfg)
+            for _ in range(self.warmup_steps):
+                engine.train_step(batch)
+            jax.block_until_ready(engine.state.params)
+            t0 = time.perf_counter()
+            for _ in range(self.timed_steps):
+                engine.train_step(batch)
+            jax.block_until_ready(engine.state.params)
+            dt = (time.perf_counter() - t0) / self.timed_steps
+            samples = int(engine.train_batch_size or 1)
+            return samples / dt
+        except Exception as e:
+            logger.warning(f"autotuning candidate failed: {e}")
+            return None
+
+    def tune(self) -> Dict[str, Any]:
+        best, best_rate = None, -1.0
+        for combo, cfg in self._candidates():
+            rate = self._measure(cfg)
+            rec = {"combo": combo, "throughput": rate}
+            self.records.append(rec)
+            log_dist(f"autotuning {combo} -> "
+                     f"{'FAIL' if rate is None else f'{rate:.1f} samples/s'}")
+            if rate is not None and rate > best_rate:
+                best, best_rate = (combo, cfg), rate
+        if best is None:
+            raise RuntimeError("no autotuning candidate succeeded")
+        combo, cfg = best
+        log_dist(f"autotuning best: {combo} at {best_rate:.1f} samples/s")
+        return {"best_config": cfg, "best_combo": combo,
+                "throughput": best_rate, "records": self.records}
+
+    def write_best(self, path: str) -> None:
+        result = self.tune()
+        with open(path, "w") as f:
+            json.dump(result["best_config"], f, indent=2)
+
+
+def autotune(engine_factory, batch_factory, base_config,
+             tuning_space=None) -> Dict[str, Any]:
+    return Autotuner(engine_factory, batch_factory, base_config,
+                     tuning_space).tune()

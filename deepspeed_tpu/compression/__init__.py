@@ -1,0 +1,4 @@
+from .compress import init_compression, redundancy_clean
+from .quantization import fake_quantize
+
+__all__ = ["init_compression", "redundancy_clean", "fake_quantize"]

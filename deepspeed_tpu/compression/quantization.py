@@ -1,0 +1,24 @@
+"""Quantization-aware-training primitives (reference
+``deepspeed/compression/basic_layer.py`` QuantAct/Embedding/Linear wrappers
+[K]) — functional: a fake-quant transform applied to param pytrees inside the
+loss, straight-through estimator for gradients."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(x: jnp.ndarray, bits: int = 8, symmetric: bool = True,
+                  per_channel: bool = True) -> jnp.ndarray:
+    """Quantize→dequantize with straight-through gradient (QAT path):
+    ``x + sg(q(x) - x)`` — identity gradient everywhere, quantized value in
+    the forward (the canonical STE formulation)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    axis = tuple(range(1, x.ndim)) if (per_channel and x.ndim > 1) else None
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    return (x + jax.lax.stop_gradient(q.astype(x.dtype) - x)).astype(x.dtype)

@@ -1,0 +1,96 @@
+"""Elastic training v1 — batch/worldsize compatibility envelopes.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` [K] —
+``compute_elastic_config(ds_config, target_deepspeed_version, world_size)``
+pre-computes (train_batch, micro_batch, GAS) triples valid across an allowed
+range of accelerator counts, so a restarted job at a different scale keeps
+hyperparameters fixed (SURVEY §5.3).  v2's torch-elastic agent maps to
+``jax.distributed`` restart + checkpoint reshard and lives with the launcher.
+
+The arithmetic is hardware-neutral; "gpus" in the API keeps the reference
+name, meaning chips here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def _candidate_batches(base_list: List[int], max_batch: int,
+                       prefer_larger: bool = True) -> List[int]:
+    """All feasible train-batch sizes = lcm-combinations of the allowed
+    micro-batches times any integer, capped at max_batch."""
+    out = set()
+    for mb in base_list:
+        b = mb
+        while b <= max_batch:
+            out.add(b)
+            b += mb
+    return sorted(out, reverse=prefer_larger)
+
+
+def get_compatible_gpus(micro_batches: List[int], max_train_batch: int,
+                        min_gpus: int = 1, max_gpus: int = 1024
+                        ) -> Tuple[List[int], int, int]:
+    """For the best train batch ≤ max: which accelerator counts divide it
+    evenly with one of the allowed micro-batches?  Returns
+    (valid_gpu_counts, final_train_batch, micro_batch)."""
+    for batch in _candidate_batches(micro_batches, max_train_batch):
+        for mb in sorted(micro_batches, reverse=True):
+            if batch % mb:
+                continue
+            slots = batch // mb  # = world × GAS
+            valid = [g for g in range(min_gpus, min(max_gpus, slots) + 1)
+                     if slots % g == 0]
+            if valid:
+                return valid, batch, mb
+    raise ElasticityError(
+        f"no (batch, world) combination exists for micro_batches="
+        f"{micro_batches} max_train_batch={max_train_batch}")
+
+
+def compute_elastic_config(ds_config: Dict[str, Any],
+                           target_deepspeed_version: str = "",
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference signature.  With ``world_size`` > 0 also resolves the final
+    (train_batch, micro_batch, GAS) for that world."""
+    e = ds_config.get("elasticity", {})
+    if not e or not e.get("enabled", False):
+        raise ElasticityError("elasticity not enabled in config")
+    micro_batches = e.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = e.get("max_train_batch_size", 2000)
+    min_gpus = e.get("min_gpus", 1)
+    max_gpus = e.get("max_gpus", 10000)
+    prefer_larger = e.get("prefer_larger_batch", True)
+
+    valid_gpus, final_batch, micro = get_compatible_gpus(
+        micro_batches, max_batch, min_gpus, max_gpus)
+    if not prefer_larger:
+        final_batch = min(_candidate_batches(micro_batches, max_batch))
+    elastic = {"train_batch_size": final_batch,
+               "micro_batch_sizes": micro_batches,
+               "valid_gpus": valid_gpus}
+    if world_size > 0:
+        if world_size not in valid_gpus and final_batch % world_size:
+            raise ElasticityError(
+                f"world_size {world_size} incompatible with elastic batch "
+                f"{final_batch} (valid counts: {valid_gpus[:16]}...)")
+        slots = final_batch // micro
+        gas = max(slots // world_size, 1)
+        final = {"train_batch_size": final_batch,
+                 "train_micro_batch_size_per_gpu": micro,
+                 "gradient_accumulation_steps": gas}
+        logger.info(f"elasticity: world={world_size} -> {final}")
+        if return_microbatch:
+            return elastic, final_batch, micro
+        return elastic, final_batch
+    if return_microbatch:
+        return elastic, final_batch, micro
+    return elastic, final_batch

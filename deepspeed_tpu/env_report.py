@@ -1,0 +1,51 @@
+"""``ds_report`` — environment + op compatibility dump.
+
+Reference: ``deepspeed/env_report.py`` [K] — torch/cuda/nccl versions and a
+per-op compatibility matrix.  TPU edition: jax/jaxlib/libtpu/flax/optax/orbax
+versions, device inventory, native-op toolchain probes.
+"""
+
+from __future__ import annotations
+
+import importlib
+import shutil
+import sys
+
+
+def _version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return "not installed"
+
+
+def cli_main() -> None:
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy", "torch"):
+        print(f"{mod:>18}: {_version(mod)}")
+    try:
+        import jax
+
+        print(f"{'backend':>18}: {jax.default_backend()}")
+        print(f"{'devices':>18}: {jax.devices()}")
+        print(f"{'device_count':>18}: {jax.device_count()}")
+    except Exception as e:
+        print(f"{'jax devices':>18}: unavailable ({e})")
+    print("-" * 60)
+    print("native op compatibility")
+    from .ops.op_builder.builder import _BUILDERS
+
+    gxx = shutil.which("g++")
+    print(f"{'g++':>18}: {gxx or 'MISSING'}")
+    for name, builder in _BUILDERS.items():
+        status = "compatible" if builder.is_compatible() else "INCOMPATIBLE"
+        print(f"{name:>18}: {status}")
+    print("-" * 60)
+
+
+if __name__ == "__main__":
+    cli_main()

@@ -1,0 +1,3 @@
+from .engine import DeepSpeedInferenceConfig, InferenceEngine, init_inference
+
+__all__ = ["init_inference", "InferenceEngine", "DeepSpeedInferenceConfig"]

@@ -1,0 +1,128 @@
+"""Inference engine v1 — ``init_inference`` + KV-cache generation.
+
+Reference: ``deepspeed/inference/engine.py`` [K] —
+``deepspeed.init_inference(model, tensor_parallel={"tp_size": N}, dtype,
+replace_with_kernel_inject, max_out_tokens, ...) → InferenceEngine`` with
+``.generate(...)`` and module-call passthrough (SURVEY §2.5, §3.6).
+
+TPU-first: "kernel injection" IS the Pallas decode-attention kernel the
+model's ``decode_step`` already calls; "AutoTP" IS the model's PartitionSpec
+rules over the ``tensor`` mesh axis — so this engine only assembles mesh +
+sharded params + jitted prefill/decode and runs the token loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.mesh import MeshLayout
+from ..utils import groups as groups_mod
+from ..utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig:
+    tensor_parallel: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"tp_size": 1})
+    dtype: Any = jnp.bfloat16
+    replace_with_kernel_inject: bool = True  # Pallas decode kernel
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+
+
+class InferenceEngine:
+    def __init__(self, model: Any, params: Any,
+                 config: DeepSpeedInferenceConfig, mesh=None):
+        self.module = model
+        self.config = config
+        tp = int(config.tensor_parallel.get("tp_size", 1))
+        if mesh is None:
+            layout = MeshLayout.infer(max(tp, 1), tp=tp, dp=1)
+            mesh = groups_mod.initialize_mesh(layout)
+        self.mesh = mesh
+        if callable(getattr(model, "param_specs", None)) and tp > 1:
+            specs = model.param_specs()
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs)
+            params = jax.device_put(params, shardings)
+        self.params = params
+        self._prefill = jax.jit(model.prefill)
+        self._decode = jax.jit(model.decode_step)
+        log_dist(f"init_inference: tp={tp} dtype={config.dtype} "
+                 f"kernel_inject={config.replace_with_kernel_inject}")
+
+    def __call__(self, input_ids: jnp.ndarray, **kwargs):
+        """Module passthrough (reference engine forwards to the model)."""
+        return self.module.forward(self.params, input_ids)
+
+    def forward(self, input_ids: jnp.ndarray, **kwargs):
+        return self(input_ids, **kwargs)
+
+    def generate(self, input_ids: Any, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0, eos_token_id: Optional[int] = None
+                 ) -> jnp.ndarray:
+        """Greedy (temperature=0) or sampled generation with a KV cache.
+        ``input_ids [B, S]`` → ``[B, S + max_new_tokens]`` (right-padded with
+        the last generated token after EOS)."""
+        input_ids = jnp.asarray(input_ids)
+        B, S = input_ids.shape
+        max_len = S + max_new_tokens
+        cache = self.module.init_cache(B, max_len)
+        logits, cache = self._prefill(self.params, input_ids, cache)
+        rng = jax.random.PRNGKey(seed)
+        out = [input_ids]
+        done = jnp.zeros((B,), bool)
+        last = None
+        for i in range(max_new_tokens):
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                scaled = logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                tok = jax.random.categorical(sub, scaled)
+            else:
+                tok = jnp.argmax(logits, axis=-1)
+            tok = tok.astype(jnp.int32)
+            if eos_token_id is not None:
+                tok = jnp.where(done & (last is not None),
+                                last if last is not None else tok, tok)
+                done = done | (tok == eos_token_id)
+            out.append(tok[:, None])
+            last = tok
+            if eos_token_id is not None and bool(jnp.all(done)):
+                pad = jnp.tile(tok[:, None], (1, max_new_tokens - i - 1))
+                out.append(pad)
+                break
+            if i < max_new_tokens - 1:
+                logits, cache = self._decode(self.params, cache, tok)
+        return jnp.concatenate(out, axis=1)
+
+
+def init_inference(model: Any = None, config: Any = None, model_params: Any = None,
+                   tensor_parallel: Optional[Dict[str, Any]] = None,
+                   dtype: Any = jnp.bfloat16, replace_with_kernel_inject: bool = True,
+                   max_out_tokens: int = 1024, mesh=None,
+                   **kwargs) -> InferenceEngine:
+    """Reference call shape [L HF-DS:452 context]; ``model`` is one of our
+    model objects, ``model_params`` its pytree (or taken from
+    ``model.init_params`` when absent — tiny models/testing)."""
+    if config is None:
+        config = DeepSpeedInferenceConfig(
+            tensor_parallel=tensor_parallel or {"tp_size": 1},
+            dtype=dtype, replace_with_kernel_inject=replace_with_kernel_inject,
+            max_out_tokens=max_out_tokens)
+    elif isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**config)
+    if model_params is None:
+        if not hasattr(model, "init_params"):
+            raise ValueError("model_params required")
+        model_params = model.init_params(jax.random.PRNGKey(0))
+    return InferenceEngine(model, model_params, config, mesh=mesh)

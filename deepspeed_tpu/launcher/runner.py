@@ -1,0 +1,149 @@
+"""``deepspeed`` CLI — multi-host launch for TPU pods.
+
+Reference: ``deepspeed/launcher/runner.py`` [K] — parse ``--hostfile``
+(``host slots=N``), ``--include/--exclude`` filters, ``--num_nodes/
+--num_gpus``, ``--master_addr/--master_port``; spawn per-rank processes with
+RANK/LOCAL_RANK/WORLD_SIZE env (SURVEY §3.1).
+
+TPU-first: libtpu enumerates all LOCAL chips in one process, so the unit of
+launch is one process PER HOST (not per chip).  Single-host: exec the script
+directly.  Multi-host: ssh each host (pdsh-style) exporting
+``jax.distributed`` coordinator env (COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID), which ``deepspeed_tpu.comm.init_distributed`` consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_hostfile(path: str) -> Dict[str, int]:
+    """``hostname slots=N`` lines → {host: slots} (reference syntax)."""
+    resources: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            resources[host] = slots
+    return resources
+
+
+def filter_hosts(resources: Dict[str, int], include: str = "",
+                 exclude: str = "") -> Dict[str, int]:
+    """``--include/--exclude`` host[:slot,...] filters (reference syntax;
+    slot filtering selects chips on a host)."""
+
+    def parse_filter(spec: str) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for item in spec.split("@"):
+            item = item.strip()
+            if not item:
+                continue
+            if ":" in item:
+                host, slots = item.split(":")
+                out[host] = [int(s) for s in slots.split(",")]
+            else:
+                out[item] = []
+        return out
+
+    result = dict(resources)
+    if include:
+        inc = parse_filter(include)
+        result = {h: (len(s) if s else resources[h])
+                  for h, s in inc.items() if h in resources}
+    if exclude:
+        exc = parse_filter(exclude)
+        for h, s in exc.items():
+            if h in result:
+                if s:
+                    result[h] = max(result[h] - len(s), 0)
+                else:
+                    del result[h]
+        result = {h: n for h, n in result.items() if n > 0}
+    return result
+
+
+def build_env(rank: int, world: int, master_addr: str, master_port: int
+              ) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update({
+        "RANK": str(rank), "WORLD_SIZE": str(world), "LOCAL_RANK": "0",
+        "MASTER_ADDR": master_addr, "MASTER_PORT": str(master_port),
+        # jax.distributed names
+        "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        "NUM_PROCESSES": str(world), "PROCESS_ID": str(rank),
+    })
+    return env
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="deepspeed", description="deepspeed_tpu launcher")
+    parser.add_argument("--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("--include", default="")
+    parser.add_argument("--exclude", default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--ssh_port", type=int, default=22)
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    hosts: Dict[str, int] = {}
+    if os.path.exists(args.hostfile):
+        hosts = filter_hosts(parse_hostfile(args.hostfile), args.include,
+                             args.exclude)
+    if args.num_nodes > 0 and hosts:
+        hosts = dict(list(hosts.items())[:args.num_nodes])
+
+    cmd = [sys.executable, args.user_script] + args.user_args
+
+    if not hosts or len(hosts) == 1 or args.launcher == "local":
+        # single host: libtpu owns every local chip in ONE process
+        logger.info(f"launching single-host: {' '.join(cmd)}")
+        proc = subprocess.run(
+            cmd, env=build_env(0, 1, args.master_addr, args.master_port))
+        return proc.returncode
+
+    world = len(hosts)
+    procs: List[subprocess.Popen] = []
+    for rank, host in enumerate(hosts):
+        env = build_env(rank, world, args.master_addr, args.master_port)
+        exports = " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in env.items()
+            if k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR",
+                     "MASTER_PORT", "COORDINATOR_ADDRESS", "NUM_PROCESSES",
+                     "PROCESS_ID"))
+        remote = f"cd {shlex.quote(os.getcwd())} && {exports} {' '.join(map(shlex.quote, cmd))}"
+        ssh_cmd = ["ssh", "-p", str(args.ssh_port), host, remote]
+        logger.info(f"rank {rank} @ {host}: {remote}")
+        procs.append(subprocess.Popen(ssh_cmd))
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,9 @@ class LlamaConfig:
     #: pipeline microbatch count (0 → pipe axis size); used when the mesh has
     #: a pipe axis > 1
     pp_microbatches: int = 0
+    #: "flash" → Pallas online-softmax kernel (TPU; falls back to XLA off-TPU),
+    #: "xla" → einsum+softmax left to the XLA fuser
+    attn_impl: str = "xla"
 
     @property
     def hd(self) -> int:
@@ -255,6 +258,10 @@ class LlamaModel:
             positions = jnp.arange(S)[None, :]
             q = _rope(q, positions, c.rope_theta)
             kk = _rope(kk, positions, c.rope_theta)
+            if c.attn_impl == "flash":
+                from ..ops.pallas.flash_attention import flash_attention
+
+                return flash_attention(q, kk, vv, True)
             causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
             return _attention(q, kk, vv, causal)
 
@@ -336,6 +343,105 @@ class LlamaModel:
     def _head(self, params: Any) -> jnp.ndarray:
         return (params["embed"].T if self.config.tie_embeddings
                 else params["lm_head"])
+
+    # ------------------------------------------------------------------
+    # KV-cache inference path (consumed by deepspeed_tpu.inference)
+    # ------------------------------------------------------------------
+
+    def init_cache(self, batch_size: int, max_len: int) -> Dict[str, Any]:
+        """Decode cache: full heads stored (GQA groups pre-expanded so the
+        Pallas decode kernel sees matched head counts)."""
+        c = self.config
+        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.hd)
+        return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+                "lengths": jnp.zeros((batch_size,), jnp.int32)}
+
+    def prefill(self, params: Any, input_ids: jnp.ndarray,
+                cache: Dict[str, Any]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """Process the prompt [B, S]; returns (last-token logits [B, V],
+        filled cache)."""
+        c = self.config
+        B, S = input_ids.shape
+        max_len = cache["k"].shape[2]
+        n_rep = c.num_heads // c.num_kv_heads
+        x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
+        positions = jnp.arange(S)[None, :]
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+
+        def layer(carry, lp):
+            x, = carry
+            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+            q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
+            kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
+            vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
+            if n_rep > 1:
+                kk = jnp.repeat(kk, n_rep, axis=2)
+                vv = jnp.repeat(vv, n_rep, axis=2)
+            q = _rope(q, positions, c.rope_theta)
+            kk = _rope(kk, positions, c.rope_theta)
+            attn = _attention(q, kk, vv, causal)
+            out = jnp.einsum("bshd,hdH->bsH", attn,
+                             lp["attn"]["wo"].astype(c.dtype))
+            x = x + out
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            ffn_out, _ = self._ffn(h, lp)
+            x = x + ffn_out
+            pad = max_len - S
+            k_entry = jnp.pad(kk, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_entry = jnp.pad(vv, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            return (x,), (k_entry, v_entry)
+
+        (x,), (ks, vs) = jax.lax.scan(layer, (x,), params["layers"])
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        logits = jnp.einsum("bH,HV->bV", x[:, -1],
+                            self._head(params).astype(c.dtype))
+        cache = {"k": ks, "v": vs,
+                 "lengths": jnp.full((B,), S, jnp.int32)}
+        return logits.astype(jnp.float32), cache
+
+    def decode_step(self, params: Any, cache: Dict[str, Any],
+                    tokens: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """One generation step: tokens [B] → (logits [B, V], updated cache)."""
+        from ..ops.pallas.decode_attention import decode_attention
+
+        c = self.config
+        B = tokens.shape[0]
+        n_rep = c.num_heads // c.num_kv_heads
+        lengths = cache["lengths"]
+        x = jnp.take(params["embed"].astype(c.dtype), tokens, axis=0)  # [B,H]
+        pos = lengths[:, None]  # [B,1] next position per sequence
+
+        def layer(carry, xs):
+            x, = carry
+            lp, k_cache, v_cache = xs
+            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+            q = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wq"].astype(c.dtype))
+            kk = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wk"].astype(c.dtype))
+            vv = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wv"].astype(c.dtype))
+            if n_rep > 1:
+                kk = jnp.repeat(kk, n_rep, axis=1)
+                vv = jnp.repeat(vv, n_rep, axis=1)
+            q = _rope(q[:, None], pos, c.rope_theta)[:, 0]
+            kk = _rope(kk[:, None], pos, c.rope_theta)[:, 0]
+            k_cache = k_cache.at[jnp.arange(B), lengths].set(kk)
+            v_cache = v_cache.at[jnp.arange(B), lengths].set(vv)
+            attn = decode_attention(q, k_cache, v_cache, lengths + 1)
+            out = jnp.einsum("bhd,hdH->bH", attn,
+                             lp["attn"]["wo"].astype(c.dtype))
+            x = x + out
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            ffn_out, _ = self._ffn(h[:, None, :], lp)
+            x = x + ffn_out[:, 0, :]
+            return (x,), (k_cache, v_cache)
+
+        (x,), (ks, vs) = jax.lax.scan(
+            layer, (x,), (params["layers"], cache["k"], cache["v"]))
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        logits = jnp.einsum("bH,HV->bV", x,
+                            self._head(params).astype(c.dtype))
+        new_cache = {"k": ks, "v": vs, "lengths": lengths + 1}
+        return logits.astype(jnp.float32), new_cache
 
     def forward(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
         """[B, S] token ids → [B, S, V] logits (fp32)."""

@@ -75,16 +75,17 @@ class MoE:
     # ------------------------------------------------------------------
 
     def init_params(self, rng: jax.Array, intermediate_size: int) -> Any:
-        """Params for the built-in SwiGLU expert + router."""
+        """Params for the built-in SwiGLU expert + router (+ the residual
+        dense MLP and 2-way mixing coefficient when ``use_residual``)."""
         E, H, I = self.num_experts, self.hidden_size, intermediate_size
-        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        k1, k2, k3, k4, k5, k6, k7, k8 = jax.random.split(rng, 8)
         import numpy as np
 
         def normal(key, shape, fan_in):
             return (jax.random.normal(key, shape, jnp.float32)
                     / np.sqrt(fan_in))
 
-        return {
+        params = {
             "wg": normal(k1, (H, E), H),
             "experts": {
                 "w_gate": normal(k2, (E, H, I), H),
@@ -92,12 +93,20 @@ class MoE:
                 "w_down": normal(k4, (E, I, H), I),
             },
         }
+        if self.use_residual:
+            params["residual_mlp"] = {
+                "w_gate": normal(k5, (1, H, I), H),
+                "w_up": normal(k6, (1, H, I), H),
+                "w_down": normal(k7, (1, I, H), I),
+            }
+            params["coefficient"] = normal(k8, (H, 2), H)
+        return params
 
     def param_specs(self) -> Any:
         """Expert-stacked dims shard over the ``expert`` axis (+ optional TP
         on the FFN inner dim — reference enable_expert_tensor_parallelism)."""
         t = AXIS_TENSOR if self.enable_expert_tensor_parallelism else None
-        return {
+        specs = {
             "wg": P(None, None),
             "experts": {
                 "w_gate": P(AXIS_EXPERT, None, t),
@@ -105,6 +114,14 @@ class MoE:
                 "w_down": P(AXIS_EXPERT, t, None),
             },
         }
+        if self.use_residual:
+            specs["residual_mlp"] = {
+                "w_gate": P(None, None, t),
+                "w_up": P(None, None, t),
+                "w_down": P(None, t, None),
+            }
+            specs["coefficient"] = P(None, None)
+        return specs
 
     def __call__(self, params: Any, x: jnp.ndarray, train: bool = True,
                  noise_rng: Optional[jax.Array] = None
@@ -113,6 +130,13 @@ class MoE:
         y, l_aux, meta = self.moe_layer(params["wg"], params["experts"], x,
                                         train=train, noise_rng=noise_rng)
         if self.use_residual:
-            # reference residual-MoE: average with the dense path output
-            y = 0.5 * (y + x)
+            # reference Residual-MoE (moe/layer.py [K]): a dense MLP runs in
+            # parallel and a learned 2-way softmax coefficient mixes the two
+            dense = swiglu_expert_fn(params["residual_mlp"],
+                                     x.reshape(1, -1, x.shape[-1]))
+            dense = dense.reshape(x.shape)
+            coef = jax.nn.softmax(
+                jnp.einsum("...h,hc->...c", x,
+                           params["coefficient"].astype(x.dtype)), axis=-1)
+            y = y * coef[..., 0:1] + dense * coef[..., 1:2]
         return y, l_aux, meta["exp_counts"]

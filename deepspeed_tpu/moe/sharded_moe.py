@@ -73,30 +73,35 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity: int,
     # positions within each expert: running count over tokens, per choice
     # (second choices queue behind ALL first choices — reference behavior)
     locations = []
+    positions = []
     offset = jnp.zeros((E,), jnp.float32)
     for m in masks:
         loc = jnp.cumsum(m, axis=0) - m + offset[None, :]
         offset = offset + jnp.sum(m, axis=0)
         locations.append(loc)
+        positions.append(jnp.sum(loc * m, axis=-1))  # [T] slot in expert
+
+    exp_counts = jnp.sum(masks[0], axis=0)  # pre-drop assignment counts
+
+    # capacity-filter masks BEFORE renormalizing (reference top2gating order:
+    # a token whose 2nd choice is dropped keeps FULL weight on its 1st)
+    if drop_tokens:
+        masks = [m * (pos < C).astype(m.dtype)[:, None]
+                 for m, pos in zip(masks, positions)]
 
     combine = jnp.zeros((T, E, C), jnp.float32)
     dispatch = jnp.zeros((T, E, C), bool)
     denom = sum(jnp.sum(gates * m, axis=-1) for m in masks)
     denom = jnp.maximum(denom, 1e-9)
-    for m, loc in zip(masks, locations):
-        pos = jnp.sum(loc * m, axis=-1)  # [T] position in chosen expert
-        if drop_tokens:
-            keep = pos < C
-        else:
-            keep = jnp.ones_like(pos, bool)
-        gate_k = jnp.sum(gates * m, axis=-1) / denom  # renormalized over top-k
-        pos_oh = _one_hot(jnp.where(keep, pos, C).astype(jnp.int32),
-                          C + 1)[:, :C]  # overflow → all-zero row
+    for m, pos in zip(masks, positions):
+        gate_k = jnp.sum(gates * m, axis=-1) / denom  # renormalized over kept
+        # out-of-range pos rows one-hot to all-zero, but m is already zero
+        # there after the capacity filter
+        pos_oh = _one_hot(pos.astype(jnp.int32), C + 1)[:, :C]
         contrib = m[:, :, None] * pos_oh[:, None, :]
         combine = combine + gate_k[:, None, None] * contrib
         dispatch = dispatch | (contrib > 0)
 
-    exp_counts = jnp.sum(masks[0], axis=0)
     meta = {"l_aux": l_aux, "exp_counts": exp_counts,
             "drop_rate": 1.0 - jnp.sum(combine > 0) / jnp.maximum(k * T, 1)}
     return combine, dispatch, l_aux, meta

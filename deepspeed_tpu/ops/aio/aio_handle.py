@@ -28,6 +28,7 @@ class AIOHandle:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_char_p, ctypes.c_int64]
         self.lib.ds_aio_pwrite.argtypes = self.lib.ds_aio_pread.argtypes
+        self.lib.ds_aio_pwrite_trunc.argtypes = self.lib.ds_aio_pread.argtypes
         self.lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
         self.lib.ds_aio_wait.restype = ctypes.c_int64
         self.lib.ds_aio_inflight.argtypes = [ctypes.c_void_p]
@@ -41,17 +42,23 @@ class AIOHandle:
         self.lib.ds_aio_pread(self._h, buf.ctypes.data, buf.nbytes,
                               path.encode(), offset)
 
-    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
+    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0,
+                     truncate: bool = False) -> None:
+        """``truncate=True`` drops stale tail bytes beyond this write (use
+        for whole-file shard rewrites; offset writes into larger files must
+        leave it False)."""
         assert buf.flags["C_CONTIGUOUS"]
-        self.lib.ds_aio_pwrite(self._h, buf.ctypes.data, buf.nbytes,
-                               path.encode(), offset)
+        fn = (self.lib.ds_aio_pwrite_trunc if truncate
+              else self.lib.ds_aio_pwrite)
+        fn(self._h, buf.ctypes.data, buf.nbytes, path.encode(), offset)
 
     def sync_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
         self.async_pread(buf, path, offset)
         self.wait()
 
-    def sync_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
-        self.async_pwrite(buf, path, offset)
+    def sync_pwrite(self, buf: np.ndarray, path: str, offset: int = 0,
+                    truncate: bool = False) -> None:
+        self.async_pwrite(buf, path, offset, truncate=truncate)
         self.wait()
 
     def wait(self) -> int:

@@ -1,0 +1,104 @@
+"""Decode attention with KV cache — the inference-serving hot kernel.
+
+Role parity: the reference's kernel-injection decode attention
+(``csrc/transformer/inference/`` fused attention over a KV cache [K]) and
+the inference-v2 ragged blocked-KV kernels.  Single-token queries attend
+over a padded per-sequence cache with true lengths — the TPU-friendly
+static-shape formulation of ragged batching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _reference_decode(q, k_cache, v_cache, lengths):
+    # q: [B, h, d]; caches: [B, Smax, h, d]; lengths: [B]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhd,bkhd->bhk", q, k_cache).astype(jnp.float32) * scale
+    Smax = k_cache.shape[1]
+    mask = jnp.arange(Smax)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", p, v_cache)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                   s_max: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    length = len_ref[b]
+    q = q_ref[0].astype(jnp.float32) * scale  # [h, d]
+    h, d = q.shape
+    nk = s_max // block_k
+
+    m0 = jnp.full((h,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h,), jnp.float32)
+    acc0 = jnp.zeros((h, d), jnp.float32)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(ki * block_k, block_k), :, :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki * block_k, block_k), :, :].astype(jnp.float32)
+        # [block_k, h] scores — elementwise-multiply + d-reduce (VPU):
+        # Mosaic cannot lower batched (per-head) dots, and decode is
+        # memory-bound so the MXU is not the limiter here
+        s = jnp.sum(kblk * q[None, :, :], axis=-1)
+        pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, h), 0)
+        s = jnp.where(pos < length, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=0))
+        p = jnp.exp(s - m_new[None, :])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=0)
+        acc_new = acc * alpha[:, None] + jnp.sum(
+            p[:, :, None] * vblk, axis=0)
+        return m_new, l_new, acc_new
+
+    # only blocks below the length can contribute
+    nk_eff = jnp.minimum((length + block_k - 1) // block_k, nk)
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-9)[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, block_k: int = 128,
+                     interpret: bool | None = None):
+    """q ``[B, h, d]`` one-token queries over padded caches
+    ``[B, Smax, h, d]`` with per-sequence ``lengths [B]``."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _reference_decode(q, k_cache, v_cache, lengths)
+        interpret = False
+    B, Smax, h, d = k_cache.shape
+    block_k = min(block_k, Smax)
+    if Smax % block_k:
+        return _reference_decode(q, k_cache, v_cache, lengths)
+
+    kernel = functools.partial(_decode_kernel, block_k=block_k, s_max=Smax,
+                               scale=1.0 / np.sqrt(d))
+    grid_spec = None
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda b, lens: (b, 0, 0)),
+                pl.BlockSpec((1, Smax, h, d), lambda b, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Smax, h, d), lambda b, lens: (b, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, d), lambda b, lens: (b, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, h, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k_cache, v_cache)
+    return out

@@ -1,0 +1,154 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+Role parity: the reference's fused attention kernels
+(``csrc/transformer/`` + inference attention [K]) — here as a blocked
+q-loop × online-softmax k-loop kernel that never materializes the
+``[S, S]`` score matrix in HBM.
+
+Forward is the Pallas kernel; backward (training) is a custom-VJP that
+recomputes scores in XLA (flash-bwd kernel is a later optimization; the
+recompute is what ``jax.remat`` would do anyway and XLA fuses it well).
+``interpret=True`` (CPU testing) and the jnp reference path keep numerics
+checkable everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _reference_attention(q, k, v, causal: bool):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S, T = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, T), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+               seq_len: int, causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    nk = seq_len // block_k
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        nk_eff = (qi * block_q + block_q + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk_eff, nk)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128):
+    """[B, S, h, d] attention; Pallas on TPU, jnp reference elsewhere."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k)[0]
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _flash_call(q, k, v, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, h, d = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        return _reference_attention(q, k, v, causal)
+    # [B, S, h, d] -> [B*h, S, d]
+    qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+
+    kernel = functools.partial(
+        _fa_kernel, block_q=block_q, block_k=block_k, seq_len=S,
+        causal=causal, scale=1.0 / np.sqrt(d))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * h, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    if _use_pallas():
+        out = _flash_call(q, k, v, causal, block_q, block_k, interpret=False)
+    else:
+        out = _reference_attention(q, k, v, causal)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, do):
+    """XLA recompute backward (standard softmax-attention gradient)."""
+    q, k, v = res
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32))
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_interpret(q, k, v, causal: bool = True,
+                              block_q: int = 64, block_k: int = 64):
+    """Interpreter-mode kernel run (CPU numerics testing)."""
+    return _flash_call(q, k, v, causal, block_q, block_k, interpret=True)

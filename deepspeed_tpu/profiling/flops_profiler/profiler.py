@@ -1,0 +1,148 @@
+"""Flops profiler — XLA cost analysis instead of module hooks.
+
+Reference: ``deepspeed/profiling/flops_profiler/profiler.py`` [K] —
+``FlopsProfiler`` (module-hook MAC counting, per-module latency table at
+``profile_step``) and standalone ``get_model_profile()``; engine config group
+``flops_profiler.{enabled,profile_step,module_depth,top_modules,detailed,
+output_file}`` (SURVEY §5.1).
+
+TPU-first: a jitted function's exact FLOPs/bytes come from the COMPILER —
+``jax.jit(fn).lower(...).compile().cost_analysis()`` — so no hook walking,
+and the numbers are the post-fusion truth rather than an analytic estimate.
+Wall-clock from timed replay gives achieved FLOP/s and MFU.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ...utils.logging import log_dist, logger
+
+#: published dense peak (bf16) per chip for MFU, overridable per deployment
+DEFAULT_PEAK_FLOPS = {
+    "tpu": 197e12,   # v5p bf16 peak; v5e ≈ 394e12 int8 / 197e12 bf16 shared
+    "cpu": 1e12,
+    "gpu": 312e12,
+}
+
+
+def _compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    costs = compiled.cost_analysis()
+    if isinstance(costs, list):  # older jax returns [dict]
+        costs = costs[0] if costs else {}
+    return dict(costs or {})
+
+
+class FlopsProfiler:
+    """Profile a jitted step function (or an engine's train step)."""
+
+    def __init__(self, model: Any = None, ds_engine: Any = None):
+        self.engine = ds_engine if ds_engine is not None else model
+        self.profile: Dict[str, float] = {}
+
+    # -- step-function profiling ------------------------------------------
+
+    def profile_fn(self, fn: Callable, *args, runs: int = 3,
+                   **kwargs) -> Dict[str, float]:
+        costs = _compiled_cost(fn, *args, **kwargs)
+        flops = float(costs.get("flops", 0.0))
+        jitted = jax.jit(fn)
+        out = jitted(*args, **kwargs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            out = jitted(*args, **kwargs)
+        jax.block_until_ready(out)
+        latency = (time.perf_counter() - t0) / runs
+        backend = jax.default_backend()
+        peak = DEFAULT_PEAK_FLOPS.get(backend, 1e12)
+        achieved = flops / latency if latency > 0 else 0.0
+        self.profile = {
+            "flops": flops,
+            "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
+            "latency_s": latency,
+            "achieved_flops_per_s": achieved,
+            "mfu": achieved / (peak * jax.device_count()),
+            "backend": backend,
+        }
+        return self.profile
+
+    # -- engine hook surface (reference API names) ------------------------
+
+    def start_profile(self, ignore_list=None) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop_profile(self) -> None:
+        self.profile.setdefault("latency_s", time.perf_counter() - self._t0)
+
+    def get_total_flops(self, as_string: bool = False):
+        v = self.profile.get("flops", 0.0)
+        return _num_to_string(v, "FLOPs") if as_string else v
+
+    def get_total_duration(self, as_string: bool = False):
+        v = self.profile.get("latency_s", 0.0)
+        return f"{v * 1e3:.2f} ms" if as_string else v
+
+    def print_model_profile(self, profile_step: int = 1, module_depth: int = -1,
+                            top_modules: int = 1, detailed: bool = True,
+                            output_file: Optional[str] = None) -> None:
+        lines = ["-" * 60, "DeepSpeed-TPU Flops Profiler",
+                 "-" * 60]
+        for k, v in self.profile.items():
+            lines.append(f"{k:>24}: {v}")
+        text = "\n".join(lines)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(text)
+        else:
+            log_dist(text)
+
+    def end_profile(self) -> None:
+        self.profile = {}
+
+
+def _num_to_string(num: float, unit: str) -> str:
+    for scale, prefix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if num >= scale:
+            return f"{num / scale:.2f} {prefix}{unit}"
+    return f"{num:.2f} {unit}"
+
+
+def get_model_profile(model: Any = None, input_shape: Tuple[int, ...] = None,
+                      args: Tuple = (), kwargs: Optional[Dict] = None,
+                      print_profile: bool = True, detailed: bool = True,
+                      module_depth: int = -1, top_modules: int = 1,
+                      warm_up: int = 1, as_string: bool = True,
+                      output_file: Optional[str] = None,
+                      ignore_modules=None,
+                      fn: Optional[Callable] = None):
+    """Standalone profile (reference ``get_model_profile`` shape).
+
+    TPU adaptation: pass ``fn`` + ``args`` (a pure function and its inputs);
+    ``model`` objects with ``.loss``/``.forward`` are profiled through that.
+    Returns (flops, macs, params) like the reference — macs = flops/2.
+    """
+    if fn is None:
+        if model is None:
+            raise ValueError("need fn or model")
+        fn = model.forward if hasattr(model, "forward") else model
+    prof = FlopsProfiler()
+    result = prof.profile_fn(fn, *args, **(kwargs or {}))
+    params = 0
+    if args:
+        try:
+            params = sum(int(x.size) for x in jax.tree.leaves(args[0]))
+        except Exception:
+            params = 0
+    if print_profile:
+        prof.print_model_profile(output_file=output_file)
+    flops = result["flops"]
+    macs = flops / 2
+    if as_string:
+        return (_num_to_string(flops, "FLOPs"), _num_to_string(macs, "MACs"),
+                _num_to_string(params, ""))
+    return flops, macs, params

@@ -1,0 +1,3 @@
+from .checkpointing import CheckpointConfig, checkpoint, configure
+
+__all__ = ["checkpoint", "configure", "CheckpointConfig"]

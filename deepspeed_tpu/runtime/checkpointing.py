@@ -66,9 +66,18 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     with open(os.path.join(ckpt_dir, "client_state.json"), "w") as fh:
         json.dump(meta, fh, default=str)
 
-    # reference writes a `latest` file naming the newest tag [K]
+    # reference writes a `latest` file naming the newest tag [K] and ships
+    # zero_to_fp32.py into the checkpoint dir [L trainer.py:4218]
     with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
         fh.write(tag)
+    try:
+        import shutil
+
+        from ..utils import zero_to_fp32 as z2f
+
+        shutil.copy(z2f.__file__, os.path.join(save_dir, "zero_to_fp32.py"))
+    except Exception:  # non-fatal convenience copy
+        pass
     log_dist(f"saved checkpoint {ckpt_dir}")
     return ckpt_dir
 

@@ -498,6 +498,17 @@ class DeepSpeedEngine:
     def eval(self):
         return self
 
+    def _zero3_consolidated_16bit_state_dict(
+            self, exclude_frozen_parameters: bool = False):
+        """Gather the (possibly ZeRO-3-sharded) params into replicated host
+        bf16 arrays [L ACC:4042] — device_get assembles the logical array
+        regardless of sharding."""
+        return jax.tree.map(
+            lambda p: np.asarray(jax.device_get(p)).astype(
+                jnp.bfloat16 if jnp.issubdtype(p.dtype, jnp.floating)
+                else p.dtype),
+            self.state.params)
+
     # checkpointing implemented in runtime/checkpointing.py, attached by entry
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         exclude_frozen_parameters=False):

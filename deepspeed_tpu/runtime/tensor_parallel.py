@@ -1,0 +1,48 @@
+"""AutoTP training entry — ``tp_model_init`` [L HF-DS:464-473].
+
+Reference: ``deepspeed/runtime/tensor_parallel/`` + ``module_inject/auto_tp``
+[K] — walk the module graph, split linears row/col-wise, insert allreduce.
+TPU-first: the "policy" is the model's ``param_specs()`` (tensor-axis
+PartitionSpecs) and the "inserted allreduce" is GSPMD; so tp init reduces to
+building/adopting a mesh with the requested tp degree and binding the model
+to it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from ..parallel.mesh import MeshLayout
+from ..utils import groups as groups_mod
+from ..utils.logging import log_dist
+
+
+def tp_model_init(model: Any = None, tp_size: int = 1, dtype: Any = None,
+                  config: Any = None, mesh: Any = None) -> Any:
+    """Bind ``model`` to a tp_size-way mesh; params created afterwards (or
+    device_put by the engine) land column/row-sharded per the model's
+    ``param_specs``."""
+    if mesh is None:
+        try:
+            mesh = groups_mod.get_mesh()
+            if int(mesh.shape.get("tensor", 1)) != tp_size:
+                mesh = None
+        except Exception:
+            mesh = None
+    if mesh is None:
+        layout = MeshLayout.infer(jax.device_count(), tp=tp_size)
+        mesh = groups_mod.initialize_mesh(layout)
+    if hasattr(model, "mesh"):
+        model.mesh = mesh
+    if dtype is not None and hasattr(model, "config") and hasattr(
+            model.config, "dtype"):
+        try:
+            object.__setattr__(model.config, "dtype", dtype)
+        except Exception:
+            import dataclasses
+
+            model.config = dataclasses.replace(model.config, dtype=dtype)
+    log_dist(f"tp_model_init: tp={tp_size} mesh={dict(mesh.shape)}")
+    return model

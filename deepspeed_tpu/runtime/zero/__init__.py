@@ -1,5 +1,8 @@
 from .config import (DeepSpeedZeroConfig, DeepSpeedZeroOffloadOptimizerConfig,
                      DeepSpeedZeroOffloadParamConfig, OffloadDeviceEnum)
+from .init_ctx import GatheredParameters, Init
+from .sharder import ZeroShardingPolicy
 
 __all__ = ["DeepSpeedZeroConfig", "DeepSpeedZeroOffloadOptimizerConfig",
-           "DeepSpeedZeroOffloadParamConfig", "OffloadDeviceEnum"]
+           "DeepSpeedZeroOffloadParamConfig", "OffloadDeviceEnum",
+           "Init", "GatheredParameters", "ZeroShardingPolicy"]

@@ -1,0 +1,102 @@
+"""zero.Init / GatheredParameters — ZeRO-3 construction-time API.
+
+Reference: ``zero/partition_parameters.py`` [K] — ``zero.Init`` patches
+``nn.Parameter.__new__`` so params are partitioned at construction
+[L HF-MU:2306]; ``GatheredParameters(params, modifier_rank=)`` temporarily
+assembles full params for surgery [L HF-MU:3218].
+
+TPU-first: params are pytrees and sharding is metadata, so
+* ``Init`` = materialize the init function DIRECTLY into its ZeRO sharding
+  (``jax.jit(init_fn, out_shardings=...)``) — the full model never exists on
+  one device, which is the entire point of the reference machinery;
+* ``GatheredParameters`` = a context that hands out the assembled host copy
+  and (with ``modifier_rank``) writes modifications back into the sharded
+  arrays on exit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ...utils import groups as groups_mod
+from .config import DeepSpeedZeroConfig
+from .sharder import ZeroShardingPolicy
+
+
+class Init:
+    """Context + materializer.  Usage::
+
+        with zero.Init(config_dict_or_path=ds_config, mesh=mesh) as zinit:
+            params = zinit.materialize(model.init_params, rng,
+                                       base_specs=model.param_specs())
+    """
+
+    def __init__(self, module: Any = None, data_parallel_group: Any = None,
+                 mem_efficient_linear: bool = True, remote_device: Any = None,
+                 pin_memory: bool = False, config_dict_or_path: Any = None,
+                 config: Any = None, enabled: bool = True, dtype: Any = None,
+                 mpu: Any = None, mesh: Any = None):
+        self.enabled = enabled
+        self.mesh = mesh if mesh is not None else groups_mod.get_mesh()
+        payload = config_dict_or_path if config_dict_or_path is not None else config
+        zero_cfg = DeepSpeedZeroConfig()
+        if isinstance(payload, dict):
+            zero_cfg = DeepSpeedZeroConfig.model_validate(
+                payload.get("zero_optimization", {}))
+        elif payload is not None:
+            from ..config import _load_config_payload
+
+            zero_cfg = DeepSpeedZeroConfig.model_validate(
+                _load_config_payload(payload).get("zero_optimization", {}))
+        if zero_cfg.stage < 3:
+            zero_cfg = zero_cfg.model_copy(update={"stage": 3})
+        self.policy = ZeroShardingPolicy.from_config(self.mesh, zero_cfg)
+
+    def __enter__(self) -> "Init":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def materialize(self, init_fn: Callable[..., Any], *args,
+                    base_specs: Any = None) -> Any:
+        """Run ``init_fn(*args)`` with every output leaf born sharded."""
+        if not self.enabled:
+            return init_fn(*args)
+        shapes = jax.eval_shape(init_fn, *args)
+        shardings = self.policy.param_shardings(shapes, base_specs)
+        return jax.jit(init_fn, out_shardings=shardings)(*args)
+
+
+class GatheredParameters:
+    """Assemble sharded params on host; write back if ``modifier_rank`` is
+    set (None → read-only view, reference semantics)."""
+
+    def __init__(self, params: Any, modifier_rank: Optional[int] = None,
+                 fwd_module: Any = None, enabled: bool = True):
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.gathered: Any = None
+
+    def __enter__(self) -> Any:
+        if not self.enabled:
+            return self.params
+        self.gathered = jax.tree.map(
+            lambda p: np.array(jax.device_get(p)), self.params)
+        return self.gathered
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is not None or not self.enabled:
+            return
+        if self.modifier_rank is not None:
+            # jax arrays are immutable, so the write-back materializes as a
+            # NEW pytree in the original shardings: callers read .result
+            # (torch mutates in place; this is the functional equivalent)
+            self.result = jax.tree.map(
+                lambda old, new: jax.device_put(
+                    new, getattr(old, "sharding", None)),
+                self.params, self.gathered)

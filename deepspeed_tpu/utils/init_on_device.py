@@ -1,0 +1,33 @@
+"""OnDevice — abstract ("meta") model construction.
+
+Reference: ``deepspeed/utils/init_on_device.py`` [K] — ``OnDevice(dtype,
+device="meta")`` builds torch modules without allocating storage.  JAX has
+this natively as ``jax.eval_shape``; the context exposes it under the
+reference name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+class OnDevice:
+    def __init__(self, dtype: Any = None, device: str = "meta",
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self) -> "OnDevice":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def abstract(self, init_fn: Callable[..., Any], *args) -> Any:
+        """ShapeDtypeStruct pytree — zero bytes allocated."""
+        if not self.enabled:
+            return init_fn(*args)
+        return jax.eval_shape(init_fn, *args)

@@ -1,0 +1,33 @@
+"""set_z3_leaf_modules — ZeRO-3 gather-granularity hints.
+
+Reference [L ACC-DC:1538]: marks MoE blocks so ZeRO-3 gathers the whole
+block at once (the hook prefetcher can't see through data-dependent expert
+routing).  Under GSPMD there IS no gather state machine — XLA schedules
+all-gathers from the dataflow graph, routing included — so the hint has no
+work to do; it is kept for API/config parity and records the request.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from .logging import logger
+
+_LEAF_MODULES: List[Any] = []
+
+
+def set_z3_leaf_modules(model: Any, leaf_module_classes: List[Any],
+                        raise_if_not_found: bool = True) -> List[Any]:
+    _LEAF_MODULES.extend(leaf_module_classes)
+    logger.info(
+        f"set_z3_leaf_modules({[getattr(c, '__name__', c) for c in leaf_module_classes]}): "
+        "no-op on TPU — GSPMD schedules gathers from dataflow, MoE included")
+    return []
+
+
+def get_z3_leaf_modules(model: Any = None) -> List[Any]:
+    return list(_LEAF_MODULES)
+
+
+def z3_leaf_module(model: Any) -> bool:
+    return False

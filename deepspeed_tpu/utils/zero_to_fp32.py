@@ -1,0 +1,76 @@
+"""zero_to_fp32 — consolidate a sharded checkpoint into plain fp32 arrays.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` [K] — the offline tool shipped
+INTO every checkpoint dir that merges ZeRO shards into a single fp32
+state_dict [L trainer.py:4218].  Orbax stores logical (unsharded) arrays, so
+"consolidation" here is a restore-without-mesh + dtype cast — resumable from
+ANY source mesh layout (the universal-checkpoint capability, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Load the params subtree of a saved engine state as host fp32 numpy,
+    flattened to {'/'-joined path: array}."""
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            candidates = sorted(
+                d for d in os.listdir(checkpoint_dir)
+                if d.startswith("global_step"))
+            if not candidates:
+                raise FileNotFoundError(
+                    f"no global_step* checkpoint under {checkpoint_dir}")
+            tag = candidates[-1]
+    state_path = os.path.join(checkpoint_dir, tag, "state")
+    with ocp.StandardCheckpointer() as loader:
+        meta = loader.metadata(state_path).item_metadata.tree
+        target = jax.tree.map(
+            lambda am: jax.ShapeDtypeStruct(tuple(am.shape), am.dtype), meta)
+        restored = loader.restore(state_path, target)
+    params = restored["params"] if isinstance(restored, dict) else restored.params
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str,
+        tag: Optional[str] = None) -> None:
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    with open(output_file, "wb") as f:
+        pickle.dump(sd, f)
+    total = sum(v.size for v in sd.values())
+    print(f"saved {len(sd)} tensors / {total:,} params to {output_file}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    a = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(a.checkpoint_dir,
+                                               a.output_file, tag=a.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,3 +105,29 @@ def test_mixtral_ep_training_matches_single_device():
     single = run(groups.initialize_mesh(MeshLayout.infer(1, dp=1)))
     np.testing.assert_allclose(sharded, single, rtol=3e-4, atol=3e-4)
     assert sharded[-1] < sharded[0]
+
+
+def test_moe_residual_path():
+    groups.initialize_mesh(MeshLayout.infer(8, ep=4, dp=2))
+    moe = MoE(hidden_size=16, num_experts=4, ep_size=4, k=1,
+              capacity_factor=4.0, use_residual=True)
+    params = moe.init_params(jax.random.PRNGKey(0), intermediate_size=32)
+    assert "residual_mlp" in params and "coefficient" in params
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16), jnp.float32)
+    y, l_aux, _ = moe(params, x)
+    assert y.shape == x.shape and jnp.isfinite(y).all()
+    # specs cover every leaf
+    assert set(moe.param_specs()) == set(params)
+
+
+def test_top2_drop_keeps_full_weight_on_survivor():
+    """Reference order: capacity-dropped 2nd choice -> 1st keeps weight 1."""
+    rng = np.random.RandomState(5)
+    T, E = 16, 2
+    logits = jnp.asarray(rng.randn(T, E), jnp.float32)
+    # capacity 1: almost every 2nd choice drops
+    combine, dispatch, _, _ = top_k_gating(logits, 2, 1)
+    w = np.asarray(combine.sum(axis=(1, 2)))
+    d = np.asarray(dispatch.sum(axis=(1, 2)))
+    # tokens with exactly one surviving route carry full weight 1.0
+    np.testing.assert_allclose(w[d == 1], 1.0, atol=1e-5)
