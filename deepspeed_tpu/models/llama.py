@@ -237,17 +237,15 @@ class LlamaModel:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(*spec)))
 
-    def _forward_trunk(self, params: Any, input_ids: jnp.ndarray
-                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """[B, S] token ids → (final-norm hidden [B, S, H], aux loss)."""
+    def decoder_layer(self, lp: Any, x: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """ONE decoder layer ``[B, S, H] → ([B, S, H], aux_loss)`` — the unit
+        of the scan in :meth:`_forward_trunk` AND the unit of ZeRO-Infinity
+        layer streaming (``runtime/swap_tensor``), where each layer's params
+        arrive from host/NVMe just ahead of use."""
         from ..runtime.sequence_parallel.ulysses_sp import ulysses_attention
 
         c = self.config
-        x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
-        # activations ride batch-sharded + sequence-sharded (Ulysses home
-        # layout; a 1-sized seq axis makes this a no-op)
-        x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
-
         n_rep = c.num_heads // c.num_kv_heads
 
         def attn_fn(q, kk, vv):
@@ -265,30 +263,47 @@ class LlamaModel:
             causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
             return _attention(q, kk, vv, causal)
 
+        h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+        q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
+        kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
+        vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
+        if n_rep > 1:  # GQA: repeat KV heads so every rank holds a slice
+            kk = jnp.repeat(kk, n_rep, axis=2)
+            vv = jnp.repeat(vv, n_rep, axis=2)
+        q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        if self.mesh is not None:
+            attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
+        else:
+            attn = attn_fn(q, kk, vv)
+        out = jnp.einsum("bshd,hdH->bsH", attn,
+                         lp["attn"]["wo"].astype(c.dtype))
+        # back to the sequence-sharded home layout
+        x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
+
+        h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+        ffn_out, l_aux = self._ffn(h, lp)
+        x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
+        return x, l_aux
+
+    def embed_fwd(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """[B, S] ids → embedded activations in the home layout."""
+        c = self.config
+        x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
+        # activations ride batch-sharded + sequence-sharded (Ulysses home
+        # layout; a 1-sized seq axis makes this a no-op)
+        return self._constrain(x, DP_AXES, AXIS_SEQ, None)
+
+    def _forward_trunk(self, params: Any, input_ids: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """[B, S] token ids → (final-norm hidden [B, S, H], aux loss)."""
+        c = self.config
+        x = self.embed_fwd(params, input_ids)
+
         def layer(carry, lp):
             x, aux = carry
-            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
-            q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
-            kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
-            vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
-            if n_rep > 1:  # GQA: repeat KV heads so every rank holds a slice
-                kk = jnp.repeat(kk, n_rep, axis=2)
-                vv = jnp.repeat(vv, n_rep, axis=2)
-            q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-            kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-            vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-            if self.mesh is not None:
-                attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
-            else:
-                attn = attn_fn(q, kk, vv)
-            out = jnp.einsum("bshd,hdH->bsH", attn,
-                             lp["attn"]["wo"].astype(c.dtype))
-            # back to the sequence-sharded home layout
-            x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
-
-            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
-            ffn_out, l_aux = self._ffn(h, lp)
-            x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
+            x, l_aux = self.decoder_layer(lp, x)
             return (x, aux + l_aux), None
 
         body = layer
@@ -349,10 +364,11 @@ class LlamaModel:
     # ------------------------------------------------------------------
 
     def init_cache(self, batch_size: int, max_len: int) -> Dict[str, Any]:
-        """Decode cache: full heads stored (GQA groups pre-expanded so the
-        Pallas decode kernel sees matched head counts)."""
+        """Decode cache: stores ``num_kv_heads`` heads only — GQA groups are
+        expanded inside the decode kernel, keeping the cache-HBM footprint at
+        the GQA size (4× smaller for llama3-8b, 8× for 70b)."""
         c = self.config
-        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.hd)
+        shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.hd)
         return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
                 "lengths": jnp.zeros((batch_size,), jnp.int32)}
 
@@ -374,12 +390,12 @@ class LlamaModel:
             q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
             kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
             vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
-            if n_rep > 1:
-                kk = jnp.repeat(kk, n_rep, axis=2)
-                vv = jnp.repeat(vv, n_rep, axis=2)
             q = _rope(q, positions, c.rope_theta)
             kk = _rope(kk, positions, c.rope_theta)
-            attn = _attention(q, kk, vv, causal)
+            # cache keeps the GQA (kv-head) layout; expand only for compute
+            kk_full = jnp.repeat(kk, n_rep, axis=2) if n_rep > 1 else kk
+            vv_full = jnp.repeat(vv, n_rep, axis=2) if n_rep > 1 else vv
+            attn = _attention(q, kk_full, vv_full, causal)
             out = jnp.einsum("bshd,hdH->bsH", attn,
                              lp["attn"]["wo"].astype(c.dtype))
             x = x + out
@@ -419,11 +435,9 @@ class LlamaModel:
             q = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wq"].astype(c.dtype))
             kk = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wk"].astype(c.dtype))
             vv = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wv"].astype(c.dtype))
-            if n_rep > 1:
-                kk = jnp.repeat(kk, n_rep, axis=1)
-                vv = jnp.repeat(vv, n_rep, axis=1)
             q = _rope(q[:, None], pos, c.rope_theta)[:, 0]
             kk = _rope(kk[:, None], pos, c.rope_theta)[:, 0]
+            # cache stays in kv-head layout; the kernel expands GQA groups
             k_cache = k_cache.at[jnp.arange(B), lengths].set(kk)
             v_cache = v_cache.at[jnp.arange(B), lengths].set(vv)
             attn = decode_attention(q, k_cache, v_cache, lengths + 1)
@@ -456,10 +470,10 @@ class LlamaModel:
     # loss
     # ------------------------------------------------------------------
 
-    def loss(self, params: Any, batch: Any) -> jnp.ndarray:
-        """Next-token cross entropy.  ``batch`` is ``{"input_ids": [B, S]}``
-        (labels = shifted inputs) or ``{"input_ids", "labels"}`` with -100
-        ignore positions (HF convention)."""
+    @staticmethod
+    def batch_labels(batch: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(input_ids, labels) from either batch form (labels default to
+        shifted inputs; -100 = ignore, HF convention)."""
         if isinstance(batch, dict):
             input_ids = batch["input_ids"]
             labels = batch.get("labels")
@@ -468,23 +482,44 @@ class LlamaModel:
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        return input_ids, labels
+
+    def _ce_from_hidden(self, params: Any, hidden: jnp.ndarray,
+                        labels: jnp.ndarray) -> jnp.ndarray:
+        """Cross entropy from final-norm'd hidden states."""
         c = self.config
-        hidden, aux = self._forward_trunk(params, input_ids)
         head = self._head(params).astype(c.dtype)
         if c.loss_tiles > 1:
             from ..runtime.sequence_parallel.ulysses_sp import \
                 sequence_tiled_loss
 
-            ce = sequence_tiled_loss(
+            return sequence_tiled_loss(
                 lambda h: jnp.einsum("bsH,HV->bsV", h, head),
                 hidden, labels, c.loss_tiles)
-        else:
-            logits = jnp.einsum("bsH,HV->bsV", hidden, head).astype(
-                jnp.float32)
-            valid = labels != -100
-            safe = jnp.where(valid, labels, 0)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-            ce = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
-                jnp.sum(valid), 1)
+        logits = jnp.einsum("bsH,HV->bsV", hidden, head).astype(jnp.float32)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+            jnp.sum(valid), 1)
+
+    def head_loss(self, params: Any, x: jnp.ndarray, batch: Any
+                  ) -> jnp.ndarray:
+        """Loss tail for layer streaming: post-last-layer activations →
+        final norm → CE.  ``params`` needs only the resident leaves
+        (final_norm + embed/lm_head)."""
+        c = self.config
+        _, labels = self.batch_labels(batch)
+        hidden = _rms_norm(x, params["final_norm"].astype(c.dtype),
+                           c.rms_norm_eps)
+        return self._ce_from_hidden(params, hidden, labels)
+
+    def loss(self, params: Any, batch: Any) -> jnp.ndarray:
+        """Next-token cross entropy.  ``batch`` is ``{"input_ids": [B, S]}``
+        (labels = shifted inputs) or ``{"input_ids", "labels"}`` with -100
+        ignore positions (HF convention)."""
+        input_ids, labels = self.batch_labels(batch)
+        hidden, aux = self._forward_trunk(params, input_ids)
+        ce = self._ce_from_hidden(params, hidden, labels)
         return ce + self.aux_loss_coef * aux

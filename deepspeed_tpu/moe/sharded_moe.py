@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.mesh import AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR, DP_AXES
+from ..utils.logging import logger
 
 P = PartitionSpec
 
@@ -154,6 +155,7 @@ class MOELayer:
         self.gate = gate
         self.expert_fn = expert_fn
         self.mesh = mesh
+        self._warned_dropped = False
 
     def _constrain(self, x, *spec):
         """Sharding constraint, skipped per-entry when a dim isn't divisible
@@ -168,6 +170,17 @@ class MOELayer:
 
         entries = [None if e is not None and x.shape[i] % size_of(e) else e
                    for i, e in enumerate(spec)]
+        dropped = [(i, e) for i, e in enumerate(spec)
+                   if e is not None and entries[i] is None]
+        if dropped and not self._warned_dropped:
+            # a capacity/hidden size that doesn't divide the expert axis
+            # silently replicates expert compute — surface it once
+            self._warned_dropped = True
+            logger.warning(
+                "MOELayer: dropping sharding constraint(s) %s on shape %s "
+                "(dim not divisible by mesh axis) — expert parallelism is "
+                "DISABLED for this tensor; pad capacity/hidden to a multiple "
+                "of the axis size to restore EP", dropped, tuple(x.shape))
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(*entries)))
 

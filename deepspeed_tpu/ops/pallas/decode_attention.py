@@ -5,6 +5,15 @@ Role parity: the reference's kernel-injection decode attention
 the inference-v2 ragged blocked-KV kernels.  Single-token queries attend
 over a padded per-sequence cache with true lengths — the TPU-friendly
 static-shape formulation of ragged batching.
+
+VMEM discipline: the KV sequence dimension is blocked through the *grid*
+(``grid=(B, nk)``) so only one ``[block_k, h, d]`` tile of K and V is
+resident at a time, with the online-softmax state (m, l, acc) carried in
+VMEM scratch across the sequential inner grid axis.  Loading the whole
+``[Smax, h, d]`` cache per sequence (h=32, d=128, Smax=8k, bf16 → ~64 MiB)
+would blow the ~16 MiB VMEM budget and fail to lower on real hardware.
+Blocks entirely beyond a sequence's true length clamp their DMA index to
+the last valid block and skip compute, so ragged batches do no wasted I/O.
 """
 
 from __future__ import annotations
@@ -17,7 +26,11 @@ import numpy as np
 
 
 def _reference_decode(q, k_cache, v_cache, lengths):
-    # q: [B, h, d]; caches: [B, Smax, h, d]; lengths: [B]
+    # q: [B, h, d]; caches: [B, Smax, kv_h, d] with kv_h | h (GQA); lengths: [B]
+    n_rep = q.shape[1] // k_cache.shape[2]
+    if n_rep > 1:
+        k_cache = jnp.repeat(k_cache, n_rep, axis=2)
+        v_cache = jnp.repeat(v_cache, n_rep, axis=2)
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhd,bkhd->bhk", q, k_cache).astype(jnp.float32) * scale
     Smax = k_cache.shape[1]
@@ -27,24 +40,35 @@ def _reference_decode(q, k_cache, v_cache, lengths):
     return jnp.einsum("bhk,bkhd->bhd", p, v_cache)
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                   s_max: int, scale: float):
+def _num_valid_blocks(length, block_k):
+    return jax.lax.div(length + block_k - 1, block_k)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                   *, block_k: int, num_blocks: int, scale: float,
+                   n_rep: int):
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
+    ki = pl.program_id(1)
     length = len_ref[b]
-    q = q_ref[0].astype(jnp.float32) * scale  # [h, d]
-    h, d = q.shape
-    nk = s_max // block_k
+    nk_valid = _num_valid_blocks(length, block_k)
 
-    m0 = jnp.full((h,), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((h,), jnp.float32)
-    acc0 = jnp.zeros((h, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(ki, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(ki * block_k, block_k), :, :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(ki * block_k, block_k), :, :].astype(jnp.float32)
+    @pl.when(ki < nk_valid)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale  # [h, d]
+        h = q.shape[0]
+        kblk = k_ref[0].astype(jnp.float32)  # [block_k, kv_h, d]
+        vblk = v_ref[0].astype(jnp.float32)
+        if n_rep > 1:  # GQA: expand KV heads in VMEM, not in the HBM cache
+            kblk = jnp.repeat(kblk, n_rep, axis=1)
+            vblk = jnp.repeat(vblk, n_rep, axis=1)
         # [block_k, h] scores — elementwise-multiply + d-reduce (VPU):
         # Mosaic cannot lower batched (per-head) dots, and decode is
         # memory-bound so the MXU is not the limiter here
@@ -52,51 +76,69 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, h), 0)
         s = jnp.where(pos < length, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=0))
+        m_prev = m_ref[0]  # [h]
+        l_prev = l_ref[0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
         p = jnp.exp(s - m_new[None, :])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=0)
-        acc_new = acc * alpha[:, None] + jnp.sum(
-            p[:, :, None] * vblk, axis=0)
-        return m_new, l_new, acc_new
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[0] = m_new
+        l_ref[0] = l_prev * alpha + jnp.sum(p, axis=0)
+        acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                        + jnp.sum(p[:, :, None] * vblk, axis=0))
 
-    # only blocks below the length can contribute
-    nk_eff = jnp.minimum((length + block_k - 1) // block_k, nk)
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-9)[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == num_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[0], 1e-9)[:, None]).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, lengths, block_k: int = 128,
                      interpret: bool | None = None):
     """q ``[B, h, d]`` one-token queries over padded caches
-    ``[B, Smax, h, d]`` with per-sequence ``lengths [B]``."""
+    ``[B, Smax, kv_h, d]`` (``kv_h`` divides ``h`` — GQA groups expanded
+    inside the kernel) with per-sequence ``lengths [B]``."""
     from jax.experimental import pallas as pl
 
     if interpret is None:
         if jax.default_backend() != "tpu":
             return _reference_decode(q, k_cache, v_cache, lengths)
         interpret = False
-    B, Smax, h, d = k_cache.shape
+    B, Smax, kv_h, d = k_cache.shape
+    h = q.shape[1]
+    n_rep = h // kv_h
     block_k = min(block_k, Smax)
-    if Smax % block_k:
+    if Smax % block_k or h % kv_h:
         return _reference_decode(q, k_cache, v_cache, lengths)
+    num_blocks = Smax // block_k
 
-    kernel = functools.partial(_decode_kernel, block_k=block_k, s_max=Smax,
-                               scale=1.0 / np.sqrt(d))
-    grid_spec = None
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               num_blocks=num_blocks, scale=1.0 / np.sqrt(d),
+                               n_rep=n_rep)
     from jax.experimental.pallas import tpu as pltpu
+
+    def _kv_index(b, ki, lens):
+        # Clamp out-of-range blocks onto the last valid one: the revisited
+        # block's DMA is a no-op and compute is @pl.when-skipped, so ragged
+        # tails cost nothing.
+        nk_valid = _num_valid_blocks(lens[b], jnp.int32(block_k))
+        return (b, jnp.minimum(ki, jnp.maximum(nk_valid - 1, 0)), 0, 0)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B,),
+            grid=(B, num_blocks),
             in_specs=[
-                pl.BlockSpec((1, h, d), lambda b, lens: (b, 0, 0)),
-                pl.BlockSpec((1, Smax, h, d), lambda b, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, Smax, h, d), lambda b, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, h, d), lambda b, ki, lens: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, kv_h, d), _kv_index),
+                pl.BlockSpec((1, block_k, kv_h, d), _kv_index),
             ],
-            out_specs=pl.BlockSpec((1, h, d), lambda b, lens: (b, 0, 0)),
+            out_specs=pl.BlockSpec((1, h, d), lambda b, ki, lens: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((1, h), jnp.float32),      # running max m
+                pltpu.VMEM((1, h), jnp.float32),      # running denom l
+                pltpu.VMEM((h, d), jnp.float32),      # output accumulator
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, h, d), q.dtype),
         interpret=interpret,

@@ -5,17 +5,18 @@ Role parity: the reference's fused attention kernels
 q-loop × online-softmax k-loop kernel that never materializes the
 ``[S, S]`` score matrix in HBM.
 
-Forward is the Pallas kernel; backward (training) is a custom-VJP that
-recomputes scores in XLA (flash-bwd kernel is a later optimization; the
-recompute is what ``jax.remat`` would do anyway and XLA fuses it well).
-``interpret=True`` (CPU testing) and the jnp reference path keep numerics
-checkable everywhere.
+Forward is the Pallas kernel and also emits the per-row log-sum-exp so
+the backward never has to re-derive softmax normalization.  Backward is a
+flash-style chunked recompute: a ``lax.scan`` over k-blocks that holds at
+most ``[B, h, S, block_k]`` of scores at a time (O(S·block) transient, not
+O(S²)), using the standard ``delta = Σ_d do·o`` trick for the softmax
+jacobian.  ``interpret=True`` (CPU testing) and the jnp reference path
+keep numerics checkable everywhere.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +34,20 @@ def _reference_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-               seq_len: int, causal: bool, scale: float):
+def _reference_fwd_with_lse(q, k, v, causal: bool):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S, T = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, T), bool))
+        s = jnp.where(mask, s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, h, S]
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+               block_k: int, seq_len: int, causal: bool, scale: float):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -74,6 +87,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
         nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, None]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -87,15 +101,16 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _flash_call(q, k, v, causal, block_q, block_k, interpret):
+def _flash_call(q, k, v, causal, block_q, block_k, interpret,
+                with_lse: bool = False):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     B, S, h, d = q.shape
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
-        return _reference_attention(q, k, v, causal)
+        out, lse = _reference_fwd_with_lse(q, k, v, causal)
+        return (out, lse) if with_lse else out
     # [B, S, h, d] -> [B*h, S, d]
     qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
     kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
@@ -104,7 +119,7 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _fa_kernel, block_q=block_q, block_k=block_k, seq_len=S,
         causal=causal, scale=1.0 / np.sqrt(d))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * h, S // block_q),
         in_specs=[
@@ -112,36 +127,76 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            # lse as [B*h, S, 1]: trailing singleton keeps the block shape
+            # legal under the (8, 128) TPU tiling rule for any block_q
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+            jax.ShapeDtypeStruct((B * h, S, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    out = out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, h, S)  # drops the singleton
+    return (out, lse) if with_lse else out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
     if _use_pallas():
-        out = _flash_call(q, k, v, causal, block_q, block_k, interpret=False)
+        out, lse = _flash_call(q, k, v, causal, block_q, block_k,
+                               interpret=False, with_lse=True)
     else:
-        out = _reference_attention(q, k, v, causal)
-    return out, (q, k, v)
+        out, lse = _reference_fwd_with_lse(q, k, v, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, do):
-    """XLA recompute backward (standard softmax-attention gradient)."""
-    q, k, v = res
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool))
-        s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
+    """Flash-style chunked backward: scan over k-blocks, O(S·block_k) live.
+
+    Uses the saved per-row log-sum-exp (no softmax re-normalization pass)
+    and ``delta_i = Σ_d do_i·o_i`` so the softmax jacobian term needs no
+    cross-block reduction.
+    """
+    q, k, v, out, lse = res
+    B, S, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    blk = min(block_k, S)
+    if S % blk:
+        blk = S  # degenerate fall-back: one chunk (== full recompute)
+    nk = S // blk
+
+    q32 = q.astype(jnp.float32)
     do32 = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32))
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    # delta: [B, h, S] — rowwise do·o
+    delta = jnp.einsum("bqhd,bqhd->bhq", do32, out.astype(jnp.float32))
+
+    k_chunks = k.reshape(B, nk, blk, h, d).transpose(1, 0, 2, 3, 4)
+    v_chunks = v.reshape(B, nk, blk, h, d).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(S)
+
+    def body(dq_acc, chunk):
+        ki, kblk, vblk = chunk
+        kb32 = kblk.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb32) * scale
+        if causal:
+            k_pos = ki * blk + jnp.arange(blk)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -1e30)
+        p = jnp.exp(s - lse[..., None])  # [B, h, S, blk]
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vblk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, kb32) * scale
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32) * scale
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((B, S, h, d), jnp.float32)
+    dq, (dk_chunks, dv_chunks) = jax.lax.scan(
+        body, dq0, (jnp.arange(nk), k_chunks, v_chunks))
+    dk = dk_chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, h, d)
+    dv = dv_chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, h, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

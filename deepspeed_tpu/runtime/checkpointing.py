@@ -145,10 +145,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                           if k != "step"}
                 restored_off = loader.restore(offload_path, target)
             offload.load_state_arrays(restored_off)
-        # re-seed host fp32 masters from the restored params
-        for dst, src in zip(offload.opt.params,
-                            jax.tree.leaves(engine.state.params)):
-            np.copyto(dst, np.asarray(jax.device_get(src), dtype=np.float32))
+        # re-seed host fp32 master slices from the restored params
+        offload.reseed_masters(engine.state.params)
 
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state: Dict[str, Any] = {}

@@ -181,7 +181,8 @@ class DeepSpeedEngine:
                                 else "AdamW"),
                 optimizer_params=(dict(opt_cfg.params.model_dump())
                                   if opt_cfg is not None else {}),
-                schedule=self._schedule)
+                schedule=self._schedule,
+                policy=self.policy, base_specs=self.base_specs)
             opt_state = ()
         else:
             opt_shapes = jax.eval_shape(self.optimizer.init, params)
@@ -322,9 +323,15 @@ class DeepSpeedEngine:
         schedule = self._schedule
         scaler = self.loss_scaler
         core = self._grad_core()
+        policy = self.policy
+        base_specs = self.base_specs
 
         def grad_fn(state: TrainState, batch):
             grads, mean_loss, overflow, grad_norm = core(state, batch)
+            # land grads in the host-partition (opt-state) layout: each
+            # process's d2h pull is exactly its master slice — reduce-scatter
+            # over DP instead of all-reduce whenever stage >= 1
+            grads = policy.apply_offload_grad_constraints(grads, base_specs)
             new_scale = (scaler.update(state.loss_scale, overflow)
                          if fp16 else state.loss_scale)
             metrics = {

@@ -10,11 +10,28 @@ scan + clip + overflow check) and STOPS; the host runs the fused C++
 Adam(W)/Adagrad/Lion over numpy master shards and pushes updated params back
 to their device shardings.  This is the step-splitting SURVEY §7 hard-part 2
 prescribes — the one boundary where the single-program model must break.
+
+Partitioning + overlap design (round 2):
+
+* Masters/moments are kept per *addressable shard* of the param's ZeRO
+  opt-state layout (``ZeroShardingPolicy.offload_shardings``).  At stage ≥ 1
+  that layout is DP-sharded, so host memory per process is ``total/dp`` —
+  the reference's ZeRO partitioning of CPU optimizer state across ranks —
+  and the whole path is multi-process safe: only ``addressable_shards`` are
+  ever pulled (never a ``device_get`` of a global array).
+* The device grad program lands grads directly in that layout
+  (``apply_offload_grad_constraints``): a reduce-scatter, not an all-reduce.
+* d2h is issued asynchronously for every shard up front
+  (``copy_to_host_async``) so transfers overlap each other and the host-side
+  flattening; h2d re-uploads are plain async ``device_put`` per shard, then
+  a single cached jitted identity reshards the assembled tree back to the
+  param layout (XLA all-gather over ICI — a no-op when layouts already
+  match, e.g. ZeRO-3).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,16 +40,68 @@ import numpy as np
 from ...utils.logging import log_dist
 
 
+def _index_key(index) -> tuple:
+    """Hashable key for a shard's global index (tuple of slices)."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+class _LeafEntry:
+    """One unique shard of one param leaf: master slot + device replicas."""
+
+    __slots__ = ("index", "devices", "slot")
+
+    def __init__(self, index, slot):
+        self.index = index
+        self.devices = []
+        self.slot = slot
+
+
 class CPUOffloadOptimizer:
-    """Host-side optimizer over the flattened param pytree."""
+    """Host-side optimizer over per-shard slices of the param pytree."""
 
     def __init__(self, params: Any, optimizer_name: str, optimizer_params: Any,
-                 schedule: Callable[[int], float]):
+                 schedule: Callable[[int], float], policy: Any = None,
+                 base_specs: Any = None):
         leaves, self.treedef = jax.tree.flatten(params)
-        self.shardings = [leaf.sharding for leaf in leaves]
-        host = [np.asarray(jax.device_get(leaf), dtype=np.float32)
-                for leaf in leaves]
+        self.param_shardings = [leaf.sharding for leaf in leaves]
+        self.global_shapes = [tuple(leaf.shape) for leaf in leaves]
         self.schedule = schedule
+
+        if policy is not None:
+            host_sh_tree = policy.offload_shardings(params, base_specs)
+            self.host_shardings = jax.tree.leaves(host_sh_tree)
+        else:
+            self.host_shardings = list(self.param_shardings)
+
+        # Reshard params into the host-partition layout and pull ONLY the
+        # process-addressable shards (multi-process safe by construction).
+        host_sh_by_tree = jax.tree.unflatten(self.treedef, self.host_shardings)
+        to_host_layout = jax.jit(lambda t: t, out_shardings=host_sh_by_tree)
+        resharded = jax.tree.leaves(to_host_layout(params))
+
+        flat_masters: List[np.ndarray] = []
+        self.layouts: List[List[_LeafEntry]] = []
+        for leaf in resharded:
+            seen: Dict[tuple, _LeafEntry] = {}
+            entries: List[_LeafEntry] = []
+            for shard in leaf.addressable_shards:
+                key = _index_key(shard.index)
+                if key not in seen:
+                    entry = _LeafEntry(shard.index, len(flat_masters))
+                    flat_masters.append(
+                        np.array(shard.data, dtype=np.float32, order="C"))
+                    seen[key] = entry
+                    entries.append(entry)
+                seen[key].devices.append(shard.device)
+            self.layouts.append(entries)
+        self.num_slots = len(flat_masters)
+
+        # Cached reshard of the updated (host-layout) tree → param layout.
+        param_sh_tree = jax.tree.unflatten(self.treedef, self.param_shardings)
+        self._to_param_layout = jax.jit(lambda t: t,
+                                        out_shardings=param_sh_tree)
+        self._to_host_layout = None  # built lazily for grad trees
+
         name = optimizer_name.lower()
         op = dict(optimizer_params or {})
         lr = op.get("lr", 1e-3)
@@ -44,51 +113,128 @@ class CPUOffloadOptimizer:
 
             betas = tuple(op.get("betas", (0.9, 0.999)))
             eps = float(op.get("eps", 1e-8))
-            self.opt = DeepSpeedCPUAdam(host, lr=lr, betas=betas, eps=eps,
-                                        weight_decay=wd,
+            self.opt = DeepSpeedCPUAdam(flat_masters, lr=lr, betas=betas,
+                                        eps=eps, weight_decay=wd,
                                         adamw_mode=(name != "adam"))
         elif name == "adagrad":
             from ...ops.adam import DeepSpeedCPUAdagrad
 
-            self.opt = DeepSpeedCPUAdagrad(host, lr=lr,
+            self.opt = DeepSpeedCPUAdagrad(flat_masters, lr=lr,
                                            eps=float(op.get("eps", 1e-10)),
                                            weight_decay=wd)
         elif name == "lion":
             from ...ops.adam import DeepSpeedCPULion
 
-            self.opt = DeepSpeedCPULion(host, lr=lr,
+            self.opt = DeepSpeedCPULion(flat_masters, lr=lr,
                                         betas=tuple(op.get("betas", (0.9, 0.99))),
                                         weight_decay=wd)
         else:
             raise ValueError(
                 f"offload_optimizer does not support optimizer '{optimizer_name}'")
+        total = sum(m.nbytes for m in self.opt.params)
         log_dist(f"ZeRO-Offload: {name} states on host "
-                 f"({sum(h.nbytes for h in host) / 2**20:.1f} MiB master)")
+                 f"({total / 2**20:.1f} MiB master slice/process, "
+                 f"{self.num_slots} shards, "
+                 f"dp-partitioned={policy is not None and policy.stage >= 1})")
+
+    # ------------------------------------------------------------------
+    # the per-step host round trip
+    # ------------------------------------------------------------------
 
     def step(self, grads: Any, step_index: int) -> Any:
-        """grads: device pytree → updated device params (original shardings)."""
+        """grads: device pytree (ideally already in the host-partition
+        layout via ``apply_offload_grad_constraints``) → updated device
+        params in their original shardings."""
         grad_leaves = jax.tree.leaves(grads)
-        grads_np = [np.asarray(jax.device_get(g), dtype=np.float32)
-                    for g in grad_leaves]
+        needs_reshard = any(
+            not g.sharding.is_equivalent_to(s, len(g.shape))
+            for g, s in zip(grad_leaves, self.host_shardings))
+        if needs_reshard:
+            if self._to_host_layout is None:
+                host_sh_tree = jax.tree.unflatten(self.treedef,
+                                                  self.host_shardings)
+                self._to_host_layout = jax.jit(
+                    lambda t: t, out_shardings=host_sh_tree)
+            grad_leaves = jax.tree.leaves(self._to_host_layout(grads))
+
+        # one single-device array per unique shard, d2h started async so the
+        # transfers overlap each other (and any remaining device compute)
+        shard_data: List[Optional[Any]] = [None] * self.num_slots
+        for leaf, entries in zip(grad_leaves, self.layouts):
+            by_key = {}
+            for shard in leaf.addressable_shards:
+                by_key[_index_key(shard.index)] = shard.data
+            for e in entries:
+                data = by_key[_index_key(e.index)]
+                data.copy_to_host_async()
+                shard_data[e.slot] = data
+
+        grads_np = [np.asarray(d, dtype=np.float32) for d in shard_data]
         lr = float(self.schedule(step_index))
         self.opt.step(grads_np, lr=lr)
-        new_leaves = [
-            jax.device_put(jnp.asarray(p), s)
-            for p, s in zip(self.opt.params, self.shardings)]
-        return jax.tree.unflatten(self.treedef, new_leaves)
+
+        # h2d per shard (async device_put), assemble global arrays in the
+        # host layout, then one compiled reshard back to the param layout
+        new_leaves = []
+        for shape, sharding, entries in zip(self.global_shapes,
+                                            self.host_shardings, self.layouts):
+            arrays = []
+            for e in entries:
+                updated = self.opt.params[e.slot]
+                for device in e.devices:
+                    arrays.append(jax.device_put(jnp.asarray(updated), device))
+            new_leaves.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays))
+        new_tree = jax.tree.unflatten(self.treedef, new_leaves)
+        return self._to_param_layout(new_tree)
+
+    # ------------------------------------------------------------------
+    # checkpoint plumbing — logical (re-assembled) arrays
+    # ------------------------------------------------------------------
+
+    def _assemble(self, slot_values: List[np.ndarray]) -> List[np.ndarray]:
+        """Per-leaf logical arrays from the process-local slots.  With
+        multi-process DP partitioning each process fills only its own slices
+        (checkpointing multi-process offload state needs per-process files,
+        as in the reference's zero_pp_rank_* layout)."""
+        out = []
+        for shape, entries in zip(self.global_shapes, self.layouts):
+            arr = np.zeros(shape, np.float32)
+            for e in entries:
+                arr[e.index] = slot_values[e.slot]
+            out.append(arr)
+        return out
 
     def state_dict_arrays(self) -> Any:
-        """Moments as a pytree for checkpointing."""
-        moments = {"exp_avg": getattr(self.opt, "exp_avg", None),
-                   "exp_avg_sq": getattr(self.opt, "exp_avg_sq", None),
-                   "step": self.opt.state_step}
-        return {k: v for k, v in moments.items() if v is not None}
+        moments = {}
+        if hasattr(self.opt, "exp_avg"):
+            moments["exp_avg"] = self._assemble(self.opt.exp_avg)
+        if hasattr(self.opt, "exp_avg_sq"):
+            moments["exp_avg_sq"] = self._assemble(self.opt.exp_avg_sq)
+        moments["step"] = self.opt.state_step
+        return moments
 
     def load_state_arrays(self, state: Any) -> None:
         for key in ("exp_avg", "exp_avg_sq"):
             if key in state and hasattr(self.opt, key):
-                for dst, src in zip(getattr(self.opt, key), state[key]):
-                    np.copyto(dst, np.asarray(src, dtype=np.float32))
+                slots = getattr(self.opt, key)
+                for leaf_i, src in enumerate(state[key]):
+                    src = np.asarray(src, dtype=np.float32)
+                    for e in self.layouts[leaf_i]:
+                        np.copyto(slots[e.slot], src[e.index])
         if "step" in state:
             self.opt.state_step = int(state["step"])
         # master params re-seeded from the engine's current params by caller
+
+    def reseed_masters(self, params: Any) -> None:
+        """Refresh host master slices from (restored) device params."""
+        host_sh_tree = jax.tree.unflatten(self.treedef, self.host_shardings)
+        resharded = jax.tree.leaves(
+            jax.jit(lambda t: t, out_shardings=host_sh_tree)(params))
+        for leaf, entries in zip(resharded, self.layouts):
+            by_key = {_index_key(s.index): s.data
+                      for s in leaf.addressable_shards}
+            for e in entries:
+                np.copyto(self.opt.params[e.slot],
+                          np.asarray(by_key[_index_key(e.index)],
+                                     dtype=np.float32))

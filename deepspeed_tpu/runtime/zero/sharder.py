@@ -193,6 +193,27 @@ class ZeroShardingPolicy:
 
         return jax.tree.map(leaf_sharding, opt_state)
 
+    def offload_shardings(self, params: Any, base_specs: Any = None) -> Any:
+        """Host-partition layout for ZeRO-Offload masters: each param leaf in
+        its opt-state placement (stage ≥ 1 → DP-sharded), so every process
+        keeps only its own slice of the fp32 master + moments — the
+        reference's partitioning of CPU optimizer state across DP ranks."""
+        return self._map_with_base(
+            lambda p, b: NamedSharding(self.mesh, self.opt_state_spec(p, b)),
+            params, base_specs)
+
+    def apply_offload_grad_constraints(self, grads: Any,
+                                       base_specs: Any = None) -> Any:
+        """Inside-jit (offload mode): land grads in the host-partition layout
+        so each process's d2h pull is exactly its master slice — a reduce-
+        scatter instead of an all-reduce whenever stage ≥ 1."""
+        if self.stage < 1:
+            return grads
+        return self._map_with_base(
+            lambda g, b: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, self.opt_state_spec(g, b))),
+            grads, base_specs)
+
     def apply_grad_constraints(self, grads: Any, base_specs: Any = None) -> Any:
         """Inside-jit: force reduce-scatter placement of grads (stage ≥ 2)."""
         if self.stage < 2:

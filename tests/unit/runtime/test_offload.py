@@ -19,7 +19,7 @@ def make_engine(offload: bool, mesh, stage: int = 2):
     cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
     model = LlamaModel(cfg, mesh=mesh)
     params = model.init_params(jax.random.PRNGKey(0))
-    zero = {"stage": stage}
+    zero = {"stage": stage, "stage3_param_persistence_threshold": 0}
     if offload:
         zero["offload_optimizer"] = {"device": "cpu"}
     ds = {"train_micro_batch_size_per_gpu": 8,
@@ -50,6 +50,29 @@ def test_offload_matches_on_device():
     # same trajectory within fp32 kernel-order tolerance
     np.testing.assert_allclose(losses_off, losses_dev, rtol=2e-4, atol=2e-4)
     assert losses_off[-1] < losses_off[0]
+
+
+def test_offload_masters_dp_partitioned():
+    """Stage >= 1: host masters are per-DP-shard slices covering each param
+    exactly once (ZeRO partitioning of CPU optimizer state), not full copies
+    per leaf."""
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    eng = make_engine(True, mesh, stage=2)
+    off = eng.offload_opt
+    n_leaves = len(jax.tree.leaves(eng.state.params))
+    total_param = sum(int(np.prod(s)) for s in off.global_shapes)
+    total_master = sum(p.size for p in off.opt.params)
+    # disjoint coverage: slice sizes sum to the logical total (no per-device
+    # duplication), and at least one leaf is split into multiple shards
+    assert total_master == total_param
+    assert off.num_slots > n_leaves
+    # every entry's devices are disjoint across entries of the same leaf
+    for entries in off.layouts:
+        seen = set()
+        for e in entries:
+            key = tuple((s.start, s.stop, s.step) for s in e.index)
+            assert key not in seen
+            seen.add(key)
 
 
 def test_offload_checkpoint_roundtrip(tmp_path):
