@@ -234,8 +234,10 @@ class LlamaModel:
     def _constrain(self, x: jnp.ndarray, *spec) -> jnp.ndarray:
         if self.mesh is None:
             return x
+        from ..parallel.mesh import strip_manual_axes
+
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, P(*spec)))
+            x, NamedSharding(self.mesh, strip_manual_axes(*spec)))
 
     def decoder_layer(self, lp: Any, x: jnp.ndarray
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -181,8 +181,10 @@ class MOELayer:
                 "(dim not divisible by mesh axis) — expert parallelism is "
                 "DISABLED for this tensor; pad capacity/hidden to a multiple "
                 "of the axis size to restore EP", dropped, tuple(x.shape))
+        from ..parallel.mesh import strip_manual_axes
+
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, P(*entries)))
+            x, NamedSharding(self.mesh, strip_manual_axes(*entries)))
 
     def __call__(self, wg: jnp.ndarray, expert_params: Any, x: jnp.ndarray,
                  train: bool = True, noise_rng: Optional[jax.Array] = None

@@ -1,0 +1,124 @@
+"""1-bit compressed gradient reduction with error feedback.
+
+Role parity: the reference 1-bit optimizer family —
+``deepspeed/runtime/fp16/onebit/{adam,lamb,zoadam}.py`` [K] (papers: 1-bit
+Adam arXiv 2102.02888, 0/1 Adam, 1-bit LAMB) — whose core mechanism is:
+compress the worker-local update to sign bits + a scale, carry the
+compression error into the next step (error feedback), and allreduce only
+the compressed representation.
+
+TPU-first shape: the compressed allreduce is a pure function over the DP
+mesh axes designed to run inside ``jax.shard_map`` (partial-manual, so TP/SP
+GSPMD axes compose): each worker packs the signs of (grad + residual) into
+a uint8 bitmask (TRUE 1 bit/element on the wire — 32× smaller than fp32)
+plus one fp32 scale per tensor, ``lax.all_gather``s the packed words over
+ICI, and decompresses/averages locally.  The residual keeps what
+compression lost, so the bias is corrected over steps (EF-SGD/1-bit Adam
+guarantee).  Engine integration: ``OnebitAdam``/``OnebitLamb``/
+``ZeroOneAdam`` config types flip the engine's grad computation into the
+shard_map local-grad path with this reducer in place of the automatic
+GSPMD psum (``runtime/engine.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_POW2 = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], np.uint8)
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return (n + mult - 1) // mult * mult
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """Flat fp tensor → uint8 bitmask of its sign bits (1 = non-negative).
+    Length is padded up to a multiple of 8 elements."""
+    n = x.size
+    bits = (x.reshape(-1) >= 0).astype(jnp.uint8)
+    padded = _pad_to(n, 8)
+    if padded != n:
+        bits = jnp.concatenate([bits, jnp.zeros((padded - n,), jnp.uint8)])
+    return (bits.reshape(-1, 8) * _POW2).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint8 bitmask → ±1 fp32 signs of length ``n``."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    signs = bits.reshape(-1)[:n].astype(jnp.float32)
+    return signs * 2.0 - 1.0
+
+
+def compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """x → (packed_signs, scale, decompressed).  ``scale`` is the L1 mean —
+    the magnitude that makes sign·scale an unbiased-ish estimate."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    scale = jnp.mean(jnp.abs(flat))
+    packed = pack_signs(flat)
+    decompressed = (unpack_signs(packed, flat.size) * scale).reshape(x.shape)
+    return packed, scale, decompressed
+
+
+def onebit_allreduce(grad: jnp.ndarray, residual: jnp.ndarray,
+                     axis_names: Sequence[str]
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-feedback compressed mean-allreduce of ONE tensor.
+
+    Runs inside shard_map: ``grad`` is this worker's local gradient,
+    ``residual`` its carried compression error.  Wire cost per worker:
+    ``n/8`` bytes of signs + 4 bytes of scale (vs ``4n`` for fp32 psum).
+    Returns (averaged decompressed update, new residual).
+    """
+    corrected = grad.astype(jnp.float32) + residual
+    packed, scale, local_dec = compress(corrected)
+    new_residual = corrected - local_dec
+
+    names = tuple(axis_names)
+    gathered = packed
+    gscale = scale
+    for ax in names:
+        gathered = jax.lax.all_gather(gathered, ax)
+        gscale = jax.lax.all_gather(gscale, ax)
+    world = int(np.prod(gathered.shape[:len(names)]))
+    gathered = gathered.reshape(world, -1)
+    gscale = gscale.reshape(world)
+    n = grad.size
+    per_worker = jax.vmap(lambda p, s: unpack_signs(p, n) * s)(gathered,
+                                                              gscale)
+    avg = jnp.mean(per_worker, axis=0).reshape(grad.shape)
+    return avg.astype(grad.dtype), new_residual
+
+
+def onebit_reduce_tree(grads: Any, residuals: Any,
+                       axis_names: Sequence[str]) -> Tuple[Any, Any]:
+    """Pytree version of :func:`onebit_allreduce`."""
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_r = jax.tree.leaves(residuals)
+    out_g, out_r = [], []
+    for g, r in zip(flat_g, flat_r):
+        a, nr = onebit_allreduce(g, r, axis_names)
+        out_g.append(a)
+        out_r.append(nr)
+    return jax.tree.unflatten(treedef, out_g), jax.tree.unflatten(treedef,
+                                                                  out_r)
+
+
+def init_residuals(params: Any, dp_world: int = 0) -> Any:
+    """Zeroed error-feedback state: one fp32 residual per param leaf.
+    ``dp_world > 0`` prepends a worker dimension (the engine shards it over
+    the DP axes so each worker owns exactly its own residual)."""
+    lead = (dp_world,) if dp_world else ()
+    return jax.tree.map(
+        lambda p: jnp.zeros(lead + tuple(np.shape(p)), jnp.float32), params)
+
+
+def wire_bytes(params: Any) -> Tuple[int, int]:
+    """(compressed, uncompressed fp32) bytes per worker per reduction —
+    what the comms logger reports for the byte-reduction claim."""
+    n = sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(params))
+    leaves = len(jax.tree.leaves(params))
+    return (_pad_to(n, 8) // 8 + 4 * leaves, 4 * n)

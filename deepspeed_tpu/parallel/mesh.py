@@ -153,6 +153,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def strip_manual_axes(*entries) -> PartitionSpec:
+    """PartitionSpec from ``entries`` minus any axis that is currently
+    MANUAL (i.e. we are inside a ``shard_map`` over it).
+
+    Model code places activations with ``with_sharding_constraint``; under a
+    partial-manual ``shard_map`` (1-bit grad reduction, pipeline loop) a
+    constraint naming a manual axis is illegal — that axis's sharding is
+    already the per-device block structure.  Dropping it preserves the
+    constraint for the still-GSPMD axes (tensor/seq) and is a no-op
+    otherwise.
+    """
+    manual = set()
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None:
+        manual = set(getattr(am, "manual_axes", ()) or ())
+    if not manual:
+        return PartitionSpec(*entries)
+    out = []
+    for e in entries:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a not in manual)
+            out.append(kept if kept else None)
+        else:
+            out.append(None if e in manual else e)
+    return PartitionSpec(*out)
+
+
 class ProcessTopology:
     """Coordinate ↔ rank bookkeeping over named axes.
 

@@ -51,6 +51,9 @@ class TrainState(NamedTuple):
     step: jnp.ndarray  # i32 — optimizer steps taken (skips excluded)
     loss_scale: LossScaleState
     skipped_steps: jnp.ndarray  # i32
+    # per-worker communication state: 1-bit error-feedback residuals
+    # (leading dim = DP world, sharded over the DP axes); () when unused
+    comm_state: Any = ()
 
 
 class DeepSpeedEngine:
@@ -92,14 +95,33 @@ class DeepSpeedEngine:
                            "optimizer, using the config-derived CPU optimizer")
             optimizer = None
         self.offload_opt = None  # built after state init (needs placed params)
-        if (config.zero_optimization.offload_param_device()
-                != OffloadDeviceEnum.none):
-            logger.warning(
-                "ZeRO param offload (Infinity) not wired into the engine yet "
-                "(SURVEY §7 phase 7); optimizer offload IS active" if
-                self.offload_enabled else
-                "ZeRO param offload (Infinity) not wired up yet; "
-                "training proceeds on-device")
+        self.infinity = None     # ZeRO-Infinity layer-streaming executor
+        self._infinity_requested = (
+            config.zero_optimization.offload_param_device()
+            != OffloadDeviceEnum.none)
+        if self._infinity_requested:
+            streamable = all(
+                callable(getattr(module, m, None))
+                for m in ("embed_fwd", "decoder_layer", "head_loss",
+                          "batch_labels"))
+            if not streamable:
+                raise ValueError(
+                    "offload_param requires a layer-streamable module "
+                    "(embed_fwd/decoder_layer/head_loss protocol — see "
+                    "runtime/swap_tensor/infinity_engine.py); "
+                    f"{type(module).__name__} does not implement it")
+            world = int(np.prod(list(
+                (mesh if mesh is not None else groups_mod.get_mesh())
+                .shape.values())))
+            if world > 1 or getattr(module, "mesh", None) is not None:
+                raise ValueError(
+                    "ZeRO-Infinity layer streaming is currently single-chip "
+                    "per process (per-layer programs are unsharded); use a "
+                    "1-device mesh and a module built with mesh=None")
+            if config.fp16.enabled is True:
+                raise NotImplementedError(
+                    "fp16 loss scaling is not implemented in layer-streaming "
+                    "(Infinity) mode — use bf16 (TPU-preferred) or fp32")
         self.compute_dtype = config.dtype()
         self.fp16_enabled = config.fp16.enabled is True
         self.bf16_enabled = config.bf16.enabled is True
@@ -123,6 +145,36 @@ class DeepSpeedEngine:
             self._schedule = lambda step: base_lr
         self.lr_scheduler = LRScheduler(self._schedule)
 
+        # --- 1-bit compressed-gradient family (reference fp16/onebit [K]) -
+        opt_name = (config.optimizer.type.lower().replace("_", "")
+                    if config.optimizer is not None else "")
+        self.onebit_enabled = opt_name in ("onebitadam", "onebitlamb",
+                                           "zerooneadam")
+        self.onebit_freeze_step = 0
+        if self.onebit_enabled:
+            # reference OnebitAdam `freeze_step` [K]: full-precision warmup
+            # before compression kicks in (variance estimates settle first)
+            extra = (config.optimizer.params.model_extra or {})
+            self.onebit_freeze_step = int(extra.get("freeze_step", 0) or 0)
+            if self.policy.stage >= 2:
+                raise ValueError(
+                    "1-bit optimizers compress the DP gradient allreduce; "
+                    "ZeRO stage >= 2 reduce-scatters instead — use stage 0/1 "
+                    "(reference has the same restriction)")
+            if self.fp16_enabled:
+                raise NotImplementedError(
+                    "1-bit compression + fp16 loss scaling not supported; "
+                    "use bf16/fp32")
+            if self.mesh is not None and int(
+                    self.mesh.shape.get("pipe", 1)) > 1:
+                raise NotImplementedError("1-bit + pipeline parallelism "
+                                          "not supported yet")
+            if self.offload_enabled or self._infinity_requested:
+                raise NotImplementedError(
+                    "1-bit optimizers are not supported with optimizer/param "
+                    "offload (the offload step would discard the error-"
+                    "feedback residuals) — pick one")
+
         # --- optimizer ---------------------------------------------------
         self.optimizer = optimizer if optimizer is not None else build_optimizer(
             config, lr=self._schedule)
@@ -145,6 +197,7 @@ class DeepSpeedEngine:
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
         self._train_step_fn = None  # compiled lazily (first call)
+        self._warmup_step_fn = None  # 1-bit warmup variant
         self._eval_loss_fn = None
 
         # --- compat-mode bookkeeping -------------------------------------
@@ -165,6 +218,19 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
 
     def _init_state(self, params: Any) -> TrainState:
+        if self._infinity_requested:
+            # ZeRO-Infinity: trunk params NEVER touch the device whole —
+            # the streaming executor owns them (host/NVMe tier); only the
+            # small resident subtree (embed/norm/head) lives in self.state
+            from .swap_tensor import LayerStreamingEngine
+
+            self.infinity = LayerStreamingEngine(
+                self.module, params, self.config, self._schedule)
+            scale_state = LossScaleState(jnp.float32(1.0), jnp.int32(0),
+                                         jnp.int32(0))
+            return TrainState(params=self.infinity.resident, opt_state=(),
+                              step=jnp.int32(0), loss_scale=scale_state,
+                              skipped_steps=jnp.int32(0))
         params = jax.tree.map(jnp.asarray, params)
         param_shardings = self.policy.param_shardings(params, self.base_specs)
         params = jax.device_put(params, param_shardings)
@@ -194,9 +260,23 @@ class DeepSpeedEngine:
         scale_state = (self.loss_scaler.init_state() if self.loss_scaler
                        else LossScaleState(jnp.float32(1.0), jnp.int32(0),
                                            jnp.int32(0)))
+        comm_state: Any = ()
+        if self.onebit_enabled:
+            # per-worker error-feedback residuals: [dp_world, *param_shape],
+            # sharded over the DP axes so each worker owns exactly its own;
+            # ONE compiled program materializes the whole pytree sharded
+            from ..ops.onebit import init_residuals
+
+            dp_world = int(np.prod([self.mesh.shape[a] for a in DP_AXES]))
+            res_shardings = jax.tree.map(
+                lambda _: NamedSharding(self.mesh, PartitionSpec(DP_AXES)),
+                params)
+            comm_state = jax.jit(
+                lambda: init_residuals(params, dp_world),
+                out_shardings=res_shardings)()
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.int32(0), loss_scale=scale_state,
-                          skipped_steps=jnp.int32(0))
+                          skipped_steps=jnp.int32(0), comm_state=comm_state)
 
     def _state_shardings(self, state: TrainState) -> TrainState:
         def of(x):
@@ -210,7 +290,7 @@ class DeepSpeedEngine:
     # the compiled train step
     # ------------------------------------------------------------------
 
-    def _grad_core(self):
+    def _grad_core(self, onebit: Optional[bool] = None):
         """Shared microbatch-scan gradient computation: accumulation, loss
         (un)scaling, ZeRO grad constraints, overflow screen, clipping.  Used
         by BOTH the fused on-device step and the offload grad-only step so
@@ -222,15 +302,11 @@ class DeepSpeedEngine:
         policy = self.policy
         loss_fn = self.loss_fn
 
-        def compute(state: TrainState, batch):
-            compute_params = (cast_tree(state.params, dtype)
-                              if dtype != jnp.float32 else state.params)
-            scale = state.loss_scale.scale
+        onebit = self.onebit_enabled if onebit is None else onebit
+        mesh = self.mesh
 
-            # [global_batch, ...] -> [gas, global_batch/gas, ...]
-            micro = jax.tree.map(
-                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
-                batch)
+        def microbatch_scan(compute_params, micro, scale):
+            """gas-scan of value_and_grad, fp32 accumulation."""
 
             def grad_of_micro(mb):
                 def scaled_loss(p):
@@ -248,14 +324,50 @@ class DeepSpeedEngine:
 
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
-            (loss_sum, grads), _ = jax.lax.scan(
-                body, (jnp.float32(0.0), zero_grads), micro)
+            return jax.lax.scan(body, (jnp.float32(0.0), zero_grads), micro)[0]
+
+        def compute(state: TrainState, batch):
+            compute_params = (cast_tree(state.params, dtype)
+                              if dtype != jnp.float32 else state.params)
+            scale = state.loss_scale.scale
+
+            # [global_batch, ...] -> [gas, global_batch/gas, ...]
+            micro = jax.tree.map(
+                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                batch)
+
+            if onebit:
+                # 1-bit path: per-worker LOCAL grads inside a partial-manual
+                # shard_map over the DP axes (TP/SP stay GSPMD-auto), then
+                # the error-feedback compressed allreduce instead of psum
+                from ..ops.onebit import onebit_reduce_tree
+
+                P = PartitionSpec
+
+                def local(params_c, micro_local, residuals):
+                    loss_sum, grads = microbatch_scan(params_c, micro_local,
+                                                      scale)
+                    res = jax.tree.map(lambda r: jnp.squeeze(r, 0), residuals)
+                    grads, new_res = onebit_reduce_tree(grads, res, DP_AXES)
+                    new_res = jax.tree.map(lambda r: r[None], new_res)
+                    mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
+                    return mean_loss, grads, new_res
+
+                mean_loss, grads, new_comm = jax.shard_map(
+                    local, mesh=mesh,
+                    in_specs=(P(), P(None, DP_AXES), P(DP_AXES)),
+                    out_specs=(P(), P(), P(DP_AXES)),
+                    axis_names=set(DP_AXES), check_vma=False)(
+                        compute_params, micro, state.comm_state)
+            else:
+                loss_sum, grads = microbatch_scan(compute_params, micro,
+                                                  scale)
+                mean_loss = loss_sum
+                new_comm = state.comm_state
 
             if fp16:
                 grads = jax.tree.map(lambda g: g / scale, grads)
-                mean_loss = loss_sum / scale  # undo scaling; /gas already in
-            else:
-                mean_loss = loss_sum
+                mean_loss = mean_loss / scale  # undo scaling; /gas already in
 
             # ZeRO stage >= 2: pin grads to their reduce-scattered layout.
             grads = policy.apply_grad_constraints(grads, self.base_specs)
@@ -267,19 +379,20 @@ class DeepSpeedEngine:
                 grads, grad_norm = clip_grads_by_global_norm(grads, clip)
             else:
                 grad_norm = global_grad_norm(grads)
-            return grads, mean_loss, overflow, grad_norm
+            return grads, mean_loss, overflow, grad_norm, new_comm
 
         return compute
 
-    def _build_train_step(self):
+    def _build_train_step(self, onebit: Optional[bool] = None):
         fp16 = self.fp16_enabled
         schedule = self._schedule
         scaler = self.loss_scaler
         tx = self.optimizer
-        core = self._grad_core()
+        core = self._grad_core(onebit)
 
         def step_fn(state: TrainState, batch):
-            grads, mean_loss, overflow, grad_norm = core(state, batch)
+            grads, mean_loss, overflow, grad_norm, new_comm = core(state,
+                                                                   batch)
 
             updates, new_opt_state = tx.update(grads, state.opt_state,
                                                state.params)
@@ -298,7 +411,8 @@ class DeepSpeedEngine:
                 params=new_params, opt_state=new_opt_state,
                 step=state.step + jnp.where(overflow, 0, 1),
                 loss_scale=new_scale,
-                skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0))
+                skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0),
+                comm_state=new_comm)
             metrics = {
                 "loss": mean_loss,
                 "grad_norm": grad_norm,
@@ -327,7 +441,7 @@ class DeepSpeedEngine:
         base_specs = self.base_specs
 
         def grad_fn(state: TrainState, batch):
-            grads, mean_loss, overflow, grad_norm = core(state, batch)
+            grads, mean_loss, overflow, grad_norm, _ = core(state, batch)
             # land grads in the host-partition (opt-state) layout: each
             # process's d2h pull is exactly its master slice — reduce-scatter
             # over DP instead of all-reduce whenever stage >= 1
@@ -373,8 +487,20 @@ class DeepSpeedEngine:
         as a single compiled program.  ``batch`` holds the full global batch
         (micro × gas × dp_world leading dim)."""
         self.tput_timer.start()
-        if self.offload_enabled:
+        if self.infinity is not None:
+            metrics = self.infinity.train_step(batch)
+            self.state = self.state._replace(
+                params=self.infinity.resident,
+                step=self.state.step + 1)
+        elif self.offload_enabled:
             metrics = self._offload_train_step(batch)
+        elif (self.onebit_enabled
+              and self.global_steps < self.onebit_freeze_step):
+            # 1-bit warmup phase: full-precision DP reduction until
+            # freeze_step (reference OnebitAdam semantics)
+            if self._warmup_step_fn is None:
+                self._warmup_step_fn = self._build_train_step(onebit=False)
+            self.state, metrics = self._warmup_step_fn(self.state, batch)
         else:
             if self._train_step_fn is None:
                 self._train_step_fn = self._build_train_step()
@@ -396,6 +522,8 @@ class DeepSpeedEngine:
         return metrics
 
     def eval_loss(self, batch) -> jnp.ndarray:
+        if self.infinity is not None:
+            return self.infinity.eval_loss(batch)
         if self._eval_loss_fn is None:
             dtype = self.compute_dtype
 
@@ -455,12 +583,15 @@ class DeepSpeedEngine:
         logger.warning(f"stepping with {n} buffered microbatches "
                        f"(configured GAS={self.gradient_accumulation_steps})")
         saved_gas, saved_fn = self.gradient_accumulation_steps, self._train_step_fn
-        self.gradient_accumulation_steps, self._train_step_fn = n, None
+        saved_warm = self._warmup_step_fn
+        self.gradient_accumulation_steps = n
+        self._train_step_fn = self._warmup_step_fn = None
         try:
             return self.train_step(batch)
         finally:
             self.gradient_accumulation_steps = saved_gas
             self._train_step_fn = saved_fn
+            self._warmup_step_fn = saved_warm
 
     # ------------------------------------------------------------------
     # introspection parity

@@ -52,8 +52,9 @@ def build_optimizer(config: DeepSpeedConfig,
         return optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=wd)
     if name in ("lamb", "fusedlamb", "onebitlamb"):
         if name == "onebitlamb":
-            logger.warning("OnebitLamb: running uncompressed lamb; compressed "
-                           "collectives attach at the comm layer")
+            logger.info("OnebitLamb: base lamb update; the engine routes "
+                        "grads through the 1-bit error-feedback compressed "
+                        "allreduce (ops/onebit.py)")
         return optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=wd)
     if name in ("lion", "deepspeedcpulion"):
         # the OptimizerParams field default [0.9, 0.999] is Adam's; Lion's
@@ -69,8 +70,9 @@ def build_optimizer(config: DeepSpeedConfig,
     if name in ("adagrad", "deepspeedcpuadagrad"):
         return optax.adagrad(learning_rate, eps=eps)
     if name in ("onebitadam", "zerooneadam"):
-        logger.warning(f"{opt_cfg.type}: running uncompressed adam; compressed "
-                       "collectives attach at the comm layer")
+        logger.info(f"{opt_cfg.type}: base adam update; the engine routes "
+                    "grads through the 1-bit error-feedback compressed "
+                    "allreduce (ops/onebit.py)")
         return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
     if name == "muon":
         try:

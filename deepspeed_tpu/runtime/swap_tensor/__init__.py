@@ -1,0 +1,9 @@
+"""ZeRO-Infinity tiering: layer-granular param/optimizer swap (cpu/nvme).
+
+Reference tree: ``deepspeed/runtime/swap_tensor/`` [K] (SURVEY §2.1).
+"""
+
+from .infinity_engine import LayerStreamingEngine
+from .partitioned_param_swapper import PartitionedParamSwapper
+
+__all__ = ["LayerStreamingEngine", "PartitionedParamSwapper"]
