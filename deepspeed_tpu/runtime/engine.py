@@ -175,6 +175,36 @@ class DeepSpeedEngine:
                     "offload (the offload step would discard the error-"
                     "feedback residuals) — pick one")
 
+        # --- ZeRO++ qgZ: int8 quantized gradient reduction ----------------
+        if config.zero_optimization.zero_quantized_weights:
+            logger.warning(
+                "zero_quantized_weights (qwZ) is not implemented: the param "
+                "all-gather is GSPMD-scheduled and quantizing it needs a "
+                "manual-gather fwd path; qgZ + hpZ are implemented")
+        self.qgz_enabled = bool(config.zero_optimization.zero_quantized_gradients)
+        if self.qgz_enabled:
+            if self.onebit_enabled:
+                raise ValueError("zero_quantized_gradients and 1-bit "
+                                 "optimizers are mutually exclusive "
+                                 "compression schemes")
+            if self.policy.stage >= 3:
+                raise NotImplementedError(
+                    "qgZ here rides the local-grad shard_map path, which "
+                    "replicates params over DP inside the grad program — "
+                    "incompatible with ZeRO-3 param sharding; use stage<=2 "
+                    "(the collective itself is stage-agnostic)")
+            if self.offload_enabled or self._infinity_requested:
+                raise NotImplementedError(
+                    "zero_quantized_gradients + offload not supported yet")
+            if self.mesh is not None and int(
+                    self.mesh.shape.get("pipe", 1)) > 1:
+                raise NotImplementedError("qgZ + pipeline parallelism "
+                                          "not supported yet")
+            from .zero.qgz import wire_bytes as _qgz_bytes
+
+            # params aren't placed yet; log after state init instead
+            self._log_qgz_bytes = _qgz_bytes
+
         # --- optimizer ---------------------------------------------------
         self.optimizer = optimizer if optimizer is not None else build_optimizer(
             config, lr=self._schedule)
@@ -196,6 +226,10 @@ class DeepSpeedEngine:
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
+        if self.qgz_enabled:
+            q, f = self._log_qgz_bytes(self.state.params)
+            log_dist(f"qgZ: DP grad reduction wire bytes {f/2**20:.1f} MiB "
+                     f"→ {q/2**20:.1f} MiB per step ({f/q:.1f}× reduction)")
         self._train_step_fn = None  # compiled lazily (first call)
         self._warmup_step_fn = None  # 1-bit warmup variant
         self._eval_loss_fn = None
@@ -303,6 +337,7 @@ class DeepSpeedEngine:
         loss_fn = self.loss_fn
 
         onebit = self.onebit_enabled if onebit is None else onebit
+        qgz = self.qgz_enabled
         mesh = self.mesh
 
         def microbatch_scan(compute_params, micro, scale):
@@ -336,27 +371,36 @@ class DeepSpeedEngine:
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
                 batch)
 
-            if onebit:
-                # 1-bit path: per-worker LOCAL grads inside a partial-manual
-                # shard_map over the DP axes (TP/SP stay GSPMD-auto), then
-                # the error-feedback compressed allreduce instead of psum
+            if onebit or qgz:
+                # compressed-comm path: per-worker LOCAL grads inside a
+                # partial-manual shard_map over the DP axes (TP/SP stay
+                # GSPMD-auto), then a compressed allreduce instead of psum —
+                # 1-bit error-feedback signs or qgZ int8 2-hop (ZeRO++)
                 from ..ops.onebit import onebit_reduce_tree
+                from .zero.qgz import qgz_reduce_tree
 
                 P = PartitionSpec
 
                 def local(params_c, micro_local, residuals):
                     loss_sum, grads = microbatch_scan(params_c, micro_local,
                                                       scale)
-                    res = jax.tree.map(lambda r: jnp.squeeze(r, 0), residuals)
-                    grads, new_res = onebit_reduce_tree(grads, res, DP_AXES)
-                    new_res = jax.tree.map(lambda r: r[None], new_res)
+                    if onebit:
+                        res = jax.tree.map(lambda r: jnp.squeeze(r, 0),
+                                           residuals)
+                        grads, new_res = onebit_reduce_tree(grads, res,
+                                                            DP_AXES)
+                        new_res = jax.tree.map(lambda r: r[None], new_res)
+                    else:
+                        grads = qgz_reduce_tree(grads, DP_AXES)
+                        new_res = residuals
                     mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
                     return mean_loss, grads, new_res
 
+                res_spec = P(DP_AXES) if onebit else P()
                 mean_loss, grads, new_comm = jax.shard_map(
                     local, mesh=mesh,
-                    in_specs=(P(), P(None, DP_AXES), P(DP_AXES)),
-                    out_specs=(P(), P(), P(DP_AXES)),
+                    in_specs=(P(), P(None, DP_AXES), res_spec),
+                    out_specs=(P(), P(), res_spec),
                     axis_names=set(DP_AXES), check_vma=False)(
                         compute_params, micro, state.comm_state)
             else:

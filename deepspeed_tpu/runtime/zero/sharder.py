@@ -49,6 +49,11 @@ class ZeroShardingPolicy:
     stage: int
     persistence_threshold: int = 0
     shard_axes: Tuple[str, ...] = DP_AXES
+    #: hpZ (ZeRO++): the *param* (secondary) partition may span a SUB-group
+    #: of the DP world — the bf16 compute copy shards only over the inner
+    #: 'data' axis (ICI-local all-gathers) while grads/opt-state stay
+    #: sharded over the full DP world.  None → same axes as everything.
+    param_shard_axes: Tuple[str, ...] = None
 
     @classmethod
     def from_config(cls, mesh: Mesh, config: DeepSpeedZeroConfig) -> "ZeroShardingPolicy":
@@ -60,8 +65,20 @@ class ZeroShardingPolicy:
         if config.mics_shard_size not in (-1, 0) and config.mics_shard_size < int(
                 np.prod([mesh.shape[a] for a in DP_AXES])):
             shard_axes = ("data",)
+        param_axes = None
+        hpz = int(config.zero_hpz_partition_size or 1)
+        if hpz > 1 and config.stage >= 3:
+            inner = int(mesh.shape.get("data", 1))
+            if hpz != inner:
+                raise ValueError(
+                    f"zero_hpz_partition_size={hpz} must equal the inner "
+                    f"'data' mesh axis size ({inner}) — the secondary "
+                    "partition maps onto the ICI-local axis (lay the mesh "
+                    "out so data=hpz and expert carries the rest of DP)")
+            param_axes = ("data",)
         return cls(mesh=mesh, stage=config.stage,
-                   persistence_threshold=int(threshold), shard_axes=shard_axes)
+                   persistence_threshold=int(threshold),
+                   shard_axes=shard_axes, param_shard_axes=param_axes)
 
     @property
     def dp_size(self) -> int:
@@ -73,7 +90,8 @@ class ZeroShardingPolicy:
 
     def _shard_spec_for_shape(
             self, shape: Tuple[int, ...],
-            base: Optional[PartitionSpec] = None) -> PartitionSpec:
+            base: Optional[PartitionSpec] = None,
+            axes: Optional[Tuple[str, ...]] = None) -> PartitionSpec:
         """Largest free dim divisible by dp_size gets the DP axes.
 
         ``base`` carries model-provided specs (TP ``tensor`` axis, etc. —
@@ -94,7 +112,8 @@ class ZeroShardingPolicy:
         for e in entries:
             if e is not None:
                 used_axes.update(e if isinstance(e, tuple) else (e,))
-        free_axes = tuple(a for a in self.shard_axes if a not in used_axes)
+        shard_axes = axes if axes is not None else self.shard_axes
+        free_axes = tuple(a for a in shard_axes if a not in used_axes)
         free_size = int(np.prod([dict(self.mesh.shape)[a]
                                  for a in free_axes])) if free_axes else 1
         if free_size == 1:
@@ -121,7 +140,9 @@ class ZeroShardingPolicy:
         shape = tuple(np.shape(leaf))
         if self.stage < 3:
             return self._base_or_empty(base, shape)
-        return self._shard_spec_for_shape(shape, base)
+        # hpZ: the compute copy shards over the inner (ICI-local) sub-axes
+        return self._shard_spec_for_shape(shape, base,
+                                          axes=self.param_shard_axes)
 
     def grad_spec(self, leaf: Any,
                   base: Optional[PartitionSpec] = None) -> PartitionSpec:
