@@ -1,0 +1,18 @@
+"""Inference v2 — FastGen-style ragged serving (SURVEY §2.5 "Inference v2").
+
+Reference: ``deepspeed/inference/v2/`` [K] — ragged/continuous batching,
+Dynamic SplitFuse scheduling, blocked KV cache.  TPU-first re-design:
+static-shape compiled programs (one chunked-prefill, one batched-decode)
+reused every scheduler step, with raggedness carried by a paged KV pool +
+block tables instead of dynamic shapes.
+"""
+
+from .engine_v2 import RaggedInferenceEngineV2, build_engine_v2
+from .kv_cache import BlockAllocator, KVCacheConfig, init_kv_pool
+from .scheduler import Request, RequestState, RaggedScheduler
+
+__all__ = [
+    "RaggedInferenceEngineV2", "build_engine_v2",
+    "BlockAllocator", "KVCacheConfig", "init_kv_pool",
+    "Request", "RequestState", "RaggedScheduler",
+]

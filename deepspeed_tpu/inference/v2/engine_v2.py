@@ -1,0 +1,252 @@
+"""RaggedInferenceEngineV2 — the FastGen-style serving engine.
+
+Reference: ``deepspeed/inference/v2/engine_v2.py`` [K] —
+``InferenceEngineV2.put(uids, tokens)`` over a ragged batch with blocked KV
+cache and Dynamic SplitFuse scheduling (SURVEY §2.5 row "Inference v2").
+
+TPU-first: instead of ragged kernels over dynamic shapes, the engine
+compiles exactly TWO fixed-shape programs and reuses them for any request
+mix (XLA traces once; raggedness lives in int32 metadata):
+
+* ``prefill_chunk`` — ``chunk`` prompt tokens of ONE sequence, writing KV
+  pages through the sequence's block table (Dynamic SplitFuse = long
+  prompts become several chunk calls interleaved with decodes).
+* ``decode_batch``  — one token for each of ``max_batch_slots`` sequences
+  over the shared paged pool (``ops/pallas/paged_attention.py`` kernel).
+
+Both donate the pool, so KV updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.llama import _rms_norm, _rope
+from ...ops.pallas.paged_attention import paged_decode_attention
+from ...utils.logging import log_dist
+from .kv_cache import KVCacheConfig, init_kv_pool
+from .scheduler import RaggedScheduler, Request
+
+
+class RaggedInferenceEngineV2:
+    def __init__(self, model: Any, params: Any,
+                 cache_config: Optional[KVCacheConfig] = None,
+                 max_batch_slots: int = 8, prefill_chunk: int = 128):
+        self.model = model
+        self.config = model.config
+        self.params = params
+        self.cache_config = cache_config or KVCacheConfig()
+        if prefill_chunk % self.cache_config.block_size:
+            raise ValueError("prefill_chunk must be a multiple of block_size")
+        if self.cache_config.max_seq_len % prefill_chunk:
+            # keeps every chunk's page-table slice in range: dynamic_slice
+            # clamps out-of-bounds starts, which would silently retarget a
+            # chunk's KV writes onto the sequence's EARLIER pages
+            raise ValueError("max_seq_len must be a multiple of prefill_chunk")
+        self.scheduler = RaggedScheduler(self.cache_config, max_batch_slots,
+                                         prefill_chunk)
+        self.pool = init_kv_pool(self.config, self.cache_config)
+        self.max_slots = max_batch_slots
+        self.chunk = prefill_chunk
+        self._prefill = jax.jit(self._prefill_chunk_fn, donate_argnums=(1,))
+        self._decode = jax.jit(self._decode_batch_fn, donate_argnums=(1,))
+        log_dist(f"inference v2: pool={self.cache_config.num_blocks}"
+                 f"x{self.cache_config.block_size} tokens, "
+                 f"slots={max_batch_slots}, chunk={prefill_chunk}")
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+
+    def _prefill_chunk_fn(self, params, pool, tokens, table_row, start_pos,
+                          last_idx):
+        """One chunk of one sequence: ``tokens [C]`` at positions
+        ``start_pos + [0..C)``; returns (logits[V] at ``last_idx``, pool)."""
+        c = self.config
+        C = tokens.shape[0]
+        bs = self.cache_config.block_size
+        mb = self.cache_config.max_blocks_per_seq
+        n_rep = c.num_heads // c.num_kv_heads
+        positions = start_pos + jnp.arange(C)  # [C]
+        x = jnp.take(params["embed"].astype(c.dtype), tokens, axis=0)  # [C,H]
+        page_cursor = start_pos // bs  # chunk & start are page-aligned
+
+        def layer(carry, xs):
+            x, = carry
+            lp, k_pool_l, v_pool_l = xs
+            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+            q = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wq"].astype(c.dtype))
+            kk = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wk"].astype(c.dtype))
+            vv = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wv"].astype(c.dtype))
+            q = _rope(q, positions, c.rope_theta)
+            kk = _rope(kk, positions, c.rope_theta)
+            # write this chunk's pages through the block table
+            pages = jax.lax.dynamic_slice(table_row, (page_cursor,),
+                                          (C // bs,))
+            k_pool_l = k_pool_l.at[pages].set(
+                kk.reshape(C // bs, bs, c.num_kv_heads, c.hd))
+            v_pool_l = v_pool_l.at[pages].set(
+                vv.reshape(C // bs, bs, c.num_kv_heads, c.hd))
+            # attend over everything this sequence owns (prefix + chunk,
+            # causal by absolute position)
+            kf = k_pool_l[table_row].reshape(mb * bs, c.num_kv_heads, c.hd)
+            vf = v_pool_l[table_row].reshape(mb * bs, c.num_kv_heads, c.hd)
+            if n_rep > 1:
+                kf = jnp.repeat(kf, n_rep, axis=1)
+                vf = jnp.repeat(vf, n_rep, axis=1)
+            scale = 1.0 / np.sqrt(c.hd)
+            s = jnp.einsum("qhd,khd->hqk", q, kf).astype(jnp.float32) * scale
+            k_pos = jnp.arange(mb * bs)
+            mask = k_pos[None, None, :] <= positions[None, :, None]
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+            attn = jnp.einsum("hqk,khd->qhd", p, vf)
+            out = jnp.einsum("qhd,hdH->qH", attn,
+                             lp["attn"]["wo"].astype(c.dtype))
+            x = x + out
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            ffn_out, _ = self.model._ffn(h[None], lp)
+            x = x + ffn_out[0]
+            return (x,), (k_pool_l, v_pool_l)
+
+        (x,), (ks, vs) = jax.lax.scan(
+            layer, (x,), (params["layers"], pool["k"], pool["v"]))
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        last_h = jax.lax.dynamic_index_in_dim(x, last_idx, axis=0,
+                                              keepdims=False)
+        logits = jnp.einsum("H,HV->V", last_h,
+                            self.model._head(params).astype(c.dtype))
+        return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+    def _decode_batch_fn(self, params, pool, tokens, kv_lens, tables):
+        """One token per slot: ``tokens [B]`` write KV at ``kv_lens [B]``
+        through ``tables [B, max_blocks]``; returns (logits [B, V], pool)."""
+        c = self.config
+        B = tokens.shape[0]
+        bs = self.cache_config.block_size
+        x = jnp.take(params["embed"].astype(c.dtype), tokens, axis=0)
+        pos = kv_lens[:, None]  # [B, 1]
+        page_ids = tables[jnp.arange(B), kv_lens // bs]  # [B]
+        offsets = kv_lens % bs
+
+        def layer(carry, xs):
+            x, = carry
+            lp, k_pool_l, v_pool_l = xs
+            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+            q = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wq"].astype(c.dtype))
+            kk = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wk"].astype(c.dtype))
+            vv = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wv"].astype(c.dtype))
+            q = _rope(q[:, None], pos, c.rope_theta)[:, 0]
+            kk = _rope(kk[:, None], pos, c.rope_theta)[:, 0]
+            k_pool_l = k_pool_l.at[page_ids, offsets].set(kk)
+            v_pool_l = v_pool_l.at[page_ids, offsets].set(vv)
+            attn = paged_decode_attention(q, k_pool_l, v_pool_l, tables,
+                                          kv_lens + 1)
+            out = jnp.einsum("bhd,hdH->bH", attn,
+                             lp["attn"]["wo"].astype(c.dtype))
+            x = x + out
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            ffn_out, _ = self.model._ffn(h[:, None, :], lp)
+            x = x + ffn_out[:, 0, :]
+            return (x,), (k_pool_l, v_pool_l)
+
+        (x,), (ks, vs) = jax.lax.scan(
+            layer, (x,), (params["layers"], pool["k"], pool["v"]))
+        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        logits = jnp.einsum("bH,HV->bV", x,
+                            self.model._head(params).astype(c.dtype))
+        return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+    # ------------------------------------------------------------------
+    # serving surface
+    # ------------------------------------------------------------------
+
+    def put(self, prompt: List[int], max_new_tokens: int = 32) -> Request:
+        """Admit one request (reference ``engine.put`` role)."""
+        return self.scheduler.add_request(prompt, max_new_tokens)
+
+    def _sample(self, logits: np.ndarray, temperature: float,
+                rng: np.random.Generator) -> np.ndarray:
+        if temperature <= 0:
+            return np.argmax(logits, axis=-1)
+        z = logits / temperature
+        z = z - z.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        return np.array([rng.choice(p.shape[-1], p=row) for row in
+                         np.atleast_2d(p)])
+
+    def step(self, temperature: float = 0.0,
+             eos_token_id: Optional[int] = None,
+             rng: Optional[np.random.Generator] = None) -> int:
+        """One scheduler step: at most one prefill chunk + one decode batch.
+        Returns the number of tokens processed (SplitFuse keeps this near
+        ``chunk + active_slots`` every step)."""
+        rng = rng or np.random.default_rng(0)
+        chunk, decode = self.scheduler.plan_step()
+        n_tokens = 0
+        if chunk is not None:
+            req = chunk.request
+            logits, self.pool = self._prefill(
+                self.params, self.pool,
+                jnp.asarray(chunk.tokens),
+                jnp.asarray(self.scheduler.table_row(req)),
+                jnp.int32(chunk.start_pos),
+                jnp.int32(max(chunk.n_valid - 1, 0)))
+            n_tokens += chunk.n_valid
+            first = None
+            if chunk.is_last:
+                first = int(self._sample(np.asarray(logits)[None],
+                                         temperature, rng)[0])
+            self.scheduler.chunk_done(chunk, first, eos_token_id)
+        if decode:
+            B = self.max_slots
+            tokens = np.zeros((B,), np.int32)
+            kv_lens = np.zeros((B,), np.int32)
+            tables = np.zeros((B, self.cache_config.max_blocks_per_seq),
+                              np.int32)
+            for req in decode:
+                s = req.slot
+                tokens[s] = req.generated[-1]
+                kv_lens[s] = req.prefilled + len(req.generated) - 1
+                tables[s] = self.scheduler.table_row(req)
+            logits, self.pool = self._decode(
+                self.params, self.pool, jnp.asarray(tokens),
+                jnp.asarray(kv_lens), jnp.asarray(tables))
+            logits = np.asarray(logits)
+            sampled = self._sample(
+                np.stack([logits[r.slot] for r in decode]), temperature, rng)
+            self.scheduler.decode_done(decode, sampled, eos_token_id)
+            n_tokens += len(decode)
+        return n_tokens
+
+    def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_token_id: Optional[int] = None,
+                 ) -> List[List[int]]:
+        """Drive the scheduler to completion over a ragged prompt batch.
+        Returns the generated-token lists in prompt order."""
+        rng = np.random.default_rng(seed)
+        reqs = [self.put(p, max_new_tokens) for p in prompts]
+        t0 = time.perf_counter()
+        total = 0
+        while self.scheduler.has_work:
+            total += self.step(temperature, eos_token_id, rng)
+        dt = time.perf_counter() - t0
+        self.last_throughput = total / dt if dt > 0 else 0.0
+        return [r.generated for r in reqs]
+
+
+def build_engine_v2(model: Any, params: Any = None,
+                    cache_config: Optional[KVCacheConfig] = None,
+                    max_batch_slots: int = 8,
+                    prefill_chunk: int = 128) -> RaggedInferenceEngineV2:
+    if params is None:
+        params = model.init_params(jax.random.PRNGKey(0))
+    return RaggedInferenceEngineV2(model, params, cache_config,
+                                   max_batch_slots, prefill_chunk)

@@ -1,0 +1,71 @@
+"""Blocked (paged) KV cache — pool + block allocator.
+
+Reference: ``deepspeed/inference/v2/ragged/`` [K] — ``BlockedKVCache`` /
+``KVCacheManager``: KV memory is a pool of fixed-size pages shared by all
+sequences; each sequence owns a list of page ids (the block table), so HBM
+is committed in page units as sequences grow instead of a padded
+``[B, max_len]`` rectangle up front.
+
+TPU-first: the pool is ONE device array per K/V with the layer dim stacked
+(``[L, num_blocks, block_size, kv_h, d]``) so the per-layer ``lax.scan``
+in the decode program slices it like every other stacked-layer tensor;
+page bookkeeping (free list, tables) is plain host Python — it never
+enters the compiled program, which only ever sees int32 table arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_blocks: int = 256          # pool pages (page 0 reserved as scratch)
+    block_size: int = 16           # tokens per page
+    max_seq_len: int = 2048        # per-sequence logical capacity
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.block_size)
+
+
+def init_kv_pool(model_config: Any, cache_config: KVCacheConfig
+                 ) -> Dict[str, jnp.ndarray]:
+    """Zeroed pool sized from the model's (layers, kv-heads, head-dim)."""
+    c = model_config
+    shape = (c.num_layers, cache_config.num_blocks, cache_config.block_size,
+             c.num_kv_heads, c.hd)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+class BlockAllocator:
+    """Free-list page allocator.  Page 0 is reserved: inactive batch slots
+    point their whole table at it, so clamped kernel lookups always resolve
+    to a valid page and dead slots scribble only on scratch."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (page 0 is reserved)")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV pool exhausted: want {n} pages, "
+                              f"{len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"bad page id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of page {b}")
+            self._free.append(b)

@@ -1,0 +1,189 @@
+"""Ragged request scheduler — continuous batching + chunked prefill.
+
+Reference: ``deepspeed/inference/v2/ragged/ragged_manager.py`` +
+``scheduling_utils`` [K] and the Dynamic SplitFuse policy (FastGen,
+arXiv 2401.08671 [P]): long prompts are split into fixed-size chunks and
+prefill work is interleaved with running decodes so every forward pass
+carries a near-constant token count — which on TPU is exactly what keeps
+ONE compiled program shape serving an arbitrary request mix.
+
+Host-side only: states, block tables and the free list live in Python;
+the device sees fixed-shape int32 arrays each step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from .kv_cache import BlockAllocator, KVCacheConfig
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    DONE = "done"
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    state: RequestState = RequestState.WAITING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    prefilled: int = 0          # prompt tokens already written to the pool
+    slot: int = -1              # decode batch slot while RUNNING
+
+    @property
+    def length(self) -> int:
+        return self.prefilled + len(self.generated)
+
+    def pages_needed(self, block_size: int) -> int:
+        total = len(self.prompt) + self.max_new_tokens
+        return -(-total // block_size)
+
+
+@dataclasses.dataclass
+class PrefillChunk:
+    request: Request
+    tokens: np.ndarray          # [chunk] int32, zero-padded
+    start_pos: int              # first position this chunk covers
+    n_valid: int                # true tokens in this chunk
+    is_last: bool               # finishing chunk → sample first token
+
+
+class RaggedScheduler:
+    """Admission + step planning over a fixed decode-slot budget.
+
+    Each :meth:`plan_step` returns at most one :class:`PrefillChunk` (the
+    SplitFuse interleave unit) plus the current decode batch composition;
+    the engine runs the corresponding compiled programs.
+    """
+
+    def __init__(self, cache_config: KVCacheConfig, max_batch_slots: int = 8,
+                 prefill_chunk: int = 128):
+        if prefill_chunk % cache_config.block_size:
+            raise ValueError("prefill_chunk must be a multiple of block_size")
+        self.cache = cache_config
+        self.allocator = BlockAllocator(cache_config.num_blocks)
+        self.chunk = prefill_chunk
+        self.max_slots = max_batch_slots
+        self.slots: List[Optional[Request]] = [None] * max_batch_slots
+        self.waiting: Deque[Request] = deque()
+        self.prefilling: Deque[Request] = deque()
+        self._uid = 0
+
+    # -- request surface ---------------------------------------------------
+
+    def add_request(self, prompt: List[int], max_new_tokens: int) -> Request:
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + max_new_tokens
+        if total > self.cache.max_seq_len:
+            raise ValueError(f"request of {total} tokens exceeds "
+                             f"max_seq_len {self.cache.max_seq_len}")
+        need = -(-total // self.cache.block_size)
+        if need > self.cache.num_blocks - 1:  # page 0 reserved
+            # reject now: _admit could never place it and generate() would
+            # spin on has_work forever
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.cache.num_blocks - 1}")
+        req = Request(uid=self._uid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens)
+        self._uid += 1
+        self.waiting.append(req)
+        return req
+
+    @property
+    def has_work(self) -> bool:
+        return (bool(self.waiting) or bool(self.prefilling)
+                or any(s is not None for s in self.slots))
+
+    # -- planning ------------------------------------------------------------
+
+    def _free_slot(self) -> int:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return -1
+
+    def _admit(self) -> None:
+        """Move waiting → prefilling while a slot + enough pages exist.
+        Pages for the FULL request (prompt + generation budget) are reserved
+        at admission so a running sequence can never die of pool OOM
+        mid-flight (the reference's conservative scheduling mode)."""
+        while self.waiting:
+            req = self.waiting[0]
+            slot = self._free_slot()
+            if slot < 0:
+                return
+            need = req.pages_needed(self.cache.block_size)
+            if need > self.allocator.num_free:
+                return
+            self.waiting.popleft()
+            req.blocks = self.allocator.allocate(need)
+            req.state = RequestState.PREFILL
+            req.slot = slot
+            self.slots[slot] = req
+            self.prefilling.append(req)
+
+    def plan_step(self) -> tuple:
+        """→ (PrefillChunk | None, decode_requests) for this step."""
+        self._admit()
+        chunk = None
+        if self.prefilling:
+            req = self.prefilling[0]
+            start = req.prefilled
+            n_valid = min(self.chunk, len(req.prompt) - start)
+            toks = np.zeros((self.chunk,), np.int32)
+            toks[:n_valid] = req.prompt[start:start + n_valid]
+            is_last = start + n_valid >= len(req.prompt)
+            chunk = PrefillChunk(request=req, tokens=toks, start_pos=start,
+                                 n_valid=n_valid, is_last=is_last)
+        decode = [r for r in self.slots
+                  if r is not None and r.state is RequestState.RUNNING]
+        return chunk, decode
+
+    # -- state transitions (called by the engine) ----------------------------
+
+    def chunk_done(self, chunk: PrefillChunk, first_token: Optional[int],
+                   eos_token_id: Optional[int] = None) -> None:
+        req = chunk.request
+        req.prefilled += chunk.n_valid
+        if chunk.is_last:
+            assert req.prefilled == len(req.prompt)
+            self.prefilling.popleft()
+            req.state = RequestState.RUNNING
+            if first_token is not None:
+                req.generated.append(int(first_token))
+                self._maybe_finish(req, int(first_token), eos_token_id)
+
+    def decode_done(self, requests: List[Request], tokens: np.ndarray,
+                    eos_token_id: Optional[int] = None) -> None:
+        for req, tok in zip(requests, tokens):
+            req.generated.append(int(tok))
+            self._maybe_finish(req, int(tok), eos_token_id)
+
+    def _maybe_finish(self, req: Request, tok: int,
+                      eos: Optional[int]) -> None:
+        if (len(req.generated) >= req.max_new_tokens
+                or (eos is not None and tok == eos)):
+            req.state = RequestState.DONE
+            self.allocator.free(req.blocks)
+            req.blocks = []
+            if req.slot >= 0:
+                self.slots[req.slot] = None
+                req.slot = -1
+
+    def table_row(self, req: Request) -> np.ndarray:
+        row = np.zeros((self.cache.max_blocks_per_seq,), np.int32)
+        row[:len(req.blocks)] = req.blocks
+        return row

@@ -8,7 +8,10 @@ model exposes ``init_params``, ``forward``, ``loss`` and partition-spec rules
 that compose with the ZeRO sharding policy.
 """
 
+from .bert import BertConfig, BertModel
 from .llama import LlamaConfig, LlamaModel
 from .mixtral import MixtralConfig, MixtralModel
+from .resnet import ResNetConfig, ResNetModel
 
-__all__ = ["LlamaConfig", "LlamaModel", "MixtralConfig", "MixtralModel"]
+__all__ = ["BertConfig", "BertModel", "LlamaConfig", "LlamaModel",
+           "MixtralConfig", "MixtralModel", "ResNetConfig", "ResNetModel"]

@@ -1,0 +1,263 @@
+"""BERT-family encoder — driver config-ladder rung 2 (ZeRO-1/2).
+
+Capability anchor: the reference's canonical ZeRO-1/2 showcase is
+BERT-large pretraining (``tests/model/BingBertSquad`` convergence suite +
+the FusedLamb large-batch BERT path [K], SURVEY §4/§2.2); the driver
+ladder names "BERT-large (ZeRO-1/2 over ICI)" as config 2 [D BASELINE.md].
+
+TPU-first, same design grammar as ``llama.py``:
+
+* stacked per-layer params + ``lax.scan`` — one compiled encoder block;
+* bidirectional (no causal mask) attention left to XLA's fusion — at
+  BERT sizes (S=512) flash tiling buys nothing over the fused softmax;
+* masked-LM loss with -100 ignore positions (HF convention), so HF-style
+  data pipelines feed it unchanged;
+* TP/ZeRO placement via ``param_specs`` exactly like the decoder models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
+
+P = PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # BERT-large defaults
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        d = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                 num_layers=4, num_heads=8, max_seq_len=128)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def bert_large(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    def num_params(self) -> int:
+        H, I, V, L = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_layers)
+        per_layer = 4 * H * H + 4 * H + 2 * H * I + I + H + 4 * H
+        embeds = (V + self.max_seq_len + self.type_vocab_size) * H + 2 * H
+        return embeds + L * per_layer + H * H + 3 * H + V  # MLM head
+
+
+def _layer_norm(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
+                eps: float) -> jnp.ndarray:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(dt) * w + b
+
+
+class BertModel:
+    """Functional MLM encoder: pure forward, params as a plain pytree."""
+
+    aux_loss_coef: float = 0.0
+
+    def __init__(self, config: BertConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        H, I, V, L = (c.hidden_size, c.intermediate_size, c.vocab_size,
+                      c.num_layers)
+        nh, hd = c.num_heads, c.hd
+        k = iter(jax.random.split(rng, 16))
+
+        def normal(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (1.0 / np.sqrt(fan_in))).astype(jnp.float32)
+
+        return {
+            "embed": {
+                "word": normal(next(k), (V, H), H),
+                "position": normal(next(k), (c.max_seq_len, H), H),
+                "token_type": normal(next(k), (c.type_vocab_size, H), H),
+                "ln_w": jnp.ones((H,), jnp.float32),
+                "ln_b": jnp.zeros((H,), jnp.float32),
+            },
+            "layers": {
+                "attn": {
+                    "wq": normal(next(k), (L, H, nh, hd), H),
+                    "wk": normal(next(k), (L, H, nh, hd), H),
+                    "wv": normal(next(k), (L, H, nh, hd), H),
+                    "wo": normal(next(k), (L, nh, hd, H), H),
+                    "bq": jnp.zeros((L, nh, hd), jnp.float32),
+                    "bk": jnp.zeros((L, nh, hd), jnp.float32),
+                    "bv": jnp.zeros((L, nh, hd), jnp.float32),
+                    "bo": jnp.zeros((L, H), jnp.float32),
+                },
+                "mlp": {
+                    "w_in": normal(next(k), (L, H, I), H),
+                    "b_in": jnp.zeros((L, I), jnp.float32),
+                    "w_out": normal(next(k), (L, I, H), I),
+                    "b_out": jnp.zeros((L, H), jnp.float32),
+                },
+                "attn_ln_w": jnp.ones((L, H), jnp.float32),
+                "attn_ln_b": jnp.zeros((L, H), jnp.float32),
+                "mlp_ln_w": jnp.ones((L, H), jnp.float32),
+                "mlp_ln_b": jnp.zeros((L, H), jnp.float32),
+            },
+            "mlm": {  # prediction-head transform; decoder ties to word embed
+                "w": normal(next(k), (H, H), H),
+                "b": jnp.zeros((H,), jnp.float32),
+                "ln_w": jnp.ones((H,), jnp.float32),
+                "ln_b": jnp.zeros((H,), jnp.float32),
+                "bias": jnp.zeros((V,), jnp.float32),
+            },
+        }
+
+    def param_specs(self, params: Optional[Any] = None) -> Dict[str, Any]:
+        t = AXIS_TENSOR
+        return {
+            "embed": {"word": P(None, None), "position": P(None, None),
+                      "token_type": P(None, None),
+                      "ln_w": P(None), "ln_b": P(None)},
+            "layers": {
+                "attn": {
+                    "wq": P(None, None, t, None), "wk": P(None, None, t, None),
+                    "wv": P(None, None, t, None), "wo": P(None, t, None, None),
+                    "bq": P(None, t, None), "bk": P(None, t, None),
+                    "bv": P(None, t, None), "bo": P(None, None),
+                },
+                "mlp": {
+                    "w_in": P(None, None, t), "b_in": P(None, t),
+                    "w_out": P(None, t, None), "b_out": P(None, None),
+                },
+                "attn_ln_w": P(None, None), "attn_ln_b": P(None, None),
+                "mlp_ln_w": P(None, None), "mlp_ln_b": P(None, None),
+            },
+            "mlm": {"w": P(None, None), "b": P(None), "ln_w": P(None),
+                    "ln_b": P(None), "bias": P(None)},
+        }
+
+    # ------------------------------------------------------------------
+
+    def _constrain(self, x: jnp.ndarray, *spec) -> jnp.ndarray:
+        if self.mesh is None:
+            return x
+        from ..parallel.mesh import strip_manual_axes
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, strip_manual_axes(*spec)))
+
+    def encoder_layer(self, lp: Any, x: jnp.ndarray,
+                      pad_mask: jnp.ndarray) -> jnp.ndarray:
+        """One post-LN encoder block ``[B, S, H] → [B, S, H]``;
+        ``pad_mask [B, S]`` True at real tokens."""
+        c = self.config
+        dt = c.dtype
+        q = jnp.einsum("bsH,Hhd->bshd", x, lp["attn"]["wq"].astype(dt)) \
+            + lp["attn"]["bq"].astype(dt)
+        kk = jnp.einsum("bsH,Hhd->bshd", x, lp["attn"]["wk"].astype(dt)) \
+            + lp["attn"]["bk"].astype(dt)
+        vv = jnp.einsum("bsH,Hhd->bshd", x, lp["attn"]["wv"].astype(dt)) \
+            + lp["attn"]["bv"].astype(dt)
+        q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        scale = 1.0 / np.sqrt(c.hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+        s = jnp.where(pad_mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        out = jnp.einsum("bshd,hdH->bsH", attn, lp["attn"]["wo"].astype(dt)) \
+            + lp["attn"]["bo"].astype(dt)
+        x = _layer_norm(x + out, lp["attn_ln_w"].astype(dt),
+                        lp["attn_ln_b"].astype(dt), c.layer_norm_eps)
+
+        h = jnp.einsum("bsH,HI->bsI", x, lp["mlp"]["w_in"].astype(dt)) \
+            + lp["mlp"]["b_in"].astype(dt)
+        h = self._constrain(jax.nn.gelu(h), DP_AXES, AXIS_SEQ, AXIS_TENSOR)
+        h = jnp.einsum("bsI,IH->bsH", h, lp["mlp"]["w_out"].astype(dt)) \
+            + lp["mlp"]["b_out"].astype(dt)
+        x = _layer_norm(x + h, lp["mlp_ln_w"].astype(dt),
+                        lp["mlp_ln_b"].astype(dt), c.layer_norm_eps)
+        return self._constrain(x, DP_AXES, AXIS_SEQ, None)
+
+    def forward(self, params: Any, input_ids: jnp.ndarray,
+                attention_mask: Optional[jnp.ndarray] = None,
+                token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """[B, S] ids → [B, S, V] MLM logits (fp32)."""
+        c = self.config
+        dt = c.dtype
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), bool)
+        else:
+            attention_mask = attention_mask.astype(bool)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((B, S), jnp.int32)
+        e = params["embed"]
+        x = (jnp.take(e["word"].astype(dt), input_ids, axis=0)
+             + e["position"].astype(dt)[None, :S]
+             + jnp.take(e["token_type"].astype(dt), token_type_ids, axis=0))
+        x = _layer_norm(x, e["ln_w"].astype(dt), e["ln_b"].astype(dt),
+                        c.layer_norm_eps)
+        x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
+
+        def layer(carry, lp):
+            return self.encoder_layer(lp, carry, attention_mask), None
+
+        body = layer
+        if c.remat:
+            body = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x,
+                            params["layers"])
+
+        m = params["mlm"]
+        h = jax.nn.gelu(jnp.einsum("bsH,HG->bsG", x, m["w"].astype(dt))
+                        + m["b"].astype(dt))
+        h = _layer_norm(h, m["ln_w"].astype(dt), m["ln_b"].astype(dt),
+                        c.layer_norm_eps)
+        logits = (jnp.einsum("bsH,VH->bsV", h, e["word"].astype(dt))
+                  + m["bias"])
+        return logits.astype(jnp.float32)
+
+    __call__ = forward
+
+    def loss(self, params: Any, batch: Any) -> jnp.ndarray:
+        """Masked-LM cross entropy; ``batch = {"input_ids", "labels"[, "
+        attention_mask", "token_type_ids"]}`` with -100 = not masked."""
+        input_ids = batch["input_ids"]
+        labels = batch["labels"]
+        logits = self.forward(params, input_ids,
+                              batch.get("attention_mask"),
+                              batch.get("token_type_ids"))
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+            jnp.sum(valid), 1)

@@ -60,6 +60,9 @@ class LlamaConfig:
     #: pipeline microbatch count (0 → pipe axis size); used when the mesh has
     #: a pipe axis > 1
     pp_microbatches: int = 0
+    #: virtual stages per pipe rank (>1 → interleaved schedule: bubble
+    #: shrinks by this factor; num_layers must divide by pp*pp_interleave)
+    pp_interleave: int = 1
     #: "flash" → Pallas online-softmax kernel (TPU; falls back to XLA off-TPU),
     #: "xla" → einsum+softmax left to the XLA fuser
     attn_impl: str = "xla"
@@ -334,7 +337,8 @@ class LlamaModel:
                 return (nx, naux)
 
             out_x, out_aux = pipeline_apply(pipe_layer, params["layers"],
-                                            micro, self.mesh)
+                                            micro, self.mesh,
+                                            virtual_stages=c.pp_interleave)
             x = out_x.reshape(B, S, -1)
             aux = out_aux.mean()
         else:

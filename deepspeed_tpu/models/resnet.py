@@ -1,0 +1,217 @@
+"""CIFAR ResNet — driver config-ladder rung 1 (ZeRO-0, one chip).
+
+Capability anchor: the reference's canonical getting-started example is
+CIFAR-10 training through the engine (DeepSpeedExamples ``cifar`` tutorial,
+referenced from the reference README [K]); the driver ladder names
+"CIFAR ResNet-56 (ZeRO-0, 1 chip)" as config 1 [D BASELINE.md].
+
+TPU-first notes:
+
+* convs via ``jax.lax.conv_general_dilated`` in NHWC — the layout XLA:TPU
+  prefers (channels-last feeds the MXU as a [spatial, C_in]x[C_in, C_out]
+  contraction);
+* the three stages are scans over stacked per-block params (same design
+  grammar as the transformer models: one compiled block body per stage);
+* normalization is batch-statistics BatchNorm *without* running averages —
+  the functional-training formulation (statistics recomputed at eval):
+  documented deviation, keeps the engine's params-only TrainState.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.mesh import DP_AXES
+
+P = PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 56                 # 6n+2; n blocks per stage
+    num_classes: int = 10
+    width: int = 16                 # stage-1 channels (then 2x, 4x)
+    image_size: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def blocks_per_stage(self) -> int:
+        if (self.depth - 2) % 6:
+            raise ValueError("depth must be 6n+2 (20, 32, 44, 56, 110, …)")
+        return (self.depth - 2) // 6
+
+    @classmethod
+    def resnet56(cls, **kw) -> "ResNetConfig":
+        return cls(depth=56, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        d = dict(depth=8, width=8, image_size=8)
+        d.update(kw)
+        return cls(**d)
+
+    def num_params(self) -> int:
+        n = self.blocks_per_stage
+        w = self.width
+        total = 3 * 3 * 3 * w + 2 * w                      # stem
+        for s, c in enumerate((w, 2 * w, 4 * w)):
+            cin = w if s == 0 else c // 2
+            total += (9 * cin * c + 9 * c * c + 4 * c      # first block
+                      + (cin != c) * cin * c)
+            total += (n - 1) * (18 * c * c + 4 * c)        # rest
+        return total + 4 * w * self.num_classes + self.num_classes
+
+
+def _conv(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1) -> jnp.ndarray:
+    """NHWC conv, SAME padding; w is [kh, kw, cin, cout]."""
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+        eps: float = 1e-5) -> jnp.ndarray:
+    """Batch-statistics norm over (N, H, W) — see module docstring."""
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(0, 1, 2), keepdims=True)
+    return (((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(dt)
+            * scale + bias)
+
+
+class ResNetModel:
+    """Functional CIFAR ResNet; params pytree + pure forward/loss."""
+
+    aux_loss_coef: float = 0.0
+
+    def __init__(self, config: ResNetConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        n, w = c.blocks_per_stage, c.width
+        keys = iter(jax.random.split(rng, 8))
+
+        def he(key, shape):
+            # conv fan-in is kh*kw*cin — the last-4-to-last-1 dims whether or
+            # not a leading stack dim is present (which may be 0 blocks)
+            fan = shape[-4:-1] if len(shape) >= 4 else shape[:-1]
+            fan_in = max(int(np.prod(fan)), 1)
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * np.sqrt(2.0 / fan_in)).astype(jnp.float32)
+
+        def stage(key, cin, cout, blocks):
+            ks = jax.random.split(key, 3)
+            p = {
+                # first block may change channels/stride; stacked rest
+                "first": {
+                    "conv1": he(ks[0], (3, 3, cin, cout)),
+                    "conv2": he(ks[1], (3, 3, cout, cout)),
+                    "bn1_s": jnp.ones((cout,), jnp.float32),
+                    "bn1_b": jnp.zeros((cout,), jnp.float32),
+                    "bn2_s": jnp.ones((cout,), jnp.float32),
+                    "bn2_b": jnp.zeros((cout,), jnp.float32),
+                },
+                "rest": {
+                    "conv1": he(ks[2], (blocks - 1, 3, 3, cout, cout)),
+                    "conv2": he(jax.random.fold_in(ks[2], 1),
+                                (blocks - 1, 3, 3, cout, cout)),
+                    "bn1_s": jnp.ones((blocks - 1, cout), jnp.float32),
+                    "bn1_b": jnp.zeros((blocks - 1, cout), jnp.float32),
+                    "bn2_s": jnp.ones((blocks - 1, cout), jnp.float32),
+                    "bn2_b": jnp.zeros((blocks - 1, cout), jnp.float32),
+                },
+            }
+            if cin != cout:
+                p["first"]["proj"] = he(jax.random.fold_in(ks[0], 7),
+                                        (1, 1, cin, cout))
+            return p
+
+        return {
+            "stem": {"conv": he(next(keys), (3, 3, 3, w)),
+                     "bn_s": jnp.ones((w,), jnp.float32),
+                     "bn_b": jnp.zeros((w,), jnp.float32)},
+            "stage1": stage(next(keys), w, w, n),
+            "stage2": stage(next(keys), w, 2 * w, n),
+            "stage3": stage(next(keys), 2 * w, 4 * w, n),
+            "head": {"w": he(next(keys), (4 * w, c.num_classes)),
+                     "b": jnp.zeros((c.num_classes,), jnp.float32)},
+        }
+
+    def param_specs(self, params: Optional[Any] = None) -> Dict[str, Any]:
+        """Vision model: no TP split (convs are small); ZeRO composes DP
+        sharding on top via the engine's policy."""
+        return jax.tree.map(lambda _: P(), self.init_shapes())
+
+    def init_shapes(self):
+        return jax.eval_shape(lambda: self.init_params(jax.random.PRNGKey(0)))
+
+    # ------------------------------------------------------------------
+
+    def _block(self, bp: Dict[str, Any], x: jnp.ndarray,
+               stride: int = 1) -> jnp.ndarray:
+        dt = self.config.dtype
+        h = _conv(x, bp["conv1"].astype(dt), stride)
+        h = jax.nn.relu(_bn(h, bp["bn1_s"].astype(dt), bp["bn1_b"].astype(dt)))
+        h = _conv(h, bp["conv2"].astype(dt))
+        h = _bn(h, bp["bn2_s"].astype(dt), bp["bn2_b"].astype(dt))
+        if "proj" in bp:
+            x = _conv(x, bp["proj"].astype(dt), stride)
+        elif stride != 1:
+            x = x[:, ::stride, ::stride]
+        return jax.nn.relu(x + h)
+
+    def forward(self, params: Any, images: jnp.ndarray) -> jnp.ndarray:
+        """[B, H, W, 3] images → [B, num_classes] logits (fp32)."""
+        c = self.config
+        dt = c.dtype
+        x = images.astype(dt)
+        x = self._constrain(x)
+        st = params["stem"]
+        x = jax.nn.relu(_bn(_conv(x, st["conv"].astype(dt)),
+                            st["bn_s"].astype(dt), st["bn_b"].astype(dt)))
+
+        for name, stride in (("stage1", 1), ("stage2", 2), ("stage3", 2)):
+            sp = params[name]
+            x = self._block(sp["first"], x, stride)
+
+            def block(carry, bp):
+                return self._block(bp, carry), None
+
+            x, _ = jax.lax.scan(block, x, sp["rest"])
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = (x @ params["head"]["w"].astype(dt)
+                  + params["head"]["b"].astype(dt))
+        return logits.astype(jnp.float32)
+
+    __call__ = forward
+
+    def _constrain(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.mesh is None:
+            return x
+        from ..parallel.mesh import strip_manual_axes
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, strip_manual_axes(
+                DP_AXES, None, None, None)))
+
+    def loss(self, params: Any, batch: Any) -> jnp.ndarray:
+        """Softmax cross entropy; ``batch = {"images", "labels"}`` (or
+        ``{"input_ids", "labels"}`` aliasing images for engine compat)."""
+        images = batch.get("images", batch.get("input_ids"))
+        labels = batch["labels"]
+        logits = self.forward(params, images)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, labels[:, None], axis=-1)[:, 0])

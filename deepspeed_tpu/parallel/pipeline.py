@@ -36,10 +36,124 @@ def pipeline_spec(n_dims_map: Any) -> Any:
         lambda nd: P(*((AXIS_PIPE,) + (None,) * (int(nd) - 1))), n_dims_map)
 
 
+def pipeline_bubble_fraction(n_micro: int, pp: int,
+                             virtual_stages: int = 1) -> float:
+    """Idle fraction of the schedule (fill+drain over total ticks).
+
+    GPipe: (pp-1)/(M+pp-1).  Interleaved (virtual_stages=v): each tick is a
+    1/v-stage chunk, so the same (pp-1)-tick fill/drain costs v× less —
+    (pp-1)/(vM+pp-1) (Megatron interleaved-1F1B bubble math; here realized
+    by the circulating-ring schedule below).
+    """
+    v, M = int(virtual_stages), int(n_micro)
+    total = v * M + pp - 1
+    return (pp - 1) / total if total > 0 else 0.0
+
+
+def _interleaved_apply(layer_fn, stacked_params, microbatches, mesh,
+                       virtual_stages: int):
+    """Interleaved pipeline: rank r owns layer chunks {r, r+pp, …} (v of
+    them); one activation per rank circulates the ``pipe`` ring, each tick
+    applying the chunk its position indexes, so fill/drain bubbles shrink
+    by v (chunk = 1/v stage).  Rank 0 retires finished activations
+    (position == v·pp) and injects waiting microbatches into empty slots;
+    jax.grad differentiates the whole ring (SendGrad = ppermute cotangent).
+    """
+    pp = int(mesh.shape[AXIS_PIPE])
+    v = int(virtual_stages)
+    tmap = jax.tree.map
+    M = jax.tree.leaves(microbatches)[0].shape[0]
+    n_chunks = v * pp
+    # scan ticks: bursts of pp injections every v·pp ticks (ring circuit),
+    # +pp to drain the final burst; exact minimum when pp | M
+    T = v * pp * (-(-M // pp)) + pp
+
+    def chunked(p):
+        # [L, ...] → [n_chunks, L/n_chunks, ...], reordered so rank r's
+        # CONTIGUOUS shard [r·v, (r+1)·v) holds round-robin chunks
+        # {r, r+pp, …} (shard_map shards dim 0 contiguously).  This gather
+        # reshards ~half the param bytes over ICI each step (and its
+        # scatter transpose in backward); storing params pre-permuted in
+        # ring order would make it free but leaks the interleave layout
+        # into optimizer/checkpoint/import — deliberate correctness-first
+        # trade-off, revisit if profiling shows it on the critical path
+        L = p.shape[0]
+        c = p.reshape(n_chunks, L // n_chunks, *p.shape[1:])
+        order = jnp.asarray([j * pp + r for r in range(pp) for j in range(v)])
+        return c[order]
+
+    stacked_params = tmap(chunked, stacked_params)
+
+    def per_stage(params_local, xs):
+        stage = jax.lax.axis_index(AXIS_PIPE)
+        zero = tmap(lambda a: jnp.zeros_like(a[0]), xs)
+        outs0 = tmap(jnp.zeros_like, xs)
+
+        def apply_chunk(j, act):
+            cp = tmap(lambda p: jax.lax.dynamic_index_in_dim(
+                p, j, 0, keepdims=False), params_local)
+
+            def body(h, lp):
+                return layer_fn(lp, h), None
+
+            out, _ = jax.lax.scan(body, act, cp)
+            return out
+
+        def tick(carry, _):
+            act, pos, mb, next_mb, outs = carry
+            # -- rank 0: retire a full-circle activation, refill the slot
+            retired = (stage == 0) & (pos == n_chunks)
+            outs = tmap(
+                lambda acc, a: jnp.where(
+                    retired,
+                    jax.lax.dynamic_update_index_in_dim(
+                        acc, a, jnp.clip(mb, 0, M - 1), 0),
+                    acc),
+                outs, act)
+            empty = retired | (pos < 0)
+            inject = (stage == 0) & empty & (next_mb < M)
+            act = tmap(
+                lambda a, x: jnp.where(
+                    inject,
+                    jax.lax.dynamic_index_in_dim(
+                        x, jnp.clip(next_mb, 0, M - 1), 0, keepdims=False),
+                    a),
+                act, xs)
+            pos = jnp.where(inject, 0, jnp.where(retired, -1, pos))
+            mb = jnp.where(inject, next_mb, mb)
+            next_mb = next_mb + inject.astype(jnp.int32)
+            # -- every rank: apply the chunk this activation has reached
+            active = (pos >= 0) & (pos < n_chunks)
+            j = jnp.clip(pos // pp, 0, v - 1)
+            new_act = apply_chunk(j, act)
+            act = tmap(lambda n, a: jnp.where(active, n, a), new_act, act)
+            pos = jnp.where(active, pos + 1, pos)
+            # -- circulate (activation + its position/microbatch id)
+            ring = [(i, (i + 1) % pp) for i in range(pp)]
+            act = tmap(lambda a: jax.lax.ppermute(a, AXIS_PIPE, ring), act)
+            pos = jax.lax.ppermute(pos, AXIS_PIPE, ring)
+            mb = jax.lax.ppermute(mb, AXIS_PIPE, ring)
+            return (act, pos, mb, next_mb, outs), None
+
+        init = (zero, jnp.int32(-1), jnp.int32(0), jnp.int32(0), outs0)
+        (_, _, _, _, outs), _ = jax.lax.scan(tick, init, None, length=T)
+        outs = tmap(lambda o: jax.lax.psum(
+            jnp.where(stage == 0, o, jnp.zeros_like(o)), AXIS_PIPE), outs)
+        return outs
+
+    in_specs = (pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)),
+                jax.tree.map(lambda _: P(), microbatches))
+    return jax.shard_map(per_stage, mesh=mesh,
+                         in_specs=in_specs,
+                         out_specs=jax.tree.map(lambda _: P(), microbatches),
+                         check_vma=False,
+                         axis_names={AXIS_PIPE})(stacked_params, microbatches)
+
+
 def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stacked_params: Any,
                    microbatches: jnp.ndarray,
-                   mesh: Mesh) -> Any:
+                   mesh: Mesh, virtual_stages: int = 1) -> Any:
     """Run ``microbatches [M, b, ...]`` through the stage pipeline.
 
     ``layer_fn(layer_params, x) -> x`` applies ONE layer (leaf shapes =
@@ -61,6 +175,14 @@ def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             return out
 
         return jax.lax.map(scan_all, microbatches)
+    if int(virtual_stages) > 1:
+        L = jax.tree.leaves(stacked_params)[0].shape[0]
+        if L % (pp * int(virtual_stages)):
+            raise ValueError(
+                f"num_layers {L} not divisible by pp*virtual_stages "
+                f"{pp}*{virtual_stages}")
+        return _interleaved_apply(layer_fn, stacked_params, microbatches,
+                                  mesh, int(virtual_stages))
 
     M = jax.tree.leaves(microbatches)[0].shape[0]
     T = M + pp - 1  # fill + steady + drain ticks

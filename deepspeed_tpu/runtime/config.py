@@ -226,6 +226,20 @@ class DataEfficiencyConfig(DeepSpeedConfigModel):
     data_routing: Dict[str, Any] = Field(default_factory=dict)
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """Reference ``hybrid_engine`` group (``runtime/hybrid_engine.py`` [K]):
+    one engine flipping between ZeRO-3 training and inference generate for
+    RLHF.  TP size / cache-release knobs kept for config parity; on TPU the
+    flip is free (same sharded arrays serve both programs)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class CompileConfig(DeepSpeedConfigModel):
     """torch.compile interop group — on TPU everything is compiled; kept so
     configs round-trip and so `deepcompile` flags are visible."""
@@ -302,6 +316,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     compile: CompileConfig = Field(default_factory=CompileConfig)
     compression_training: Dict[str, Any] = Field(default_factory=dict)
     curriculum_learning: Dict[str, Any] = Field(default_factory=dict)

@@ -1,0 +1,20 @@
+"""Data-efficiency pipeline — curriculum learning + random-LTD.
+
+Reference: ``deepspeed/runtime/data_pipeline/`` [K] (SURVEY §2.1 row
+"Data efficiency"): ``data_sampling/data_sampler.py`` (difficulty-ordered
+curriculum sampling), ``curriculum_scheduler.py`` (difficulty schedules),
+``data_routing/`` (random layerwise token dropping, csrc/random_ltd
+gather/scatter kernels).
+
+TPU adaptations: the gather/scatter kernels are ``jnp.take``/segment
+scatter (XLA handles them, SURVEY §2.2 "Random-LTD" row); schedules snap
+to power-of-two-ish buckets so changing curriculum state reuses a small
+set of compiled programs instead of recompiling every step.
+"""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import CurriculumSampler, DeepSpeedDataSampler
+from .random_ltd import RandomLTDScheduler, random_ltd_apply
+
+__all__ = ["CurriculumScheduler", "CurriculumSampler",
+           "DeepSpeedDataSampler", "RandomLTDScheduler", "random_ltd_apply"]

@@ -1,0 +1,96 @@
+"""Curriculum data sampling.
+
+Reference: ``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py``
+[K] — ``DeepSpeedDataSampler``: difficulty-metric-indexed sampling where
+only samples whose difficulty ≤ the scheduler's current value are eligible,
+with deterministic shuffling per epoch.  The index-from-metric-files
+machinery (MapReduce over tokenized datasets) collapses to "caller supplies
+a difficulty value per sample" — the analysis tooling is out of scope, the
+*training-time* behavior is the parity surface.
+
+Two curriculum modes, both reference behaviors:
+
+* **sample pools** (``CurriculumSampler``): eligible-sample pool grows with
+  difficulty (e.g. vocabulary rarity, external difficulty scores);
+* **sequence truncation** (``truncate_batch``): the classic seqlen
+  curriculum — batches truncated to the scheduled length (difficulty IS
+  the sequence length, reference ``curriculum_learning`` legacy mode).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class CurriculumSampler:
+    """Yield sample indices whose difficulty ≤ current schedule value."""
+
+    def __init__(self, difficulties: Sequence[float],
+                 scheduler: CurriculumScheduler, seed: int = 1234):
+        self.difficulties = np.asarray(difficulties)
+        self.order = np.argsort(self.difficulties, kind="stable")
+        self.sorted_difficulties = self.difficulties[self.order]
+        self.scheduler = scheduler
+        self.seed = seed
+
+    def eligible_count(self, step: int) -> int:
+        d = self.scheduler.get_difficulty(step)
+        return int(np.searchsorted(self.sorted_difficulties, d, side="right"))
+
+    def sample(self, step: int, batch_size: int) -> np.ndarray:
+        """Batch of indices drawn uniformly from the eligible pool
+        (deterministic in (seed, step))."""
+        n = self.eligible_count(step)
+        if n == 0:
+            raise ValueError("no samples eligible at current difficulty "
+                             f"{self.scheduler.get_difficulty(step)}")
+        rng = np.random.default_rng((self.seed, step))
+        return self.order[rng.integers(0, n, size=batch_size)]
+
+
+class DeepSpeedDataSampler:
+    """Reference-named iterator facade: wraps a dataset + difficulty metric
+    into an infinite curriculum batch stream."""
+
+    def __init__(self, dataset: Any, difficulties: Sequence[float],
+                 batch_size: int, curriculum_config: Dict[str, Any],
+                 seed: int = 1234):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.scheduler = CurriculumScheduler(curriculum_config)
+        self.sampler = CurriculumSampler(difficulties, self.scheduler, seed)
+        self.global_step = 0
+
+    def set_step(self, step: int) -> None:
+        self.global_step = int(step)
+        self.scheduler.update_difficulty(step)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        idx = self.sampler.sample(self.global_step, self.batch_size)
+        self.global_step += 1
+        batch = [self.dataset[int(i)] for i in idx]
+        if isinstance(batch[0], dict):
+            return {k: np.stack([b[k] for b in batch]) for k in batch[0]}
+        return np.stack(batch)
+
+
+def truncate_batch(batch: Dict[str, Any], seqlen: int,
+                   keys: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+    """Seqlen-curriculum batch post-processor: truncate sequence-shaped
+    entries to ``seqlen`` (reference legacy ``curriculum_learning`` applies
+    exactly this to input_ids/attention_mask/labels)."""
+    keys = keys or ("input_ids", "attention_mask", "labels",
+                    "token_type_ids")
+    out = dict(batch)
+    for k in keys:
+        v = out.get(k)
+        if v is not None and getattr(v, "ndim", 0) >= 2:
+            out[k] = v[:, :seqlen]
+    return out

@@ -1,0 +1,80 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``deepspeed/runtime/data_pipeline/data_routing/`` +
+``csrc/random_ltd/`` [K] (arXiv 2211.11586 [P]): during training, middle
+layers process a random SUBSET of tokens; dropped tokens bypass the layer
+unchanged.  The kept-token count follows a schedule from
+``random_ltd_schedule.min_value`` up to the full sequence.
+
+TPU-first: the reference needs gather/scatter CUDA kernels; under XLA the
+same data movement is ``jnp.take_along_axis`` + scatter-add, fused into
+the surrounding program (SURVEY §2.2 "Random-LTD" row: "no kernel
+needed").  The kept count is static per compiled program; the scheduler
+quantizes it (``difficulty_step``) so a whole training run touches only a
+handful of program shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+def random_ltd_apply(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                     x: jnp.ndarray, keep: int, rng: jax.Array
+                     ) -> jnp.ndarray:
+    """Run ``layer_fn`` on ``keep`` randomly-selected tokens of
+    ``x [B, S, H]``; other tokens pass through unchanged.
+
+    ``keep`` must be a static Python int (it sets the compiled shape).
+    Selection is without replacement, per batch row, order-preserving —
+    the reference's sorted-gather semantics, so RoPE/position handling
+    inside ``layer_fn`` sees monotone positions.
+    """
+    B, S, H = x.shape
+    keep = int(keep)
+    if keep >= S:
+        return layer_fn(x)
+    # per-row random permutation → first `keep` sorted = uniform subset
+    scores = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(scores, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)  # order-preserving gather
+    sub = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [B, keep, H]
+    out_sub = layer_fn(sub)
+    # scatter processed tokens back over the identity residual
+    return x.at[jnp.arange(B)[:, None], idx].set(out_sub)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference ``random_ltd_schedule`` schema:
+    ``{min_value, max_value, schedule_type: fixed_linear,
+    schedule_config: {require_steps, seq_per_step}}``)."""
+
+    def __init__(self, config: Dict[str, Any], seq_len: int):
+        sched = dict(config.get("random_ltd_schedule", {}))
+        self.seq_len = int(seq_len)
+        cfg = {
+            "min_difficulty": int(sched.get("min_value", seq_len // 2)),
+            "max_difficulty": int(sched.get("max_value", seq_len)),
+            "schedule_type": sched.get("schedule_type", "fixed_linear"),
+            "schedule_config": {
+                "total_curriculum_step":
+                    int(sched.get("schedule_config", {}).get(
+                        "require_steps", 1000)),
+                "difficulty_step":
+                    int(sched.get("schedule_config", {}).get(
+                        "seq_per_step", 16)),
+            },
+        }
+        self.scheduler = CurriculumScheduler(cfg)
+        self.layer_ids = list(config.get("random_ltd_layer_id", []))
+
+    def keep_count(self, step: int) -> int:
+        return min(self.scheduler.get_difficulty(step), self.seq_len)
+
+    def applies_to(self, layer_id: int) -> bool:
+        return not self.layer_ids or layer_id in self.layer_ids

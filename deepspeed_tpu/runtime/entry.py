@@ -122,6 +122,12 @@ def initialize(args: Any = None,
     if monitor.enabled:
         engine.monitor = monitor
 
+    if cfg.hybrid_engine.enabled:
+        from .hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(
+            engine, max_out_tokens=cfg.hybrid_engine.max_out_tokens)
+
     dataloader = None
     if training_data is not None:
         from .dataloader import DeepSpeedDataLoader

@@ -1,0 +1,132 @@
+"""Hybrid engine — one engine for RLHF's train ↔ generate flip.
+
+Reference: ``deepspeed/runtime/hybrid_engine.py`` [K] —
+``DeepSpeedHybridEngine(DeepSpeedEngine)``: trains under ZeRO-3, then for
+the RLHF experience-generation phase gathers the sharded params and runs
+kernel-injected inference, flipping back without reloading weights
+(SURVEY §2.1 "Hybrid engine (RLHF)" row).
+
+TPU-first collapse: the reference's flip machinery exists because torch
+inference kernels need contiguous full weights while ZeRO-3 holds shards.
+Under GSPMD both the train step AND the generate programs consume the SAME
+sharded param pytree — the "flip" is just dispatching a different compiled
+program against ``engine.state.params``.  What remains worth building is
+exactly this class: the shared-weights lifecycle (generate always sees the
+latest optimizer step, no copy), the jitted prefill/decode reuse across
+flips, and the generate-throughput metrics the reference logs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine:
+    """Wraps a training engine with a weight-sharing generate path.
+
+    Train API passes through (``train_step``/``backward``/``step``/…);
+    ``generate`` runs the model's prefill/decode programs against the
+    engine's CURRENT params — after any ``train_step``, generation uses the
+    updated weights with zero copies or re-init.
+    """
+
+    def __init__(self, engine: Any, max_out_tokens: int = 512):
+        if not callable(getattr(engine.module, "prefill", None)):
+            raise TypeError(
+                "hybrid engine needs a model with prefill/decode_step "
+                f"(got {type(engine.module)})")
+        self.engine = engine
+        self.module = engine.module
+        self.max_out_tokens = int(max_out_tokens)
+        self._prefill = jax.jit(self.module.prefill)
+        self._decode = jax.jit(self.module.decode_step)
+        self._gen_tokens = 0
+        self._gen_time = 0.0
+        self._train_time = 0.0
+
+    # -- train passthrough -------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        # anything not defined here is the training engine's surface
+        return getattr(self.engine, name)
+
+    def train_step(self, batch) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        out = self.engine.train_step(batch)
+        self._train_time += time.perf_counter() - t0
+        return out
+
+    # -- generate phase ----------------------------------------------------
+
+    def generate(self, input_ids: Any, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id: Optional[int] = None) -> jnp.ndarray:
+        """Greedy/sampled generation with the training engine's live params
+        (reference ``DeepSpeedHybridEngine.generate``)."""
+        max_new = int(max_new_tokens or self.max_out_tokens)
+        input_ids = jnp.asarray(input_ids)
+        B, S = input_ids.shape
+        params = self.engine.state.params  # ZeRO-sharded, latest step
+        t0 = time.perf_counter()
+        cache = self.module.init_cache(B, S + max_new)
+        logits, cache = self._prefill(params, input_ids, cache)
+        rng = jax.random.PRNGKey(seed)
+        out: List[jnp.ndarray] = [input_ids]
+        last = None
+        done = jnp.zeros((B,), bool)
+        produced = 0  # actually-decoded tokens (eos padding excluded)
+        for i in range(max_new):
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                scaled = logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                tok = jax.random.categorical(sub, scaled).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if eos_token_id is not None and last is not None:
+                tok = jnp.where(done, last, tok)
+            produced += int(B - jnp.sum(done))
+            out.append(tok[:, None])
+            last = tok
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+                if bool(jnp.all(done)):
+                    pad = jnp.tile(tok[:, None], (1, max_new - i - 1))
+                    out.append(pad)
+                    break
+            if i < max_new - 1:
+                logits, cache = self._decode(params, cache, tok)
+        result = jnp.concatenate(out, axis=1)
+        self._gen_time += time.perf_counter() - t0
+        self._gen_tokens += produced
+        return result
+
+    # -- reference surface shims -------------------------------------------
+
+    def eval(self):
+        self.engine.eval()
+        return self
+
+    def train(self, mode: bool = True):
+        self.engine.train(mode)
+        return self
+
+    def release_inference_cache(self) -> None:
+        """Reference API: drop inference buffers between phases.  Caches
+        here are per-call locals, so this only clears the jit caches."""
+        self._prefill = jax.jit(self.module.prefill)
+        self._decode = jax.jit(self.module.decode_step)
+
+    def print_latency_log(self) -> None:
+        tps = self._gen_tokens / self._gen_time if self._gen_time else 0.0
+        log_dist(f"hybrid engine: generated {self._gen_tokens} tokens "
+                 f"({tps:.1f} tok/s), train time {self._train_time:.2f}s, "
+                 f"generate time {self._gen_time:.2f}s")

@@ -59,6 +59,59 @@ def test_pipeline_gradients_match_sequential():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("pp,M,v", [(2, 4, 2), (2, 3, 2), (4, 4, 2),
+                                    (2, 8, 4)])
+def test_interleaved_forward_matches_sequential(pp, M, v):
+    """Virtual-stage (interleaved) schedule is numerics-identical; only the
+    bubble shrinks."""
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=pp))
+    params = make_params(L=8)
+    micro = jnp.asarray(np.random.RandomState(4).randn(M, 2, 8), jnp.float32)
+    out = jax.jit(lambda p, x: pipeline_apply(layer_fn, p, x, mesh,
+                                              virtual_stages=v))(params, micro)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref_apply(params, micro)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_gradients_match_sequential():
+    pp, M, v = 2, 4, 2
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=pp))
+    params = make_params(L=8)
+    micro = jnp.asarray(np.random.RandomState(5).randn(M, 2, 8), jnp.float32)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(layer_fn, p, micro, mesh,
+                                      virtual_stages=v) ** 2)
+
+    def loss_ref(p):
+        return jnp.sum(ref_apply(p, micro) ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_interleave_requires_divisible_layers():
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=2))
+    params = make_params(L=6)  # 6 not divisible by pp*v = 8
+    micro = jnp.ones((2, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_apply(layer_fn, params, micro, mesh, virtual_stages=4)
+
+
+def test_bubble_fraction_shrinks_with_interleave():
+    from deepspeed_tpu.parallel.pipeline import pipeline_bubble_fraction
+
+    gpipe = pipeline_bubble_fraction(8, 4, 1)
+    inter = pipeline_bubble_fraction(8, 4, 4)
+    assert inter < gpipe
+    assert abs(gpipe - 3 / 11) < 1e-9
+    assert abs(inter - 3 / 35) < 1e-9
+
+
 def test_pipeline_composes_with_dp():
     """pipe × data hybrid: batch sharded over data, layers over pipe."""
     mesh = groups.initialize_mesh(MeshLayout.infer(8, pp=2, dp=4))

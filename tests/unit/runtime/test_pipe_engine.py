@@ -1,0 +1,121 @@
+"""PipelineEngine + tied-layer gradients.
+
+Reference anchor: ``deepspeed/runtime/pipe/engine.py`` tied-weight grad
+all-reduce across owning stages [K].  Here tied layers share ONE param
+leaf, so autodiff SUMS the use-site cotangents — the same reduction,
+verified against a hand-built two-use-site model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import MeshLayout
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+from deepspeed_tpu.utils import groups
+
+
+def _tied_module(H=8, V=16):
+    """embed → tanh mid-layer → unembed with the SAME weight (tied)."""
+
+    def embed_init(rng):
+        return {"w": jax.random.normal(rng, (V, H)) * 0.1}
+
+    def embed_apply(p, x):      # x: [B] int ids → [B, H]
+        return jnp.take(p["w"], x, axis=0)
+
+    def mid_init(rng):
+        return {"m": jax.random.normal(rng, (H, H)) * 0.5}
+
+    def mid_apply(p, x):
+        return jnp.tanh(x @ p["m"])
+
+    def unembed_apply(p, x):    # reuses the tied embedding: [B, H] → [B, V]
+        return x @ p["w"].T
+
+    def loss_fn(logits, y):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    return PipelineModule(
+        layers=[
+            TiedLayerSpec(init_fn=embed_init, apply_fn=embed_apply,
+                          key="embed", name="embed"),
+            LayerSpec(init_fn=mid_init, apply_fn=mid_apply, name="mid"),
+            TiedLayerSpec(init_fn=embed_init, apply_fn=unembed_apply,
+                          key="embed", name="unembed"),
+        ],
+        num_stages=2, loss_fn=loss_fn)
+
+
+def _engine(module):
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=module, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "SGD", "params": {"lr": 0.1}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 0})
+    return engine
+
+
+def test_tied_layer_single_leaf():
+    """Tie groups materialize exactly one param leaf per key."""
+    engine = _engine(_tied_module())
+    assert list(engine.state.params["tied"].keys()) == ["embed"]
+    # 3 specs but only 2 leaf groups: 1 tied + 1 regular
+    assert len(engine.state.params["layers"]) == 1
+
+
+def test_tied_gradient_is_sum_of_use_sites():
+    """d(loss)/d(tied) == embed-site grad + unembed-site grad (the
+    reference's cross-stage tied allreduce)."""
+    module = _tied_module()
+    engine = _engine(module)
+    p = jax.device_get(engine.state.params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 16, size=(8,)))
+    y = jnp.asarray(rng.randint(0, 16, size=(8,)))
+
+    def loss_tied(tied_w, mid):
+        h = jnp.take(tied_w, x, axis=0)
+        h = jnp.tanh(h @ mid)
+        logits = h @ tied_w.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    def loss_split(w_embed, w_unembed, mid):
+        h = jnp.take(w_embed, x, axis=0)
+        h = jnp.tanh(h @ mid)
+        logits = h @ w_unembed.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    tied_w = p["tied"]["embed"]["w"]
+    mid = p["layers"]["1"]["m"]
+    g_tied = jax.grad(loss_tied)(tied_w, mid)
+    g_embed = jax.grad(loss_split, argnums=0)(tied_w, tied_w, mid)
+    g_unembed = jax.grad(loss_split, argnums=1)(tied_w, tied_w, mid)
+    np.testing.assert_allclose(np.asarray(g_tied),
+                               np.asarray(g_embed + g_unembed),
+                               rtol=1e-5, atol=1e-6)
+
+    # and the engine's own grad path agrees
+    loss_fn = engine.loss_fn
+    g_engine = jax.grad(lambda pp: loss_fn(pp, (x, y)))(p)
+    np.testing.assert_allclose(np.asarray(g_engine["tied"]["embed"]["w"]),
+                               np.asarray(g_tied), rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_engine_train_batch_converges():
+    module = _tied_module()
+    engine = _engine(module)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randint(0, 16, size=(8,)))
+    batch = (x, x)  # learn identity mapping
+    first = float(engine.train_batch(batch=batch))
+    for _ in range(20):
+        last = float(engine.train_batch(batch=batch))
+    assert last < first
