@@ -1,0 +1,15 @@
+"""Accelerator abstraction — the reference's L0 extension point.
+
+Reference: ``deepspeed/accelerator/`` [K] (SURVEY §1 L0):
+``abstract_accelerator.py:DeepSpeedAccelerator`` (~90 abstract methods) +
+``real_accelerator.py:get_accelerator()`` auto-detecting singleton with the
+``DS_ACCELERATOR`` env override.  The north star names a ``tpu`` accelerator
+as the sanctioned extension path [D BASELINE.json].
+"""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+__all__ = ["DeepSpeedAccelerator", "get_accelerator", "set_accelerator",
+           "TPU_Accelerator", "CPU_Accelerator"]

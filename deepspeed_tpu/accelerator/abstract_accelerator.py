@@ -1,0 +1,128 @@
+"""Abstract accelerator interface.
+
+Reference: ``deepspeed/accelerator/abstract_accelerator.py`` [K] — the
+subset of its ~90 methods that the TPU runtime actually dispatches
+through.  Methods the reference needs only for CUDA stream/event
+micromanagement collapse to no-ops under XLA's async dispatch model and
+are still present so accelerator-generic caller code ports unchanged.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "none"
+
+    # -- identity ----------------------------------------------------------
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        return (self._name if device_index is None
+                else f"{self._name}:{device_index}")
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def current_device(self) -> int: ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def is_available(self) -> bool: ...
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    # -- capabilities ------------------------------------------------------
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> list:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16]
+
+    # -- device handles / execution ---------------------------------------
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None) -> Any: ...
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Drain outstanding work (XLA: block on a trivial computation)."""
+        import jax
+        import jax.numpy as jnp
+
+        jnp.zeros(()).block_until_ready()
+        jax.effects_barrier()
+
+    # streams/events: XLA schedules async itself; kept as no-op objects
+    class _NullStream:
+        def __enter__(self):  # pragma: no cover - trivial
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def synchronize(self):
+            pass
+
+    def Stream(self, *a, **k):
+        return self._NullStream()
+
+    def stream(self, s):
+        return self._NullStream()
+
+    def current_stream(self, device_index=None):
+        return self._NullStream()
+
+    def default_stream(self, device_index=None):
+        return self._NullStream()
+
+    # -- memory ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict: ...
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(
+            device_index)
+
+    def empty_cache(self) -> None:
+        pass
+
+    def pin_memory(self, tensor: Any, align_bytes: int = 1) -> Any:
+        return tensor  # host numpy is already DMA-able through dlpack
+
+    # -- RNG ---------------------------------------------------------------
+
+    def manual_seed(self, seed: int) -> None:
+        self._seed = int(seed)
+
+    def initial_seed(self) -> int:
+        return getattr(self, "_seed", 0)
+
+    # -- op builders -------------------------------------------------------
+
+    def create_op_builder(self, class_name: str) -> Any:
+        builder = self.get_op_builder(class_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, class_name: str) -> Any:
+        from ..ops.op_builder.builder import get_op_builder
+
+        return get_op_builder(class_name)

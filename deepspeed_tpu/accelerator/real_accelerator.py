@@ -1,0 +1,45 @@
+"""get_accelerator() — auto-detecting singleton with env override.
+
+Reference: ``deepspeed/accelerator/real_accelerator.py`` [K]:
+``get_accelerator()`` probes hardware once and caches; ``DS_ACCELERATOR``
+env forces a backend; ``set_accelerator()`` installs a custom one (the
+sanctioned extension path the north star names for new hardware).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is not None:
+        return _ACCELERATOR
+    from .tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+    forced = os.environ.get("DS_ACCELERATOR", "").lower()
+    if forced == "cpu":
+        _ACCELERATOR = CPU_Accelerator()
+    elif forced == "tpu":
+        _ACCELERATOR = TPU_Accelerator()
+    elif forced:
+        raise ValueError(f"DS_ACCELERATOR={forced!r} is not a known "
+                         "accelerator (tpu, cpu)")
+    else:
+        tpu = TPU_Accelerator()
+        _ACCELERATOR = tpu if tpu.is_available() else CPU_Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator()._name in ("tpu", "cpu")

@@ -1,0 +1,77 @@
+"""TPU (and CPU-fallback) accelerator implementations.
+
+Reference roles: ``deepspeed/accelerator/cuda_accelerator.py`` /
+``cpu_accelerator.py`` [K].  The TPU class answers through jax/libtpu;
+the CPU class serves the virtual-mesh test environment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    _name = "tpu"
+    #: collectives ride XLA over ICI/DCN — the backend jax.distributed sets up
+    _communication_backend_name = "xla"
+
+    def _devices(self):
+        return [d for d in jax.devices() if d.platform == "tpu"]
+
+    def is_available(self) -> bool:
+        try:
+            return len(self._devices()) > 0
+        except Exception:
+            return False
+
+    def current_device(self) -> int:
+        return 0  # one process drives all local chips under jax
+
+    def device_count(self) -> int:
+        return len(self._devices())
+
+    def device(self, device_index: Optional[int] = None) -> Any:
+        return self._devices()[device_index or 0]
+
+    def memory_stats(self, device_index: Optional[int] = None) -> dict:
+        try:
+            return dict(self.device(device_index).memory_stats() or {})
+        except Exception:
+            return {}
+
+    def device_kind(self) -> str:
+        return self.device().device_kind
+
+    def on_accelerator(self, tensor: Any) -> bool:
+        sharding = getattr(tensor, "sharding", None)
+        if sharding is None:
+            return False
+        return any(d.platform == "tpu" for d in sharding.device_set)
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+    _name = "cpu"
+    _communication_backend_name = "gloo"  # reference name for the CPU path
+
+    def is_available(self) -> bool:
+        return True
+
+    def current_device(self) -> int:
+        return 0
+
+    def device_count(self) -> int:
+        return len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+
+    def device(self, device_index: Optional[int] = None) -> Any:
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        return cpus[device_index or 0] if cpus else jax.devices()[0]
+
+    def memory_stats(self, device_index: Optional[int] = None) -> dict:
+        return {}
+
+    def on_accelerator(self, tensor: Any) -> bool:
+        return True

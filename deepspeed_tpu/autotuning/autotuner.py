@@ -27,6 +27,34 @@ DEFAULT_TUNING_SPACE = {
     "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
 }
 
+#: reference's offload dimension (enabled by passing this as tuning_space
+#: or merging it in; kept out of the default so fast tunes stay fast)
+OFFLOAD_TUNING_SPACE = {
+    **DEFAULT_TUNING_SPACE,
+    "zero_optimization.offload_optimizer.device": ["none", "cpu"],
+}
+
+
+def zero_memory_estimate(n_params: int, stage: int, dp: int,
+                         offload_optimizer: bool = False,
+                         dtype_bytes: int = 2) -> int:
+    """Device bytes/chip for model+optimizer state under a ZeRO stage —
+    the reference ModelBasedTuner's memory model (params bf16 2N, grads
+    2N, fp32 master+Adam moments 12N, sharded per stage; activations not
+    included — the XLA OOM check catches those)."""
+    params = dtype_bytes * n_params
+    grads = dtype_bytes * n_params
+    opt = 12 * n_params  # fp32 master + m + v
+    if offload_optimizer:
+        opt = 0
+    if stage >= 1:
+        opt //= dp
+    if stage >= 2:
+        grads //= dp
+    if stage >= 3:
+        params //= dp
+    return params + grads + opt
+
 
 class Autotuner:
     def __init__(self, engine_factory: Callable[[Dict[str, Any]], Any],
@@ -34,10 +62,16 @@ class Autotuner:
                  base_config: Dict[str, Any],
                  tuning_space: Optional[Dict[str, List[Any]]] = None,
                  metric: str = "throughput", warmup_steps: int = 1,
-                 timed_steps: int = 3):
+                 timed_steps: int = 3, model_params_count: int = 0,
+                 hbm_bytes: int = 0, dp_size: int = 1):
         """``engine_factory(config_dict) -> engine`` builds a fresh engine;
         ``batch_factory(config_dict) -> batch`` supplies a matching global
-        batch.  Factories own model/params so the tuner stays generic."""
+        batch.  Factories own model/params so the tuner stays generic.
+
+        ``model_params_count`` + ``hbm_bytes`` (both optional) switch on
+        the memory model: candidates whose estimated state footprint
+        exceeds HBM are pruned WITHOUT compiling them (the reference
+        ModelBasedTuner's OOM pre-screen); 0 for either disables it."""
         self.engine_factory = engine_factory
         self.batch_factory = batch_factory
         self.base_config = base_config
@@ -45,6 +79,9 @@ class Autotuner:
         self.metric = metric
         self.warmup_steps = warmup_steps
         self.timed_steps = timed_steps
+        self.model_params_count = int(model_params_count)
+        self.hbm_bytes = int(hbm_bytes)
+        self.dp_size = max(int(dp_size), 1)
         self.records: List[Dict[str, Any]] = []
 
     def _apply(self, cfg: Dict[str, Any], dotted: str, value: Any) -> None:
@@ -62,17 +99,40 @@ class Autotuner:
                 self._apply(cfg, k, v)
             yield dict(zip(keys, combo)), cfg
 
+    def _memory_prune(self, combo: Dict[str, Any]) -> bool:
+        """True → skip without compiling (estimated state exceeds HBM)."""
+        if not (self.model_params_count and self.hbm_bytes):
+            return False
+        base_zero = self.base_config.get("zero_optimization", {})
+        stage = int(combo.get("zero_optimization.stage",
+                              base_zero.get("stage", 0)))
+        base_off = base_zero.get("offload_optimizer", {}).get("device",
+                                                              "none")
+        offload = str(combo.get(
+            "zero_optimization.offload_optimizer.device", base_off)) == "cpu"
+        est = zero_memory_estimate(self.model_params_count, stage,
+                                   self.dp_size, offload)
+        return est > self.hbm_bytes
+
     def _measure(self, cfg: Dict[str, Any]) -> Optional[float]:
         try:
             engine = self.engine_factory(cfg)
             batch = self.batch_factory(cfg)
+
+            def sync(metrics):
+                # scalar fetch = real fence (block_until_ready is a no-op
+                # on tunneled platforms)
+                return float(metrics["loss"])
+
+            m = None
             for _ in range(self.warmup_steps):
-                engine.train_step(batch)
-            jax.block_until_ready(engine.state.params)
+                m = engine.train_step(batch)
+            if m is not None:  # warmup_steps=0 is legal
+                sync(m)
             t0 = time.perf_counter()
             for _ in range(self.timed_steps):
-                engine.train_step(batch)
-            jax.block_until_ready(engine.state.params)
+                m = engine.train_step(batch)
+            sync(m)
             dt = (time.perf_counter() - t0) / self.timed_steps
             samples = int(engine.train_batch_size or 1)
             return samples / dt
@@ -83,6 +143,11 @@ class Autotuner:
     def tune(self) -> Dict[str, Any]:
         best, best_rate = None, -1.0
         for combo, cfg in self._candidates():
+            if self._memory_prune(combo):
+                self.records.append({"combo": combo, "throughput": None,
+                                     "pruned": "memory_model"})
+                log_dist(f"autotuning {combo} -> PRUNED (memory model)")
+                continue
             rate = self._measure(cfg)
             rec = {"combo": combo, "throughput": rate}
             self.records.append(rec)

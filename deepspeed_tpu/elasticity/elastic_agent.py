@@ -1,0 +1,110 @@
+"""Elastic agent v2 — restart/rendezvous supervision.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py:DSElasticAgent`` [K]
+(SURVEY §5.3): subclasses torch-elastic's agent — rendezvous store, worker
+monitoring, restart on membership change or failure, each restart
+re-initializing the process group and resuming from checkpoint.
+
+TPU mapping (SURVEY §5.3's plan): the rendezvous/process-group piece is
+``jax.distributed.initialize`` driven by coordinator env vars, and "resume
+at a different world size" is the checkpoint reshard-on-load the runtime
+already provides (orbax restores into whatever mesh the restarted world
+builds).  What the agent owns is the supervision loop: run the training
+function, catch worker failure, tear down the distributed client,
+re-rendezvous (env may now describe a different world), and relaunch from
+the latest checkpoint — up to ``max_restarts``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+from ..utils.logging import log_dist, logger
+
+
+class WorkerSpec:
+    """Reference-shaped description of the elastic worker."""
+
+    def __init__(self, fn: Callable[..., Any], args: tuple = (),
+                 max_restarts: int = 3, monitor_interval: float = 0.1,
+                 checkpoint_dir: Optional[str] = None):
+        self.fn = fn
+        self.args = args
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = float(monitor_interval)
+        self.checkpoint_dir = checkpoint_dir
+
+
+class DSElasticAgent:
+    """Supervise an elastic training function.
+
+    ``fn(restart_count, checkpoint_dir, *args)`` runs one training
+    attempt; raising marks the attempt failed.  Between attempts the agent
+    re-reads the coordinator env (COORDINATOR_ADDRESS / NUM_PROCESSES /
+    PROCESS_ID — the jax.distributed discovery the launcher sets) and
+    re-initializes the distributed client, so a changed membership simply
+    yields a different mesh on relaunch; state continuity comes from the
+    checkpoint dir (reshard-on-load handles the new layout).
+    """
+
+    def __init__(self, spec: WorkerSpec, start_method: str = "inproc"):
+        self.spec = spec
+        self.start_method = start_method
+        self.restart_count = 0
+        self.last_result: Any = None
+
+    # -- rendezvous --------------------------------------------------------
+
+    def _rendezvous(self) -> None:
+        """(Re-)join the jax.distributed world described by the env.
+        No-op when no coordinator is configured (single process)."""
+        import jax
+
+        coord = os.environ.get("COORDINATOR_ADDRESS")
+        if not coord:
+            return
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass  # not initialized yet
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PROCESS_ID", "0")))
+        log_dist(f"elastic rendezvous: world={os.environ.get('NUM_PROCESSES')}"
+                 f" process={os.environ.get('PROCESS_ID')}")
+
+    # -- supervision loop --------------------------------------------------
+
+    def run(self) -> Any:
+        spec = self.spec
+        while True:
+            try:
+                self._rendezvous()
+                self.last_result = spec.fn(self.restart_count,
+                                           spec.checkpoint_dir, *spec.args)
+                log_dist(f"elastic worker finished after "
+                         f"{self.restart_count} restart(s)")
+                return self.last_result
+            except Exception as e:  # worker failure → restart or give up
+                self.restart_count += 1
+                if self.restart_count > spec.max_restarts:
+                    logger.error(
+                        f"elastic agent: giving up after "
+                        f"{spec.max_restarts} restarts ({e!r})")
+                    raise
+                logger.warning(
+                    f"elastic agent: worker failed ({e!r}); restart "
+                    f"{self.restart_count}/{spec.max_restarts}")
+                time.sleep(spec.monitor_interval)
+
+
+def launch_elastic(fn: Callable[..., Any], args: tuple = (),
+                   max_restarts: int = 3,
+                   checkpoint_dir: Optional[str] = None) -> Any:
+    """Convenience wrapper (reference ``ds_elastic`` entry role)."""
+    spec = WorkerSpec(fn, args=args, max_restarts=max_restarts,
+                      checkpoint_dir=checkpoint_dir)
+    return DSElasticAgent(spec).run()

@@ -1,0 +1,19 @@
+"""Optimized linear + LoRA — ``deepspeed/linear/`` parity.
+
+Reference: ``deepspeed/linear/{optimized_linear,quantization,config}.py``
+[K] (SURVEY §2.5 "Optimized linear / LoRA"): ``OptimizedLinear`` shards a
+frozen (optionally fp6/fp8-quantized) base weight and trains low-rank
+LoRA adapters; ``LoRAConfig``/``QuantizationConfig`` carry the knobs.
+
+TPU-first: the module is a functional param-tree factory — base weights
+carry a ``tensor``-axis PartitionSpec like every other matmul weight,
+quantization is int8 + group scales stored as the leaf format (dequant
+fuses into the matmul), and freezing is an optax mask, not a module flag.
+"""
+
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import (LoRAOptimizedLinear, OptimizedLinear,
+                               lora_merge, lora_trainable_mask)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "OptimizedLinear",
+           "LoRAOptimizedLinear", "lora_trainable_mask", "lora_merge"]

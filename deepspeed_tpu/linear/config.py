@@ -1,0 +1,29 @@
+"""Config dataclasses for the linear subsystem (reference names [K])."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference ``deepspeed.linear.LoRAConfig`` [K]."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # shards over the 'tensor' axis when >1
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.lora_r
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """Reference ``deepspeed.linear.QuantizationConfig`` [K] — fp6/fp8
+    there; int8 group quantization here (the TPU-supported narrow format;
+    fp8 on TPU arrives with newer generations, gap documented)."""
+
+    q_bits: int = 8
+    group_size: int = 256
+    quantized_initialization: bool = True

@@ -1,0 +1,170 @@
+"""OptimizedLinear / LoRAOptimizedLinear — functional form.
+
+Reference: ``deepspeed/linear/optimized_linear.py`` [K]:
+``OptimizedLinear(input_dim, output_dim, lora_config, quantization_config)``
+returns a module whose base weight is sharded+frozen (optionally
+quantized) and whose LoRA adapters train.  Here the same capability is a
+param-tree factory + pure apply, composing with the engine like any model:
+
+    lin = LoRAOptimizedLinear(in, out, lora_config, quant_config)
+    params = lin.init(rng)             # {"base" or "base_q", "lora_a/b"}
+    y = lin.apply(params, x)
+    mask = lora_trainable_mask(params) # optax.masked freeze of the base
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from ..parallel.mesh import AXIS_TENSOR
+from .config import LoRAConfig, QuantizationConfig
+
+P = PartitionSpec
+
+
+# one int8 group-quantizer serves qwZ and the linear subsystem — a scale
+# or edge-case fix lands in both (runtime/zero/qwz.py owns the math)
+from ..runtime.zero.qwz import _dequant as _dq
+from ..runtime.zero.qwz import _quant as _q
+
+
+def _quantize(w: jnp.ndarray, group: int):
+    q, s = _q(w.astype(jnp.float32), group=group)
+    return q, s.astype(jnp.float32)
+
+
+def _dequantize(q: jnp.ndarray, scale: jnp.ndarray, group: int):
+    return _dq(q, scale, q.shape, group=group)
+
+
+class OptimizedLinear:
+    """Base linear with optional int8-quantized frozen weight."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 lora_config: Optional[LoRAConfig] = None,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 bias: bool = False, dtype: Any = jnp.bfloat16):
+        if lora_config is not None:
+            # reference behavior: lora_config upgrades to the LoRA class
+            self.__class__ = LoRAOptimizedLinear
+            LoRAOptimizedLinear.__init__(
+                self, input_dim, output_dim, lora_config,
+                quantization_config, bias=bias, dtype=dtype)
+            return
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.quant = quantization_config
+        self.bias = bias
+        self.dtype = dtype
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        w = (jax.random.normal(rng, (self.input_dim, self.output_dim),
+                               jnp.float32)
+             / np.sqrt(self.input_dim))
+        params: Dict[str, Any] = {}
+        if self.quant is not None and self.quant.quantized_initialization:
+            q, s = _quantize(w, self.quant.group_size)
+            params["base_q"], params["base_scale"] = q, s
+        else:
+            params["base"] = w
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def _base_weight(self, params: Dict[str, Any]) -> jnp.ndarray:
+        if "base_q" in params:
+            return _dequantize(params["base_q"], params["base_scale"],
+                               self.quant.group_size).astype(self.dtype)
+        return params["base"].astype(self.dtype)
+
+    def apply(self, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        y = x.astype(self.dtype) @ self._base_weight(params)
+        if "bias" in params:
+            y = y + params["bias"].astype(self.dtype)
+        return y
+
+    __call__ = apply
+
+    def param_specs(self) -> Dict[str, Any]:
+        specs: Dict[str, Any] = {}
+        if self.quant is not None and self.quant.quantized_initialization:
+            specs["base_q"] = P(None, AXIS_TENSOR)
+            specs["base_scale"] = P(None, None)
+        else:
+            specs["base"] = P(None, AXIS_TENSOR)
+        if self.bias:
+            specs["bias"] = P(AXIS_TENSOR)
+        return specs
+
+
+class LoRAOptimizedLinear(OptimizedLinear):
+    """Frozen (possibly quantized) base + trainable rank-r adapters."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 lora_config: Optional[LoRAConfig] = None,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 bias: bool = False, dtype: Any = jnp.bfloat16):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.lora = lora_config or LoRAConfig()
+        self.quant = quantization_config
+        self.bias = bias
+        self.dtype = dtype
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        r1, r2 = jax.random.split(rng)
+        params = OptimizedLinear.init(self, r1)
+        r = self.lora.lora_r
+        # reference init: A ~ kaiming, B = 0 → adapter starts as identity
+        params["lora_a"] = (jax.random.normal(r2, (self.input_dim, r),
+                                              jnp.float32)
+                            / np.sqrt(self.input_dim))
+        params["lora_b"] = jnp.zeros((r, self.output_dim), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        base = jax.lax.stop_gradient(self._base_weight(params))  # frozen
+        y = x @ base
+        y = y + self.lora.scaling * (
+            (x @ params["lora_a"].astype(self.dtype))
+            @ params["lora_b"].astype(self.dtype))
+        if "bias" in params:
+            y = y + params["bias"].astype(self.dtype)
+        return y
+
+    __call__ = apply
+
+    def param_specs(self) -> Dict[str, Any]:
+        specs = OptimizedLinear.param_specs(self)
+        specs["lora_a"] = P(None, None)
+        specs["lora_b"] = P(None, AXIS_TENSOR)
+        return specs
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """True for LoRA leaves, False for base/quantized leaves — feed to
+    ``optax.masked`` so the optimizer updates adapters only (the
+    reference's requires_grad split)."""
+    def one(path, _):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return name.startswith("lora")
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def lora_merge(params: Dict[str, Any], lora_config: LoRAConfig,
+               group_size: int = 256) -> jnp.ndarray:
+    """Fold adapters into a dense weight (export/serving path)."""
+    if "base_q" in params:
+        base = _dequantize(params["base_q"], params["base_scale"],
+                           group_size)
+    else:
+        base = params["base"]
+    return base + lora_config.scaling * (params["lora_a"]
+                                         @ params["lora_b"])

@@ -93,4 +93,12 @@ _BUILDERS: Dict[str, Type[OpBuilder]] = {
 
 
 def get_op_builder(name: str) -> Optional[Type[OpBuilder]]:
-    return _BUILDERS.get(name)
+    """Lookup by op name ("cpu_adam") or reference class name
+    ("CPUAdamBuilder") — accelerator.get_op_builder uses the latter [K]."""
+    b = _BUILDERS.get(name)
+    if b is not None:
+        return b
+    for cls in _BUILDERS.values():
+        if cls.__name__ == name:
+            return cls
+    return None

@@ -1,0 +1,169 @@
+"""Block-sparse attention — sparsity configs + masked attention.
+
+Reference: ``deepspeed/ops/sparse_attention/`` + ``csrc/sparse_attention``
+[K] (SURVEY §2.2 "Sparse attention"): Triton block-sparse kernels driven
+by ``SparsityConfig`` subclasses (``Fixed``, ``BigBird``,
+``BSLongformer``, ``Variable``, ``Dense``) whose ``make_layout`` emits a
+[blocks, blocks] mask of which key blocks each query block touches.
+
+TPU-first: the LAYOUT is the portable artifact.  Compute here applies the
+block mask inside the standard fp32-softmax attention — XLA folds the
+mask into the fused softmax, and because whole masked blocks contribute
+-inf the compiler's dead-block elimination plus the mask'd softmax give
+correctness on any backend.  The bandwidth win at long S belongs to a
+Pallas splash-attention kernel consuming the same layout (the kernel
+skips masked blocks' DMA entirely); layout→kernel hookup is the later
+optimization, layout semantics are the parity surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparsityConfig:
+    """Base: dense layout (reference ``DenseSparsityConfig`` behavior)."""
+
+    def __init__(self, num_heads: int = 1, block: int = 16,
+                 different_layout_per_head: bool = False):
+        if different_layout_per_head:
+            raise NotImplementedError(
+                "per-head layouts are not implemented; all heads share one "
+                "layout (reference configs using this flag need porting)")
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def _blocks(self, seq_len: int) -> int:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} not divisible by block "
+                             f"{self.block}")
+        return seq_len // self.block
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._blocks(seq_len)
+        return np.ones((n, n), np.int32)
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Reference ``FixedSparsityConfig`` [K]: local windows of
+    ``num_local_blocks`` + every window's last ``num_global_blocks``
+    attended globally."""
+
+    def __init__(self, num_heads: int = 1, block: int = 16,
+                 num_local_blocks: int = 4, num_global_blocks: int = 1,
+                 attention: str = "bidirectional", **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._blocks(seq_len)
+        lay = np.zeros((n, n), np.int32)
+        for qb in range(n):
+            w0 = (qb // self.num_local_blocks) * self.num_local_blocks
+            lay[qb, w0:min(w0 + self.num_local_blocks, n)] = 1  # local window
+        # global: the last num_global_blocks of every window are visible
+        # to all queries (and attend everything)
+        for w0 in range(0, n, self.num_local_blocks):
+            g0 = min(w0 + self.num_local_blocks, n) - self.num_global_blocks
+            for g in range(max(g0, 0), min(w0 + self.num_local_blocks, n)):
+                lay[:, g] = 1
+                lay[g, :] = 1
+        if self.attention == "unidirectional":
+            lay = np.tril(lay)
+        return lay
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + designated global blocks (Longformer pattern)."""
+
+    def __init__(self, num_heads: int = 1, block: int = 16,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices=(0,), **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = tuple(global_block_indices)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._blocks(seq_len)
+        lay = np.zeros((n, n), np.int32)
+        half = self.num_sliding_window_blocks // 2
+        for qb in range(n):
+            lay[qb, max(0, qb - half):min(n, qb + half + 1)] = 1
+        for g in self.global_block_indices:
+            if g < n:
+                lay[:, g] = 1
+                lay[g, :] = 1
+        return lay
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + sliding window + global blocks (BigBird pattern)."""
+
+    def __init__(self, num_heads: int = 1, block: int = 16,
+                 num_random_blocks: int = 1,
+                 num_sliding_window_blocks: int = 3,
+                 num_global_blocks: int = 1, seed: int = 0, **kw):
+        super().__init__(num_heads, block, **kw)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._blocks(seq_len)
+        lay = np.zeros((n, n), np.int32)
+        half = self.num_sliding_window_blocks // 2
+        rng = np.random.RandomState(self.seed)
+        for qb in range(n):
+            lay[qb, max(0, qb - half):min(n, qb + half + 1)] = 1
+            if n > self.num_random_blocks:
+                lay[qb, rng.choice(n, self.num_random_blocks,
+                                   replace=False)] = 1
+        for g in range(min(self.num_global_blocks, n)):
+            lay[:, g] = 1
+            lay[g, :] = 1
+            lay[:, n - 1 - g] = 1
+            lay[n - 1 - g, :] = 1
+        return lay
+
+
+class VariableSparsityConfig(FixedSparsityConfig):
+    """Reference name kept: fixed pattern with per-call window override."""
+
+
+def block_layout_to_token_mask(layout: np.ndarray, block: int,
+                               causal: bool = False) -> jnp.ndarray:
+    """[nb, nb] block layout → [S, S] boolean token mask."""
+    mask = jnp.asarray(np.kron(layout, np.ones((block, block))) > 0)
+    if causal:
+        S = mask.shape[0]
+        mask = mask & jnp.tril(jnp.ones((S, S), bool))
+    return mask
+
+
+def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     sparsity_config: SparsityConfig,
+                     causal: bool = False,
+                     key_padding_mask: Optional[jnp.ndarray] = None
+                     ) -> jnp.ndarray:
+    """[B, S, h, d] attention under a block-sparse layout."""
+    S = q.shape[1]
+    layout = sparsity_config.make_layout(S)
+    mask = block_layout_to_token_mask(layout, sparsity_config.block, causal)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    m = mask[None, None]
+    if key_padding_mask is not None:
+        m = m & key_padding_mask[:, None, None, :].astype(bool)
+    s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    # a fully-masked query row softmaxes garbage — zero it explicitly
+    p = jnp.where(jnp.any(m, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -33,14 +33,37 @@ def _tag_for(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
 
+def _ckpt_engine_for(engine):
+    ceng = getattr(engine, "_ckpt_engine", None)
+    if ceng is None:
+        from .checkpoint_engine import make_checkpoint_engine
+
+        ceng = make_checkpoint_engine(engine.config)
+        engine._ckpt_engine = ceng
+    return ceng
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
     tag = _tag_for(engine, tag)
     ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
     os.makedirs(ckpt_dir, exist_ok=True)
 
+    # main state goes through the configured backend: sync, or async
+    # (orbax AsyncCheckpointer — returns after the device→host snapshot,
+    # writes behind training; the reference's decoupled engine role).
+    # The `latest` durability marker is a commit callback so an async save
+    # that dies mid-write never leaves `latest` naming a torn checkpoint.
+    ceng = _ckpt_engine_for(engine)
+
+    def _write_latest():
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
+            fh.write(tag)
+
+    ceng.save(engine.state, os.path.join(ckpt_dir, "state"),
+              commit_fn=_write_latest)
+
     with ocp.StandardCheckpointer() as saver:
-        saver.save(os.path.join(ckpt_dir, "state"), engine.state, force=True)
         infinity = getattr(engine, "infinity", None)
         if infinity is not None:
             # ZeRO-Infinity: the trunk lives in the swapper (host/NVMe) —
@@ -82,10 +105,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     with open(os.path.join(ckpt_dir, "client_state.json"), "w") as fh:
         json.dump(meta, fh, default=str)
 
-    # reference writes a `latest` file naming the newest tag [K] and ships
-    # zero_to_fp32.py into the checkpoint dir [L trainer.py:4218]
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
-        fh.write(tag)
+    # reference ships zero_to_fp32.py into the checkpoint dir
+    # [L trainer.py:4218]; the `latest` tag file was written by the
+    # checkpoint engine's commit (deferred past durability when async)
     try:
         import shutil
 
@@ -123,6 +145,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         logger.warning(f"no checkpoint found under {load_dir}")
         return None, None
     ckpt_dir = os.path.abspath(os.path.join(load_dir, tag))
+    # join any in-flight async save before reading (it may be this tag)
+    _ckpt_engine_for(engine).wait()
 
     # Restore INTO the engine's current sharded layout: orbax reshards on
     # load, so a checkpoint written on a different mesh/world restores

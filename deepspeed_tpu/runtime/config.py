@@ -165,6 +165,9 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False  # [L HF-DS:179-182]
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     writer: Optional[Dict[str, Any]] = None
+    #: {"type": "sync"|"async"} — async = orbax AsyncCheckpointer (the
+    #: reference's DecoupledCheckpointEngine role)
+    checkpoint_engine: Dict[str, Any] = Field(default_factory=dict)
 
 
 class TensorParallelConfig(DeepSpeedConfigModel):

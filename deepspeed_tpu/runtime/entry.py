@@ -71,7 +71,21 @@ def initialize(args: Any = None,
         ep = 1
         if mpu is not None and hasattr(mpu, "get_sequence_parallel_world_size"):
             sp = int(mpu.get_sequence_parallel_world_size())
-        layout = MeshLayout.infer(jax.device_count(), tp=tp, pp=pp, sp=sp, ep=ep)
+        dp = None
+        mics = int(cfg.zero_optimization.mics_shard_size or -1)
+        if mics > 0:
+            # MiCS: factor the DP world into (data=shard-group,
+            # expert=replica-groups) so the sharder's data-axis-only
+            # sharding realizes the sub-group partition.  The expert axis
+            # doubles as the replica axis — MoE EP and MiCS can't share it.
+            total_dp = jax.device_count() // (tp * pp * sp)
+            if total_dp % mics:
+                raise ValueError(
+                    f"mics_shard_size={mics} must divide the DP world "
+                    f"{total_dp}")
+            dp, ep = mics, total_dp // mics
+        layout = MeshLayout.infer(jax.device_count(), tp=tp, pp=pp, sp=sp,
+                                  ep=ep, dp=dp)
         mesh = groups_mod.initialize_mesh(layout)
         world = jax.device_count()
     else:

@@ -1,0 +1,108 @@
+"""qwZ — ZeRO++ quantized-weight all-gather (arXiv 2306.10209 [P]).
+
+Role parity: ``zero_quantized_weights`` inside the reference's
+``zero/stage3.py`` + ``csrc/quantization`` [K]: ZeRO-3's parameter
+all-gathers move int8 + group scales instead of fp16, halving (vs bf16)
+the gather bytes that dominate stage-3 comm.
+
+TPU-first formulation: the gather is GSPMD-inserted, so qwZ becomes a
+dtype trick in the program — quantize the SHARDED fp32 master leaf
+(elementwise, stays sharded), pin the int8 tensor (and its scales) to a
+REPLICATED sharding constraint, then dequantize locally.  The constraint
+forces the compiler to place the all-gather on the int8 representation:
+wire bytes drop ~4× vs fp32 / ~2× vs bf16, and the dequant runs
+post-gather on every chip.  The backward is straight-through (cotangent
+flows to the master unchanged) — exactly the reference semantics, where
+quantization is gather compression, not a training-math change; the
+LOSSY part (compute sees int8-rounded weights) is also shared with the
+reference.
+
+Group scheme: blocks of ``GROUP`` along the last dim when it divides,
+else one scale per last-dim row — shape-preserving, so the leaf's
+sharding plan (ZeRO/TP) is untouched through the quantize step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+GROUP = 256
+
+
+def _quant(p32: jnp.ndarray, group: int = GROUP):
+    d = p32.shape[-1] if p32.ndim else 1
+    if p32.ndim and d % group == 0:
+        g = p32.reshape(*p32.shape[:-1], d // group, group)
+    else:
+        g = p32[..., None, :]  # one group per row
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(p32.shape), scale[..., 0]
+
+
+def _dequant(q: jnp.ndarray, scale: jnp.ndarray, shape,
+             group: int = GROUP) -> jnp.ndarray:
+    d = shape[-1] if shape else 1
+    if shape and d % group == 0:
+        g = q.reshape(*shape[:-1], d // group, group)
+    else:
+        g = q[..., None, :]
+    return (g.astype(jnp.float32) * scale[..., None]).reshape(shape)
+
+
+def make_qwz(mesh: Mesh, base_spec: Optional[PartitionSpec] = None
+             ) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Per-leaf weight compressor bound to ``mesh``.
+
+    ``base_spec`` is the leaf's MODEL placement (TP column/row split etc.):
+    the int8 tensor is pinned to exactly that spec — replicated over the
+    ZeRO DP axes (undoing the stage-3 shard → the all-gather lands on
+    int8) while every TP/pipe axis the model claimed stays sharded, so
+    qwZ never materializes a weight TP was keeping split.
+    """
+    target = NamedSharding(mesh, base_spec or PartitionSpec())
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def _impl(p: jnp.ndarray) -> jnp.ndarray:
+        q, s = _quant(p.astype(jnp.float32))
+        # the constraint is THE mechanism: the DP all-gather lands on int8
+        q = jax.lax.with_sharding_constraint(q, target)
+        s = jax.lax.with_sharding_constraint(s, replicated)  # tiny
+        return _dequant(q, s, p.shape).astype(p.dtype)
+
+    @jax.custom_vjp
+    def qwz(p):
+        return _impl(p)
+
+    def fwd(p):
+        return _impl(p), None
+
+    def bwd(_, g):  # straight-through: gather compression, not new math
+        return (g,)
+
+    qwz.defvjp(fwd, bwd)
+    return qwz
+
+
+def qwz_compress_tree(params: Any, mesh: Mesh, threshold: int = 0,
+                      base_specs: Any = None) -> Any:
+    """Apply qwZ to every float leaf larger than ``threshold`` elements
+    (small/persisted leaves stay full precision, mirroring the reference's
+    persistence-threshold interplay).  ``base_specs`` — matching pytree of
+    model PartitionSpecs (TP placement to preserve)."""
+
+    def one(p, spec):
+        if (not jnp.issubdtype(p.dtype, jnp.floating)
+                or int(np.prod(p.shape)) <= threshold):
+            return p
+        return make_qwz(mesh, spec)(p)
+
+    if base_specs is None:
+        return jax.tree.map(lambda p: one(p, None), params)
+    return jax.tree.map(one, params, base_specs)
