@@ -1,4 +1,8 @@
-from .compress import init_compression, redundancy_clean
+from .compress import (apply_layer_reduction, init_compression,
+                       knowledge_distillation_loss, redundancy_clean,
+                       student_initialize)
 from .quantization import fake_quantize
 
-__all__ = ["init_compression", "redundancy_clean", "fake_quantize"]
+__all__ = ["init_compression", "redundancy_clean", "fake_quantize",
+           "apply_layer_reduction", "knowledge_distillation_loss",
+           "student_initialize"]

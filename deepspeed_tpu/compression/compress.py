@@ -9,7 +9,14 @@ TPU-first: models are functional, so "wrapping a module" becomes wrapping
 the LOSS: ``init_compression`` returns a transformed loss whose params pass
 through fake-quant / pruning masks on every forward (gradients flow via STE).
 ``redundancy_clean`` applies the masks destructively to the param pytree.
-Layer-reduction/distillation is a documented gap for a later round.
+
+Coverage vs the reference config groups: ``weight_quantization`` (QAT),
+``sparse_pruning`` (unstructured magnitude), ``row_pruning`` (structured
+output-channel), ``head_pruning`` (whole attention heads, name-matched on
+attn leaves), ``layer_reduction`` (student keeps a subset of stacked
+layers) + a knowledge-distillation loss helper.  ``activation_quantization``
+and ``channel_pruning`` remain gaps (activations aren't reachable from a
+loss wrapper; models call ``quantization.fake_quantize`` directly).
 """
 
 from __future__ import annotations
@@ -43,26 +50,98 @@ def _compression_transform(ds_config: Dict[str, Any]
     sp = _get(ct, "sparse_pruning", "shared_parameters", default={}) or {}
     sp_enabled = sp.get("enabled", False)
     density = float(sp.get("dense_ratio", 0.5)) if sp_enabled else 1.0
+    rp = _get(ct, "row_pruning", "shared_parameters", default={}) or {}
+    rp_enabled = rp.get("enabled", False)
+    rp_density = float(rp.get("dense_ratio", 0.5)) if rp_enabled else 1.0
+    hp = _get(ct, "head_pruning", "shared_parameters", default={}) or {}
+    hp_enabled = hp.get("enabled", False)
+    hp_density = float(hp.get("dense_ratio", 0.5)) if hp_enabled else 1.0
+
+    def _row_prune(p):
+        # structured: zero whole OUTPUT channels (last dim) by L2 norm over
+        # every other dim (reference row_pruning semantics)
+        norms = jnp.sqrt(jnp.sum(jnp.square(p),
+                                 axis=tuple(range(p.ndim - 1))))
+        k = max(int(norms.size * rp_density), 1)
+        thresh = jnp.sort(norms)[-k]
+        return jnp.where(norms >= thresh, p, 0.0)
+
+    HEAD_AXIS = {"wq": -2, "wk": -2, "wv": -2, "wo": -3}
+
+    def _head_norms(p, name):
+        axis = p.ndim + HEAD_AXIS[name]
+        other = tuple(i for i in range(p.ndim) if i != axis)
+        return jnp.sqrt(jnp.sum(jnp.square(p), axis=other))
+
+    def _apply_head_mask(p, name, keep):
+        axis = p.ndim + HEAD_AXIS[name]
+        shape = [1] * p.ndim
+        shape[axis] = p.shape[axis]
+        return p * keep.reshape(shape)
+
+    def _head_prune_groups(params: Any) -> Any:
+        """Pre-pass: ONE keep-mask per attention group, decided from the
+        COMBINED q/k/v/o head norms — per-leaf masks could disagree, and a
+        head whose q is zeroed but whose v/o survive degrades to emitting
+        its mean value (uniform softmax) instead of being excised."""
+
+        def walk(node):
+            if isinstance(node, dict) and all(
+                    k in node for k in ("wq", "wk", "wv", "wo")):
+                def mask_from(norms):
+                    k = max(int(norms.size * hp_density), 1)
+                    return norms >= jnp.sort(norms)[-k]
+
+                nq = _head_norms(node["wq"], "wq")
+                nk = _head_norms(node["wk"], "wk")
+                if nk.size == nq.size:  # MHA: one mask for all four
+                    keep = mask_from(nq + nk
+                                     + _head_norms(node["wv"], "wv")
+                                     + _head_norms(node["wo"], "wo"))
+                    masks = {k: keep for k in HEAD_AXIS}
+                else:  # GQA: q/o share a mask; kv groups get their own
+                    keep_q = mask_from(nq + _head_norms(node["wo"], "wo"))
+                    keep_kv = mask_from(nk + _head_norms(node["wv"], "wv"))
+                    masks = {"wq": keep_q, "wo": keep_q,
+                             "wk": keep_kv, "wv": keep_kv}
+                return {kk: (_apply_head_mask(vv, kk, masks[kk])
+                             if kk in HEAD_AXIS else vv)
+                        for kk, vv in node.items()}
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            return node
+
+        return walk(params)
 
     def transform(params: Any) -> Any:
-        def leaf(p):
+        if hp_enabled:
+            params = _head_prune_groups(params)
+
+        def leaf(path, p):
             if not jnp.issubdtype(p.dtype, jnp.floating) or p.ndim < 2:
                 return p
+            name = (path[-1].key if hasattr(path[-1], "key")
+                    else str(path[-1]))
+            in_attn = any(getattr(e, "key", "") == "attn" for e in path)
             out = p
+            if rp_enabled and not (in_attn and name in HEAD_AXIS):
+                out = _row_prune(out)
             if sp_enabled:
                 k = max(int(p.size * density), 1)
-                thresh = jnp.sort(jnp.abs(p).reshape(-1))[-k]
+                thresh = jnp.sort(jnp.abs(out).reshape(-1))[-k]
                 out = jnp.where(jnp.abs(out) >= thresh, out, 0.0)
             if wq_enabled:
                 out = fake_quantize(out, bits=bits)
             return out
 
-        return jax.tree.map(leaf, params)
+        return jax.tree_util.tree_map_with_path(leaf, params)
 
-    if not (wq_enabled or sp_enabled):
+    if not (wq_enabled or sp_enabled or rp_enabled or hp_enabled):
         return lambda params: params
     logger.info(f"init_compression: weight_quant={wq_enabled}(bits={bits}) "
-                f"sparse_pruning={sp_enabled}(density={density})")
+                f"sparse_pruning={sp_enabled}(density={density}) "
+                f"row_pruning={rp_enabled}(density={rp_density}) "
+                f"head_pruning={hp_enabled}(density={hp_density})")
     return transform
 
 
@@ -98,3 +177,59 @@ def redundancy_clean(params_or_model: Any, deepspeed_config: Dict[str, Any],
     modules; here: rewrites the leaves)."""
     transform = _compression_transform(deepspeed_config)
     return transform(params_or_model)
+
+
+def apply_layer_reduction(params: Any, keep_layers, layers_key: str = "layers"
+                          ) -> Any:
+    """Reference ``layer_reduction`` [K]: build a shallower student by
+    keeping ``keep_layers`` (teacher layer indices) of the stacked trunk —
+    each kept layer initializes from its teacher layer (``teacher_layer``
+    config semantics).  Works on any model whose per-layer params are
+    stacked on dim 0 under ``params[layers_key]`` (this zoo's convention).
+    """
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+    out = dict(params)
+    out[layers_key] = jax.tree.map(lambda p: p[idx], params[layers_key])
+    return out
+
+
+def knowledge_distillation_loss(student_logits: jnp.ndarray,
+                                teacher_logits: jnp.ndarray,
+                                labels: Optional[jnp.ndarray] = None,
+                                alpha: float = 0.5,
+                                temperature: float = 1.0) -> jnp.ndarray:
+    """KD objective: alpha * T^2 * KL(teacher_T || student_T)
+    + (1-alpha) * CE(student, labels) — the reference compression
+    examples' distillation form."""
+    T = temperature
+    t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / T, axis=-1)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / T, axis=-1)
+    kl = jnp.sum(jnp.exp(t) * (t - s), axis=-1)
+    loss = alpha * (T * T) * jnp.mean(kl)
+    if labels is not None and alpha < 1.0:
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(student_logits.astype(jnp.float32),
+                                  axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        ce = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+            jnp.sum(valid), 1)
+        loss = loss + (1.0 - alpha) * ce
+    return loss
+
+
+def student_initialize(student_model: Any, teacher_params: Any,
+                       deepspeed_config: Dict[str, Any]) -> Any:
+    """Reference ``student_initialization`` role: derive student params
+    from the teacher per ``layer_reduction.teacher_layer``."""
+    lr_cfg = _get(deepspeed_config or {}, "compression_training",
+                  "layer_reduction", default={}) or {}
+    if not lr_cfg.get("enabled", False):
+        return teacher_params
+    keep = lr_cfg.get("teacher_layer")
+    if keep is None:
+        n = int(lr_cfg.get("keep_number_layer", 1))
+        total = jax.tree.leaves(teacher_params["layers"])[0].shape[0]
+        step = max(total // n, 1)
+        keep = list(range(0, total, step))[:n]
+    return apply_layer_reduction(teacher_params, keep)

@@ -88,17 +88,26 @@ class DSElasticAgent:
                 log_dist(f"elastic worker finished after "
                          f"{self.restart_count} restart(s)")
                 return self.last_result
+            except SystemExit as e:
+                # scripts commonly end via sys.exit(main()); code 0/None is
+                # success, anything else is a worker failure to supervise
+                if e.code in (0, None):
+                    return self.last_result
+                e = RuntimeError(f"worker exited with code {e.code}")
+                self._maybe_restart(e)
             except Exception as e:  # worker failure → restart or give up
-                self.restart_count += 1
-                if self.restart_count > spec.max_restarts:
-                    logger.error(
-                        f"elastic agent: giving up after "
-                        f"{spec.max_restarts} restarts ({e!r})")
-                    raise
-                logger.warning(
-                    f"elastic agent: worker failed ({e!r}); restart "
-                    f"{self.restart_count}/{spec.max_restarts}")
-                time.sleep(spec.monitor_interval)
+                self._maybe_restart(e)
+
+    def _maybe_restart(self, e: BaseException) -> None:
+        spec = self.spec
+        self.restart_count += 1
+        if self.restart_count > spec.max_restarts:
+            logger.error(f"elastic agent: giving up after "
+                         f"{spec.max_restarts} restarts ({e!r})")
+            raise e
+        logger.warning(f"elastic agent: worker failed ({e!r}); restart "
+                       f"{self.restart_count}/{spec.max_restarts}")
+        time.sleep(spec.monitor_interval)
 
 
 def launch_elastic(fn: Callable[..., Any], args: tuple = (),
@@ -108,3 +117,29 @@ def launch_elastic(fn: Callable[..., Any], args: tuple = (),
     spec = WorkerSpec(fn, args=args, max_restarts=max_restarts,
                       checkpoint_dir=checkpoint_dir)
     return DSElasticAgent(spec).run()
+
+
+def cli_main(argv=None) -> int:
+    """``ds_elastic`` CLI: supervise a user script under the agent."""
+    import argparse
+    import runpy
+    import sys
+
+    parser = argparse.ArgumentParser(prog="ds_elastic")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--checkpoint_dir", default=None)
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs="*")
+    args = parser.parse_args(argv)
+
+    def worker(restart_count, ckpt_dir):
+        os.environ["DS_ELASTIC_RESTART_COUNT"] = str(restart_count)
+        if ckpt_dir:
+            os.environ["DS_ELASTIC_CHECKPOINT_DIR"] = ckpt_dir
+        sys.argv = [args.user_script] + list(args.user_args)
+        runpy.run_path(args.user_script, run_name="__main__")
+        return 0
+
+    launch_elastic(worker, max_restarts=args.max_restarts,
+                   checkpoint_dir=args.checkpoint_dir)
+    return 0

@@ -16,10 +16,9 @@ from __future__ import annotations
 
 import argparse
 import os
-import shlex
 import subprocess
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 from ..utils.logging import logger
 
@@ -81,14 +80,10 @@ def filter_hosts(resources: Dict[str, int], include: str = "",
 
 def build_env(rank: int, world: int, master_addr: str, master_port: int
               ) -> Dict[str, str]:
+    from .multinode_runner import rank_env
+
     env = dict(os.environ)
-    env.update({
-        "RANK": str(rank), "WORLD_SIZE": str(world), "LOCAL_RANK": "0",
-        "MASTER_ADDR": master_addr, "MASTER_PORT": str(master_port),
-        # jax.distributed names
-        "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
-        "NUM_PROCESSES": str(world), "PROCESS_ID": str(rank),
-    })
+    env.update(rank_env(rank, world, master_addr, master_port))
     return env
 
 
@@ -104,7 +99,8 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--master_addr", default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", default="ssh",
-                        choices=["ssh", "pdsh", "local"])
+                        choices=["ssh", "pdsh", "openmpi", "slurm",
+                                 "local", "local-multi"])
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -119,30 +115,24 @@ def main(argv: List[str] = None) -> int:
 
     cmd = [sys.executable, args.user_script] + args.user_args
 
-    if not hosts or len(hosts) == 1 or args.launcher == "local":
+    if args.launcher == "local-multi":
+        # N local processes (DistributedTest-style); hostfile not needed
+        n = args.num_nodes if args.num_nodes > 0 else max(len(hosts), 2)
+        hosts = {f"local{i}": 1 for i in range(n)}
+    elif not hosts or len(hosts) == 1 or args.launcher == "local":
         # single host: libtpu owns every local chip in ONE process
         logger.info(f"launching single-host: {' '.join(cmd)}")
         proc = subprocess.run(
             cmd, env=build_env(0, 1, args.master_addr, args.master_port))
         return proc.returncode
 
-    world = len(hosts)
-    procs: List[subprocess.Popen] = []
-    for rank, host in enumerate(hosts):
-        env = build_env(rank, world, args.master_addr, args.master_port)
-        exports = " ".join(
-            f"{k}={shlex.quote(v)}" for k, v in env.items()
-            if k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR",
-                     "MASTER_PORT", "COORDINATOR_ADDRESS", "NUM_PROCESSES",
-                     "PROCESS_ID"))
-        remote = f"cd {shlex.quote(os.getcwd())} && {exports} {' '.join(map(shlex.quote, cmd))}"
-        ssh_cmd = ["ssh", "-p", str(args.ssh_port), host, remote]
-        logger.info(f"rank {rank} @ {host}: {remote}")
-        procs.append(subprocess.Popen(ssh_cmd))
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
-    return rc
+    from .multinode_runner import get_runner
+
+    kw = {"ssh_port": args.ssh_port} if args.launcher == "ssh" else {}
+    runner = get_runner(args.launcher, hosts, args.master_addr,
+                        args.master_port, **kw)
+    logger.info(f"launching {runner.world} hosts via {runner.name}")
+    return runner.launch(cmd)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+"""``ds_ssh`` — run a command on every hostfile host.
+
+Reference: ``bin/ds_ssh`` [K]: parallel-ssh a shell command across the
+hostfile (ops convenience for pod management).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from typing import List
+
+from ..launcher.runner import DLTS_HOSTFILE, parse_hostfile
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(prog="ds_ssh")
+    parser.add_argument("--hostfile", "-f", default=DLTS_HOSTFILE)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("need a command")
+    hosts = list(parse_hostfile(args.hostfile))
+    procs = {h: subprocess.Popen(["ssh", h] + args.command,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+             for h in hosts}
+    rc = 0
+    for h, p in procs.items():
+        out, _ = p.communicate()
+        print(f"----- {h} (rc={p.returncode})")
+        sys.stdout.write(out.decode(errors="replace"))
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,3 +278,20 @@ def test_mics_shard_size_must_divide():
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
                     "zero_optimization": {"stage": 3, "mics_shard_size": 3},
                     "steps_per_print": 0})
+
+
+def test_elastic_agent_handles_sys_exit():
+    """sys.exit(nonzero) from a supervised script counts as a failure to
+    restart, not an agent crash; sys.exit(0) is success."""
+    from deepspeed_tpu.elasticity.elastic_agent import launch_elastic
+
+    attempts = []
+
+    def exits_nonzero_then_ok(restart_count, ckpt_dir):
+        attempts.append(restart_count)
+        if restart_count < 1:
+            raise SystemExit(1)
+        raise SystemExit(0)
+
+    launch_elastic(exits_nonzero_then_ok, max_restarts=2)
+    assert attempts == [0, 1]

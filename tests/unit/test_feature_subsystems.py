@@ -235,3 +235,100 @@ def test_zero_to_fp32_export(tmp_path):
     # consolidated 16-bit export
     sd16 = engine._zero3_consolidated_16bit_state_dict()
     assert jax.tree.leaves(sd16)[0].dtype == jnp.bfloat16
+
+
+def test_compression_structured_row_and_head_pruning():
+    """row_pruning zeroes whole output channels; head_pruning zeroes whole
+    attention heads (name-matched attn leaves)."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.compression import redundancy_clean
+
+    rng = np.random.RandomState(0)
+    params = {
+        "layers": {
+            "attn": {"wq": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+                     "wk": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+                     "wv": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+                     "wo": jnp.asarray(rng.randn(2, 4, 8, 16) * 0.1)},
+            "mlp": {"w_up": jnp.asarray(rng.randn(2, 16, 32) * 0.1)},
+        },
+    }
+    cfg = {"compression_training": {
+        "row_pruning": {"shared_parameters": {"enabled": True,
+                                              "dense_ratio": 0.5}},
+        "head_pruning": {"shared_parameters": {"enabled": True,
+                                               "dense_ratio": 0.5}}}}
+    out = redundancy_clean(params, cfg)
+    # head pruning: exactly 2 of 4 heads fully zero in wq (dim -2)
+    wq = np.asarray(out["layers"]["attn"]["wq"])
+    head_zero = (np.abs(wq).sum(axis=(0, 1, 3)) == 0)
+    assert head_zero.sum() == 2
+    # the surviving heads are untouched
+    # row pruning: half the mlp output channels zeroed
+    wu = np.asarray(out["layers"]["mlp"]["w_up"])
+    col_zero = (np.abs(wu).sum(axis=(0, 1)) == 0)
+    assert col_zero.sum() == 16
+    # wo heads (dim -3) pruned too
+    wo = np.asarray(out["layers"]["attn"]["wo"])
+    assert (np.abs(wo).sum(axis=(0, 2, 3)) == 0).sum() == 2
+
+
+def test_layer_reduction_and_distillation():
+    import jax
+    import numpy as np
+    from deepspeed_tpu.compression import (apply_layer_reduction,
+                                           knowledge_distillation_loss,
+                                           student_initialize)
+
+    teacher = {"embed": jnp.ones((4, 8)),
+               "layers": {"w": jnp.arange(6, dtype=jnp.float32
+                                          ).reshape(6, 1) * jnp.ones((6, 3))}}
+    student = apply_layer_reduction(teacher, [0, 2, 4])
+    assert student["layers"]["w"].shape == (3, 3)
+    np.testing.assert_array_equal(np.asarray(student["layers"]["w"][:, 0]),
+                                  [0, 2, 4])
+    # student_initialize honors keep_number_layer spacing
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2}}}
+    s2 = student_initialize(None, teacher, cfg)
+    assert s2["layers"]["w"].shape[0] == 2
+
+    # KD loss: equals CE at alpha=0, pure KL at alpha=1 (0 when t==s)
+    logits = jnp.asarray(np.random.RandomState(1).randn(4, 10),
+                         jnp.float32)
+    labels = jnp.asarray([1, 2, 3, 4])
+    kd_same = knowledge_distillation_loss(logits, logits, labels, alpha=1.0)
+    assert abs(float(kd_same)) < 1e-5
+    ce_only = knowledge_distillation_loss(logits, logits * 0, labels,
+                                          alpha=0.0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    want_ce = -float(jnp.mean(jnp.take_along_axis(
+        logp, labels[:, None], axis=1)))
+    assert abs(float(ce_only) - want_ce) < 1e-5
+
+
+def test_head_pruning_mask_consistent_across_qkvo():
+    """One keep-mask per attention group: the SAME heads zero in all of
+    wq/wk/wv/wo (per-leaf masks would leave half-pruned heads emitting
+    their mean value through a surviving wo)."""
+    import numpy as np
+    from deepspeed_tpu.compression import redundancy_clean
+
+    rng = np.random.RandomState(7)
+    params = {"attn": {
+        "wq": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+        "wk": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+        "wv": jnp.asarray(rng.randn(2, 16, 4, 8) * 0.1),
+        "wo": jnp.asarray(rng.randn(2, 4, 8, 16) * 0.1)}}
+    cfg = {"compression_training": {"head_pruning": {
+        "shared_parameters": {"enabled": True, "dense_ratio": 0.5}}}}
+    out = redundancy_clean(params, cfg)
+    zq = np.abs(np.asarray(out["attn"]["wq"])).sum(axis=(0, 1, 3)) == 0
+    zk = np.abs(np.asarray(out["attn"]["wk"])).sum(axis=(0, 1, 3)) == 0
+    zv = np.abs(np.asarray(out["attn"]["wv"])).sum(axis=(0, 1, 3)) == 0
+    zo = np.abs(np.asarray(out["attn"]["wo"])).sum(axis=(0, 2, 3)) == 0
+    assert zq.sum() == 2
+    np.testing.assert_array_equal(zq, zk)
+    np.testing.assert_array_equal(zq, zv)
+    np.testing.assert_array_equal(zq, zo)
