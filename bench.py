@@ -32,24 +32,12 @@ import numpy as np
 # round-1 recorded headline (BENCH_r01.json) — the cross-round baseline
 R01_TOKENS_PER_SEC = 35367.7
 
-#: bf16 dense peak per chip by device kind (public spec sheets)
-PEAK_BF16 = (
-    ("v6", 918e12),     # Trillium
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-)
-
-
 def peak_flops_per_chip() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for tag, peak in PEAK_BF16:
-        if tag in kind:
-            return peak
-    return 197e12  # conservative default for unknown TPU kinds
+    # single source of truth for the per-kind peak table
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        peak_flops_per_chip as _peak)
+
+    return _peak()
 
 
 def hbm_bytes() -> int:
@@ -188,6 +176,39 @@ def main() -> None:
         gc.collect()
     except Exception as e:  # a variant must never kill the headline line
         extras["variants"] = {"zero3_remat_large_error": str(e)[:200]}
+
+    # -- variant: inference v2 ragged serving throughput -------------------
+    # NOTE: on the tunneled chip every decode step pays a network round
+    # trip for sampling, so this measures the serving LOOP, not the chip;
+    # it is tracked round-over-round for relative movement.
+    try:
+        from deepspeed_tpu.inference.v2 import KVCacheConfig, build_engine_v2
+        from deepspeed_tpu.models import LlamaModel
+        from deepspeed_tpu.parallel import MeshLayout
+        from deepspeed_tpu.utils import groups
+
+        groups.reset_mesh()
+        groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+        smodel = LlamaModel(cfg)  # same 110M config, mesh-less
+        sparams = smodel.init_params(jax.random.PRNGKey(0))
+        v2 = build_engine_v2(
+            smodel, sparams,
+            cache_config=KVCacheConfig(num_blocks=512, block_size=16,
+                                       max_seq_len=1024),
+            max_batch_slots=8, prefill_chunk=128)
+        prng = np.random.RandomState(1)
+        prompts = [prng.randint(1, cfg.vocab_size, size=n).tolist()
+                   for n in (40, 100, 200, 350, 64, 128, 500, 80)]
+        v2.generate(prompts[:2], max_new_tokens=4)  # compile both programs
+        v2.generate(prompts, max_new_tokens=32)
+        extras.setdefault("variants", {})[
+            "inference_v2_ragged_tokens_per_sec"] = round(
+                v2.last_throughput, 1)
+        del v2
+        gc.collect()
+    except Exception as e:
+        extras.setdefault("variants", {})[
+            "inference_v2_error"] = str(e)[:200]
 
     # -- variant: CPU-offload optimizer (target >=0.8x on-device) ----------
     try:

@@ -21,12 +21,33 @@ import jax
 
 from ...utils.logging import log_dist, logger
 
-#: published dense peak (bf16) per chip for MFU, overridable per deployment
+#: published dense bf16 peak per chip by device kind (spec sheets)
+PEAK_BF16_BY_KIND = (
+    ("v6", 918e12),     # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+#: fallback peak per backend when the device kind is unrecognized
 DEFAULT_PEAK_FLOPS = {
-    "tpu": 197e12,   # v5p bf16 peak; v5e ≈ 394e12 int8 / 197e12 bf16 shared
+    "tpu": 197e12,
     "cpu": 1e12,
     "gpu": 312e12,
 }
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for THIS chip (kind-matched, backend fallback)."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    for tag, peak in PEAK_BF16_BY_KIND:
+        if tag in kind:
+            return peak
+    return DEFAULT_PEAK_FLOPS.get(jax.default_backend(), 1e12)
 
 
 def _compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:
@@ -59,7 +80,7 @@ class FlopsProfiler:
         jax.block_until_ready(out)
         latency = (time.perf_counter() - t0) / runs
         backend = jax.default_backend()
-        peak = DEFAULT_PEAK_FLOPS.get(backend, 1e12)
+        peak = peak_flops_per_chip()
         achieved = flops / latency if latency > 0 else 0.0
         self.profile = {
             "flops": flops,

@@ -560,6 +560,12 @@ class DeepSpeedEngine:
             if self._train_step_fn is None:
                 self._train_step_fn = self._build_train_step()
             self.state, metrics = self._train_step_fn(self.state, batch)
+        if self.config.wall_clock_breakdown:
+            # breakdown mode trades throughput for truth (the reference
+            # inserts barriers the same way): a scalar fetch is the only
+            # reliable fence, so the timer sees DEVICE step time instead of
+            # host dispatch time
+            float(metrics["loss"])
         self.tput_timer.stop(sync=False)
         from ..utils import debug as _debug
 
@@ -571,9 +577,22 @@ class DeepSpeedEngine:
         if self.steps_per_print and self.global_steps % int(
                 self.steps_per_print) == 0:
             m = {k: float(v) for k, v in metrics.items()}
-            log_dist(f"step={self.global_steps} loss={m['loss']:.4f} "
-                     f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
-                     f"loss_scale={m['loss_scale']:.0f}")
+            line = (f"step={self.global_steps} loss={m['loss']:.4f} "
+                    f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
+                    f"loss_scale={m['loss_scale']:.0f}")
+            if self.config.wall_clock_breakdown:
+                # fused-step engine: fwd/bwd/step are ONE program, so the
+                # reference's per-phase split collapses to step wall time +
+                # throughput (+ a memory line, the other half of the
+                # reference's breakdown prints)
+                from ..utils.memory import memory_status
+
+                t = self.tput_timer
+                mem = memory_status()
+                line += (f" | step_time={t.avg_step_time() * 1e3:.1f}ms "
+                         f"samples/s={t.samples_per_sec():.1f} "
+                         f"hbm={mem.get('device_in_use_GB', 0):.2f}GB")
+            log_dist(line)
         if self.monitor is not None:
             self.monitor.write_events(
                 [(f"Train/{k}", v, self.global_steps)

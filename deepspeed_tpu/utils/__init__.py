@@ -1,9 +1,12 @@
 from .init_on_device import OnDevice
 from .logging import log_dist, logger, set_log_level
+from .memory import memory_status, see_memory_usage
+from .nvtx import instrument_w_nvtx
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .z3_leaf_module import (get_z3_leaf_modules, set_z3_leaf_modules,
                              z3_leaf_module)
 
 __all__ = ["logger", "log_dist", "set_log_level",
            "SynchronizedWallClockTimer", "ThroughputTimer", "OnDevice",
-           "set_z3_leaf_modules", "get_z3_leaf_modules", "z3_leaf_module"]
+           "set_z3_leaf_modules", "get_z3_leaf_modules", "z3_leaf_module",
+           "see_memory_usage", "memory_status", "instrument_w_nvtx"]

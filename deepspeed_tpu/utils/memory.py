@@ -1,0 +1,57 @@
+"""Memory introspection — ``see_memory_usage`` parity.
+
+Reference: ``deepspeed/runtime/utils.py:see_memory_usage(message, force)``
+[K]: prints allocator stats at checkpoints in the engine lifecycle (the
+single most-used debugging helper in reference issue reports).  TPU form:
+per-device HBM stats from the runtime + host RSS/available from procfs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import jax
+
+from .logging import log_dist
+
+
+def _host_memory() -> Dict[str, float]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            info = {line.split(":")[0]: line.split()[1] for line in f}
+        out["host_used_GB"] = (int(info["MemTotal"])
+                               - int(info["MemAvailable"])) / 2 ** 20
+        out["host_available_GB"] = int(info["MemAvailable"]) / 2 ** 20
+    except (OSError, KeyError):
+        pass
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["process_rss_GB"] = rss_pages * os.sysconf("SC_PAGE_SIZE") / 2 ** 30
+    except (OSError, ValueError):
+        pass
+    return out
+
+
+def memory_status() -> Dict[str, float]:
+    """Device + host memory numbers (GB)."""
+    out = _host_memory()
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out["device_in_use_GB"] = stats.get("bytes_in_use", 0) / 2 ** 30
+        out["device_limit_GB"] = stats.get("bytes_limit", 0) / 2 ** 30
+        out["device_peak_GB"] = stats.get("peak_bytes_in_use", 0) / 2 ** 30
+    except Exception:
+        pass
+    return out
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Reference signature; logs device HBM + host memory at ``message``."""
+    if not force:
+        return
+    s = memory_status()
+    parts = [f"{k}={v:.2f}" for k, v in s.items()]
+    log_dist(f"MEMSTATS {message} | " + " ".join(parts))

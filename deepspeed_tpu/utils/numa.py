@@ -1,0 +1,61 @@
+"""NUMA / CPU-affinity helpers for the host-side optimizer.
+
+Reference: ``deepspeed/utils/numa.py`` [K]: parses the NUMA topology and
+pins launcher worker processes to cores so CPU-Adam's OpenMP threads
+don't migrate across sockets (ZeRO-Offload throughput on multi-socket
+hosts).  Same role here for the C++ host optimizer
+(``csrc/adam/cpu_adam.cpp``, OpenMP).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .logging import logger
+
+
+def get_numa_nodes() -> Dict[int, List[int]]:
+    """{numa_node: [cpu, ...]} from sysfs; single node 0 when absent."""
+    base = "/sys/devices/system/node"
+    nodes: Dict[int, List[int]] = {}
+    if os.path.isdir(base):
+        for entry in sorted(os.listdir(base)):
+            if not entry.startswith("node"):
+                continue
+            try:
+                nid = int(entry[4:])
+            except ValueError:
+                continue
+            cpus: List[int] = []
+            cpulist = os.path.join(base, entry, "cpulist")
+            if os.path.exists(cpulist):
+                with open(cpulist) as f:
+                    for part in f.read().strip().split(","):
+                        if "-" in part:
+                            a, b = part.split("-")
+                            cpus.extend(range(int(a), int(b) + 1))
+                        elif part:
+                            cpus.append(int(part))
+            nodes[nid] = cpus
+    if not nodes:
+        nodes[0] = list(range(os.cpu_count() or 1))
+    return nodes
+
+
+def pin_to_numa_node(node: Optional[int] = None,
+                     local_rank: int = 0) -> List[int]:
+    """Affinity-pin this process to one NUMA node's cores (round-robin by
+    ``local_rank`` when ``node`` is None).  Returns the core list; also
+    sizes OMP threads to the allocation so CPU-Adam doesn't oversubscribe."""
+    nodes = get_numa_nodes()
+    if node is None:
+        node = sorted(nodes)[local_rank % len(nodes)]
+    cores = nodes.get(node) or nodes[sorted(nodes)[0]]
+    try:
+        os.sched_setaffinity(0, cores)
+        os.environ.setdefault("OMP_NUM_THREADS", str(len(cores)))
+        logger.info(f"pinned to NUMA node {node}: {len(cores)} cores")
+    except (AttributeError, OSError) as e:  # non-linux / containers
+        logger.warning(f"NUMA pinning unavailable: {e}")
+    return cores

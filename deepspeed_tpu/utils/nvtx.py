@@ -1,0 +1,38 @@
+"""Profiler range annotation — ``instrument_w_nvtx`` parity.
+
+Reference: ``deepspeed/utils/nvtx.py`` [K]: decorates hot functions with
+NVTX ranges for nsight.  TPU equivalent (SURVEY §5.1): ``jax.profiler``
+trace annotations — the named range shows up in xprof/tensorboard traces
+around both the host call and the device ops it dispatches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+
+
+def instrument_w_nvtx(func: Callable) -> Callable:
+    """Decorator: wrap ``func`` in a named profiler range (reference name
+    kept so call sites port verbatim)."""
+
+    @functools.wraps(func)
+    def wrapped(*args: Any, **kwargs: Any):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            with jax.named_scope(func.__qualname__):
+                return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str):
+    """Manual range begin (reference ``nvtx.range_push`` role)."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    return ann
+
+
+def range_pop(ann) -> None:
+    ann.__exit__(None, None, None)

@@ -204,6 +204,27 @@ def test_v2_continuous_batching_slot_reuse(tiny_model):
     assert eng2.scheduler.allocator.num_free == 63
 
 
+def test_v2_mixtral_matches_v1_greedy():
+    """MoE models route through v2 unchanged (model._ffn override)."""
+    from deepspeed_tpu.models import MixtralConfig, MixtralModel
+
+    cfg = MixtralConfig.tiny(num_layers=2, max_seq_len=64,
+                             dtype=jnp.float32, num_experts=4, top_k=2)
+    model = MixtralModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(2))
+    prompts = [np.random.RandomState(6).randint(1, 512, size=n).tolist()
+               for n in (4, 11)]
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=2, prefill_chunk=8)
+    got = eng2.generate(prompts, max_new_tokens=4)
+    for prompt, g in zip(prompts, got):
+        want = _v1_greedy(model, params, prompt, 4)
+        assert g == want
+
+
 def test_v2_eos_stops_early(tiny_model):
     model, params = tiny_model
     prompt = [5, 6, 7]

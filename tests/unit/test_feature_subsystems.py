@@ -332,3 +332,74 @@ def test_head_pruning_mask_consistent_across_qkvo():
     np.testing.assert_array_equal(zq, zk)
     np.testing.assert_array_equal(zq, zv)
     np.testing.assert_array_equal(zq, zo)
+
+
+def test_memory_and_nvtx_utils():
+    from deepspeed_tpu.utils import (instrument_w_nvtx, memory_status,
+                                     see_memory_usage)
+    from deepspeed_tpu.utils.numa import get_numa_nodes, pin_to_numa_node
+
+    s = memory_status()
+    assert isinstance(s, dict)
+    see_memory_usage("unit test", force=True)  # logs, must not raise
+
+    calls = []
+
+    @instrument_w_nvtx
+    def hot(x):
+        calls.append(x)
+        return x + 1
+
+    assert hot(1) == 2 and calls == [1]
+
+    nodes = get_numa_nodes()
+    assert 0 in nodes and len(nodes[0]) >= 1
+    # pinning mutates process affinity + OMP env — restore so later tests
+    # keep the whole machine
+    before_aff = os.sched_getaffinity(0)
+    before_omp = os.environ.get("OMP_NUM_THREADS")
+    try:
+        cores = pin_to_numa_node(0)
+        assert len(cores) >= 1
+    finally:
+        os.sched_setaffinity(0, before_aff)
+        if before_omp is None:
+            os.environ.pop("OMP_NUM_THREADS", None)
+        else:
+            os.environ["OMP_NUM_THREADS"] = before_omp
+
+
+def test_wall_clock_breakdown_logging():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    groups.reset_mesh()
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    model = LlamaModel(cfg, mesh=mesh)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 1, "wall_clock_breakdown": True})
+    import numpy as np
+    batch = {"input_ids": jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, size=(8, 16)))}
+    import io
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    ds_logger.addHandler(handler)
+    try:
+        engine.train_step(batch)
+    finally:
+        ds_logger.removeHandler(handler)
+    out = stream.getvalue()
+    assert "step_time=" in out and "samples/s=" in out
