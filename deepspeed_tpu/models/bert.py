@@ -83,6 +83,15 @@ class BertModel:
     def __init__(self, config: BertConfig, mesh: Optional[Mesh] = None):
         self.config = config
         self.mesh = mesh
+        #: random-LTD state, assigned by the engine from the
+        #: ``data_efficiency.data_routing.random_ltd`` config: middle
+        #: layers process ``ltd_keep`` randomly-selected tokens (None →
+        #: off).  BERT's learned ABSOLUTE position embeddings are added at
+        #: embedding time, so gathering tokens is exact — no RoPE
+        #: re-indexing problem (why the reference's random-LTD showcase is
+        #: BERT/GPT2-era models, arXiv 2211.11586)
+        self.ltd_keep: Optional[int] = None
+        self.ltd_layer_ids: tuple = ()
 
     # ------------------------------------------------------------------
 
@@ -206,7 +215,8 @@ class BertModel:
 
     def forward(self, params: Any, input_ids: jnp.ndarray,
                 attention_mask: Optional[jnp.ndarray] = None,
-                token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                token_type_ids: Optional[jnp.ndarray] = None,
+                ltd_step: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """[B, S] ids → [B, S, V] MLM logits (fp32)."""
         c = self.config
         dt = c.dtype
@@ -225,16 +235,60 @@ class BertModel:
                         c.layer_norm_eps)
         x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
 
-        def layer(carry, lp):
-            return self.encoder_layer(lp, carry, attention_mask), None
+        keep = self.ltd_keep
+        ltd_on = (keep is not None and 0 < keep < S
+                  and len(self.ltd_layer_ids) > 0)
+        if ltd_on:
+            from ..runtime.data_pipeline.random_ltd import random_ltd_apply
 
-        body = layer
-        if c.remat:
-            body = jax.checkpoint(
-                layer,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x,
-                            params["layers"])
+            # selection rng: content + step keyed (the engine threads the
+            # step in as the ``_step`` batch leaf) — a revisited sample
+            # drops a FRESH token subset each epoch, matching the
+            # reference's per-step selection
+            base_rng = jax.random.fold_in(
+                jax.random.PRNGKey(17),
+                jnp.sum(input_ids).astype(jnp.uint32))
+            if ltd_step is not None:
+                base_rng = jax.random.fold_in(
+                    base_rng, ltd_step.reshape(-1)[0].astype(jnp.uint32))
+            is_ltd = jnp.asarray([i in self.ltd_layer_ids
+                                  for i in range(c.num_layers)])
+
+            def ltd_layer(lp, x, rng):
+                return random_ltd_apply(
+                    lambda sub, sub_mask: self.encoder_layer(lp, sub,
+                                                             sub_mask),
+                    x, keep, rng, mask=attention_mask)
+
+            def layer(carry, xs):
+                x, i = carry
+                lp, flag = xs
+                nx = jax.lax.cond(
+                    flag,
+                    lambda: ltd_layer(lp, x, jax.random.fold_in(base_rng, i)),
+                    lambda: self.encoder_layer(lp, x, attention_mask))
+                return (nx, i + 1), None
+
+            body = layer
+            if c.remat:
+                body = jax.checkpoint(
+                    layer,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            (x, _), _ = jax.lax.scan(body, (x, jnp.int32(0)),
+                                     (params["layers"], is_ltd))
+        else:
+            def layer(carry, lp):
+                return self.encoder_layer(lp, carry, attention_mask), None
+
+            body = layer
+            if c.remat:
+                body = jax.checkpoint(
+                    layer,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x,
+                                params["layers"])
 
         m = params["mlm"]
         h = jax.nn.gelu(jnp.einsum("bsH,HG->bsG", x, m["w"].astype(dt))
@@ -254,7 +308,8 @@ class BertModel:
         labels = batch["labels"]
         logits = self.forward(params, input_ids,
                               batch.get("attention_mask"),
-                              batch.get("token_type_ids"))
+                              batch.get("token_type_ids"),
+                              ltd_step=batch.get("_step"))
         valid = labels != -100
         safe = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)

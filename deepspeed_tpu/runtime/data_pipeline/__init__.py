@@ -13,8 +13,9 @@ set of compiled programs instead of recompiling every step.
 """
 
 from .curriculum_scheduler import CurriculumScheduler
-from .data_sampler import CurriculumSampler, DeepSpeedDataSampler
+from .data_sampler import (CurriculumDataLoader, CurriculumSampler,
+                           DeepSpeedDataSampler)
 from .random_ltd import RandomLTDScheduler, random_ltd_apply
 
-__all__ = ["CurriculumScheduler", "CurriculumSampler",
+__all__ = ["CurriculumScheduler", "CurriculumSampler", "CurriculumDataLoader",
            "DeepSpeedDataSampler", "RandomLTDScheduler", "random_ltd_apply"]

@@ -81,6 +81,28 @@ class DeepSpeedDataSampler:
         return np.stack(batch)
 
 
+class CurriculumDataLoader:
+    """Wrap any batch iterable with seqlen-curriculum truncation driven by
+    the engine's step counter (the legacy ``curriculum_learning`` config's
+    runtime behavior: batches shrink to the scheduled difficulty early in
+    training and grow back; shapes bucket via ``difficulty_step``)."""
+
+    def __init__(self, loader: Any, scheduler: CurriculumScheduler,
+                 step_fn: Any):
+        self.loader = loader
+        self.scheduler = scheduler
+        self.step_fn = step_fn  # () -> current global step
+
+    def __iter__(self) -> Iterator[Any]:
+        for batch in self.loader:
+            seqlen = self.scheduler.get_difficulty(int(self.step_fn()))
+            yield (truncate_batch(batch, seqlen)
+                   if isinstance(batch, dict) else batch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+
 def truncate_batch(batch: Dict[str, Any], seqlen: int,
                    keys: Optional[Sequence[str]] = None) -> Dict[str, Any]:
     """Seqlen-curriculum batch post-processor: truncate sequence-shaped

@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from .curriculum_scheduler import CurriculumScheduler
 
 
-def random_ltd_apply(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
-                     x: jnp.ndarray, keep: int, rng: jax.Array
-                     ) -> jnp.ndarray:
+def random_ltd_apply(layer_fn: Callable[..., jnp.ndarray],
+                     x: jnp.ndarray, keep: int, rng: jax.Array,
+                     mask: jnp.ndarray = None) -> jnp.ndarray:
     """Run ``layer_fn`` on ``keep`` randomly-selected tokens of
     ``x [B, S, H]``; other tokens pass through unchanged.
 
@@ -34,17 +34,25 @@ def random_ltd_apply(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
     Selection is without replacement, per batch row, order-preserving —
     the reference's sorted-gather semantics, so RoPE/position handling
     inside ``layer_fn`` sees monotone positions.
+
+    With ``mask [B, S]`` (attention/padding mask), ``layer_fn`` is called
+    as ``layer_fn(sub, sub_mask)`` with the mask gathered by the same
+    indices — the single home of the select/gather/scatter logic for both
+    standalone use and model integrations.
     """
     B, S, H = x.shape
     keep = int(keep)
     if keep >= S:
-        return layer_fn(x)
+        return layer_fn(x) if mask is None else layer_fn(x, mask)
     # per-row random permutation → first `keep` sorted = uniform subset
     scores = jax.random.uniform(rng, (B, S))
     idx = jnp.argsort(scores, axis=1)[:, :keep]
     idx = jnp.sort(idx, axis=1)  # order-preserving gather
     sub = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [B, keep, H]
-    out_sub = layer_fn(sub)
+    if mask is None:
+        out_sub = layer_fn(sub)
+    else:
+        out_sub = layer_fn(sub, jnp.take_along_axis(mask, idx, axis=1))
     # scatter processed tokens back over the identity residual
     return x.at[jnp.arange(B)[:, None], idx].set(out_sub)
 

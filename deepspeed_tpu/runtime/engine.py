@@ -238,6 +238,31 @@ class DeepSpeedEngine:
         self._warmup_step_fn = None  # 1-bit warmup variant
         self._eval_loss_fn = None
 
+        # --- random-LTD (data_efficiency.data_routing) --------------------
+        # keep-count changes along a quantized schedule; each bucket gets
+        # its own compiled step (the model reads ltd_keep at trace time)
+        self._ltd_cfg = None
+        self._ltd_sched = None
+        self._ltd_fns: Dict[int, Any] = {}
+        de = config.data_efficiency
+        routing = (de.data_routing.get("random_ltd", {})
+                   if de.enabled else {})
+        if routing.get("enabled"):
+            ids = tuple(routing.get("random_ltd_layer_id", []))
+            if not hasattr(self.module, "ltd_keep"):
+                logger.warning("random_ltd enabled but the model has no "
+                               "ltd_keep support; ignoring")
+            elif not ids:
+                # explicit beats implicit: without layer ids the model
+                # would silently never drop a token while the engine
+                # compiles a redundant program per keep bucket
+                logger.warning("random_ltd enabled but random_ltd_layer_id "
+                               "is empty; ignoring (list the layers to "
+                               "apply token dropping to)")
+            else:
+                self._ltd_cfg = dict(routing)
+                self.module.ltd_layer_ids = ids
+
         # --- compat-mode bookkeeping -------------------------------------
         self._pending_batch: Any = None
         self._microbatch_buffer: List[Any] = []
@@ -366,6 +391,12 @@ class DeepSpeedEngine:
             return jax.lax.scan(body, (jnp.float32(0.0), zero_grads), micro)[0]
 
         def compute(state: TrainState, batch):
+            if self._ltd_cfg is not None and isinstance(batch, dict):
+                # step rides as a per-row leaf (survives the gas reshape) so
+                # the model's LTD token selection is fresh every step
+                rows = jax.tree.leaves(batch)[0].shape[0]
+                batch = {**batch,
+                         "_step": jnp.full((rows,), state.step, jnp.int32)}
             compute_params = (cast_tree(state.params, dtype)
                               if dtype != jnp.float32 else state.params)
             if self.qwz_enabled:
@@ -556,6 +587,21 @@ class DeepSpeedEngine:
             if self._warmup_step_fn is None:
                 self._warmup_step_fn = self._build_train_step(onebit=False)
             self.state, metrics = self._warmup_step_fn(self.state, batch)
+        elif self._ltd_cfg is not None:
+            # random-LTD: pick this step's keep bucket, (re)use its program
+            from .data_pipeline.random_ltd import RandomLTDScheduler
+
+            seq = jax.tree.leaves(batch)[0].shape[1]
+            if self._ltd_sched is None or seq > self._ltd_sched.seq_len:
+                # rebuild on longer sequences: a curriculum-truncated FIRST
+                # batch must not cap the keep schedule for the whole run
+                self._ltd_sched = RandomLTDScheduler(self._ltd_cfg, seq)
+            keep = min(self._ltd_sched.keep_count(self.global_steps), seq)
+            self.module.ltd_keep = None if keep >= seq else keep
+            key = keep if keep < seq else -1
+            if key not in self._ltd_fns:
+                self._ltd_fns[key] = self._build_train_step()
+            self.state, metrics = self._ltd_fns[key](self.state, batch)
         else:
             if self._train_step_fn is None:
                 self._train_step_fn = self._build_train_step()
@@ -662,14 +708,17 @@ class DeepSpeedEngine:
                        f"(configured GAS={self.gradient_accumulation_steps})")
         saved_gas, saved_fn = self.gradient_accumulation_steps, self._train_step_fn
         saved_warm = self._warmup_step_fn
+        saved_ltd = self._ltd_fns
         self.gradient_accumulation_steps = n
         self._train_step_fn = self._warmup_step_fn = None
+        self._ltd_fns = {}  # LTD programs bake GAS in too
         try:
             return self.train_step(batch)
         finally:
             self.gradient_accumulation_steps = saved_gas
             self._train_step_fn = saved_fn
             self._warmup_step_fn = saved_warm
+            self._ltd_fns = saved_ltd
 
     # ------------------------------------------------------------------
     # introspection parity

@@ -149,6 +149,24 @@ def initialize(args: Any = None,
         dataloader = DeepSpeedDataLoader(
             training_data, batch_size=int(cfg.train_batch_size),
             mesh=mesh, collate_fn=collate_fn, shuffle=True, seed=cfg.seed)
+        # seqlen curriculum: legacy top-level group or the data_efficiency
+        # nested form — both feed the same scheduler
+        cl = dict(cfg.curriculum_learning or {})
+        if not cl.get("enabled"):
+            cl = dict(cfg.data_efficiency.data_sampling.get(
+                "curriculum_learning", {})) if cfg.data_efficiency.enabled \
+                else {}
+        if cl.get("enabled"):
+            from .data_pipeline import CurriculumScheduler
+            from .data_pipeline.data_sampler import CurriculumDataLoader
+
+            sched = CurriculumScheduler(cl)
+            engine.curriculum_scheduler = sched
+            dataloader = CurriculumDataLoader(
+                dataloader, sched, lambda: engine.global_steps)
+            log_dist(f"curriculum learning: seqlen "
+                     f"{sched.min}→{sched.max} over "
+                     f"{getattr(sched, 'total', '?')} steps")
 
     log_dist(f"deepspeed_tpu.initialize: stage={cfg.zero_optimization.stage} "
              f"dtype={cfg.dtype().__name__} mesh={dict(mesh.shape)} "

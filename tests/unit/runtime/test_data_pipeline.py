@@ -157,3 +157,94 @@ def test_random_ltd_under_jit_static_keep():
     a = step(x, 8, jax.random.PRNGKey(0))
     b = step(x, 16, jax.random.PRNGKey(0))
     assert a.shape == b.shape == x.shape
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def _mlm_data(vocab, n_samples=32, seq=64, seed=0):
+    rng = np.random.RandomState(seed)
+    data = []
+    for _ in range(n_samples):
+        ids = rng.randint(4, vocab, size=(seq,))
+        labels = np.where(rng.rand(seq) < 0.15, ids, -100)
+        data.append({"input_ids": ids, "labels": labels})
+    return data
+
+
+def test_curriculum_dataloader_wired_through_initialize():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertConfig, BertModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    groups.reset_mesh()
+    cfg = BertConfig.tiny(num_layers=2, max_seq_len=64, dtype=jnp.float32)
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    model = BertModel(cfg, mesh=mesh)
+    engine, _, dl, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, training_data=_mlm_data(cfg.vocab_size),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 0,
+                "curriculum_learning": {
+                    "enabled": True, "min_difficulty": 16,
+                    "max_difficulty": 64,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 16}}})
+    it = iter(dl)
+    first = next(it)
+    assert first["input_ids"].shape[1] == 16      # truncated at step 0
+    m = engine.train_step(first)
+    assert np.isfinite(float(m["loss"]))
+    engine.global_steps = 10                      # past the schedule
+    late = next(it)
+    assert late["input_ids"].shape[1] == 64       # full length restored
+
+
+def test_random_ltd_wired_through_engine():
+    """BERT + random_ltd config: buckets compile per keep count, training
+    converges, and keep grows along the schedule."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertConfig, BertModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    groups.reset_mesh()
+    cfg = BertConfig.tiny(num_layers=4, max_seq_len=32, dtype=jnp.float32)
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    model = BertModel(cfg, mesh=mesh)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 0,
+                "data_efficiency": {
+                    "enabled": True,
+                    "data_routing": {"random_ltd": {
+                        "enabled": True,
+                        "random_ltd_layer_id": [1, 2],
+                        "random_ltd_schedule": {
+                            "min_value": 16, "max_value": 32,
+                            "schedule_type": "fixed_linear",
+                            "schedule_config": {"require_steps": 6,
+                                                "seq_per_step": 8}}}}}})
+    assert engine.module.ltd_layer_ids == (1, 2)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(4, cfg.vocab_size, size=(8, 32))
+    labels = np.where(rng.rand(8, 32) < 0.15, ids, -100)
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+    first = float(engine.train_step(batch)["loss"])   # keep=16 bucket
+    for _ in range(8):
+        last = float(engine.train_step(batch)["loss"])
+    assert last < first
+    # schedule crossed 16 → 24 → full(32≡off): several compiled buckets
+    assert len(engine._ltd_fns) >= 2
+    assert -1 in engine._ltd_fns                      # full-keep bucket
+    assert engine.module.ltd_keep is None             # LTD off at the end
