@@ -272,13 +272,26 @@ class LlamaModel:
         q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
         kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
         vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
-        if n_rep > 1:  # GQA: repeat KV heads so every rank holds a slice
+        if n_rep > 1 and c.attn_impl != "ring":
+            # GQA: repeat KV heads so every Ulysses rank holds a slice;
+            # the ring path rotates kv-width blocks and expands per-visit
             kk = jnp.repeat(kk, n_rep, axis=2)
             vv = jnp.repeat(vv, n_rep, axis=2)
         q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-        if self.mesh is not None:
+        if c.attn_impl == "ring" and self.mesh is not None:
+            # ring SP: sequence stays sharded THROUGH attention (no
+            # head-count bound, unlike Ulysses) — RoPE on global positions
+            # first, then KV blocks rotate over the seq axis
+            from ..runtime.sequence_parallel.ring import ring_attention
+
+            S = q.shape[1]
+            positions = jnp.arange(S)[None, :]
+            q = _rope(q, positions, c.rope_theta)
+            kk = _rope(kk, positions, c.rope_theta)
+            attn = ring_attention(q, kk, vv, causal=True, mesh=self.mesh)
+        elif self.mesh is not None:
             attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
         else:
             attn = attn_fn(q, kk, vv)

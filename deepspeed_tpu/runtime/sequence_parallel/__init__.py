@@ -6,11 +6,13 @@ SequenceTiledCompute/TiledMLP) and the legacy
 ``deepspeed/sequence/layer.py:DistributedAttention`` [K].
 """
 
+from .ring import ring_attention
 from .ulysses_sp import (SequenceTiledCompute, TiledMLP, UlyssesSPAttentionHF,
                          UlyssesSPDataLoaderAdapter, sequence_tiled_loss,
                          ulysses_attention)
 
 __all__ = [
-    "ulysses_attention", "UlyssesSPAttentionHF", "UlyssesSPDataLoaderAdapter",
-    "SequenceTiledCompute", "TiledMLP", "sequence_tiled_loss",
+    "ulysses_attention", "ring_attention", "UlyssesSPAttentionHF",
+    "UlyssesSPDataLoaderAdapter", "SequenceTiledCompute", "TiledMLP",
+    "sequence_tiled_loss",
 ]
