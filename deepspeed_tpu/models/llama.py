@@ -252,15 +252,24 @@ class LlamaModel:
 
         c = self.config
         n_rep = c.num_heads // c.num_kv_heads
+        # the ring branch below is taken only with a mesh; every other path
+        # (incl. ring-configured but mesh-less) needs GQA-expanded KV
+        ring_active = c.attn_impl == "ring" and self.mesh is not None
+
+        def apply_rope_qk(q, kk):
+            """Global-position RoPE on q/k — ONE home for position handling
+            (used by the local attn body AND the ring branch)."""
+            S = q.shape[1]
+            positions = jnp.arange(S)[None, :]
+            return (_rope(q, positions, c.rope_theta),
+                    _rope(kk, positions, c.rope_theta))
 
         def attn_fn(q, kk, vv):
             """Position-exact attention on [b, S, h_local, d] blocks — runs
             under shard_map with the FULL sequence after the Ulysses
             all-to-all (heads local), or directly when unsharded."""
+            q, kk = apply_rope_qk(q, kk)
             S = q.shape[1]
-            positions = jnp.arange(S)[None, :]
-            q = _rope(q, positions, c.rope_theta)
-            kk = _rope(kk, positions, c.rope_theta)
             if c.attn_impl == "flash":
                 from ..ops.pallas.flash_attention import flash_attention
 
@@ -272,7 +281,7 @@ class LlamaModel:
         q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
         kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
         vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
-        if n_rep > 1 and c.attn_impl != "ring":
+        if n_rep > 1 and not ring_active:
             # GQA: repeat KV heads so every Ulysses rank holds a slice;
             # the ring path rotates kv-width blocks and expands per-visit
             kk = jnp.repeat(kk, n_rep, axis=2)
@@ -280,16 +289,13 @@ class LlamaModel:
         q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-        if c.attn_impl == "ring" and self.mesh is not None:
+        if ring_active:
             # ring SP: sequence stays sharded THROUGH attention (no
             # head-count bound, unlike Ulysses) — RoPE on global positions
             # first, then KV blocks rotate over the seq axis
             from ..runtime.sequence_parallel.ring import ring_attention
 
-            S = q.shape[1]
-            positions = jnp.arange(S)[None, :]
-            q = _rope(q, positions, c.rope_theta)
-            kk = _rope(kk, positions, c.rope_theta)
+            q, kk = apply_rope_qk(q, kk)
             attn = ring_attention(q, kk, vv, causal=True, mesh=self.mesh)
         elif self.mesh is not None:
             attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)

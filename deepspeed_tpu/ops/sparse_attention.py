@@ -30,10 +30,6 @@ class SparsityConfig:
 
     def __init__(self, num_heads: int = 1, block: int = 16,
                  different_layout_per_head: bool = False):
-        if different_layout_per_head:
-            raise NotImplementedError(
-                "per-head layouts are not implemented; all heads share one "
-                "layout (reference configs using this flag need porting)")
         self.num_heads = num_heads
         self.block = block
         self.different_layout_per_head = different_layout_per_head
@@ -44,9 +40,25 @@ class SparsityConfig:
                              f"{self.block}")
         return seq_len // self.block
 
-    def make_layout(self, seq_len: int) -> np.ndarray:
+    def _head_layout(self, seq_len: int, head: int) -> np.ndarray:
+        """One head's [nb, nb] layout; subclasses with head-varying
+        patterns (BigBird's random blocks) override or consume ``head``."""
         n = self._blocks(seq_len)
         return np.ones((n, n), np.int32)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        """[nb, nb] shared layout, or [num_heads, nb, nb] when
+        ``different_layout_per_head`` (reference layout shapes).  Patterns
+        that don't actually vary per head (Fixed/Longformer) collapse back
+        to the shared 2-D form — h× identical masks would cost h× memory
+        for nothing."""
+        if self.different_layout_per_head:
+            per_head = [self._head_layout(seq_len, h)
+                        for h in range(self.num_heads)]
+            if all(np.array_equal(per_head[0], l) for l in per_head[1:]):
+                return per_head[0]
+            return np.stack(per_head)
+        return self._head_layout(seq_len, 0)
 
 
 class FixedSparsityConfig(SparsityConfig):
@@ -62,7 +74,7 @@ class FixedSparsityConfig(SparsityConfig):
         self.num_global_blocks = num_global_blocks
         self.attention = attention
 
-    def make_layout(self, seq_len: int) -> np.ndarray:
+    def _head_layout(self, seq_len: int, head: int) -> np.ndarray:
         n = self._blocks(seq_len)
         lay = np.zeros((n, n), np.int32)
         for qb in range(n):
@@ -90,7 +102,7 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.global_block_indices = tuple(global_block_indices)
 
-    def make_layout(self, seq_len: int) -> np.ndarray:
+    def _head_layout(self, seq_len: int, head: int) -> np.ndarray:
         n = self._blocks(seq_len)
         lay = np.zeros((n, n), np.int32)
         half = self.num_sliding_window_blocks // 2
@@ -116,11 +128,12 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_global_blocks = num_global_blocks
         self.seed = seed
 
-    def make_layout(self, seq_len: int) -> np.ndarray:
+    def _head_layout(self, seq_len: int, head: int) -> np.ndarray:
         n = self._blocks(seq_len)
         lay = np.zeros((n, n), np.int32)
         half = self.num_sliding_window_blocks // 2
-        rng = np.random.RandomState(self.seed)
+        # per-head layouts differ by their RANDOM blocks (reference BigBird)
+        rng = np.random.RandomState(self.seed + head)
         for qb in range(n):
             lay[qb, max(0, qb - half):min(n, qb + half + 1)] = 1
             if n > self.num_random_blocks:
@@ -140,10 +153,15 @@ class VariableSparsityConfig(FixedSparsityConfig):
 
 def block_layout_to_token_mask(layout: np.ndarray, block: int,
                                causal: bool = False) -> jnp.ndarray:
-    """[nb, nb] block layout → [S, S] boolean token mask."""
-    mask = jnp.asarray(np.kron(layout, np.ones((block, block))) > 0)
+    """[nb, nb] (or per-head [h, nb, nb]) block layout → [S, S]
+    (or [h, S, S]) boolean token mask."""
+    if layout.ndim == 3:
+        mask = jnp.asarray(np.stack(
+            [np.kron(l, np.ones((block, block))) for l in layout]) > 0)
+    else:
+        mask = jnp.asarray(np.kron(layout, np.ones((block, block))) > 0)
     if causal:
-        S = mask.shape[0]
+        S = mask.shape[-1]
         mask = mask & jnp.tril(jnp.ones((S, S), bool))
     return mask
 
@@ -159,7 +177,7 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     mask = block_layout_to_token_mask(layout, sparsity_config.block, causal)
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    m = mask[None, None]
+    m = mask[None] if mask.ndim == 3 else mask[None, None]
     if key_padding_mask is not None:
         m = m & key_padding_mask[:, None, None, :].astype(bool)
     s = jnp.where(m, s, -1e30)

@@ -78,6 +78,16 @@ class DeepSpeedEngine:
         self.base_specs = (module.param_specs()
                           if callable(getattr(module, "param_specs", None))
                           else None)
+        if (self.base_specs is None
+                and int(self.mesh.shape.get("tensor", 1)) > 1):
+            # AutoTP fallback: models without hand-authored specs get
+            # name-pattern-inferred tensor placement (reference AutoTP for
+            # arbitrary modules); GSPMD keeps any inference correct
+            from .tensor_parallel import infer_tp_specs
+
+            self.base_specs = infer_tp_specs(params)
+            log_dist("AutoTP: inferred tensor-parallel specs from param "
+                     "names (model provides no param_specs)")
         from .zero.config import OffloadDeviceEnum
 
         self.offload_enabled = (config.zero_optimization.offload_optimizer_device()

@@ -10,13 +10,68 @@ to it.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Optional
 
 import jax
+from jax.sharding import PartitionSpec
 
-from ..parallel.mesh import MeshLayout
+from ..parallel.mesh import AXIS_TENSOR, MeshLayout
 from ..utils import groups as groups_mod
 from ..utils.logging import log_dist
+
+P = PartitionSpec
+
+# AutoTP name policy (reference ``module_inject/auto_tp.py`` knowledge):
+# COLUMN-split linears (output dim sharded): attention q/k/v and the MLP
+# up/gate family; ROW-split (input dim sharded): attention output and the
+# MLP down family.  Names cover this zoo + the common HF/Megatron spellings.
+_COLUMN_PAT = re.compile(
+    r"(^|[._])(wq|wk|wv|q_proj|k_proj|v_proj|query|key|value|qkv"
+    r"|w_gate|w_up|gate_proj|up_proj|w_in|wi|fc1|intermediate"
+    r"|dense_h_to_4h|lm_head)($|[._])")
+_ROW_PAT = re.compile(
+    r"(^|[._])(wo|o_proj|out_proj|w_down|down_proj|w_out|wo_proj|fc2"
+    r"|dense_4h_to_h|attention_output)($|[._])")
+
+
+def infer_tp_specs(params: Any, tp_axis: str = AXIS_TENSOR) -> Any:
+    """AutoTP for arbitrary param pytrees: infer tensor-axis PartitionSpecs
+    from leaf NAMES (reference role: ``AutoTP`` module-graph analysis —
+    here the pytree paths are the graph).
+
+    Convention: matmul leaves are ``[..., in, out]`` (this zoo's layout).
+    Column-split names shard the last (output) dim, row-split names the
+    second-to-last (input) dim; attention leaves with an explicit head dim
+    ``[..., H, heads, hd]``/``[..., heads, hd, H]`` shard the heads dim.
+    Everything unmatched (embeddings, norms, biases, 1-D) replicates —
+    GSPMD keeps any placement numerically correct, so inference is purely
+    a performance policy and safe by construction.
+    """
+    def leaf(path, p) -> PartitionSpec:
+        ndim = getattr(p, "ndim", len(getattr(p, "shape", ())))
+        if ndim < 2:
+            return P()
+        # match on the FULL joined path, not just the last key: Flax nests
+        # {'q_proj': {'kernel': ...}} and torch-style dotted names put the
+        # informative segment one level up
+        keys = [(e.key if hasattr(e, "key") else str(e)) for e in path]
+        name = ".".join(keys).lower()
+        last = keys[-1].lower()
+        none = (None,) * ndim
+        if _COLUMN_PAT.search(name):
+            if last in ("wq", "wk", "wv") and ndim >= 3:
+                # [..., H, heads, hd] → shard the heads dim
+                return P(*none[:-2], tp_axis, None)
+            return P(*none[:-1], tp_axis)
+        if _ROW_PAT.search(name):
+            if last == "wo" and ndim >= 3:
+                # [..., heads, hd, H] → shard the heads dim
+                return P(*none[:-3], tp_axis, None, None)
+            return P(*none[:-2], tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
 
 
 def tp_model_init(model: Any = None, tp_size: int = 1, dtype: Any = None,

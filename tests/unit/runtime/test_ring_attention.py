@@ -117,3 +117,16 @@ def test_ring_gqa_rotates_kv_width():
     want = _plain_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_config_meshless_gqa_forward():
+    """attn_impl='ring' without a mesh falls back to local attention and
+    must expand GQA KV heads (regression: mismatched-head einsum crash)."""
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32,
+                           attn_impl="ring")  # tiny is GQA: 8 q / 4 kv heads
+    model = LlamaModel(cfg)  # mesh=None
+    params = model.init_params(jax.random.PRNGKey(0))
+    logits = model.forward(params, jnp.asarray([[1, 2, 3, 4]]))
+    assert logits.shape == (1, 4, cfg.vocab_size)

@@ -295,3 +295,124 @@ def test_elastic_agent_handles_sys_exit():
 
     launch_elastic(exits_nonzero_then_ok, max_restarts=2)
     assert attempts == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# AutoTP spec inference
+# ---------------------------------------------------------------------------
+
+def test_infer_tp_specs_name_patterns():
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.tensor_parallel import infer_tp_specs
+
+    params = {
+        "embed": jnp.zeros((512, 64)),
+        "layers": {
+            "attn": {"wq": jnp.zeros((2, 64, 8, 8)),
+                     "wo": jnp.zeros((2, 8, 8, 64))},
+            "mlp": {"w_up": jnp.zeros((2, 64, 256)),
+                    "w_down": jnp.zeros((2, 256, 64)),
+                    "norm": jnp.zeros((2, 64))},
+        },
+        "q_proj": jnp.zeros((64, 64)),      # HF spelling → column
+        "down_proj": jnp.zeros((256, 64)),  # HF spelling → row
+        "bias": jnp.zeros((64,)),
+    }
+    specs = infer_tp_specs(params)
+    assert specs["embed"] == P()                               # replicated
+    assert specs["layers"]["attn"]["wq"] == P(None, None, "tensor", None)
+    assert specs["layers"]["attn"]["wo"] == P(None, "tensor", None, None)
+    assert specs["layers"]["mlp"]["w_up"] == P(None, None, "tensor")
+    assert specs["layers"]["mlp"]["w_down"] == P(None, "tensor", None)
+    assert specs["layers"]["mlp"]["norm"] == P()
+    assert specs["q_proj"] == P(None, "tensor")
+    assert specs["down_proj"] == P("tensor", None)
+    assert specs["bias"] == P()
+
+
+def test_autotp_inferred_training_matches_single_device():
+    """A spec-less model (bare loss over a dict pytree) trains under tp=2
+    with inferred specs and tracks the unsharded trace."""
+    import deepspeed_tpu
+
+    rng = np.random.RandomState(4)
+    W = {"q_proj": jnp.asarray(rng.randn(16, 16) * .3, jnp.float32),
+         "out_proj": jnp.asarray(rng.randn(16, 16) * .3, jnp.float32),
+         "head": jnp.asarray(rng.randn(16, 8) * .3, jnp.float32)}
+    x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 8, size=(8,)))
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        h = jnp.tanh(xb @ p["q_proj"])
+        h = jnp.tanh(h @ p["out_proj"])
+        logits = h @ p["head"]
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lp, yb[:, None], axis=1))
+
+    def run(mesh):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=loss_fn, model_parameters=jax.tree.map(jnp.copy, W),
+            mesh=mesh,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "SGD", "params": {"lr": 0.1}},
+                    "zero_optimization": {"stage": 0},
+                    "steps_per_print": 0})
+        return engine, [float(engine.train_step((x, y))["loss"])
+                        for _ in range(4)]
+
+    groups.reset_mesh()
+    engine_tp, tp_losses = run(groups.initialize_mesh(
+        MeshLayout.infer(8, tp=2, dp=4)))
+    # inferred: q_proj column-sharded over tensor
+    spec = engine_tp.state.params["q_proj"].sharding.spec
+    flat = [a for e in spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+    assert "tensor" in flat
+    groups.reset_mesh()
+    _, single_losses = run(groups.initialize_mesh(MeshLayout.infer(1, dp=1)))
+    for a, b in zip(tp_losses, single_losses):
+        assert abs(a - b) < 1e-4, (tp_losses, single_losses)
+
+
+def test_per_head_sparse_layouts():
+    """different_layout_per_head: BigBird heads get distinct random blocks
+    and attention applies the per-head masks."""
+    from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                    sparse_attention)
+
+    cfg = BigBirdSparsityConfig(num_heads=4, block=4, num_random_blocks=2,
+                                num_sliding_window_blocks=1,
+                                num_global_blocks=1,
+                                different_layout_per_head=True)
+    lay = cfg.make_layout(64)
+    assert lay.shape == (4, 16, 16)
+    # at least one pair of heads differs (random blocks per head)
+    assert any(not np.array_equal(lay[0], lay[h]) for h in range(1, 4))
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 64, 4, 8), jnp.float32)
+    out = sparse_attention(q, q, q, cfg)
+    assert out.shape == (2, 64, 4, 8)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_infer_tp_specs_matches_nested_and_dotted_paths():
+    """Flax-style nesting ({'q_proj': {'kernel'}}) and dotted keys match;
+    Fixed-pattern per-head layouts collapse to the shared 2-D form."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    from deepspeed_tpu.runtime.tensor_parallel import infer_tp_specs
+
+    params = {"q_proj": {"kernel": jnp.zeros((64, 64)),
+                         "bias": jnp.zeros((64,))},
+              "self_attn.o_proj.weight": jnp.zeros((64, 64))}
+    specs = infer_tp_specs(params)
+    assert specs["q_proj"]["kernel"] == P(None, "tensor")
+    assert specs["q_proj"]["bias"] == P()
+    assert specs["self_attn.o_proj.weight"] == P("tensor", None)
+
+    lay = FixedSparsityConfig(num_heads=8, block=4,
+                              different_layout_per_head=True).make_layout(64)
+    assert lay.ndim == 2  # identical heads collapse — no 8x mask memory
