@@ -151,10 +151,21 @@ def init_compression(model: Any, deepspeed_config: Dict[str, Any],
     through the configured compression transform each call."""
     transform = _compression_transform(deepspeed_config)
 
+    aq = _get(deepspeed_config or {}, "compression_training",
+              "activation_quantization", "shared_parameters",
+              default={}) or {}
+
     class CompressedModel:
         def __init__(self, inner):
             self._inner = inner
             self.compression_transform = transform
+            if aq.get("enabled"):
+                # models consume this in their activation hot spots
+                # (reference QuantAct wrapper role)
+                inner.act_quant_bits = int(aq.get("bits", 8))
+            elif hasattr(inner, "act_quant_bits"):
+                # a previous arming must not outlive its config
+                inner.act_quant_bits = None
 
         def __getattr__(self, name):
             return getattr(self._inner, name)

@@ -15,10 +15,35 @@ def fake_quantize(x: jnp.ndarray, bits: int = 8, symmetric: bool = True,
                   per_channel: bool = True) -> jnp.ndarray:
     """Quantize→dequantize with straight-through gradient (QAT path):
     ``x + sg(q(x) - x)`` — identity gradient everywhere, quantized value in
-    the forward (the canonical STE formulation)."""
-    qmax = 2.0 ** (bits - 1) - 1
-    axis = tuple(range(1, x.ndim)) if (per_channel and x.ndim > 1) else None
-    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
-    scale = jnp.where(amax > 0, amax / qmax, 1.0)
-    q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    the forward (the canonical STE formulation).  ``symmetric=False`` uses
+    a dynamic [min, max] range (one-sided post-nonlinearity activations)."""
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        axis = (tuple(range(1, x.ndim))
+                if (per_channel and x.ndim > 1) else None)
+        amax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+        scale = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    else:
+        levels = 2.0 ** bits - 1
+        axis = (tuple(range(1, x.ndim))
+                if (per_channel and x.ndim > 1) else None)
+        lo = jnp.min(x, axis=axis, keepdims=axis is not None)
+        hi = jnp.max(x, axis=axis, keepdims=axis is not None)
+        scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+        q = jnp.round((x - lo) / scale) * scale + lo
     return (x + jax.lax.stop_gradient(q.astype(x.dtype) - x)).astype(x.dtype)
+
+
+def quantize_activation(x: jnp.ndarray, bits: int = 8) -> jnp.ndarray:
+    """Activation fake-quant (reference ``QuantAct`` role): the asymmetric
+    per-tensor branch of :func:`fake_quantize` — one quantizer, two modes."""
+    return fake_quantize(x, bits=bits, symmetric=False, per_channel=False)
+
+
+def maybe_quantize_activation(model: Any, x: jnp.ndarray) -> jnp.ndarray:
+    """The model-side QuantAct hook, in ONE home: quantize when
+    ``init_compression`` armed ``model.act_quant_bits``, identity
+    otherwise.  Models call this at their activation hot spots."""
+    bits = getattr(model, "act_quant_bits", None)
+    return quantize_activation(x, bits) if bits else x

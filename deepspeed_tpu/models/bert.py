@@ -206,7 +206,10 @@ class BertModel:
 
         h = jnp.einsum("bsH,HI->bsI", x, lp["mlp"]["w_in"].astype(dt)) \
             + lp["mlp"]["b_in"].astype(dt)
-        h = self._constrain(jax.nn.gelu(h), DP_AXES, AXIS_SEQ, AXIS_TENSOR)
+        from ..compression.quantization import maybe_quantize_activation
+
+        h = maybe_quantize_activation(self, jax.nn.gelu(h))
+        h = self._constrain(h, DP_AXES, AXIS_SEQ, AXIS_TENSOR)
         h = jnp.einsum("bsI,IH->bsH", h, lp["mlp"]["w_out"].astype(dt)) \
             + lp["mlp"]["b_out"].astype(dt)
         x = _layer_norm(x + h, lp["mlp_ln_w"].astype(dt),

@@ -374,8 +374,10 @@ class LlamaModel:
         c = self.config
         gate = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_gate"].astype(c.dtype))
         up = jnp.einsum("bsH,HI->bsI", h, lp["mlp"]["w_up"].astype(c.dtype))
-        act = self._constrain(jax.nn.silu(gate) * up,
-                              DP_AXES, AXIS_SEQ, AXIS_TENSOR)
+        from ..compression.quantization import maybe_quantize_activation
+
+        act = maybe_quantize_activation(self, jax.nn.silu(gate) * up)
+        act = self._constrain(act, DP_AXES, AXIS_SEQ, AXIS_TENSOR)
         down = jnp.einsum("bsI,IH->bsH", act,
                           lp["mlp"]["w_down"].astype(c.dtype))
         return down, jnp.float32(0.0)

@@ -78,8 +78,10 @@ class DeepSpeedEngine:
         self.base_specs = (module.param_specs()
                           if callable(getattr(module, "param_specs", None))
                           else None)
+        from ..parallel.mesh import AXIS_TENSOR
+
         if (self.base_specs is None
-                and int(self.mesh.shape.get("tensor", 1)) > 1):
+                and int(self.mesh.shape.get(AXIS_TENSOR, 1)) > 1):
             # AutoTP fallback: models without hand-authored specs get
             # name-pattern-inferred tensor placement (reference AutoTP for
             # arbitrary modules); GSPMD keeps any inference correct

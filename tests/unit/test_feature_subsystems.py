@@ -403,3 +403,51 @@ def test_wall_clock_breakdown_logging():
         ds_logger.removeHandler(handler)
     out = stream.getvalue()
     assert "step_time=" in out and "samples/s=" in out
+
+
+def test_activation_quantization_wired():
+    """activation_quantization: init_compression arms the model's QuantAct
+    hook; loss changes but training still converges, STE keeps gradients."""
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import init_compression
+    from deepspeed_tpu.compression.quantization import quantize_activation
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.parallel import MeshLayout
+
+    # primitive: 2-bit quantization leaves few distinct values, STE grad = 1
+    x = jnp.asarray(np.linspace(-1, 1, 64), jnp.float32)
+    q = quantize_activation(x, bits=2)
+    assert len(np.unique(np.asarray(q).round(5))) <= 4
+    g = jax.grad(lambda t: jnp.sum(quantize_activation(t, 2)))(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0, atol=1e-6)
+
+    groups.reset_mesh()
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    model = LlamaModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    batch = {"input_ids": jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, size=(8, 32)))}
+    plain_loss = float(model.loss(params, batch))
+
+    cm = init_compression(model, {"compression_training": {
+        "activation_quantization": {"shared_parameters": {
+            "enabled": True, "bits": 4}}}})
+    assert model.act_quant_bits == 4
+    aq_loss = float(cm.loss(params, batch))
+    assert aq_loss != plain_loss            # quantization is in the graph
+    params_host = jax.device_get(params)    # engine donates the originals
+    engine, *_ = deepspeed_tpu.initialize(
+        model=cm, model_parameters=params, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}, "steps_per_print": 0})
+    first = float(engine.train_step(batch)["loss"])
+    for _ in range(6):
+        last = float(engine.train_step(batch)["loss"])
+    assert last < first
+    # re-wrapping WITHOUT the config disarms the hook (no state leak)
+    init_compression(model, {})
+    assert model.act_quant_bits is None
+    np.testing.assert_allclose(float(model.loss(params_host, batch)),
+                               plain_loss, rtol=1e-6)
