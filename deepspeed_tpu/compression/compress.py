@@ -161,8 +161,15 @@ def init_compression(model: Any, deepspeed_config: Dict[str, Any],
             self.compression_transform = transform
             if aq.get("enabled"):
                 # models consume this in their activation hot spots
-                # (reference QuantAct wrapper role)
+                # (reference QuantAct wrapper role).  ORDER MATTERS: jit
+                # captures the hook at trace time, so arm BEFORE building
+                # engines — programs compiled earlier keep their old
+                # behavior (same trace-time rule as every config knob)
                 inner.act_quant_bits = int(aq.get("bits", 8))
+                logger.info("activation quantization armed "
+                            f"({inner.act_quant_bits}-bit); (re)build "
+                            "engines AFTER init_compression — compiled "
+                            "programs capture the hook at trace time")
             elif hasattr(inner, "act_quant_bits"):
                 # a previous arming must not outlive its config
                 inner.act_quant_bits = None

@@ -43,6 +43,11 @@ class RaggedInferenceEngineV2:
         self.cache_config = cache_config or KVCacheConfig()
         if prefill_chunk % self.cache_config.block_size:
             raise ValueError("prefill_chunk must be a multiple of block_size")
+        if getattr(self.config, "sliding_window", None):
+            raise NotImplementedError(
+                "sliding-window models are not supported by the v2 paged "
+                "engine yet (its attention masks are causal-only); use the "
+                "v1 engine")
         if self.cache_config.max_seq_len % prefill_chunk:
             # keeps every chunk's page-table slice in range: dynamic_slice
             # clamps out-of-bounds starts, which would silently retarget a

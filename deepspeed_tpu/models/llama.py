@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.mesh import AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, DP_AXES
+from ..utils.logging import logger
 
 P = PartitionSpec
 
@@ -52,6 +53,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    #: Mistral-style sliding-window attention: each token attends at most
+    #: this many previous positions (None → full causal).  Training and
+    #: prefill mask by window; decode masks the cache tail (a rolling
+    #: window KV cache is a serving optimization for a later round).
+    sliding_window: Optional[int] = None
     dtype: Any = jnp.bfloat16
     remat: bool = True
     #: >1 → chunk final projection+loss over the sequence so the [B,S,V]
@@ -81,6 +87,17 @@ class LlamaConfig:
         """Test/CI model — small enough for an 8-device CPU mesh."""
         d = dict(vocab_size=512, hidden_size=128, intermediate_size=352,
                  num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=256)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def mistral_7b(cls, **kw) -> "LlamaConfig":
+        """Mistral-7B: Llama architecture + GQA + sliding-window attention
+        (the reference ships a mistral implementation in
+        ``inference/v2/model_implementations`` [K])."""
+        d = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                 num_layers=32, num_heads=32, num_kv_heads=8,
+                 max_seq_len=8192, rope_theta=10000.0, sliding_window=4096)
         d.update(kw)
         return cls(**d)
 
@@ -270,12 +287,23 @@ class LlamaModel:
             all-to-all (heads local), or directly when unsharded."""
             q, kk = apply_rope_qk(q, kk)
             S = q.shape[1]
-            if c.attn_impl == "flash":
+            W = c.sliding_window
+            if c.attn_impl == "flash" and W is None:
                 from ..ops.pallas.flash_attention import flash_attention
 
                 return flash_attention(q, kk, vv, True)
-            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
-            return _attention(q, kk, vv, causal)
+            if c.attn_impl == "flash" and W is not None \
+                    and not getattr(self, "_warned_flash_window", False):
+                self._warned_flash_window = True
+                logger.warning(
+                    "sliding_window is set: the flash kernel has no window "
+                    "support yet, falling back to MASKED DENSE attention "
+                    "(O(S^2) scores — expect much higher memory at long S)")
+            from ..ops.masks import local_attention_mask
+
+            pos = jnp.arange(S)
+            mask = local_attention_mask(pos, pos, causal=True, window=W)
+            return _attention(q, kk, vv, mask[None, None])
 
         h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
         q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
@@ -296,7 +324,8 @@ class LlamaModel:
             from ..runtime.sequence_parallel.ring import ring_attention
 
             q, kk = apply_rope_qk(q, kk)
-            attn = ring_attention(q, kk, vv, causal=True, mesh=self.mesh)
+            attn = ring_attention(q, kk, vv, causal=True, mesh=self.mesh,
+                                  window=c.sliding_window)
         elif self.mesh is not None:
             attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
         else:
@@ -407,9 +436,13 @@ class LlamaModel:
         B, S = input_ids.shape
         max_len = cache["k"].shape[2]
         n_rep = c.num_heads // c.num_kv_heads
+        from ..ops.masks import local_attention_mask
+
         x = jnp.take(params["embed"].astype(c.dtype), input_ids, axis=0)
         positions = jnp.arange(S)[None, :]
-        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        pos = jnp.arange(S)
+        causal = local_attention_mask(pos, pos, causal=True,
+                                      window=c.sliding_window)[None, None]
 
         def layer(carry, lp):
             x, = carry
@@ -467,7 +500,8 @@ class LlamaModel:
             # cache stays in kv-head layout; the kernel expands GQA groups
             k_cache = k_cache.at[jnp.arange(B), lengths].set(kk)
             v_cache = v_cache.at[jnp.arange(B), lengths].set(vv)
-            attn = decode_attention(q, k_cache, v_cache, lengths + 1)
+            attn = decode_attention(q, k_cache, v_cache, lengths + 1,
+                                    window=c.sliding_window)
             out = jnp.einsum("bhd,hdH->bH", attn,
                              lp["attn"]["wo"].astype(c.dtype))
             x = x + out

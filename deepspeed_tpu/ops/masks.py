@@ -1,0 +1,30 @@
+"""Attention mask construction — ONE home for window/causal semantics.
+
+Every attention path (Llama train/prefill, ring SP, dense references)
+builds its mask here so the sliding-window definition cannot drift
+between them: causal = ``iq >= ik``; window W limits reach to
+``|iq - ik| < W`` — one-sided (past only) under causality, symmetric for
+bidirectional use (a non-causal "window" that bounded only the past
+would silently attend unboundedly forward).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def local_attention_mask(q_pos: jnp.ndarray, k_pos: jnp.ndarray,
+                         causal: bool = True,
+                         window: Optional[int] = None) -> jnp.ndarray:
+    """[Sq, Sk] boolean mask from absolute position vectors."""
+    dq = q_pos[:, None]
+    dk = k_pos[None, :]
+    mask = dq >= dk if causal else jnp.ones((q_pos.size, k_pos.size), bool)
+    if window is not None:
+        if causal:
+            mask = mask & (dq - dk < window)
+        else:
+            mask = mask & (jnp.abs(dq - dk) < window)
+    return mask

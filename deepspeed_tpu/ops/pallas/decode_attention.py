@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _reference_decode(q, k_cache, v_cache, lengths):
+def _reference_decode(q, k_cache, v_cache, lengths, window=None):
     # q: [B, h, d]; caches: [B, Smax, kv_h, d] with kv_h | h (GQA); lengths: [B]
     n_rep = q.shape[1] // k_cache.shape[2]
     if n_rep > 1:
@@ -34,7 +34,10 @@ def _reference_decode(q, k_cache, v_cache, lengths):
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhd,bkhd->bhk", q, k_cache).astype(jnp.float32) * scale
     Smax = k_cache.shape[1]
-    mask = jnp.arange(Smax)[None, None, :] < lengths[:, None, None]
+    pos = jnp.arange(Smax)[None, None, :]
+    mask = pos < lengths[:, None, None]
+    if window is not None:  # sliding window: only the last `window` tokens
+        mask = mask & (pos >= lengths[:, None, None] - window)
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhk,bkhd->bhd", p, v_cache)
@@ -93,12 +96,17 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
 
 def decode_attention(q, k_cache, v_cache, lengths, block_k: int = 128,
-                     interpret: bool | None = None):
+                     interpret: bool | None = None, window=None):
     """q ``[B, h, d]`` one-token queries over padded caches
     ``[B, Smax, kv_h, d]`` (``kv_h`` divides ``h`` — GQA groups expanded
-    inside the kernel) with per-sequence ``lengths [B]``."""
+    inside the kernel) with per-sequence ``lengths [B]``.  ``window``
+    (Mistral sliding window) routes to the masked reference path — the
+    blocked kernel's window support (skipping pre-window blocks' DMA) is a
+    serving optimization for a later round."""
     from jax.experimental import pallas as pl
 
+    if window is not None:
+        return _reference_decode(q, k_cache, v_cache, lengths, window)
     if interpret is None:
         if jax.default_backend() != "tpu":
             return _reference_decode(q, k_cache, v_cache, lengths)

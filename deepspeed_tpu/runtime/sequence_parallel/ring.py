@@ -35,7 +35,7 @@ P = PartitionSpec
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, sp: int,
-                          causal: bool):
+                          causal: bool, window=None):
     """Per-device body: ``q [B, Sl, h, d]``, ``k/v [B, Sl, kv_h, d]`` with
     ``kv_h | h`` — GQA groups rotate at their stored width and expand
     per-visit (rotating pre-expanded heads would multiply the ppermute
@@ -57,11 +57,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int,
             kbf = jnp.repeat(kbf, n_rep, axis=2)
             vbf = jnp.repeat(vbf, n_rep, axis=2)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kbf)
-        if causal:
+        if causal or window is not None:
+            from ...ops.masks import local_attention_mask
+
             # global positions: mine = my*Sl + iq, theirs = src*Sl + ik
             iq = my * Sl + jnp.arange(Sl)
             ik = src * Sl + jnp.arange(Sl)
-            mask = iq[:, None] >= ik[None, :]
+            mask = local_attention_mask(iq, ik, causal=causal, window=window)
             s = jnp.where(mask[None, None], s, -jnp.inf)
         m_blk = jnp.max(s, axis=-1)                      # [B, h, Sl]
         m_new = jnp.maximum(m, m_blk)
@@ -92,7 +94,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int,
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    causal: bool = True,
-                   mesh: Optional[Mesh] = None) -> jnp.ndarray:
+                   mesh: Optional[Mesh] = None,
+                   window: Optional[int] = None) -> jnp.ndarray:
     """Sequence-parallel attention over the ``seq`` mesh axis.
 
     ``q,k,v``: GLOBAL ``[B, S, h, d]`` arrays (seq-sharded or not — the
@@ -104,7 +107,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     mesh = mesh if mesh is not None else groups_mod.get_mesh()
     sp = int(mesh.shape.get(AXIS_SEQ, 1))
     if sp == 1:
-        return _plain_attention(q, k, v, causal)
+        return _plain_attention(q, k, v, causal, window)
     if q.shape[1] % sp:
         raise ValueError(f"sequence {q.shape[1]} not divisible by sp={sp}")
 
@@ -114,20 +117,31 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ctx = jax.sharding.get_abstract_mesh()
     sm_mesh = ctx if ctx is not None and ctx.shape else mesh
     body = partial(_ring_attention_local, axis_name=AXIS_SEQ, sp=sp,
-                   causal=causal)
+                   causal=causal, window=window)
     spec = P(None, AXIS_SEQ, None, None)
     return jax.shard_map(body, mesh=sm_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False,
                          axis_names={AXIS_SEQ})(q, k, v)
 
 
-def _plain_attention(q, k, v, causal):
+def _plain_attention(q, k, v, causal, window=None):
     """Dense fallback/reference — one home for the math
     (``ops/pallas/flash_attention._reference_attention``), GQA-expanded."""
-    from ...ops.pallas.flash_attention import _reference_attention
-
     n_rep = q.shape[2] // k.shape[2]
     if n_rep > 1:
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
+    if window is not None:
+        from ...ops.masks import local_attention_mask
+
+        S = q.shape[1]
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        pos = jnp.arange(S)
+        mask = local_attention_mask(pos, pos, causal=causal, window=window)
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    from ...ops.pallas.flash_attention import _reference_attention
+
     return _reference_attention(q, k, v, causal)
