@@ -35,7 +35,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.mesh import AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, DP_AXES
-from ..utils.logging import logger
 
 P = PartitionSpec
 
@@ -288,17 +287,12 @@ class LlamaModel:
             q, kk = apply_rope_qk(q, kk)
             S = q.shape[1]
             W = c.sliding_window
-            if c.attn_impl == "flash" and W is None:
+            if c.attn_impl == "flash":
                 from ..ops.pallas.flash_attention import flash_attention
 
-                return flash_attention(q, kk, vv, True)
-            if c.attn_impl == "flash" and W is not None \
-                    and not getattr(self, "_warned_flash_window", False):
-                self._warned_flash_window = True
-                logger.warning(
-                    "sliding_window is set: the flash kernel has no window "
-                    "support yet, falling back to MASKED DENSE attention "
-                    "(O(S^2) scores — expect much higher memory at long S)")
+                # window rides into the kernel: k-blocks wholly outside the
+                # window are skipped, so windowed work is O(S·W), not O(S²)
+                return flash_attention(q, kk, vv, True, window=W)
             from ..ops.masks import local_attention_mask
 
             pos = jnp.arange(S)

@@ -23,31 +23,37 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _reference_attention(q, k, v, causal: bool):
+def _mask(S, T, causal, window=None):
+    from ..masks import local_attention_mask
+
+    return local_attention_mask(jnp.arange(S), jnp.arange(T),
+                                causal=causal, window=window)
+
+
+def _reference_attention(q, k, v, causal: bool, window=None):
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        S, T = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((S, T), bool))
-        s = jnp.where(mask, s, -1e30)
+    if causal or window is not None:
+        s = jnp.where(_mask(s.shape[-2], s.shape[-1], causal, window),
+                      s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _reference_fwd_with_lse(q, k, v, causal: bool):
+def _reference_fwd_with_lse(q, k, v, causal: bool, window=None):
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        S, T = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((S, T), bool))
-        s = jnp.where(mask, s, -1e30)
+    if causal or window is not None:
+        s = jnp.where(_mask(s.shape[-2], s.shape[-1], causal, window),
+                      s, -1e30)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, h, S]
     p = jnp.exp(s - lse[..., None]).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-               block_k: int, seq_len: int, causal: bool, scale: float):
+               block_k: int, seq_len: int, causal: bool, scale: float,
+               window=None):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -66,10 +72,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or window is not None:
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+            keep = q_pos >= k_pos if causal else jnp.bool_(True)
+            if window is not None:
+                reach = (q_pos - k_pos < window if causal
+                         else jnp.abs(q_pos - k_pos) < window)
+                keep = keep & reach
+            s = jnp.where(keep, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -85,16 +96,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         nk_eff = jnp.minimum(nk_eff, nk)
     else:
         nk_eff = nk
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    if window is not None:
+        # sliding window: blocks entirely BEFORE the earliest reachable
+        # position are skipped too — this is where flash beats the dense
+        # mask for windowed (Mistral) configs: work per q block is
+        # O(window), not O(S)
+        k0 = jnp.maximum(qi * block_q - (window - 1), 0) // block_k
+    else:
+        k0 = 0
+    m, l, acc = jax.lax.fori_loop(k0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[0] = (m + jnp.log(l))[:, None]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 128, block_k: int = 128):
-    """[B, S, h, d] attention; Pallas on TPU, jnp reference elsewhere."""
-    return _flash_fwd(q, k, v, causal, block_q, block_k)[0]
+                    block_q: int = 128, block_k: int = 128,
+                    window=None):
+    """[B, S, h, d] attention; Pallas on TPU, jnp reference elsewhere.
+    ``window`` = sliding-window reach (ops/masks semantics); the kernel
+    skips k-blocks wholly outside the window."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k, window)[0]
 
 
 def _use_pallas() -> bool:
@@ -102,14 +124,14 @@ def _use_pallas() -> bool:
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                with_lse: bool = False):
+                with_lse: bool = False, window=None):
     from jax.experimental import pallas as pl
 
     B, S, h, d = q.shape
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
-        out, lse = _reference_fwd_with_lse(q, k, v, causal)
+        out, lse = _reference_fwd_with_lse(q, k, v, causal, window)
         return (out, lse) if with_lse else out
     # [B, S, h, d] -> [B*h, S, d]
     qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
@@ -118,7 +140,7 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
 
     kernel = functools.partial(
         _fa_kernel, block_q=block_q, block_k=block_k, seq_len=S,
-        causal=causal, scale=1.0 / np.sqrt(d))
+        causal=causal, scale=1.0 / np.sqrt(d), window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * h, S // block_q),
@@ -144,16 +166,17 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
     return (out, lse) if with_lse else out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+def _flash_fwd(q, k, v, causal, block_q, block_k, window=None):
     if _use_pallas():
         out, lse = _flash_call(q, k, v, causal, block_q, block_k,
-                               interpret=False, with_lse=True)
+                               interpret=False, with_lse=True,
+                               window=window)
     else:
-        out, lse = _reference_fwd_with_lse(q, k, v, causal)
+        out, lse = _reference_fwd_with_lse(q, k, v, causal, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, do):
+def _flash_bwd(causal, block_q, block_k, window, res, do):
     """Flash-style chunked backward: scan over k-blocks, O(S·block_k) live.
 
     Uses the saved per-row log-sum-exp (no softmax re-normalization pass)
@@ -181,9 +204,12 @@ def _flash_bwd(causal, block_q, block_k, res, do):
         ki, kblk, vblk = chunk
         kb32 = kblk.astype(jnp.float32)
         s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb32) * scale
-        if causal:
+        if causal or window is not None:
+            from ..masks import local_attention_mask
+
             k_pos = ki * blk + jnp.arange(blk)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -1e30)
+            s = jnp.where(local_attention_mask(q_pos, k_pos, causal, window),
+                          s, -1e30)
         p = jnp.exp(s - lse[..., None])  # [B, h, S, blk]
         dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
         dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vblk.astype(jnp.float32))
@@ -204,6 +230,8 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_interpret(q, k, v, causal: bool = True,
-                              block_q: int = 64, block_k: int = 64):
+                              block_q: int = 64, block_k: int = 64,
+                              window=None):
     """Interpreter-mode kernel run (CPU numerics testing)."""
-    return _flash_call(q, k, v, causal, block_q, block_k, interpret=True)
+    return _flash_call(q, k, v, causal, block_q, block_k, interpret=True,
+                       window=window)

@@ -131,17 +131,6 @@ def _plain_attention(q, k, v, causal, window=None):
     if n_rep > 1:
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
-    if window is not None:
-        from ...ops.masks import local_attention_mask
-
-        S = q.shape[1]
-        scale = 1.0 / np.sqrt(q.shape[-1])
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-        pos = jnp.arange(S)
-        mask = local_attention_mask(pos, pos, causal=causal, window=window)
-        s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
     from ...ops.pallas.flash_attention import _reference_attention
 
-    return _reference_attention(q, k, v, causal)
+    return _reference_attention(q, k, v, causal, window)
