@@ -11,7 +11,9 @@ that compose with the ZeRO sharding policy.
 from .bert import BertConfig, BertModel
 from .llama import LlamaConfig, LlamaModel
 from .mixtral import MixtralConfig, MixtralModel
+from .opt import OPTConfig, OPTModel
 from .resnet import ResNetConfig, ResNetModel
 
 __all__ = ["BertConfig", "BertModel", "LlamaConfig", "LlamaModel",
-           "MixtralConfig", "MixtralModel", "ResNetConfig", "ResNetModel"]
+           "MixtralConfig", "MixtralModel", "OPTConfig", "OPTModel",
+           "ResNetConfig", "ResNetModel"]

@@ -313,9 +313,6 @@ class BertModel:
                               batch.get("attention_mask"),
                               batch.get("token_type_ids"),
                               ltd_step=batch.get("_step"))
-        valid = labels != -100
-        safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
-            jnp.sum(valid), 1)
+        from .llama import masked_cross_entropy
+
+        return masked_cross_entropy(logits, labels)

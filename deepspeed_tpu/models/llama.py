@@ -146,6 +146,18 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
+def masked_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray
+                         ) -> jnp.ndarray:
+    """Token-mean CE with -100 ignore positions (HF convention) — the one
+    home of the loss tail shared by every LM in the zoo."""
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1)
+
+
 def _attention(q, k, v, mask):
     """Reference attention: fp32 softmax; [B, S, h, D] layout.
 
@@ -551,13 +563,8 @@ class LlamaModel:
             return sequence_tiled_loss(
                 lambda h: jnp.einsum("bsH,HV->bsV", h, head),
                 hidden, labels, c.loss_tiles)
-        logits = jnp.einsum("bsH,HV->bsV", hidden, head).astype(jnp.float32)
-        valid = labels != -100
-        safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
-            jnp.sum(valid), 1)
+        logits = jnp.einsum("bsH,HV->bsV", hidden, head)
+        return masked_cross_entropy(logits, labels)
 
     def head_loss(self, params: Any, x: jnp.ndarray, batch: Any
                   ) -> jnp.ndarray:
