@@ -43,11 +43,10 @@ class RaggedInferenceEngineV2:
         self.cache_config = cache_config or KVCacheConfig()
         if prefill_chunk % self.cache_config.block_size:
             raise ValueError("prefill_chunk must be a multiple of block_size")
-        if getattr(self.config, "sliding_window", None):
-            raise NotImplementedError(
-                "sliding-window models are not supported by the v2 paged "
-                "engine yet (its attention masks are causal-only); use the "
-                "v1 engine")
+        #: Mistral-style window, threaded into both compiled programs'
+        #: masks (pages before the window still occupy pool slots — a
+        #: window-aware page-release policy is a later optimization)
+        self.window = getattr(self.config, "sliding_window", None)
         if self.cache_config.max_seq_len % prefill_chunk:
             # keeps every chunk's page-table slice in range: dynamic_slice
             # clamps out-of-bounds starts, which would silently retarget a
@@ -104,11 +103,13 @@ class RaggedInferenceEngineV2:
             if n_rep > 1:
                 kf = jnp.repeat(kf, n_rep, axis=1)
                 vf = jnp.repeat(vf, n_rep, axis=1)
+            from ...ops.masks import local_attention_mask
+
             scale = 1.0 / np.sqrt(c.hd)
             s = jnp.einsum("qhd,khd->hqk", q, kf).astype(jnp.float32) * scale
-            k_pos = jnp.arange(mb * bs)
-            mask = k_pos[None, None, :] <= positions[None, :, None]
-            s = jnp.where(mask, s, -1e30)
+            mask = local_attention_mask(positions, jnp.arange(mb * bs),
+                                        causal=True, window=self.window)
+            s = jnp.where(mask[None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
             attn = jnp.einsum("hqk,khd->qhd", p, vf)
             out = jnp.einsum("qhd,hdH->qH", attn,
@@ -151,7 +152,7 @@ class RaggedInferenceEngineV2:
             k_pool_l = k_pool_l.at[page_ids, offsets].set(kk)
             v_pool_l = v_pool_l.at[page_ids, offsets].set(vv)
             attn = paged_decode_attention(q, k_pool_l, v_pool_l, tables,
-                                          kv_lens + 1)
+                                          kv_lens + 1, window=self.window)
             out = jnp.einsum("bhd,hdH->bH", attn,
                              lp["attn"]["wo"].astype(c.dtype))
             x = x + out

@@ -25,9 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def paged_decode_reference(q, k_pool, v_pool, block_tables, lengths):
+def paged_decode_reference(q, k_pool, v_pool, block_tables, lengths,
+                           window=None):
     """Pure-jnp reference.  ``q [B, h, d]``; pools ``[N, bs, kv_h, d]``;
-    ``block_tables [B, max_blocks]``; ``lengths [B]``."""
+    ``block_tables [B, max_blocks]``; ``lengths [B]``; ``window`` =
+    sliding-window reach (only the last ``window`` cache entries)."""
     B = q.shape[0]
     _, bs, kv_h, d = k_pool.shape
     max_blocks = block_tables.shape[1]
@@ -40,7 +42,10 @@ def paged_decode_reference(q, k_pool, v_pool, block_tables, lengths):
         v = jnp.repeat(v, n_rep, axis=2)
     scale = 1.0 / np.sqrt(d)
     s = jnp.einsum("bhd,bkhd->bhk", q, k).astype(jnp.float32) * scale
-    mask = jnp.arange(max_blocks * bs)[None, None, :] < lengths[:, None, None]
+    pos = jnp.arange(max_blocks * bs)[None, None, :]
+    mask = pos < lengths[:, None, None]
+    if window is not None:
+        mask = mask & (pos >= lengths[:, None, None] - window)
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhk,bkhd->bhd", p, v)
@@ -52,7 +57,7 @@ def _num_valid_blocks(length, block_size):
 
 def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
                   m_ref, l_ref, acc_ref, *, block_size: int, num_blocks: int,
-                  scale: float, n_rep: int):
+                  scale: float, n_rep: int, window=None):
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
@@ -66,7 +71,16 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(ki < nk_valid)
+    if window is not None:
+        # skip blocks wholly BEFORE the window: a fully-masked block would
+        # otherwise poison the online softmax (exp(-1e30 - m) with m also
+        # -1e30 is exp(0)); the boundary block always has >=1 live entry
+        k0 = jnp.maximum(length - window, 0) // block_size
+        in_range = (ki < nk_valid) & (ki >= k0)
+    else:
+        in_range = ki < nk_valid
+
+    @pl.when(in_range)
     def _update():
         q = q_ref[0].astype(jnp.float32) * scale  # [h, d]
         h = q.shape[0]
@@ -78,7 +92,10 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
         s = jnp.sum(kblk * q[None, :, :], axis=-1)  # [block_size, h]
         pos = ki * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (block_size, h), 0)
-        s = jnp.where(pos < length, s, -1e30)
+        keep = pos < length
+        if window is not None:  # sliding window: only the cache tail
+            keep = keep & (pos >= length - window)
+        s = jnp.where(keep, s, -1e30)
         m_prev = m_ref[0]
         l_prev = l_ref[0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
@@ -96,35 +113,44 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
-                           interpret: bool | None = None):
+                           interpret: bool | None = None, window=None):
     """One-token queries ``q [B, h, d]`` over a shared paged KV pool
     ``[N, block_size, kv_h, d]`` addressed by ``block_tables [B, max_blocks]``
-    with true ``lengths [B]``."""
+    with true ``lengths [B]``.  ``window`` routes to the masked reference
+    path (kernel-side page skipping for windows is a later optimization,
+    same status as ``decode_attention``)."""
     from jax.experimental import pallas as pl
 
     if interpret is None:
         if jax.default_backend() != "tpu":
             return paged_decode_reference(q, k_pool, v_pool, block_tables,
-                                          lengths)
+                                          lengths, window)
         interpret = False
     B, h, d = q.shape
     _, block_size, kv_h, _ = k_pool.shape
     max_blocks = block_tables.shape[1]
     n_rep = h // kv_h
     if h % kv_h:
-        return paged_decode_reference(q, k_pool, v_pool, block_tables, lengths)
+        return paged_decode_reference(q, k_pool, v_pool, block_tables,
+                                      lengths, window)
 
     kernel = functools.partial(_paged_kernel, block_size=block_size,
                                num_blocks=max_blocks,
-                               scale=1.0 / np.sqrt(d), n_rep=n_rep)
+                               scale=1.0 / np.sqrt(d), n_rep=n_rep,
+                               window=window)
     from jax.experimental.pallas import tpu as pltpu
 
     def _kv_index(b, ki, lens, table):
         # in-range pages resolve through the block table; out-of-range grid
-        # steps clamp onto the sequence's last valid page (the repeated DMA
-        # is a no-op and compute is @pl.when-skipped)
+        # steps clamp onto a valid page (the repeated DMA is a no-op and
+        # compute is masked); with a window, pages wholly BEFORE the
+        # window clamp forward onto the window's first page — their
+        # compute is fully masked, and their DMA collapses to a revisit
         nk_valid = _num_valid_blocks(lens[b], jnp.int32(block_size))
         ki_c = jnp.minimum(ki, jnp.maximum(nk_valid - 1, 0))
+        if window is not None:
+            k0 = jnp.maximum(lens[b] - window, 0) // block_size
+            ki_c = jnp.maximum(ki_c, k0)
         return (table[b, ki_c], 0, 0, 0)
 
     out = pl.pallas_call(

@@ -240,3 +240,24 @@ def test_v2_eos_stops_early(tiny_model):
     # chosen token before position 3), eos itself included — v1 semantics
     stop = want.index(eos)
     assert got[0] == want[:stop + 1]
+
+
+def test_paged_kernel_window_matches_reference():
+    """Windowed paged kernel (interpret) == windowed reference — including
+    sequences long enough that whole pages fall before the window (the
+    fully-masked-block hazard)."""
+    rng = np.random.RandomState(9)
+    B, h, d, bs, max_blocks, num_pool = 2, 4, 8, 8, 4, 16
+    kv_h = 2
+    q = jnp.asarray(rng.randn(B, h, d).astype(np.float32))
+    k_pool = jnp.asarray(rng.randn(num_pool, bs, kv_h, d).astype(np.float32))
+    v_pool = jnp.asarray(rng.randn(num_pool, bs, kv_h, d).astype(np.float32))
+    tables = jnp.asarray(np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32))
+    lengths = jnp.asarray(np.array([30, 12], np.int32))
+    for W in (5, 9, 40):
+        want = paged_decode_reference(q, k_pool, v_pool, tables, lengths,
+                                      window=W)
+        got = paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                                     interpret=True, window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"W={W}")

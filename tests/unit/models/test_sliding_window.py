@@ -79,13 +79,30 @@ def test_ring_window_matches_dense_window():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_v2_rejects_sliding_window():
-    from deepspeed_tpu.inference.v2 import build_engine_v2
+def test_v2_windowed_ragged_matches_v1():
+    """The paged v2 engine honors the sliding window: ragged greedy
+    generate == the v1 (cached, windowed) engine per prompt."""
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.inference.v2 import KVCacheConfig, build_engine_v2
 
-    cfg = _cfg()
+    cfg = _cfg(max_seq_len=64)
     model = LlamaModel(cfg)
-    with pytest.raises(NotImplementedError, match="sliding"):
-        build_engine_v2(model, model.init_params(jax.random.PRNGKey(0)))
+    params = model.init_params(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 512, size=n).tolist() for n in (4, 13)]
+
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=2, prefill_chunk=16)
+    assert eng2.window == cfg.sliding_window
+    got = eng2.generate(prompts, max_new_tokens=6)
+    v1 = init_inference(model=model, model_params=params)
+    for prompt, g in zip(prompts, got):
+        want = np.asarray(v1.generate(
+            jnp.asarray([prompt]), max_new_tokens=6))[0, len(prompt):]
+        np.testing.assert_array_equal(np.asarray(g), want)
 
 
 def test_flash_kernel_window_matches_reference():
