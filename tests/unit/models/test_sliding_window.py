@@ -132,3 +132,18 @@ def test_flash_kernel_window_matches_reference():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_flash_blocks_shrink_to_divisor():
+    """S divisible by 128 but not 512 still runs the kernel (blocks shrink
+    to a divisor instead of falling to the dense path)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import (
+        _reference_attention, flash_attention_interpret)
+
+    rng = np.random.RandomState(11)
+    S = 320  # divisible by 64, not by 128/256/512
+    q = jnp.asarray(rng.randn(1, S, 2, 16) * .3, jnp.float32)
+    got = flash_attention_interpret(q, q, q, True, 512, 512)
+    want = _reference_attention(q, q, q, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
