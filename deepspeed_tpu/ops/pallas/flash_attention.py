@@ -132,12 +132,13 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
     from jax.experimental import pallas as pl
 
     B, S, h, d = q.shape
-    # shrink blocks to divisors of S (halving preserves TPU-friendly
-    # sizes): S=1920 with 512-defaults still runs the kernel at 128/128
-    # instead of falling to the O(S^2) dense path
+    # shrink blocks to divisors of S that keep the (8, 128) sublane tiling
+    # legal: S=1920 with 512-defaults runs the kernel at 128/128 instead
+    # of the O(S^2) dense path; a non-8-aligned S (e.g. 321) can never
+    # satisfy both constraints and drops to the dense reference
     def fit(b):
         b = min(b, S)
-        while b > 1 and S % b:
+        while b >= 64 and (S % b or b % 8):
             b //= 2
         return b
 

@@ -141,9 +141,18 @@ def test_flash_blocks_shrink_to_divisor():
         _reference_attention, flash_attention_interpret)
 
     rng = np.random.RandomState(11)
-    S = 320  # divisible by 64, not by 128/256/512
+    S = 640  # > 512 and divisible by 128, not by 512 → halving must run
     q = jnp.asarray(rng.randn(1, S, 2, 16) * .3, jnp.float32)
     got = flash_attention_interpret(q, q, q, True, 512, 512)
     want = _reference_attention(q, q, q, True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # non-8-aligned S can never satisfy the sublane rule → dense fallback
+    # (must still be numerically correct)
+    S2 = 321
+    q2 = jnp.asarray(rng.randn(1, S2, 2, 16) * .3, jnp.float32)
+    got2 = flash_attention_interpret(q2, q2, q2, True, 512, 512)
+    np.testing.assert_allclose(np.asarray(got2),
+                               np.asarray(_reference_attention(
+                                   q2, q2, q2, True)),
                                rtol=2e-5, atol=2e-5)
