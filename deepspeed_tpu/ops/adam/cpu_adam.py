@@ -53,28 +53,42 @@ class DeepSpeedCPUAdam:
             bias_correction=bias_correction, adamw_mode=adamw_mode)
         self.state_step = 0
 
+    def begin_step(self, lr: Optional[float] = None) -> None:
+        """Advance the shared step counter once per optimizer step; slots
+        are then updated individually via :meth:`step_slot` (the offload
+        engine's bucket pipeline interleaves them with transfers)."""
+        self.state_step += 1
+        self._lr = float(lr if lr is not None else self.defaults["lr"])
+
+    def step_slot(self, i: int, grad: np.ndarray,
+                  bf16_out: Optional[np.ndarray] = None) -> None:
+        """Fused Adam(W) over slot ``i`` only.  ``bf16_out`` (uint16 view)
+        optionally receives the updated params in bf16 wire format.  The
+        ctypes call releases the GIL, so concurrent d2h waits and h2d
+        dispatch in other threads overlap with this compute."""
+        d = self.defaults
+        p = self.params[i]
+        g = np.ascontiguousarray(grad, dtype=np.float32)
+        args = [_fp(p), _fp(g), _fp(self.exp_avg[i]), _fp(self.exp_avg_sq[i])]
+        common = [ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
+                  ctypes.c_float(self._lr), ctypes.c_float(d["betas"][0]),
+                  ctypes.c_float(d["betas"][1]), ctypes.c_float(d["eps"]),
+                  ctypes.c_float(d["weight_decay"]),
+                  ctypes.c_int(int(d["adamw_mode"])),
+                  ctypes.c_int(int(d["bias_correction"]))]
+        if bf16_out is not None:
+            self.lib.ds_adam_step_bf16(
+                *args, bf16_out.ctypes.data_as(_u16p), *common)
+        else:
+            self.lib.ds_adam_step(*args, *common)
+
     def step(self, grads: Sequence[np.ndarray],
              bf16_out: Optional[Sequence[np.ndarray]] = None,
              lr: Optional[float] = None) -> None:
         """One fused step over every shard. ``grads[i]`` matches
         ``self.params[i]``; optional ``bf16_out[i]`` (uint16 view) receives
         the updated params in bf16."""
-        d = self.defaults
-        self.state_step += 1
-        use_lr = float(lr if lr is not None else d["lr"])
-        for i, (p, g) in enumerate(zip(self.params, grads)):
-            g = np.ascontiguousarray(g, dtype=np.float32)
-            args = [_fp(p), _fp(g), _fp(self.exp_avg[i]),
-                    _fp(self.exp_avg_sq[i])]
-            common = [ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
-                      ctypes.c_float(use_lr), ctypes.c_float(d["betas"][0]),
-                      ctypes.c_float(d["betas"][1]), ctypes.c_float(d["eps"]),
-                      ctypes.c_float(d["weight_decay"]),
-                      ctypes.c_int(int(d["adamw_mode"])),
-                      ctypes.c_int(int(d["bias_correction"]))]
-            if bf16_out is not None:
-                out = bf16_out[i]
-                self.lib.ds_adam_step_bf16(
-                    *args, out.ctypes.data_as(_u16p), *common)
-            else:
-                self.lib.ds_adam_step(*args, *common)
+        self.begin_step(lr)
+        for i in range(len(self.params)):
+            self.step_slot(i, grads[i],
+                           None if bf16_out is None else bf16_out[i])

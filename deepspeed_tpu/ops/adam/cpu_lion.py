@@ -27,15 +27,26 @@ class DeepSpeedCPULion:
         self.lr, self.betas, self.weight_decay = lr, betas, weight_decay
         self.state_step = 0
 
+    def begin_step(self, lr: Optional[float] = None) -> None:
+        self.state_step += 1
+        self._lr = float(lr if lr is not None else self.lr)
+
+    def step_slot(self, i: int, grad: np.ndarray,
+                  bf16_out: Optional[np.ndarray] = None) -> None:
+        if bf16_out is not None:
+            raise NotImplementedError("bf16 wire emit is Adam-only")
+        p = self.params[i]
+        g = np.ascontiguousarray(grad, dtype=np.float32)
+        self.lib.ds_lion_step(
+            p.ctypes.data_as(_f32p), g.ctypes.data_as(_f32p),
+            self.exp_avg[i].ctypes.data_as(_f32p),
+            ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
+            ctypes.c_float(self._lr),
+            ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+            ctypes.c_float(self.weight_decay))
+
     def step(self, grads: Sequence[np.ndarray],
              lr: Optional[float] = None) -> None:
-        self.state_step += 1
-        for i, (p, g) in enumerate(zip(self.params, grads)):
-            g = np.ascontiguousarray(g, dtype=np.float32)
-            self.lib.ds_lion_step(
-                p.ctypes.data_as(_f32p), g.ctypes.data_as(_f32p),
-                self.exp_avg[i].ctypes.data_as(_f32p),
-                ctypes.c_int64(p.size), ctypes.c_int(self.state_step),
-                ctypes.c_float(float(lr if lr is not None else self.lr)),
-                ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
-                ctypes.c_float(self.weight_decay))
+        self.begin_step(lr)
+        for i in range(len(self.params)):
+            self.step_slot(i, grads[i])

@@ -116,9 +116,10 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
                            interpret: bool | None = None, window=None):
     """One-token queries ``q [B, h, d]`` over a shared paged KV pool
     ``[N, block_size, kv_h, d]`` addressed by ``block_tables [B, max_blocks]``
-    with true ``lengths [B]``.  ``window`` routes to the masked reference
-    path (kernel-side page skipping for windows is a later optimization,
-    same status as ``decode_attention``)."""
+    with true ``lengths [B]``.  ``window`` (sliding-window attention) is
+    handled natively by the kernel: out-of-window pages are skipped via the
+    k0 grid start in ``_paged_kernel`` and the clamped ``_kv_index``, so no
+    dead-page work is done."""
     from jax.experimental import pallas as pl
 
     if interpret is None:

@@ -150,6 +150,275 @@ def _interleaved_apply(layer_fn, stacked_params, microbatches, mesh,
                          axis_names={AXIS_PIPE})(stacked_params, microbatches)
 
 
+def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
+                        stacked_params: Any,
+                        embed_fn: Callable[[Any, Any], Any],
+                        embed_params: Any,
+                        head_fn: Callable[[Any, Any, Any], jnp.ndarray],
+                        head_params: Any,
+                        microbatches: Any,
+                        mesh: Mesh):
+    """1F1B training schedule: mean loss + grads in ONE pass with O(pp)
+    stashed activations per stage — vs GPipe-through-autodiff, which keeps
+    all M microbatch activations live until the backward drain.
+
+    Reference: ``runtime/pipe/schedule.py`` ``TrainSchedule`` [K] — warmup
+    forwards, steady-state alternating 1F1B, cooldown backwards.  TPU-native
+    realization: the instruction stream is a ``lax.scan`` over lockstep
+    ticks inside ``shard_map``; Send/RecvActivation is the forward
+    ``ppermute`` ring, Send/RecvGrad the backward ring, and per-stage weight
+    residency is simply the pipe-sharded ``[L, ...]`` stack (params never
+    move).  Schedule (tick ``t``, stage ``s``, micro ``m``):
+
+        F_s(m) at t = m + s              (stage 0 embeds micro m at t = m)
+        B_s(m) at t = m + 2·pp - 2 - s   (last stage fuses F+loss+B)
+
+    so stage ``s`` holds at most ``2(pp-s)-1 ≤ 2pp-1`` stashed activations
+    regardless of M.  Backward recomputes the stage forward from the stashed
+    stage INPUT (activation checkpointing at stage boundaries — the same
+    memory/compute trade the reference runs PP with).
+
+    ``layer_fn(lp, x) -> x`` — one trunk layer (``x`` may be a pytree);
+    ``embed_fn(ep, micro) -> x`` — builds stage-0 input from one microbatch;
+    ``head_fn(hp, x, micro) -> scalar`` — per-micro loss (mean over rows);
+    ``microbatches`` — pytree with leading dim M.  Call inside jit.
+
+    Returns ``(loss_mean, (trunk_grads, embed_grads, head_grads), stats)``;
+    ``stats["stash_depth"]`` is the per-stage live-activation bound (the
+    GPipe equivalent is M).
+    """
+    pp = int(mesh.shape[AXIS_PIPE])
+    tmap = jax.tree.map
+    M = int(jax.tree.leaves(microbatches)[0].shape[0])
+    S = 2 * pp - 1            # stash ring depth — the 1F1B memory bound
+    T = M + 2 * pp - 2        # warmup + steady + cooldown ticks
+
+    def chunk_fwd(pl, x):
+        def body(h, lp):
+            return layer_fn(lp, h), None
+        out, _ = jax.lax.scan(body, x, pl)
+        return out
+
+    def per_stage(pl, ep, hp, micros):
+        s = jax.lax.axis_index(AXIS_PIPE)
+        is_last = s == pp - 1
+        micro0 = tmap(lambda a: a[0], micros)
+        x0 = embed_fn(ep, micro0)
+        zero_act = tmap(lambda z: jnp.zeros_like(z), x0)
+        stash0 = tmap(lambda z: jnp.zeros((S,) + z.shape, z.dtype), zero_act)
+
+        def zlike(tree):
+            return tmap(lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+        def tick(carry, t):
+            f_recv, b_recv, stash, gacc, ge, gh, loss_acc = carry
+            # ---------------- forward ----------------
+            m_f = t - s
+            f_active = (m_f >= 0) & (m_f < M)
+            micro_f = tmap(lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.clip(m_f, 0, M - 1), 0, keepdims=False), micros)
+            x_embed = embed_fn(ep, micro_f)   # consumed by stage 0 only
+            x_in = tmap(lambda e, r: jnp.where(s == 0, e, r),
+                        x_embed, f_recv)
+            slot_f = jnp.clip(jnp.remainder(m_f, S), 0, S - 1)
+            stash = tmap(
+                lambda st, xi: jnp.where(
+                    f_active,
+                    jax.lax.dynamic_update_index_in_dim(st, xi, slot_f, 0),
+                    st), stash, x_in)
+
+            # forward branch: 0 = idle, 1 = plain F, 2 = last-stage F+loss+B
+            def idle_f(xi, mf):
+                return (xi, jnp.float32(0.0), zlike(hp), zlike(pl),
+                        zero_act)
+
+            def plain_f(xi, mf):
+                return (chunk_fwd(pl, xi), jnp.float32(0.0), zlike(hp),
+                        zlike(pl), zero_act)
+
+            def fused_fb(xi, mf):
+                x2, cvjp = jax.vjp(chunk_fwd, pl, xi)
+                loss_m, hvjp = jax.vjp(
+                    lambda hp_, xx: head_fn(hp_, xx, mf), hp, x2)
+                dhp, dx2 = hvjp(jnp.asarray(1.0 / M, loss_m.dtype))
+                dpl, dxi = cvjp(dx2)
+                return (x2, loss_m.astype(jnp.float32),
+                        tmap(lambda a: a.astype(jnp.float32), dhp),
+                        tmap(lambda a: a.astype(jnp.float32), dpl), dxi)
+
+            branch = jnp.where(f_active, jnp.where(is_last, 2, 1), 0)
+            x_out, loss_m, dhp, dpl_f, dxi_last = jax.lax.switch(
+                branch, (idle_f, plain_f, fused_fb), x_in, micro_f)
+            loss_acc = loss_acc + loss_m
+            gh = tmap(jnp.add, gh, dhp)
+
+            # ---------------- backward (non-last stages) ----------------
+            m_b = t - (2 * pp - 2 - s)
+            b_active = (m_b >= 0) & (m_b < M) & jnp.logical_not(is_last)
+            slot_b = jnp.clip(jnp.remainder(m_b, S), 0, S - 1)
+            x_b = tmap(lambda st: jax.lax.dynamic_index_in_dim(
+                st, slot_b, 0, keepdims=False), stash)
+
+            def do_bwd(xb, brecv):
+                _, cvjp = jax.vjp(chunk_fwd, pl, xb)
+                dpl, dxi = cvjp(brecv)
+                return tmap(lambda a: a.astype(jnp.float32), dpl), dxi
+
+            def skip_bwd(xb, brecv):
+                return zlike(pl), zero_act
+
+            dpl_b, dxi_b = jax.lax.cond(b_active, do_bwd, skip_bwd,
+                                        x_b, b_recv)
+            gacc = tmap(lambda g, a, b: g + a + b, gacc, dpl_f, dpl_b)
+
+            # stage 0's dx is the embed-output cotangent → embed grads.
+            # When stage 0 IS the last stage (pp == 1, or generally the
+            # fused branch at s == 0) the cotangent comes from the fused
+            # F+B (dxi_last) in the SAME tick (m_b == m_f there).
+            micro_b = tmap(lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.clip(m_b, 0, M - 1), 0, keepdims=False), micros)
+            dxi_0 = tmap(lambda a, b: jnp.where(is_last, a, b),
+                         dxi_last, dxi_b)
+            emb_active = (s == 0) & (b_active | (is_last & f_active))
+
+            def do_emb(mb, dxi):
+                _, evjp = jax.vjp(lambda ep_: embed_fn(ep_, mb), ep)
+                (dep,) = evjp(dxi)
+                return tmap(lambda a: a.astype(jnp.float32), dep)
+
+            def skip_emb(mb, dxi):
+                return zlike(ep)
+
+            ge = tmap(jnp.add, ge, jax.lax.cond(
+                emb_active, do_emb, skip_emb, micro_b, dxi_0))
+
+            # ---------------- rings ----------------
+            fwd_ring = [(i, (i + 1) % pp) for i in range(pp)]
+            bwd_ring = [(i, (i - 1) % pp) for i in range(pp)]
+            f_send = tmap(lambda o: jnp.where(f_active, o,
+                                              jnp.zeros_like(o)), x_out)
+            b_out = tmap(lambda a, b: jnp.where(is_last, a, b),
+                         dxi_last, dxi_b)
+            b_send = tmap(
+                lambda o: jnp.where(b_active | (is_last & f_active), o,
+                                    jnp.zeros_like(o)), b_out)
+            f_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, fwd_ring),
+                          f_send)
+            b_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, bwd_ring),
+                          b_send)
+            return (f_recv, b_recv, stash, gacc, ge, gh, loss_acc), None
+
+        init = (zero_act, zero_act, stash0, zlike(pl), zlike(ep), zlike(hp),
+                jnp.float32(0.0))
+        (_, _, _, gacc, ge, gh, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(T))
+        # loss / embed / head grads live on one stage each → psum replicates
+        loss = jax.lax.psum(loss_acc, AXIS_PIPE) / M
+        ge = tmap(lambda a: jax.lax.psum(a, AXIS_PIPE), ge)
+        gh = tmap(lambda a: jax.lax.psum(a, AXIS_PIPE), gh)
+        return loss, gacc, ge, gh
+
+    trunk_spec = pipeline_spec(jax.tree.map(jnp.ndim, stacked_params))
+    rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+    loss, g_trunk, g_emb, g_head = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(trunk_spec, rep(embed_params), rep(head_params),
+                  rep(microbatches)),
+        out_specs=(P(), trunk_spec, rep(embed_params), rep(head_params)),
+        check_vma=False,
+        axis_names={AXIS_PIPE})(stacked_params, embed_params, head_params,
+                                microbatches)
+    stats = {"stash_depth": S, "ticks": T, "gpipe_stash": M,
+             "bubble_fraction": pipeline_bubble_fraction(M, pp)}
+    return loss, (g_trunk, g_emb, g_head), stats
+
+
+def pipeline_apply_stages(stage_fns: Any, params: Any, microbatches: Any,
+                          mesh: Mesh) -> Any:
+    """GPipe fill/drain for HETEROGENEOUS stages (reference: arbitrary
+    ``LayerSpec`` graphs partitioned by ``PipelineModule``, SURVEY §3.5).
+
+    ``stage_fns[i](params, x) -> x`` — stage ``i``'s chain; stage 0 receives
+    a raw microbatch (so an embed front-end with a different input shape is
+    fine), every OTHER boundary activation must be shape-uniform (the
+    ``ppermute`` ring carries one activation type — the same constraint the
+    reference's P2P buffers impose per pipeline edge).  The last stage's
+    output may have its own shape (logits).  Each rank executes only its
+    own stage via ``lax.switch`` on the pipe index; params enter replicated
+    over ``pipe`` (generality traded for residency — homogeneous layer
+    stacks should use ``pipeline_apply`` / ``pipeline_train_1f1b``, which
+    shard the stack).
+
+    Returns the last stage's outputs ``[M, ...]``.  Call inside jit.
+    """
+    pp = int(mesh.shape[AXIS_PIPE])
+    assert len(stage_fns) == pp, (len(stage_fns), pp)
+    tmap = jax.tree.map
+    M = int(jax.tree.leaves(microbatches)[0].shape[0])
+    T = M + pp - 1
+    micro0 = tmap(lambda a: a[0], microbatches)
+
+    # shape donors: boundary activation (stage-0 output) and final output
+    hid_shape = jax.eval_shape(stage_fns[0], params, micro0)
+    x = hid_shape
+    for fn in stage_fns[1:]:
+        x = jax.eval_shape(fn, params, x)
+    fin_shape = x
+
+    if pp == 1:
+        return jax.lax.map(lambda m: stage_fns[0](params, m), microbatches)
+
+    def per_stage(p, xs):
+        stage = jax.lax.axis_index(AXIS_PIPE)
+        zero_hid = tmap(lambda d: jnp.zeros(d.shape, d.dtype), hid_shape)
+        zero_fin = tmap(lambda d: jnp.zeros(d.shape, d.dtype), fin_shape)
+        outs0 = tmap(lambda d: jnp.zeros((M,) + d.shape, d.dtype), fin_shape)
+
+        def branch(i):
+            def run(micro, recv):
+                out = stage_fns[i](p, micro if i == 0 else recv)
+                if i == pp - 1:
+                    return zero_hid, out
+                return out, zero_fin
+            return run
+
+        branches = [branch(i) for i in range(pp)]
+
+        def tick(carry, t):
+            recv, outs = carry
+            micro = tmap(lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.clip(t, 0, M - 1), 0, keepdims=False), xs)
+            ring_out, fin_out = jax.lax.switch(stage, branches, micro, recv)
+            idx = t - (pp - 1)
+            write = (stage == pp - 1) & (idx >= 0)
+            outs = tmap(
+                lambda acc, o: jnp.where(
+                    write,
+                    jax.lax.dynamic_update_index_in_dim(
+                        acc, o, jnp.clip(idx, 0, M - 1), 0),
+                    acc),
+                outs, fin_out)
+            nxt = tmap(lambda o: jax.lax.ppermute(
+                o, AXIS_PIPE, [(i, (i + 1) % pp) for i in range(pp)]),
+                ring_out)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (zero_hid, outs0), jnp.arange(T))
+        outs = tmap(lambda o: jax.lax.psum(
+            jnp.where(stage == pp - 1, o, jnp.zeros_like(o)), AXIS_PIPE),
+            outs)
+        return outs
+
+    return jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(), params),
+                  jax.tree.map(lambda _: P(), microbatches)),
+        out_specs=jax.tree.map(lambda _: P(), jax.tree.map(
+            lambda d: d, fin_shape)),
+        check_vma=False,
+        axis_names={AXIS_PIPE})(params, microbatches)
+
+
 def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stacked_params: Any,
                    microbatches: jnp.ndarray,

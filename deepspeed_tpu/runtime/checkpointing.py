@@ -204,6 +204,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     offload = getattr(engine, "offload_opt", None)
     if offload is not None:
+        restored_master = False
         offload_path = os.path.join(ckpt_dir, "offload_state")
         if os.path.exists(offload_path) and not params_only:
             with ocp.StandardCheckpointer() as loader:
@@ -211,10 +212,18 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                               for a in v]
                           for k, v in offload.state_dict_arrays().items()
                           if k != "step"}
-                restored_off = loader.restore(offload_path, target)
-            offload.load_state_arrays(restored_off)
-        # re-seed host fp32 master slices from the restored params
-        offload.reseed_masters(engine.state.params)
+                try:
+                    restored_off = loader.restore(offload_path, target)
+                except Exception:
+                    # legacy checkpoint (pre-round-3): no 'master' entry —
+                    # restore the moments and fall through to reseeding
+                    target.pop("master", None)
+                    restored_off = loader.restore(offload_path, target)
+            restored_master = offload.load_state_arrays(restored_off)
+        if not restored_master:
+            # legacy/params-only checkpoint: re-seed host fp32 master slices
+            # from the restored device params (exact only for an fp32 wire)
+            offload.reseed_masters(engine.state.params)
 
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state: Dict[str, Any] = {}

@@ -122,14 +122,18 @@ class DeepSpeedEngine:
                     "(embed_fwd/decoder_layer/head_loss protocol — see "
                     "runtime/swap_tensor/infinity_engine.py); "
                     f"{type(module).__name__} does not implement it")
-            world = int(np.prod(list(
-                (mesh if mesh is not None else groups_mod.get_mesh())
-                .shape.values())))
-            if world > 1 or getattr(module, "mesh", None) is not None:
+            eff_mesh = mesh if mesh is not None else groups_mod.get_mesh()
+            world = int(np.prod(list(eff_mesh.shape.values())))
+            if world > 1 and getattr(module, "mesh", None) is None:
                 raise ValueError(
-                    "ZeRO-Infinity layer streaming is currently single-chip "
-                    "per process (per-layer programs are unsharded); use a "
-                    "1-device mesh and a module built with mesh=None")
+                    "ZeRO-Infinity layer streaming on a multi-device mesh "
+                    "requires the module to be built WITH that mesh (its "
+                    "per-layer programs carry the sharding constraints); "
+                    "pass mesh= to the model constructor")
+            if int(eff_mesh.shape.get("pipe", 1)) > 1:
+                raise NotImplementedError(
+                    "layer streaming is itself layer-sequential; combine it "
+                    "with dp/tp/sp axes, not pipe")
             if config.fp16.enabled is True:
                 raise NotImplementedError(
                     "fp16 loss scaling is not implemented in layer-streaming "
@@ -203,12 +207,6 @@ class DeepSpeedEngine:
                 raise ValueError("zero_quantized_gradients and 1-bit "
                                  "optimizers are mutually exclusive "
                                  "compression schemes")
-            if self.policy.stage >= 3:
-                raise NotImplementedError(
-                    "qgZ here rides the local-grad shard_map path, which "
-                    "replicates params over DP inside the grad program — "
-                    "incompatible with ZeRO-3 param sharding; use stage<=2 "
-                    "(the collective itself is stage-agnostic)")
             if self.offload_enabled or self._infinity_requested:
                 raise NotImplementedError(
                     "zero_quantized_gradients + offload not supported yet")
@@ -300,7 +298,9 @@ class DeepSpeedEngine:
             from .swap_tensor import LayerStreamingEngine
 
             self.infinity = LayerStreamingEngine(
-                self.module, params, self.config, self._schedule)
+                self.module, params, self.config, self._schedule,
+                mesh=getattr(self.module, "mesh", None),
+                base_specs=self.base_specs)
             scale_state = LossScaleState(jnp.float32(1.0), jnp.int32(0),
                                          jnp.int32(0))
             return TrainState(params=self.infinity.resident, opt_state=(),
@@ -316,15 +316,26 @@ class DeepSpeedEngine:
             from .zero.offload import CPUOffloadOptimizer
 
             opt_cfg = self.config.optimizer
+            opt_name = (opt_cfg.type if opt_cfg is not None else "AdamW")
+            # bf16 wire needs the C++ kernel's fused bf16 emit — Adam-only;
+            # Lion/Adagrad offload stays on the fp32 wire
+            wire_bf16 = (self.bf16_enabled and opt_name.lower()
+                         in ("adam", "adamw", "cpu_adam"))
             self.offload_opt = CPUOffloadOptimizer(
                 params,
-                optimizer_name=(opt_cfg.type if opt_cfg is not None
-                                else "AdamW"),
+                optimizer_name=opt_name,
                 optimizer_params=(dict(opt_cfg.params.model_dump())
                                   if opt_cfg is not None else {}),
                 schedule=self._schedule,
-                policy=self.policy, base_specs=self.base_specs)
+                policy=self.policy, base_specs=self.base_specs,
+                wire_bf16=wire_bf16)
             opt_state = ()
+            if wire_bf16:
+                # bf16 wire: the device copy lives in bf16 (fp32 masters are
+                # host-side) — halves HBM and h2d bytes, same compute as the
+                # on-device bf16 path which casts fp32→bf16 every step
+                params = jax.jit(lambda t: cast_tree(t, jnp.bfloat16),
+                                 out_shardings=param_shardings)(params)
         else:
             opt_shapes = jax.eval_shape(self.optimizer.init, params)
             opt_shardings = self.policy.opt_state_shardings(
@@ -425,7 +436,105 @@ class DeepSpeedEngine:
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
                 batch)
 
-            if onebit or qgz:
+            if qgz and policy.stage >= 3:
+                # qgZ under ZeRO-3 (round 3): params enter the partial-manual
+                # shard_map in their stage-3 DP-SHARDED layout (no more
+                # program-long replication), are all-gathered over DP inside,
+                # and grads leave via a single-hop int8 reduce-scatter that
+                # lands them directly in the stage-3 grad/opt-state layout —
+                # the reference's qgZ lives inside stage3.py the same way
+                # (SURVEY §2.1 ZeRO++ row).  Transient peak = params/tp
+                # during the grad step (the fused path gathers per-layer;
+                # layer-granular gather here is future work).
+                from .zero.qgz import (quantized_allreduce,
+                                       quantized_reduce_scatter)
+
+                P = PartitionSpec
+                dp_set = set(DP_AXES)
+                if tuple(policy.shard_axes) != tuple(DP_AXES):
+                    raise NotImplementedError(
+                        "qgZ at stage>=3 + MiCS sub-group sharding not "
+                        "supported (the manual reduce must cover every DP "
+                        "axis)")
+
+                def _manual_proj(spec, shape):
+                    entries = list(spec) + [None] * (len(shape) - len(spec))
+                    man_entries, dims = [], []
+                    for i, e in enumerate(entries):
+                        axes = (e if isinstance(e, tuple)
+                                else ((e,) if e is not None else ()))
+                        man = tuple(a for a in axes if a in dp_set)
+                        auto = tuple(a for a in axes if a not in dp_set)
+                        if man and auto:
+                            raise NotImplementedError(
+                                "qgZ stage>=3: leaf mixes DP and model axes "
+                                "on one dim")
+                        man_entries.append(man if man else None)
+                        if man:
+                            dims.append(i)
+                    if len(dims) > 1:
+                        raise NotImplementedError(
+                            "qgZ stage>=3: multi-dim DP sharding")
+                    dim = dims[0] if dims else None
+                    return (PartitionSpec(*man_entries), dim,
+                            man_entries[dim] if dim is not None else None)
+
+                def _leaf_info(p, b):
+                    if b is not None:
+                        for e in tuple(b):
+                            axes = (e if isinstance(e, tuple)
+                                    else ((e,) if e else ()))
+                            if any(a in dp_set for a in axes):
+                                raise NotImplementedError(
+                                    "qgZ at stage>=3 does not support model "
+                                    "params sharded over DP axes (expert-"
+                                    "stacked MoE weights)")
+                    shape = np.shape(p)
+                    pin, pdim, paxes = _manual_proj(policy.param_spec(p, b),
+                                                    shape)
+                    gout, gdim, gaxes = _manual_proj(policy.grad_spec(p, b),
+                                                     shape)
+                    return {"pin": pin, "pdim": pdim, "paxes": paxes,
+                            "gout": gout, "gdim": gdim, "gaxes": gaxes}
+
+                if self.base_specs is None:
+                    info = jax.tree.map(lambda p: _leaf_info(p, None),
+                                        compute_params)
+                else:
+                    info = jax.tree.map(_leaf_info, compute_params,
+                                        self.base_specs)
+                pin_tree = jax.tree.map(lambda p, i: i["pin"],
+                                        compute_params, info)
+                gout_tree = jax.tree.map(lambda p, i: i["gout"],
+                                         compute_params, info)
+
+                def local3(params_shards, micro_local):
+                    def gather(p, i):
+                        if i["pdim"] is None:
+                            return p
+                        return jax.lax.all_gather(p, i["paxes"],
+                                                  axis=i["pdim"], tiled=True)
+                    params_full = jax.tree.map(gather, params_shards, info)
+                    loss_sum, grads = microbatch_scan(params_full,
+                                                      micro_local, scale)
+
+                    def reduce(g, i):
+                        if i["gdim"] is None:
+                            return quantized_allreduce(g, DP_AXES)
+                        return quantized_reduce_scatter(g, i["gaxes"],
+                                                        i["gdim"])
+                    grads = jax.tree.map(reduce, grads, info)
+                    mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
+                    return mean_loss, grads
+
+                mean_loss, grads = jax.shard_map(
+                    local3, mesh=mesh,
+                    in_specs=(pin_tree, P(None, DP_AXES)),
+                    out_specs=(P(), gout_tree),
+                    axis_names=set(DP_AXES), check_vma=False)(
+                        compute_params, micro)
+                new_comm = state.comm_state
+            elif onebit or qgz:
                 # compressed-comm path: per-worker LOCAL grads inside a
                 # partial-manual shard_map over the DP axes (TP/SP stay
                 # GSPMD-auto), then a compressed allreduce instead of psum —
@@ -538,12 +647,19 @@ class DeepSpeedEngine:
         policy = self.policy
         base_specs = self.base_specs
 
+        wire_bf16 = (self.offload_opt is not None
+                     and self.offload_opt.wire_bf16)
+
         def grad_fn(state: TrainState, batch):
             grads, mean_loss, overflow, grad_norm, _ = core(state, batch)
             # land grads in the host-partition (opt-state) layout: each
             # process's d2h pull is exactly its master slice — reduce-scatter
             # over DP instead of all-reduce whenever stage >= 1
             grads = policy.apply_offload_grad_constraints(grads, base_specs)
+            if wire_bf16:
+                # bf16 grad wire (reference sends fp16 grads to the CPU
+                # optimizer): halves d2h bytes; accumulation stayed fp32
+                grads = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
             new_scale = (scaler.update(state.loss_scale, overflow)
                          if fp16 else state.loss_scale)
             metrics = {

@@ -80,7 +80,12 @@ class DeepSpeedHybridEngine:
         out: List[jnp.ndarray] = [input_ids]
         last = None
         done = jnp.zeros((B,), bool)
-        produced = 0  # actually-decoded tokens (eos padding excluded)
+        # device-side decoded-token counter: NO host fetch inside the loop
+        # (a per-token device→host sync serializes decode — exactly the
+        # throughput this class exists to report); the early-exit all-done
+        # check runs only every few steps, and only when eos is set
+        produced = jnp.int32(0)
+        check_every = 8
         for i in range(max_new):
             if temperature > 0:
                 rng, sub = jax.random.split(rng)
@@ -93,20 +98,20 @@ class DeepSpeedHybridEngine:
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if eos_token_id is not None and last is not None:
                 tok = jnp.where(done, last, tok)
-            produced += int(B - jnp.sum(done))
+            produced = produced + (B - jnp.sum(done))
             out.append(tok[:, None])
             last = tok
             if eos_token_id is not None:
                 done = done | (tok == eos_token_id)
-                if bool(jnp.all(done)):
+                if (i + 1) % check_every == 0 and bool(jnp.all(done)):
                     pad = jnp.tile(tok[:, None], (1, max_new - i - 1))
                     out.append(pad)
                     break
             if i < max_new - 1:
                 logits, cache = self._decode(params, cache, tok)
         result = jnp.concatenate(out, axis=1)
+        self._gen_tokens += int(produced)  # single sync, after the loop
         self._gen_time += time.perf_counter() - t0
-        self._gen_tokens += produced
         return result
 
     # -- reference surface shims -------------------------------------------

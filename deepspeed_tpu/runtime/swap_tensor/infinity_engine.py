@@ -38,14 +38,25 @@ from .partitioned_param_swapper import PartitionedParamSwapper
 
 
 class LayerStreamingEngine:
-    """Train-step executor for models whose trunk params live off-device."""
+    """Train-step executor for models whose trunk params live off-device.
+
+    With ``mesh``/``base_specs`` (round 3), streaming composes with
+    DP/TP/SP: each layer's wire params land h2d directly in their TP
+    sharding (replicated over DP), activations ride the DP axes, and the
+    per-layer programs are ordinary SPMD jits — the reference's Infinity
+    likewise runs under full data parallelism (``zero/stage3.py`` +
+    ``swap_tensor/*``, SURVEY §2.1).  Host planes are per-process; in a
+    multi-controller deployment each process streams only its addressable
+    slice (single-controller semantics here)."""
 
     def __init__(self, model: Any, params: Any, config: Any,
-                 schedule: Callable[[int], float]):
+                 schedule: Callable[[int], float], mesh: Any = None,
+                 base_specs: Any = None):
         c = model.config
         self.model = model
         self.config = config
         self.schedule = schedule
+        self.mesh = mesh
         self.L = int(c.num_layers)
         self.compute_dtype = config.dtype()
         wire_dtype = (self.compute_dtype
@@ -89,14 +100,58 @@ class LayerStreamingEngine:
         one = lambda leaf, i: np.asarray(leaf[i], dtype=np.float32)
         layer_trees = [jax.tree.map(functools.partial(one, i=i), layers)
                        for i in range(self.L)]
+
+        placement = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel.mesh import strip_manual_axes
+
+            layer_specs = None
+            if isinstance(base_specs, dict) and "layers" in base_specs:
+                # per-layer specs = stacked specs minus the leading
+                # (pipe/stack) dim
+                layer_specs = jax.tree.map(
+                    lambda s: P(*tuple(s)[1:]), base_specs["layers"],
+                    is_leaf=lambda x: isinstance(x, P))
+
+            def placement(views, _specs=layer_specs):
+                if _specs is None:
+                    return jax.tree.map(
+                        lambda v: jax.device_put(
+                            np.array(v), NamedSharding(mesh, P())), views)
+                return jax.tree.map(
+                    lambda v, s: jax.device_put(
+                        np.array(v),
+                        NamedSharding(mesh, strip_manual_axes(*s))),
+                    views, _specs)
+
         self.swapper = PartitionedParamSwapper(
             layer_trees, wire_dtype=wire_dtype, nvme_path=nvme_path,
             buffer_count=int(getattr(pcfg, "buffer_count", 4) or 4),
-            aio_config=config.aio, adam_hparams=hp)
+            aio_config=config.aio, adam_hparams=hp, placement=placement)
         del layer_trees, layers
 
-        self.resident = jax.tree.map(
-            lambda x: jnp.asarray(np.asarray(x), jnp.float32), resident)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel.mesh import strip_manual_axes
+
+            res_specs = (base_specs if isinstance(base_specs, dict) else {})
+
+            def _place(v, s):
+                sh = NamedSharding(mesh, strip_manual_axes(*s)
+                                   if isinstance(s, P) else P())
+                return jax.device_put(np.asarray(v, dtype=np.float32), sh)
+
+            self.resident = {
+                k: (jax.tree.map(lambda a: _place(a, None), v)
+                    if k not in res_specs
+                    else jax.tree.map(_place, v, res_specs[k]))
+                for k, v in resident.items()}
+        else:
+            self.resident = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x), jnp.float32), resident)
         self.res_tx = optax.adamw(
             learning_rate=lambda s: jnp.asarray(schedule(s), jnp.float32),
             b1=float(hp.get("betas", (0.9, 0.999))[0]),
@@ -106,16 +161,9 @@ class LayerStreamingEngine:
         self.res_opt_state = self.res_tx.init(self.resident)
 
         gas = config.gradient_accumulation_steps
-        if isinstance(gas, int) and gas > 1:
-            raise NotImplementedError(
-                "layer streaming currently supports gradient_accumulation_"
-                "steps=1 (raise the micro batch instead — activations are "
-                "the cheap resource here)")
+        self.gas = int(gas) if isinstance(gas, int) else 1
         clip = config.gradient_clipping
-        if not isinstance(clip, str) and float(clip or 0) > 0:
-            logger.warning("gradient_clipping is not applied in layer-"
-                           "streaming (Infinity) mode yet; proceeding "
-                           "without clipping")
+        self.clip = 0.0 if isinstance(clip, str) else float(clip or 0.0)
 
         self.global_steps = 0
         self.last_metrics: Dict[str, Any] = {}
@@ -175,11 +223,18 @@ class LayerStreamingEngine:
         elif name == "res_update":
             tx = self.res_tx
 
-            def res_update(res, opt_state, grads, step):
-                del step
+            def res_update(res, opt_state, grads, scale):
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) * scale, grads)
                 updates, new_state = tx.update(grads, opt_state, res)
                 return optax.apply_updates(res, updates), new_state
             fn = jax.jit(res_update, donate_argnums=(0, 1))
+        elif name == "sq_norm":
+            def sq_norm(tree):
+                leaves = jax.tree.leaves(tree)
+                return sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                           for l in leaves)
+            fn = jax.jit(sq_norm)
         else:
             raise KeyError(name)
         self._jits[name] = fn
@@ -189,53 +244,121 @@ class LayerStreamingEngine:
     # the streamed train step
     # ------------------------------------------------------------------
 
+    def _place_batch(self, batch: Any) -> Any:
+        """DP-shard the batch over the mesh (no-op single-chip)."""
+        if self.mesh is None:
+            return batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ...parallel.mesh import DP_AXES
+
+        sh = NamedSharding(self.mesh, P(DP_AXES))
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), sh), batch)
+
     def train_step(self, batch: Any) -> Dict[str, Any]:
         model = self.model
-        ids, _ = model.batch_labels(batch)
         L, sw = self.L, self.swapper
+        gas = self.gas
         layer_fwd = self._fn("layer_fwd")
         layer_bwd = self._fn("layer_bwd")
+        sq_norm = self._fn("sq_norm")
+        # fused mode: update each layer during backward (write-behind).
+        # gas > 1 and global clipping both need the full gradient before any
+        # update, so they stash grad planes and run a second (update) pass —
+        # the reference separates backward and optimizer.step() the same way.
+        fused = (gas == 1 and self.clip <= 0.0)
 
-        # ---- forward: read-ahead one layer --------------------------------
-        x = self._fn("embed")(self.resident, ids)
-        acts: List[Any] = []
-        aux_sum = jnp.float32(0.0)
-        sw.prefetch(0)
-        for i in range(L):
-            lp = sw.get_device(i)
-            sw.prefetch(i + 1)
-            acts.append(x)
-            x, aux = layer_fwd(lp, x)
-            aux_sum = aux_sum + aux
-            sw.release(i)
-
-        (loss, (g_res, dx)) = self._fn("head_grad")(self.resident, x, batch)
-        loss = loss + self.aux_coef * aux_sum
-
-        # ---- backward: stream layers in reverse, update behind ------------
-        sw.begin_step()
         lr = float(self.schedule(self.global_steps))
-        sw.prefetch(L - 1, full=True)
-        for i in reversed(range(L)):
-            lp = sw.get_device(i)
-            sw.prefetch(i - 1, full=True)
-            dx, dlp = layer_bwd(lp, acts[i], dx)
-            acts[i] = None  # free the activation as soon as it's consumed
-            sw.step_layer(i, dlp, lr=lr)
-            sw.release(i)
+        sw.begin_step()
 
-        # ---- resident params: embed grad from dx + head grads -------------
-        g_emb = self._fn("embed_grad")(ids, dx)
-        g_res = dict(g_res)
-        g_res["embed"] = g_res["embed"].astype(jnp.float32) + g_emb
+        if gas > 1:
+            rows = int(np.shape(jax.tree.leaves(batch)[0])[0])
+            if rows % gas:
+                raise ValueError(
+                    f"batch rows {rows} not divisible by "
+                    f"gradient_accumulation_steps {gas}")
+
+            def split(x, k):
+                n = np.shape(x)[0] // gas
+                return x[k * n:(k + 1) * n]
+            micros = [jax.tree.map(functools.partial(split, k=k), batch)
+                      for k in range(gas)]
+        else:
+            micros = [batch]
+
+        loss_sum = jnp.float32(0.0)
+        norm_sq_dev = jnp.float32(0.0)
+        g_res_acc = None
+        for k, mb in enumerate(micros):
+            mb = self._place_batch(mb)
+            ids, _ = model.batch_labels(mb)
+
+            # ---- forward: read-ahead one layer ----------------------------
+            x = self._fn("embed")(self.resident, ids)
+            acts: List[Any] = []
+            aux_sum = jnp.float32(0.0)
+            sw.prefetch(0)
+            for i in range(L):
+                lp = sw.get_device(i)
+                sw.prefetch(i + 1)
+                acts.append(x)
+                x, aux = layer_fwd(lp, x)
+                aux_sum = aux_sum + aux
+                sw.release(i)
+
+            loss, (g_res, dx) = self._fn("head_grad")(self.resident, x, mb)
+            loss_sum = loss_sum + loss + self.aux_coef * aux_sum
+
+            # ---- backward: stream in reverse, update/stash behind ---------
+            sw.prefetch(L - 1, full=fused)
+            for i in reversed(range(L)):
+                lp = sw.get_device(i)
+                sw.prefetch(i - 1, full=fused)
+                dx, dlp = layer_bwd(lp, acts[i], dx)
+                acts[i] = None  # free the activation once consumed
+                if fused:
+                    norm_sq_dev = norm_sq_dev + sq_norm(dlp)
+                    sw.step_layer(i, dlp, lr=lr)
+                else:
+                    sw.stash_grads(i, dlp, accumulate=(k > 0))
+                sw.release(i)
+
+            # ---- resident grads: embed grad from dx + head grads ----------
+            g_emb = self._fn("embed_grad")(ids, dx)
+            g_res = dict(g_res)
+            g_res["embed"] = g_res["embed"].astype(jnp.float32) + g_emb
+            g_res_acc = (g_res if g_res_acc is None else
+                         jax.tree.map(lambda a, b: a + b, g_res_acc, g_res))
+
+        # ---- global grad norm, clip scale, deferred update pass -----------
+        res_sq = float(sq_norm(g_res_acc))
+        if fused:
+            grad_norm = float(np.sqrt(float(norm_sq_dev) + res_sq))
+            scale = 1.0
+        else:
+            # gplanes/g_res_acc hold SUMS over micros; the mean-loss grad is
+            # that sum / gas, so the norm divides by gas once
+            trunk_sq = sum(float(np.dot(g, g))
+                           for g in sw._gplanes.values())
+            grad_norm = float(np.sqrt(trunk_sq + res_sq)) / gas
+            scale = 1.0 / gas
+            if self.clip > 0.0 and grad_norm > self.clip:
+                scale *= self.clip / grad_norm
+            sw.prefetch(0, full=True)
+            for i in range(L):
+                sw.prefetch(i + 1, full=True)
+                sw.apply_stashed(i, lr=lr, scale=scale)
+
         self.resident, self.res_opt_state = self._fn("res_update")(
-            self.resident, self.res_opt_state, g_res, self.global_steps)
+            self.resident, self.res_opt_state, g_res_acc,
+            jnp.float32(scale))
 
         sw.flush()
         self.global_steps += 1
-        metrics = {"loss": jnp.asarray(loss),
+        metrics = {"loss": jnp.asarray(loss_sum) / gas,
                    "lr": jnp.float32(lr),
-                   "grad_norm": jnp.float32(float("nan")),
+                   "grad_norm": jnp.float32(grad_norm),
                    "loss_scale": jnp.float32(1.0),
                    "overflow": jnp.bool_(False)}
         self.last_metrics = metrics
@@ -244,6 +367,7 @@ class LayerStreamingEngine:
     def eval_loss(self, batch: Any) -> jnp.ndarray:
         """Streamed forward-only loss (no grads, no update)."""
         sw = self.swapper
+        batch = self._place_batch(batch)
         ids, _ = self.model.batch_labels(batch)
         layer_fwd = self._fn("layer_fwd")
         x = self._fn("embed")(self.resident, ids)

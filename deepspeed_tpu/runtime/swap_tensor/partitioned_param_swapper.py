@@ -59,15 +59,23 @@ def _leaf_layout(tree: Any) -> Tuple[Any, List[Tuple[Tuple[int, ...], int]]]:
 
 
 class _Planes:
-    """One layer's staging buffers (contiguous 1-D host arrays)."""
+    """One layer's staging buffers (contiguous 1-D host arrays).  The grad
+    plane ``g`` is allocated lazily — only the stash path (gradient
+    accumulation / global clipping) needs it."""
 
-    __slots__ = ("wire", "master", "m", "v")
+    __slots__ = ("wire", "master", "m", "v", "g")
 
     def __init__(self, n: int, wire_dtype):
         self.wire = np.zeros((n,), wire_dtype)
         self.master = np.zeros((n,), np.float32)
         self.m = np.zeros((n,), np.float32)
         self.v = np.zeros((n,), np.float32)
+        self.g = None
+
+    def ensure_g(self) -> np.ndarray:
+        if self.g is None:
+            self.g = np.zeros_like(self.master)
+        return self.g
 
 
 class PartitionedParamSwapper:
@@ -81,8 +89,14 @@ class PartitionedParamSwapper:
 
     def __init__(self, layer_trees: List[Any], *, wire_dtype=jnp.bfloat16,
                  nvme_path: Optional[str] = None, buffer_count: int = 4,
-                 aio_config: Any = None, adam_hparams: Optional[Dict] = None):
+                 aio_config: Any = None, adam_hparams: Optional[Dict] = None,
+                 placement: Optional[Any] = None):
         assert layer_trees, "need at least one layer"
+        #: tree → device tree; the streaming executor injects a mesh-aware
+        #: fn (NamedSharding device_put per leaf) for multi-chip runs.  MUST
+        #: snapshot (np.array) each leaf: on the CPU backend device_put
+        #: aliases the numpy buffer, and slots/planes are reused in place.
+        self._placement = placement
         self.L = len(layer_trees)
         self.treedef, self.layout = _leaf_layout(layer_trees[0])
         self.n_elems = sum(int(np.prod(s)) if s else 1 for s, _ in self.layout)
@@ -142,6 +156,8 @@ class PartitionedParamSwapper:
             self._dirty_writes = 0
 
         self._device_cache: Dict[int, Any] = {}
+        self._gplanes: Dict[int, np.ndarray] = {}  # stashed grads per layer
+        self._scratch_g: Optional[np.ndarray] = None  # fused-path grad buf
         tier = "nvme" if self.nvme_dir else "cpu"
         per_layer = self.n_elems * (12 + self.wire_np_dtype.itemsize)
         host_mib = (self.buffer_count if self.nvme_dir else self.L) \
@@ -263,13 +279,16 @@ class PartitionedParamSwapper:
         """Device pytree of layer ``i``'s wire (compute-dtype) params."""
         if i not in self._device_cache:
             planes = self._ensure_host(i)
-            # device_put is async (and on the CPU test backend it ALIASES the
-            # numpy buffer for the array's whole lifetime) — hand it a private
-            # snapshot so slot reuse / in-place adam updates can't race the
-            # transfer or the compute reading it
-            self._device_cache[i] = jax.tree.map(
-                lambda v: jax.device_put(np.array(v)),
-                self._leaf_views(planes.wire))
+            views = self._leaf_views(planes.wire)
+            if self._placement is not None:
+                self._device_cache[i] = self._placement(views)
+            else:
+                # device_put is async (and on the CPU test backend it ALIASES
+                # the numpy buffer for the array's whole lifetime) — hand it a
+                # private snapshot so slot reuse / in-place adam updates can't
+                # race the transfer or the compute reading it
+                self._device_cache[i] = jax.tree.map(
+                    lambda v: jax.device_put(np.array(v)), views)
         return self._device_cache[i]
 
     def release(self, i: int) -> None:
@@ -283,38 +302,89 @@ class PartitionedParamSwapper:
     def begin_step(self) -> None:
         self.state_step += 1
 
+    def _flatten_grads(self, buf: np.ndarray, grads_tree: Any,
+                       accumulate: bool = False) -> None:
+        """d2h the layer grad tree into a contiguous fp32 plane (optionally
+        += for gradient accumulation); transfers issued async up front."""
+        grad_leaves = jax.tree.leaves(grads_tree)
+        for g in grad_leaves:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        for g, (shape, off) in zip(grad_leaves, self.layout):
+            n = int(np.prod(shape)) if shape else 1
+            g_np = np.asarray(g).reshape(-1)
+            if g_np.dtype != np.float32:
+                g_np = g_np.astype(np.float32)
+            if accumulate:
+                buf[off:off + n] += g_np
+            else:
+                buf[off:off + n] = g_np
+
+    def _adam_planes(self, planes: _Planes, g: np.ndarray, lr: float) -> None:
+        """ONE fused C++ Adam(W) call over the whole contiguous layer plane
+        (master/m/v updated in place, bf16 wire emitted in the same pass)."""
+        common = [ctypes.c_int64(self.n_elems), ctypes.c_int(self.state_step),
+                  ctypes.c_float(lr), ctypes.c_float(self.betas[0]),
+                  ctypes.c_float(self.betas[1]), ctypes.c_float(self.eps),
+                  ctypes.c_float(self.weight_decay),
+                  ctypes.c_int(int(self.adamw_mode)),
+                  ctypes.c_int(int(self.bias_correction))]
+        if self._wire_is_bf16:
+            self._lib.ds_adam_step_bf16(
+                _fp(planes.master), _fp(g), _fp(planes.m), _fp(planes.v),
+                planes.wire.view(np.uint16).ctypes.data_as(_u16p), *common)
+        else:
+            self._lib.ds_adam_step(_fp(planes.master), _fp(g), _fp(planes.m),
+                                   _fp(planes.v), *common)
+            planes.wire[:] = planes.master.astype(self.wire_np_dtype)
+
     def step_layer(self, i: int, grads_tree: Any,
                    lr: Optional[float] = None) -> None:
         """Fused host update of layer ``i`` from device grads: d2h, C++
         Adam(W) over master/m/v, bf16 wire emit, NVMe write-behind."""
         planes = self._ensure_host(i, full=True)
-        grad_leaves = jax.tree.leaves(grads_tree)
-        for g in grad_leaves:
-            if hasattr(g, "copy_to_host_async"):
-                g.copy_to_host_async()
-        use_lr = float(self.lr if lr is None else lr)
-        for g, (shape, off) in zip(grad_leaves, self.layout):
-            n = int(np.prod(shape)) if shape else 1
-            g_np = np.ascontiguousarray(
-                np.asarray(g, dtype=np.float32).reshape(-1))
-            common = [ctypes.c_int64(n), ctypes.c_int(self.state_step),
-                      ctypes.c_float(use_lr), ctypes.c_float(self.betas[0]),
-                      ctypes.c_float(self.betas[1]), ctypes.c_float(self.eps),
-                      ctypes.c_float(self.weight_decay),
-                      ctypes.c_int(int(self.adamw_mode)),
-                      ctypes.c_int(int(self.bias_correction))]
-            master = planes.master[off:off + n]
-            m = planes.m[off:off + n]
-            v = planes.v[off:off + n]
-            if self._wire_is_bf16:
-                wire = planes.wire[off:off + n]
-                self._lib.ds_adam_step_bf16(
-                    _fp(master), _fp(g_np), _fp(m), _fp(v),
-                    wire.view(np.uint16).ctypes.data_as(_u16p), *common)
-            else:
-                self._lib.ds_adam_step(_fp(master), _fp(g_np), _fp(m),
-                                       _fp(v), *common)
-                planes.wire[off:off + n] = master.astype(self.wire_np_dtype)
+        # ONE shared scratch plane for the fused path (grads are consumed
+        # immediately) — per-layer grad planes are stash-path-only
+        if self._scratch_g is None:
+            self._scratch_g = np.zeros((self.n_elems,), np.float32)
+        g = self._scratch_g
+        self._flatten_grads(g, grads_tree)
+        self._adam_planes(planes, g, float(self.lr if lr is None else lr))
+        self._device_cache.pop(i, None)
+        if self.nvme_dir is not None:
+            for kind, buf in (("wire", planes.wire),
+                              ("master", planes.master),
+                              ("m", planes.m), ("v", planes.v)):
+                self._aio.async_pwrite(buf, self._path(i, kind))
+            self._dirty_writes += 4
+
+    # -- deferred update (gradient accumulation / global clipping) -------
+    #
+    # Grad planes ride host RAM on BOTH tiers (the reference's optimizer
+    # swapper likewise stages grads in host buffers; spilling them to NVMe
+    # is an option it exposes that we don't need yet): host cost is one
+    # extra fp32 plane per layer only while a step is in flight.
+
+    def stash_grads(self, i: int, grads_tree: Any,
+                    accumulate: bool = False) -> None:
+        """Land layer ``i``'s grads in its host grad plane instead of
+        updating immediately — used when the update must wait for the
+        global grad norm (clipping) or later microbatches (gas > 1)."""
+        g = self._gplanes.get(i)
+        if g is None:
+            g = self._gplanes[i] = np.zeros((self.n_elems,), np.float32)
+            accumulate = False
+        self._flatten_grads(g, grads_tree, accumulate=accumulate)
+
+    def apply_stashed(self, i: int, lr: Optional[float] = None,
+                      scale: float = 1.0) -> None:
+        """Second pass: fused update of layer ``i`` from its stashed grad
+        plane, scaled by ``scale`` (global clip factor)."""
+        planes = self._ensure_host(i, full=True)
+        g = self._gplanes.pop(i)
+        if scale != 1.0:
+            np.multiply(g, np.float32(scale), out=g)
+        self._adam_planes(planes, g, float(self.lr if lr is None else lr))
         self._device_cache.pop(i, None)
         if self.nvme_dir is not None:
             for kind, buf in (("wire", planes.wire),

@@ -11,7 +11,9 @@ Adam(W)/Adagrad/Lion over numpy master shards and pushes updated params back
 to their device shardings.  This is the step-splitting SURVEY §7 hard-part 2
 prescribes — the one boundary where the single-program model must break.
 
-Partitioning + overlap design (round 2):
+Partitioning + overlap design (round 3 — bucketed read-ahead/write-behind,
+role parity with the reference's ``swap_tensor/pipelined_optimizer_swapper``
+read-ahead/write-behind loop, SURVEY §2.1):
 
 * Masters/moments are kept per *addressable shard* of the param's ZeRO
   opt-state layout (``ZeroShardingPolicy.offload_shardings``).  At stage ≥ 1
@@ -21,20 +23,34 @@ Partitioning + overlap design (round 2):
   ever pulled (never a ``device_get`` of a global array).
 * The device grad program lands grads directly in that layout
   (``apply_offload_grad_constraints``): a reduce-scatter, not an all-reduce.
-* d2h is issued asynchronously for every shard up front
-  (``copy_to_host_async``) so transfers overlap each other and the host-side
-  flattening; h2d re-uploads are plain async ``device_put`` per shard, then
-  a single cached jitted identity reshards the assembled tree back to the
-  param layout (XLA all-gather over ICI — a no-op when layouts already
-  match, e.g. ZeRO-3).
+* **Bucket pipeline**: shards are grouped into ~``bucket_bytes`` buckets.
+  d2h is issued asynchronously for every shard up front
+  (``copy_to_host_async``), then the step runs double-buffered: the main
+  thread blocks on bucket *i+1*'s grads landing while a worker thread runs
+  the fused C++ Adam over bucket *i* and immediately dispatches its updated
+  params h2d (``device_put`` is async).  The ctypes optimizer call releases
+  the GIL, so host compute, d2h waits, and h2d dispatch genuinely overlap.
+* **bf16 wire** (``wire_bf16=True``, engine sets it when bf16 is enabled):
+  device params live in bf16 (halving HBM *and* h2d bytes — the reference
+  keeps fp16 compute params on device with fp32 masters on CPU the same
+  way); the C++ kernel emits the bf16 copy directly (``ds_adam_step_bf16``)
+  so no extra host cast pass.  Grads arrive bf16 over the wire too (the
+  grad program casts after fp32 accumulation — reference sends fp16 grads
+  to the CPU optimizer).  Masters stay fp32 on host and are checkpointed.
+* Finally a single cached jitted identity reshards the assembled tree back
+  to the param layout (XLA all-gather over ICI — a no-op when layouts
+  already match, e.g. ZeRO-3).
 """
 
 from __future__ import annotations
 
+import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 from ...utils.logging import log_dist
@@ -61,7 +77,8 @@ class CPUOffloadOptimizer:
 
     def __init__(self, params: Any, optimizer_name: str, optimizer_params: Any,
                  schedule: Callable[[int], float], policy: Any = None,
-                 base_specs: Any = None):
+                 base_specs: Any = None, bucket_bytes: int = 32 << 20,
+                 wire_bf16: bool = False):
         leaves, self.treedef = jax.tree.flatten(params)
         self.param_shardings = [leaf.sharding for leaf in leaves]
         self.global_shapes = [tuple(leaf.shape) for leaf in leaves]
@@ -95,6 +112,32 @@ class CPUOffloadOptimizer:
                 seen[key].devices.append(shard.device)
             self.layouts.append(entries)
         self.num_slots = len(flat_masters)
+
+        self.wire_bf16 = bool(wire_bf16)
+        # slot → device replicas, for worker-thread h2d dispatch
+        self._slot_devices: List[list] = [None] * self.num_slots
+        for entries in self.layouts:
+            for e in entries:
+                self._slot_devices[e.slot] = e.devices
+        # ~bucket_bytes groups of consecutive slots — the unit of the
+        # d2h-wait / C++-Adam / h2d-dispatch pipeline
+        self.buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for slot, m in enumerate(flat_masters):
+            cur.append(slot)
+            cur_bytes += m.nbytes
+            if cur_bytes >= bucket_bytes:
+                self.buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            self.buckets.append(cur)
+        # staging buffers the C++ kernel writes bf16 params into (wire copy)
+        self._bf16_stage = ([np.empty(m.shape, np.uint16) for m in flat_masters]
+                            if self.wire_bf16 else None)
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ds-offload")
+        self.last_timings: Dict[str, float] = {}
 
         # Cached reshard of the updated (host-layout) tree → param layout.
         param_sh_tree = jax.tree.unflatten(self.treedef, self.param_shardings)
@@ -141,10 +184,32 @@ class CPUOffloadOptimizer:
     # the per-step host round trip
     # ------------------------------------------------------------------
 
+    def _update_bucket(self, bucket: List[int], grads_np: List[np.ndarray],
+                       h2d: List[Optional[list]]) -> None:
+        """Worker-thread body: fused C++ step over one bucket's slots, then
+        immediately dispatch the updated params h2d (write-behind).  Runs
+        concurrently with the main thread's d2h wait on the next bucket."""
+        t0 = time.perf_counter()
+        for slot, g in zip(bucket, grads_np):
+            if self.wire_bf16:
+                stage = self._bf16_stage[slot]
+                self.opt.step_slot(slot, g, bf16_out=stage)
+                src = stage.view(ml_dtypes.bfloat16)
+            else:
+                self.opt.step_slot(slot, g)
+                src = self.opt.params[slot]
+            t1 = time.perf_counter()
+            self.last_timings["host_opt_s"] += t1 - t0
+            h2d[slot] = [jax.device_put(src, d)
+                         for d in self._slot_devices[slot]]
+            t0 = time.perf_counter()
+            self.last_timings["h2d_dispatch_s"] += t0 - t1
+
     def step(self, grads: Any, step_index: int) -> Any:
         """grads: device pytree (ideally already in the host-partition
         layout via ``apply_offload_grad_constraints``) → updated device
         params in their original shardings."""
+        t_start = time.perf_counter()
         grad_leaves = jax.tree.leaves(grads)
         needs_reshard = any(
             not g.sharding.is_equivalent_to(s, len(g.shape))
@@ -157,8 +222,9 @@ class CPUOffloadOptimizer:
                     lambda t: t, out_shardings=host_sh_tree)
             grad_leaves = jax.tree.leaves(self._to_host_layout(grads))
 
-        # one single-device array per unique shard, d2h started async so the
-        # transfers overlap each other (and any remaining device compute)
+        # one single-device array per unique shard, d2h started async up
+        # front so transfers stream in slot (= bucket) order while earlier
+        # buckets are being consumed
         shard_data: List[Optional[Any]] = [None] * self.num_slots
         for leaf, entries in zip(grad_leaves, self.layouts):
             by_key = {}
@@ -169,24 +235,43 @@ class CPUOffloadOptimizer:
                 data.copy_to_host_async()
                 shard_data[e.slot] = data
 
-        grads_np = [np.asarray(d, dtype=np.float32) for d in shard_data]
-        lr = float(self.schedule(step_index))
-        self.opt.step(grads_np, lr=lr)
+        self.last_timings = {"d2h_wait_s": 0.0, "host_opt_s": 0.0,
+                             "h2d_dispatch_s": 0.0}
+        self.opt.begin_step(float(self.schedule(step_index)))
+        h2d: List[Optional[list]] = [None] * self.num_slots
+        pending = None
+        for bucket in self.buckets:
+            t0 = time.perf_counter()
+            grads_np = []
+            for slot in bucket:
+                g = np.asarray(shard_data[slot])  # blocks on THIS bucket only
+                if g.dtype != np.float32:
+                    g = g.astype(np.float32)  # bf16 wire → fp32 for the opt
+                grads_np.append(g)
+                shard_data[slot] = None  # release the device grad shard
+            self.last_timings["d2h_wait_s"] += time.perf_counter() - t0
+            if pending is not None:
+                pending.result()  # double buffer: at most one bucket in flight
+            pending = self._pool.submit(self._update_bucket, bucket,
+                                        grads_np, h2d)
+        if pending is not None:
+            pending.result()
 
-        # h2d per shard (async device_put), assemble global arrays in the
-        # host layout, then one compiled reshard back to the param layout
+        # assemble global arrays in the host layout from the already-
+        # dispatched per-shard device arrays, then one compiled reshard back
+        # to the param layout
         new_leaves = []
         for shape, sharding, entries in zip(self.global_shapes,
                                             self.host_shardings, self.layouts):
             arrays = []
             for e in entries:
-                updated = self.opt.params[e.slot]
-                for device in e.devices:
-                    arrays.append(jax.device_put(jnp.asarray(updated), device))
+                arrays.extend(h2d[e.slot])
             new_leaves.append(jax.make_array_from_single_device_arrays(
                 shape, sharding, arrays))
         new_tree = jax.tree.unflatten(self.treedef, new_leaves)
-        return self._to_param_layout(new_tree)
+        out = self._to_param_layout(new_tree)
+        self.last_timings["step_total_s"] = time.perf_counter() - t_start
+        return out
 
     # ------------------------------------------------------------------
     # checkpoint plumbing — logical (re-assembled) arrays
@@ -211,10 +296,16 @@ class CPUOffloadOptimizer:
             moments["exp_avg"] = self._assemble(self.opt.exp_avg)
         if hasattr(self.opt, "exp_avg_sq"):
             moments["exp_avg_sq"] = self._assemble(self.opt.exp_avg_sq)
+        # fp32 masters travel in the checkpoint (reference optim_state
+        # layout): with a bf16 wire the device copy is lossy, so masters
+        # cannot be reconstructed from params on resume
+        moments["master"] = self._assemble(self.opt.params)
         moments["step"] = self.opt.state_step
         return moments
 
-    def load_state_arrays(self, state: Any) -> None:
+    def load_state_arrays(self, state: Any) -> bool:
+        """Restore host state; returns True when fp32 masters were in the
+        checkpoint (the caller must NOT reseed them from device params)."""
         for key in ("exp_avg", "exp_avg_sq"):
             if key in state and hasattr(self.opt, key):
                 slots = getattr(self.opt, key)
@@ -222,9 +313,15 @@ class CPUOffloadOptimizer:
                     src = np.asarray(src, dtype=np.float32)
                     for e in self.layouts[leaf_i]:
                         np.copyto(slots[e.slot], src[e.index])
+        restored_master = "master" in state
+        if restored_master:
+            for leaf_i, src in enumerate(state["master"]):
+                src = np.asarray(src, dtype=np.float32)
+                for e in self.layouts[leaf_i]:
+                    np.copyto(self.opt.params[e.slot], src[e.index])
         if "step" in state:
             self.opt.state_step = int(state["step"])
-        # master params re-seeded from the engine's current params by caller
+        return restored_master
 
     def reseed_masters(self, params: Any) -> None:
         """Refresh host master slices from (restored) device params."""

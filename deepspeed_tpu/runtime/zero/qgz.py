@@ -87,6 +87,43 @@ def quantized_allreduce(g: jnp.ndarray, axis_names: Sequence[str]
     return out.reshape(g.shape).astype(g.dtype)
 
 
+def quantized_reduce_scatter(g: jnp.ndarray, axis_names: Sequence[str],
+                             dim: int) -> jnp.ndarray:
+    """int8 single-hop reduce-scatter of one tensor along ``dim`` — the
+    stage-3 form of qgZ: each worker ends up holding only ITS slice of the
+    mean gradient (matching the ZeRO-3 grad/opt-state layout), so hop 2
+    (all-gather) never happens and wire bytes drop to ~1×int8 vs 4×fp32.
+
+    Inside shard_map; ``g`` is this worker's full local gradient."""
+    names = tuple(axis_names)
+    world = 1
+    for ax in names:
+        world *= jax.lax.axis_size(ax)
+    if world == 1:
+        return g
+
+    gm = jnp.moveaxis(g, dim, 0).astype(jnp.float32)
+    per = gm.shape[0] // world
+    rest = int(np.prod(gm.shape[1:])) if gm.ndim > 1 else 1
+    n = per * rest
+    flat = gm.reshape(world, n)
+    pad = -n % GROUP
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+
+    q, s = jax.vmap(_quant_groups)(flat)      # [W, n'] int8, [W, n'/G] f32
+    q = jax.lax.all_to_all(q[:, None], names, split_axis=0, concat_axis=1,
+                           tiled=False)        # [1, W, n']
+    s = jax.lax.all_to_all(s[:, None], names, split_axis=0, concat_axis=1,
+                           tiled=False)
+    partial = jax.vmap(_dequant_groups)(q[0], s[0])   # [W, n'] f32
+    red = jnp.sum(partial, axis=0) / world
+    if pad:
+        red = red[:n]
+    out = red.reshape((per,) + tuple(gm.shape[1:]))
+    return jnp.moveaxis(out, 0, dim).astype(g.dtype)
+
+
 def qgz_reduce_tree(grads: Any, axis_names: Sequence[str]) -> Any:
     return jax.tree.map(lambda g: quantized_allreduce(g, axis_names), grads)
 

@@ -35,8 +35,12 @@ GROUP = 256
 
 
 def _quant(p32: jnp.ndarray, group: int = GROUP):
+    if p32.ndim == 0:
+        # 0-d leaves (scalars) can't be grouped — and aren't worth wiring
+        # as int8; return as-is (callers treat scale=None as "not quantized")
+        return p32, None
     d = p32.shape[-1] if p32.ndim else 1
-    if p32.ndim and d % group == 0:
+    if d % group == 0:
         g = p32.reshape(*p32.shape[:-1], d // group, group)
     else:
         g = p32[..., None, :]  # one group per row
@@ -71,6 +75,8 @@ def make_qwz(mesh: Mesh, base_spec: Optional[PartitionSpec] = None
 
     def _impl(p: jnp.ndarray) -> jnp.ndarray:
         q, s = _quant(p.astype(jnp.float32))
+        if s is None:  # 0-d leaf — nothing to group-quantize
+            return p
         # the constraint is THE mechanism: the DP all-gather lands on int8
         q = jax.lax.with_sharding_constraint(q, target)
         s = jax.lax.with_sharding_constraint(s, replicated)  # tiny
