@@ -5,39 +5,67 @@ Reference: ``deepspeed/inference/v2/engine_v2.py`` [K] —
 cache and Dynamic SplitFuse scheduling (SURVEY §2.5 row "Inference v2").
 
 TPU-first: instead of ragged kernels over dynamic shapes, the engine
-compiles exactly TWO fixed-shape programs and reuses them for any request
-mix (XLA traces once; raggedness lives in int32 metadata):
+compiles a small number of fixed-shape programs and reuses them for any
+request mix (XLA traces once; raggedness lives in int32 metadata):
 
-* ``prefill_chunk`` — ``chunk`` prompt tokens of ONE sequence, writing KV
-  pages through the sequence's block table (Dynamic SplitFuse = long
-  prompts become several chunk calls interleaved with decodes).
-* ``decode_batch``  — one token for each of ``max_batch_slots`` sequences
-  over the shared paged pool (``ops/pallas/paged_attention.py`` kernel).
+* ``prefill_batch`` — ``chunk`` prompt tokens for each of up to
+  ``prefill_batch`` sequences at once, writing KV pages through each row's
+  block table (Dynamic SplitFuse = long prompts become several chunk calls
+  interleaved with decodes; round 3 batches the chunks across sequences).
+* ``decode_burst``  — ``k`` successive decode steps for all
+  ``max_batch_slots`` sequences in ONE device program: sampling happens
+  in-graph (greedy or temperature) and only ``[k, B]`` int32 token ids
+  return to the host — no per-token logits round-trip over the tunnel.
+  Page tables are fully reserved at admission (prompt + generation budget),
+  so a burst never needs host page allocation mid-flight.
 
-Both donate the pool, so KV updates are in-place in HBM.
+Architecture deltas (norms, positions, FFN, head) live in
+``adapters.ModelAdapterV2`` — llama/mistral/mixtral AND OPT serve on the
+same engine (reference keeps per-arch model implementations under
+``inference/v2/model_implementations`` [K]).
+
+Both programs donate the pool, so KV updates are in-place in HBM.
+
+Cost note (round-2 advisor): each prefill row still attends over the full
+``max_blocks_per_seq * block_size`` key range (masked), so chunk cost is
+O(max_seq_len) — size ``KVCacheConfig.max_seq_len`` to the workload.
 """
 
 from __future__ import annotations
 
+import functools
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.llama import _rms_norm, _rope
 from ...ops.pallas.paged_attention import paged_decode_attention
 from ...utils.logging import log_dist
+from .adapters import ModelAdapterV2, make_adapter
 from .kv_cache import KVCacheConfig, init_kv_pool
 from .scheduler import RaggedScheduler, Request
+
+
+def _sample(logits: jnp.ndarray, temperature: jnp.ndarray,
+            key: jax.Array) -> jnp.ndarray:
+    """In-graph sampling over ``[N, V]`` fp32 logits: greedy when
+    ``temperature <= 0``, else softmax sampling at that temperature."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, drawn, greedy)
 
 
 class RaggedInferenceEngineV2:
     def __init__(self, model: Any, params: Any,
                  cache_config: Optional[KVCacheConfig] = None,
-                 max_batch_slots: int = 8, prefill_chunk: int = 128):
+                 max_batch_slots: int = 8, prefill_chunk: int = 128,
+                 prefill_batch: int = 2, decode_burst: int = 8,
+                 adapter: Optional[ModelAdapterV2] = None):
         self.model = model
+        self.adapter = adapter or make_adapter(model)
         self.config = model.config
         self.params = params
         self.cache_config = cache_config or KVCacheConfig()
@@ -46,127 +74,168 @@ class RaggedInferenceEngineV2:
         #: Mistral-style window, threaded into both compiled programs'
         #: masks (pages before the window still occupy pool slots — a
         #: window-aware page-release policy is a later optimization)
-        self.window = getattr(self.config, "sliding_window", None)
+        self.window = self.adapter.window
         if self.cache_config.max_seq_len % prefill_chunk:
             # keeps every chunk's page-table slice in range: dynamic_slice
             # clamps out-of-bounds starts, which would silently retarget a
             # chunk's KV writes onto the sequence's EARLIER pages
             raise ValueError("max_seq_len must be a multiple of prefill_chunk")
         self.scheduler = RaggedScheduler(self.cache_config, max_batch_slots,
-                                         prefill_chunk)
-        self.pool = init_kv_pool(self.config, self.cache_config)
+                                         prefill_chunk, prefill_batch)
+        self.pool = init_kv_pool(self.adapter, self.cache_config)
         self.max_slots = max_batch_slots
         self.chunk = prefill_chunk
-        self._prefill = jax.jit(self._prefill_chunk_fn, donate_argnums=(1,))
-        self._decode = jax.jit(self._decode_batch_fn, donate_argnums=(1,))
+        self.prefill_batch = prefill_batch
+        self.decode_burst = max(1, decode_burst)
+        self._prefill = jax.jit(self._prefill_batch_fn, donate_argnums=(1,))
+        self._decode_jits: Dict[int, Callable] = {}
+        self._key = jax.random.PRNGKey(0)
         log_dist(f"inference v2: pool={self.cache_config.num_blocks}"
                  f"x{self.cache_config.block_size} tokens, "
-                 f"slots={max_batch_slots}, chunk={prefill_chunk}")
+                 f"slots={max_batch_slots}, chunk={prefill_chunk}"
+                 f"x{prefill_batch}, burst={self.decode_burst}, "
+                 f"adapter={type(self.adapter).__name__}")
 
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
 
-    def _prefill_chunk_fn(self, params, pool, tokens, table_row, start_pos,
-                          last_idx):
-        """One chunk of one sequence: ``tokens [C]`` at positions
-        ``start_pos + [0..C)``; returns (logits[V] at ``last_idx``, pool)."""
-        c = self.config
-        C = tokens.shape[0]
+    def _layer_step(self, lp, k_pool_l, v_pool_l, x_flat, positions_flat,
+                    write_fn, attend_fn):
+        """Shared per-layer skeleton: qkv → KV write → attention →
+        post-attn block.  ``write_fn``/``attend_fn`` differ between the
+        prefill and decode programs."""
+        ad = self.adapter
+        q, kk, vv = ad.qkv(lp, x_flat, positions_flat)
+        k_pool_l, v_pool_l = write_fn(k_pool_l, v_pool_l, kk, vv)
+        attn = attend_fn(q, k_pool_l, v_pool_l)
+        x_flat = ad.post_attn(lp, x_flat, attn)
+        return x_flat, k_pool_l, v_pool_l
+
+    def _prefill_batch_fn(self, params, pool, tokens, tables, start_pos,
+                          last_idx, temperature, key):
+        """Up to ``Bp`` sequences' chunks at once: ``tokens [Bp, C]`` at
+        positions ``start_pos[r] + [0..C)``; rows beyond the live chunk
+        count carry all-zero tables (page 0 = scratch).  Returns
+        (sampled token ids ``[Bp]``, pool)."""
+        ad = self.adapter
+        Bp, C = tokens.shape
         bs = self.cache_config.block_size
         mb = self.cache_config.max_blocks_per_seq
-        n_rep = c.num_heads // c.num_kv_heads
-        positions = start_pos + jnp.arange(C)  # [C]
-        x = jnp.take(params["embed"].astype(c.dtype), tokens, axis=0)  # [C,H]
-        page_cursor = start_pos // bs  # chunk & start are page-aligned
+        n_rep = ad.num_heads // ad.kv_heads
+        positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [Bp, C]
+        pos_flat = positions.reshape(-1)
+        x = ad.embed(params, tokens.reshape(-1), pos_flat)  # [Bp*C, H]
+        page_cursor = start_pos // bs  # chunks & starts are page-aligned
+
+        # per-row page slice for this chunk's writes: [Bp, C//bs]
+        pages = jax.vmap(
+            lambda row, cur: jax.lax.dynamic_slice(row, (cur,), (C // bs,))
+        )(tables, page_cursor)
+        pages_flat = pages.reshape(-1)
+
+        from ...ops.masks import local_attention_mask
+
+        karange = jnp.arange(mb * bs)
+        mask = jax.vmap(lambda p: local_attention_mask(
+            p, karange, causal=True, window=self.window))(positions)
+        mask = mask[:, None]  # [Bp, 1(head), C, mb*bs]
+
+        def write_fn(k_pool_l, v_pool_l, kk, vv):
+            k_pool_l = k_pool_l.at[pages_flat].set(
+                kk.reshape(Bp * (C // bs), bs, ad.kv_heads, ad.head_dim))
+            v_pool_l = v_pool_l.at[pages_flat].set(
+                vv.reshape(Bp * (C // bs), bs, ad.kv_heads, ad.head_dim))
+            return k_pool_l, v_pool_l
+
+        def attend_fn(q, k_pool_l, v_pool_l):
+            # gather each row's full page set (masked; cost note in module
+            # docstring) and attend chunk-queries over it
+            kf = k_pool_l[tables].reshape(Bp, mb * bs, ad.kv_heads,
+                                          ad.head_dim)
+            vf = v_pool_l[tables].reshape(Bp, mb * bs, ad.kv_heads,
+                                          ad.head_dim)
+            if n_rep > 1:
+                kf = jnp.repeat(kf, n_rep, axis=2)
+                vf = jnp.repeat(vf, n_rep, axis=2)
+            qb = q.reshape(Bp, C, ad.num_heads, ad.head_dim)
+            scale = 1.0 / np.sqrt(ad.head_dim)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qb, kf
+                           ).astype(jnp.float32) * scale
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(ad.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+            return attn.reshape(Bp * C, ad.num_heads, ad.head_dim)
 
         def layer(carry, xs):
             x, = carry
             lp, k_pool_l, v_pool_l = xs
-            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
-            q = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wq"].astype(c.dtype))
-            kk = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wk"].astype(c.dtype))
-            vv = jnp.einsum("sH,Hhd->shd", h, lp["attn"]["wv"].astype(c.dtype))
-            q = _rope(q, positions, c.rope_theta)
-            kk = _rope(kk, positions, c.rope_theta)
-            # write this chunk's pages through the block table
-            pages = jax.lax.dynamic_slice(table_row, (page_cursor,),
-                                          (C // bs,))
-            k_pool_l = k_pool_l.at[pages].set(
-                kk.reshape(C // bs, bs, c.num_kv_heads, c.hd))
-            v_pool_l = v_pool_l.at[pages].set(
-                vv.reshape(C // bs, bs, c.num_kv_heads, c.hd))
-            # attend over everything this sequence owns (prefix + chunk,
-            # causal by absolute position)
-            kf = k_pool_l[table_row].reshape(mb * bs, c.num_kv_heads, c.hd)
-            vf = v_pool_l[table_row].reshape(mb * bs, c.num_kv_heads, c.hd)
-            if n_rep > 1:
-                kf = jnp.repeat(kf, n_rep, axis=1)
-                vf = jnp.repeat(vf, n_rep, axis=1)
-            from ...ops.masks import local_attention_mask
-
-            scale = 1.0 / np.sqrt(c.hd)
-            s = jnp.einsum("qhd,khd->hqk", q, kf).astype(jnp.float32) * scale
-            mask = local_attention_mask(positions, jnp.arange(mb * bs),
-                                        causal=True, window=self.window)
-            s = jnp.where(mask[None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
-            attn = jnp.einsum("hqk,khd->qhd", p, vf)
-            out = jnp.einsum("qhd,hdH->qH", attn,
-                             lp["attn"]["wo"].astype(c.dtype))
-            x = x + out
-            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
-            ffn_out, _ = self.model._ffn(h[None], lp)
-            x = x + ffn_out[0]
+            x, k_pool_l, v_pool_l = self._layer_step(
+                lp, k_pool_l, v_pool_l, x, pos_flat, write_fn, attend_fn)
             return (x,), (k_pool_l, v_pool_l)
 
         (x,), (ks, vs) = jax.lax.scan(
-            layer, (x,), (params["layers"], pool["k"], pool["v"]))
-        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
-        last_h = jax.lax.dynamic_index_in_dim(x, last_idx, axis=0,
-                                              keepdims=False)
-        logits = jnp.einsum("H,HV->V", last_h,
-                            self.model._head(params).astype(c.dtype))
-        return logits.astype(jnp.float32), {"k": ks, "v": vs}
+            layer, (x,), (ad.layers(params), pool["k"], pool["v"]))
+        x = ad.finalize(params, x).reshape(Bp, C, -1)
+        last_h = jnp.take_along_axis(
+            x, last_idx[:, None, None], axis=1)[:, 0]  # [Bp, H]
+        logits = ad.logits(params, last_h)  # [Bp, V]
+        return _sample(logits, temperature, key), {"k": ks, "v": vs}
 
-    def _decode_batch_fn(self, params, pool, tokens, kv_lens, tables):
-        """One token per slot: ``tokens [B]`` write KV at ``kv_lens [B]``
-        through ``tables [B, max_blocks]``; returns (logits [B, V], pool)."""
-        c = self.config
+    def _decode_burst_fn(self, params, pool, tokens, kv_lens, tables,
+                         max_pos, temperature, key, *, n_steps: int):
+        """``n_steps`` decode iterations entirely on device: each step
+        writes KV at ``kv_lens`` through ``tables``, attends via the paged
+        kernel, samples the next token in-graph and feeds it back.  Write
+        positions clamp at ``max_pos`` (a slot that hit EOS/budget inside
+        the burst only scribbles its own reserved pages; the host discards
+        its surplus tokens).  Returns (token ids ``[n_steps, B]``, pool)."""
+        ad = self.adapter
         B = tokens.shape[0]
         bs = self.cache_config.block_size
-        x = jnp.take(params["embed"].astype(c.dtype), tokens, axis=0)
-        pos = kv_lens[:, None]  # [B, 1]
-        page_ids = tables[jnp.arange(B), kv_lens // bs]  # [B]
-        offsets = kv_lens % bs
 
-        def layer(carry, xs):
-            x, = carry
-            lp, k_pool_l, v_pool_l = xs
-            h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
-            q = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wq"].astype(c.dtype))
-            kk = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wk"].astype(c.dtype))
-            vv = jnp.einsum("bH,Hhd->bhd", h, lp["attn"]["wv"].astype(c.dtype))
-            q = _rope(q[:, None], pos, c.rope_theta)[:, 0]
-            kk = _rope(kk[:, None], pos, c.rope_theta)[:, 0]
-            k_pool_l = k_pool_l.at[page_ids, offsets].set(kk)
-            v_pool_l = v_pool_l.at[page_ids, offsets].set(vv)
-            attn = paged_decode_attention(q, k_pool_l, v_pool_l, tables,
-                                          kv_lens + 1, window=self.window)
-            out = jnp.einsum("bhd,hdH->bH", attn,
-                             lp["attn"]["wo"].astype(c.dtype))
-            x = x + out
-            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
-            ffn_out, _ = self.model._ffn(h[:, None, :], lp)
-            x = x + ffn_out[:, 0, :]
-            return (x,), (k_pool_l, v_pool_l)
+        def one_step(carry, key):
+            tokens, kv_lens, pool = carry
+            wp = jnp.minimum(kv_lens, max_pos)  # [B] write positions
+            page_ids = tables[jnp.arange(B), wp // bs]
+            offsets = wp % bs
+            x = ad.embed(params, tokens, wp)
 
-        (x,), (ks, vs) = jax.lax.scan(
-            layer, (x,), (params["layers"], pool["k"], pool["v"]))
-        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
-        logits = jnp.einsum("bH,HV->bV", x,
-                            self.model._head(params).astype(c.dtype))
-        return logits.astype(jnp.float32), {"k": ks, "v": vs}
+            def write_fn(k_pool_l, v_pool_l, kk, vv):
+                return (k_pool_l.at[page_ids, offsets].set(kk),
+                        v_pool_l.at[page_ids, offsets].set(vv))
+
+            def attend_fn(q, k_pool_l, v_pool_l):
+                return paged_decode_attention(q, k_pool_l, v_pool_l, tables,
+                                              wp + 1, window=self.window)
+
+            def layer(carry, xs):
+                x, = carry
+                lp, k_pool_l, v_pool_l = xs
+                x, k_pool_l, v_pool_l = self._layer_step(
+                    lp, k_pool_l, v_pool_l, x, wp, write_fn, attend_fn)
+                return (x,), (k_pool_l, v_pool_l)
+
+            (x,), (ks, vs) = jax.lax.scan(
+                layer, (x,), (ad.layers(params), pool["k"], pool["v"]))
+            x = ad.finalize(params, x)
+            logits = ad.logits(params, x)  # [B, V]
+            nxt = _sample(logits, temperature, key)
+            return (nxt, kv_lens + 1, {"k": ks, "v": vs}), nxt
+
+        keys = jax.random.split(key, n_steps)
+        (_, _, pool), toks = jax.lax.scan(
+            one_step, (tokens, kv_lens, pool), keys)
+        return toks, pool
+
+    def _decode(self, n_steps: int) -> Callable:
+        fn = self._decode_jits.get(n_steps)
+        if fn is None:
+            fn = jax.jit(functools.partial(self._decode_burst_fn,
+                                           n_steps=n_steps),
+                         donate_argnums=(1,))
+            self._decode_jits[n_steps] = fn
+        return fn
 
     # ------------------------------------------------------------------
     # serving surface
@@ -176,59 +245,66 @@ class RaggedInferenceEngineV2:
         """Admit one request (reference ``engine.put`` role)."""
         return self.scheduler.add_request(prompt, max_new_tokens)
 
-    def _sample(self, logits: np.ndarray, temperature: float,
-                rng: np.random.Generator) -> np.ndarray:
-        if temperature <= 0:
-            return np.argmax(logits, axis=-1)
-        z = logits / temperature
-        z = z - z.max(axis=-1, keepdims=True)
-        p = np.exp(z)
-        p /= p.sum(axis=-1, keepdims=True)
-        return np.array([rng.choice(p.shape[-1], p=row) for row in
-                         np.atleast_2d(p)])
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
 
     def step(self, temperature: float = 0.0,
              eos_token_id: Optional[int] = None,
              rng: Optional[np.random.Generator] = None) -> int:
-        """One scheduler step: at most one prefill chunk + one decode batch.
-        Returns the number of tokens processed (SplitFuse keeps this near
-        ``chunk + active_slots`` every step)."""
-        rng = rng or np.random.default_rng(0)
-        chunk, decode = self.scheduler.plan_step()
+        """One scheduler step: a batched prefill call and/or a decode
+        burst.  While prefill work exists the burst length is 1 so
+        SplitFuse keeps interleaving chunks with decodes; once all prompts
+        are in, decodes run ``decode_burst`` steps per dispatch.  Returns
+        the number of tokens processed."""
+        del rng  # sampling is in-graph now; kept for API compat
+        chunks, decode = self.scheduler.plan_step()
+        temp = jnp.float32(temperature)
         n_tokens = 0
-        if chunk is not None:
-            req = chunk.request
-            logits, self.pool = self._prefill(
-                self.params, self.pool,
-                jnp.asarray(chunk.tokens),
-                jnp.asarray(self.scheduler.table_row(req)),
-                jnp.int32(chunk.start_pos),
-                jnp.int32(max(chunk.n_valid - 1, 0)))
-            n_tokens += chunk.n_valid
-            first = None
-            if chunk.is_last:
-                first = int(self._sample(np.asarray(logits)[None],
-                                         temperature, rng)[0])
-            self.scheduler.chunk_done(chunk, first, eos_token_id)
+        if chunks:
+            Bp, C = self.prefill_batch, self.chunk
+            tokens = np.zeros((Bp, C), np.int32)
+            tables = np.zeros((Bp, self.cache_config.max_blocks_per_seq),
+                              np.int32)
+            start = np.zeros((Bp,), np.int32)
+            last = np.zeros((Bp,), np.int32)
+            for i, ch in enumerate(chunks):
+                tokens[i] = ch.tokens
+                tables[i] = self.scheduler.table_row(ch.request)
+                start[i] = ch.start_pos
+                last[i] = max(ch.n_valid - 1, 0)
+            sampled, self.pool = self._prefill(
+                self.params, self.pool, jnp.asarray(tokens),
+                jnp.asarray(tables), jnp.asarray(start), jnp.asarray(last),
+                temp, self._next_key())
+            sampled = np.asarray(sampled)
+            for i, ch in enumerate(chunks):
+                first = int(sampled[i]) if ch.is_last else None
+                self.scheduler.chunk_done(ch, first, eos_token_id)
+                n_tokens += ch.n_valid
         if decode:
+            burst = 1 if (chunks or self.scheduler.prefilling) \
+                else min(self.decode_burst,
+                         max(r.remaining_budget for r in decode))
             B = self.max_slots
             tokens = np.zeros((B,), np.int32)
             kv_lens = np.zeros((B,), np.int32)
+            max_pos = np.zeros((B,), np.int32)
             tables = np.zeros((B, self.cache_config.max_blocks_per_seq),
                               np.int32)
             for req in decode:
                 s = req.slot
                 tokens[s] = req.generated[-1]
                 kv_lens[s] = req.prefilled + len(req.generated) - 1
+                max_pos[s] = len(req.prompt) + req.max_new_tokens - 1
                 tables[s] = self.scheduler.table_row(req)
-            logits, self.pool = self._decode(
+            toks, self.pool = self._decode(burst)(
                 self.params, self.pool, jnp.asarray(tokens),
-                jnp.asarray(kv_lens), jnp.asarray(tables))
-            logits = np.asarray(logits)
-            sampled = self._sample(
-                np.stack([logits[r.slot] for r in decode]), temperature, rng)
-            self.scheduler.decode_done(decode, sampled, eos_token_id)
-            n_tokens += len(decode)
+                jnp.asarray(kv_lens), jnp.asarray(tables),
+                jnp.asarray(max_pos), temp, self._next_key())
+            toks = np.asarray(toks)  # [burst, B]
+            n_tokens += self.scheduler.decode_burst_done(decode, toks,
+                                                         eos_token_id)
         return n_tokens
 
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,
@@ -237,12 +313,12 @@ class RaggedInferenceEngineV2:
                  ) -> List[List[int]]:
         """Drive the scheduler to completion over a ragged prompt batch.
         Returns the generated-token lists in prompt order."""
-        rng = np.random.default_rng(seed)
+        self._key = jax.random.PRNGKey(seed)
         reqs = [self.put(p, max_new_tokens) for p in prompts]
         t0 = time.perf_counter()
         total = 0
         while self.scheduler.has_work:
-            total += self.step(temperature, eos_token_id, rng)
+            total += self.step(temperature, eos_token_id)
         dt = time.perf_counter() - t0
         self.last_throughput = total / dt if dt > 0 else 0.0
         return [r.generated for r in reqs]
@@ -251,8 +327,11 @@ class RaggedInferenceEngineV2:
 def build_engine_v2(model: Any, params: Any = None,
                     cache_config: Optional[KVCacheConfig] = None,
                     max_batch_slots: int = 8,
-                    prefill_chunk: int = 128) -> RaggedInferenceEngineV2:
+                    prefill_chunk: int = 128,
+                    prefill_batch: int = 2,
+                    decode_burst: int = 8) -> RaggedInferenceEngineV2:
     if params is None:
         params = model.init_params(jax.random.PRNGKey(0))
     return RaggedInferenceEngineV2(model, params, cache_config,
-                                   max_batch_slots, prefill_chunk)
+                                   max_batch_slots, prefill_chunk,
+                                   prefill_batch, decode_burst)

@@ -32,10 +32,16 @@ class KVCacheConfig:
         return -(-self.max_seq_len // self.block_size)
 
 
-def init_kv_pool(model_config: Any, cache_config: KVCacheConfig
+def init_kv_pool(model_or_adapter: Any, cache_config: KVCacheConfig
                  ) -> Dict[str, jnp.ndarray]:
-    """Zeroed pool sized from the model's (layers, kv-heads, head-dim)."""
-    c = model_config
+    """Zeroed pool sized from the model's (layers, kv-heads, head-dim).
+    Accepts either a ``ModelAdapterV2`` (preferred — normalizes families
+    without ``num_kv_heads``, e.g. OPT) or a raw model config."""
+    c = model_or_adapter
+    if hasattr(c, "kv_heads"):  # adapter protocol
+        shape = (c.num_layers, cache_config.num_blocks,
+                 cache_config.block_size, c.kv_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
     shape = (c.num_layers, cache_config.num_blocks, cache_config.block_size,
              c.num_kv_heads, c.hd)
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}

@@ -45,6 +45,11 @@ class Request:
     def length(self) -> int:
         return self.prefilled + len(self.generated)
 
+    @property
+    def remaining_budget(self) -> int:
+        """Generation tokens this request may still emit."""
+        return max(self.max_new_tokens - len(self.generated), 0)
+
     def pages_needed(self, block_size: int) -> int:
         total = len(self.prompt) + self.max_new_tokens
         return -(-total // block_size)
@@ -68,12 +73,13 @@ class RaggedScheduler:
     """
 
     def __init__(self, cache_config: KVCacheConfig, max_batch_slots: int = 8,
-                 prefill_chunk: int = 128):
+                 prefill_chunk: int = 128, prefill_batch: int = 1):
         if prefill_chunk % cache_config.block_size:
             raise ValueError("prefill_chunk must be a multiple of block_size")
         self.cache = cache_config
         self.allocator = BlockAllocator(cache_config.num_blocks)
         self.chunk = prefill_chunk
+        self.prefill_batch = max(1, prefill_batch)
         self.max_slots = max_batch_slots
         self.slots: List[Optional[Request]] = [None] * max_batch_slots
         self.waiting: Deque[Request] = deque()
@@ -136,21 +142,22 @@ class RaggedScheduler:
             self.prefilling.append(req)
 
     def plan_step(self) -> tuple:
-        """→ (PrefillChunk | None, decode_requests) for this step."""
+        """→ (list[PrefillChunk] (≤ ``prefill_batch``, one chunk per
+        distinct prefilling request), decode_requests) for this step."""
         self._admit()
-        chunk = None
-        if self.prefilling:
-            req = self.prefilling[0]
+        chunks: List[PrefillChunk] = []
+        for req in list(self.prefilling)[:self.prefill_batch]:
             start = req.prefilled
             n_valid = min(self.chunk, len(req.prompt) - start)
             toks = np.zeros((self.chunk,), np.int32)
             toks[:n_valid] = req.prompt[start:start + n_valid]
             is_last = start + n_valid >= len(req.prompt)
-            chunk = PrefillChunk(request=req, tokens=toks, start_pos=start,
-                                 n_valid=n_valid, is_last=is_last)
+            chunks.append(PrefillChunk(request=req, tokens=toks,
+                                       start_pos=start, n_valid=n_valid,
+                                       is_last=is_last))
         decode = [r for r in self.slots
                   if r is not None and r.state is RequestState.RUNNING]
-        return chunk, decode
+        return chunks, decode
 
     # -- state transitions (called by the engine) ----------------------------
 
@@ -160,7 +167,7 @@ class RaggedScheduler:
         req.prefilled += chunk.n_valid
         if chunk.is_last:
             assert req.prefilled == len(req.prompt)
-            self.prefilling.popleft()
+            self.prefilling.remove(req)
             req.state = RequestState.RUNNING
             if first_token is not None:
                 req.generated.append(int(first_token))
@@ -171,6 +178,23 @@ class RaggedScheduler:
         for req, tok in zip(requests, tokens):
             req.generated.append(int(tok))
             self._maybe_finish(req, int(tok), eos_token_id)
+
+    def decode_burst_done(self, requests: List[Request], tokens: np.ndarray,
+                          eos_token_id: Optional[int] = None) -> int:
+        """Accept an in-graph burst's ``[n_steps, B]`` token matrix: each
+        request takes its slot's column until it finishes (EOS/budget);
+        surplus tokens a done slot generated inside the burst are
+        discarded.  Returns the number of accepted tokens."""
+        accepted = 0
+        for req in requests:
+            col = tokens[:, req.slot]
+            for tok in col:
+                if req.state is not RequestState.RUNNING:
+                    break
+                req.generated.append(int(tok))
+                accepted += 1
+                self._maybe_finish(req, int(tok), eos_token_id)
+        return accepted
 
     def _maybe_finish(self, req: Request, tok: int,
                       eos: Optional[int]) -> None:

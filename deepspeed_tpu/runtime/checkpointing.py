@@ -212,13 +212,17 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                               for a in v]
                           for k, v in offload.state_dict_arrays().items()
                           if k != "step"}
-                try:
-                    restored_off = loader.restore(offload_path, target)
-                except Exception:
-                    # legacy checkpoint (pre-round-3): no 'master' entry —
-                    # restore the moments and fall through to reseeding
+                # legacy checkpoints (pre-round-3) carry no 'master' entry;
+                # probe the saved tree instead of masking restore errors
+                saved_keys = set(loader.metadata(offload_path)
+                                 .item_metadata.tree)
+                if "master" not in saved_keys:
                     target.pop("master", None)
-                    restored_off = loader.restore(offload_path, target)
+                    log_dist("offload restore: legacy checkpoint without "
+                             "fp32 masters — moments restored, masters "
+                             "reseeded from device params (exact only for "
+                             "an fp32 wire)")
+                restored_off = loader.restore(offload_path, target)
             restored_master = offload.load_state_arrays(restored_off)
         if not restored_master:
             # legacy/params-only checkpoint: re-seed host fp32 master slices

@@ -339,8 +339,7 @@ class LayerStreamingEngine:
         else:
             # gplanes/g_res_acc hold SUMS over micros; the mean-loss grad is
             # that sum / gas, so the norm divides by gas once
-            trunk_sq = sum(float(np.dot(g, g))
-                           for g in sw._gplanes.values())
+            trunk_sq = sw.stashed_sq_norm()
             grad_norm = float(np.sqrt(trunk_sq + res_sq)) / gas
             scale = 1.0 / gas
             if self.clip > 0.0 and grad_norm > self.clip:

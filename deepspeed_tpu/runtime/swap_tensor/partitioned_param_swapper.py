@@ -376,6 +376,12 @@ class PartitionedParamSwapper:
             accumulate = False
         self._flatten_grads(g, grads_tree, accumulate=accumulate)
 
+    def stashed_sq_norm(self) -> float:
+        """Σ‖g‖² over every stashed grad plane — THE place that knows where
+        grad planes live (today host RAM; if they ever spill to NVMe this
+        method must read them back, keeping global clipping correct)."""
+        return sum(float(np.dot(g, g)) for g in self._gplanes.values())
+
     def apply_stashed(self, i: int, lr: Optional[float] = None,
                       scale: float = 1.0) -> None:
         """Second pass: fused update of layer ``i`` from its stashed grad

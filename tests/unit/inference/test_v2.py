@@ -112,8 +112,8 @@ def test_scheduler_admission_respects_pool():
     r1 = s.add_request([1] * 8, max_new_tokens=4)
     # needs 3 more → must wait
     r2 = s.add_request([1] * 8, max_new_tokens=4)
-    chunk, decode = s.plan_step()
-    assert chunk is not None and chunk.request is r1
+    chunks, decode = s.plan_step()
+    assert chunks and chunks[0].request is r1
     assert r1.state is RequestState.PREFILL
     assert r2.state is RequestState.WAITING
     # finish r1 → its pages come back → r2 admitted
@@ -122,21 +122,21 @@ def test_scheduler_admission_respects_pool():
     r1.blocks = []
     s.slots[r1.slot] = None
     s.prefilling.popleft()
-    chunk, _ = s.plan_step()
-    assert chunk.request is r2
+    chunks, _ = s.plan_step()
+    assert chunks[0].request is r2
 
 
 def test_split_fuse_chunking():
     cache = KVCacheConfig(num_blocks=32, block_size=4, max_seq_len=32)
     s = RaggedScheduler(cache, max_batch_slots=2, prefill_chunk=8)
     req = s.add_request(list(range(1, 21)), max_new_tokens=2)  # 20 tokens
-    chunk, _ = s.plan_step()
+    chunk, = s.plan_step()[0]
     assert (chunk.n_valid, chunk.start_pos, chunk.is_last) == (8, 0, False)
     s.chunk_done(chunk, None)
-    chunk, _ = s.plan_step()
+    chunk, = s.plan_step()[0]
     assert (chunk.n_valid, chunk.start_pos, chunk.is_last) == (8, 8, False)
     s.chunk_done(chunk, None)
-    chunk, _ = s.plan_step()
+    chunk, = s.plan_step()[0]
     assert (chunk.n_valid, chunk.start_pos, chunk.is_last) == (4, 16, True)
     s.chunk_done(chunk, 7)
     assert req.state is RequestState.RUNNING
@@ -240,6 +240,82 @@ def test_v2_eos_stops_early(tiny_model):
     # chosen token before position 3), eos itself included — v1 semantics
     stop = want.index(eos)
     assert got[0] == want[:stop + 1]
+
+
+def test_v2_opt_matches_v1_greedy():
+    """OPT (LayerNorm + learned positions + biased projections) serves on
+    v2 through its adapter — the family the llama-schema engine could not
+    serve (VERDICT round 2 missing #5)."""
+    from deepspeed_tpu.models.opt import OPTConfig, OPTModel
+
+    cfg = OPTConfig.tiny(num_layers=2, max_seq_len=64, dtype=jnp.float32)
+    model = OPTModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(5))
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 512, size=n).tolist() for n in (3, 10, 17)]
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=4, prefill_chunk=8)
+    got = eng2.generate(prompts, max_new_tokens=5)
+    for prompt, g in zip(prompts, got):
+        want = _v1_greedy(model, params, prompt, 5)
+        assert g == want, f"prompt len {len(prompt)}: {g} != {want}"
+
+
+def test_v2_batched_prefill_and_burst(tiny_model):
+    """prefill_batch>1 (chunks from several requests in one call) and
+    decode_burst>1 (multi-token in-graph decode) keep greedy equivalence
+    and release every page."""
+    model, params = tiny_model
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 512, size=n).tolist() for n in (3, 7, 12, 20)]
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=96, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=4, prefill_chunk=8, prefill_batch=3, decode_burst=4)
+    got = eng2.generate(prompts, max_new_tokens=7)
+    for prompt, g in zip(prompts, got):
+        want = _v1_greedy(model, params, prompt, 7)
+        assert g == want, f"prompt len {len(prompt)}: {g} != {want}"
+    assert eng2.scheduler.allocator.num_free == 95
+
+
+def test_v2_burst_eos_truncation(tiny_model):
+    """EOS inside a burst: surplus burst tokens are discarded and the pages
+    come back (host-side acceptance after the in-graph loop)."""
+    model, params = tiny_model
+    prompt = [5, 6, 7]
+    want = _v1_greedy(model, params, prompt, 8)
+    eos = want[1]  # EOS lands mid-burst
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=32, block_size=4,
+                                   max_seq_len=32),
+        max_batch_slots=2, prefill_chunk=8, decode_burst=8)
+    got = eng2.generate([prompt], max_new_tokens=8, eos_token_id=eos)
+    stop = want.index(eos)
+    assert got[0] == want[:stop + 1]
+    assert eng2.scheduler.allocator.num_free == 31
+
+
+def test_v2_temperature_sampling_in_graph(tiny_model):
+    """temperature>0 samples in-graph: output differs across seeds but
+    stays fixed for a given seed (reproducible device-side sampling)."""
+    model, params = tiny_model
+    prompt = [3, 4, 5, 6]
+    eng = lambda: build_engine_v2(  # noqa: E731
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=32, block_size=4,
+                                   max_seq_len=32),
+        max_batch_slots=2, prefill_chunk=8)
+    a = eng().generate([prompt], max_new_tokens=8, temperature=1.0, seed=0)
+    b = eng().generate([prompt], max_new_tokens=8, temperature=1.0, seed=0)
+    c = eng().generate([prompt], max_new_tokens=8, temperature=1.0, seed=7)
+    assert a == b
+    assert a != c  # astronomically unlikely to collide for 8 tokens
 
 
 def test_paged_kernel_window_matches_reference():
