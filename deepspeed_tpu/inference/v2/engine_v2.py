@@ -85,7 +85,7 @@ class RaggedInferenceEngineV2:
         self.pool = init_kv_pool(self.adapter, self.cache_config)
         self.max_slots = max_batch_slots
         self.chunk = prefill_chunk
-        self.prefill_batch = prefill_batch
+        self.prefill_batch = max(1, prefill_batch)
         self.decode_burst = max(1, decode_burst)
         self._prefill = jax.jit(self._prefill_batch_fn, donate_argnums=(1,))
         self._decode_jits: Dict[int, Callable] = {}
@@ -283,9 +283,12 @@ class RaggedInferenceEngineV2:
                 self.scheduler.chunk_done(ch, first, eos_token_id)
                 n_tokens += ch.n_valid
         if decode:
+            # exactly TWO decode program shapes ever compile (1 and
+            # decode_burst): over-running a request's budget inside a
+            # burst is safe (max_pos clamps writes, the host discards
+            # surplus tokens), so the tail reuses the full-length program
             burst = 1 if (chunks or self.scheduler.prefilling) \
-                else min(self.decode_burst,
-                         max(r.remaining_budget for r in decode))
+                else self.decode_burst
             B = self.max_slots
             tokens = np.zeros((B,), np.int32)
             kv_lens = np.zeros((B,), np.int32)

@@ -175,9 +175,13 @@ class RaggedScheduler:
 
     def decode_done(self, requests: List[Request], tokens: np.ndarray,
                     eos_token_id: Optional[int] = None) -> None:
-        for req, tok in zip(requests, tokens):
-            req.generated.append(int(tok))
-            self._maybe_finish(req, int(tok), eos_token_id)
+        """Single-step acceptance — a burst of 1 (kept for callers that
+        decode one token per dispatch)."""
+        order = {r.slot: i for i, r in enumerate(requests)}
+        row = np.zeros((1, max(order) + 1), tokens.dtype)
+        for req in requests:
+            row[0, req.slot] = tokens[order[req.slot]]
+        self.decode_burst_done(requests, row, eos_token_id)
 
     def decode_burst_done(self, requests: List[Request], tokens: np.ndarray,
                           eos_token_id: Optional[int] = None) -> int:

@@ -3,9 +3,10 @@ flash attention (csrc/transformer fused attention), decode attention w/ KV
 cache (csrc/transformer/inference), int8 quantizer (csrc/quantization for
 ZeRO++ compressed collectives)."""
 
-from .flash_attention import flash_attention
+from .block_sparse_attention import block_sparse_attention
 from .decode_attention import decode_attention
+from .flash_attention import flash_attention
 from .quantizer import dequantize_int8, quantize_int8
 
 __all__ = ["flash_attention", "decode_attention", "quantize_int8",
-           "dequantize_int8"]
+           "dequantize_int8", "block_sparse_attention"]

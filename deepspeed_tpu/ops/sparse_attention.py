@@ -169,9 +169,21 @@ def block_layout_to_token_mask(layout: np.ndarray, block: int,
 def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      sparsity_config: SparsityConfig,
                      causal: bool = False,
-                     key_padding_mask: Optional[jnp.ndarray] = None
-                     ) -> jnp.ndarray:
-    """[B, S, h, d] attention under a block-sparse layout."""
+                     key_padding_mask: Optional[jnp.ndarray] = None,
+                     impl: str = "auto") -> jnp.ndarray:
+    """[B, S, h, d] attention under a block-sparse layout.
+
+    ``impl``: "auto" routes to the Pallas block-skipping kernel
+    (:mod:`.pallas.block_sparse_attention`) on TPU when no padding mask is
+    given — O(live·block) work per q-block; "dense" forces the masked
+    reference below (also the kernel's numerics anchor)."""
+    if impl == "auto" and key_padding_mask is None:
+        import jax as _jax
+
+        if _jax.default_backend() == "tpu":
+            from .pallas.block_sparse_attention import block_sparse_attention
+
+            return block_sparse_attention(q, k, v, sparsity_config, causal)
     S = q.shape[1]
     layout = sparsity_config.make_layout(S)
     mask = block_layout_to_token_mask(layout, sparsity_config.block, causal)

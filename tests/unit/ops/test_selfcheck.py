@@ -1,0 +1,62 @@
+"""The bench kernel self-check gate (VERDICT round-2 item 7).
+
+On CPU the kernels route to their jnp references, so a clean run passing
+here only proves the gate's plumbing; the real numerics check happens on
+the chip (bench.py runs it before the headline).  What IS provable
+anywhere: a wrong kernel fails the gate — the gate has teeth.
+"""
+
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[3])
+
+
+def _bench():
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def test_selfcheck_passes_clean():
+    _bench().selfcheck()
+
+
+def test_selfcheck_detects_broken_kernel(monkeypatch):
+    """A kernel producing wrong values (the round-1 VMEM-overflow class)
+    must fail the gate."""
+    bench = _bench()
+    import importlib
+
+    fa_mod = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.flash_attention")
+    real = fa_mod.flash_attention
+
+    def broken(q, k, v, *a, **kw):
+        return real(q, k, v, *a, **kw) * 1.5  # silently wrong scale
+
+    monkeypatch.setattr(fa_mod, "flash_attention", broken)
+    with pytest.raises(AssertionError, match="selfcheck FAILED"):
+        bench.selfcheck()
+
+
+def test_selfcheck_detects_nan(monkeypatch):
+    bench = _bench()
+    import importlib
+
+    da_mod = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.decode_attention")
+    real = da_mod.decode_attention
+
+    def nan_kernel(q, k_cache, v_cache, lengths, **kw):
+        out = real(q, k_cache, v_cache, lengths, **kw)
+        return out.at[0].set(np.nan)
+
+    monkeypatch.setattr(da_mod, "decode_attention", nan_kernel)
+    with pytest.raises(AssertionError, match="selfcheck FAILED"):
+        bench.selfcheck()
