@@ -177,6 +177,8 @@ class RaggedScheduler:
                     eos_token_id: Optional[int] = None) -> None:
         """Single-step acceptance — a burst of 1 (kept for callers that
         decode one token per dispatch)."""
+        if not requests:
+            return
         order = {r.slot: i for i, r in enumerate(requests)}
         row = np.zeros((1, max(order) + 1), tokens.dtype)
         for req in requests:

@@ -205,17 +205,26 @@ def _bs_attention(q, k, v, layout_key, causal, block_q, block_k, cb,
                    interpret)[0]
 
 
-#: key → np layout (hashable indirection for custom_vjp); bounded LRU —
-#: entries are the raw [H, nb, nb] layouts (tens of KB each)
+#: key → np layout (hashable indirection for custom_vjp); bounded LRU.
+#: The key embeds (bytes, shape, dtype) so an evicted entry can always be
+#: reconstructed — a delayed vjp after 32+ other layouts must not KeyError.
 _LAYOUTS: OrderedDict = OrderedDict()
 _LAYOUTS_MAX = 32
+
+
+def _layout_from_key(key) -> np.ndarray:
+    cached = _LAYOUTS.get(key)
+    if cached is not None:
+        return cached
+    raw, shape, dtype = key
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
 
 
 def _bs_fwd(q, k, v, layout_key, causal, block_q, block_k, cb, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    layout = _LAYOUTS[layout_key]
+    layout = _layout_from_key(layout_key)
     B, S, h, d = q.shape
     H = layout.shape[0]
     idx, counts, cells = _plan(layout, S, block_q, block_k, cb, causal)
@@ -260,7 +269,7 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     """Dense masked backward (correct everywhere; sparse-fast bwd is a
     later optimization)."""
     q, k, v = res
-    layout = _LAYOUTS[layout_key]
+    layout = _layout_from_key(layout_key)
 
     def f(q, k, v):
         return _dense_reference(q, k, v, layout, cb, causal)
@@ -302,7 +311,7 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if not (fits(block_q) and fits(block_k)):
         return _dense_reference(q, k, v, layout, cb, causal)
 
-    key = (layout.tobytes(), layout.shape)
+    key = (layout.tobytes(), layout.shape, layout.dtype.str)
     _LAYOUTS[key] = layout
     _LAYOUTS.move_to_end(key)
     while len(_LAYOUTS) > _LAYOUTS_MAX:
