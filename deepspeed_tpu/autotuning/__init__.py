@@ -1,3 +1,3 @@
-from .autotuner import Autotuner, autotune
+from .autotuner import Autotuner, ModelBasedTuner, autotune
 
-__all__ = ["Autotuner", "autotune"]
+__all__ = ["Autotuner", "ModelBasedTuner", "autotune"]

@@ -252,7 +252,12 @@ def _timed(name: str, fn, x):
     t0 = time.perf_counter()
     out = fn(x)
     if comms_logger.enabled:
-        out = jax.block_until_ready(out)
+        # block_until_ready is a no-op on tunneled platforms (axon) — a
+        # ONE-element fetch (device-side index, then host transfer of a
+        # scalar) is the reliable execution fence
+        jax.block_until_ready(out)
+        leaf = jax.tree.leaves(out)[0]
+        np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)])
         comms_logger.record(name, _nbytes(x), time.perf_counter() - t0)
     return out
 

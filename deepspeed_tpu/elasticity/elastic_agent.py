@@ -1,70 +1,114 @@
-"""Elastic agent v2 — restart/rendezvous supervision.
+"""Elastic agent v2 — cross-host rendezvous + restart supervision.
 
 Reference: ``deepspeed/elasticity/elastic_agent.py:DSElasticAgent`` [K]
 (SURVEY §5.3): subclasses torch-elastic's agent — rendezvous store, worker
 monitoring, restart on membership change or failure, each restart
 re-initializing the process group and resuming from checkpoint.
 
-TPU mapping (SURVEY §5.3's plan): the rendezvous/process-group piece is
-``jax.distributed.initialize`` driven by coordinator env vars, and "resume
-at a different world size" is the checkpoint reshard-on-load the runtime
-already provides (orbax restores into whatever mesh the restarted world
-builds).  What the agent owns is the supervision loop: run the training
-function, catch worker failure, tear down the distributed client,
-re-rendezvous (env may now describe a different world), and relaunch from
-the latest checkpoint — up to ``max_restarts``.
+TPU mapping: the process-group piece is ``jax.distributed.initialize``
+driven by coordinator env vars; "resume at a different world size" is the
+checkpoint reshard-on-load the runtime already provides (orbax restores
+into whatever mesh the restarted world builds).  The agent owns:
+
+* the CROSS-HOST rendezvous (``rendezvous.ElasticRendezvous`` over the
+  TCP store — torch-elastic's TCPStore role): each round assigns
+  ``(rank, world, coordinator)`` and rank 0's host coordinates
+  ``jax.distributed`` for that round;
+* supervision: run the worker (a subprocess for real deployments — a
+  crash cannot take the agent down — or an in-process fn for embedding),
+  heartbeat the store, and watch for (a) local worker failure, (b) a
+  round bump by a peer, (c) stale peer heartbeats.  Any of the three
+  tears the local worker down and re-rendezvouses — every surviving
+  agent converges on the new membership within a heartbeat interval.
 """
 
 from __future__ import annotations
 
 import os
+import signal
+import subprocess
+import sys
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional
 
 from ..utils.logging import log_dist, logger
+from .rendezvous import ElasticRendezvous, RendezvousClient, RendezvousServer
 
 
 class WorkerSpec:
-    """Reference-shaped description of the elastic worker."""
+    """Reference-shaped description of the elastic worker: either a
+    callable ``fn(restart_count, checkpoint_dir, *args)`` (in-process) or
+    a ``cmd`` argv (subprocess — the production mode)."""
 
-    def __init__(self, fn: Callable[..., Any], args: tuple = (),
+    def __init__(self, fn: Optional[Callable[..., Any]] = None,
+                 args: tuple = (), cmd: Optional[List[str]] = None,
                  max_restarts: int = 3, monitor_interval: float = 0.1,
+                 heartbeat_ttl: float = 5.0,
                  checkpoint_dir: Optional[str] = None):
+        if (fn is None) == (cmd is None):
+            raise ValueError("WorkerSpec needs exactly one of fn= or cmd=")
         self.fn = fn
         self.args = args
+        self.cmd = list(cmd) if cmd else None
         self.max_restarts = int(max_restarts)
         self.monitor_interval = float(monitor_interval)
+        self.heartbeat_ttl = float(heartbeat_ttl)
         self.checkpoint_dir = checkpoint_dir
 
 
-class DSElasticAgent:
-    """Supervise an elastic training function.
+class _RestartSignal(Exception):
+    """Internal: membership changed / peer died — restart the attempt."""
 
-    ``fn(restart_count, checkpoint_dir, *args)`` runs one training
-    attempt; raising marks the attempt failed.  Between attempts the agent
-    re-reads the coordinator env (COORDINATOR_ADDRESS / NUM_PROCESSES /
-    PROCESS_ID — the jax.distributed discovery the launcher sets) and
-    re-initializes the distributed client, so a changed membership simply
-    yields a different mesh on relaunch; state continuity comes from the
-    checkpoint dir (reshard-on-load handles the new layout).
+
+class DSElasticAgent:
+    """Supervise an elastic training worker across hosts.
+
+    Without a rendezvous (``rdzv=None`` and no ``DS_RDZV_ENDPOINT``), this
+    degrades to the single-host supervision loop (round-2 behavior).  With
+    one, every attempt (re-)joins the current membership round first.
     """
 
-    def __init__(self, spec: WorkerSpec, start_method: str = "inproc"):
+    def __init__(self, spec: WorkerSpec, start_method: str = "inproc",
+                 rdzv: Optional[ElasticRendezvous] = None,
+                 node_id: Optional[str] = None):
         self.spec = spec
         self.start_method = start_method
         self.restart_count = 0
         self.last_result: Any = None
+        self.node_id = node_id or os.environ.get(
+            "DS_ELASTIC_NODE_ID", f"node-{os.getpid()}")
+        if rdzv is None and os.environ.get("DS_RDZV_ENDPOINT"):
+            rdzv = ElasticRendezvous(
+                RendezvousClient(os.environ["DS_RDZV_ENDPOINT"]),
+                node_id=self.node_id,
+                min_nodes=int(os.environ.get("DS_ELASTIC_MIN_NODES", "1")),
+                max_nodes=int(os.environ.get("DS_ELASTIC_MAX_NODES", "64")))
+        self.rdzv = rdzv
+        self._round = -1
+        self._peers: List[str] = []
 
     # -- rendezvous --------------------------------------------------------
 
     def _rendezvous(self) -> None:
-        """(Re-)join the jax.distributed world described by the env.
-        No-op when no coordinator is configured (single process)."""
+        """(Re-)join the world.  Store-backed when available; else the
+        static env the launcher set (COORDINATOR_ADDRESS / NUM_PROCESSES /
+        PROCESS_ID)."""
+        if self.rdzv is not None:
+            r, rank, world, coord = self.rdzv.next_round()
+            self._round = r
+            members = self.rdzv.c.get(
+                ElasticRendezvous._members_key(r)) or []
+            self._peers = [m[0] for m in members]
+            os.environ["COORDINATOR_ADDRESS"] = coord
+            os.environ["NUM_PROCESSES"] = str(world)
+            os.environ["PROCESS_ID"] = str(rank)
+            log_dist(f"elastic rendezvous: round={r} rank={rank}/{world} "
+                     f"coordinator={coord}")
+        coord = os.environ.get("COORDINATOR_ADDRESS")
+        if not coord or self.spec.cmd is not None:
+            return  # subprocess workers init jax.distributed themselves
         import jax
 
-        coord = os.environ.get("COORDINATOR_ADDRESS")
-        if not coord:
-            return
         try:
             jax.distributed.shutdown()
         except Exception:
@@ -73,8 +117,6 @@ class DSElasticAgent:
             coordinator_address=coord,
             num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
             process_id=int(os.environ.get("PROCESS_ID", "0")))
-        log_dist(f"elastic rendezvous: world={os.environ.get('NUM_PROCESSES')}"
-                 f" process={os.environ.get('PROCESS_ID')}")
 
     # -- supervision loop --------------------------------------------------
 
@@ -83,30 +125,132 @@ class DSElasticAgent:
         while True:
             try:
                 self._rendezvous()
-                self.last_result = spec.fn(self.restart_count,
-                                           spec.checkpoint_dir, *spec.args)
+                if spec.cmd is not None:
+                    self.last_result = self._run_subprocess()
+                else:
+                    self.last_result = self._run_fn()
+                if self.rdzv is not None:
+                    # graceful leave: peers must not mistake a finished
+                    # node's silent heartbeat for a death and tear down
+                    # their own near-complete attempts
+                    self.rdzv.leave()
                 log_dist(f"elastic worker finished after "
                          f"{self.restart_count} restart(s)")
                 return self.last_result
+            except _RestartSignal as e:
+                self._maybe_restart(e, announce=False)
             except SystemExit as e:
                 # scripts commonly end via sys.exit(main()); code 0/None is
                 # success, anything else is a worker failure to supervise
                 if e.code in (0, None):
                     return self.last_result
-                e = RuntimeError(f"worker exited with code {e.code}")
-                self._maybe_restart(e)
+                self._maybe_restart(
+                    RuntimeError(f"worker exited with code {e.code}"))
             except Exception as e:  # worker failure → restart or give up
                 self._maybe_restart(e)
 
-    def _maybe_restart(self, e: BaseException) -> None:
+    def _run_fn(self) -> Any:
+        """In-process attempt.  With a rendezvous attached, a daemon thread
+        keeps heartbeating (so peers don't declare this node dead mid-
+        attempt) and watches the round counter; an in-process fn cannot be
+        preempted, so a round bump is honored AFTER the fn returns (the
+        attempt's result is discarded and the agent re-rendezvouses —
+        subprocess mode is the production path for prompt teardown)."""
+        spec = self.spec
+        if self.rdzv is None:
+            return spec.fn(self.restart_count, spec.checkpoint_dir,
+                           *spec.args)
+        import threading
+
+        stop = threading.Event()
+        round_moved = threading.Event()
+
+        def beat():
+            while not stop.wait(spec.monitor_interval):
+                try:
+                    self.rdzv.heartbeat()
+                    if self.rdzv.current_round() != self._round:
+                        # the attempt is already doomed; latch and stop so
+                        # we never bump a round someone else already moved
+                        round_moved.set()
+                        return
+                    stale = self.rdzv.stale_peers(self._peers,
+                                                  spec.heartbeat_ttl)
+                    if stale:
+                        # bump ONCE, then latch — re-bumping every tick
+                        # would storm the counter past the round peers
+                        # are trying to re-form on
+                        self.rdzv.bump_round(f"stale peers {stale}")
+                        round_moved.set()
+                        return
+                except Exception:
+                    pass  # store hiccup — keep the attempt running
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            result = spec.fn(self.restart_count, spec.checkpoint_dir,
+                             *spec.args)
+        finally:
+            stop.set()
+            t.join(timeout=2)
+        if round_moved.is_set():
+            raise _RestartSignal(
+                f"membership round moved past {self._round} during the "
+                f"attempt — result discarded, re-rendezvousing")
+        return result
+
+    def _run_subprocess(self) -> int:
+        """Spawn the worker argv and monitor it: heartbeat, watch the
+        round counter and peer heartbeats, reap the child.  Returns the
+        child's exit code (0) on success."""
+        spec = self.spec
+        env = dict(os.environ)
+        env["DS_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+        if spec.checkpoint_dir:
+            env["DS_ELASTIC_CHECKPOINT_DIR"] = spec.checkpoint_dir
+        proc = subprocess.Popen(spec.cmd, env=env)
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        return 0
+                    if self.rdzv is not None:
+                        self.rdzv.bump_round(
+                            f"worker on {self.node_id} exited rc={rc}")
+                    raise RuntimeError(
+                        f"worker exited with code {rc}")
+                if self.rdzv is not None:
+                    self.rdzv.heartbeat()
+                    if self.rdzv.current_round() != self._round:
+                        raise _RestartSignal(
+                            f"membership round moved past {self._round}")
+                    stale = self.rdzv.stale_peers(self._peers,
+                                                  spec.heartbeat_ttl)
+                    if stale:
+                        self.rdzv.bump_round(f"stale peers {stale}")
+                        raise _RestartSignal(f"peers {stale} went silent")
+                time.sleep(spec.monitor_interval)
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+    def _maybe_restart(self, e: BaseException, announce: bool = True) -> None:
         spec = self.spec
         self.restart_count += 1
         if self.restart_count > spec.max_restarts:
             logger.error(f"elastic agent: giving up after "
                          f"{spec.max_restarts} restarts ({e!r})")
             raise e
-        logger.warning(f"elastic agent: worker failed ({e!r}); restart "
-                       f"{self.restart_count}/{spec.max_restarts}")
+        level = logger.warning if announce else logger.info
+        level(f"elastic agent[{self.node_id}]: restarting "
+              f"({self.restart_count}/{spec.max_restarts}): {e!r}")
         time.sleep(spec.monitor_interval)
 
 
@@ -120,26 +264,64 @@ def launch_elastic(fn: Callable[..., Any], args: tuple = (),
 
 
 def cli_main(argv=None) -> int:
-    """``ds_elastic`` CLI: supervise a user script under the agent."""
+    """``ds_elastic`` CLI: supervise a user script under the agent.
+
+    ``--rdzv_endpoint host:port`` joins a cross-host rendezvous store
+    (start one with ``--standalone`` on the first node); without it the
+    agent is the single-host supervision loop."""
     import argparse
     import runpy
-    import sys
 
     parser = argparse.ArgumentParser(prog="ds_elastic")
     parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument("--checkpoint_dir", default=None)
+    parser.add_argument("--rdzv_endpoint", default=None,
+                        help="host:port of the rendezvous store")
+    parser.add_argument("--standalone", action="store_true",
+                        help="also host the rendezvous store here")
+    parser.add_argument("--min_nodes", type=int, default=1)
+    parser.add_argument("--max_nodes", type=int, default=64)
+    parser.add_argument("--node_id", default=None)
+    parser.add_argument("--subprocess", action="store_true",
+                        help="run the script as a supervised subprocess "
+                             "(recommended with a rendezvous)")
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs="*")
     args = parser.parse_args(argv)
 
-    def worker(restart_count, ckpt_dir):
-        os.environ["DS_ELASTIC_RESTART_COUNT"] = str(restart_count)
-        if ckpt_dir:
-            os.environ["DS_ELASTIC_CHECKPOINT_DIR"] = ckpt_dir
-        sys.argv = [args.user_script] + list(args.user_args)
-        runpy.run_path(args.user_script, run_name="__main__")
-        return 0
+    server = None
+    if args.standalone:
+        host = (args.rdzv_endpoint or "127.0.0.1:29499").rsplit(":", 1)
+        server = RendezvousServer(host[0], int(host[1]))
+        os.environ["DS_RDZV_ENDPOINT"] = server.endpoint
+        print(f"rendezvous store: {server.endpoint}")
+    elif args.rdzv_endpoint:
+        os.environ["DS_RDZV_ENDPOINT"] = args.rdzv_endpoint
+    os.environ["DS_ELASTIC_MIN_NODES"] = str(args.min_nodes)
+    os.environ["DS_ELASTIC_MAX_NODES"] = str(args.max_nodes)
+    if args.node_id:
+        os.environ["DS_ELASTIC_NODE_ID"] = args.node_id
 
-    launch_elastic(worker, max_restarts=args.max_restarts,
-                   checkpoint_dir=args.checkpoint_dir)
-    return 0
+    try:
+        if args.subprocess or os.environ.get("DS_RDZV_ENDPOINT"):
+            spec = WorkerSpec(
+                cmd=[sys.executable, args.user_script] + list(args.user_args),
+                max_restarts=args.max_restarts,
+                checkpoint_dir=args.checkpoint_dir)
+            DSElasticAgent(spec).run()
+            return 0
+
+        def worker(restart_count, ckpt_dir):
+            os.environ["DS_ELASTIC_RESTART_COUNT"] = str(restart_count)
+            if ckpt_dir:
+                os.environ["DS_ELASTIC_CHECKPOINT_DIR"] = ckpt_dir
+            sys.argv = [args.user_script] + list(args.user_args)
+            runpy.run_path(args.user_script, run_name="__main__")
+            return 0
+
+        launch_elastic(worker, max_restarts=args.max_restarts,
+                       checkpoint_dir=args.checkpoint_dir)
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()

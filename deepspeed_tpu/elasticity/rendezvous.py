@@ -1,0 +1,280 @@
+"""Cross-host elastic rendezvous — store, rounds, heartbeats.
+
+Reference: torch-elastic's rendezvous backend (c10d TCPStore + the
+etcd/c10d rendezvous state machine) that ``DSElasticAgent`` rides
+(``deepspeed/elasticity/elastic_agent.py`` [K], SURVEY §5.3).  Round 2's
+agent supervised an in-process worker only; this module adds the
+cross-host story:
+
+* :class:`RendezvousServer` — a tiny TCP key-value store (JSON line
+  protocol: GET/SET/ADD/WAIT) playing the reference's TCPStore role for
+  the CONTROL plane only (the data plane is XLA over ICI/DCN; the hot
+  path never touches this).
+* :class:`ElasticRendezvous` — versioned membership rounds on top of the
+  store: agents join a round, barrier until ``min_nodes`` are present
+  (plus a settle window up to ``max_nodes``), and receive deterministic
+  ``(round, rank, world, coordinator)`` assignments — rank 0's host
+  becomes the ``jax.distributed`` coordinator for that round.
+* Heartbeats + round bumps: every agent heartbeats ``hb/<node>``; a
+  worker failure (or a stale heartbeat noticed by any peer) bumps the
+  round counter, which every other agent's monitor loop watches — they
+  tear down their local workers and re-rendezvous.  Membership may differ
+  in the new round; resume-at-a-different-world is the checkpoint
+  reshard-on-load the runtime already provides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import log_dist, logger
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+class _StoreState:
+    def __init__(self):
+        self.data: Dict[str, Any] = {}
+        self.cond = threading.Condition()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        state: _StoreState = self.server.state  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+            except Exception:
+                break
+            op = req.get("op")
+            with state.cond:
+                if op == "set":
+                    state.data[req["k"]] = req["v"]
+                    state.cond.notify_all()
+                    out = {"ok": True}
+                elif op == "get":
+                    out = {"ok": True, "v": state.data.get(req["k"])}
+                elif op == "add":
+                    v = int(state.data.get(req["k"], 0)) + int(req["d"])
+                    state.data[req["k"]] = v
+                    state.cond.notify_all()
+                    out = {"ok": True, "v": v}
+                elif op == "append":
+                    lst = list(state.data.get(req["k"], []))
+                    if req["v"] not in lst:
+                        lst.append(req["v"])
+                    state.data[req["k"]] = lst
+                    state.cond.notify_all()
+                    out = {"ok": True, "v": lst}
+                elif op == "wait_ge":
+                    deadline = time.monotonic() + float(req.get("t", 30.0))
+                    ok = True
+                    while int(state.data.get(req["k"], 0)) < int(req["v"]):
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            ok = False
+                            break
+                        state.cond.wait(left)
+                    out = {"ok": ok, "v": state.data.get(req["k"], 0)}
+                else:
+                    out = {"ok": False, "err": f"bad op {op!r}"}
+            self.wfile.write((json.dumps(out) + "\n").encode())
+            self.wfile.flush()
+
+
+class RendezvousServer:
+    """Threaded TCP store; start on ONE host (usually alongside agent 0)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.state = _StoreState()  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log_dist(f"rendezvous store at {self.host}:{self.port}")
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RendezvousClient:
+    """One persistent connection to the store (reconnects on failure)."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+            self._file = s.makefile("rwb")
+            self._sock = s
+        return self._sock
+
+    def _call(self, **req) -> Dict[str, Any]:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    self._connect()
+                    self._file.write((json.dumps(req) + "\n").encode())
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("store closed connection")
+                    return json.loads(line)
+                except (OSError, ConnectionError):
+                    self.close()
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def set(self, k: str, v: Any) -> None:
+        self._call(op="set", k=k, v=v)
+
+    def get(self, k: str) -> Any:
+        return self._call(op="get", k=k)["v"]
+
+    def add(self, k: str, d: int = 1) -> int:
+        return int(self._call(op="add", k=k, d=d)["v"])
+
+    def append(self, k: str, v: Any) -> List[Any]:
+        return list(self._call(op="append", k=k, v=v)["v"])
+
+    def wait_ge(self, k: str, v: int, timeout: float = 30.0) -> bool:
+        return bool(self._call(op="wait_ge", k=k, v=v, t=timeout)["ok"])
+
+
+# ---------------------------------------------------------------------------
+# rendezvous rounds
+# ---------------------------------------------------------------------------
+
+class ElasticRendezvous:
+    """Versioned membership rounds (torch-elastic rendezvous role).
+
+    Each agent calls :meth:`next_round` to (re-)join; the call blocks
+    until ``min_nodes`` agents are present in the CURRENT round, waits a
+    short settle window for late joiners (up to ``max_nodes``), then
+    returns ``(round_id, rank, world, coordinator_address)``.  Ranks are
+    the sorted order of node ids — deterministic across agents.
+    """
+
+    def __init__(self, client: RendezvousClient, node_id: str,
+                 min_nodes: int = 1, max_nodes: int = 64,
+                 coordinator_port: int = 9876, settle_s: float = 0.3,
+                 timeout_s: float = 60.0):
+        self.c = client
+        self.node_id = node_id
+        self.min_nodes = int(min_nodes)
+        self.max_nodes = int(max_nodes)
+        self.coordinator_port = int(coordinator_port)
+        self.settle_s = float(settle_s)
+        self.timeout_s = float(timeout_s)
+
+    # round bookkeeping keys
+    @staticmethod
+    def _members_key(r: int) -> str:
+        return f"rdzv/round/{r}/members"
+
+    def current_round(self) -> int:
+        return int(self.c.get("rdzv/round") or 0)
+
+    def bump_round(self, reason: str = "") -> int:
+        r = self.c.add("rdzv/round", 1)
+        log_dist(f"rendezvous round bumped to {r} ({reason})")
+        return r
+
+    def next_round(self) -> Tuple[int, int, int, str]:
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"rendezvous: no stable round within {self.timeout_s}s")
+            r = self.current_round()
+            members = self.c.append(self._members_key(r),
+                                    [self.node_id, _my_host()])
+            if len(members) < self.min_nodes:
+                # block until enough peers have joined THIS round (or the
+                # round moves on under us)
+                while (time.monotonic() < deadline
+                       and self.current_round() == r
+                       and len(members) < self.min_nodes):
+                    time.sleep(0.05)
+                    members = self.c.append(self._members_key(r),
+                                            [self.node_id, _my_host()])
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"rendezvous round {r}: {len(members)} of "
+                        f"{self.min_nodes} nodes after {self.timeout_s}s")
+            if self.current_round() != r:
+                continue  # round moved while we waited — rejoin
+            time.sleep(self.settle_s)  # late joiners up to max_nodes
+            members = sorted(self.c.get(self._members_key(r)) or [],
+                             key=lambda m: m[0])[:self.max_nodes]
+            ids = [m[0] for m in members]
+            if self.node_id not in ids:
+                continue  # squeezed out by max_nodes — rejoin next round
+            rank = ids.index(self.node_id)
+            world = len(ids)
+            coord_host = members[0][1]
+            coord = f"{coord_host}:{self.coordinator_port + (r % 32)}"
+            self.c.set(f"rdzv/left/{self.node_id}", False)  # (re)joined
+            self.heartbeat()
+            return r, rank, world, coord
+
+    # -- failure detection -------------------------------------------------
+
+    def heartbeat(self) -> None:
+        self.c.set(f"rdzv/hb/{self.node_id}", time.time())
+
+    def leave(self) -> None:
+        """Graceful departure: a finished node stops heartbeating but must
+        not be mistaken for a death — peers skip left nodes in
+        :meth:`stale_peers` and keep their own attempts running."""
+        self.c.set(f"rdzv/left/{self.node_id}", True)
+
+    def stale_peers(self, peer_ids: List[str], ttl_s: float) -> List[str]:
+        now = time.time()
+        stale = []
+        for pid in peer_ids:
+            if pid == self.node_id:
+                continue
+            if self.c.get(f"rdzv/left/{pid}"):
+                continue  # graceful leave, not a death
+            ts = self.c.get(f"rdzv/hb/{pid}")
+            if ts is None or now - float(ts) > ttl_s:
+                stale.append(pid)
+        return stale
+
+
+def _my_host() -> str:
+    return os.environ.get("DS_ELASTIC_HOST",
+                          socket.gethostbyname(socket.gethostname())
+                          if os.environ.get("DS_ELASTIC_RESOLVE")
+                          else "127.0.0.1")

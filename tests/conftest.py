@@ -18,6 +18,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE a persistent XLA compilation cache was tried here (8x faster warm
+# reruns) and REVERTED: an interrupted run leaves entries that abort the
+# whole process on load (`Fatal Python error: Aborted` inside the XLA CPU
+# client) — a poisoned cache turns every later suite run red with no
+# Python-level recovery.  bench.py still uses one, with a dirty-run
+# sentinel that wipes the dir after any unclean exit.
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Full-suite runner: one fresh pytest process per shard.
+#
+# Why sharded: a single-process run of all ~260 tests intermittently dies
+# with a silent SIGABRT inside the XLA CPU runtime after ~240 heavy
+# jit-compiled tests (cumulative runtime state; maps/fds/threads/RSS all
+# far below limits — tracked as a known issue, reproduced only in
+# whole-suite single-process runs).  Sharding by directory gives each
+# slice a fresh XLA client, which is also how CI tiers the suite.
+#
+# Usage: tests/run_suite.sh [extra pytest args...]
+set -u
+cd "$(dirname "$0")/.."
+
+SHARDS=(
+  "tests/unit/inference"
+  "tests/unit/launcher tests/unit/models"
+  "tests/unit/moe tests/unit/ops tests/unit/parallel"
+  "tests/unit/runtime"
+  "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
+  "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
+)
+
+total_pass=0
+fail=0
+for shard in "${SHARDS[@]}"; do
+  echo "=== shard: $shard"
+  log=$(mktemp)
+  python -m pytest $shard -q "$@" >"$log" 2>&1
+  rc=$?  # the real exit code — a silent SIGABRT has no text to grep
+  tail -2 "$log"
+  n=$(grep -oE '[0-9]+ passed' "$log" | grep -oE '[0-9]+' | head -1)
+  total_pass=$((total_pass + ${n:-0}))
+  if [ $rc -ne 0 ]; then
+    echo "=== shard FAILED (exit $rc)"
+    fail=1
+  fi
+  rm -f "$log"
+done
+echo "=== total passed: $total_pass; fail=$fail"
+exit $fail

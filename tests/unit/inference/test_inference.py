@@ -10,6 +10,8 @@ from deepspeed_tpu.models import LlamaConfig, LlamaModel
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 def greedy_reference(model, params, input_ids, n_new):
     """Re-run the full forward for every generated token (no cache)."""

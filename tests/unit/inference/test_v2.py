@@ -22,6 +22,7 @@ from deepspeed_tpu.ops.pallas.paged_attention import (
 # kernel numerics
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_rep", [1, 2])
 def test_paged_decode_matches_dense(n_rep):
     """Paged attention over a shuffled page table == dense attention over
@@ -61,6 +62,7 @@ def test_paged_decode_matches_dense(n_rep):
     np.testing.assert_allclose(np.asarray(out), want, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_paged_kernel_interpret_matches_reference():
     """The Pallas kernel (interpret mode) == the jnp reference."""
     rng = np.random.RandomState(1)
@@ -165,6 +167,7 @@ def _v1_greedy(model, params, prompt, n_new):
     return np.asarray(out)[0, len(prompt):].tolist()
 
 
+@pytest.mark.slow
 def test_v2_matches_v1_greedy_ragged(tiny_model):
     model, params = tiny_model
     rng = np.random.RandomState(3)
@@ -185,6 +188,7 @@ def test_v2_matches_v1_greedy_ragged(tiny_model):
     assert eng2.scheduler.allocator.num_free == 63
 
 
+@pytest.mark.slow
 def test_v2_continuous_batching_slot_reuse(tiny_model):
     """A short request finishing early frees its slot for a waiting one;
     results still match v1 per-prompt."""
@@ -204,6 +208,7 @@ def test_v2_continuous_batching_slot_reuse(tiny_model):
     assert eng2.scheduler.allocator.num_free == 63
 
 
+@pytest.mark.slow
 def test_v2_mixtral_matches_v1_greedy():
     """MoE models route through v2 unchanged (model._ffn override)."""
     from deepspeed_tpu.models import MixtralConfig, MixtralModel
@@ -225,6 +230,7 @@ def test_v2_mixtral_matches_v1_greedy():
         assert g == want
 
 
+@pytest.mark.slow
 def test_v2_eos_stops_early(tiny_model):
     model, params = tiny_model
     prompt = [5, 6, 7]
@@ -242,6 +248,7 @@ def test_v2_eos_stops_early(tiny_model):
     assert got[0] == want[:stop + 1]
 
 
+@pytest.mark.slow
 def test_v2_opt_matches_v1_greedy():
     """OPT (LayerNorm + learned positions + biased projections) serves on
     v2 through its adapter — the family the llama-schema engine could not
@@ -264,6 +271,7 @@ def test_v2_opt_matches_v1_greedy():
         assert g == want, f"prompt len {len(prompt)}: {g} != {want}"
 
 
+@pytest.mark.slow
 def test_v2_batched_prefill_and_burst(tiny_model):
     """prefill_batch>1 (chunks from several requests in one call) and
     decode_burst>1 (multi-token in-graph decode) keep greedy equivalence
@@ -283,6 +291,7 @@ def test_v2_batched_prefill_and_burst(tiny_model):
     assert eng2.scheduler.allocator.num_free == 95
 
 
+@pytest.mark.slow
 def test_v2_burst_eos_truncation(tiny_model):
     """EOS inside a burst: surplus burst tokens are discarded and the pages
     come back (host-side acceptance after the in-graph loop)."""
@@ -301,6 +310,7 @@ def test_v2_burst_eos_truncation(tiny_model):
     assert eng2.scheduler.allocator.num_free == 31
 
 
+@pytest.mark.slow
 def test_v2_temperature_sampling_in_graph(tiny_model):
     """temperature>0 samples in-graph: output differs across seeds but
     stays fixed for a given seed (reproducible device-side sampling)."""
@@ -318,6 +328,7 @@ def test_v2_temperature_sampling_in_graph(tiny_model):
     assert a != c  # astronomically unlikely to collide for 8 tokens
 
 
+@pytest.mark.slow
 def test_paged_kernel_window_matches_reference():
     """Windowed paged kernel (interpret) == windowed reference — including
     sequences long enough that whole pages fall before the window (the
@@ -337,3 +348,39 @@ def test_paged_kernel_window_matches_reference():
                                      interpret=True, window=W)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5, err_msg=f"W={W}")
+
+
+@pytest.mark.slow
+def test_v2_tp_sharded_serving_matches_meshless():
+    """TP-sharded v2 serving (reference inference/v2 serves TP-sharded
+    models): params in their param_specs shardings, KV pool sharded on the
+    kv-head axis over ``tensor`` — greedy tokens match the meshless engine
+    and the pool really is sharded."""
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    cfg = LlamaConfig.tiny(num_layers=2, max_seq_len=64, num_heads=8,
+                           num_kv_heads=4, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 512, size=n).tolist() for n in (4, 13)]
+
+    plain = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=2, prefill_chunk=8, decode_burst=4)
+    want = plain.generate(prompts, max_new_tokens=5)
+
+    groups.reset_mesh()
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, tp=2))
+    tp_model = LlamaModel(cfg, mesh=mesh)
+    eng = build_engine_v2(
+        tp_model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=2, prefill_chunk=8, decode_burst=4, mesh=mesh)
+    assert not eng.pool["k"].sharding.is_fully_replicated
+    got = eng.generate(prompts, max_new_tokens=5)
+    assert got == want

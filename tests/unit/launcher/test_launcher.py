@@ -120,6 +120,7 @@ WORKER = textwrap.dedent("""\
 """)
 
 
+@pytest.mark.slow
 def test_local_multi_two_process_rendezvous(tmp_path):
     """LocalMultiRunner actually launches 2 processes that rendezvous via
     jax.distributed and run a cross-process collective."""

@@ -3,11 +3,14 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import OPTConfig, OPTModel
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
+
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
 
 
 def _cfg(**kw):

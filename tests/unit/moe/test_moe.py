@@ -9,6 +9,8 @@ from deepspeed_tpu.moe import MoE, MOELayer, TopKGate, top_k_gating
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 def test_top1_gating_invariants():
     rng = np.random.RandomState(0)

@@ -11,6 +11,8 @@ from deepspeed_tpu.ops import onebit
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 def test_pack_unpack_roundtrip():
     rng = np.random.RandomState(0)

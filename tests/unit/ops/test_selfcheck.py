@@ -12,6 +12,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 _REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[3])
 
 

@@ -39,6 +39,7 @@ def _batch(cfg, seed=0):
 # async checkpointing
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_async_checkpoint_roundtrip(tmp_path):
     """Async save → keep training → load restores the SAVED state (the
     in-flight write is joined, not torn)."""
@@ -79,6 +80,7 @@ def test_async_engine_serializes_back_to_back_saves(tmp_path):
 # engine.compile()
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_engine_compile_compat():
     cfg, engine = _engine()
     assert engine._train_step_fn is None

@@ -10,6 +10,8 @@ from deepspeed_tpu.runtime.data_pipeline import (
     RandomLTDScheduler, random_ltd_apply)
 from deepspeed_tpu.runtime.data_pipeline.data_sampler import truncate_batch
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 # ---------------------------------------------------------------------------
 # schedules

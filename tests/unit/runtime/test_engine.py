@@ -10,6 +10,8 @@ import deepspeed_tpu as dst
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 HIDDEN = 16
 
 

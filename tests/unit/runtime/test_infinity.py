@@ -12,8 +12,11 @@ from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
-pytestmark = pytest.mark.skipif(not CPUAdamBuilder.is_compatible(),
-                                reason="no g++ toolchain")
+pytestmark = [
+    pytest.mark.slow,  # jit/engine-heavy; smoke tier runs -m "not slow"
+    pytest.mark.skipif(not CPUAdamBuilder.is_compatible(),
+                       reason="no g++ toolchain"),
+]
 
 
 def make_engine(mesh, offload_param=None, nvme_path=None):

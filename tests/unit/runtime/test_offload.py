@@ -40,6 +40,7 @@ def batch():
     return {"input_ids": jnp.asarray(ids)}
 
 
+@pytest.mark.slow
 def test_offload_matches_on_device():
     mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
     b = batch()
@@ -54,6 +55,7 @@ def test_offload_matches_on_device():
     assert losses_off[-1] < losses_off[0]
 
 
+@pytest.mark.slow
 def test_offload_bf16_wire_matches_on_device():
     """bf16 wire mode: device params live in bf16 (fp32 masters host-side),
     grads cross d2h as bf16 — same trajectory as the on-device bf16 path
@@ -74,6 +76,7 @@ def test_offload_bf16_wire_matches_on_device():
     assert losses_off[-1] < losses_off[0]
 
 
+@pytest.mark.slow
 def test_offload_bf16_checkpoint_restores_fp32_masters(tmp_path):
     """Masters travel in the checkpoint: resume must match exactly even
     though the device copy is lossy bf16."""
@@ -95,6 +98,7 @@ def test_offload_bf16_checkpoint_restores_fp32_masters(tmp_path):
     np.testing.assert_allclose(loss_resumed, loss_before, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_offload_bucket_pipeline_structure():
     """Buckets partition all slots in order; pipeline timing surface is
     populated after a step."""
@@ -133,6 +137,7 @@ def test_offload_masters_dp_partitioned():
             seen.add(key)
 
 
+@pytest.mark.slow
 def test_offload_checkpoint_roundtrip(tmp_path):
     mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
     b = batch()

@@ -15,6 +15,9 @@ from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
                                                TiedLayerSpec)
 from deepspeed_tpu.utils import groups
+import pytest
+
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
 
 
 def _tied_module(H=8, V=16):

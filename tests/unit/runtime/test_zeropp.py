@@ -12,6 +12,8 @@ from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.runtime.zero import qgz
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 def test_quantized_allreduce_close_to_exact(mesh8):
     rng = np.random.RandomState(0)

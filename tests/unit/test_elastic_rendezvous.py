@@ -1,0 +1,200 @@
+"""Cross-host elastic recovery (VERDICT round-2 missing #6).
+
+Reference behavior being mirrored: torch-elastic rendezvous + agent
+(``DSElasticAgent`` [K], SURVEY §5.3) — N node agents coordinate through a
+store; a worker failure on ANY node restarts the gang on every node; a
+NODE loss (agent killed hard) is detected via heartbeats and the survivors
+re-form at the smaller world.
+
+"Multi-node" here = multiple agent PROCESSES on localhost sharing one TCP
+store (the same one-box pattern the reference's elastic tests use).
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.rendezvous import (ElasticRendezvous,
+                                                 RendezvousClient,
+                                                 RendezvousServer)
+
+_REPO = str(pathlib.Path(__file__).resolve().parents[2])
+
+
+# ---------------------------------------------------------------------------
+# store + rounds (in-process, threads)
+# ---------------------------------------------------------------------------
+
+def test_store_ops():
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        c.set("k", {"a": 1})
+        assert c.get("k") == {"a": 1}
+        assert c.add("n", 2) == 2
+        assert c.add("n", 3) == 5
+        assert c.append("lst", "x") == ["x"]
+        assert c.append("lst", "x") == ["x"]  # idempotent
+        assert c.append("lst", "y") == ["x", "y"]
+        assert c.wait_ge("n", 5, timeout=1.0)
+        assert not c.wait_ge("n", 99, timeout=0.2)
+    finally:
+        srv.shutdown()
+
+
+def test_rendezvous_assigns_deterministic_ranks():
+    srv = RendezvousServer()
+    try:
+        import threading
+
+        results = {}
+
+        def join(node_id):
+            r = ElasticRendezvous(RendezvousClient(srv.endpoint), node_id,
+                                  min_nodes=3, settle_s=0.2)
+            results[node_id] = r.next_round()
+
+        ts = [threading.Thread(target=join, args=(f"n{i}",))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(results) == 3
+        rounds = {v[0] for v in results.values()}
+        worlds = {v[2] for v in results.values()}
+        coords = {v[3] for v in results.values()}
+        assert len(rounds) == 1 and worlds == {3} and len(coords) == 1
+        ranks = sorted((nid, v[1]) for nid, v in results.items())
+        assert [r for _, r in ranks] == [0, 1, 2]  # sorted-node-id order
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multi-agent gang restart (real processes)
+# ---------------------------------------------------------------------------
+
+_WORKER = textwrap.dedent("""
+    import os, sys, time
+    log = os.environ["T_LOG"]
+    rank = os.environ.get("PROCESS_ID", "?")
+    world = os.environ.get("NUM_PROCESSES", "?")
+    restart = os.environ.get("DS_ELASTIC_RESTART_COUNT", "?")
+    with open(log, "a") as f:
+        f.write(f"start rank={rank} world={world} restart={restart}\\n")
+    if rank == "1" and restart == "0":
+        time.sleep(0.3)
+        sys.exit(1)  # simulated worker crash on node 1, first attempt
+    time.sleep(%(run_s)s)
+    with open(log, "a") as f:
+        f.write(f"done rank={rank} world={world} restart={restart}\\n")
+""")
+
+_AGENT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                        WorkerSpec)
+    spec = WorkerSpec(cmd=[sys.executable, os.environ["T_WORKER"]],
+                      max_restarts=4, monitor_interval=0.05,
+                      heartbeat_ttl=%(ttl)s)
+    DSElasticAgent(spec).run()
+""")
+
+
+def _spawn_agent(tmp_path, endpoint, node_id, worker_py, log,
+                 min_nodes, ttl=5.0, run_s=1.0):
+    env = dict(os.environ)
+    env.update({
+        "DS_RDZV_ENDPOINT": endpoint,
+        "DS_ELASTIC_NODE_ID": node_id,
+        "DS_ELASTIC_MIN_NODES": str(min_nodes),
+        "T_WORKER": worker_py,
+        "T_LOG": log,
+        "JAX_PLATFORMS": "cpu",
+    })
+    return subprocess.Popen(
+        [sys.executable, "-c",
+         _AGENT % {"repo": _REPO, "ttl": ttl}], env=env)
+
+
+@pytest.mark.slow
+def test_gang_restart_on_worker_failure(tmp_path):
+    """Worker dies on node 1 → BOTH nodes' workers restart and both
+    complete at world=2 on the next round."""
+    srv = RendezvousServer()
+    worker_py = str(tmp_path / "worker.py")
+    log = str(tmp_path / "log.txt")
+    with open(worker_py, "w") as f:
+        # run long enough that node 0's first attempt is still in flight
+        # when node 1's crash bumps the round (teardown, not completion)
+        f.write(_WORKER % {"run_s": 3.0})
+    try:
+        agents = [_spawn_agent(tmp_path, srv.endpoint, f"n{i}", worker_py,
+                               log, min_nodes=2) for i in range(2)]
+        for a in agents:
+            assert a.wait(timeout=60) == 0
+        lines = open(log).read().splitlines()
+        done = [l for l in lines if l.startswith("done")]
+        assert len(done) == 2
+        # both completions happened in the SECOND attempt at world=2
+        assert all("world=2" in l and "restart=1" in l for l in done), lines
+        # node 0's first attempt was torn down by the round bump (no done
+        # line with restart=0)
+        assert not any(l.startswith("done") and "restart=0" in l
+                       for l in lines)
+    finally:
+        for a in agents:
+            if a.poll() is None:
+                a.kill()
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_survivor_reforms_after_node_loss(tmp_path):
+    """An agent killed HARD (node loss) → the survivor's heartbeat check
+    bumps the round and it completes alone at world=1."""
+    srv = RendezvousServer()
+    worker_py = str(tmp_path / "worker.py")
+    log = str(tmp_path / "log.txt")
+    # long-running worker so the kill lands mid-attempt; no crash logic
+    with open(worker_py, "w") as f:
+        f.write(textwrap.dedent("""
+            import os, time
+            log = os.environ["T_LOG"]
+            rank = os.environ.get("PROCESS_ID", "?")
+            world = os.environ.get("NUM_PROCESSES", "?")
+            restart = os.environ.get("DS_ELASTIC_RESTART_COUNT", "?")
+            with open(log, "a") as f:
+                f.write(f"start rank={rank} world={world} restart={restart}\\n")
+            time.sleep(float(os.environ.get("T_RUN_S", "2.0")))
+            with open(log, "a") as f:
+                f.write(f"done rank={rank} world={world} restart={restart}\\n")
+        """))
+    try:
+        os.environ["T_RUN_S"] = "4.0"
+        a0 = _spawn_agent(tmp_path, srv.endpoint, "n0", worker_py, log,
+                          min_nodes=1, ttl=1.0)
+        a1 = _spawn_agent(tmp_path, srv.endpoint, "n1", worker_py, log,
+                          min_nodes=1, ttl=1.0)
+        time.sleep(2.0)  # both mid-attempt at world=2
+        a1.send_signal(signal.SIGKILL)  # node loss — no goodbye
+        a1.wait(timeout=10)
+        assert a0.wait(timeout=60) == 0
+        lines = open(log).read().splitlines()
+        # the survivor finished a later attempt at world=1
+        assert any(l.startswith("done") and "world=1" in l
+                   for l in lines), lines
+    finally:
+        os.environ.pop("T_RUN_S", None)
+        for a in (a0, a1):
+            if a.poll() is None:
+                a.kill()
+        srv.shutdown()

@@ -15,6 +15,8 @@ import pytest
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
 
+pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
+
 
 # ---------------------------------------------------------------- elasticity
 
@@ -451,3 +453,45 @@ def test_activation_quantization_wired():
     assert model.act_quant_bits is None
     np.testing.assert_allclose(float(model.loss(params_host, batch)),
                                plain_loss, rtol=1e-6)
+
+
+def test_model_based_tuner_finds_best_with_fewer_measurements():
+    """ModelBasedTuner (reference ModelBasedTuner role): a synthetic
+    throughput landscape with additive structure — the tuner must find the
+    argmax while MEASURING fewer candidates than the 12-point grid."""
+    from deepspeed_tpu.autotuning import ModelBasedTuner
+
+    space = {"zero_optimization.stage": [0, 1, 2],
+             "train_micro_batch_size_per_gpu": [1, 2, 4, 8]}
+    # separable landscape: stage effect x batch effect, best at (1, 4)
+    stage_gain = {0: 1.0, 1: 1.3, 2: 1.1}
+    batch_gain = {1: 0.5, 2: 0.9, 4: 1.2, 8: 1.0}
+    measured = []
+
+    class FakeEngine:
+        def __init__(self, cfg):
+            self.cfg = cfg
+            self.train_batch_size = 1
+
+        def train_step(self, batch):
+            s = self.cfg["zero_optimization"]["stage"]
+            b = self.cfg["train_micro_batch_size_per_gpu"]
+            measured.append((s, b))
+            self._dt = 1.0 / (stage_gain[s] * batch_gain[b])
+            import time as _t
+            _t.sleep(self._dt * 1e-2)
+            return {"loss": 0.0}
+
+    tuner = ModelBasedTuner(lambda cfg: FakeEngine(cfg), lambda cfg: {},
+                            {"zero_optimization": {"stage": 0},
+                             "train_micro_batch_size_per_gpu": 1},
+                            tuning_space=space, warmup_steps=0,
+                            timed_steps=3, seed_measurements=4,
+                            measure_budget=8)
+    result = tuner.tune()
+    assert result["best_combo"] == {"zero_optimization.stage": 1,
+                                    "train_micro_batch_size_per_gpu": 4}
+    n_measured = len({m for m in measured})
+    assert n_measured < 12  # strictly fewer than the grid
+    pruned = [r for r in result["records"] if r.get("pruned") == "perf_model"]
+    assert pruned and all("predicted" in r for r in pruned)
