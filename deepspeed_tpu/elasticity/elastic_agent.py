@@ -222,12 +222,18 @@ class DSElasticAgent:
                     raise RuntimeError(
                         f"worker exited with code {rc}")
                 if self.rdzv is not None:
-                    self.rdzv.heartbeat()
-                    if self.rdzv.current_round() != self._round:
+                    try:
+                        self.rdzv.heartbeat()
+                        moved = self.rdzv.current_round() != self._round
+                        stale = self.rdzv.stale_peers(self._peers,
+                                                      spec.heartbeat_ttl)
+                    except (OSError, ConnectionError):
+                        # transient store hiccup must not kill a healthy
+                        # worker (matches the in-process beat thread)
+                        moved, stale = False, []
+                    if moved:
                         raise _RestartSignal(
                             f"membership round moved past {self._round}")
-                    stale = self.rdzv.stale_peers(self._peers,
-                                                  spec.heartbeat_ttl)
                     if stale:
                         self.rdzv.bump_round(f"stale peers {stale}")
                         raise _RestartSignal(f"peers {stale} went silent")

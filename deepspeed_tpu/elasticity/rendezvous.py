@@ -202,6 +202,10 @@ class ElasticRendezvous:
     def _members_key(r: int) -> str:
         return f"rdzv/round/{r}/members"
 
+    @staticmethod
+    def _sealed_key(r: int) -> str:
+        return f"rdzv/round/{r}/sealed"
+
     def current_round(self) -> int:
         return int(self.c.get("rdzv/round") or 0)
 
@@ -212,13 +216,25 @@ class ElasticRendezvous:
 
     def next_round(self) -> Tuple[int, int, int, str]:
         deadline = time.monotonic() + self.timeout_s
+        my_host = _my_host(self.c._addr)
         while True:
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"rendezvous: no stable round within {self.timeout_s}s")
             r = self.current_round()
+            if self.c.get(self._sealed_key(r)):
+                # SCALE-UP: this round's gang already formed and is
+                # running; joining its member list would give us a world
+                # the running peers don't share.  Bump so everyone
+                # (their monitors watch the counter) re-forms with us.
+                # rejoin immediately — the running peers need a monitor
+                # tick to notice the bump, so our append lands well inside
+                # the new round's settle window
+                self.bump_round(f"node {self.node_id} joining a sealed "
+                                f"round")
+                continue
             members = self.c.append(self._members_key(r),
-                                    [self.node_id, _my_host()])
+                                    [self.node_id, my_host])
             if len(members) < self.min_nodes:
                 # block until enough peers have joined THIS round (or the
                 # round moves on under us)
@@ -227,7 +243,7 @@ class ElasticRendezvous:
                        and len(members) < self.min_nodes):
                     time.sleep(0.05)
                     members = self.c.append(self._members_key(r),
-                                            [self.node_id, _my_host()])
+                                            [self.node_id, my_host])
                 if time.monotonic() >= deadline:
                     raise TimeoutError(
                         f"rendezvous round {r}: {len(members)} of "
@@ -235,14 +251,33 @@ class ElasticRendezvous:
             if self.current_round() != r:
                 continue  # round moved while we waited — rejoin
             time.sleep(self.settle_s)  # late joiners up to max_nodes
+            if self.current_round() != r:
+                continue  # bumped during the settle window — rejoin
             members = sorted(self.c.get(self._members_key(r)) or [],
                              key=lambda m: m[0])[:self.max_nodes]
             ids = [m[0] for m in members]
-            if self.node_id not in ids:
-                continue  # squeezed out by max_nodes — rejoin next round
-            rank = ids.index(self.node_id)
-            world = len(ids)
-            coord_host = members[0][1]
+            hosts = {m[0]: m[1] for m in members}
+            # SEAL via atomic append: the FIRST returner's membership list
+            # freezes the gang — every agent (however racy its own view)
+            # adopts element 0, so no two members ever compute different
+            # worlds for the same round
+            frozen = self.c.append(self._sealed_key(r), ids)[0]
+            if self.node_id not in frozen:
+                if self.node_id in ids:
+                    # arrived inside the settle window after the freeze:
+                    # force a re-formation that includes us
+                    self.bump_round(f"node {self.node_id} arrived after "
+                                    f"round {r} sealed")
+                    continue
+                # squeezed out by max_nodes: park as STANDBY — the round
+                # composition cannot change until the counter moves
+                while (time.monotonic() < deadline
+                       and self.current_round() == r):
+                    time.sleep(self.settle_s)
+                continue
+            rank = frozen.index(self.node_id)
+            world = len(frozen)
+            coord_host = hosts.get(frozen[0], _my_host(self.c._addr))
             coord = f"{coord_host}:{self.coordinator_port + (r % 32)}"
             self.c.set(f"rdzv/left/{self.node_id}", False)  # (re)joined
             self.heartbeat()
@@ -273,8 +308,28 @@ class ElasticRendezvous:
         return stale
 
 
-def _my_host() -> str:
-    return os.environ.get("DS_ELASTIC_HOST",
-                          socket.gethostbyname(socket.gethostname())
-                          if os.environ.get("DS_ELASTIC_RESOLVE")
-                          else "127.0.0.1")
+def _my_host(store_addr: Optional[Tuple[str, int]] = None) -> str:
+    """This node's address as PEERS can reach it.  ``DS_ELASTIC_HOST``
+    overrides; otherwise the outbound-interface IP toward the store (a
+    connected UDP socket reads the route without sending anything) — the
+    address that reaches the store is the one peers can dial for the
+    ``jax.distributed`` coordinator.  Loopback only as a last resort."""
+    env = os.environ.get("DS_ELASTIC_HOST")
+    if env:
+        return env
+    if store_addr is not None:
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((store_addr[0], int(store_addr[1])))
+                ip = s.getsockname()[0]
+            finally:
+                s.close()
+            if ip and not ip.startswith("0."):
+                return ip
+        except OSError:
+            pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"

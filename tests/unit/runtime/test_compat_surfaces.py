@@ -150,3 +150,68 @@ def test_async_latest_marker_deferred_to_commit(tmp_path):
     # commit is deferred until the write is durable
     eng.wait()
     assert committed == [True]
+
+
+def test_accelerator_full_surface():
+    """The L0 surface (reference abstract_accelerator's ~90 methods mapped
+    to XLA semantics): events time, memory queries answer in bytes,
+    tensor constructors build typed jnp arrays, profiler ranges nest,
+    capability probes describe the XLA execution model."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+
+    # events: record/record → elapsed wall ms
+    e0, e1 = acc.Event(enable_timing=True), acc.Event(enable_timing=True)
+    e0.record()
+    _time.sleep(0.01)
+    e1.record()
+    assert 5.0 < e0.elapsed_time(e1) < 1000.0
+    assert e0.query() is True
+
+    # execution-model probes
+    assert acc.is_synchronized_device() is False
+    assert acc.resolves_data_dependency() is True
+    assert acc.use_host_timers() is True
+
+    # memory surface (CPU backend reports zeros; must not raise)
+    assert acc.max_memory_allocated() >= 0
+    free, total = acc.mem_get_info()
+    assert free <= total
+    acc.reset_peak_memory_stats()
+    assert acc.memory_reserved() >= 0
+    assert acc.is_pinned(jnp.zeros(2))
+
+    # device properties
+    props = acc.device_properties()
+    assert {"name", "platform", "total_memory"} <= set(props)
+    assert acc.get_device_name()
+
+    # typed tensor constructors
+    assert acc.BFloat16Tensor([1, 2]).dtype == jnp.bfloat16
+    assert acc.FloatTensor([1, 2]).dtype == jnp.float32
+    assert acc.IntTensor([1, 2]).dtype == jnp.int32
+    assert acc.ByteTensor([1, 2]).dtype == jnp.uint8
+
+    # profiler ranges nest without error
+    acc.range_push("outer")
+    acc.range_push("inner")
+    acc.range_pop()
+    acc.range_pop()
+
+    # RNG + env surface
+    acc.manual_seed_all(7)
+    assert acc.initial_seed() == 7
+    assert acc.default_generator() is not None
+    env = {}
+    acc.set_visible_devices_envs(env, [0, 1])
+    assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+    assert "JAX" in acc.export_envs()
+    assert acc.is_triton_supported() is False
+    called = []
+    acc.lazy_call(lambda: called.append(1))
+    assert called == [1]

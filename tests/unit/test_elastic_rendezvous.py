@@ -198,3 +198,42 @@ def test_survivor_reforms_after_node_loss(tmp_path):
             if a.poll() is None:
                 a.kill()
         srv.shutdown()
+
+
+@pytest.mark.slow
+def test_scale_up_new_node_triggers_reformation(tmp_path):
+    """A node joining a RUNNING (sealed) round bumps it: the running agent
+    restarts its worker and both complete at world=2 (torch-elastic's
+    scale-up semantics)."""
+    srv = RendezvousServer()
+    worker_py = str(tmp_path / "worker.py")
+    log = str(tmp_path / "log.txt")
+    with open(worker_py, "w") as f:
+        f.write(textwrap.dedent("""
+            import os, time
+            log = os.environ["T_LOG"]
+            rank = os.environ.get("PROCESS_ID", "?")
+            world = os.environ.get("NUM_PROCESSES", "?")
+            restart = os.environ.get("DS_ELASTIC_RESTART_COUNT", "?")
+            with open(log, "a") as f:
+                f.write(f"start rank={rank} world={world} restart={restart}\\n")
+            time.sleep(2.0)
+            with open(log, "a") as f:
+                f.write(f"done rank={rank} world={world} restart={restart}\\n")
+        """))
+    try:
+        a0 = _spawn_agent(tmp_path, srv.endpoint, "n0", worker_py, log,
+                          min_nodes=1)
+        time.sleep(1.0)  # n0's round 0 is sealed and running
+        a1 = _spawn_agent(tmp_path, srv.endpoint, "n1", worker_py, log,
+                          min_nodes=1)  # same job config; join → bump
+        assert a0.wait(timeout=60) == 0
+        assert a1.wait(timeout=60) == 0
+        lines = open(log).read().splitlines()
+        done2 = [l for l in lines if l.startswith("done") and "world=2" in l]
+        assert len(done2) == 2, lines
+    finally:
+        for a in (a0, a1):
+            if a.poll() is None:
+                a.kill()
+        srv.shutdown()
