@@ -1,12 +1,16 @@
 #!/bin/bash
 # Full-suite runner: one fresh pytest process per shard.
 #
-# Why sharded: a single-process run of all ~260 tests intermittently dies
-# with a silent SIGABRT inside the XLA CPU runtime after ~240 heavy
-# jit-compiled tests (cumulative runtime state; maps/fds/threads/RSS all
-# far below limits — tracked as a known issue, reproduced only in
-# whole-suite single-process runs).  Sharding by directory gives each
-# slice a fresh XLA client, which is also how CI tiers the suite.
+# Why sharded: a single-process run of all ~260 tests reliably dies with
+# a SIGABRT inside the XLA CPU runtime after ~240 heavy jit tests.
+# Root-caused via an LD_PRELOAD SIGABRT backtrace (no gdb in the image):
+#   absl LogMessage::Fail <- xla::internal::AwaitAndLogIfStuck
+#   (rendezvous.cc) <- cpu::AllReduceThunk::Execute <- Eigen WorkerLoop
+# i.e. a CPU-collective RENDEZVOUS TIMEOUT: late in a long run the 8
+# virtual devices' collective participants stop being co-scheduled on
+# the shared Eigen pool, the all-reduce rendezvous never completes, and
+# XLA LOG(FATAL)s.  Sharding gives each slice a fresh XLA client/pool,
+# which sidesteps the starvation entirely (and is how CI tiers anyway).
 #
 # Usage: tests/run_suite.sh [extra pytest args...]
 set -u
