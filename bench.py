@@ -60,26 +60,37 @@ def _mark_cache_clean() -> None:
         pass
 
 
-try:
-    import atexit
-    import shutil
+def _setup_compile_cache() -> None:
+    """Called from main() (and at import by the loopback subprocess) — NOT
+    unconditionally at import: the test suite imports this module for
+    selfcheck(), and a test process managing the sentinel would wipe or
+    orphan the driver's warm cache (an aborted test run once left the
+    sentinel behind, forcing the next driver run cold)."""
+    try:
+        import atexit
+        import shutil
 
-    # the loopback subprocess (DS_BENCH_SUBPROCESS=1) shares the cache but
-    # must not wipe it or clear the parent's sentinel
-    if not os.environ.get("DS_BENCH_SUBPROCESS"):
-        if os.path.exists(_SENTINEL):
-            shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+        # the loopback subprocess (DS_BENCH_SUBPROCESS=1) shares the cache
+        # but must not wipe it or clear the parent's sentinel
+        if not os.environ.get("DS_BENCH_SUBPROCESS"):
+            if os.path.exists(_SENTINEL):
+                shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            with open(_SENTINEL, "w") as _f:
+                _f.write(str(os.getpid()))
+            # atexit covers sys.exit and normal teardown; a kill mid-run
+            # leaves the sentinel and the NEXT run starts cold on a fresh
+            # dir
+            atexit.register(_mark_cache_clean)
         os.makedirs(_CACHE_DIR, exist_ok=True)
-        with open(_SENTINEL, "w") as _f:
-            _f.write(str(os.getpid()))
-        # atexit covers sys.exit and normal teardown; a kill mid-run leaves
-        # the sentinel behind and the NEXT run starts cold on a fresh dir
-        atexit.register(_mark_cache_clean)
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-except Exception:
-    pass
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+
+
+if os.environ.get("DS_BENCH_SUBPROCESS"):
+    _setup_compile_cache()
 
 # round-1 recorded headline (BENCH_r01.json) — the cross-round baseline
 R01_TOKENS_PER_SEC = 35367.7
@@ -346,6 +357,8 @@ def serve_v2_throughput(model, prompts, max_new: int, *,
 
 def main() -> None:
     from deepspeed_tpu.models import LlamaConfig
+
+    _setup_compile_cache()
 
     on_tpu = jax.devices()[0].platform == "tpu"
     extras: dict = {}
