@@ -703,6 +703,75 @@ def main() -> None:
             "llama8b_proxy_error"] = str(e)[:200]
 
 
+    _mark("resnet_cifar")
+    # -- driver ladder config 1: CIFAR ResNet-56, ZeRO-0 -------------------
+    try:
+        _budget_check()
+        from deepspeed_tpu.models.resnet import ResNetConfig, ResNetModel
+
+        rcfg = ResNetConfig.resnet56(dtype=jnp.bfloat16)
+        rb = 128
+        rng0 = np.random.RandomState(0)
+        rdata = {
+            "images": jnp.asarray(rng0.randn(
+                rb, rcfg.image_size, rcfg.image_size, 3).astype(np.float32)),
+            "labels": jnp.asarray(rng0.randint(0, rcfg.num_classes,
+                                               size=(rb,))),
+        }
+        eng = build_engine(rcfg, rb, zero_stage=0, model_cls=ResNetModel)
+        # measure() counts batch*seq tokens; seq=1 makes that images/sec,
+        # with its median-of-segments noise rejection and budget logic
+        ips = measure(eng, rb, 1, rcfg.num_classes, steps=20,
+                      budget_s=45.0, data=rdata)
+        extras["variants"]["resnet56_cifar_images_per_sec"] = round(ips, 1)
+        del eng, rdata
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})[
+            "resnet_cifar_error"] = str(e)[:200]
+
+    _mark("fused_adam_probe")
+    # -- SURVEY row 30 evidence: a hand-fused Pallas Adam only matters if
+    # XLA leaves update bandwidth on the table.  The probe times an
+    # isolated optax adamw step over a 13.75M-param plane and reports
+    # achieved HBM GB/s (7 fp32 passes/param) — read against the chip's
+    # ~820 GB/s peak, it bounds what a custom kernel could win on a
+    # component that is ~2%% of a training step.
+    try:
+        _budget_check()
+        import optax
+
+        n = 110_000_000 // 8  # one shard-sized param plane
+        p = jnp.zeros((n,), jnp.float32)
+        g = jnp.ones((n,), jnp.float32) * 1e-3
+        tx = optax.adamw(1e-4)
+        state = tx.init(p)
+
+        @jax.jit
+        def opt_step(p, g, state):
+            u, state = tx.update(g, state, p)
+            return optax.apply_updates(p, u), state
+
+        p2, state = opt_step(p, g, state)  # compile
+        float(jnp.sum(p2))
+        # 200 chained steps between fences: the ~100 ms tunnel fence
+        # amortizes to 0.5 ms/step, so the number reflects the kernel
+        t0 = time.perf_counter()
+        for _ in range(200):
+            p2, state = opt_step(p2, g, state)
+        float(jnp.sum(p2))
+        dt = (time.perf_counter() - t0) / 200
+        # bytes moved: p r/w + g r + m r/w + v r/w = 7 floats/param
+        gbps = 7 * 4 * n / dt / 1e9
+        extras["variants"]["optax_adam_hbm_gbps"] = round(gbps, 1)
+        del p, g, p2, state
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})[
+            "fused_adam_probe_error"] = str(e)[:200]
+
     _mark("infinity")
     # -- ZeRO-Infinity capacity: peak params/chip the tiering can hold -----
     # CAPACITY math, not a measured training run: on this tunneled chip a
