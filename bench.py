@@ -281,6 +281,26 @@ def selfcheck(block_q: int = 512, block_k: int = 512) -> None:
     want = sparse_attention(qs, ks, vs, bb, impl="dense")
     checks.append(("block_sparse", rel_err(got, want), TOL))
 
+    # block-sparse backward (local-window layout → the sparse vjp path)
+    from deepspeed_tpu.ops.sparse_attention import BSLongformerSparsityConfig
+
+    lw = BSLongformerSparsityConfig(num_heads=hq, block=16,
+                                    num_sliding_window_blocks=3,
+                                    global_block_indices=())
+
+    def loss_sparse(q, k, v):
+        return jnp.sum(block_sparse_attention(
+            q, k, v, lw, block_q=128, block_k=128).astype(jnp.float32) ** 2)
+
+    def loss_dense_lw(q, k, v):
+        return jnp.sum(sparse_attention(
+            q, k, v, lw, impl="dense").astype(jnp.float32) ** 2)
+
+    gs_ = jax.grad(loss_sparse, argnums=(0, 1, 2))(qs, ks, vs)
+    gd_ = jax.grad(loss_dense_lw, argnums=(0, 1, 2))(qs, ks, vs)
+    for nm, a, b in zip("qkv", gs_, gd_):
+        checks.append((f"block_sparse_bwd_d{nm}", rel_err(a, b), TOL))
+
     # int8 quantizer round trip
     x = jnp.asarray(rng.randn(512, 256).astype(np.float32))
     qx, s = quantize_int8(x)

@@ -18,9 +18,12 @@ live; round 2 shipped layout semantics but ran DENSE masked attention
 * Fully-masked query rows produce 0 (matching the dense path's explicit
   zeroing), via ``where(l > 0, acc / l, 0)``.
 
-Backward currently routes to the dense masked reference (correct, not
-sparse-fast) through a ``custom_vjp`` — sparse training speed is a later
-optimization; serving/scoring is the hot use.
+Backward (``custom_vjp``) auto-selects: an O(live) gathered-tile sparse
+backward (jnp: gather live k-blocks, softmax jacobian per tile,
+segment-sum scatter of dk/dv — 1.5-2.4x faster than the dense vjp for
+local-window layouts on v5e at S=4096) when ``max_live*2 <= nk``, else
+the dense masked vjp (a dense global row makes the padded form slower
+than dense).  A per-row-count Pallas bwd kernel is the round-4 item.
 """
 
 from __future__ import annotations
@@ -265,11 +268,120 @@ def _bs_fwd(q, k, v, layout_key, causal, block_q, block_k, cb, interpret):
     return out, (q, k, v)
 
 
+def _sparse_bwd_tiles(q, k, v, do, layout, cb, causal, block_q, block_k):
+    """O(live) backward: gathered live-tile recompute (jnp, XLA fuses).
+
+    Shapes: q/k/v/do ``[B, S, h, d]``.  The plan's padded ``idx/counts/
+    cells`` arrays drive a fully vectorized gather over live tiles only —
+    scores/probabilities exist as ``[B, h, nq, L, bq, bk]`` (L = max
+    live), so work AND memory scale with the live count, not S².  dk/dv
+    return through a scatter-add over the gathered block ids."""
+    B, S, h, d = q.shape
+    H = layout.shape[0]
+    idx, counts, cells = _plan(layout, S, block_q, block_k, cb, causal)
+    nq, L = idx.shape[1], idx.shape[2]
+    nk = S // block_k
+    scale = 1.0 / np.sqrt(d)
+    # head-fold: layout head axis is 1 (shared) or h.  The k/v GATHER
+    # needs an h-sized index; the mask tensors stay at H and broadcast —
+    # expanding a shared layout's masks h-fold would cost h× the memory
+    # for identical copies.
+    hl = np.arange(h) % H                      # [h] → layout head index
+    idx_h = jnp.asarray(idx)[hl]               # [h, nq, L] (gather index)
+    idx_H = jnp.asarray(idx)                   # [H, nq, L] (mask builds)
+    counts_H = jnp.asarray(counts)             # [H, nq]
+    cells_H = jnp.asarray(cells)               # [H, nq, L, qc, kc]
+
+    qt = q.transpose(0, 2, 1, 3).reshape(B, h, nq, block_q, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(B, h, nk, block_k, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(B, h, nk, block_k, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(B, h, nq, block_q, d)
+
+    # gather each (h, qi)'s live k/v blocks: [B, h, nq, L, bk, d]
+    harange = jnp.arange(h)[:, None, None]
+    kg = kt[:, harange, idx_h]
+    vg = vt[:, harange, idx_h]
+
+    f32 = jnp.float32
+    s = jnp.einsum("bhqad,bhqlkd->bhqlak", qt.astype(f32),
+                   kg.astype(f32)) * scale  # [B,h,nq,L,bq,bk]
+
+    # per-tile keep mask: cell kron + causal + live-slot gating, all at
+    # the layout head size H (broadcasts over h in the where/products)
+    keep = jnp.repeat(jnp.repeat(cells_H > 0, cb, axis=3),
+                      cb, axis=4)  # [H, nq, L, bq, bk]
+    if causal:
+        q_pos = (jnp.arange(nq)[:, None] * block_q
+                 + jnp.arange(block_q)[None, :])        # [nq, bq]
+        k_pos = (idx_H[..., None] * block_k
+                 + jnp.arange(block_k))                  # [H, nq, L, bk]
+        keep = keep & (q_pos[None, :, None, :, None]
+                       >= k_pos[:, :, :, None, :])
+    live = (jnp.arange(L)[None, None] < counts_H[..., None])  # [H, nq, L]
+    keep = keep & live[..., None, None]
+    keep = keep[None]  # [1, H(bcast->h), nq, L, bq, bk]
+
+    s = jnp.where(keep, s, -1e30)
+    m = jnp.max(s, axis=(3, 5), keepdims=True)           # over (L, bk)
+    p = jnp.where(keep, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=(3, 5), keepdims=True)
+    l = jnp.where(l > 0, l, 1.0)
+    p = p / l                                            # [B,h,nq,L,bq,bk]
+
+    o = jnp.einsum("bhqlak,bhqlkd->bhqad", p, vg.astype(f32))
+    delta = jnp.sum(dot.astype(f32) * o, axis=-1)        # [B,h,nq,bq]
+    dp = jnp.einsum("bhqad,bhqlkd->bhqlak", dot.astype(f32),
+                    vg.astype(f32))
+    ds = p * (dp - delta[:, :, :, None, :, None])        # [B,h,nq,L,bq,bk]
+
+    dq = jnp.einsum("bhqlak,bhqlkd->bhqad", ds, kg.astype(f32)) * scale
+    dk_g = jnp.einsum("bhqlak,bhqad->bhqlkd", ds, qt.astype(f32)) * scale
+    dv_g = jnp.einsum("bhqlak,bhqad->bhqlkd", p, dot.astype(f32))
+
+    # scatter-add gathered-tile grads back to their k blocks via
+    # segment-sum over flat block ids (duplicate ids across q-blocks
+    # accumulate; tiny index arrays — a full-shape advanced-index
+    # scatter measured pathologically slow on TPU)
+    flat_ids = idx_h.reshape(h, nq * L)
+
+    def seg(vals_h, ids_h):  # [nq*L, bk*d], [nq*L] → [nk, bk*d]
+        return jax.ops.segment_sum(vals_h, ids_h, num_segments=nk)
+
+    def seg_bh(vals_b):  # [h, nq*L, bk*d]
+        return jax.vmap(seg)(vals_b, flat_ids)
+
+    dk = jax.vmap(seg_bh)(
+        dk_g.reshape(B, h, nq * L, block_k * d)).reshape(
+            B, h, nk, block_k, d)
+    dv = jax.vmap(seg_bh)(
+        dv_g.reshape(B, h, nq * L, block_k * d)).reshape(
+            B, h, nk, block_k, d)
+
+    dq = dq.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
-    """Dense masked backward (correct everywhere; sparse-fast bwd is a
-    later optimization)."""
+    """Backward, auto-selected by the plan's shape.
+
+    The gathered-tile sparse backward pads every q-block to ``max_live``
+    k-blocks, so it only SAVES work when ``max_live << nk`` (local-window
+    layouts).  One dense global row (BigBird/Fixed) drags ``max_live`` to
+    ``nk`` and the padded form does more work than the dense vjp plus
+    gather/scatter overhead (v5e, S=4096: local window L=3/nk=16 runs
+    1.5-2.4x FASTER sparse; a global row making L=nk runs 0.68x) — the
+    dense masked vjp is the right backward there.  A per-row-count Pallas
+    bwd kernel is the round-4 item that removes this trade."""
     q, k, v = res
     layout = _layout_from_key(layout_key)
+    S = q.shape[1]
+    idx, _, _ = _plan(layout, S, block_q, block_k, cb, causal)
+    nk = S // block_k
+    if idx.shape[2] * 2 <= nk:
+        return _sparse_bwd_tiles(q, k, v, do, layout, cb, causal,
+                                 block_q, block_k)
 
     def f(q, k, v):
         return _dense_reference(q, k, v, layout, cb, causal)
