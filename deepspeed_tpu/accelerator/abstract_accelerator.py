@@ -140,19 +140,45 @@ class DeepSpeedAccelerator(abc.ABC):
             self._t = None
             self._timing = enable_timing
 
+        @staticmethod
+        def _drain():
+            # XLA dispatch is async: a host timestamp taken without
+            # draining outstanding device work measures dispatch latency,
+            # not execution.  Block on a trivial computation (same fence
+            # as the accelerator's synchronize()) before stamping.
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                jnp.zeros(()).block_until_ready()
+                jax.effects_barrier()
+            except Exception:
+                pass  # no device / not initialized — host-only semantics
+
         def record(self, stream=None):
             import time as _time
 
+            if self._timing:
+                self._drain()
             self._t = _time.perf_counter()
 
         def synchronize(self):
-            pass
+            self._drain()
 
         def query(self) -> bool:
             return True
 
         def elapsed_time(self, other) -> float:
-            """Milliseconds from self.record() to other.record()."""
+            """Milliseconds from self.record() to other.record().
+
+            Like ``torch.cuda.Event``, raises unless BOTH events were
+            created with ``enable_timing=True`` — un-timed records don't
+            drain async dispatch, so their stamps measure dispatch
+            latency and would be confidently wrong."""
+            if not (self._timing and getattr(other, "_timing", False)):
+                raise RuntimeError(
+                    "elapsed_time requires both events to be created "
+                    "with enable_timing=True")
             if self._t is None or getattr(other, "_t", None) is None:
                 return 0.0
             return (other._t - self._t) * 1e3

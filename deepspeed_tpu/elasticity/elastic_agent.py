@@ -73,7 +73,8 @@ class DSElasticAgent:
                  node_id: Optional[str] = None):
         self.spec = spec
         self.start_method = start_method
-        self.restart_count = 0
+        self.restart_count = 0   # total attempts (workers key resume off it)
+        self.failure_count = 0   # only FAILURES consume max_restarts
         self.last_result: Any = None
         self.node_id = node_id or os.environ.get(
             "DS_ELASTIC_NODE_ID", f"node-{os.getpid()}")
@@ -96,9 +97,13 @@ class DSElasticAgent:
         if self.rdzv is not None:
             r, rank, world, coord = self.rdzv.next_round()
             self._round = r
-            members = self.rdzv.c.get(
-                ElasticRendezvous._members_key(r)) or []
-            self._peers = [m[0] for m in members]
+            # monitor the FROZEN gang, not the raw members key: a node
+            # squeezed out by max_nodes appended itself to members but is
+            # parked as standby and never heartbeats — treating it as a
+            # peer would churn the round forever
+            sealed = self.rdzv.c.get(
+                ElasticRendezvous._sealed_key(r)) or [[]]
+            self._peers = list(sealed[0])
             os.environ["COORDINATOR_ADDRESS"] = coord
             os.environ["NUM_PROCESSES"] = str(world)
             os.environ["PROCESS_ID"] = str(rank)
@@ -138,7 +143,12 @@ class DSElasticAgent:
                          f"{self.restart_count} restart(s)")
                 return self.last_result
             except _RestartSignal as e:
-                self._maybe_restart(e, announce=False)
+                # membership changes (scale-up joins, peer death noticed
+                # elsewhere, round bumps) are the elastic steady state, not
+                # worker failures: they restart WITHOUT consuming the
+                # max_restarts budget, so a healthy job that scales many
+                # times never gives up (torch-elastic behavior)
+                self._maybe_restart(e, announce=False, budgeted=False)
             except SystemExit as e:
                 # scripts commonly end via sys.exit(main()); code 0/None is
                 # success, anything else is a worker failure to supervise
@@ -247,16 +257,20 @@ class DSElasticAgent:
                     proc.kill()
                     proc.wait()
 
-    def _maybe_restart(self, e: BaseException, announce: bool = True) -> None:
+    def _maybe_restart(self, e: BaseException, announce: bool = True,
+                       budgeted: bool = True) -> None:
         spec = self.spec
         self.restart_count += 1
-        if self.restart_count > spec.max_restarts:
-            logger.error(f"elastic agent: giving up after "
-                         f"{spec.max_restarts} restarts ({e!r})")
-            raise e
+        if budgeted:
+            self.failure_count += 1
+            if self.failure_count > spec.max_restarts:
+                logger.error(f"elastic agent: giving up after "
+                             f"{spec.max_restarts} failures ({e!r})")
+                raise e
         level = logger.warning if announce else logger.info
-        level(f"elastic agent[{self.node_id}]: restarting "
-              f"({self.restart_count}/{spec.max_restarts}): {e!r}")
+        level(f"elastic agent[{self.node_id}]: restarting (attempt "
+              f"{self.restart_count}, failures "
+              f"{self.failure_count}/{spec.max_restarts}): {e!r}")
         time.sleep(spec.monitor_interval)
 
 

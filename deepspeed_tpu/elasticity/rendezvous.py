@@ -74,6 +74,17 @@ class _Handler(socketserver.StreamRequestHandler):
                     state.data[req["k"]] = lst
                     state.cond.notify_all()
                     out = {"ok": True, "v": lst}
+                elif op == "hb":
+                    # heartbeat keys are stamped with the STORE's clock so
+                    # staleness checks never compare two hosts' wall clocks
+                    # (cross-host skew > ttl would fake peer deaths).
+                    # monotonic, not wall: an NTP step on the store host
+                    # must not age every heartbeat at once
+                    state.data[req["k"]] = time.monotonic()
+                    state.cond.notify_all()
+                    out = {"ok": True}
+                elif op == "now":
+                    out = {"ok": True, "v": time.monotonic()}
                 elif op == "wait_ge":
                     deadline = time.monotonic() + float(req.get("t", 30.0))
                     ok = True
@@ -170,6 +181,12 @@ class RendezvousClient:
     def wait_ge(self, k: str, v: int, timeout: float = 30.0) -> bool:
         return bool(self._call(op="wait_ge", k=k, v=v, t=timeout)["ok"])
 
+    def hb(self, k: str) -> None:
+        self._call(op="hb", k=k)
+
+    def now(self) -> float:
+        return float(self._call(op="now")["v"])
+
 
 # ---------------------------------------------------------------------------
 # rendezvous rounds
@@ -196,6 +213,16 @@ class ElasticRendezvous:
         self.coordinator_port = int(coordinator_port)
         self.settle_s = float(settle_s)
         self.timeout_s = float(timeout_s)
+        # grace bookkeeping for peers that sealed a round but have not yet
+        # written their first heartbeat (store-clock first-missing stamps);
+        # reset whenever we join a new round — stale notices from an old
+        # round must not shortcut the new round's grace window
+        self._hb_missing: Dict[str, float] = {}
+        # store-clock time our current round formed: heartbeat stamps older
+        # than this are leftovers from a previous round (a slow-rejoining
+        # peer that sealed but hasn't beaten yet) and get the same grace as
+        # a missing stamp instead of an instant death
+        self._round_start: float = 0.0
 
     # round bookkeeping keys
     @staticmethod
@@ -256,7 +283,6 @@ class ElasticRendezvous:
             members = sorted(self.c.get(self._members_key(r)) or [],
                              key=lambda m: m[0])[:self.max_nodes]
             ids = [m[0] for m in members]
-            hosts = {m[0]: m[1] for m in members}
             # SEAL via atomic append: the FIRST returner's membership list
             # freezes the gang — every agent (however racy its own view)
             # adopts element 0, so no two members ever compute different
@@ -277,16 +303,46 @@ class ElasticRendezvous:
                 continue
             rank = frozen.index(self.node_id)
             world = len(frozen)
-            coord_host = hosts.get(frozen[0], _my_host(self.c._addr))
-            coord = f"{coord_host}:{self.coordinator_port + (r % 32)}"
+            # Each round publishes a FRESH coordinator endpoint through the
+            # store: rank 0 binds an ephemeral port on its own host (the
+            # only host that can know what's free there) so a hung
+            # coordinator from an earlier round can never collide with the
+            # new round's jax.distributed.initialize (ports never recycle
+            # round-mod-N style).
+            coord_key = f"rdzv/round/{r}/coord"
+            if rank == 0:
+                self.c.set(
+                    coord_key,
+                    f"{my_host}:{_free_port(self.coordinator_port)}")
+            coord = self.c.get(coord_key)
+            # bounded wait: if rank 0 died between sealing and publishing,
+            # nothing else would ever bump this round (monitors only run
+            # after next_round returns) — so WE bump and re-form instead
+            # of burning the whole rendezvous deadline waiting
+            coord_deadline = min(deadline,
+                                 time.monotonic() + 5 * self.settle_s + 2.0)
+            while coord is None and time.monotonic() < coord_deadline:
+                if self.current_round() != r:
+                    break
+                time.sleep(0.02)
+                coord = self.c.get(coord_key)
+            if coord is None:
+                if self.current_round() == r:
+                    self.bump_round(f"round {r}: rank 0 never published "
+                                    f"a coordinator")
+                continue  # re-form without rank 0's corpse
             self.c.set(f"rdzv/left/{self.node_id}", False)  # (re)joined
+            self._hb_missing.clear()
+            self._round_start = self.c.now()
             self.heartbeat()
             return r, rank, world, coord
 
     # -- failure detection -------------------------------------------------
 
     def heartbeat(self) -> None:
-        self.c.set(f"rdzv/hb/{self.node_id}", time.time())
+        # stamped by the STORE's clock (op=hb), not this host's — see
+        # stale_peers: all staleness math happens on one clock
+        self.c.hb(f"rdzv/hb/{self.node_id}")
 
     def leave(self) -> None:
         """Graceful departure: a finished node stops heartbeating but must
@@ -295,7 +351,10 @@ class ElasticRendezvous:
         self.c.set(f"rdzv/left/{self.node_id}", True)
 
     def stale_peers(self, peer_ids: List[str], ttl_s: float) -> List[str]:
-        now = time.time()
+        # one clock for everything: heartbeats are store-stamped (op=hb)
+        # and "now" is the store's clock too, so cross-host skew cannot
+        # fake a death
+        now = self.c.now()
         stale = []
         for pid in peer_ids:
             if pid == self.node_id:
@@ -303,9 +362,41 @@ class ElasticRendezvous:
             if self.c.get(f"rdzv/left/{pid}"):
                 continue  # graceful leave, not a death
             ts = self.c.get(f"rdzv/hb/{pid}")
-            if ts is None or now - float(ts) > ttl_s:
+            if ts is None or float(ts) < self._round_start:
+                # no heartbeat for THIS round yet (never beaten, or the
+                # stamp is a leftover from a previous round — a slow
+                # rejoiner that sealed but hasn't beaten) — grace it for a
+                # full ttl from when WE first noticed, instead of
+                # declaring it dead on our first monitor tick
+                first = self._hb_missing.setdefault(pid, now)
+                if now - first > ttl_s:
+                    stale.append(pid)
+                continue
+            self._hb_missing.pop(pid, None)
+            if now - float(ts) > ttl_s:
                 stale.append(pid)
         return stale
+
+
+def _free_port(base: Optional[int] = None) -> int:
+    """A currently-free TCP port.  With ``base``, scan a small window
+    starting there (operators firewall a known range around the
+    configured coordinator_port) and fall back to an OS ephemeral port
+    only if the whole window is busy.  Bind-testing is what fixes the
+    original bug: a hung coordinator still bound on a port is SKIPPED
+    instead of collided with.  (The tiny close→reuse window is the
+    standard ephemeral-port trade.)"""
+    candidates = list(range(base, base + 64)) if base else []
+    for port in candidates + [0]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("", port))
+            return int(s.getsockname()[1])
+        except OSError:
+            continue
+        finally:
+            s.close()
+    raise OSError("no free TCP port")
 
 
 def _my_host(store_addr: Optional[Tuple[str, int]] = None) -> str:

@@ -77,6 +77,87 @@ def test_rendezvous_assigns_deterministic_ranks():
         srv.shutdown()
 
 
+def test_heartbeats_are_store_stamped_and_graced():
+    """Heartbeat staleness math uses the STORE's clock (op=hb stamps
+    server-side), and a peer with no heartbeat yet is graced for a full
+    ttl instead of being declared dead on the first check (round-3
+    advisor findings)."""
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        r = ElasticRendezvous(c, "me", min_nodes=1)
+        # a peer that sealed but hasn't heartbeaten: graced, not stale
+        assert r.stale_peers(["late"], ttl_s=0.3) == []
+        time.sleep(0.4)
+        assert r.stale_peers(["late"], ttl_s=0.3) == ["late"]
+        # a fresh server-stamped heartbeat clears it — even if this
+        # host's clock were skewed far ahead, the store clock governs
+        c.hb("rdzv/hb/late")
+        assert r.stale_peers(["late"], ttl_s=0.3) == []
+        assert isinstance(c.now(), float)
+    finally:
+        srv.shutdown()
+
+
+def test_membership_restarts_do_not_consume_failure_budget():
+    """_RestartSignal (scale-up / peer-death teardowns) restarts without
+    burning max_restarts; only real failures do (round-3 advisor)."""
+    from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                        WorkerSpec,
+                                                        _RestartSignal)
+    calls = {"n": 0}
+
+    def worker(restart_count, ckpt_dir):
+        calls["n"] += 1
+        if calls["n"] <= 5:  # 5 membership churns — more than max_restarts
+            raise _RestartSignal("round moved")
+        return "ok"
+
+    agent = DSElasticAgent(WorkerSpec(fn=worker, max_restarts=2,
+                                      monitor_interval=0.01))
+    assert agent.run() == "ok"
+    assert agent.failure_count == 0 and agent.restart_count == 5
+
+    # real failures still exhaust the budget
+    def always_fail(restart_count, ckpt_dir):
+        raise RuntimeError("boom")
+
+    agent2 = DSElasticAgent(WorkerSpec(fn=always_fail, max_restarts=2,
+                                       monitor_interval=0.01))
+    with pytest.raises(RuntimeError):
+        agent2.run()
+    assert agent2.failure_count == 3  # 2 retries + the give-up attempt
+
+
+def test_coordinator_port_skips_bound_ports():
+    """Each round publishes a BIND-TESTED coordinator endpoint through the
+    store: a hung coordinator from an earlier round still bound on a port
+    is skipped, never collided with (round-3 advisor).  The configured
+    coordinator_port stays the base of the scan window so firewalled
+    deployments keep a predictable range."""
+    import socket as _socket
+
+    srv = RendezvousServer()
+    hog = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        c = RendezvousClient(srv.endpoint)
+        r = ElasticRendezvous(c, "solo", min_nodes=1, settle_s=0.05)
+        # simulate a hung coordinator occupying the base port
+        hog.bind(("", r.coordinator_port))
+        hog.listen(1)
+        _, _, _, coord0 = r.next_round()
+        p0 = int(coord0.rsplit(":", 1)[1])
+        assert p0 != r.coordinator_port  # bound port skipped
+        assert p0 >= r.coordinator_port  # window stays firewall-friendly
+        assert c.get("rdzv/round/0/coord") == coord0  # published via store
+        r.bump_round("test")
+        _, _, _, coord1 = r.next_round()
+        assert c.get("rdzv/round/1/coord") == coord1
+    finally:
+        hog.close()
+        srv.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # multi-agent gang restart (real processes)
 # ---------------------------------------------------------------------------
