@@ -359,6 +359,13 @@ def init_distributed(dist_backend: str = "xla",
     process_id = process_id if process_id is not None else (
         int(os.environ["RANK"]) if "RANK" in os.environ else None)
     if coordinator_address and num_processes and num_processes > 1:
+        try:
+            # CPU backend: cross-process collectives need gloo (the test
+            # substrate for multi-controller runs; TPU rides ICI/DCN and
+            # ignores this).  Must be set before the backend exists.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # backend already up or knob absent — TPU path
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

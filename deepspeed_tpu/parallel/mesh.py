@@ -153,6 +153,46 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def global_put(value, sharding: NamedSharding):
+    """``device_put`` that also works when ``sharding`` spans processes.
+
+    Multi-controller JAX cannot ``device_put`` host data onto devices other
+    processes own; ``make_array_from_callback`` sidesteps that — every
+    process materializes only its ADDRESSABLE shards (the callback is
+    called per local device with that device's global index), and the
+    result is one global array.  Each process must pass the same logical
+    ``value`` (the usual SPMD contract).  Single-process: plain
+    ``device_put`` (same semantics, fewer host copies)."""
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def global_feed(value, sharding: NamedSharding):
+    """Host batch leaf → global array under ``sharding`` (THE batch-feeding
+    helper — engine, streaming executor, and dataloader all route here).
+
+    * global ``jax.Array``s pass through untouched;
+    * single-process: plain ``device_put``;
+    * multi-process + sharded spec: ``value`` is this process's LOCAL rows
+      (the per-rank slice its dataloader produced — the reference's
+      per-rank batch feeding) and
+      ``make_array_from_process_local_data`` assembles the global array;
+    * multi-process + replicated spec: ``value`` is the full (identical)
+      array on every process — :func:`global_put` semantics.
+    """
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        return value
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    if sharding.is_fully_replicated:
+        return global_put(value, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(value))
+
+
 def strip_manual_axes(*entries) -> PartitionSpec:
     """PartitionSpec from ``entries`` minus any axis that is currently
     MANUAL (i.e. we are inside a ``shard_map`` over it).

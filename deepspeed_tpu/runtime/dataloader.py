@@ -2,11 +2,12 @@
 
 Capability parity with the reference ``deepspeed/runtime/dataloader.py`` [K]:
 ``DeepSpeedDataLoader`` (micro-batch sizing + distributed sharding) and
-``RepeatingLoader``.  TPU-native: a single-controller process feeds the GLOBAL
-batch; sharding over DP ranks is a ``jax.device_put`` with the batch
-NamedSharding, not a per-rank sampler.  For multi-host, each process yields
-its local slice and ``make_array_from_process_local_data`` assembles the
-global array.
+``RepeatingLoader``.  TPU-native: single-controller, one process feeds the
+GLOBAL batch and sharding over DP ranks is a ``jax.device_put`` with the
+batch NamedSharding, not a per-rank sampler.  Multi-controller
+(``jax.process_count() > 1``): each process materializes ONLY its own rows
+and ``make_array_from_process_local_data`` assembles the global array —
+per-rank feeding, exercised by ``tests/unit/multiprocess/``.
 """
 
 from __future__ import annotations
@@ -16,7 +17,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 import jax
 import numpy as np
 
-from ..parallel.mesh import batch_sharding
+from ..parallel.mesh import batch_sharding, global_feed, global_put
 
 
 class RepeatingLoader:
@@ -89,11 +90,31 @@ class DeepSpeedDataLoader:
     def __iter__(self) -> Iterator[Any]:
         order = self._order()
         self._epoch += 1
+        pw = jax.process_count()
         for start in range(0, len(order), self.batch_size):
             sel = order[start:start + self.batch_size]
             if len(sel) < self.batch_size and self.drop_last:
                 break
+            sh = self._sharding_for(len(sel))
+            if pw > 1 and len(sel) % pw == 0 and sh is self.sharding:
+                # multi-controller: each process materializes ONLY its own
+                # rows (per-rank feeding, the reference's DistributedSampler
+                # contract) and the global dp-sharded array is assembled
+                # from the local slices.  Only when the dp sharding really
+                # applies — a replicated fallback (partial batch) must see
+                # the FULL batch on every process, below.
+                n = len(sel) // pw
+                lo = jax.process_index() * n
+                items = [self.dataset[int(i)] for i in sel[lo:lo + n]]
+                local = (self.collate_fn(items) if self.collate_fn
+                         else jax.tree.map(lambda *xs: np.stack(xs), *items))
+                yield jax.tree.map(
+                    lambda x: global_feed(np.asarray(x), sh), local)
+                continue
             items = [self.dataset[int(i)] for i in sel]
             batch = (self.collate_fn(items) if self.collate_fn
                      else jax.tree.map(lambda *xs: np.stack(xs), *items))
-            yield jax.device_put(batch, self._sharding_for(len(sel)))
+            # global_put: multi-host-safe for replicated AND sharded specs
+            # (every process holds the full batch here)
+            yield jax.tree.map(lambda x: global_put(np.asarray(x), sh),
+                               batch)

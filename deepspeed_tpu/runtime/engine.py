@@ -696,11 +696,30 @@ class DeepSpeedEngine:
     # idiomatic API — one call per optimizer step
     # ------------------------------------------------------------------
 
+    def _feed_batch(self, batch):
+        """Assemble the GLOBAL batch under multi-controller execution.
+
+        Single process: pass through (the jit's in_shardings place it).
+        Multi-process (``jax.process_count() > 1``): host leaves are this
+        process's LOCAL rows — the per-rank slice its dataloader produced,
+        the reference's per-rank batch feeding — and are assembled into
+        global dp-sharded arrays via
+        ``jax.make_array_from_process_local_data``; leaves that are already
+        global jax.Arrays pass through untouched."""
+        if jax.process_count() == 1:
+            return batch
+        from ..parallel.mesh import global_feed
+
+        sh = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
+        return jax.tree.map(lambda x: global_feed(x, sh), batch)
+
     def train_step(self, batch) -> Dict[str, Any]:
         """Run ONE full optimizer step (fwd+bwd over all microbatches + update)
         as a single compiled program.  ``batch`` holds the full global batch
-        (micro × gas × dp_world leading dim)."""
+        (micro × gas × dp_world leading dim) — or, multi-process, this
+        process's local rows (see :meth:`_feed_batch`)."""
         self.tput_timer.start()
+        batch = self._feed_batch(batch)
         if self.infinity is not None:
             metrics = self.infinity.train_step(batch)
             self.state = self.state._replace(
@@ -774,6 +793,7 @@ class DeepSpeedEngine:
         return metrics
 
     def eval_loss(self, batch) -> jnp.ndarray:
+        batch = self._feed_batch(batch)
         if self.infinity is not None:
             return self.infinity.eval_loss(batch)
         if self._eval_loss_fn is None:

@@ -45,9 +45,16 @@ class LayerStreamingEngine:
     sharding (replicated over DP), activations ride the DP axes, and the
     per-layer programs are ordinary SPMD jits — the reference's Infinity
     likewise runs under full data parallelism (``zero/stage3.py`` +
-    ``swap_tensor/*``, SURVEY §2.1).  Host planes are per-process; in a
-    multi-controller deployment each process streams only its addressable
-    slice (single-controller semantics here)."""
+    ``swap_tensor/*``, SURVEY §2.1).
+
+    MULTI-CONTROLLER (``jax.process_count() > 1``): host planes are
+    PER-PROCESS — each process owns 1/world of every layer's flat
+    master/moments/wire plane (the reference's partitioned optimizer
+    state).  The wire chunk rides a device-sharded global array and is
+    all-gathered IN-GRAPH into the layer's compute shardings (XLA
+    collectives over ICI/DCN); gradients reduce-scatter back the same way
+    and each process d2h's only its addressable slice.  Host RAM and nvme
+    bytes per process: O(layer/world)."""
 
     def __init__(self, model: Any, params: Any, config: Any,
                  schedule: Callable[[int], float], mesh: Any = None,
@@ -101,19 +108,35 @@ class LayerStreamingEngine:
         layer_trees = [jax.tree.map(functools.partial(one, i=i), layers)
                        for i in range(self.L)]
 
-        placement = None
+        self.proc_world = jax.process_count()
+        if self.proc_world > 1 and mesh is None:
+            raise ValueError(
+                "multi-controller ZeRO-Infinity needs a mesh (pass mesh= "
+                "to initialize, or build the model with one)")
+
+        layer_specs = None
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
-            from ...parallel.mesh import strip_manual_axes
-
-            layer_specs = None
             if isinstance(base_specs, dict) and "layers" in base_specs:
                 # per-layer specs = stacked specs minus the leading
                 # (pipe/stack) dim
                 layer_specs = jax.tree.map(
                     lambda s: P(*tuple(s)[1:]), base_specs["layers"],
                     is_leaf=lambda x: isinstance(x, P))
+
+        placement = None
+        shard = None
+        if self.proc_world > 1:
+            # per-process host planes: each process owns the flat-plane
+            # segments its devices cover; device assembly is the in-graph
+            # all-gather built in _build_flat_fns below
+            placement, shard = self._build_flat_fns(
+                layer_trees[0], layer_specs, wire_dtype)
+        elif mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel.mesh import strip_manual_axes
 
             def placement(views, _specs=layer_specs):
                 if _specs is None:
@@ -129,7 +152,8 @@ class LayerStreamingEngine:
         self.swapper = PartitionedParamSwapper(
             layer_trees, wire_dtype=wire_dtype, nvme_path=nvme_path,
             buffer_count=int(getattr(pcfg, "buffer_count", 4) or 4),
-            aio_config=config.aio, adam_hparams=hp, placement=placement)
+            aio_config=config.aio, adam_hparams=hp, placement=placement,
+            shard=shard)
         del layer_trees, layers
 
         if mesh is not None:
@@ -139,10 +163,12 @@ class LayerStreamingEngine:
 
             res_specs = (base_specs if isinstance(base_specs, dict) else {})
 
+            from ...parallel.mesh import global_put
+
             def _place(v, s):
                 sh = NamedSharding(mesh, strip_manual_axes(*s)
                                    if isinstance(s, P) else P())
-                return jax.device_put(np.asarray(v, dtype=np.float32), sh)
+                return global_put(np.asarray(v, dtype=np.float32), sh)
 
             self.resident = {
                 k: (jax.tree.map(lambda a: _place(a, None), v)
@@ -175,6 +201,125 @@ class LayerStreamingEngine:
                  f"{n_trunk:,} trunk params off-device "
                  f"({'nvme' if nvme_path else 'cpu'} tier), "
                  f"{n_res:,} resident on device")
+
+    # ------------------------------------------------------------------
+    # multi-controller flat-plane machinery
+    # ------------------------------------------------------------------
+
+    def _build_flat_fns(self, layer_tree: Any, layer_specs: Any,
+                        wire_dtype):
+        """Build the in-graph gather/scatter pair for per-process planes.
+
+        Returns ``(placement, shard)``: the placement fn maps the local
+        flat wire plane → device layer pytree in its compute shardings
+        (XLA all-gathers over the mesh); ``shard`` is the swapper's
+        segment table.  Segments come from the ACTUAL device sharding of
+        the flat plane (``devices_indices_map``), so permuted mesh device
+        orders — ICI-topology meshes — map host bytes to the right global
+        offsets.  Also installs ``self._scatter_flat``: device grad pytree
+        → this process's local flat fp32 plane (in-graph layout + d2h of
+        only the addressable shards)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ...parallel.mesh import strip_manual_axes
+        from .partitioned_param_swapper import _leaf_layout
+
+        mesh = self.mesh
+        treedef, layout = _leaf_layout(layer_tree)
+        n_elems = sum(int(np.prod(s)) if s else 1 for s, _ in layout)
+        n_dev = int(mesh.devices.size)
+        n_pad = -(-n_elems // n_dev) * n_dev
+        flat_sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+        # global index segments, grouped by owning process, sorted by start
+        dev_map = flat_sh.devices_indices_map((n_pad,))
+        by_proc: Dict[int, list] = {}
+        for d, idx in dev_map.items():
+            sl = idx[0]
+            by_proc.setdefault(d.process_index, []).append(
+                (int(sl.start or 0), int(sl.stop or n_pad)))
+        gather_map = [sorted(by_proc.get(p, []))
+                      for p in range(self.proc_world)]
+        me = jax.process_index()
+        segments = gather_map[me]
+        # device → plane offset of its slice (plane = segments in order)
+        plane_off = {}
+        off = 0
+        for a, b in segments:
+            plane_off[a] = off
+            off += b - a
+        local_devs = sorted(
+            [(int(idx[0].start or 0), d) for d, idx in dev_map.items()
+             if d.process_index == me])
+
+        if layer_specs is None:
+            out_sh = jax.tree.unflatten(
+                treedef, [NamedSharding(mesh, P())] * len(layout))
+        else:
+            out_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, strip_manual_axes(*s)),
+                layer_specs, is_leaf=lambda x: isinstance(x, P))
+
+        def assemble(flat):
+            views = [flat[off:off + (int(np.prod(s)) if s else 1)]
+                     .reshape(s) for s, off in layout]
+            return jax.tree.unflatten(treedef, views)
+
+        assemble_jit = jax.jit(assemble, out_shardings=out_sh)
+
+        def scatter(tree):
+            leaves = jax.tree.leaves(tree)
+            flat = jnp.concatenate(
+                [l.reshape(-1).astype(jnp.float32) for l in leaves])
+            return jnp.pad(flat, (0, n_pad - n_elems))
+
+        scatter_jit = jax.jit(scatter, out_shardings=flat_sh)
+
+        def local_chunk(garr) -> np.ndarray:
+            # shards land in the plane at their segment's offset — the
+            # same global-order rule the swapper's planes use
+            out = np.empty((off,), np.float32)
+            for s in garr.addressable_shards:
+                a = int(s.index[0].start or 0)
+                o = plane_off[a]
+                out[o:o + (int(s.index[0].stop or n_pad) - a)] = \
+                    np.asarray(s.data)
+            return out
+
+        def placement(local_wire: np.ndarray):
+            # one single-device array per local device, each a view into
+            # the plane at that device's segment
+            arrs = [
+                jax.device_put(
+                    local_wire[plane_off[a]:plane_off[a]
+                               + (int(dev_map[d][0].stop or n_pad) - a)],
+                    d)
+                for a, d in local_devs]
+            garr = jax.make_array_from_single_device_arrays(
+                (n_pad,), flat_sh, arrs)
+            return assemble_jit(garr)
+
+        self._scatter_flat = lambda tree: local_chunk(scatter_jit(tree))
+        shard = {"rank": me, "world": self.proc_world, "n_pad": n_pad,
+                 "segments": segments, "gather_map": gather_map}
+        return placement, shard
+
+    def _trunk_grads(self, dlp: Any) -> Any:
+        """What the swapper's update path consumes for one layer's grads:
+        the tree itself (single-controller) or this process's local flat
+        chunk (multi-controller)."""
+        if self.proc_world > 1:
+            return self._scatter_flat(dlp)
+        return dlp
+
+    def _host_sum(self, x: float) -> float:
+        """Sum a per-process host scalar across processes (no-op single)."""
+        if self.proc_world == 1:
+            return float(x)
+        from jax.experimental import multihost_utils
+
+        return float(np.sum(multihost_utils.process_allgather(
+            np.asarray(x, np.float32))))
 
     # ------------------------------------------------------------------
     # jitted pieces (compiled once; shared across layers)
@@ -245,16 +390,17 @@ class LayerStreamingEngine:
     # ------------------------------------------------------------------
 
     def _place_batch(self, batch: Any) -> Any:
-        """DP-shard the batch over the mesh (no-op single-chip)."""
+        """DP-shard the batch over the mesh (no-op single-chip).  Arrays
+        the engine already assembled globally pass through; multi-process
+        host leaves are this process's LOCAL rows."""
         if self.mesh is None:
             return batch
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ...parallel.mesh import DP_AXES
+        from ...parallel.mesh import DP_AXES, global_feed
 
         sh = NamedSharding(self.mesh, P(DP_AXES))
-        return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), sh), batch)
+        return jax.tree.map(lambda x: global_feed(x, sh), batch)
 
     def train_step(self, batch: Any) -> Dict[str, Any]:
         model = self.model
@@ -319,9 +465,10 @@ class LayerStreamingEngine:
                 acts[i] = None  # free the activation once consumed
                 if fused:
                     norm_sq_dev = norm_sq_dev + sq_norm(dlp)
-                    sw.step_layer(i, dlp, lr=lr)
+                    sw.step_layer(i, self._trunk_grads(dlp), lr=lr)
                 else:
-                    sw.stash_grads(i, dlp, accumulate=(k > 0))
+                    sw.stash_grads(i, self._trunk_grads(dlp),
+                                   accumulate=(k > 0))
                 sw.release(i)
 
             # ---- resident grads: embed grad from dx + head grads ----------
@@ -338,8 +485,10 @@ class LayerStreamingEngine:
             scale = 1.0
         else:
             # gplanes/g_res_acc hold SUMS over micros; the mean-loss grad is
-            # that sum / gas, so the norm divides by gas once
-            trunk_sq = sw.stashed_sq_norm()
+            # that sum / gas, so the norm divides by gas once.  Sharded
+            # planes are disjoint chunks → the global norm is the cross-
+            # process sum of local dots
+            trunk_sq = self._host_sum(sw.stashed_sq_norm())
             grad_norm = float(np.sqrt(trunk_sq + res_sq)) / gas
             scale = 1.0 / gas
             if self.clip > 0.0 and grad_norm > self.clip:

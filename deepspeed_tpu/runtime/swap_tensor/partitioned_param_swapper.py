@@ -90,7 +90,8 @@ class PartitionedParamSwapper:
     def __init__(self, layer_trees: List[Any], *, wire_dtype=jnp.bfloat16,
                  nvme_path: Optional[str] = None, buffer_count: int = 4,
                  aio_config: Any = None, adam_hparams: Optional[Dict] = None,
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None,
+                 shard: Optional[Tuple[int, int, int]] = None):
         assert layer_trees, "need at least one layer"
         #: tree → device tree; the streaming executor injects a mesh-aware
         #: fn (NamedSharding device_put per leaf) for multi-chip runs.  MUST
@@ -100,6 +101,32 @@ class PartitionedParamSwapper:
         self.L = len(layer_trees)
         self.treedef, self.layout = _leaf_layout(layer_trees[0])
         self.n_elems = sum(int(np.prod(s)) if s else 1 for s, _ in self.layout)
+        # ``shard``: MULTI-CONTROLLER host planes.  Each process owns the
+        # global index SEGMENTS its addressable devices cover in the
+        # device-sharded flat plane — the reference's partitioned optimizer
+        # state (ZeRO-3 under Infinity, SURVEY §2.1 #17): host RAM AND nvme
+        # bytes per process are O(layer/world).  Segments, not a rank-
+        # derived contiguous chunk: mesh construction may permute device
+        # order (ICI topology), so a process's slice of the flat plane need
+        # not be [rank*k, (rank+1)*k).  The local plane concatenates the
+        # segments in global order; the executor assembles/scatters the
+        # device arrays with the same ordering rule.
+        #   shard = {"rank", "world", "n_pad",
+        #            "segments": [(start, stop), ...]            # mine
+        #            "gather_map": [[(start, stop), ...], ...]}  # per rank
+        if shard is not None:
+            self.shard_rank = int(shard["rank"])
+            self.shard_world = int(shard["world"])
+            self.n_pad = int(shard["n_pad"])
+            self.segments = [(int(a), int(b)) for a, b in shard["segments"]]
+            self._gather_map = shard["gather_map"]
+            self.n_plane = sum(b - a for a, b in self.segments)
+        else:
+            self.n_pad = self.n_elems
+            self.n_plane = self.n_elems
+            self.shard_rank, self.shard_world = 0, 1
+            self.segments = [(0, self.n_elems)]
+            self._gather_map = None
         self.wire_np_dtype = np.dtype(wire_dtype)
         self._wire_is_bf16 = wire_dtype == jnp.bfloat16
         self.nvme_dir = nvme_path
@@ -141,13 +168,13 @@ class PartitionedParamSwapper:
                 overlap_events=getattr(ac, "overlap_events", True),
                 thread_count=getattr(ac, "thread_count", 2))
             # persist every layer once, then keep only the staging ring
-            scratch = _Planes(self.n_elems, self.wire_np_dtype)
+            scratch = _Planes(self.n_plane, self.wire_np_dtype)
             for i, tree in enumerate(layer_trees):
                 self._fill_planes(scratch, tree)
                 self._write_layer_sync(i, scratch, init=True)
             del scratch
             self._resident = None
-            self._slots = [_Planes(self.n_elems, self.wire_np_dtype)
+            self._slots = [_Planes(self.n_plane, self.wire_np_dtype)
                            for _ in range(self.buffer_count)]
             self._slot_of: Dict[int, int] = {}      # layer -> slot idx
             self._slot_state: Dict[int, str] = {}   # layer -> wire|full|reading
@@ -159,7 +186,7 @@ class PartitionedParamSwapper:
         self._gplanes: Dict[int, np.ndarray] = {}  # stashed grads per layer
         self._scratch_g: Optional[np.ndarray] = None  # fused-path grad buf
         tier = "nvme" if self.nvme_dir else "cpu"
-        per_layer = self.n_elems * (12 + self.wire_np_dtype.itemsize)
+        per_layer = self.n_plane * (12 + self.wire_np_dtype.itemsize)
         host_mib = (self.buffer_count if self.nvme_dir else self.L) \
             * per_layer / 2**20
         log_dist(f"ZeRO-Infinity swapper: {self.L} layers × "
@@ -171,33 +198,80 @@ class PartitionedParamSwapper:
     # ------------------------------------------------------------------
 
     def _seed_planes(self, tree: Any) -> _Planes:
-        planes = _Planes(self.n_elems, self.wire_np_dtype)
+        planes = _Planes(self.n_plane, self.wire_np_dtype)
         self._fill_planes(planes, tree)
         return planes
 
     def _fill_planes(self, planes: _Planes, tree: Any,
                      zero_moments: bool = True) -> None:
+        """Seed planes from a GLOBAL layer pytree.  Sharded: only the
+        intersections of each leaf's flat range with this process's
+        segments land in the (plane-relative) positions; plane positions
+        past ``n_elems`` (padding) are zeroed."""
         leaves = jax.tree.leaves(tree)
-        for leaf, (shape, off) in zip(leaves, self.layout):
-            n = int(np.prod(shape)) if shape else 1
-            flat = np.asarray(leaf, dtype=np.float32).reshape(-1)
-            planes.master[off:off + n] = flat
-            planes.wire[off:off + n] = flat.astype(self.wire_np_dtype)
+        flats = [None] * len(leaves)
+        poff = 0  # plane offset of the current segment
+        for lo, hi in self.segments:
+            for li, (leaf, (shape, off)) in enumerate(
+                    zip(leaves, self.layout)):
+                n = int(np.prod(shape)) if shape else 1
+                a, b = max(off, lo), min(off + n, hi)
+                if a >= b:
+                    continue
+                if flats[li] is None:
+                    flats[li] = np.asarray(
+                        leaf, dtype=np.float32).reshape(-1)
+                seg = flats[li][a - off:b - off]
+                pa = poff + (a - lo)
+                planes.master[pa:pa + (b - a)] = seg
+                planes.wire[pa:pa + (b - a)] = seg.astype(
+                    self.wire_np_dtype)
+            if hi > self.n_elems:  # padding tail of this segment
+                pa = poff + (max(lo, self.n_elems) - lo)
+                pb = poff + (hi - lo)
+                planes.master[pa:pb] = 0.0
+                planes.wire[pa:pb] = 0.0
+            poff += hi - lo
         if zero_moments:
             planes.m[:] = 0.0
             planes.v[:] = 0.0
 
     def _leaf_views(self, plane: np.ndarray) -> Any:
+        assert self.shard_world == 1, (
+            "sharded planes hold a process-local chunk; whole-leaf views "
+            "only exist after a cross-process gather (gather_plane)")
         views = [plane[off:off + (int(np.prod(s)) if s else 1)].reshape(s)
                  for s, off in self.layout]
         return jax.tree.unflatten(self.treedef, views)
+
+    def gather_plane(self, plane: np.ndarray) -> np.ndarray:
+        """All-gather per-process planes into the full flat plane (every
+        process participates and receives the full copy) — checkpoint and
+        introspection path only; the hot path all-gathers in-graph.  Each
+        rank's plane is scattered back through its segment table, so
+        permuted device orders reassemble correctly."""
+        if self.shard_world == 1:
+            return plane
+        from jax.experimental import multihost_utils
+
+        stacked = np.asarray(multihost_utils.process_allgather(plane))
+        full = np.zeros((self.n_pad,), plane.dtype)
+        for p, segs in enumerate(self._gather_map):
+            poff = 0
+            for a, b in segs:
+                full[a:b] = stacked[p, poff:poff + (b - a)]
+                poff += b - a
+        return full
 
     # ------------------------------------------------------------------
     # nvme file plumbing
     # ------------------------------------------------------------------
 
     def _path(self, i: int, kind: str) -> str:
-        return os.path.join(self.nvme_dir, f"layer_{i:05d}.{kind}")
+        # sharded: each process persists only ITS chunk (distinct files —
+        # nvme bytes per process stay O(layer/world))
+        suffix = (f".r{self.shard_rank}" if self.shard_world > 1 else "")
+        return os.path.join(self.nvme_dir, f"layer_{i:05d}{suffix}.{kind}")
 
     def _write_layer_sync(self, i: int, planes: _Planes, init: bool) -> None:
         for kind, buf in (("wire", planes.wire), ("master", planes.master),
@@ -235,8 +309,12 @@ class PartitionedParamSwapper:
             return
         if self.nvme_dir is None:
             if i not in self._device_cache:
-                self._device_cache[i] = jax.tree.map(
-                    jax.device_put, self._leaf_views(self._resident[i].wire))
+                if self._placement is not None or self.shard_world > 1:
+                    self.get_device(i)  # placement/sharded assembly path
+                else:
+                    self._device_cache[i] = jax.tree.map(
+                        jax.device_put,
+                        self._leaf_views(self._resident[i].wire))
             return
         state = self._slot_state.get(i)
         if state == "full" or (state in ("wire", "reading") and not full):
@@ -279,6 +357,14 @@ class PartitionedParamSwapper:
         """Device pytree of layer ``i``'s wire (compute-dtype) params."""
         if i not in self._device_cache:
             planes = self._ensure_host(i)
+            if self.shard_world > 1:
+                # multi-controller: hand the executor the LOCAL flat chunk;
+                # it builds the device-sharded global plane and all-gathers
+                # in-graph (params partitioned on host, gathered for
+                # compute — the reference ZeRO-3-under-Infinity shape)
+                self._device_cache[i] = self._placement(
+                    np.array(planes.wire))
+                return self._device_cache[i]
             views = self._leaf_views(planes.wire)
             if self._placement is not None:
                 self._device_cache[i] = self._placement(views)
@@ -305,7 +391,18 @@ class PartitionedParamSwapper:
     def _flatten_grads(self, buf: np.ndarray, grads_tree: Any,
                        accumulate: bool = False) -> None:
         """d2h the layer grad tree into a contiguous fp32 plane (optionally
-        += for gradient accumulation); transfers issued async up front."""
+        += for gradient accumulation); transfers issued async up front.
+
+        Sharded mode: ``grads_tree`` is already this process's flat LOCAL
+        chunk (the executor reduce-scatters in-graph and hands over the
+        addressable slice) — land it directly."""
+        if self.shard_world > 1:
+            g_np = np.asarray(grads_tree, dtype=np.float32).reshape(-1)
+            if accumulate:
+                buf += g_np
+            else:
+                buf[:] = g_np
+            return
         grad_leaves = jax.tree.leaves(grads_tree)
         for g in grad_leaves:
             if hasattr(g, "copy_to_host_async"):
@@ -323,7 +420,7 @@ class PartitionedParamSwapper:
     def _adam_planes(self, planes: _Planes, g: np.ndarray, lr: float) -> None:
         """ONE fused C++ Adam(W) call over the whole contiguous layer plane
         (master/m/v updated in place, bf16 wire emitted in the same pass)."""
-        common = [ctypes.c_int64(self.n_elems), ctypes.c_int(self.state_step),
+        common = [ctypes.c_int64(self.n_plane), ctypes.c_int(self.state_step),
                   ctypes.c_float(lr), ctypes.c_float(self.betas[0]),
                   ctypes.c_float(self.betas[1]), ctypes.c_float(self.eps),
                   ctypes.c_float(self.weight_decay),
@@ -346,7 +443,7 @@ class PartitionedParamSwapper:
         # ONE shared scratch plane for the fused path (grads are consumed
         # immediately) — per-layer grad planes are stash-path-only
         if self._scratch_g is None:
-            self._scratch_g = np.zeros((self.n_elems,), np.float32)
+            self._scratch_g = np.zeros((self.n_plane,), np.float32)
         g = self._scratch_g
         self._flatten_grads(g, grads_tree)
         self._adam_planes(planes, g, float(self.lr if lr is None else lr))
@@ -372,7 +469,7 @@ class PartitionedParamSwapper:
         global grad norm (clipping) or later microbatches (gas > 1)."""
         g = self._gplanes.get(i)
         if g is None:
-            g = self._gplanes[i] = np.zeros((self.n_elems,), np.float32)
+            g = self._gplanes[i] = np.zeros((self.n_plane,), np.float32)
             accumulate = False
         self._flatten_grads(g, grads_tree, accumulate=accumulate)
 
@@ -412,12 +509,23 @@ class PartitionedParamSwapper:
     # ------------------------------------------------------------------
 
     def layer_master_tree(self, i: int) -> Any:
-        """fp32 master params of layer ``i`` as a (copied) pytree."""
+        """fp32 master params of layer ``i`` as a (copied) pytree.
+        Sharded: cross-process gather — every process gets the full tree
+        (collective: all processes must call this together)."""
         planes = self._ensure_host(i, full=True)
+        if self.shard_world > 1:
+            full = self.gather_plane(planes.master)[:self.n_elems]
+            views = [full[off:off + (int(np.prod(s)) if s else 1)].reshape(s)
+                     for s, off in self.layout]
+            return jax.tree.unflatten(self.treedef,
+                                      [np.array(v) for v in views])
         return jax.tree.map(np.array, self._leaf_views(planes.master))
 
     def layer_moments(self, i: int) -> Dict[str, np.ndarray]:
         planes = self._ensure_host(i, full=True)
+        if self.shard_world > 1:
+            return {"m": self.gather_plane(planes.m)[:self.n_elems],
+                    "v": self.gather_plane(planes.v)[:self.n_elems]}
         return {"m": np.array(planes.m), "v": np.array(planes.v)}
 
     def load_layer(self, i: int, master_tree: Any,
@@ -427,8 +535,18 @@ class PartitionedParamSwapper:
         planes = self._ensure_host(i, full=True)
         self._fill_planes(planes, master_tree, zero_moments=False)
         if moments is not None:
-            planes.m[:] = np.asarray(moments["m"], np.float32)
-            planes.v[:] = np.asarray(moments["v"], np.float32)
+            # checkpoints store GLOBAL moment vectors; sharded planes take
+            # their segments (segment tails in padding are zeroed)
+            gm = np.asarray(moments["m"], np.float32)
+            gv = np.asarray(moments["v"], np.float32)
+            poff = 0
+            for lo, hi in self.segments:
+                k = max(0, min(hi, self.n_elems) - lo)
+                planes.m[poff:poff + k] = gm[lo:lo + k]
+                planes.v[poff:poff + k] = gv[lo:lo + k]
+                planes.m[poff + k:poff + (hi - lo)] = 0.0
+                planes.v[poff + k:poff + (hi - lo)] = 0.0
+                poff += hi - lo
         self._device_cache.pop(i, None)
         if self.nvme_dir is not None:
             self._write_layer_sync(i, planes, init=False)

@@ -1,0 +1,46 @@
+"""Shared tiny problem for the multiprocess tier — must be identical in
+every rank worker AND in the single-process comparison run."""
+
+import numpy as np
+
+HIDDEN = 16
+
+
+def make_problem(seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(HIDDEN, 1)).astype(np.float32)
+    x = rng.normal(size=(64, HIDDEN)).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.normal(size=(64, 1)).astype(np.float32)
+
+    params = {
+        "w1": jnp.asarray(
+            rng.normal(size=(HIDDEN, HIDDEN)).astype(np.float32) * 0.3),
+        "b1": jnp.zeros((HIDDEN,), jnp.float32),
+        "w2": jnp.asarray(
+            rng.normal(size=(HIDDEN, 1)).astype(np.float32) * 0.3),
+    }
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        h = jnp.tanh(bx @ p["w1"] + p["b1"])
+        pred = h @ p["w2"]
+        return jnp.mean((pred - by) ** 2)
+
+    return loss_fn, params, (x, y)
+
+
+def base_config(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    zs = over.pop("zero_stage", None)
+    if zs is not None:
+        cfg["zero_optimization"] = {"stage": zs}
+    cfg.update(over)
+    return cfg

@@ -1,0 +1,48 @@
+"""Rank worker: train 3 ZeRO-3 steps as one of 2 REAL processes, then
+save a checkpoint — the save itself is a multi-process operation (every
+rank participates in the orbax write of its addressable shards)."""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["T_REPO"])
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu as dst  # noqa: E402
+
+
+def main() -> int:
+    dst.init_distributed()
+    rank = jax.process_index()
+
+    from mp_common import make_problem, base_config
+
+    loss_fn, params, (x, y) = make_problem()
+    engine, _, _, _ = dst.initialize(
+        model=loss_fn, model_parameters=params,
+        config=base_config(zero_stage=3))
+
+    n = x.shape[0] // jax.process_count()
+    local = (np.asarray(x[rank * n:(rank + 1) * n]),
+             np.asarray(y[rank * n:(rank + 1) * n]))
+
+    losses = [float(engine.train_step(local)["loss"]) for _ in range(3)]
+    engine.save_checkpoint(os.environ["T_CKPT"])
+
+    with open(os.path.join(os.environ["T_OUT"], f"save_rank{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Rank worker: ZeRO-Infinity layer streaming as one of 2 REAL processes
+with PER-PROCESS host planes — each process owns 1/2 of every layer's
+master/moments/wire plane, the device wire is all-gathered in-graph, and
+gradients come back as per-process flat chunks (the reference's
+partitioned-optimizer-state deployment, SURVEY §2.1 #17)."""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["T_REPO"])
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu as dst  # noqa: E402
+
+
+def main() -> int:
+    dst.init_distributed()
+    rank = jax.process_index()
+
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    mesh = groups.initialize_mesh(MeshLayout.infer(8))  # dp=8 over 2 procs
+    cfg = LlamaConfig.tiny(num_layers=4, dtype=jnp.float32)
+    model = LlamaModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ds = {"train_micro_batch_size_per_gpu": 8,
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "AdamW",
+                        "params": {"lr": 1e-3, "betas": [0.9, 0.999],
+                                   "eps": 1e-8, "weight_decay": 0.0}},
+          "zero_optimization": {"stage": 3,
+                                "offload_param": {"device": "cpu"}}}
+    engine, _, _, _ = dst.initialize(model=model, model_parameters=params,
+                                     config=ds, mesh=mesh)
+    assert engine.infinity is not None
+    sw = engine.infinity.swapper
+    # per-process host planes: each process holds HALF the flat plane
+    assert sw.shard_world == 2 and sw.n_plane == sw.n_pad // 2
+
+    ids = np.random.RandomState(0).randint(0, 512, size=(8, 32))
+    local = {"input_ids": ids[rank * 4:(rank + 1) * 4]}  # per-process rows
+
+    losses = [float(engine.train_step(local)["loss"]) for _ in range(3)]
+
+    out = {"rank": rank, "losses": losses,
+           "n_plane": int(sw.n_plane), "n_pad": int(sw.n_pad)}
+    with open(os.path.join(os.environ["T_OUT"], f"inf_rank{rank}.json"),
+              "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Rank worker: ZeRO-3 training as one of N REAL OS processes.
+
+Launched by the repo's own launcher (``--launcher local-multi``), which
+exports COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID — the same env
+contract production multi-host launches use.  Each process owns 4 virtual
+CPU devices; collectives cross the process boundary through gloo.
+
+The worker trains the shared tiny problem feeding ONLY ITS OWN batch rows
+(per-process batch feeding — the reference's per-rank dataloader contract)
+and rank 0 writes the loss trajectory for the test to compare against the
+single-process fake-8 run.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["T_REPO"])
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu as dst  # noqa: E402
+
+
+def main() -> int:
+    dst.init_distributed()  # consumes the launcher's coordinator env
+    assert jax.process_count() == int(os.environ["NUM_PROCESSES"])
+    rank = jax.process_index()
+    world_dev = len(jax.devices())
+
+    from mp_common import make_problem, base_config  # noqa: E402
+
+    loss_fn, params, (x, y) = make_problem()
+    engine, _, _, _ = dst.initialize(
+        model=loss_fn, model_parameters=params,
+        config=base_config(zero_stage=3))
+
+    # per-process batch feeding: each rank slices ITS rows of the global
+    # batch; the engine assembles the global dp-sharded array
+    n = x.shape[0] // jax.process_count()
+    lo = rank * n
+    local = (np.asarray(x[lo:lo + n]), np.asarray(y[lo:lo + n]))
+
+    losses = []
+    for _ in range(5):
+        m = engine.train_step(local)
+        losses.append(float(m["loss"]))
+
+    # the dataloader feeds per-rank too: each process materializes only
+    # its rows, the yielded array is GLOBAL and dp-sharded
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    ds = [{"x": np.float32(np.arange(4) + i)} for i in range(16)]
+    dl = DeepSpeedDataLoader(ds, batch_size=8, mesh=engine.mesh)
+    b0 = next(iter(dl))
+    assert b0["x"].shape == (8, 4) and not b0["x"].is_fully_addressable
+
+    # every process must agree on the trajectory (global collectives)
+    out = {"rank": rank, "world_devices": world_dev, "losses": losses}
+    with open(os.path.join(os.environ["T_OUT"], f"rank{rank}.json"),
+              "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
