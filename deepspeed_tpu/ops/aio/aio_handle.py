@@ -33,6 +33,9 @@ class AIOHandle:
         self.lib.ds_aio_wait.restype = ctypes.c_int64
         self.lib.ds_aio_inflight.argtypes = [ctypes.c_void_p]
         self.lib.ds_aio_inflight.restype = ctypes.c_int64
+        self.lib.ds_aio_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
         self._h = self.lib.ds_aio_new(block_size, queue_depth,
                                       int(single_submit), int(overlap_events),
                                       thread_count)
@@ -67,6 +70,16 @@ class AIOHandle:
 
     def inflight(self) -> int:
         return int(self.lib.ds_aio_inflight(self._h))
+
+    def stats(self) -> dict:
+        """Bytes moved through O_DIRECT vs the buffered fallback — the
+        page-cache-bypass evidence (reference csrc/aio's defining
+        property).  Buffered bytes > 0 on direct-incapable filesystems
+        (tmpfs) and for sub-4KiB tails."""
+        d = ctypes.c_int64(0)
+        b = ctypes.c_int64(0)
+        self.lib.ds_aio_stats(self._h, ctypes.byref(d), ctypes.byref(b))
+        return {"direct_bytes": int(d.value), "buffered_bytes": int(b.value)}
 
     def __del__(self):
         try:

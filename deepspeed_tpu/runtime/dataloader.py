@@ -80,6 +80,30 @@ class DeepSpeedDataLoader:
             return replicated(self.mesh)
         return self.sharding
 
+    def _local_rows(self, n: int):
+        """This process's contiguous batch-row block [start, stop) under
+        the dp sharding — derived from the ACTUAL device index map, so
+        permuted mesh device orders still feed the right rows — or None
+        when the process's addressable rows aren't one contiguous 1/pw
+        block (batch axes not process-major, e.g. a model-parallel plane
+        per process): then every process materializes the full batch."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        probe = NamedSharding(self.mesh, PartitionSpec(self.sharding.spec[0]))
+        ivs = sorted({(sl[0].start or 0,
+                       n if sl[0].stop is None else sl[0].stop)
+                      for sl in probe.addressable_devices_indices_map(
+                          (n,)).values()})
+        start, stop = ivs[0]
+        for a, b in ivs[1:]:
+            if a > stop:
+                return None  # non-contiguous ownership
+            stop = max(stop, b)
+        if stop - start != n // _jax.process_count():
+            return None  # overlapping/replicated ownership
+        return start, stop
+
     def _order(self) -> np.ndarray:
         idx = np.arange(len(self.dataset))
         if self.shuffle:
@@ -96,16 +120,17 @@ class DeepSpeedDataLoader:
             if len(sel) < self.batch_size and self.drop_last:
                 break
             sh = self._sharding_for(len(sel))
-            if pw > 1 and len(sel) % pw == 0 and sh is self.sharding:
+            rows = (self._local_rows(len(sel))
+                    if pw > 1 and len(sel) % pw == 0 and sh is self.sharding
+                    else None)
+            if rows is not None:
                 # multi-controller: each process materializes ONLY its own
                 # rows (per-rank feeding, the reference's DistributedSampler
                 # contract) and the global dp-sharded array is assembled
                 # from the local slices.  Only when the dp sharding really
                 # applies — a replicated fallback (partial batch) must see
                 # the FULL batch on every process, below.
-                n = len(sel) // pw
-                lo = jax.process_index() * n
-                items = [self.dataset[int(i)] for i in sel[lo:lo + n]]
+                items = [self.dataset[int(i)] for i in sel[rows[0]:rows[1]]]
                 local = (self.collate_fn(items) if self.collate_fn
                          else jax.tree.map(lambda *xs: np.stack(xs), *items))
                 yield jax.tree.map(

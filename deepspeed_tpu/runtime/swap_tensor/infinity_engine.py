@@ -132,7 +132,7 @@ class LayerStreamingEngine:
             # segments its devices cover; device assembly is the in-graph
             # all-gather built in _build_flat_fns below
             placement, shard = self._build_flat_fns(
-                layer_trees[0], layer_specs, wire_dtype)
+                layer_trees[0], layer_specs)
         elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -206,8 +206,7 @@ class LayerStreamingEngine:
     # multi-controller flat-plane machinery
     # ------------------------------------------------------------------
 
-    def _build_flat_fns(self, layer_tree: Any, layer_specs: Any,
-                        wire_dtype):
+    def _build_flat_fns(self, layer_tree: Any, layer_specs: Any):
         """Build the in-graph gather/scatter pair for per-process planes.
 
         Returns ``(placement, shard)``: the placement fn maps the local

@@ -91,7 +91,7 @@ class PartitionedParamSwapper:
                  nvme_path: Optional[str] = None, buffer_count: int = 4,
                  aio_config: Any = None, adam_hparams: Optional[Dict] = None,
                  placement: Optional[Any] = None,
-                 shard: Optional[Tuple[int, int, int]] = None):
+                 shard: Optional[Dict[str, Any]] = None):
         assert layer_trees, "need at least one layer"
         #: tree → device tree; the streaming executor injects a mesh-aware
         #: fn (NamedSharding device_put per leaf) for multi-chip runs.  MUST
