@@ -128,15 +128,28 @@ struct Handle {
     while (remaining > 0) {
       int64_t want = remaining < chunk ? remaining : chunk;
       if (kind == Op::READ) {
-        // read whole aligned blocks; copy out just the requested bytes
-        ssize_t got = ::pread(fd, bounce, align_up(want), off);
-        if (got < want) return -1;
+        // read whole aligned blocks; copy out just the requested bytes.
+        // Short transfers are legal (signal/kernel split) — retry from
+        // the returned count as long as O_DIRECT alignment holds.
+        int64_t need = align_up(want);
+        int64_t done = 0;
+        while (done < want) {
+          ssize_t got = ::pread(fd, (char*)bounce + done, need - done,
+                                off + done);
+          if (got <= 0 || got % kAlign) return -1;
+          done += got;
+        }
         std::memcpy(p, bounce, want);
       } else {
         if (want % kAlign) return -1;  // caller routes tails elsewhere
         std::memcpy(bounce, p, want);
-        ssize_t put = ::pwrite(fd, bounce, want, off);
-        if (put != want) return -1;
+        int64_t done = 0;
+        while (done < want) {
+          ssize_t put = ::pwrite(fd, (char*)bounce + done, want - done,
+                                 off + done);
+          if (put <= 0 || put % kAlign) return -1;
+          done += put;
+        }
       }
       p += want;
       off += want;

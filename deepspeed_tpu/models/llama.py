@@ -267,8 +267,15 @@ class LlamaModel:
             return x
         from ..parallel.mesh import strip_manual_axes
 
+        stripped = strip_manual_axes(*spec)
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            # inside a (partial-manual) shard_map / set_mesh scope: a bare
+            # PartitionSpec binds to the CONTEXT mesh — a concrete-mesh
+            # NamedSharding would fail the context-consistency check
+            return jax.lax.with_sharding_constraint(x, stripped)
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, strip_manual_axes(*spec)))
+            x, NamedSharding(self.mesh, stripped))
 
     def decoder_layer(self, lp: Any, x: jnp.ndarray
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:

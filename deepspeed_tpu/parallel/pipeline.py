@@ -204,7 +204,10 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
         is_last = s == pp - 1
         micro0 = tmap(lambda a: a[0], micros)
         x0 = embed_fn(ep, micro0)
-        zero_act = tmap(lambda z: jnp.zeros_like(z), x0)
+        # shape/dtype-only zeros: the model may constrain x0 with a
+        # concrete-mesh sharding, which zeros_like would drag into the
+        # manual-pipe context (mesh mismatch)
+        zero_act = tmap(lambda z: jnp.zeros(z.shape, z.dtype), x0)
         stash0 = tmap(lambda z: jnp.zeros((S,) + z.shape, z.dtype), zero_act)
 
         def zlike(tree):
@@ -295,13 +298,13 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
             # ---------------- rings ----------------
             fwd_ring = [(i, (i + 1) % pp) for i in range(pp)]
             bwd_ring = [(i, (i - 1) % pp) for i in range(pp)]
-            f_send = tmap(lambda o: jnp.where(f_active, o,
-                                              jnp.zeros_like(o)), x_out)
+            f_send = tmap(lambda o: jnp.where(
+                f_active, o, jnp.zeros(o.shape, o.dtype)), x_out)
             b_out = tmap(lambda a, b: jnp.where(is_last, a, b),
                          dxi_last, dxi_b)
             b_send = tmap(
                 lambda o: jnp.where(b_active | (is_last & f_active), o,
-                                    jnp.zeros_like(o)), b_out)
+                                    jnp.zeros(o.shape, o.dtype)), b_out)
             f_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, fwd_ring),
                           f_send)
             b_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, bwd_ring),

@@ -192,6 +192,12 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
+    #: which schedule executes when stages > 1 (reference TrainSchedule =
+    #: 1f1b; SURVEY §3.5).  "1f1b": one-forward-one-backward via
+    #: parallel.pipeline.pipeline_train_1f1b (O(pp) stashed activations);
+    #: "gpipe": fill/drain forward + autodiff backward; "interleaved":
+    #: gpipe with virtual stages
+    schedule: str = "1f1b"
 
 
 class ElasticityConfig(DeepSpeedConfigModel):

@@ -61,6 +61,7 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.sharding = batch_sharding(self.mesh, sp_shard_sequence)
         self._epoch = 0
+        self._local_rows_cache: dict = {}
 
     def __len__(self):
         n = len(self.dataset) // self.batch_size
@@ -86,7 +87,14 @@ class DeepSpeedDataLoader:
         permuted mesh device orders still feed the right rows — or None
         when the process's addressable rows aren't one contiguous 1/pw
         block (batch axes not process-major, e.g. a model-parallel plane
-        per process): then every process materializes the full batch."""
+        per process): then every process materializes the full batch.
+        Deterministic per (mesh, n) — memoized off the input hot path."""
+        if n in self._local_rows_cache:
+            return self._local_rows_cache[n]
+        self._local_rows_cache[n] = rows = self._compute_local_rows(n)
+        return rows
+
+    def _compute_local_rows(self, n: int):
         import jax as _jax
         from jax.sharding import NamedSharding, PartitionSpec
 

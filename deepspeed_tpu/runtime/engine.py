@@ -141,6 +141,40 @@ class DeepSpeedEngine:
         self.compute_dtype = config.dtype()
         self.fp16_enabled = config.fp16.enabled is True
         self.bf16_enabled = config.bf16.enabled is True
+
+        # --- pipeline schedule routing (reference TrainSchedule = 1F1B) --
+        from ..parallel.mesh import AXIS_PIPE
+
+        pp = int(self.mesh.shape.get(AXIS_PIPE, 1))
+        self._pp_1f1b = (
+            pp > 1
+            and str(config.pipeline.schedule).lower() == "1f1b"
+            and isinstance(params, dict) and "layers" in params
+            and all(callable(getattr(module, m, None))
+                    for m in ("embed_fwd", "decoder_layer", "head_loss",
+                              "batch_labels")))
+        self.last_pipe_stats = None  # set at trace time by _pp_1f1b_grads
+        if self._pp_1f1b and self.fp16_enabled:
+            log_dist("pipeline.schedule=1f1b does not compose with fp16 "
+                     "loss scaling yet — falling back to the GPipe "
+                     "(autodiff) schedule")
+            self._pp_1f1b = False
+        compressed_comm = (
+            config.zero_optimization.zero_quantized_gradients
+            or config.zero_optimization.zero_quantized_weights
+            or (config.optimizer is not None
+                and "onebit" in config.optimizer.type.lower().replace("-",
+                                                                      "")))
+        if self._pp_1f1b and compressed_comm:
+            log_dist("pipeline.schedule=1f1b does not compose with "
+                     "compressed-comm paths (1-bit/qwZ/qgZ) — falling back "
+                     "to the GPipe (autodiff) schedule")
+            self._pp_1f1b = False
+        if pp > 1 and not self._pp_1f1b \
+                and str(config.pipeline.schedule).lower() == "1f1b":
+            log_dist("pipeline.schedule=1f1b needs the layer-streamable "
+                     "module protocol (embed_fwd/decoder_layer/head_loss) "
+                     "— running the module's own pipeline path instead")
         gas = config.gradient_accumulation_steps
         self.gradient_accumulation_steps = int(gas) if isinstance(gas, int) else 1
         self.micro_batch_size = config.train_micro_batch_size_per_gpu
@@ -376,6 +410,56 @@ class DeepSpeedEngine:
     # the compiled train step
     # ------------------------------------------------------------------
 
+    def _pp_1f1b_grads(self, compute_params, batch):
+        """Grads + mean loss through the 1F1B schedule.
+
+        Bridges the module's layer-streamable protocol (embed_fwd /
+        decoder_layer / head_loss — the same contract Infinity streams
+        through) onto ``pipeline_train_1f1b``'s (embed_fn, layer_fn,
+        head_fn) surface; MoE aux loss rides the activation carry.
+        Reference: ``runtime/pipe/engine.py`` TrainSchedule execution
+        (SURVEY §3.5)."""
+        from ..parallel.mesh import AXIS_PIPE
+        from ..parallel.pipeline import pipeline_train_1f1b
+
+        mod = self.module
+        aux_coef = float(getattr(mod, "aux_loss_coef", 0.0))
+        gas = self.gradient_accumulation_steps
+        pp = int(self.mesh.shape[AXIS_PIPE])
+        rows = jax.tree.leaves(batch)[0].shape[0]
+        m_pipe = int(getattr(getattr(mod, "config", None),
+                             "pp_microbatches", 0) or pp)
+        M = gas * m_pipe
+        if rows % M:
+            raise ValueError(
+                f"batch rows {rows} not divisible by pipeline microbatches "
+                f"{M} (gas {gas} × pp micro {m_pipe})")
+        micro = jax.tree.map(
+            lambda x: x.reshape((M, rows // M) + x.shape[1:]), batch)
+        resident = {k: v for k, v in compute_params.items()
+                    if k != "layers"}
+
+        def embed_fn(ep, mb):
+            ids, _ = mod.batch_labels(mb)
+            return (mod.embed_fwd(ep, ids), jnp.float32(0.0))
+
+        def layer_fn(lp, act):
+            x, aux = act
+            nx, naux = mod.decoder_layer(lp, x)
+            return (nx, aux + naux)
+
+        def head_fn(hp, act, mb):
+            x, aux = act
+            return mod.head_loss(hp, x, mb) + aux_coef * aux
+
+        loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(
+            layer_fn, compute_params["layers"], embed_fn, resident,
+            head_fn, resident, micro, self.mesh)
+        self.last_pipe_stats = dict(stats, schedule="1f1b")
+        grads = dict(jax.tree.map(jnp.add, g_emb, g_head))
+        grads["layers"] = g_trunk
+        return grads, loss
+
     def _grad_core(self, onebit: Optional[bool] = None):
         """Shared microbatch-scan gradient computation: accumulation, loss
         (un)scaling, ZeRO grad constraints, overflow screen, clipping.  Used
@@ -430,6 +514,26 @@ class DeepSpeedEngine:
                     threshold=policy.persistence_threshold,
                     base_specs=self.base_specs)
             scale = state.loss_scale.scale
+
+            if self._pp_1f1b and not (onebit or qgz or self.qwz_enabled):
+                # 1F1B pipeline schedule (reference TrainSchedule): grads
+                # come from the lockstep tick scan in parallel/pipeline.py
+                # — O(pp) stashed activations per stage — instead of
+                # autodiff through the module's GPipe forward.  The
+                # pipeline microbatch count absorbs gas (both are "grads
+                # summed over micros of the mean loss").
+                grads, mean_loss = self._pp_1f1b_grads(compute_params,
+                                                       batch)
+                grads = policy.apply_grad_constraints(grads,
+                                                      self.base_specs)
+                overflow = jnp.bool_(False)
+                if clip > 0:
+                    grads, grad_norm = clip_grads_by_global_norm(grads,
+                                                                 clip)
+                else:
+                    grad_norm = global_grad_norm(grads)
+                return (grads, mean_loss, overflow, grad_norm,
+                        state.comm_state)
 
             # [global_batch, ...] -> [gas, global_batch/gas, ...]
             micro = jax.tree.map(

@@ -12,10 +12,14 @@ activations with ``ppermute`` along the ``pipe`` mesh axis inside
 differently).  That path lives in ``parallel/pipeline.py`` once the ``pipe``
 axis size is > 1.
 
-This engine currently LOWERS THE SAME API onto a fused sequential program
-(stages chained inside one jit — correct for pp=1 and for validating pipeline
-models); the scan/ppermute schedule is wired in when `pipe`>1 support lands
-(tracked in SURVEY §7 build order step 10).
+With ``pipe == 1`` the API lowers onto a fused sequential program (stages
+chained inside one jit).  With ``pipe > 1`` the stage chains execute the
+REAL fill/drain schedule — ``parallel.pipeline.pipeline_apply_stages``'s
+lax.scan + ppermute ring over the pipe mesh axis (each rank runs only its
+own stage via lax.switch).  Homogeneous layer-stack models get the 1F1B
+schedule through ``DeepSpeedEngine`` directly (``pipeline.schedule``
+config key); heterogeneous-stage 1F1B is future work — GPipe-through-
+autodiff computes identical gradients with a larger activation footprint.
 """
 
 from __future__ import annotations
@@ -51,13 +55,54 @@ class PipelineEngine(DeepSpeedEngine):
             else:
                 params["layers"][str(i)] = spec.build(jax.random.fold_in(rng, i))
 
-        def loss_fn(p, batch):
-            x, y = batch
-            for i, spec in enumerate(module.specs):
-                layer_p = (p["tied"][spec.key] if isinstance(spec, TiedLayerSpec)
-                           else p["layers"][str(i)])
-                x = spec.apply_fn(layer_p, x)
-            return module.loss_fn(x, y)
+        from ...utils import groups as groups_mod
+        from ...parallel.mesh import AXIS_PIPE
+
+        eff_mesh = mesh if mesh is not None else groups_mod.get_mesh()
+        pp = int(eff_mesh.shape.get(AXIS_PIPE, 1)) if eff_mesh else 1
+
+        def _apply_spec(p, i, spec, x):
+            layer_p = (p["tied"][spec.key] if isinstance(spec, TiedLayerSpec)
+                       else p["layers"][str(i)])
+            return spec.apply_fn(layer_p, x)
+
+        if pp > 1:
+            # REAL pipeline execution: partition the spec chain into pp
+            # stage fns and run the ppermute fill/drain schedule
+            from ...parallel.pipeline import pipeline_apply_stages
+
+            bounds = module.stage_bounds(pp)
+
+            def _stage_fn(s):
+                lo, hi = bounds[s], bounds[s + 1]
+
+                def run(p, x):
+                    for i in range(lo, hi):
+                        x = _apply_spec(p, i, module.specs[i], x)
+                    return x
+                return run
+
+            stage_fns = [_stage_fn(s) for s in range(pp)]
+            M = int(config.pipeline.num_micro_batches or pp)
+
+            def loss_fn(p, batch):
+                x, y = batch
+                rows = x.shape[0]
+                if rows % M:
+                    raise ValueError(
+                        f"batch rows {rows} not divisible by pipeline "
+                        f"microbatches {M}")
+                micro_x = x.reshape((M, rows // M) + x.shape[1:])
+                outs = pipeline_apply_stages(stage_fns, p, micro_x,
+                                             eff_mesh)
+                outs = outs.reshape((rows,) + outs.shape[2:])
+                return module.loss_fn(outs, y)
+        else:
+            def loss_fn(p, batch):
+                x, y = batch
+                for i, spec in enumerate(module.specs):
+                    x = _apply_spec(p, i, spec, x)
+                return module.loss_fn(x, y)
 
         super().__init__(loss_fn=loss_fn, params=params, config=config,
                          optimizer=optimizer, lr_schedule=lr_schedule,

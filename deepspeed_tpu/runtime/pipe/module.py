@@ -63,3 +63,11 @@ class PipelineModule:
 
     def stage_layers(self, stage_id: int) -> List[LayerSpec]:
         return self.specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_bounds(self, n_stages: int) -> List[int]:
+        """Stage boundaries for an EXECUTION width that may differ from the
+        module's declared ``num_stages`` (the engine partitions over the
+        actual pipe mesh axis)."""
+        if n_stages == self.num_stages:
+            return self.parts
+        return self._partition_uniform(len(self.specs), n_stages)
