@@ -136,8 +136,12 @@ struct Handle {
         while (done < want) {
           ssize_t got = ::pread(fd, (char*)bounce + done, need - done,
                                 off + done);
-          if (got <= 0 || got % kAlign) return -1;
+          if (got <= 0) return -1;
           done += got;
+          // continuing from an unaligned position would break O_DIRECT;
+          // legal only when the request is already satisfied (short final
+          // read at an unaligned EOF — buffered tails make those normal)
+          if (done < want && done % kAlign) return -1;
         }
         std::memcpy(p, bounce, want);
       } else {

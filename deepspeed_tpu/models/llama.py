@@ -283,6 +283,20 @@ class LlamaModel:
         of the scan in :meth:`_forward_trunk` AND the unit of ZeRO-Infinity
         layer streaming (``runtime/swap_tensor``), where each layer's params
         arrive from host/NVMe just ahead of use."""
+        c = self.config
+        out = self._attn_block(lp, x)
+        # back to the sequence-sharded home layout
+        x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
+
+        h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+        ffn_out, l_aux = self._ffn(h, lp)
+        x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
+        return x, l_aux
+
+    def _attn_block(self, lp: Any, x: jnp.ndarray) -> jnp.ndarray:
+        """Attention half of one decoder layer (its norm + QKV + attention
+        + output proj, WITHOUT the residual) — separately callable so the
+        per-module flops profiler can attribute cost at module_depth 2."""
         from ..runtime.sequence_parallel.ulysses_sp import ulysses_attention
 
         c = self.config
@@ -343,15 +357,19 @@ class LlamaModel:
             attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
         else:
             attn = attn_fn(q, kk, vv)
-        out = jnp.einsum("bshd,hdH->bsH", attn,
-                         lp["attn"]["wo"].astype(c.dtype))
-        # back to the sequence-sharded home layout
-        x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
+        return jnp.einsum("bshd,hdH->bsH", attn,
+                          lp["attn"]["wo"].astype(c.dtype))
 
-        h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
-        ffn_out, l_aux = self._ffn(h, lp)
-        x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
-        return x, l_aux
+    def profile_submodules(self) -> Dict[str, Any]:
+        """Depth-2 module pieces for the flops profiler: name →
+        ``fn(lp, x)`` over one decoder layer's params + activations."""
+        c = self.config
+
+        def mlp(lp, x):
+            h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+            return self._ffn(h, lp)[0]
+
+        return {"attn": self._attn_block, "mlp": mlp}
 
     def embed_fwd(self, params: Any, input_ids: jnp.ndarray) -> jnp.ndarray:
         """[B, S] ids → embedded activations in the home layout."""

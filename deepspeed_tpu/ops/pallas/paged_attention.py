@@ -177,3 +177,35 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
     )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
       q, k_pool, v_pool)
     return out
+
+
+def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, lengths,
+                              mesh, window=None):
+    """TENSOR-PARALLEL paged decode: the Pallas kernel itself is not
+    GSPMD-partitionable (custom call), so the partitioning is explicit —
+    a ``shard_map`` over the ``tensor`` mesh axis on the HEAD dims.
+    Attention heads are independent, so each TP rank runs the kernel on
+    its local ``h/tp`` query heads against its local ``kv_h/tp`` pool
+    slice with NO cross-rank communication; block tables and lengths are
+    replicated metadata.  Requires ``tp | kv_heads`` (the serving engine
+    enforces this at admission).
+
+    Reference: the v2 inference kernels run TP-sharded the same way
+    (SURVEY §2.2 inference-kernels row); this closes round 3's
+    "einsum-fallback attention under TP serving" gap."""
+    from ...parallel.mesh import AXIS_TENSOR
+
+    P = jax.sharding.PartitionSpec
+
+    def local(q_, kp, vp, bt, ln):
+        return paged_decode_attention(q_, kp, vp, bt, ln, window=window)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, AXIS_TENSOR, None),
+                  P(None, None, AXIS_TENSOR, None),
+                  P(None, None, AXIS_TENSOR, None), P(), P()),
+        out_specs=P(None, AXIS_TENSOR, None),
+        check_vma=False,
+        axis_names={AXIS_TENSOR})(q, k_pool, v_pool,
+                                  block_tables, lengths)

@@ -126,6 +126,108 @@ class FlopsProfiler:
         self.profile = {}
 
 
+def profile_model_modules(model: Any, params: Any, batch: Any,
+                          module_depth: int = -1, top_modules: int = 0,
+                          runs: int = 3) -> Dict[str, Dict[str, float]]:
+    """PER-MODULE flops/params/latency table (reference FlopsProfiler's
+    ``module_depth``/``top_modules`` per-module breakdown, SURVEY §2.5).
+
+    TPU-first: instead of module hooks, each piece of the model's
+    layer-streamable protocol compiles separately and its cost comes from
+    the COMPILER (``cost_analysis``) plus a timed on-device replay —
+    "which layer burns the FLOPs" answered with post-fusion truth:
+
+    * depth 1 — ``embed``, ``layers`` (one decoder layer × L), ``head``
+    * depth 2 — inside one decoder layer, whatever the model's
+      ``profile_submodules()`` exposes (attn/mlp for the Llama family)
+
+    Returns ``{module: {flops, macs, params, latency_s, pct_latency,
+    tflops_per_s, count}}``; ``latency_s`` is the per-call forward time,
+    ``pct_latency`` weights by ``count`` (layers run L times per step).
+    """
+    needed = ("embed_fwd", "decoder_layer", "head_loss", "batch_labels")
+    if not all(callable(getattr(model, m, None)) for m in needed):
+        raise ValueError(
+            "per-module profiling needs the layer-streamable protocol "
+            f"(embed_fwd/decoder_layer/head_loss); {type(model).__name__} "
+            "does not implement it")
+    ids, _ = model.batch_labels(batch)
+    L = int(model.config.num_layers)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    resident = {k: v for k, v in params.items() if k != "layers"}
+
+    def timed(fn, *args) -> Tuple[float, float]:
+        costs = _compiled_cost(fn, *args)
+        jitted = jax.jit(fn)
+        jax.block_until_ready(jitted(*args))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            out = jitted(*args)
+        jax.block_until_ready(out)
+        return float(costs.get("flops", 0.0)), \
+            (time.perf_counter() - t0) / runs
+
+    def n_params(tree) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+    x = jax.jit(model.embed_fwd)(resident, ids)
+    rows: Dict[str, Dict[str, float]] = {}
+
+    def add(name, fn, args, count, params_of, depth):
+        flops, lat = timed(fn, *args)
+        rows[name] = {"flops": flops, "macs": flops / 2.0,
+                      "params": n_params(params_of), "latency_s": lat,
+                      "count": count, "depth": depth,
+                      "tflops_per_s": (flops / lat / 1e12) if lat else 0.0}
+
+    add("embed", model.embed_fwd, (resident, ids), 1,
+        {k: v for k, v in resident.items() if k == "embed"}, 1)
+    add("layers", lambda l, a: model.decoder_layer(l, a)[0], (lp, x), L,
+        params["layers"], 1)
+    add("head", model.head_loss, (resident, x, batch), 1,
+        {k: v for k, v in resident.items() if k != "embed"}, 1)
+    if (module_depth < 0 or module_depth >= 2) and callable(
+            getattr(model, "profile_submodules", None)):
+        for name, fn in model.profile_submodules().items():
+            add(f"layers.{name}", fn, (lp, x), L,
+                {}, 2)  # params attributed at depth 1
+    total = sum(r["latency_s"] * r["count"] for r in rows.values()
+                if r["depth"] == 1)
+    for r in rows.values():
+        r["pct_latency"] = 100.0 * r["latency_s"] * r["count"] / total \
+            if total else 0.0
+    if top_modules and top_modules > 0:
+        keep = set()
+        for d in (1, 2):
+            at_d = sorted((n for n, r in rows.items() if r["depth"] == d),
+                          key=lambda n: -rows[n]["pct_latency"])
+            keep.update(at_d[:top_modules])
+        rows = {n: r for n, r in rows.items() if n in keep}
+    return rows
+
+
+def format_module_table(rows: Dict[str, Dict[str, float]]) -> str:
+    """Reference-style top-modules table."""
+    lines = ["-" * 78,
+             f"{'module':<16}{'params':>12}{'MACs':>14}{'fwd latency':>14}"
+             f"{'% latency':>11}{'TFLOP/s':>10}",
+             "-" * 78]
+    for name, r in sorted(rows.items(),
+                          key=lambda kv: (kv[1]['depth'],
+                                          -kv[1]['pct_latency'])):
+        pad = "  " if r["depth"] == 2 else ""
+        cnt = f" x{int(r['count'])}" if r["count"] > 1 else ""
+        lines.append(
+            f"{pad + name + cnt:<16}"
+            f"{_num_to_string(r['params'], ''):>12}"
+            f"{_num_to_string(r['macs'], 'MACs'):>14}"
+            f"{r['latency_s'] * 1e3:>11.2f} ms"
+            f"{r['pct_latency']:>10.1f}%"
+            f"{r['tflops_per_s']:>10.2f}")
+    lines.append("-" * 78)
+    return "\n".join(lines)
+
+
 def _num_to_string(num: float, unit: str) -> str:
     for scale, prefix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
         if num >= scale:

@@ -170,8 +170,10 @@ class DeepSpeedEngine:
                      "compressed-comm paths (1-bit/qwZ/qgZ) — falling back "
                      "to the GPipe (autodiff) schedule")
             self._pp_1f1b = False
-        if pp > 1 and not self._pp_1f1b \
-                and str(config.pipeline.schedule).lower() == "1f1b":
+        if (pp > 1 and not self._pp_1f1b
+                and str(config.pipeline.schedule).lower() == "1f1b"
+                and not self.fp16_enabled and not compressed_comm):
+            # the fp16/compressed-comm fallbacks logged their own reason
             log_dist("pipeline.schedule=1f1b needs the layer-streamable "
                      "module protocol (embed_fwd/decoder_layer/head_loss) "
                      "— running the module's own pipeline path instead")
@@ -894,7 +896,31 @@ class DeepSpeedEngine:
             self.monitor.write_events(
                 [(f"Train/{k}", v, self.global_steps)
                  for k, v in metrics.items() if k != "overflow"])
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == int(fp.profile_step):
+            self._emit_module_profile(batch, fp)
         return metrics
+
+    def _emit_module_profile(self, batch, fp) -> None:
+        """One-shot per-module flops/latency table at ``profile_step``
+        (reference FlopsProfiler behavior, SURVEY §2.5)."""
+        try:
+            from ..profiling.flops_profiler.profiler import (
+                format_module_table, profile_model_modules)
+
+            rows = profile_model_modules(
+                self.module, self.state.params, batch,
+                module_depth=int(fp.module_depth),
+                top_modules=int(fp.top_modules) if not fp.detailed else 0)
+            text = format_module_table(rows)
+            if fp.output_file:
+                with open(fp.output_file, "w") as f:
+                    f.write(text + "\n")
+            log_dist("flops profiler (per-module, step "
+                     f"{self.global_steps}):\n{text}")
+        except Exception as e:
+            logger.warning(f"flops profiler: per-module table unavailable "
+                           f"({e})")
 
     def eval_loss(self, batch) -> jnp.ndarray:
         batch = self._feed_batch(batch)

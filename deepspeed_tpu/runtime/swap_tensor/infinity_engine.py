@@ -101,10 +101,15 @@ class LayerStreamingEngine:
                         "offload_param.device=nvme requires nvme_path")
                 nvme_path = pcfg.nvme_path
 
-        # split: trunk layers → swapper; everything else resident on device
+        # split: trunk layers → swapper; everything else resident on device.
+        # one() keeps the SOURCE dtype: for numpy inputs these are views
+        # (no copy) — the swapper's plane fill does the fp32 cast per
+        # layer, so peak host memory is planes + the original tree, not
+        # planes + a second fp32 copy of the whole trunk (an 8B trunk is
+        # 28 GB per copy)
         layers = params["layers"]
         resident = {k: v for k, v in params.items() if k != "layers"}
-        one = lambda leaf, i: np.asarray(leaf[i], dtype=np.float32)
+        one = lambda leaf, i: np.asarray(leaf[i])
         layer_trees = [jax.tree.map(functools.partial(one, i=i), layers)
                        for i in range(self.L)]
 

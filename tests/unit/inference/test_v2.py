@@ -384,3 +384,6 @@ def test_v2_tp_sharded_serving_matches_meshless():
     assert not eng.pool["k"].sharding.is_fully_replicated
     got = eng.generate(prompts, max_new_tokens=5)
     assert got == want
+    # the PAGED KERNEL path executed under TP (shard_map over kv heads),
+    # not the einsum fallback (VERDICT r3 item 5)
+    assert eng.last_attn_path == "pallas_tp_shard_map"
