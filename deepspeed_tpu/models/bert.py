@@ -208,7 +208,7 @@ class BertModel:
             + lp["mlp"]["b_in"].astype(dt)
         from ..compression.quantization import maybe_quantize_activation
 
-        h = maybe_quantize_activation(self, jax.nn.gelu(h))
+        h = maybe_quantize_activation(self, jax.nn.gelu(h, approximate=False))
         h = self._constrain(h, DP_AXES, AXIS_SEQ, AXIS_TENSOR)
         h = jnp.einsum("bsI,IH->bsH", h, lp["mlp"]["w_out"].astype(dt)) \
             + lp["mlp"]["b_out"].astype(dt)
@@ -295,7 +295,7 @@ class BertModel:
 
         m = params["mlm"]
         h = jax.nn.gelu(jnp.einsum("bsH,HG->bsG", x, m["w"].astype(dt))
-                        + m["b"].astype(dt))
+                        + m["b"].astype(dt), approximate=False)
         h = _layer_norm(h, m["ln_w"].astype(dt), m["ln_b"].astype(dt),
                         c.layer_norm_eps)
         logits = (jnp.einsum("bsH,VH->bsV", h, e["word"].astype(dt))

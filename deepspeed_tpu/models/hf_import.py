@@ -1,4 +1,5 @@
-"""HF checkpoint import — map Hugging Face Llama weights into the model zoo.
+"""HF checkpoint import — map Hugging Face weights into the model zoo
+(Llama, Mistral, Mixtral, OPT, BERT).
 
 Capability anchor: reference users bring HF torch models directly
 (``deepspeed.initialize(model=hf_model)``); this build's engine consumes
@@ -29,11 +30,34 @@ def _to_np(t: Any) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _getter(hf_config: Any):
+    """Uniform key access over an HF config object or a config.json dict."""
+    return (hf_config.get if isinstance(hf_config, dict)
+            else lambda k, d=None: getattr(hf_config, k, d))
+
+
+def _load(model_name_or_path: str, config_fn, params_fn, model_cls=None,
+          **config_overrides):
+    """Shared load pipeline: AutoConfig → zoo config → from_pretrained →
+    state-dict mapping.  ``transformers`` (torch CPU) handles safetensors
+    and sharded bins uniformly."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    config = config_fn(hf_cfg, **config_overrides)
+    model = (model_cls or AutoModelForCausalLM).from_pretrained(
+        model_name_or_path)
+    try:
+        params = params_fn(model.state_dict(), config)
+    finally:
+        del model
+    return config, params
+
+
 def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     """Build a :class:`LlamaConfig` from an HF ``LlamaConfig`` object or a
     plain dict (``config.json`` contents)."""
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _getter(hf_config)
     d = dict(
         vocab_size=int(get("vocab_size")),
         hidden_size=int(get("hidden_size")),
@@ -50,6 +74,9 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     hd = get("head_dim")
     if hd is not None and int(hd) != d["hidden_size"] // d["num_heads"]:
         d["head_dim"] = int(hd)
+    sw = get("sliding_window")
+    if sw is not None:
+        d["sliding_window"] = int(sw)
     d.update(overrides)
     return LlamaConfig(**d)
 
@@ -104,13 +131,307 @@ def load_hf_llama(model_name_or_path: str, **config_overrides
     Uses ``transformers`` (torch CPU) for robust format handling —
     safetensors and sharded bins both resolve through ``from_pretrained``.
     """
-    from transformers import AutoConfig, LlamaForCausalLM
+    return _load(model_name_or_path, config_from_hf,
+                 params_from_hf_state_dict, **config_overrides)
 
-    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
-    config = config_from_hf(hf_cfg, **config_overrides)
-    model = LlamaForCausalLM.from_pretrained(model_name_or_path)
-    try:
-        params = params_from_hf_state_dict(model.state_dict(), config)
-    finally:
-        del model
-    return config, params
+
+# ---------------------------------------------------------------------------
+# Mistral — same layout as Llama (HF MistralForCausalLM shares the module
+# names), plus the sliding-window config key
+# ---------------------------------------------------------------------------
+
+def load_hf_mistral(model_name_or_path: str, **config_overrides
+                    ) -> Tuple[LlamaConfig, Dict[str, Any]]:
+    """HF Mistral checkpoint → (LlamaConfig-with-window, params).  The zoo
+    serves Mistral through :class:`LlamaModel` (sliding_window set)."""
+    return _load(model_name_or_path, config_from_hf,
+                 params_from_hf_state_dict, **config_overrides)
+
+
+# ---------------------------------------------------------------------------
+# Mixtral — Llama attention + block-sparse MoE experts
+# ---------------------------------------------------------------------------
+
+def config_from_hf_mixtral(hf_config: Any, **overrides):
+    from .mixtral import MixtralConfig
+
+    get = _getter(hf_config)
+    d = dict(
+        vocab_size=int(get("vocab_size")),
+        hidden_size=int(get("hidden_size")),
+        intermediate_size=int(get("intermediate_size")),
+        num_layers=int(get("num_hidden_layers")),
+        num_heads=int(get("num_attention_heads")),
+        num_kv_heads=int(get("num_key_value_heads",
+                             get("num_attention_heads"))),
+        max_seq_len=int(get("max_position_embeddings", 4096)),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        rms_norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        num_experts=int(get("num_local_experts", 8)),
+        top_k=int(get("num_experts_per_tok", 2)),
+    )
+    d.update(overrides)
+    return MixtralConfig(**d)
+
+
+def params_from_hf_mixtral_state_dict(state_dict: Dict[str, Any],
+                                      config: Any) -> Dict[str, Any]:
+    """HF ``MixtralForCausalLM`` state dict → stacked params: the dense
+    Llama attention mapping plus ``moe`` (router + expert-stacked FFN;
+    HF per-expert w1/w3/w2 = gate/up/down, each ``[I, H]``/``[H, I]``)."""
+    c = config
+    H, L, E = c.hidden_size, c.num_layers, c.num_experts
+    nh, nkv, hd = c.num_heads, c.num_kv_heads, c.hd
+
+    def w(name):
+        key = f"model.layers.{{i}}.{name}.weight"
+        return [_to_np(state_dict[key.format(i=i)]) for i in range(L)]
+
+    wq = np.stack([m.T.reshape(H, nh, hd) for m in w("self_attn.q_proj")])
+    wk = np.stack([m.T.reshape(H, nkv, hd) for m in w("self_attn.k_proj")])
+    wv = np.stack([m.T.reshape(H, nkv, hd) for m in w("self_attn.v_proj")])
+    wo = np.stack([m.T.reshape(nh, hd, H) for m in w("self_attn.o_proj")])
+    wg = np.stack([m.T for m in w("block_sparse_moe.gate")])  # [L, H, E]
+
+    def experts(proj):
+        out = []
+        for i in range(L):
+            per = [_to_np(state_dict[
+                f"model.layers.{i}.block_sparse_moe.experts.{e}."
+                f"{proj}.weight"]).T for e in range(E)]
+            out.append(np.stack(per))
+        return np.stack(out)
+
+    params = {
+        "embed": jnp.asarray(_to_np(state_dict["model.embed_tokens.weight"])),
+        "layers": {
+            "attn": {"wq": jnp.asarray(wq), "wk": jnp.asarray(wk),
+                     "wv": jnp.asarray(wv), "wo": jnp.asarray(wo)},
+            "moe": {
+                "wg": jnp.asarray(wg),
+                "w_gate": jnp.asarray(experts("w1")),  # [L, E, H, I]
+                "w_up": jnp.asarray(experts("w3")),    # [L, E, H, I]
+                "w_down": jnp.asarray(experts("w2")),  # [L, E, I, H]
+            },
+            "attn_norm": jnp.asarray(np.stack(w("input_layernorm"))),
+            "mlp_norm": jnp.asarray(np.stack(w("post_attention_layernorm"))),
+        },
+        "final_norm": jnp.asarray(_to_np(state_dict["model.norm.weight"])),
+    }
+    if not c.tie_embeddings:
+        key = ("lm_head.weight" if "lm_head.weight" in state_dict
+               else "model.embed_tokens.weight")
+        params["lm_head"] = jnp.asarray(_to_np(state_dict[key]).T)
+    return params
+
+
+def load_hf_mixtral(model_name_or_path: str, **config_overrides):
+    return _load(model_name_or_path, config_from_hf_mixtral,
+                 params_from_hf_mixtral_state_dict, **config_overrides)
+
+
+# ---------------------------------------------------------------------------
+# OPT — pre-LN decoder with learned positions (HF offset-2 table maps 1:1)
+# ---------------------------------------------------------------------------
+
+def config_from_hf_opt(hf_config: Any, **overrides):
+    from .opt import OPTConfig
+
+    get = _getter(hf_config)
+    if get("do_layer_norm_before", True) is False:
+        raise NotImplementedError(
+            "this OPT implementation is pre-LN; post-LN variants "
+            "(do_layer_norm_before=false, e.g. opt-350m) are not supported")
+    proj = get("word_embed_proj_dim")
+    if proj is not None and int(proj) != int(get("hidden_size")):
+        raise NotImplementedError(
+            f"word_embed_proj_dim {proj} != hidden_size "
+            f"{get('hidden_size')} (project_in/out variants like opt-350m "
+            "are not supported)")
+    d = dict(
+        vocab_size=int(get("vocab_size")),
+        hidden_size=int(get("hidden_size")),
+        ffn_dim=int(get("ffn_dim")),
+        num_layers=int(get("num_hidden_layers")),
+        num_heads=int(get("num_attention_heads")),
+        max_seq_len=int(get("max_position_embeddings", 2048)),
+    )
+    d.update(overrides)
+    return OPTConfig(**d)
+
+
+def params_from_hf_opt_state_dict(state_dict: Dict[str, Any],
+                                  config: Any) -> Dict[str, Any]:
+    """HF ``OPTForCausalLM`` state dict → stacked params.  HF's learned
+    position table already carries the legacy offset-2 rows, matching this
+    zoo's ``POSITION_OFFSET`` layout row-for-row."""
+    c = config
+    H, L = c.hidden_size, c.num_layers
+    nh, hd = c.num_heads, c.hd
+    pre = "model.decoder."
+
+    def w(name):
+        return [_to_np(state_dict[f"{pre}layers.{i}.{name}.weight"])
+                for i in range(L)]
+
+    def b(name):
+        return [_to_np(state_dict[f"{pre}layers.{i}.{name}.bias"])
+                for i in range(L)]
+
+    return {
+        "embed": jnp.asarray(_to_np(state_dict[pre + "embed_tokens.weight"])),
+        "pos_embed": jnp.asarray(
+            _to_np(state_dict[pre + "embed_positions.weight"])),
+        "layers": {
+            "attn": {
+                "wq": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd) for m in w("self_attn.q_proj")])),
+                "wk": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd) for m in w("self_attn.k_proj")])),
+                "wv": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd) for m in w("self_attn.v_proj")])),
+                "wo": jnp.asarray(np.stack(
+                    [m.T.reshape(nh, hd, H)
+                     for m in w("self_attn.out_proj")])),
+                "bq": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("self_attn.q_proj")])),
+                "bk": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("self_attn.k_proj")])),
+                "bv": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("self_attn.v_proj")])),
+                "bo": jnp.asarray(np.stack(b("self_attn.out_proj"))),
+            },
+            "mlp": {
+                "w_in": jnp.asarray(np.stack([m.T for m in w("fc1")])),
+                "b_in": jnp.asarray(np.stack(b("fc1"))),
+                "w_out": jnp.asarray(np.stack([m.T for m in w("fc2")])),
+                "b_out": jnp.asarray(np.stack(b("fc2"))),
+            },
+            "attn_ln_w": jnp.asarray(np.stack(w("self_attn_layer_norm"))),
+            "attn_ln_b": jnp.asarray(np.stack(b("self_attn_layer_norm"))),
+            "mlp_ln_w": jnp.asarray(np.stack(w("final_layer_norm"))),
+            "mlp_ln_b": jnp.asarray(np.stack(b("final_layer_norm"))),
+        },
+        "final_ln_w": jnp.asarray(
+            _to_np(state_dict[pre + "final_layer_norm.weight"])),
+        "final_ln_b": jnp.asarray(
+            _to_np(state_dict[pre + "final_layer_norm.bias"])),
+    }
+
+
+def load_hf_opt(model_name_or_path: str, **config_overrides):
+    return _load(model_name_or_path, config_from_hf_opt,
+                 params_from_hf_opt_state_dict, **config_overrides)
+
+
+# ---------------------------------------------------------------------------
+# BERT — post-LN encoder + tied MLM head
+# ---------------------------------------------------------------------------
+
+def config_from_hf_bert(hf_config: Any, **overrides):
+    from .bert import BertConfig
+
+    get = _getter(hf_config)
+    d = dict(
+        vocab_size=int(get("vocab_size")),
+        hidden_size=int(get("hidden_size")),
+        intermediate_size=int(get("intermediate_size")),
+        num_layers=int(get("num_hidden_layers")),
+        num_heads=int(get("num_attention_heads")),
+        max_seq_len=int(get("max_position_embeddings", 512)),
+        type_vocab_size=int(get("type_vocab_size", 2)),
+        layer_norm_eps=float(get("layer_norm_eps", 1e-12)),
+    )
+    d.update(overrides)
+    return BertConfig(**d)
+
+
+def params_from_hf_bert_state_dict(state_dict: Dict[str, Any],
+                                   config: Any) -> Dict[str, Any]:
+    """HF ``BertForMaskedLM`` state dict → stacked params (post-LN:
+    ``attention.output.LayerNorm``/``output.LayerNorm`` land on the
+    post-residual norms; the MLM decoder is tied to the word embedding,
+    with its standalone bias imported)."""
+    c = config
+    H, L = c.hidden_size, c.num_layers
+    nh, hd = c.num_heads, c.hd
+    enc = "bert.encoder.layer.{i}."
+
+    def w(name):
+        return [_to_np(state_dict[(enc + name + ".weight").format(i=i)])
+                for i in range(L)]
+
+    def b(name):
+        return [_to_np(state_dict[(enc + name + ".bias").format(i=i)])
+                for i in range(L)]
+
+    emb = "bert.embeddings."
+    return {
+        "embed": {
+            "word": jnp.asarray(
+                _to_np(state_dict[emb + "word_embeddings.weight"])),
+            "position": jnp.asarray(
+                _to_np(state_dict[emb + "position_embeddings.weight"])),
+            "token_type": jnp.asarray(
+                _to_np(state_dict[emb + "token_type_embeddings.weight"])),
+            "ln_w": jnp.asarray(_to_np(state_dict[emb + "LayerNorm.weight"])),
+            "ln_b": jnp.asarray(_to_np(state_dict[emb + "LayerNorm.bias"])),
+        },
+        "layers": {
+            "attn": {
+                "wq": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd)
+                     for m in w("attention.self.query")])),
+                "wk": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd)
+                     for m in w("attention.self.key")])),
+                "wv": jnp.asarray(np.stack(
+                    [m.T.reshape(H, nh, hd)
+                     for m in w("attention.self.value")])),
+                "wo": jnp.asarray(np.stack(
+                    [m.T.reshape(nh, hd, H)
+                     for m in w("attention.output.dense")])),
+                "bq": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("attention.self.query")])),
+                "bk": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("attention.self.key")])),
+                "bv": jnp.asarray(np.stack(
+                    [v.reshape(nh, hd) for v in b("attention.self.value")])),
+                "bo": jnp.asarray(np.stack(b("attention.output.dense"))),
+            },
+            "mlp": {
+                "w_in": jnp.asarray(np.stack(
+                    [m.T for m in w("intermediate.dense")])),
+                "b_in": jnp.asarray(np.stack(b("intermediate.dense"))),
+                "w_out": jnp.asarray(np.stack(
+                    [m.T for m in w("output.dense")])),
+                "b_out": jnp.asarray(np.stack(b("output.dense"))),
+            },
+            "attn_ln_w": jnp.asarray(np.stack(
+                w("attention.output.LayerNorm"))),
+            "attn_ln_b": jnp.asarray(np.stack(
+                b("attention.output.LayerNorm"))),
+            "mlp_ln_w": jnp.asarray(np.stack(w("output.LayerNorm"))),
+            "mlp_ln_b": jnp.asarray(np.stack(b("output.LayerNorm"))),
+        },
+        "mlm": {
+            "w": jnp.asarray(_to_np(
+                state_dict["cls.predictions.transform.dense.weight"]).T),
+            "b": jnp.asarray(_to_np(
+                state_dict["cls.predictions.transform.dense.bias"])),
+            "ln_w": jnp.asarray(_to_np(
+                state_dict["cls.predictions.transform.LayerNorm.weight"])),
+            "ln_b": jnp.asarray(_to_np(
+                state_dict["cls.predictions.transform.LayerNorm.bias"])),
+            "bias": jnp.asarray(_to_np(state_dict["cls.predictions.bias"])),
+        },
+    }
+
+
+def load_hf_bert(model_name_or_path: str, **config_overrides):
+    from transformers import BertForMaskedLM
+
+    return _load(model_name_or_path, config_from_hf_bert,
+                 params_from_hf_bert_state_dict, model_cls=BertForMaskedLM,
+                 **config_overrides)

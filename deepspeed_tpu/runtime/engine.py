@@ -154,26 +154,36 @@ class DeepSpeedEngine:
                     for m in ("embed_fwd", "decoder_layer", "head_loss",
                               "batch_labels")))
         self.last_pipe_stats = None  # set at trace time by _pp_1f1b_grads
-        if self._pp_1f1b and self.fp16_enabled:
-            log_dist("pipeline.schedule=1f1b does not compose with fp16 "
-                     "loss scaling yet — falling back to the GPipe "
-                     "(autodiff) schedule")
-            self._pp_1f1b = False
+        from ..parallel.mesh import AXIS_TENSOR as _AT
+
+        fallback_reason = None
         compressed_comm = (
             config.zero_optimization.zero_quantized_gradients
             or config.zero_optimization.zero_quantized_weights
             or (config.optimizer is not None
                 and "onebit" in config.optimizer.type.lower().replace("-",
                                                                       "")))
-        if self._pp_1f1b and compressed_comm:
-            log_dist("pipeline.schedule=1f1b does not compose with "
-                     "compressed-comm paths (1-bit/qwZ/qgZ) — falling back "
-                     "to the GPipe (autodiff) schedule")
+        if self._pp_1f1b and self.fp16_enabled:
+            fallback_reason = ("does not compose with fp16 loss scaling "
+                              "yet")
+        elif self._pp_1f1b and int(self.mesh.shape.get(_AT, 1)) > 1:
+            # XLA's SPMD partitioner CHECK-fails on the 1F1B partial-manual
+            # shard_map combined with tensor-axis GSPMD constraints inside
+            # (spmd_partitioner_util.cc partition-group mismatch, verified
+            # on jax 0.9 CPU).  GPipe-through-autodiff partitions fine and
+            # computes identical gradients, at a larger activation
+            # footprint.
+            fallback_reason = ("+ tensor parallelism trips an XLA "
+                              "partitioner limitation")
+        elif self._pp_1f1b and compressed_comm:
+            fallback_reason = ("does not compose with compressed-comm "
+                              "paths (1-bit/qwZ/qgZ)")
+        if fallback_reason is not None:
+            log_dist(f"pipeline.schedule=1f1b {fallback_reason} — falling "
+                     f"back to the GPipe (autodiff) schedule")
             self._pp_1f1b = False
-        if (pp > 1 and not self._pp_1f1b
-                and str(config.pipeline.schedule).lower() == "1f1b"
-                and not self.fp16_enabled and not compressed_comm):
-            # the fp16/compressed-comm fallbacks logged their own reason
+        elif (pp > 1 and not self._pp_1f1b
+              and str(config.pipeline.schedule).lower() == "1f1b"):
             log_dist("pipeline.schedule=1f1b needs the layer-streamable "
                      "module protocol (embed_fwd/decoder_layer/head_loss) "
                      "— running the module's own pipeline path instead")

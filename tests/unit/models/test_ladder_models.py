@@ -178,3 +178,125 @@ def test_hf_import_tied_embeddings(tiny_hf_checkpoint):
     ids = jnp.asarray([[1, 2, 3]])
     logits = model.forward(params, ids)
     assert logits.shape == (1, 3, 128)
+
+
+# ---------------------------------------------------------------------------
+# HF import breadth (VERDICT r3 item 9): Mistral / Mixtral / OPT / BERT
+# follow the same logits-match-torch pattern as Llama above
+# ---------------------------------------------------------------------------
+
+def test_hf_mistral_import_logits_match(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig as HFMistralConfig
+    from transformers import MistralForCausalLM
+
+    from deepspeed_tpu.models.hf_import import load_hf_mistral
+
+    hf_cfg = HFMistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=16,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = MistralForCausalLM(hf_cfg)
+    hf.save_pretrained(tmp_path)
+
+    config, params = load_hf_mistral(str(tmp_path), dtype=jnp.float32,
+                                     remat=False)
+    assert config.sliding_window == 16
+    model = LlamaModel(config)
+    ids = np.random.RandomState(5).randint(0, 128, size=(2, 10))
+    ours = model.forward(params, jnp.asarray(ids))
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_mixtral_import_logits_match(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig as HFMixtralConfig
+    from transformers import MixtralForCausalLM
+
+    from deepspeed_tpu.models import MixtralModel
+    from deepspeed_tpu.models.hf_import import load_hf_mixtral
+
+    hf_cfg = HFMixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = MixtralForCausalLM(hf_cfg)
+    hf.save_pretrained(tmp_path)
+
+    # generous capacity: HF routes every token to its top-k with no drops
+    config, params = load_hf_mixtral(str(tmp_path), dtype=jnp.float32,
+                                     remat=False, capacity_factor=100.0)
+    assert config.num_experts == 4 and config.top_k == 2
+    model = MixtralModel(config)
+    ids = np.random.RandomState(5).randint(0, 128, size=(2, 10))
+    ours = model.forward(params, jnp.asarray(ids))
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs,
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_hf_opt_import_logits_match(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import OPTConfig as HFOPTConfig
+    from transformers import OPTForCausalLM
+
+    from deepspeed_tpu.models import OPTModel
+    from deepspeed_tpu.models.hf_import import load_hf_opt
+
+    hf_cfg = HFOPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, word_embed_proj_dim=64)
+    torch.manual_seed(0)
+    hf = OPTForCausalLM(hf_cfg)
+    hf.save_pretrained(tmp_path)
+
+    config, params = load_hf_opt(str(tmp_path), dtype=jnp.float32,
+                                 remat=False)
+    model = OPTModel(config)
+    ids = np.random.RandomState(5).randint(0, 128, size=(2, 10))
+    ours = model.forward(params, jnp.asarray(ids))
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_bert_import_logits_match(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as HFBertConfig
+    from transformers import BertForMaskedLM
+
+    from deepspeed_tpu.models import BertModel
+    from deepspeed_tpu.models.hf_import import load_hf_bert
+
+    hf_cfg = HFBertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, type_vocab_size=2,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = BertForMaskedLM(hf_cfg)
+    hf.save_pretrained(tmp_path)
+
+    config, params = load_hf_bert(str(tmp_path), dtype=jnp.float32,
+                                  remat=False)
+    model = BertModel(config)
+    ids = np.random.RandomState(5).randint(0, 128, size=(2, 10))
+    ours = model.forward(params, jnp.asarray(ids))
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs,
+                               rtol=2e-4, atol=2e-4)

@@ -206,3 +206,62 @@ def test_compat_pipeline_engine_runs_schedule_at_pp2():
     losses_seq = [float(eng_seq.train_batch(batch=(x, x)))
                   for _ in range(5)]
     np.testing.assert_allclose(losses_pp, losses_seq, rtol=2e-4, atol=2e-5)
+
+
+def _build_relayout_engine(pp, tp, stage, schedule="1f1b"):
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+
+    groups.reset_mesh()
+    mesh = groups.initialize_mesh(
+        MeshLayout.infer(8, pp=pp, tp=tp, dp=8 // (pp * tp)))
+    cfg = LlamaConfig.tiny(num_layers=4, max_seq_len=32,
+                           dtype=jnp.float32, pp_microbatches=4)
+    model = LlamaModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 16,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": stage},
+                "pipeline": {"stages": pp, "schedule": schedule}})
+    return eng
+
+
+def _relayout_batch():
+    return {"input_ids": jnp.asarray(
+        np.random.RandomState(3).randint(0, 512, size=(16, 32)))}
+
+
+def test_universal_checkpoint_3d_relayout_to_pp_tp(tmp_path):
+    """Universal-checkpoint 3D relayout (VERDICT r3 item 8, reference
+    ``ds_to_universal`` role, SURVEY §5.4): save under dp8 ZeRO-3, resume
+    under pp2 x tp2 x dp2 ZeRO-1 with the training trace continuing
+    (orbax reshard-on-load owns the relayout).  The reverse direction is
+    its own test — one process can't host too many mesh programs (the
+    documented XLA-CPU limit, tests/run_suite.sh)."""
+    b = _relayout_batch()
+    src = _build_relayout_engine(pp=1, tp=1, stage=3)
+    [float(src.train_step(b)["loss"]) for _ in range(2)]
+    src.save_checkpoint(str(tmp_path / "a"))
+    ref_next = float(src.train_step(b)["loss"])
+
+    dst3d = _build_relayout_engine(pp=2, tp=2, stage=1)
+    dst3d.load_checkpoint(str(tmp_path / "a"))
+    got = float(dst3d.train_step(b)["loss"])
+    np.testing.assert_allclose(got, ref_next, rtol=3e-4)
+
+
+def test_universal_checkpoint_3d_relayout_to_dp(tmp_path):
+    """Reverse 3D relayout: save under pp2 x tp2 x dp2 ZeRO-1, resume
+    under dp8 ZeRO-3 — trace continues."""
+    b = _relayout_batch()
+    src = _build_relayout_engine(pp=2, tp=2, stage=1)
+    [float(src.train_step(b)["loss"]) for _ in range(2)]
+    src.save_checkpoint(str(tmp_path / "b"))
+    ref_next = float(src.train_step(b)["loss"])
+
+    back = _build_relayout_engine(pp=1, tp=1, stage=3)
+    back.load_checkpoint(str(tmp_path / "b"))
+    got = float(back.train_step(b)["loss"])
+    np.testing.assert_allclose(got, ref_next, rtol=3e-4)
