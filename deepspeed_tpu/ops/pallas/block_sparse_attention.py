@@ -18,12 +18,21 @@ live; round 2 shipped layout semantics but ran DENSE masked attention
 * Fully-masked query rows produce 0 (matching the dense path's explicit
   zeroing), via ``where(l > 0, acc / l, 0)``.
 
+The production TPU forward is the splash-style GATHER kernel
+(:func:`_bs_gather_kernel`): a (bh, q-block, live-s) grid whose K/V
+``BlockSpec`` index_map reads the scalar-prefetched live list, so each
+step DMAs ONLY its live k-block — HBM traffic O(live), VMEM O(block),
+sequence length unbounded.  (Round 3's dynamic-offset ``make_async_copy``
+gather crashed Mosaic; a data-dependent index_map is the supported way —
+the paged decode kernel gathers pages identically.)
+
 Backward (``custom_vjp``) auto-selects: an O(live) gathered-tile sparse
 backward (jnp: gather live k-blocks, softmax jacobian per tile,
 segment-sum scatter of dk/dv — 1.5-2.4x faster than the dense vjp for
 local-window layouts on v5e at S=4096) when ``max_live*2 <= nk``, else
 the dense masked vjp (a dense global row makes the padded form slower
-than dense).  A per-row-count Pallas bwd kernel is the round-4 item.
+than dense).  A per-row-count Pallas bwd kernel (the gather-forward
+pattern applied to dq/dk/dv) is the remaining item.
 """
 
 from __future__ import annotations
@@ -96,6 +105,47 @@ def _plan(layout: np.ndarray, S: int, block_q: int, block_k: int,
 # kernel
 # ---------------------------------------------------------------------------
 
+def _tile_update(q, kblk, vblk, cell, kj, qi, m, l, acc, *,
+                 block_q: int, block_k: int, cb: int, causal: bool):
+    """ONE live tile's online-softmax update — shared by the resident
+    (interpret) and gather (production) kernels so their numerics cannot
+    drift.  ``q`` is pre-scaled fp32; returns (m', l', acc')."""
+    qc, kc = block_q // cb, block_k // cb
+    # 0/1 expansion matmuls: keep = R @ cell @ K (an in-kernel kron;
+    # Mosaic rejects the naive broadcast+reshape-merge lowering)
+    ri = jax.lax.broadcasted_iota(jnp.int32, (block_q, qc), 0) // cb
+    rc = jax.lax.broadcasted_iota(jnp.int32, (block_q, qc), 1)
+    R = (ri == rc).astype(jnp.float32)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (kc, block_k), 0)
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (kc, block_k), 1) // cb
+    K = (ki == kcol).astype(jnp.float32)
+    keep_f = jax.lax.dot_general(
+        jax.lax.dot_general(R, cell, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32),
+        K, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    keep = keep_f > 0.5
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_off = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        keep = keep & (q_pos >= kj * block_k + k_off)
+
+    s_mat = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    s_mat = jnp.where(keep, s_mat, -1e30)
+    m_new = jnp.maximum(m, jnp.max(s_mat, axis=-1))
+    # explicit zeroing: a row whose every entry in this tile is masked
+    # must not accumulate exp(-1e30 - (-1e30)) = 1 garbage
+    p = jnp.where(keep, jnp.exp(s_mat - m_new[:, None]), 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        p, vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
                block_q: int, block_k: int, cb: int, H: int, scale: float,
                causal: bool):
@@ -105,11 +155,12 @@ def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
     the inner ``qi`` grid dim, so Pallas skips the re-fetch), and compute
     is O(live · block_k) per q-block instead of O(S).
 
-    NOTE a true splash-style HBM gather (DMA only live blocks, double
-    buffered) was implemented and reverted: dynamic-offset
-    ``make_async_copy`` from an HBM ref crashes this toolchain's Mosaic
-    (remote-compile 500 on ``tpu.memref_slice``); revisit when the
-    toolchain moves.  VMEM residency bounds S·d ≲ 2M elems per head."""
+    NOTE this resident kernel now serves interpret mode only — the
+    production TPU forward is :func:`_bs_gather_kernel`, whose
+    scalar-prefetched ``index_map`` realizes the splash-style gather
+    without the dynamic-offset ``make_async_copy`` that crashed Mosaic.
+    VMEM residency bounds this kernel to S·d ≲ 2M elems per head; the
+    gather kernel has no such bound."""
     from jax.experimental import pallas as pl
 
     bh = pl.program_id(0)
@@ -121,15 +172,6 @@ def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
 
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
 
-    # 0/1 expansion matmuls: keep = R @ cell @ K (an in-kernel kron;
-    # Mosaic rejects the naive broadcast+reshape-merge lowering)
-    ri = jax.lax.broadcasted_iota(jnp.int32, (block_q, qc), 0) // cb
-    rc = jax.lax.broadcasted_iota(jnp.int32, (block_q, qc), 1)
-    R = (ri == rc).astype(jnp.float32)
-    ki = jax.lax.broadcasted_iota(jnp.int32, (kc, block_k), 0)
-    kcol = jax.lax.broadcasted_iota(jnp.int32, (kc, block_k), 1) // cb
-    K = (ki == kcol).astype(jnp.float32)
-
     m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
@@ -140,31 +182,9 @@ def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
         kblk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
         vblk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
         cell = cells_ref[0, 0, s].astype(jnp.float32)  # [qc, kc]
-        keep_f = jax.lax.dot_general(
-            jax.lax.dot_general(R, cell, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32),
-            K, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        keep = keep_f > 0.5
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_off = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            keep = keep & (q_pos >= kj * block_k + k_off)
-
-        s_mat = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-        s_mat = jnp.where(keep, s_mat, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s_mat, axis=-1))
-        # explicit zeroing: a row whose every entry in this tile is masked
-        # must not accumulate exp(-1e30 - (-1e30)) = 1 garbage
-        p = jnp.where(keep, jnp.exp(s_mat - m_new[:, None]), 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        return _tile_update(q, kblk, vblk, cell, kj, qi, m, l, acc,
+                            block_q=block_q, block_k=block_k, cb=cb,
+                            causal=causal)
 
     m, l, acc = jax.lax.fori_loop(0, count, body, (m0, l0, acc0))
     l2 = l[:, None]
@@ -175,6 +195,117 @@ def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
+
+def _bs_gather_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref,
+                      o_ref, m_ref, l_ref, acc_ref, *, block_q: int,
+                      block_k: int, cb: int, H: int, scale: float,
+                      causal: bool, max_live: int):
+    """Splash-style GATHER forward: the grid walks (bh, q-block, live-s)
+    and the K/V BlockSpec's scalar-prefetched ``index_map`` DMAs ONLY the
+    live k-block for each step — HBM traffic is O(live · block_k) per
+    q-block and VMEM holds one block, so S is unbounded by VMEM
+    residency.  This is the Mosaic-safe realization of the round-3
+    "splash gather" (dynamic-offset ``make_async_copy`` crashed the
+    toolchain; a data-dependent ``index_map`` is exactly how the paged
+    decode kernel already gathers pages, so it compiles).  Online-softmax
+    state rides VMEM scratch across the s steps; padded steps (s ≥
+    count) repeat the last live index so their DMA is skipped by Pallas'
+    same-block elision and their compute by ``pl.when``."""
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    s = pl.program_id(2)
+    h_idx = jax.lax.rem(bh, H)
+    count = cnt_ref[h_idx, qi]
+    qc, kc = block_q // cb, block_k // cb
+    d = q_ref.shape[-1]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s < count)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # [block_q, d]
+        kblk = k_ref[0].astype(jnp.float32)           # [block_k, d]
+        vblk = v_ref[0].astype(jnp.float32)
+        kj = idx_ref[h_idx, qi, s]
+        cell = cells_ref[0, 0, 0].astype(jnp.float32)  # [qc, kc]
+        m_new, l_new, acc_new = _tile_update(
+            q, kblk, vblk, cell, kj, qi, m_ref[:, 0], l_ref[:, 0],
+            acc_ref[...], block_q=block_q, block_k=block_k, cb=cb,
+            causal=causal)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+        acc_ref[...] = acc_new
+
+    @pl.when(s == max_live - 1)
+    def _finalize():
+        l2 = l_ref[...]
+        o_ref[0] = jnp.where(
+            l2 > 0, acc_ref[...] / jnp.where(l2 > 0, l2, 1.0),
+            0.0).astype(o_ref.dtype)
+
+
+def _bs_fwd_gather(q, k, v, layout_key, causal, block_q, block_k, cb,
+                   interpret):
+    """Forward via :func:`_bs_gather_kernel` (same contract as
+    :func:`_bs_fwd`)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    layout = _layout_from_key(layout_key)
+    B, S, h, d = q.shape
+    H = layout.shape[0]
+    idx, counts, cells = _plan(layout, S, block_q, block_k, cb, causal)
+    max_live = idx.shape[2]
+    nq = S // block_q
+    qc, kc = block_q // cb, block_k // cb
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    Hl = h if H == h else 1
+    kern = functools.partial(_bs_gather_kernel, block_q=block_q,
+                             block_k=block_k, cb=cb, H=Hl,
+                             scale=1.0 / np.sqrt(d), causal=causal,
+                             max_live=max_live)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * h, nq, max_live),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, s, idx, cnt: (bh, qi, 0)),
+            # the splash gather: each grid step DMAs only ITS live block
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, idx, cnt:
+                         (bh, idx[jax.lax.rem(bh, Hl), qi, s], 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, idx, cnt:
+                         (bh, idx[jax.lax.rem(bh, Hl), qi, s], 0)),
+            pl.BlockSpec((1, 1, 1, qc, kc),
+                         lambda bh, qi, s, idx, cnt:
+                         (jax.lax.rem(bh, Hl), qi, s, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, s, idx, cnt: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+        interpret=bool(interpret),
+    )(jnp.asarray(idx), jnp.asarray(counts), qr, kr, vr, jnp.asarray(cells))
+    out = out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v)
+
 
 def _dense_reference(q, k, v, layout, cb, causal):
     from ..sparse_attention import block_layout_to_token_mask
@@ -201,11 +332,20 @@ def _norm_layout(layout: np.ndarray, h: int) -> np.ndarray:
     return layout
 
 
+def _select_fwd(interpret):
+    """The splash-style GATHER kernel is the production forward: it DMAs
+    only live k-blocks (HBM traffic O(live), VMEM O(block)), measured
+    ≥ the VMEM-resident kernel at every S and unbounded in sequence
+    length.  The resident kernel remains for interpret mode (its single
+    fori_loop interprets ~max_live× faster than the per-step grid)."""
+    return _bs_fwd if interpret else _bs_fwd_gather
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _bs_attention(q, k, v, layout_key, causal, block_q, block_k, cb,
                   interpret):
-    return _bs_fwd(q, k, v, layout_key, causal, block_q, block_k, cb,
-                   interpret)[0]
+    return _select_fwd(interpret)(q, k, v, layout_key, causal, block_q,
+                                  block_k, cb, interpret)[0]
 
 
 #: key → np layout (hashable indirection for custom_vjp); bounded LRU.
@@ -372,8 +512,9 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     ``nk`` and the padded form does more work than the dense vjp plus
     gather/scatter overhead (v5e, S=4096: local window L=3/nk=16 runs
     1.5-2.4x FASTER sparse; a global row making L=nk runs 0.68x) — the
-    dense masked vjp is the right backward there.  A per-row-count Pallas
-    bwd kernel is the round-4 item that removes this trade."""
+    dense masked vjp is the right backward there.  A per-row-count
+    Pallas bwd kernel (the gather-forward pattern applied to dq/dk/dv
+    accumulation) is the remaining item that removes this trade."""
     q, k, v = res
     layout = _layout_from_key(layout_key)
     S = q.shape[1]
@@ -390,7 +531,13 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     return vjp(do)
 
 
-_bs_attention.defvjp(_bs_fwd, _bs_bwd)
+def _bs_vjp_fwd(q, k, v, layout_key, causal, block_q, block_k, cb,
+                interpret):
+    return _select_fwd(interpret)(q, k, v, layout_key, causal, block_q,
+                                  block_k, cb, interpret)
+
+
+_bs_attention.defvjp(_bs_vjp_fwd, _bs_bwd)
 
 
 def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
