@@ -22,6 +22,7 @@ SHARDS=(
   "tests/unit/moe tests/unit/ops tests/unit/parallel"
   "tests/unit/runtime"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
+  "tests/unit/multiprocess"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
 )
 
