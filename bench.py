@@ -772,14 +772,26 @@ def main() -> None:
                                    num_sliding_window_blocks=5,
                                    num_global_blocks=1)
 
-        def _bench_attn(f, n=20):
-            o = f(qs, ks, vs)
+        def _bench_attn(f, n=5, reps=10):
+            # amortize dispatch: the tunnel's ~5ms per-call floor would
+            # otherwise swamp sub-ms kernel differences — chain `reps`
+            # applications inside ONE program via lax.scan (output feeds
+            # back as v, so steps can't be elided)
+            def chained(q, k, v):
+                def body(c, _):
+                    return (c[0], c[1], f(c[0], c[1], c[2]).astype(
+                        c[2].dtype)), None
+                (q_, k_, v_), _ = jax.lax.scan(body, (q, k, v), None,
+                                               length=reps)
+                return v_
+            g = jax.jit(chained)
+            o = g(qs, ks, vs)
             float(jnp.sum(o.astype(jnp.float32)))  # compile + fence
             t0 = time.perf_counter()
             for _ in range(n):
-                o = f(qs, ks, vs)
+                o = g(qs, ks, vs)
             float(jnp.sum(o.astype(jnp.float32)))  # real fence (tunnel)
-            return (time.perf_counter() - t0) / n
+            return (time.perf_counter() - t0) / (n * reps)
 
         t_dense = _bench_attn(jax.jit(
             lambda q, k, v: sparse_attention(q, k, v, bb, impl="dense")))

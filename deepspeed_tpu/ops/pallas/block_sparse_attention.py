@@ -18,13 +18,16 @@ live; round 2 shipped layout semantics but ran DENSE masked attention
 * Fully-masked query rows produce 0 (matching the dense path's explicit
   zeroing), via ``where(l > 0, acc / l, 0)``.
 
-The production TPU forward is the splash-style GATHER kernel
-(:func:`_bs_gather_kernel`): a (bh, q-block, live-s) grid whose K/V
-``BlockSpec`` index_map reads the scalar-prefetched live list, so each
-step DMAs ONLY its live k-block — HBM traffic O(live), VMEM O(block),
-sequence length unbounded.  (Round 3's dynamic-offset ``make_async_copy``
-gather crashed Mosaic; a data-dependent index_map is the supported way —
-the paged decode kernel gathers pages identically.)
+Two TPU forwards, selected by shape (:func:`_select_fwd`): the
+VMEM-resident kernel when a head's K/V fit VMEM (zero per-step transfer
+— fastest at short/medium S), and the splash-style GATHER kernel
+(:func:`_bs_gather_kernel`) beyond that bound: a (bh, q-block, live-s)
+grid whose K/V ``BlockSpec`` index_map reads the scalar-prefetched live
+list, so each step DMAs ONLY its live k-block — HBM traffic O(live),
+VMEM O(block), sequence length unbounded.  (Round 3's dynamic-offset
+``make_async_copy`` gather crashed Mosaic; a data-dependent index_map
+is the supported way — the paged decode kernel gathers pages
+identically.)
 
 Backward (``custom_vjp``) auto-selects: an O(live) gathered-tile sparse
 backward (jnp: gather live k-blocks, softmax jacobian per tile,
@@ -155,12 +158,11 @@ def _bs_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, cells_ref, o_ref, *,
     the inner ``qi`` grid dim, so Pallas skips the re-fetch), and compute
     is O(live · block_k) per q-block instead of O(S).
 
-    NOTE this resident kernel now serves interpret mode only — the
-    production TPU forward is :func:`_bs_gather_kernel`, whose
-    scalar-prefetched ``index_map`` realizes the splash-style gather
-    without the dynamic-offset ``make_async_copy`` that crashed Mosaic.
-    VMEM residency bounds this kernel to S·d ≲ 2M elems per head; the
-    gather kernel has no such bound."""
+    This kernel serves production traffic whenever a head's K/V fit the
+    VMEM budget (see :func:`_select_fwd` — zero per-step transfer makes
+    it fastest at short/medium S) and ALL interpret-mode runs.  Beyond
+    the VMEM bound (S·d > ``_RESIDENT_VMEM_ELEMS`` per plane) the
+    splash-style :func:`_bs_gather_kernel` takes over."""
     from jax.experimental import pallas as pl
 
     bh = pl.program_id(0)
@@ -332,20 +334,36 @@ def _norm_layout(layout: np.ndarray, h: int) -> np.ndarray:
     return layout
 
 
-def _select_fwd(interpret):
-    """The splash-style GATHER kernel is the production forward: it DMAs
-    only live k-blocks (HBM traffic O(live), VMEM O(block)), measured
-    ≥ the VMEM-resident kernel at every S and unbounded in sequence
-    length.  The resident kernel remains for interpret mode (its single
-    fori_loop interprets ~max_live× faster than the per-step grid)."""
-    return _bs_fwd if interpret else _bs_fwd_gather
+#: PER-PLANE element bound (S·d of K, same for V) for the resident
+#: kernel; K+V together then occupy up to 2x this.  2M elems/plane =
+#: 8 MiB/plane in bf16 — comfortably inside a v5e core's ~64 MiB VMEM
+#: alongside q/acc scratch, with headroom for fp32 inputs (2x bytes)
+_RESIDENT_VMEM_ELEMS = 2 * 1024 * 1024
+
+
+def _select_fwd(q, interpret):
+    """Shape-aware forward selection (measured on v5e):
+
+    * resident kernel — K/V DMA'd once per (batch·head) and kept in
+      VMEM; zero per-step transfer cost.  Fastest whenever S·d fits the
+      VMEM budget, and the only interpret-mode kernel (its fori_loop
+      interprets ~max_live× faster than the gather's per-step grid).
+    * gather kernel — per-step DMA of only the live k-block via the
+      scalar-prefetched index_map; HBM traffic O(live), VMEM O(block).
+      Takes over when K/V exceed VMEM residency (long sequences), where
+      the resident kernel cannot run at all.
+    """
+    S, d = q.shape[1], q.shape[3]
+    if interpret or S * d <= _RESIDENT_VMEM_ELEMS:
+        return _bs_fwd
+    return _bs_fwd_gather
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _bs_attention(q, k, v, layout_key, causal, block_q, block_k, cb,
                   interpret):
-    return _select_fwd(interpret)(q, k, v, layout_key, causal, block_q,
-                                  block_k, cb, interpret)[0]
+    return _select_fwd(q, interpret)(q, k, v, layout_key, causal, block_q,
+                                     block_k, cb, interpret)[0]
 
 
 #: key → np layout (hashable indirection for custom_vjp); bounded LRU.
@@ -533,8 +551,8 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
 
 def _bs_vjp_fwd(q, k, v, layout_key, causal, block_q, block_k, cb,
                 interpret):
-    return _select_fwd(interpret)(q, k, v, layout_key, causal, block_q,
-                                  block_k, cb, interpret)
+    return _select_fwd(q, interpret)(q, k, v, layout_key, causal, block_q,
+                                     block_k, cb, interpret)
 
 
 _bs_attention.defvjp(_bs_vjp_fwd, _bs_bwd)

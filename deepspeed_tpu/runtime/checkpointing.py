@@ -43,8 +43,40 @@ def _ckpt_engine_for(engine):
     return ceng
 
 
+def _globalize_tree(tree, mesh):
+    """Multi-controller: re-place host-local (single-device) leaves —
+    eager scalars like ``state.step`` or a restored optax ``count`` — as
+    mesh-replicated global arrays (same values on every process by the
+    SPMD contract).  Orbax cannot serialize host-local arrays in a
+    multi-host setting, and a committed single-device leaf poisons any
+    jit that also takes global arguments.  Jit-produced leaves already
+    carry global shardings and pass through."""
+    from ..parallel.mesh import global_put, replicated
+
+    rep = replicated(mesh)
+
+    def fix(x):
+        if (isinstance(x, jax.Array) and x.is_fully_addressable
+                and len(x.sharding.device_set) == 1):
+            return global_put(np.asarray(x), rep)
+        return x
+
+    return jax.tree.map(fix, tree)
+
+
+def _globalize_state(engine):
+    if jax.process_count() == 1 or getattr(engine, "mesh", None) is None:
+        return
+    engine.state = _globalize_tree(engine.state, engine.mesh)
+    infinity = getattr(engine, "infinity", None)
+    if infinity is not None:
+        infinity.res_opt_state = _globalize_tree(infinity.res_opt_state,
+                                                 engine.mesh)
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
+    _globalize_state(engine)
     tag = _tag_for(engine, tag)
     ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -147,6 +179,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ckpt_dir = os.path.abspath(os.path.join(load_dir, tag))
     # join any in-flight async save before reading (it may be this tag)
     _ckpt_engine_for(engine).wait()
+    _globalize_state(engine)  # restore targets must be globally shardable
 
     # Restore INTO the engine's current sharded layout: orbax reshards on
     # load, so a checkpoint written on a different mesh/world restores

@@ -142,12 +142,22 @@ def test_infinity_per_process_host_planes(tmp_path):
     single-controller caveat the round-3 verdict flagged), the device
     wire is assembled by an in-graph all-gather, and the trajectory
     matches the single-process streaming run of the same model."""
-    launch_ranks("worker_infinity.py", 2, str(tmp_path), timeout=600)
+    launch_ranks("worker_infinity.py", 2, str(tmp_path), timeout=600,
+                 extra_env={"T_CKPT": str(tmp_path / "inf_ckpt")})
     results = [json.load(open(tmp_path / f"inf_rank{r}.json"))
                for r in (0, 1)]
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
                                rtol=1e-6)
     assert results[0]["n_plane"] * 2 == results[0]["n_pad"]
+    # multi-process Infinity checkpoint: the gathered-plane save/re-sliced
+    # load continues the trajectory exactly
+    np.testing.assert_allclose(results[0]["resumed_loss"],
+                               results[0]["next_loss"], rtol=1e-5)
+    # gas>1 + global clipping stream under multi-process too
+    assert np.isfinite(results[0]["gas_loss"])
+    assert results[0]["gas_norm"] > 0
+    np.testing.assert_allclose(results[0]["gas_loss"],
+                               results[1]["gas_loss"], rtol=1e-6)
 
     # oracle: the same model streamed in ONE process on the fake-8 mesh
     code = f"""

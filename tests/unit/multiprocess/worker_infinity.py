@@ -54,7 +54,33 @@ def main() -> int:
 
     losses = [float(engine.train_step(local)["loss"]) for _ in range(3)]
 
+    # checkpoint round trip under REAL multi-process: the trunk save
+    # gathers each layer's partitioned planes across processes, and the
+    # load re-slices them — trajectory must continue exactly
+    engine.save_checkpoint(os.environ["T_CKPT"])
+    next_loss = float(engine.train_step(local)["loss"])
+
+    engine2, _, _, _ = dst.initialize(
+        model=LlamaModel(cfg, mesh=mesh),
+        model_parameters=LlamaModel(cfg, mesh=mesh).init_params(
+            jax.random.PRNGKey(7)), config=ds, mesh=mesh)
+    engine2.load_checkpoint(os.environ["T_CKPT"])
+    resumed_loss = float(engine2.train_step(local)["loss"])
+
+    # gas>1 under multi-process streaming: the micro split runs on the
+    # assembled GLOBAL batch (eager slicing follows global semantics)
+    ds_gas = dict(ds, gradient_accumulation_steps=2, gradient_clipping=0.5)
+    m3 = LlamaModel(cfg, mesh=mesh)
+    eng_gas, _, _, _ = dst.initialize(
+        model=m3, model_parameters=m3.init_params(jax.random.PRNGKey(1)),
+        config=ds_gas, mesh=mesh)
+    gas_metrics = eng_gas.train_step(local)
+    gas_loss = float(gas_metrics["loss"])
+    gas_norm = float(gas_metrics["grad_norm"])
+
     out = {"rank": rank, "losses": losses,
+           "next_loss": next_loss, "resumed_loss": resumed_loss,
+           "gas_loss": gas_loss, "gas_norm": gas_norm,
            "n_plane": int(sw.n_plane), "n_pad": int(sw.n_pad)}
     with open(os.path.join(os.environ["T_OUT"], f"inf_rank{rank}.json"),
               "w") as f:
