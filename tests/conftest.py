@@ -28,6 +28,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_finish(session):
+    """A single process cannot survive the whole suite: ~290 jit-heavy
+    tests reliably SIGABRT late in the run (XLA-CPU collective rendezvous
+    timeout — root cause documented in tests/run_suite.sh).  Warn anyone
+    who launched the full suite un-sharded so the eventual crash isn't a
+    mystery."""
+    if len(session.items) > 150:
+        import warnings
+
+        warnings.warn(
+            f"collected {len(session.items)} tests in ONE process — runs "
+            "this large can die in a late XLA-CPU SIGABRT (known runtime "
+            "issue, see tests/run_suite.sh). Use tests/run_suite.sh for "
+            "the full suite, or -m 'not slow' for the smoke tier.",
+            stacklevel=1)
+
+
 @pytest.fixture(autouse=True)
 def _reset_groups():
     from deepspeed_tpu.utils import groups
