@@ -66,21 +66,39 @@ class ReduceOp:
 class CommsLogger:
     """Counts collective calls and (eager path) wall time per op name.
 
-    Honesty note on the two paths: eager verbs record at *execution* time
-    (count/bytes/seconds are real).  The in-graph wrappers record at *trace*
-    time — a structural census of collectives per compiled program, not
-    per-step execution counts (XLA runs the compiled program without Python).
-    Use ``jax.profiler`` / xprof for true in-graph collective timing.
+    Three surfaces, mirroring what can honestly be measured where:
+
+    * eager verbs record at *execution* time (count/bytes/seconds real);
+    * in-graph wrappers always record a *trace-time* census (structural
+      collectives per compiled program — XLA runs without Python);
+    * with ``exec_counts=True``, in-graph wrappers ALSO attach an
+      effectful host callback that fires on every EXECUTION of the
+      compiled program — ``exec_summary()`` counts scale with runs (a
+      trace-time census cannot).  Counts are per LOCAL DEVICE SHARD per
+      run (an 8-device mesh bumps a collective 8× per step; multi-host,
+      each process counts its own shards) — divide by
+      ``jax.local_device_count()`` for per-step numbers.  Opt-in: each
+      callback is a device→host hop, meaningful overhead on
+      remote/tunneled platforms — a diagnostics switch, like the
+      reference's comms_logger.  Per-collective DEVICE timing still
+      comes from ``profiling/collective_trace.py``.
     """
 
     def __init__(self) -> None:
         self.enabled = False
         self.verbose = False
+        self.exec_counts = False
         self.stats: dict[str, dict[str, float]] = {}
+        self.exec_stats: dict[str, dict[str, float]] = {}
+        import threading
 
-    def configure(self, enabled: bool = True, verbose: bool = False) -> None:
+        self._exec_lock = threading.Lock()
+
+    def configure(self, enabled: bool = True, verbose: bool = False,
+                  exec_counts: bool = False) -> None:
         self.enabled = enabled
         self.verbose = verbose
+        self.exec_counts = exec_counts
 
     def record(self, name: str, nbytes: int, seconds: float = 0.0) -> None:
         if not self.enabled:
@@ -92,11 +110,42 @@ class CommsLogger:
         if self.verbose:
             logger.info(f"comm: {name} bytes={nbytes} time={seconds * 1e3:.3f}ms")
 
+    def record_exec(self, name: str, nbytes: int) -> None:
+        # gate at EXECUTION time too: probes baked into already-compiled
+        # programs must stop counting the moment the logger is disabled.
+        # Locked: unordered debug callbacks may fire concurrently from
+        # several device shards, and += is not atomic.
+        if not (self.enabled and self.exec_counts):
+            return
+        with self._exec_lock:
+            entry = self.exec_stats.setdefault(name,
+                                               {"count": 0, "bytes": 0})
+            entry["count"] += 1
+            entry["bytes"] += nbytes
+
+    def attach_exec_probe(self, name: str, x) -> None:
+        """Called from in-graph wrappers at trace time: plant an effectful
+        callback that bumps ``exec_stats`` on every EXECUTION of the
+        compiled program (jax.debug.callback is an effect, so it is
+        neither DCE'd nor cached away)."""
+        if not (self.enabled and self.exec_counts):
+            return
+        nbytes = _nbytes(x)
+        jax.debug.callback(
+            functools.partial(self.record_exec, name, nbytes))
+
     def summary(self) -> dict[str, dict[str, float]]:
         return self.stats
 
+    def exec_summary(self) -> dict[str, dict[str, float]]:
+        """Per-execution stats; counts are per local device shard per run
+        (see class docstring) — divide by ``jax.local_device_count()``
+        for per-step numbers."""
+        return self.exec_stats
+
     def reset(self) -> None:
         self.stats = {}
+        self.exec_stats = {}
 
 
 comms_logger = CommsLogger()
@@ -125,27 +174,32 @@ def _axis(group: Union[MeshAxisGroup, AxisName, None]) -> AxisName:
 def psum(x, group: Union[MeshAxisGroup, AxisName, None] = None):
     axis = _axis(group)
     comms_logger.record("psum", _nbytes(x))
+    comms_logger.attach_exec_probe("psum", x)
     return jax.lax.psum(x, axis_name=axis)
 
 
 def pmean(x, group: Union[MeshAxisGroup, AxisName, None] = None):
     axis = _axis(group)
     comms_logger.record("pmean", _nbytes(x))
+    comms_logger.attach_exec_probe("pmean", x)
     return jax.lax.pmean(x, axis_name=axis)
 
 
 def pmax(x, group=None):
     comms_logger.record("pmax", _nbytes(x))
+    comms_logger.attach_exec_probe("pmax", x)
     return jax.lax.pmax(x, axis_name=_axis(group))
 
 
 def all_gather_in_graph(x, group=None, axis: int = 0, tiled: bool = True):
     comms_logger.record("all_gather", _nbytes(x))
+    comms_logger.attach_exec_probe("all_gather", x)
     return jax.lax.all_gather(x, axis_name=_axis(group), axis=axis, tiled=tiled)
 
 
 def reduce_scatter_in_graph(x, group=None, scatter_dimension: int = 0, tiled: bool = True):
     comms_logger.record("reduce_scatter", _nbytes(x))
+    comms_logger.attach_exec_probe("reduce_scatter", x)
     return jax.lax.psum_scatter(
         x, axis_name=_axis(group), scatter_dimension=scatter_dimension, tiled=tiled)
 
@@ -154,6 +208,7 @@ def all_to_all_in_graph(x, group=None, split_axis: int = 0, concat_axis: int = 0
                         tiled: bool = True):
     """Ulysses/MoE workhorse — first-class on ICI."""
     comms_logger.record("all_to_all", _nbytes(x))
+    comms_logger.attach_exec_probe("all_to_all", x)
     return jax.lax.all_to_all(
         x, axis_name=_axis(group), split_axis=split_axis,
         concat_axis=concat_axis, tiled=tiled)
@@ -162,6 +217,7 @@ def all_to_all_in_graph(x, group=None, split_axis: int = 0, concat_axis: int = 0
 def ppermute(x, perm: Sequence[Tuple[int, int]], group=None):
     """Pipeline P2P: send/recv pairs as a collective-permute (ICI-native)."""
     comms_logger.record("ppermute", _nbytes(x))
+    comms_logger.attach_exec_probe("ppermute", x)
     return jax.lax.ppermute(x, axis_name=_axis(group), perm=list(perm))
 
 

@@ -125,6 +125,10 @@ class AioConfig(DeepSpeedConfigModel):
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
+    #: also count in-graph collectives per EXECUTION via effectful host
+    #: callbacks (per-local-shard counts; measurable overhead — see
+    #: comm.CommsLogger)
+    exec_counts: bool = False
     prof_all: bool = True
     prof_ops: List[str] = Field(default_factory=list)
     debug: bool = False

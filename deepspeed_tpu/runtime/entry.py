@@ -100,7 +100,9 @@ def initialize(args: Any = None,
     cfg.resolve_auto_precision()
 
     if cfg.comms_logger.enabled:
-        comm.comms_logger.configure(enabled=True, verbose=cfg.comms_logger.verbose)
+        comm.comms_logger.configure(
+            enabled=True, verbose=cfg.comms_logger.verbose,
+            exec_counts=cfg.comms_logger.exec_counts)
 
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time

@@ -114,3 +114,37 @@ def test_profile_collectives_device_table():
     assert any("psum" in k or "all-reduce" in k for k in table)
     for entry in table.values():
         assert entry["count"] >= 1 and entry["total_us"] >= 0.0
+
+
+def test_comms_logger_execution_counts():
+    """exec_counts=True plants effectful callbacks so in-graph collectives
+    are counted per EXECUTION (trace-time census stays a per-program
+    structural count) — round-3 weak item 5."""
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    mesh = groups.initialize_mesh(MeshLayout.infer(8, dp=8))
+    comms_logger.reset()
+    comms_logger.configure(enabled=True, exec_counts=True)
+    try:
+        @jax.jit
+        def step(x):
+            def local(v):
+                return comm.psum(v, group="data")
+            from jax.sharding import PartitionSpec as P
+
+            return jax.shard_map(local, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"),
+                                 check_vma=False)(x)
+
+        x = jnp.arange(8.0)
+        for _ in range(3):
+            jax.block_until_ready(step(x))
+        trace = comms_logger.summary()["psum"]["count"]
+        execd = comms_logger.exec_summary()["psum"]["count"]
+        assert trace == 1, trace       # one compiled program
+        # one callback per device shard per run on the fake-8 mesh; the
+        # invariant that matters: execution count scales with RUNS
+        assert execd >= 3 and execd % 3 == 0, execd
+    finally:
+        comms_logger.configure(enabled=False)
+        comms_logger.reset()
