@@ -141,11 +141,20 @@ class CommsLogger:
         """Per-execution stats; counts are per local device shard per run
         (see class docstring) — divide by ``jax.local_device_count()``
         for per-step numbers."""
+        try:
+            # debug callbacks are asynchronous; flush in-flight effects so
+            # the summary reflects every completed run
+            jax.effects_barrier()
+        except Exception:
+            pass
         return self.exec_stats
 
     def reset(self) -> None:
         self.stats = {}
-        self.exec_stats = {}
+        with self._exec_lock:
+            # same lock the execution probes take: a concurrent callback
+            # must not land its increment in an abandoned dict
+            self.exec_stats = {}
 
 
 comms_logger = CommsLogger()

@@ -291,6 +291,8 @@ class DeepSpeedEngine:
             log_dist(f"qgZ: DP grad reduction wire bytes {f/2**20:.1f} MiB "
                      f"→ {q/2**20:.1f} MiB per step ({f/q:.1f}× reduction)")
         self._train_step_fn = None  # compiled lazily (first call)
+        #: forced-partial-boundary programs, keyed by microbatch count
+        self._partial_step_fns: Dict[int, Any] = {}
         self._warmup_step_fn = None  # 1-bit warmup variant
         self._eval_loss_fn = None
 
@@ -991,19 +993,32 @@ class DeepSpeedEngine:
                  jax.tree.map(lambda *xs: jnp.concatenate(xs), *buffered))
         if n == self.gradient_accumulation_steps:
             return self.train_step(batch)
-        # partial accumulation (forced boundary): rebuild the step for n
+        # partial accumulation (forced boundary): the program bakes GAS
+        # in, so n needs its own — built once per distinct n and CACHED
+        # (round-3 weak item 7: a workload that forces the same partial
+        # boundary every epoch must not pay a recompile each time)
         logger.warning(f"stepping with {n} buffered microbatches "
                        f"(configured GAS={self.gradient_accumulation_steps})")
         saved_gas, saved_fn = self.gradient_accumulation_steps, self._train_step_fn
         saved_warm = self._warmup_step_fn
         saved_ltd = self._ltd_fns
+        saved_inf_gas = self.infinity.gas if self.infinity is not None else None
         self.gradient_accumulation_steps = n
-        self._train_step_fn = self._warmup_step_fn = None
-        self._ltd_fns = {}  # LTD programs bake GAS in too
+        if self.infinity is not None:
+            self.infinity.gas = n  # the streaming executor baked its own
+        # every GAS-baking program family gets a per-n cache entry —
+        # warmup (1-bit) and LTD programs recompile per n too
+        cached = self._partial_step_fns.get(n, (None, None, {}))
+        self._train_step_fn, self._warmup_step_fn, self._ltd_fns = cached
         try:
             return self.train_step(batch)
         finally:
+            self._partial_step_fns[n] = (self._train_step_fn,
+                                         self._warmup_step_fn,
+                                         self._ltd_fns)
             self.gradient_accumulation_steps = saved_gas
+            if self.infinity is not None:
+                self.infinity.gas = saved_inf_gas
             self._train_step_fn = saved_fn
             self._warmup_step_fn = saved_warm
             self._ltd_fns = saved_ltd

@@ -223,3 +223,30 @@ def test_checkpoint_reshard_across_stages(tmp_path):
     for a, b in zip(jax.tree.leaves(ref),
                     jax.tree.leaves(jax.tree.map(np.asarray, e0.state.params))):
         np.testing.assert_array_equal(a, b)
+
+
+def test_forced_partial_boundary_caches_program():
+    """A forced partial accumulation boundary compiles its own program
+    once per distinct microbatch count and reuses it afterwards — the
+    recompile-per-occurrence cliff (round-3 weak 7) is gone."""
+    loss_fn, params, data = make_problem()
+    eng, _, _, _ = dst.initialize(
+        model=loss_fn, model_parameters=params,
+        config=base_config(gradient_accumulation_steps=4))
+    micro = jax.tree.map(lambda x: x[:8], data)
+
+    def partial_step(n):
+        for _ in range(n):
+            eng.backward(eng.forward(micro))
+        eng.set_gradient_accumulation_boundary(True)
+        eng.step()
+        eng.set_gradient_accumulation_boundary(False)
+
+    partial_step(2)
+    assert 2 in eng._partial_step_fns
+    first = eng._partial_step_fns[2][0]
+    assert first is not None
+    partial_step(2)
+    assert eng._partial_step_fns[2][0] is first  # reused, not rebuilt
+    # the full-GAS program is untouched by partial stepping
+    assert eng.gradient_accumulation_steps == 4
