@@ -151,6 +151,12 @@ class CommsLogger:
 
     def reset(self) -> None:
         self.stats = {}
+        try:
+            # flush in-flight callbacks first, or counts from PRE-reset
+            # runs would land in the fresh dict after the swap
+            jax.effects_barrier()
+        except Exception:
+            pass
         with self._exec_lock:
             # same lock the execution probes take: a concurrent callback
             # must not land its increment in an abandoned dict

@@ -209,3 +209,75 @@ def test_gather_forward_matches_dense_reference():
                                     cfg.block, True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bucketed_backward_matches_dense_global_rows(causal):
+    """The per-row-count bucketed backward handles layouts WITH dense
+    global rows (the case the padded form had to punt to the dense vjp):
+    gradients match the dense masked reference exactly, per head."""
+    import importlib
+
+    bsa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.block_sparse_attention")
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+
+    rng = np.random.default_rng(3)
+    B, S, h, d = 2, 1024, 4, 32
+    q = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    do = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    cfg = BigBirdSparsityConfig(num_heads=h, block=32, num_global_blocks=2,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3)
+    layout = bsa._norm_layout(cfg.make_layout(S), h)
+    # this layout has global rows/cols: max_live*2 > nk (the old gate's
+    # dense-fallback territory) but overall live fraction is sparse
+    idx, counts, _ = bsa._plan(layout, S, 64, 64, cfg.block, causal)
+    assert idx.shape[2] * 2 > (S // 64)  # old gate would punt to dense
+    assert counts.sum() / counts.size / (S // 64) <= 0.5  # yet sparse
+
+    got = bsa._sparse_bwd_bucketed(q, k, v, do, layout, cfg.block, causal,
+                                   64, 64)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(bsa._dense_reference(q_, k_, v_, layout, cfg.block,
+                                            causal) * do)
+
+    want = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bucketed_backward_selected_for_global_row_layouts():
+    """_bs_bwd routes global-row layouts through the bucketed backward
+    (they previously fell back to the dense vjp)."""
+    import importlib
+    from unittest import mock
+
+    bsa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.block_sparse_attention")
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+
+    rng = np.random.default_rng(0)
+    B, S, h, d = 1, 512, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+    cfg = BigBirdSparsityConfig(num_heads=h, block=32, num_global_blocks=2,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3)
+    layout = bsa._norm_layout(cfg.make_layout(S), h)
+    key = (layout.tobytes(), layout.shape, layout.dtype.str)
+    bsa._LAYOUTS[key] = layout
+
+    with mock.patch.object(bsa, "_sparse_bwd_bucketed",
+                           wraps=bsa._sparse_bwd_bucketed) as spy:
+        def loss(q_, k_, v_):
+            return jnp.sum(bsa._bs_attention(q_, k_, v_, key, True, 64, 64,
+                                             cfg.block, True) ** 2)
+
+        jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        assert spy.called
