@@ -799,7 +799,48 @@ def main() -> None:
             lambda q, k, v: block_sparse_attention(q, k, v, bb)))
         extras.setdefault("variants", {})["block_sparse_speedup_s4096"] = \
             round(t_dense / t_sparse, 2)
-        del qs, ks, vs
+        # long-context comparison — the block-sparse kernels' real value
+        # is where dense S² attention stops being viable.  Baseline is
+        # dense causal FLASH (what you'd run without sparse support) at
+        # S=8192 with a representative 64-cell BigBird; the gather kernel
+        # also runs S=32k+ where both dense paths cannot.  (The cb=16
+        # config above coarsens near-dense at kernel granularity and
+        # auto-dispatch correctly picks the dense path — speedup ~1.0.)
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        S8 = 8192
+        q8 = jnp.asarray(rng.randn(1, S8, hb, db)).astype(jnp.bfloat16)
+        k8 = jnp.asarray(rng.randn(1, S8, hb, db)).astype(jnp.bfloat16)
+        v8 = jnp.asarray(rng.randn(1, S8, hb, db)).astype(jnp.bfloat16)
+        bb64 = BigBirdSparsityConfig(num_heads=hb, block=64,
+                                     num_random_blocks=1,
+                                     num_sliding_window_blocks=3,
+                                     num_global_blocks=1)
+
+        def _bench_attn8(f, n=4, reps=10):
+            def chained(q, k, v):
+                def body(c, _):
+                    return (c[0], c[1], f(c[0], c[1], c[2]).astype(
+                        c[2].dtype)), None
+                (a, b, v_), _ = jax.lax.scan(body, (q, k, v), None,
+                                             length=reps)
+                return v_
+            g = jax.jit(chained)
+            float(jnp.sum(g(q8, k8, v8).astype(jnp.float32)))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                o = g(q8, k8, v8)
+            float(jnp.sum(o.astype(jnp.float32)))
+            return (time.perf_counter() - t0) / (n * reps)
+
+        t_flash8 = _bench_attn8(
+            lambda q, k, v: flash_attention(q, k, v, True))
+        t_sparse8 = _bench_attn8(
+            lambda q, k, v: block_sparse_attention(q, k, v, bb64,
+                                                   causal=True))
+        extras["variants"]["block_sparse_vs_flash_s8192"] = \
+            round(t_flash8 / t_sparse8, 2)
+        del qs, ks, vs, q8, k8, v8
         free_hbm()
     except Exception as e:
         free_hbm()

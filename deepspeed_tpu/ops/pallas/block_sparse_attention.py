@@ -523,6 +523,25 @@ def _sparse_bwd_tiles(q, k, v, do, layout, cb, causal, block_q, block_k):
 
 
 
+def _live_fraction(counts: np.ndarray, S: int, block_q: int,
+                   block_k: int, causal: bool) -> float:
+    """Live kernel-block fraction of the ACHIEVABLE area — causal layouts
+    are normalized by the tril'd block count (``_plan`` already trils the
+    layout, so a full-grid denominator would undercount causal density by
+    ~2x and miscalibrate both dispatch gates)."""
+    H, nq = counts.shape
+    nk = S // block_k
+    if causal:
+        achievable = sum(min(nk, -(-((qi + 1) * block_q) // block_k))
+                         for qi in range(nq)) * H
+    else:
+        achievable = H * nq * nk
+    return float(counts.sum()) / float(max(achievable, 1))
+
+
+_BWD_BUCKET_CACHE: OrderedDict = OrderedDict()
+
+
 def _bwd_buckets(layout: np.ndarray, S: int, block_q: int, block_k: int,
                  cb: int, causal: bool):
     """Host-side bucket plan for the per-row-count backward: rows (one per
@@ -530,6 +549,11 @@ def _bwd_buckets(layout: np.ndarray, S: int, block_q: int, block_k: int,
     power of two — a dense global row lands in its own deep bucket and no
     longer pads every other row to its depth.  ≤ log2(nk)+1 buckets, so
     the compile count stays bounded."""
+    ck = (layout.tobytes(), layout.shape, S, block_q, block_k, cb, causal)
+    hit = _BWD_BUCKET_CACHE.get(ck)
+    if hit is not None:
+        _BWD_BUCKET_CACHE.move_to_end(ck)
+        return hit
     idx, counts, cells = _plan(layout, S, block_q, block_k, cb, causal)
     H, nq, L = idx.shape
     buckets: dict = {}
@@ -547,7 +571,11 @@ def _bwd_buckets(layout: np.ndarray, S: int, block_q: int, block_k: int,
     for lb in sorted(buckets):
         rows = np.asarray(buckets[lb], np.int32)
         out.append((lb, rows[:, 0], rows[:, 1]))
-    return idx, counts, cells, out
+    result = (idx, counts, cells, out)
+    _BWD_BUCKET_CACHE[ck] = result
+    while len(_BWD_BUCKET_CACHE) > _PLAN_CACHE_MAX:
+        _BWD_BUCKET_CACHE.popitem(last=False)
+    return result
 
 
 def _sparse_bwd_bucketed(q, k, v, do, layout, cb, causal, block_q, block_k):
@@ -658,13 +686,11 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     layout = _layout_from_key(layout_key)
     S = q.shape[1]
     _, counts, _ = _plan(layout, S, block_q, block_k, cb, causal)
-    H, nq = counts.shape
-    nk = S // block_k
     # the bucketed backward's work is the TRUE live area (each row pays
     # its own depth), so the only reason to fall back to the dense vjp is
     # a layout that is mostly live anyway — there the gather/scatter
     # overhead buys nothing
-    live_frac = float(counts.sum()) / float(H * nq * nk)
+    live_frac = _live_fraction(counts, S, block_q, block_k, causal)
     if live_frac <= 0.5:
         _, _, _, buckets = _bwd_buckets(layout, S, block_q, block_k, cb,
                                         causal)
@@ -722,6 +748,20 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if not (fits(block_q) and fits(block_k)):
         return _dense_reference(q, k, v, layout, cb, causal)
 
+    # fine-celled layouts can coarsen to near-dense at kernel-block
+    # granularity (a 256-token block is live if ANY of its 16-token cells
+    # is) — when most kernel blocks are live, the dense masked path's big
+    # fused matmuls beat the tile loop (measured: cb=16 BigBird at S=4096
+    # coarsens to 0.92 live and dense wins 2x).  Auto-dispatch exists to
+    # pick the fastest correct impl, so route those to dense — but NOT
+    # in interpret mode (that flag means "exercise the kernel", and the
+    # kernel tests' tiny grids coarsen dense), and NOT at long S, where
+    # the dense path's O(S^2) logits/mask stop being materializable.
+    _, counts, _ = _plan(layout, S, block_q, block_k, cb, causal)
+    if (not interpret and S <= 8192
+            and _live_fraction(counts, S, block_q, block_k,
+                               causal) > 0.6):
+        return _dense_reference(q, k, v, layout, cb, causal)
     key = (layout.tobytes(), layout.shape, layout.dtype.str)
     _LAYOUTS[key] = layout
     _LAYOUTS.move_to_end(key)

@@ -262,7 +262,7 @@ def test_bucketed_backward_selected_for_global_row_layouts():
     from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
 
     rng = np.random.default_rng(0)
-    B, S, h, d = 1, 512, 2, 32
+    B, S, h, d = 1, 2048, 2, 32
     q = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
     k = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
