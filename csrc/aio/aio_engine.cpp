@@ -215,6 +215,20 @@ struct Handle {
       if (body > 0)
         rc = direct_body(dfd, op.kind, p, body, op.offset, *bounce);
       ::close(dfd);
+      if (rc != 0 && op.kind == Op::READ) {
+        // the overshoot decision was taken at open time; a concurrent
+        // whole-file rewrite to the exact logical size (dropping only the
+        // alignment overshoot) between fstat and the final pread makes
+        // the direct read come up short.  Reads are idempotent — retry
+        // the whole request buffered.  A file shrunk below
+        // offset+nbytes still fails (buffered_body errors at EOF): the
+        // requested bytes genuinely don't exist.
+        int rfd = ::open(op.path.c_str(), base, 0644);
+        if (rfd < 0) return -1;
+        rc = buffered_body(rfd, op.kind, p, op.nbytes, op.offset);
+        ::close(rfd);
+        return rc;
+      }
       if (rc == 0 && tail > 0) {
         int tfd = ::open(op.path.c_str(), base, 0644);
         if (tfd < 0) return -1;

@@ -144,13 +144,21 @@ class DeepSpeedAccelerator(abc.ABC):
         def _drain():
             # XLA dispatch is async: a host timestamp taken without
             # draining outstanding device work measures dispatch latency,
-            # not execution.  Block on a trivial computation (same fence
-            # as the accelerator's synchronize()) before stamping.
+            # not execution.  FETCH a freshly computed scalar (device
+            # round-trip through execution) rather than block_until_ready
+            # — on the tunneled platform block_until_ready returns
+            # immediately (see bench.py block()), while a device_get
+            # completes only after this program executes, which per-device
+            # in-order execution sequences after all previously dispatched
+            # work.  An Event holds no handle on that work, so the
+            # in-order-execution assumption (true of XLA's per-device
+            # streams) is what makes this independent fetch a fence.
             try:
                 import jax
                 import jax.numpy as jnp
+                import numpy as _np
 
-                jnp.zeros(()).block_until_ready()
+                _np.asarray(jnp.zeros(()) + 1.0)
                 jax.effects_barrier()
             except Exception:
                 pass  # no device / not initialized — host-only semantics

@@ -127,7 +127,16 @@ class CommsLogger:
         """Called from in-graph wrappers at trace time: plant an effectful
         callback that bumps ``exec_stats`` on every EXECUTION of the
         compiled program (jax.debug.callback is an effect, so it is
-        neither DCE'd nor cached away)."""
+        neither DCE'd nor cached away).
+
+        The enable decision is baked in at TRACE time: programs compiled
+        while ``exec_counts`` was off carry no probe and are not
+        retrofitted when it is later enabled (only the disable direction
+        is dynamic, via the exec-time gate in :meth:`record_exec`).
+        Configure ``exec_counts=True`` before first compile of anything
+        you want counted — planting callbacks unconditionally would tax
+        every program with a device→host hop even when diagnostics are
+        off, the wrong default on tunneled platforms."""
         if not (self.enabled and self.exec_counts):
             return
         nbytes = _nbytes(x)

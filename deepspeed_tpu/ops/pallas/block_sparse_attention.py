@@ -372,6 +372,12 @@ def _bs_attention(q, k, v, layout_key, causal, block_q, block_k, cb,
 _LAYOUTS: OrderedDict = OrderedDict()
 _LAYOUTS_MAX = 32
 
+# longest S at which the dense path's O(S^2) logits/mask are still
+# materializable on v5e HBM — beyond it, forward AND backward must route
+# to the sparse kernels regardless of live fraction (one constant so a
+# retune cannot desynchronize the two dispatch sites)
+_DENSE_DISPATCH_MAX_S = 8192
+
 
 def _layout_from_key(key) -> np.ndarray:
     cached = _LAYOUTS.get(key)
@@ -691,7 +697,11 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     # a layout that is mostly live anyway — there the gather/scatter
     # overhead buys nothing
     live_frac = _live_fraction(counts, S, block_q, block_k, causal)
-    if live_frac <= 0.5:
+    # beyond _DENSE_DISPATCH_MAX_S the dense vjp's O(S^2) logits stop
+    # being materializable, so the bucketed form runs regardless of live
+    # fraction (a 0.6-live S=32k layout must not OOM in backward when the
+    # forward deliberately routed it to the kernel)
+    if live_frac <= 0.5 or S > _DENSE_DISPATCH_MAX_S:
         _, _, _, buckets = _bwd_buckets(layout, S, block_q, block_k, cb,
                                         causal)
         if len(buckets) <= 1:
@@ -758,7 +768,7 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # kernel tests' tiny grids coarsen dense), and NOT at long S, where
     # the dense path's O(S^2) logits/mask stop being materializable.
     _, counts, _ = _plan(layout, S, block_q, block_k, cb, causal)
-    if (not interpret and S <= 8192
+    if (not interpret and S <= _DENSE_DISPATCH_MAX_S
             and _live_fraction(counts, S, block_q, block_k,
                                causal) > 0.6):
         return _dense_reference(q, k, v, layout, cb, causal)

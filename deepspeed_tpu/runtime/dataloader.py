@@ -98,6 +98,21 @@ class DeepSpeedDataLoader:
         import jax as _jax
         from jax.sharding import NamedSharding, PartitionSpec
 
+        # the fast path hands FULL-width local rows to the full sharding,
+        # so every non-batch dim must be entirely process-local: a mesh
+        # axis sharding e.g. the sequence dim across processes (ALST
+        # sp_shard_sequence on a multi-host seq axis) means this process's
+        # addressable block is narrower than the rows we'd build — fall
+        # back to the global_put path there.
+        mesh_devs = np.asarray(self.mesh.devices)
+        names = list(self.mesh.axis_names)
+        for entry in self.sharding.spec[1:]:
+            for a in ((entry,) if isinstance(entry, str) else (entry or ())):
+                moved = np.moveaxis(mesh_devs, names.index(a), 0)
+                for col in moved.reshape(moved.shape[0], -1).T:
+                    if len({d.process_index for d in col}) > 1:
+                        return None  # non-batch axis spans processes
+
         probe = NamedSharding(self.mesh, PartitionSpec(self.sharding.spec[0]))
         ivs = sorted({(sl[0].start or 0,
                        n if sl[0].stop is None else sl[0].stop)
