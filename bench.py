@@ -590,6 +590,67 @@ def _bench_llama8b_infinity(batch: int = 2, seq: int = 2048) -> dict:
     return result
 
 
+def _bench_infinity_sp_miniature() -> dict:
+    """Ladder config 5's COMPOSITION, miniature, on the real chip: Llama
+    trunk + Ulysses SP machinery (mesh-routed attention, SP dataloader
+    adapter, sequence-tiled loss) + ZeRO-Infinity layer streaming, all in
+    ONE run (VERDICT r4 item 1).
+
+    One physical chip means the seq axis is size 1 — the all-to-all is a
+    no-op here (``sp1_no_op: true`` in the result says so) — but every
+    composed code path executes end-to-end on TPU: the streamed per-layer
+    programs are the SAME jits the fake-8 dp2×sp2(×tp2) equality tests
+    (tests/unit/runtime/test_infinity_sp.py) and the ``infinity_sp``
+    dryrun layout prove correct at sp>1."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.runtime.sequence_parallel.ulysses_sp import (
+        UlyssesSPDataLoaderAdapter)
+    from deepspeed_tpu.utils import groups
+
+    if not CPUAdamBuilder.is_compatible():
+        raise RuntimeError("no g++ toolchain for the fused C++ Adam")
+    groups.reset_mesh()
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, sp=1))
+    batch, seq = 4, 1024
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                      intermediate_size=688, num_layers=3, num_heads=8,
+                      num_kv_heads=4, max_seq_len=seq, dtype=jnp.bfloat16,
+                      attn_impl="flash", loss_tiles=4)
+    model = LlamaModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ds = {"train_micro_batch_size_per_gpu": batch,
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "zero_optimization": {"stage": 3,
+                                "offload_param": {"device": "cpu"}},
+          "bf16": {"enabled": True}, "steps_per_print": 0}
+    eng, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                       config=ds, mesh=mesh)
+    assert eng.infinity is not None
+
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                           size=(batch, seq))
+    loader = UlyssesSPDataLoaderAdapter(
+        [{"input_ids": jnp.asarray(ids)}] * 4)
+    batches = list(loader)
+    eng.train_step(batches[0])  # warm every per-layer program
+    t0 = time.perf_counter()
+    steps = 2
+    for k in range(steps):
+        m = eng.train_step(batches[(k + 1) % len(batches)])
+    loss = float(m["loss"])  # fences the streamed tail
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(loss)
+    n_params = eng.infinity.total_param_count()
+    return {"tokens_per_sec": round(batch * seq / dt, 1),
+            "step_s": round(dt, 3), "loss": round(loss, 4),
+            "params": n_params, "layers": cfg.num_layers,
+            "sp1_no_op": True, "loss_tiles": cfg.loss_tiles}
+
+
 def main() -> None:
     from deepspeed_tpu.models import LlamaConfig
 
@@ -1019,6 +1080,18 @@ def main() -> None:
         extras.setdefault("variants", {})[
             "llama8b_infinity_error"] = str(e)[:300]
 
+    _mark("infinity_sp_miniature")
+    # -- ladder config 5's composition (Infinity × Ulysses SP) on-chip ----
+    try:
+        _budget_check()
+        extras.setdefault("variants", {})["llama_infinity_sp"] = \
+            _bench_infinity_sp_miniature()
+        extras["variants"]["llama_infinity_sp_tokens_per_sec"] = \
+            extras["variants"]["llama_infinity_sp"]["tokens_per_sec"]
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})[
+            "llama_infinity_sp_error"] = str(e)[:300]
 
     _mark("resnet_cifar")
     # -- driver ladder config 1: CIFAR ResNet-56, ZeRO-0 -------------------

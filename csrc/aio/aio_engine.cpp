@@ -78,6 +78,7 @@ struct Handle {
   std::atomic<int64_t> errors{0};
   std::atomic<int64_t> bytes_direct{0};
   std::atomic<int64_t> bytes_buffered{0};
+  std::atomic<int64_t> read_retries{0};
   bool shutdown = false;
 
   void worker() {
@@ -222,7 +223,11 @@ struct Handle {
         // the direct read come up short.  Reads are idempotent — retry
         // the whole request buffered.  A file shrunk below
         // offset+nbytes still fails (buffered_body errors at EOF): the
-        // requested bytes genuinely don't exist.
+        // requested bytes genuinely don't exist.  read_retries makes the
+        // degradation observable: a direct-path regression (EIO,
+        // alignment bug) that this retry would otherwise mask shows up
+        // as a climbing counter in ds_aio_stats.
+        read_retries.fetch_add(1);
         int rfd = ::open(op.path.c_str(), base, 0644);
         if (rfd < 0) return -1;
         rc = buffered_body(rfd, op.kind, p, op.nbytes, op.offset);
@@ -366,6 +371,12 @@ void ds_aio_stats(void* hp, int64_t* direct_bytes, int64_t* buffered_bytes) {
   Handle* h = (Handle*)hp;
   if (direct_bytes) *direct_bytes = h->bytes_direct.load();
   if (buffered_bytes) *buffered_bytes = h->bytes_buffered.load();
+}
+
+// Direct reads that degraded to the buffered fallback (shrink race, or a
+// masked direct-path failure) — should stay ~0 in healthy operation.
+int64_t ds_aio_read_retries(void* hp) {
+  return ((Handle*)hp)->read_retries.load();
 }
 
 }  // extern "C"

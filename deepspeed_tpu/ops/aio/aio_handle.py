@@ -36,6 +36,8 @@ class AIOHandle:
         self.lib.ds_aio_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64)]
+        self.lib.ds_aio_read_retries.argtypes = [ctypes.c_void_p]
+        self.lib.ds_aio_read_retries.restype = ctypes.c_int64
         self._h = self.lib.ds_aio_new(block_size, queue_depth,
                                       int(single_submit), int(overlap_events),
                                       thread_count)
@@ -79,7 +81,8 @@ class AIOHandle:
         d = ctypes.c_int64(0)
         b = ctypes.c_int64(0)
         self.lib.ds_aio_stats(self._h, ctypes.byref(d), ctypes.byref(b))
-        return {"direct_bytes": int(d.value), "buffered_bytes": int(b.value)}
+        return {"direct_bytes": int(d.value), "buffered_bytes": int(b.value),
+                "read_retries": int(self.lib.ds_aio_read_retries(self._h))}
 
     def __del__(self):
         try:

@@ -516,6 +516,23 @@ class LayerStreamingEngine:
         self.last_metrics = metrics
         return metrics
 
+    def sp_program_evidence(self, batch: Any) -> Dict[str, Any]:
+        """Evidence that Ulysses SP is live INSIDE the streamed per-layer
+        program: compiles layer 0's forward against a real embedded batch
+        and reports whether its HLO contains the all-to-all and how the
+        inter-layer activations are sharded.  Shared by the config-5
+        composition test and the ``infinity_sp`` dryrun layout so the
+        proof can't drift between the two."""
+        ids, _ = self.model.batch_labels(self._place_batch(batch))
+        x = self._fn("embed")(self.resident, ids)
+        sw = self.swapper
+        sw.prefetch(0)
+        lp = sw.get_device(0)
+        hlo = self._fn("layer_fwd").lower(lp, x).compile().as_text()
+        sw.release(0)
+        return {"all_to_all_in_layer_program": "all-to-all" in hlo,
+                "activation_spec": str(x.sharding.spec)}
+
     def eval_loss(self, batch: Any) -> jnp.ndarray:
         """Streamed forward-only loss (no grads, no update)."""
         sw = self.swapper
