@@ -26,6 +26,7 @@ The model contract is three pure fns (``LlamaModel`` implements it):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -154,11 +155,24 @@ class LayerStreamingEngine:
                         NamedSharding(mesh, strip_manual_axes(*s))),
                     views, _specs)
 
+        # Pipelined optimizer swapping (reference
+        # pipelined_optimizer_swapper.py) is the PRODUCTION DEFAULT: the
+        # host Adam runs in a worker thread behind device compute.  The
+        # reference gates it behind offload_optimizer.pipeline_read/write;
+        # here an explicitly-false pair opts out (and
+        # DS_INFINITY_SERIAL_OPT=1 is the debugging kill switch).
+        ocfg = zcfg.offload_optimizer
+        pipeline = True
+        if ocfg is not None and {
+                "pipeline_read", "pipeline_write"} & ocfg.model_fields_set:
+            pipeline = bool(ocfg.pipeline_read or ocfg.pipeline_write)
+        if os.environ.get("DS_INFINITY_SERIAL_OPT", "0") == "1":
+            pipeline = False  # the debugging kill switch beats any config
         self.swapper = PartitionedParamSwapper(
             layer_trees, wire_dtype=wire_dtype, nvme_path=nvme_path,
             buffer_count=int(getattr(pcfg, "buffer_count", 4) or 4),
             aio_config=config.aio, adam_hparams=hp, placement=placement,
-            shard=shard)
+            shard=shard, pipeline=pipeline)
         del layer_trees, layers
 
         if mesh is not None:
@@ -469,7 +483,9 @@ class LayerStreamingEngine:
                 acts[i] = None  # free the activation once consumed
                 if fused:
                     norm_sq_dev = norm_sq_dev + sq_norm(dlp)
-                    sw.step_layer(i, self._trunk_grads(dlp), lr=lr)
+                    # pipelined: the worker's d2h + C++ Adam hide behind
+                    # the remaining layers' backward on the device
+                    sw.step_layer_async(i, self._trunk_grads(dlp), lr=lr)
                 else:
                     sw.stash_grads(i, self._trunk_grads(dlp),
                                    accumulate=(k > 0))
@@ -500,7 +516,9 @@ class LayerStreamingEngine:
             sw.prefetch(0, full=True)
             for i in range(L):
                 sw.prefetch(i + 1, full=True)
-                sw.apply_stashed(i, lr=lr, scale=scale)
+                # pipelined: layer i's C++ Adam overlaps layer i+1's
+                # read-ahead (and, nvme tier, i-1's write-behind)
+                sw.apply_stashed_async(i, lr=lr, scale=scale)
 
         self.resident, self.res_opt_state = self._fn("res_update")(
             self.resident, self.res_opt_state, g_res_acc,

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import ctypes
 import os
+import queue
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -78,6 +80,60 @@ class _Planes:
         return self.g
 
 
+class _OptPipeline:
+    """Bounded single-worker pipeline hiding the host optimizer behind
+    device compute — the reference's
+    ``runtime/swap_tensor/pipelined_optimizer_swapper.py`` role.
+
+    The main thread submits (layer, grads, ...) right after dispatching
+    that layer's vjp; the d2h of the grads is started asynchronously AT
+    SUBMIT (``copy_to_host_async``), so while the worker runs layer i's
+    fused C++ Adam (ctypes releases the GIL — real CPU parallelism),
+    layer i-1's grads are in flight over DMA and the device is computing
+    layer i-2's backward.  Depth-bounded queue: at most ``depth`` layers
+    of grads stay live on device — depth-1 queued plus the one the worker
+    popped and is processing (the double-buffer memory contract)."""
+
+    def __init__(self, run, depth: int = 2):
+        self._run = run
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth - 1))
+        self._err: Optional[BaseException] = None
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="ds-opt-pipeline")
+        self._t.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                if self._err is None:  # after an error: drain, don't run
+                    self._run(*item)
+            except BaseException as e:  # surfaced on drain()
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def submit(self, *item: Any) -> None:
+        if self._err is not None:
+            self.drain()
+        self._q.put(item)
+
+    def drain(self) -> None:
+        """Block until every submitted update has completed; re-raise the
+        first worker error (the step must not silently lose an update)."""
+        self._q.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._t.join(timeout=30)
+
+
 class PartitionedParamSwapper:
     """Layer-granular param + optimizer-state store with cpu/nvme tiers.
 
@@ -91,7 +147,8 @@ class PartitionedParamSwapper:
                  nvme_path: Optional[str] = None, buffer_count: int = 4,
                  aio_config: Any = None, adam_hparams: Optional[Dict] = None,
                  placement: Optional[Any] = None,
-                 shard: Optional[Dict[str, Any]] = None):
+                 shard: Optional[Dict[str, Any]] = None,
+                 pipeline: bool = False):
         assert layer_trees, "need at least one layer"
         #: tree → device tree; the streaming executor injects a mesh-aware
         #: fn (NamedSharding device_put per leaf) for multi-chip runs.  MUST
@@ -185,6 +242,15 @@ class PartitionedParamSwapper:
         self._device_cache: Dict[int, Any] = {}
         self._gplanes: Dict[int, np.ndarray] = {}  # stashed grads per layer
         self._scratch_g: Optional[np.ndarray] = None  # fused-path grad buf
+        # pipelined optimizer (reference pipelined_optimizer_swapper role):
+        # a worker thread runs grad-flatten + fused C++ Adam + write-behind
+        # while the main thread keeps dispatching device work.  The lock
+        # guards nvme slot/aio bookkeeping shared between the threads;
+        # _pinned stops _evict_for_slot from reusing a slot mid-update.
+        self._lock = threading.RLock()
+        self._pinned: set = set()
+        self._pipe_g: Optional[np.ndarray] = None  # worker-exclusive buf
+        self._pipe = _OptPipeline(self._pipe_step) if pipeline else None
         tier = "nvme" if self.nvme_dir else "cpu"
         per_layer = self.n_plane * (12 + self.wire_np_dtype.itemsize)
         host_mib = (self.buffer_count if self.nvme_dir else self.L) \
@@ -291,7 +357,11 @@ class PartitionedParamSwapper:
             if failed:
                 raise IOError(f"AIO write-behind failed ({failed} ops)")
             self._dirty_writes = 0
-        victim = self._lru.pop(0)
+        # never evict a layer the pipeline worker is mid-update on (its
+        # planes object must stay that slot's); buffer_count >= 2 and at
+        # most one in-flight update guarantee an unpinned victim exists
+        victim = next(l for l in self._lru if l not in self._pinned)
+        self._lru.remove(victim)
         slot = self._slot_of.pop(victim)
         self._slot_state.pop(victim, None)
         self._device_cache.pop(victim, None)
@@ -316,42 +386,44 @@ class PartitionedParamSwapper:
                         jax.device_put,
                         self._leaf_views(self._resident[i].wire))
             return
-        state = self._slot_state.get(i)
-        if state == "full" or (state in ("wire", "reading") and not full):
-            if i in self._lru:
-                self._lru.remove(i)
-            self._lru.append(i)
-            return
-        if state is None:
-            slot = self._evict_for_slot()
-            self._slot_of[i] = slot
-            self._lru.append(i)
-        planes = self._slots[self._slot_of[i]]
-        self._aio.async_pread(planes.wire, self._path(i, "wire"))
-        if full:
-            self._aio.async_pread(planes.master, self._path(i, "master"))
-            self._aio.async_pread(planes.m, self._path(i, "m"))
-            self._aio.async_pread(planes.v, self._path(i, "v"))
-        self._slot_state[i] = "reading" if not full else "full"
+        with self._lock:  # slot/aio state shared with the pipeline worker
+            state = self._slot_state.get(i)
+            if state == "full" or (state in ("wire", "reading") and not full):
+                if i in self._lru:
+                    self._lru.remove(i)
+                self._lru.append(i)
+                return
+            if state is None:
+                slot = self._evict_for_slot()
+                self._slot_of[i] = slot
+                self._lru.append(i)
+            planes = self._slots[self._slot_of[i]]
+            self._aio.async_pread(planes.wire, self._path(i, "wire"))
+            if full:
+                self._aio.async_pread(planes.master, self._path(i, "master"))
+                self._aio.async_pread(planes.m, self._path(i, "m"))
+                self._aio.async_pread(planes.v, self._path(i, "v"))
+            self._slot_state[i] = "reading" if not full else "full"
 
     def _ensure_host(self, i: int, full: bool = False) -> _Planes:
         if self.nvme_dir is None:
             return self._resident[i]
-        state = self._slot_state.get(i)
-        if state is None or (full and state in ("wire", "reading")):
-            self.prefetch(i, full=full)
-        # refresh recency: the layer being used must never be the eviction
-        # victim of its own read-ahead
-        if i in self._lru:
-            self._lru.remove(i)
-        self._lru.append(i)
-        failed = self._aio.wait()  # drain reads (and any writes) for safety
-        if failed:
-            raise IOError(f"AIO read of layer {i} failed ({failed} ops)")
-        self._dirty_writes = 0
-        self._slot_state[i] = "full" if (full or self._slot_state.get(i)
-                                         == "full") else "wire"
-        return self._slots[self._slot_of[i]]
+        with self._lock:  # slot/aio state shared with the pipeline worker
+            state = self._slot_state.get(i)
+            if state is None or (full and state in ("wire", "reading")):
+                self.prefetch(i, full=full)
+            # refresh recency: the layer being used must never be the
+            # eviction victim of its own read-ahead
+            if i in self._lru:
+                self._lru.remove(i)
+            self._lru.append(i)
+            failed = self._aio.wait()  # drain reads (and writes) for safety
+            if failed:
+                raise IOError(f"AIO read of layer {i} failed ({failed} ops)")
+            self._dirty_writes = 0
+            self._slot_state[i] = "full" if (full or self._slot_state.get(i)
+                                             == "full") else "wire"
+            return self._slots[self._slot_of[i]]
 
     def get_device(self, i: int) -> Any:
         """Device pytree of layer ``i``'s wire (compute-dtype) params."""
@@ -386,7 +458,15 @@ class PartitionedParamSwapper:
     # ------------------------------------------------------------------
 
     def begin_step(self) -> None:
+        self.drain_updates()  # no update may straddle a step boundary
         self.state_step += 1
+
+    def __del__(self):
+        try:
+            if getattr(self, "_pipe", None) is not None:
+                self._pipe.close()
+        except Exception:
+            pass
 
     def _flatten_grads(self, buf: np.ndarray, grads_tree: Any,
                        accumulate: bool = False) -> None:
@@ -455,6 +535,66 @@ class PartitionedParamSwapper:
                 self._aio.async_pwrite(buf, self._path(i, kind))
             self._dirty_writes += 4
 
+    # -- pipelined update (worker thread; see _OptPipeline) ---------------
+
+    def step_layer_async(self, i: int, grads_tree: Any,
+                         lr: Optional[float] = None) -> None:
+        """Fused-path update of layer ``i``, handed to the pipeline worker
+        so the device keeps computing earlier layers' backward.  The grad
+        d2h starts HERE (async) — by the time the worker flattens, bytes
+        are on host or in flight.  Falls back to the synchronous
+        :meth:`step_layer` when the pipeline is off."""
+        if self._pipe is None:
+            return self.step_layer(i, grads_tree, lr)
+        for g in jax.tree.leaves(grads_tree):
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        self._device_cache.pop(i, None)  # stale wire must not serve again
+        self._pipe.submit("fused", i, grads_tree,
+                          None if lr is None else float(lr), 1.0)
+
+    def apply_stashed_async(self, i: int, lr: Optional[float] = None,
+                            scale: float = 1.0) -> None:
+        """Pipelined second-pass update from the stashed grad plane: the
+        worker's C++ Adam on layer ``i`` overlaps the main thread's
+        read-ahead of layer ``i+1`` (and, nvme tier, its write-behind)."""
+        if self._pipe is None:
+            return self.apply_stashed(i, lr, scale)
+        self._device_cache.pop(i, None)
+        self._pipe.submit("stash", i, None,
+                          None if lr is None else float(lr), float(scale))
+
+    def _pipe_step(self, kind: str, i: int, grads_tree: Any,
+                   lr: Optional[float], scale: float) -> None:
+        """Worker body: flatten (fused path) → fused C++ Adam → tier
+        write-behind.  Pins ``i`` so slot eviction can't reuse its planes
+        mid-update; nvme slot/aio mutations ride ``self._lock``."""
+        with self._lock:
+            self._pinned.add(i)
+        try:
+            planes = self._ensure_host(i, full=True)
+            if kind == "fused":
+                if self._pipe_g is None:
+                    self._pipe_g = np.zeros((self.n_plane,), np.float32)
+                g = self._pipe_g
+                self._flatten_grads(g, grads_tree)
+            else:
+                g = self._gplanes.pop(i)
+                if scale != 1.0:
+                    np.multiply(g, np.float32(scale), out=g)
+            self._adam_planes(planes, g, float(self.lr if lr is None else lr))
+            with self._lock:
+                self._device_cache.pop(i, None)
+                if self.nvme_dir is not None:
+                    for kind2, buf in (("wire", planes.wire),
+                                       ("master", planes.master),
+                                       ("m", planes.m), ("v", planes.v)):
+                        self._aio.async_pwrite(buf, self._path(i, kind2))
+                    self._dirty_writes += 4
+        finally:
+            with self._lock:
+                self._pinned.discard(i)
+
     # -- deferred update (gradient accumulation / global clipping) -------
     #
     # Grad planes ride host RAM on BOTH tiers (the reference's optimizer
@@ -497,12 +637,23 @@ class PartitionedParamSwapper:
             self._dirty_writes += 4
 
     def flush(self) -> None:
-        """Drain outstanding write-behind IO (end of step / checkpoint)."""
-        if self._aio is not None and self._dirty_writes:
-            failed = self._aio.wait()
-            if failed:
-                raise IOError(f"AIO flush failed ({failed} ops)")
-            self._dirty_writes = 0
+        """Drain in-flight pipelined updates, then outstanding write-behind
+        IO (end of step / checkpoint)."""
+        self.drain_updates()
+        with self._lock:
+            if self._aio is not None and self._dirty_writes:
+                failed = self._aio.wait()
+                if failed:
+                    raise IOError(f"AIO flush failed ({failed} ops)")
+                self._dirty_writes = 0
+
+    def drain_updates(self) -> None:
+        """Wait for every pipelined optimizer update submitted so far;
+        re-raises the first worker failure.  MUST run before anything that
+        reads planes for a layer with an in-flight update (next-step
+        ``get_device``, checkpoint export, grad-norm reads)."""
+        if self._pipe is not None:
+            self._pipe.drain()
 
     # ------------------------------------------------------------------
     # checkpoint surface
@@ -512,6 +663,7 @@ class PartitionedParamSwapper:
         """fp32 master params of layer ``i`` as a (copied) pytree.
         Sharded: cross-process gather — every process gets the full tree
         (collective: all processes must call this together)."""
+        self.drain_updates()
         planes = self._ensure_host(i, full=True)
         if self.shard_world > 1:
             full = self.gather_plane(planes.master)[:self.n_elems]
@@ -522,6 +674,7 @@ class PartitionedParamSwapper:
         return jax.tree.map(np.array, self._leaf_views(planes.master))
 
     def layer_moments(self, i: int) -> Dict[str, np.ndarray]:
+        self.drain_updates()
         planes = self._ensure_host(i, full=True)
         if self.shard_world > 1:
             return {"m": self.gather_plane(planes.m)[:self.n_elems],
@@ -532,6 +685,7 @@ class PartitionedParamSwapper:
                    moments: Optional[Dict[str, np.ndarray]] = None) -> None:
         """Install restored masters (+ moments).  ``moments=None`` = a
         params-only load: existing moments are PRESERVED, not zeroed."""
+        self.drain_updates()
         planes = self._ensure_host(i, full=True)
         self._fill_planes(planes, master_tree, zero_moments=False)
         if moments is not None:
