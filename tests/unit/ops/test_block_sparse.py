@@ -281,3 +281,38 @@ def test_bucketed_backward_selected_for_global_row_layouts():
 
         jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         assert spy.called
+
+
+@pytest.mark.parametrize("name,make,causal", CASES,
+                         ids=[c[0] for c in CASES])
+def test_pallas_flat_backward_matches_dense_all_layouts(name, make, causal):
+    """The flat-tile Pallas backward (_sparse_bwd_pallas, interpret mode
+    here; on-chip via bench --selfcheck) == the dense masked vjp for
+    every layout family — the kernel realization of the bucketed jnp
+    backward's O(live) property, fed by forward-saved softmax stats."""
+    import importlib
+
+    bsa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.block_sparse_attention")
+
+    q, k, v = _qkv(B=1, S=256, h=4)
+    cfg = make(4)
+    layout = bsa._norm_layout(cfg.make_layout(256), 4)
+    key = (layout.tobytes(), layout.shape, layout.dtype.str)
+    bsa._LAYOUTS[key] = layout
+
+    out, res = bsa._bs_fwd(q, k, v, key, causal, 64, 64, cfg.block, True)
+    _, _, _, o_saved, lse = res
+    do = 3 * out ** 2
+    g1 = bsa._sparse_bwd_pallas(q, k, v, o_saved, lse, do, layout,
+                                cfg.block, causal, 64, 64, interpret=True)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(sparse_attention(
+            q, k, v, cfg, causal=causal, impl="dense") ** 3)
+
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{nm} ({name})")
