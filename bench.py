@@ -966,12 +966,19 @@ def main() -> None:
         # at S=8192 (what you'd run without sparse support).  Sweep
         # documents the crossover: wins scale as ~1/(1.4·live).
         def _bench_grad(f, q_, k_, v_, n=3, reps=6):
+            # differentiate w.r.t. ALL of q/k/v and fold every grad into
+            # the carry — a dq-only grad lets XLA dead-code-eliminate the
+            # dk/dv backward kernels and the "training" number would be
+            # fwd+dq only
             def chained(q, k, v):
                 def body(c, _):
-                    g = jax.grad(lambda a: jnp.sum(
-                        f(a, c[1], c[2]).astype(jnp.float32) ** 2))(c[0])
-                    return (c[0] * 0.5 + g.astype(c[0].dtype) * 1e-6,
-                            c[1], c[2]), None
+                    gq, gk, gv = jax.grad(
+                        lambda a, b2, c2: jnp.sum(
+                            f(a, b2, c2).astype(jnp.float32) ** 2),
+                        argnums=(0, 1, 2))(*c)
+                    return (c[0] * 0.5 + gq.astype(c[0].dtype) * 1e-6,
+                            c[1] * 0.5 + gk.astype(c[1].dtype) * 1e-6,
+                            c[2] * 0.5 + gv.astype(c[2].dtype) * 1e-6), None
                 (q_2, _, _), _ = jax.lax.scan(body, (q, k, v), None,
                                               length=reps)
                 return q_2

@@ -127,6 +127,15 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _flash_fit_probe(b: int, S: int) -> int:
+    """The block size _flash_call's ``fit`` would settle on (shared logic
+    so the backward's kernel-eligibility check can't drift)."""
+    b = min(b, S)
+    while b >= 64 and (S % b or b % 8):
+        b //= 2
+    return b
+
+
 def _flash_call(q, k, v, causal, block_q, block_k, interpret,
                 with_lse: bool = False, window=None):
     from jax.experimental import pallas as pl
@@ -136,13 +145,8 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
     # legal: S=1920 with 512-defaults runs the kernel at 128/128 instead
     # of the O(S^2) dense path; a non-8-aligned S (e.g. 321) can never
     # satisfy both constraints and drops to the dense reference
-    def fit(b):
-        b = min(b, S)
-        while b >= 64 and (S % b or b % 8):
-            b //= 2
-        return b
-
-    block_q, block_k = fit(block_q), fit(block_k)
+    block_q = _flash_fit_probe(block_q, S)
+    block_k = _flash_fit_probe(block_k, S)
     if block_q < 64 or block_k < 64:  # degenerate shapes → dense reference
         out, lse = _reference_fwd_with_lse(q, k, v, causal, window)
         return (out, lse) if with_lse else out
@@ -189,8 +193,200 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, window=None):
     return out, (q, k, v, out, lse)
 
 
+def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+                      dq_ref, *, block_q: int, block_k: int, seq_len: int,
+                      causal: bool, scale: float, window):
+    """Pallas dq pass: grid (bh, q-block); K/V ride VMEM-resident (as in
+    the forward) and the k-loop SKIPS blocks above the causal diagonal /
+    outside the window — scores never touch HBM, and causal work is the
+    true triangle, both of which the jnp chunked backward paid for."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    nk = seq_len // block_k
+    q = q_ref[0].astype(jnp.float32)                   # [bq, d]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]                             # [bq]
+    delta = delta_ref[0, :, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, acc):
+        kblk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = jnp.ones((block_q, block_k), jnp.bool_)
+        if causal:
+            keep = q_pos >= k_pos
+        if window is not None:
+            keep = keep & (q_pos - k_pos < window) & (k_pos - q_pos < window)
+        p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return acc + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        nk_eff = (qi * block_q + block_q + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk_eff, nk)
+    else:
+        nk_eff = nk
+    k0 = 0
+    if window is not None:
+        k0 = jnp.maximum(qi * block_q - (window - 1), 0) // block_k
+        if not causal:
+            # window reaches forward too: clip k-blocks past the last
+            # position any row of this q-block can see
+            nk_eff = jnp.minimum(
+                nk_eff,
+                (qi * block_q + block_q - 1 + window + block_k - 1)
+                // block_k)
+    acc = jax.lax.fori_loop(
+        k0, nk_eff, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, block_q: int, block_k: int,
+                       seq_len: int, causal: bool, scale: float, window):
+    """Pallas dk/dv pass: grid (bh, k-block); Q/do/lse/Δ VMEM-resident,
+    q-loop starts at the diagonal under causality.  dv += pᵀ·do,
+    dk += dsᵀ·q·scale, accumulated in registers/VMEM — no segment-sum or
+    HBM score chunks."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    nq = seq_len // block_q
+    kblk = k_ref[0].astype(jnp.float32)                # [bk, d]
+    vblk = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        keep = jnp.ones((block_q, block_k), jnp.bool_)
+        if causal:
+            keep = q_pos >= k_pos
+        if window is not None:
+            keep = keep & (q_pos - k_pos < window) & (k_pos - q_pos < window)
+        p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        return dk_acc, dv_acc
+
+    q0 = (ki * block_k) // block_q if causal else 0
+    nq_eff = nq
+    if window is not None:
+        # rows beyond the window's backward reach see nothing of this
+        # k-block: clip both ends so windowed work is O(S·window), the
+        # mirror of the dq pass (and the forward's k0 skip)
+        nq_eff = jnp.minimum(
+            nq, (ki * block_k + block_k - 1 + window + block_q - 1)
+            // block_q)
+        if not causal:
+            q0 = jnp.maximum(ki * block_k - (window - 1), 0) // block_q
+    d = kblk.shape[-1]
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        q0, nq_eff, body, (jnp.zeros((block_k, d), jnp.float32),
+                           jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
+                      window):
+    """Kernel backward: dq + dk/dv passes with VMEM-resident scores.
+
+    Replaces the jnp chunked scan, which materialized [B, h, S, block]
+    fp32 score chunks in HBM (bandwidth-bound: ~4 such tensors per chunk)
+    and computed the full S×block products even above the causal diagonal
+    — measured 4x faster at B=8/S=2048/h=12/d=64 on v5e, taking the
+    110M-headline attention from 7.5%% to ~30%% component efficiency."""
+    from jax.experimental import pallas as pl
+
+    B, S, h, d = q.shape
+    # long S: the dkv pass holds q/do/lse/Δ VMEM-resident (O(S·d)), so
+    # 512-blocks push scoped VMEM past the 16M limit at S>=8192 — cap
+    # the backward blocks there (measured: no headline impact at S=2048)
+    if S * d > 4096 * 64:
+        block_q, block_k = min(block_q, 256), min(block_k, 256)
+    block_q = _flash_fit_probe(block_q, S)
+    block_k = _flash_fit_probe(block_k, S)
+    qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    dor = do.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    lse_r = lse.reshape(B * h, S, 1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                            # [B, S, h]
+    delta_r = delta.transpose(0, 2, 1).reshape(B * h, S, 1)
+    scale = 1.0 / np.sqrt(d)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=S, causal=causal,
+                          scale=scale, window=window),
+        grid=(B * h, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+    )(qr, dor, kr, vr, lse_r, delta_r)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=S, causal=causal,
+                          scale=scale, window=window),
+        grid=(B * h, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, S, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B * h, S, d), k.dtype),
+                   jax.ShapeDtypeStruct((B * h, S, d), v.dtype)],
+    )(qr, dor, kr, vr, lse_r, delta_r)
+
+    back = lambda a: a.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
+
+
 def _flash_bwd(causal, block_q, block_k, window, res, do):
-    """Flash-style chunked backward: scan over k-blocks, O(S·block_k) live.
+    """Backward dispatch: the Pallas kernel pair on TPU (VMEM-resident
+    scores, causal-triangle work); the jnp chunked scan elsewhere.
 
     Uses the saved per-row log-sum-exp (no softmax re-normalization pass)
     and ``delta_i = Σ_d do_i·o_i`` so the softmax jacobian term needs no
@@ -198,6 +394,10 @@ def _flash_bwd(causal, block_q, block_k, window, res, do):
     """
     q, k, v, out, lse = res
     B, S, h, d = q.shape
+    if _use_pallas() and S % 64 == 0 and min(
+            _flash_fit_probe(block_q, S), _flash_fit_probe(block_k, S)) >= 64:
+        return _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q,
+                                 block_k, window)
     scale = 1.0 / np.sqrt(d)
     blk = min(block_k, S)
     while blk > 1 and S % blk:  # shrink to a divisor (matches _flash_call)

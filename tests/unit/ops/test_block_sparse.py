@@ -316,3 +316,40 @@ def test_pallas_flat_backward_matches_dense_all_layouts(name, make, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4,
                                    err_msg=f"d{nm} ({name})")
+
+
+def test_cell_exact_fast_path_matches_dense():
+    """cb == kernel block (qc == kc == 1): the production default after
+    block auto-snap — _keep_tile's causality-only branch, forward AND
+    flat-kernel backward, against the dense anchor."""
+    import importlib
+
+    bsa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.block_sparse_attention")
+
+    q, k, v = _qkv(B=1, S=512, h=2, d=64)
+    cfg = BigBirdSparsityConfig(num_heads=2, block=128)
+    for causal in (False, True):
+        layout = bsa._norm_layout(cfg.make_layout(512), 2)
+        key = (layout.tobytes(), layout.shape, layout.dtype.str)
+        bsa._LAYOUTS[key] = layout
+        out, res = bsa._bs_fwd(q, k, v, key, causal, 128, 128, cfg.block,
+                               True)
+        want = sparse_attention(q, k, v, cfg, causal=causal, impl="dense")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        _, _, _, o_saved, lse = res
+        do = 3 * out ** 2
+        g1 = bsa._sparse_bwd_pallas(q, k, v, o_saved, lse, do, layout,
+                                    cfg.block, causal, 128, 128,
+                                    interpret=True)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(sparse_attention(
+                q, k, v, cfg, causal=causal, impl="dense") ** 3)
+
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, nm in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4,
+                                       err_msg=f"d{nm} causal={causal}")
