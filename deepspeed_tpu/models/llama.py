@@ -127,6 +127,51 @@ class LlamaConfig:
         return V * H + L * per_layer + head
 
 
+@jax.custom_vjp
+def _tp_copy(x: jnp.ndarray) -> jnp.ndarray:
+    """Megatron's *f* operator for the manual-TP layer: identity forward,
+    psum over the (manual) ``tensor`` axis in backward — the input of a
+    column-parallel linear is used by every rank, so its cotangent is the
+    cross-rank sum."""
+    return x
+
+
+def _tp_copy_fwd(x):
+    return x, None
+
+
+def _tp_copy_bwd(_, g):
+    from ..parallel.mesh import AXIS_TENSOR
+
+    return (jax.lax.psum(g, AXIS_TENSOR),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@jax.custom_vjp
+def _tp_reduce(x: jnp.ndarray) -> jnp.ndarray:
+    """Megatron's *g* operator: psum over the manual ``tensor`` axis in
+    forward, IDENTITY backward (the psum output is replicated, so its
+    cotangent is already the full value on every rank).  Explicit because
+    ``lax.psum``'s autodiff transpose under ``check_vma=False`` shard_map
+    is another psum — which would scale row-parallel cotangents by tp."""
+    from ..parallel.mesh import AXIS_TENSOR
+
+    return jax.lax.psum(x, AXIS_TENSOR)
+
+
+def _tp_reduce_fwd(x):
+    return _tp_reduce(x), None
+
+
+def _tp_reduce_bwd(_, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
 def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     dt = x.dtype
     x32 = x.astype(jnp.float32)
@@ -359,6 +404,66 @@ class LlamaModel:
             attn = attn_fn(q, kk, vv)
         return jnp.einsum("bshd,hdH->bsH", attn,
                           lp["attn"]["wo"].astype(c.dtype))
+
+    def decoder_layer_manual_tp(self, lp: Any, x: jnp.ndarray
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """ONE decoder layer on LOCAL tensor shards under a MANUAL
+        ``tensor`` axis — the 1F1B × TP path.
+
+        Why it exists: the 1F1B schedule is a pipe-manual ``shard_map``,
+        and tensor-axis GSPMD constraints INSIDE a partial-manual region
+        trip an XLA partitioner CHECK (spmd_partitioner_util.cc; see the
+        engine's routing note).  Manualizing the tensor axis too removes
+        every in-region constraint: this method is the Megatron
+        column/row pattern (reference ``megatron/mpu`` semantics via
+        AutoTP specs, SURVEY §2.1 #25) with explicit collectives —
+        ``_tp_copy`` (identity fwd / psum bwd: Megatron's *f*) before the
+        column-parallel projections, ``psum`` (Megatron's *g*) after the
+        row-parallel ones.
+
+        ``lp`` leaves are the per-rank shards ``param_specs`` dictates:
+        wq/wk/wv ``[H, h/tp, d]``, wo ``[h/tp, d, H]``, w_gate/w_up
+        ``[H, I/tp]``, w_down ``[I/tp, H]``, norms replicated.  ``x`` is
+        the full ``[B, S, H]`` activation (replicated over tensor)."""
+        c = self.config
+        n_rep = c.num_heads // c.num_kv_heads
+
+        h = _rms_norm(x, lp["attn_norm"].astype(c.dtype), c.rms_norm_eps)
+        h = _tp_copy(h)
+        q = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wq"].astype(c.dtype))
+        kk = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wk"].astype(c.dtype))
+        vv = jnp.einsum("bsH,Hhd->bshd", h, lp["attn"]["wv"].astype(c.dtype))
+        if n_rep > 1:
+            kk = jnp.repeat(kk, n_rep, axis=2)
+            vv = jnp.repeat(vv, n_rep, axis=2)
+        S = q.shape[1]
+        positions = jnp.arange(S)[None, :]
+        q = _rope(q, positions, c.rope_theta)
+        kk = _rope(kk, positions, c.rope_theta)
+        W = c.sliding_window
+        if c.attn_impl == "flash":
+            from ..ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(q, kk, vv, True, window=W)
+        else:
+            from ..ops.masks import local_attention_mask
+
+            pos = jnp.arange(S)
+            mask = local_attention_mask(pos, pos, causal=True, window=W)
+            attn = _attention(q, kk, vv, mask[None, None])
+        out = jnp.einsum("bshd,hdH->bsH", attn,
+                         lp["attn"]["wo"].astype(c.dtype))
+        x = x + _tp_reduce(out)
+
+        h2 = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
+        h2 = _tp_copy(h2)
+        gate = jnp.einsum("bsH,HI->bsI", h2,
+                          lp["mlp"]["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bsH,HI->bsI", h2, lp["mlp"]["w_up"].astype(c.dtype))
+        down = jnp.einsum("bsI,IH->bsH", jax.nn.silu(gate) * up,
+                          lp["mlp"]["w_down"].astype(c.dtype))
+        x = x + _tp_reduce(down)
+        return x, jnp.float32(0.0)
 
     def profile_submodules(self) -> Dict[str, Any]:
         """Depth-2 module pieces for the flops profiler: name →

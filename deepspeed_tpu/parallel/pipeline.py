@@ -157,7 +157,9 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
                         head_fn: Callable[[Any, Any, Any], jnp.ndarray],
                         head_params: Any,
                         microbatches: Any,
-                        mesh: Mesh):
+                        mesh: Mesh,
+                        manual_axes: tuple = (),
+                        trunk_specs: Any = None):
     """1F1B training schedule: mean loss + grads in ONE pass with O(pp)
     stashed activations per stage — vs GPipe-through-autodiff, which keeps
     all M microbatch activations live until the backward drain.
@@ -321,7 +323,18 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
         gh = tmap(lambda a: jax.lax.psum(a, AXIS_PIPE), gh)
         return loss, gacc, ge, gh
 
-    trunk_spec = pipeline_spec(jax.tree.map(jnp.ndim, stacked_params))
+    # ``manual_axes`` (1F1B × TP): the tensor axis joins the manual set —
+    # layer_fn then sees LOCAL tensor shards and does its own collectives
+    # (decoder_layer_manual_tp) — because tensor GSPMD constraints inside
+    # the partial-manual region trip the XLA partitioner CHECK the engine
+    # routing documents.  ``trunk_specs`` carries the model's pipe+tensor
+    # placement for the stacked layer params in that mode.  Known trade:
+    # embed/head enter replicated over tensor (P()), so each tensor rank
+    # computes the full-vocab head loss + its vjp redundantly — a
+    # vocab-parallel head (Megatron g on the logits) inside the manual
+    # region is the follow-up that removes the duplicated flops.
+    trunk_spec = (trunk_specs if trunk_specs is not None
+                  else pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)))
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
     loss, g_trunk, g_emb, g_head = jax.shard_map(
         per_stage, mesh=mesh,
@@ -329,8 +342,8 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
                   rep(microbatches)),
         out_specs=(P(), trunk_spec, rep(embed_params), rep(head_params)),
         check_vma=False,
-        axis_names={AXIS_PIPE})(stacked_params, embed_params, head_params,
-                                microbatches)
+        axis_names={AXIS_PIPE, *manual_axes})(
+            stacked_params, embed_params, head_params, microbatches)
     stats = {"stash_depth": S, "ticks": T, "gpipe_stash": M,
              "bubble_fraction": pipeline_bubble_fraction(M, pp)}
     return loss, (g_trunk, g_emb, g_head), stats

@@ -163,19 +163,40 @@ class DeepSpeedEngine:
             or (config.optimizer is not None
                 and "onebit" in config.optimizer.type.lower().replace("-",
                                                                       "")))
-        if self._pp_1f1b and self.fp16_enabled:
-            fallback_reason = ("does not compose with fp16 loss scaling "
-                              "yet")
-        elif self._pp_1f1b and int(self.mesh.shape.get(_AT, 1)) > 1:
+        self._pp_1f1b_manual_tp = False
+        tp = int(self.mesh.shape.get(_AT, 1))
+        if self._pp_1f1b and tp > 1:
             # XLA's SPMD partitioner CHECK-fails on the 1F1B partial-manual
             # shard_map combined with tensor-axis GSPMD constraints inside
             # (spmd_partitioner_util.cc partition-group mismatch, verified
-            # on jax 0.9 CPU).  GPipe-through-autodiff partitions fine and
-            # computes identical gradients, at a larger activation
-            # footprint.
-            fallback_reason = ("+ tensor parallelism trips an XLA "
-                              "partitioner limitation")
-        elif self._pp_1f1b and compressed_comm:
+            # on jax 0.9 CPU).  The workaround manualizes the TENSOR axis
+            # too: the model supplies a Megatron column/row layer with
+            # explicit collectives (decoder_layer_manual_tp), leaving no
+            # tensor constraint inside the region.  Models without that
+            # hook (or with a seq axis, whose constraints would hit the
+            # same CHECK) fall back to GPipe-through-autodiff, which
+            # partitions fine and computes identical gradients at a larger
+            # activation footprint.
+            from ..parallel.mesh import AXIS_SEQ as _AS
+
+            cfg_m = getattr(module, "config", None)
+            shards_ok = (
+                cfg_m is not None
+                and getattr(cfg_m, "num_heads", 0) > 0
+                and getattr(cfg_m, "num_heads", 0) % tp == 0
+                and getattr(cfg_m, "num_kv_heads", 0) > 0
+                and getattr(cfg_m, "num_kv_heads", 0) % tp == 0
+                and getattr(cfg_m, "intermediate_size", 0) > 0
+                and getattr(cfg_m, "intermediate_size", 0) % tp == 0)
+            if (callable(getattr(module, "decoder_layer_manual_tp", None))
+                    and int(self.mesh.shape.get(_AS, 1)) == 1
+                    and shards_ok):
+                self._pp_1f1b_manual_tp = True
+            else:
+                fallback_reason = ("+ tensor parallelism trips an XLA "
+                                   "partitioner limitation (and this "
+                                   "module has no manual-TP layer hook)")
+        if fallback_reason is None and self._pp_1f1b and compressed_comm:
             fallback_reason = ("does not compose with compressed-comm "
                               "paths (1-bit/qwZ/qgZ)")
         if fallback_reason is not None:
@@ -424,7 +445,7 @@ class DeepSpeedEngine:
     # the compiled train step
     # ------------------------------------------------------------------
 
-    def _pp_1f1b_grads(self, compute_params, batch):
+    def _pp_1f1b_grads(self, compute_params, batch, scale=None):
         """Grads + mean loss through the 1F1B schedule.
 
         Bridges the module's layer-streamable protocol (embed_fwd /
@@ -457,19 +478,55 @@ class DeepSpeedEngine:
             ids, _ = mod.batch_labels(mb)
             return (mod.embed_fwd(ep, ids), jnp.float32(0.0))
 
+        manual_tp = getattr(self, "_pp_1f1b_manual_tp", False)
+        layer_impl = (mod.decoder_layer_manual_tp if manual_tp
+                      else mod.decoder_layer)
+
         def layer_fn(lp, act):
             x, aux = act
-            nx, naux = mod.decoder_layer(lp, x)
+            nx, naux = layer_impl(lp, x)
             return (nx, aux + naux)
 
         def head_fn(hp, act, mb):
             x, aux = act
-            return mod.head_loss(hp, x, mb) + aux_coef * aux
+            loss = mod.head_loss(hp, x, mb) + aux_coef * aux
+            # fp16 loss scaling INSIDE the schedule: the 1/M cotangent
+            # seed then carries the scale through every stage's fp16 vjp
+            return loss * scale if scale is not None else loss
+
+        manual_axes: tuple = ()
+        trunk_specs = None
+        if manual_tp:
+            # tensor joins the manual set; the trunk in/out specs carry
+            # the model's pipe+tensor placement (manual axes only — dp/
+            # ZeRO placement on other dims stays with GSPMD outside)
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import AXIS_PIPE as _AP
+            from ..parallel.mesh import AXIS_TENSOR as _AT2
+            manual_axes = (_AT2,)
+            keep = {_AP, _AT2}
+
+            def manual_only(spec):
+                out = []
+                for e in tuple(spec):
+                    if isinstance(e, (tuple, list)):
+                        kept = tuple(a for a in e if a in keep)
+                        out.append(kept if kept else None)
+                    else:
+                        out.append(e if e in keep else None)
+                return P(*out)
+
+            trunk_specs = jax.tree.map(
+                manual_only, mod.param_specs()["layers"],
+                is_leaf=lambda s: isinstance(s, P))
 
         loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(
             layer_fn, compute_params["layers"], embed_fn, resident,
-            head_fn, resident, micro, self.mesh)
-        self.last_pipe_stats = dict(stats, schedule="1f1b")
+            head_fn, resident, micro, self.mesh,
+            manual_axes=manual_axes, trunk_specs=trunk_specs)
+        self.last_pipe_stats = dict(stats, schedule="1f1b",
+                                    manual_tp=manual_tp)
         grads = dict(jax.tree.map(jnp.add, g_emb, g_head))
         grads["layers"] = g_trunk
         return grads, loss
@@ -535,12 +592,23 @@ class DeepSpeedEngine:
                 # — O(pp) stashed activations per stage — instead of
                 # autodiff through the module's GPipe forward.  The
                 # pipeline microbatch count absorbs gas (both are "grads
-                # summed over micros of the mean loss").
-                grads, mean_loss = self._pp_1f1b_grads(compute_params,
-                                                       batch)
+                # summed over micros of the mean loss").  fp16: the
+                # per-micro loss is scaled INSIDE the schedule (cotangents
+                # ride scaled through the fp16 backward), unscaled here;
+                # the overflow vote is globally consistent by construction
+                # — grads are one logical SPMD array, so every stage
+                # computes the same isfinite reduction (the reference
+                # all-reduces a per-stage overflow flag to the same end).
+                grads, mean_loss = self._pp_1f1b_grads(
+                    compute_params, batch, scale=scale if fp16 else None)
+                if fp16:
+                    grads = jax.tree.map(lambda g: g / scale, grads)
+                    mean_loss = mean_loss / scale
                 grads = policy.apply_grad_constraints(grads,
                                                       self.base_specs)
-                overflow = jnp.bool_(False)
+                overflow = has_overflow(grads) if fp16 else jnp.bool_(False)
+                grads = jax.tree.map(
+                    lambda g: jnp.where(overflow, 0.0, g), grads)
                 if clip > 0:
                     grads, grad_norm = clip_grads_by_global_norm(grads,
                                                                  clip)
