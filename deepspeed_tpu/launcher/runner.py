@@ -102,9 +102,30 @@ def main(argv: List[str] = None) -> int:
                         choices=["ssh", "pdsh", "openmpi", "slurm",
                                  "local", "local-multi"])
     parser.add_argument("--ssh_port", type=int, default=22)
+    parser.add_argument("--autotuning", default="", choices=["", "tune",
+                                                             "run"],
+                        help="orchestrate short profiling runs of the "
+                             "user script over the tuning space; 'run' "
+                             "relaunches with the winning config")
+    parser.add_argument("--autotuning_space", default="",
+                        choices=["", "default", "offload"])
+    parser.add_argument("--autotuning_results",
+                        default="autotuning_results")
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.autotuning:
+        from ..autotuning.cli import orchestrate
+
+        rc = orchestrate(
+            args, [sys.executable, args.user_script] + args.user_args)
+        if args.autotuning != "run" or rc != 0:
+            return rc
+        # run mode: fall through to the NORMAL launch path with the
+        # winning config override in the environment — the real job gets
+        # the full hostfile/launcher/rank-env machinery, not a bare
+        # subprocess
 
     hosts: Dict[str, int] = {}
     if os.path.exists(args.hostfile):

@@ -21,6 +21,7 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -279,3 +280,72 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         client_state = meta.get("client_state", {})
     log_dist(f"loaded checkpoint {ckpt_dir}")
     return ckpt_dir, client_state
+
+
+def load_universal_checkpoint(engine, universal_dir: str) -> None:
+    """Load a ``ds_to_universal`` directory into the engine under ANY mesh.
+
+    Reference: the ``--load_universal`` path of ``deepspeed/runtime/
+    engine.py`` consuming ``checkpoint/ds_to_universal.py`` output (SURVEY
+    §5.4).  Each per-param fp32 file lands via ``jax.device_put`` onto the
+    TARGET state's sharding (the resharding the reference does with its
+    pattern-matched slice merges falls out of GSPMD placement); Adam
+    moments fill the matching ``mu``/``nu`` leaves of the optax state by
+    path suffix, and the step counter resumes.
+    """
+    import json as _json
+
+    meta_path = os.path.join(universal_dir, "universal_metadata.json")
+    with open(meta_path) as f:
+        meta = _json.load(f)
+    zero_dir = os.path.join(universal_dir, "zero")
+
+    def _load(key: str, name: str) -> np.ndarray:
+        return np.load(os.path.join(zero_dir, key, name + ".npy"))
+
+    def _put(arr: np.ndarray, like):
+        arr = arr.astype(like.dtype)
+        sh = getattr(like, "sharding", None)
+        return jax.device_put(arr, sh) if sh is not None else jnp.asarray(
+            arr)
+
+    from ..utils.zero_to_fp32 import path_key
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        engine.state.params)
+    new_leaves = []
+    for path, leaf in flat:
+        key = path_key(path)
+        if key not in meta["params"]:
+            raise KeyError(
+                f"universal checkpoint has no parameter '{key}' "
+                f"(has: {sorted(meta['params'])[:8]}…)")
+        new_leaves.append(_put(_load(key, "fp32"), leaf))
+    params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    oflat, otreedef = jax.tree_util.tree_flatten_with_path(
+        engine.state.opt_state)
+    new_opt = []
+    for path, leaf in oflat:
+        parts = path_key(path).split("/")
+        repl = None
+        for field, fname in (("mu", "exp_avg"), ("nu", "exp_avg_sq")):
+            if field in parts:
+                suffix = "/".join(parts[parts.index(field) + 1:])
+                entry = meta["params"].get(suffix)
+                if entry and entry.get("has_moments") and tuple(
+                        entry["shape"]) == tuple(np.shape(leaf)):
+                    repl = _put(_load(suffix, fname), leaf)
+        if repl is None and "count" in parts and np.ndim(leaf) == 0:
+            # optax's bias-correction step counter — without it the
+            # resumed Adam re-warms from step 0 and the trajectory drifts
+            repl = jnp.asarray(int(meta["step"]), leaf.dtype)
+        new_opt.append(repl if repl is not None else leaf)
+    opt_state = jax.tree_util.tree_unflatten(otreedef, new_opt)
+
+    engine.state = engine.state._replace(
+        params=params, opt_state=opt_state,
+        step=jnp.asarray(int(meta["step"]), jnp.int32))
+    engine.global_steps = int(meta["step"])
+    log_dist(f"loaded universal checkpoint {universal_dir} "
+             f"(step {meta['step']})")

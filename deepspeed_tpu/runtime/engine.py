@@ -951,6 +951,24 @@ class DeepSpeedEngine:
         if _debug.enabled():
             _debug.check_step(metrics)
         self.global_steps += 1
+        import os as _os
+
+        result_path = _os.environ.get("DS_AUTOTUNING_RESULT")
+        if (result_path and self.global_steps
+                == int(_os.environ.get("DS_AUTOTUNING_STEPS", "8"))):
+            # candidate profiling run under `deepspeed --autotuning`: fence
+            # the async steps, report measured throughput, and let the
+            # orchestrator reap the process
+            import json as _json
+
+            float(metrics["loss"])  # real device fence
+            t = self.tput_timer
+            tmp = result_path + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump({"samples_per_sec": t.samples_per_sec(),
+                            "avg_step_time_s": t.avg_step_time(),
+                            "steps": self.global_steps}, f)
+            _os.replace(tmp, result_path)  # atomic: no torn reads
         self.lr_scheduler.last_step = self.global_steps
         self.last_metrics = metrics
         if self.steps_per_print and self.global_steps % int(
@@ -1162,6 +1180,13 @@ class DeepSpeedEngine:
         from .checkpointing import save_checkpoint as _save
 
         return _save(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_universal_checkpoint(self, universal_dir):
+        """Resume from a ``ds_to_universal`` per-parameter directory at
+        THIS engine's parallelism layout (reference --load_universal)."""
+        from .checkpointing import load_universal_checkpoint
+
+        return load_universal_checkpoint(self, universal_dir)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True,

@@ -18,6 +18,7 @@ see ``zero.Init``).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -39,6 +40,22 @@ def _resolve_config(config, config_params) -> DeepSpeedConfig:
         from .config import _load_config_payload
 
         payload = _load_config_payload(payload)
+    override = os.environ.get("DS_AUTOTUNING_CONFIG_OVERRIDE")
+    if override:
+        # the launcher's --autotuning orchestration hands each candidate
+        # run its dotted-key overrides through the environment (the
+        # reference's exp-config rewrite, deepspeed/autotuning/)
+        import json as _json
+
+        payload = dict(payload)
+        for dotted, value in _json.loads(override).items():
+            node = payload
+            parts = dotted.split(".")
+            for p in parts[:-1]:
+                nxt = dict(node.get(p) or {})
+                node[p] = nxt
+                node = nxt
+            node[parts[-1]] = value
     # batch sizes resolved below, once the parallel dims are known
     return DeepSpeedConfig.model_validate(payload)
 

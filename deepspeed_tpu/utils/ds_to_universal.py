@@ -1,0 +1,107 @@
+"""ds_to_universal — convert a saved engine checkpoint to the UNIVERSAL
+per-parameter layout.
+
+Reference: ``deepspeed/checkpoint/ds_to_universal.py`` [K] (SURVEY §5.4) —
+the shipped CLI that merges a parallelism-specific ZeRO checkpoint into one
+directory per parameter holding canonical fp32 weights + optimizer moments,
+loadable at ANY parallelism layout.
+
+TPU-native mechanics: orbax already stores logical (unsharded) arrays, so
+the conversion is a restore-without-mesh walk of the saved TrainState that
+writes, per parameter path::
+
+    <out>/zero/<param/path>/fp32.npy         fp32 master weight
+    <out>/zero/<param/path>/exp_avg.npy      Adam first moment (when found)
+    <out>/zero/<param/path>/exp_avg_sq.npy   Adam second moment (when found)
+    <out>/universal_metadata.json            step + per-param shapes/dtypes
+
+and ``load_universal_checkpoint`` (runtime/checkpointing.py) re-assembles
+an engine's TrainState from those files under ANY mesh — each array lands
+via ``jax.device_put`` onto the target state's shardings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+from .zero_to_fp32 import path_key as _path_key
+from .zero_to_fp32 import restore_saved_state as _restore_state
+
+
+def convert(checkpoint_dir: str, output_dir: str,
+            tag: Optional[str] = None) -> Dict[str, Any]:
+    """Write the universal layout; returns the metadata dict."""
+    state, tag = _restore_state(checkpoint_dir, tag)
+    params = state["params"] if isinstance(state, dict) else state.params
+    opt_state = (state.get("opt_state") if isinstance(state, dict)
+                 else getattr(state, "opt_state", None))
+    step = state.get("step", 0) if isinstance(state, dict) else \
+        getattr(state, "step", 0)
+
+    flat_params = {
+        _path_key(p): np.asarray(jax.device_get(l), np.float32)
+        for p, l in jax.tree_util.tree_flatten_with_path(params)[0]}
+
+    # Adam moments: optax's ScaleByAdamState mirrors the param tree under
+    # leaves whose path contains 'mu' / 'nu'.  Match by path SUFFIX — the
+    # optax chain prefix (tuple indices, state names) varies by config.
+    moments: Dict[str, Dict[str, np.ndarray]] = {"mu": {}, "nu": {}}
+    if opt_state is not None:
+        for p, l in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+            key = _path_key(p)
+            parts = key.split("/")
+            for field, name in (("mu", "mu"), ("nu", "nu")):
+                if name in parts:
+                    suffix = "/".join(parts[parts.index(name) + 1:])
+                    if suffix in flat_params and np.shape(l) == np.shape(
+                            flat_params[suffix]):
+                        moments[field][suffix] = np.asarray(
+                            jax.device_get(l), np.float32)
+
+    zero_dir = os.path.join(output_dir, "zero")
+    meta: Dict[str, Any] = {"step": int(np.asarray(step)),
+                            "source_tag": tag, "params": {}}
+    for key, arr in flat_params.items():
+        pdir = os.path.join(zero_dir, key)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"), arr)
+        entry = {"shape": list(arr.shape), "has_moments": False}
+        if key in moments["mu"] and key in moments["nu"]:
+            np.save(os.path.join(pdir, "exp_avg.npy"), moments["mu"][key])
+            np.save(os.path.join(pdir, "exp_avg_sq.npy"),
+                    moments["nu"][key])
+            entry["has_moments"] = True
+        meta["params"][key] = entry
+    os.makedirs(output_dir, exist_ok=True)
+    with open(os.path.join(output_dir, "universal_metadata.json"),
+              "w") as f:
+        json.dump(meta, f, indent=2)
+    return meta
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ds_to_universal",
+        description="Convert a saved checkpoint to the universal "
+                    "per-parameter fp32 layout")
+    ap.add_argument("--input_folder", required=True)
+    ap.add_argument("--output_folder", required=True)
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args(argv)
+    meta = convert(args.input_folder, args.output_folder, args.tag)
+    print(f"ds_to_universal: wrote {len(meta['params'])} params "
+          f"(step {meta['step']}) to {args.output_folder}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -18,37 +18,64 @@ import jax
 import numpy as np
 
 
-def get_fp32_state_dict_from_zero_checkpoint(
-        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
-    """Load the params subtree of a saved engine state as host fp32 numpy,
-    flattened to {'/'-joined path: array}."""
+def path_key(path) -> str:
+    """Canonical '/'-joined key for a pytree path (GetAttrKey / DictKey /
+    SequenceKey all covered) — ONE implementation shared by every
+    checkpoint-export tool so converter and loader can never disagree."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    """'latest' file, else newest global_step* dir (shared by every
+    offline checkpoint tool)."""
+    if tag is not None:
+        return tag
+    latest = os.path.join(checkpoint_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    candidates = sorted(d for d in os.listdir(checkpoint_dir)
+                        if d.startswith("global_step"))
+    if not candidates:
+        raise FileNotFoundError(
+            f"no global_step* checkpoint under {checkpoint_dir}")
+    return candidates[-1]
+
+
+def restore_saved_state(checkpoint_dir: str, tag: Optional[str] = None):
+    """Mesh-free host restore of a saved engine TrainState; returns
+    (state, tag)."""
     import orbax.checkpoint as ocp
 
-    if tag is None:
-        latest = os.path.join(checkpoint_dir, "latest")
-        if os.path.exists(latest):
-            with open(latest) as f:
-                tag = f.read().strip()
-        else:
-            candidates = sorted(
-                d for d in os.listdir(checkpoint_dir)
-                if d.startswith("global_step"))
-            if not candidates:
-                raise FileNotFoundError(
-                    f"no global_step* checkpoint under {checkpoint_dir}")
-            tag = candidates[-1]
+    tag = resolve_tag(checkpoint_dir, tag)
     state_path = os.path.join(checkpoint_dir, tag, "state")
     with ocp.StandardCheckpointer() as loader:
         meta = loader.metadata(state_path).item_metadata.tree
         target = jax.tree.map(
             lambda am: jax.ShapeDtypeStruct(tuple(am.shape), am.dtype), meta)
-        restored = loader.restore(state_path, target)
+        return loader.restore(state_path, target), tag
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Load the params subtree of a saved engine state as host fp32 numpy,
+    flattened to {'/'-joined path: array}."""
+    restored, _ = restore_saved_state(checkpoint_dir, tag)
     params = restored["params"] if isinstance(restored, dict) else restored.params
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
-        flat[key] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+        flat[path_key(path)] = np.asarray(jax.device_get(leaf),
+                                          dtype=np.float32)
     return flat
 
 

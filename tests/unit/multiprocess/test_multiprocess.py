@@ -45,6 +45,10 @@ def launch_ranks(worker: str, nproc: int, out_dir: str,
     launcher (the local-multi runner — DistributedTest's analogue)."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers set their own device count
+    # CPU-only subprocess tier: without this, the axon sitecustomize
+    # registers the tunneled TPU backend in every worker — a dead tunnel
+    # then hangs the interpreter at import
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({
         "T_REPO": _REPO,
         "T_OUT": out_dir,
@@ -84,6 +88,7 @@ print("LOSSES=" + json.dumps(losses))
 """
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run([sys.executable, "-c", code], env=env,
                           capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stderr[-4000:]
@@ -124,6 +129,7 @@ print("LOSSES=" + json.dumps(losses))
 """
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run([sys.executable, "-c", code], env=env,
                           capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stderr[-4000:]
@@ -136,19 +142,23 @@ print("LOSSES=" + json.dumps(losses))
     np.testing.assert_allclose(resumed, oracle[3:], rtol=2e-4)
 
 
-def test_infinity_per_process_host_planes(tmp_path):
-    """ZeRO-Infinity streaming across 2 REAL processes: each process's
-    host planes hold HALF of every layer (per-process planes, the
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_infinity_per_process_host_planes(tmp_path, nproc):
+    """ZeRO-Infinity streaming across N REAL processes: each process's
+    host planes hold 1/N of every layer (per-process planes, the
     single-controller caveat the round-3 verdict flagged), the device
     wire is assembled by an in-graph all-gather, and the trajectory
-    matches the single-process streaming run of the same model."""
-    launch_ranks("worker_infinity.py", 2, str(tmp_path), timeout=600,
-                 extra_env={"T_CKPT": str(tmp_path / "inf_ckpt")})
+    matches the single-process streaming run of the same model.  nproc=4
+    covers >2 host-plane segments per layer and 2-device processes."""
+    launch_ranks("worker_infinity.py", nproc, str(tmp_path), timeout=600,
+                 extra_env={"T_CKPT": str(tmp_path / "inf_ckpt"),
+                            "T_DEVS": str(8 // nproc)})
     results = [json.load(open(tmp_path / f"inf_rank{r}.json"))
-               for r in (0, 1)]
-    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
-                               rtol=1e-6)
-    assert results[0]["n_plane"] * 2 == results[0]["n_pad"]
+               for r in range(nproc)]
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0]["losses"], r["losses"],
+                                   rtol=1e-6)
+    assert results[0]["n_plane"] * nproc == results[0]["n_pad"]
     # multi-process Infinity checkpoint: the gathered-plane save/re-sliced
     # load continues the trajectory exactly
     np.testing.assert_allclose(results[0]["resumed_loss"],
@@ -192,6 +202,7 @@ print("LOSSES=" + json.dumps(losses))
 """
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run([sys.executable, "-c", code], env=env,
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-4000:]
@@ -201,18 +212,117 @@ print("LOSSES=" + json.dumps(losses))
                                rtol=3e-4, atol=3e-4)
 
 
-def test_zero3_two_processes_matches_single_process(tmp_path):
-    """ZeRO-3 trained as 2 REAL processes (2×4 devices, gloo collectives,
-    per-process batch feeding) reproduces the single-process fake-8
-    trajectory exactly — same global program, different deployment."""
-    launch_ranks("worker_zero3.py", 2, str(tmp_path))
-    results = [json.load(open(tmp_path / f"rank{r}.json")) for r in (0, 1)]
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_zero3_two_processes_matches_single_process(tmp_path, nproc):
+    """ZeRO-3 trained as N REAL processes (N x 8/N devices, gloo
+    collectives, per-process batch feeding) reproduces the single-process
+    fake-8 trajectory exactly — same global program, different
+    deployment."""
+    launch_ranks("worker_zero3.py", nproc, str(tmp_path),
+                 extra_env={"T_DEVS": str(8 // nproc)})
+    results = [json.load(open(tmp_path / f"rank{r}.json"))
+               for r in range(nproc)]
     assert all(r["world_devices"] == 8 for r in results)
-    # both ranks observed the same (replicated) loss trajectory
-    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
-                               rtol=1e-6)
+    # every rank observed the same (replicated) loss trajectory
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0]["losses"], r["losses"],
+                                   rtol=1e-6)
     # and it matches the single-process oracle on the same 8-device mesh
     oracle = _single_process_losses(zero_stage=3)
     np.testing.assert_allclose(results[0]["losses"], oracle, rtol=2e-4)
     # training actually progressed
     assert results[0]["losses"][-1] < results[0]["losses"][0]
+
+
+def test_elastic_failure_resume_at_new_world_size(tmp_path):
+    """Failure path end to end (VERDICT r4 item 8): 2 nodes train under
+    the elastic agent, one node is SIGKILLED mid-attempt, the survivor's
+    agent re-forms the gang at world=1, and the restarted worker RESUMES
+    from the multi-process checkpoint (orbax reshard-on-load onto the
+    smaller world) and continues the trajectory."""
+    import signal
+    import textwrap
+    import time as _time
+
+    from deepspeed_tpu.elasticity.rendezvous import RendezvousServer
+
+    agent_code = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {_REPO!r})
+        from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                            WorkerSpec)
+        spec = WorkerSpec(cmd=[sys.executable, os.environ["T_WORKER"]],
+                          max_restarts=4, monitor_interval=0.1,
+                          heartbeat_ttl=2.0)
+        DSElasticAgent(spec).run()
+    """)
+
+    srv = RendezvousServer()
+    worker_py = str(_HERE / "worker_elastic_train.py")
+
+    logs = []
+
+    def spawn(node_id):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "DS_RDZV_ENDPOINT": srv.endpoint,
+            "DS_ELASTIC_NODE_ID": node_id,
+            "DS_ELASTIC_MIN_NODES": "1",
+            "T_WORKER": worker_py,
+            "T_REPO": _REPO,
+            "T_OUT": str(tmp_path),
+            "T_CKPT": str(tmp_path / "ckpt"),
+            "T_DEVS": "4",
+            "T_PARK_S": "45",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+        })
+        log = open(tmp_path / f"agent_{node_id}.log", "w")
+        logs.append(log)
+        # own process group: cleanup can kill the agent AND its parked
+        # worker children in one signal (no orphaned trainers on CI)
+        return subprocess.Popen(
+            [sys.executable, "-c", agent_code], env=env,
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+
+    def _logs():
+        return "".join(
+            f"===== {n} =====\n" + open(tmp_path / f"agent_{n}.log").read()[-3000:]
+            for n in ("n0", "n1"))
+
+    a0 = a1 = None
+    try:
+        a0 = spawn("n0")
+        _time.sleep(2.0)  # staggered join: one scale-up bump, less churn
+        a1 = spawn("n1")
+        # wait until a pre-kill attempt has trained + checkpointed
+        deadline = _time.time() + 300
+        while not (tmp_path / "ckpt").exists() and _time.time() < deadline:
+            _time.sleep(1.0)
+        assert (tmp_path / "ckpt").exists(), \
+            "pre-kill attempt never saved\n" + _logs()
+        _time.sleep(5.0)  # let the collective save commit
+        a1.send_signal(signal.SIGKILL)  # node loss — no goodbye
+        a1.wait(timeout=15)
+        (tmp_path / "kill_done").touch()  # flip workers to report phase
+        assert a0.wait(timeout=300) == 0, _logs()
+        res = json.load(open(tmp_path / "elastic_rank0.json"))
+        assert res["world"] == 1          # re-formed at the new world size
+        assert res["restart"] >= 1        # the gang actually restarted
+        assert res["resumed_step"] >= 2   # resumed FROM THE CHECKPOINT
+        assert res["final_step"] == res["resumed_step"] + 2
+        assert all(np.isfinite(l) for l in res["losses"])
+    finally:
+        for a in (a0, a1):
+            if a is not None:
+                try:  # kill the whole process group (agent + workers)
+                    os.killpg(os.getpgid(a.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for log in logs:
+            log.close()
+        srv.shutdown()

@@ -1,0 +1,83 @@
+"""Elastic failure-path worker: real training under the DSElasticAgent.
+
+Attempt 0 runs at world=2 (two agents), trains, checkpoints, then parks
+mid-attempt so the test can SIGKILL one node's agent.  The survivor's
+agent detects the stale peer, bumps the round, and re-runs this worker at
+world=1 — which RESUMES from the checkpoint (orbax reshard-on-load onto
+the smaller world) and finishes the trajectory.  The reference analogue:
+``DSElasticAgent`` + universal-checkpoint resume at a new world size
+(SURVEY §5.3/§5.4).
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("T_DEVS", "4"))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["T_REPO"])
+sys.path.insert(0, os.path.dirname(__file__))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu as dst  # noqa: E402
+
+
+def main() -> int:
+    world_env = int(os.environ.get("NUM_PROCESSES", "1"))
+    if world_env > 1:
+        dst.init_distributed()
+    rank = jax.process_index()
+    world = jax.process_count()
+    restart = int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0"))
+    ckpt = os.environ["T_CKPT"]
+    # phase gate: before the kill (marker absent) every attempt trains,
+    # checkpoints, and PARKS — robust to rendezvous round churn (solo
+    # min_nodes=1 rounds, scale-up bumps); after the kill the surviving
+    # attempt resumes from the checkpoint and reports
+    after_kill = os.path.exists(
+        os.path.join(os.environ["T_OUT"], "kill_done"))
+
+    from mp_common import base_config, make_problem
+
+    loss_fn, params, (x, y) = make_problem()
+    engine, _, _, _ = dst.initialize(
+        model=loss_fn, model_parameters=params,
+        config=base_config(zero_stage=3))
+    if os.path.isdir(ckpt):
+        try:
+            engine.load_checkpoint(ckpt)
+        except Exception:
+            pass  # half-written save from a churned round — start fresh
+    resumed_step = int(engine.state.step)
+
+    n = x.shape[0] // world
+    lo = rank * n
+    local = (np.asarray(x[lo:lo + n]), np.asarray(y[lo:lo + n]))
+    losses = [float(engine.train_step(local)["loss"]) for _ in range(2)]
+
+    if not after_kill:
+        engine.save_checkpoint(ckpt)
+        # park mid-attempt: the test kills one node's agent here; the
+        # survivor's round bump tears this worker down (SIGTERM)
+        time.sleep(float(os.environ.get("T_PARK_S", "120")))
+        return 0
+
+    out = {"rank": rank, "world": world, "restart": restart,
+           "resumed_step": resumed_step, "losses": losses,
+           "final_step": int(engine.state.step)}
+    with open(os.path.join(os.environ["T_OUT"],
+                           f"elastic_rank{rank}.json"), "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
-"""Rank worker: ZeRO-Infinity layer streaming as one of 2 REAL processes
-with PER-PROCESS host planes — each process owns 1/2 of every layer's
+"""Rank worker: ZeRO-Infinity layer streaming as one of N REAL processes
+with PER-PROCESS host planes — each process owns 1/N of every layer's
 master/moments/wire plane, the device wire is all-gathered in-graph, and
 gradients come back as per-process flat chunks (the reference's
 partitioned-optimizer-state deployment, SURVEY §2.1 #17)."""
@@ -8,8 +8,10 @@ import json
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("T_DEVS", "4"))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -31,7 +33,7 @@ def main() -> int:
     from deepspeed_tpu.parallel import MeshLayout
     from deepspeed_tpu.utils import groups
 
-    mesh = groups.initialize_mesh(MeshLayout.infer(8))  # dp=8 over 2 procs
+    mesh = groups.initialize_mesh(MeshLayout.infer(8))  # dp=8 over N procs
     cfg = LlamaConfig.tiny(num_layers=4, dtype=jnp.float32)
     model = LlamaModel(cfg, mesh=mesh)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -46,11 +48,13 @@ def main() -> int:
                                      config=ds, mesh=mesh)
     assert engine.infinity is not None
     sw = engine.infinity.swapper
-    # per-process host planes: each process holds HALF the flat plane
-    assert sw.shard_world == 2 and sw.n_plane == sw.n_pad // 2
+    # per-process host planes: each process holds 1/world of the flat plane
+    world = jax.process_count()
+    assert sw.shard_world == world and sw.n_plane == sw.n_pad // world
 
     ids = np.random.RandomState(0).randint(0, 512, size=(8, 32))
-    local = {"input_ids": ids[rank * 4:(rank + 1) * 4]}  # per-process rows
+    rows = 8 // world
+    local = {"input_ids": ids[rank * rows:(rank + 1) * rows]}
 
     losses = [float(engine.train_step(local)["loss"]) for _ in range(3)]
 
