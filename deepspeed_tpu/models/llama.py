@@ -172,6 +172,28 @@ def _tp_reduce_bwd(_, g):
 _tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
 
 
+@jax.custom_vjp
+def _tp_max(x: jnp.ndarray) -> jnp.ndarray:
+    """Cross-rank max over the manual ``tensor`` axis with a ZERO
+    backward — used only for the log-sum-exp shift, whose derivative
+    w.r.t. the shift is identically 0 (``lax.pmax`` has no autodiff rule
+    at all, so the no-op cotangent must be spelled out)."""
+    from ..parallel.mesh import AXIS_TENSOR
+
+    return jax.lax.pmax(x, AXIS_TENSOR)
+
+
+def _tp_max_fwd(x):
+    return _tp_max(x), None
+
+
+def _tp_max_bwd(_, g):
+    return (jnp.zeros_like(g),)
+
+
+_tp_max.defvjp(_tp_max_fwd, _tp_max_bwd)
+
+
 def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     dt = x.dtype
     x32 = x.astype(jnp.float32)
@@ -706,6 +728,66 @@ class LlamaModel:
         hidden = _rms_norm(x, params["final_norm"].astype(c.dtype),
                            c.rms_norm_eps)
         return self._ce_from_hidden(params, hidden, labels)
+
+    def head_loss_manual_tp(self, params: Any, x: jnp.ndarray, batch: Any
+                            ) -> jnp.ndarray:
+        """Vocab-parallel loss tail for the manual-TP 1F1B region:
+        ``params["lm_head"]`` is this rank's COLUMN shard ``[H, V/tp]``
+        (Megatron parallel cross entropy) — local logits, cross-rank
+        max-shifted log-sum-exp and gold-logit gather via explicit
+        collectives, so no rank ever materializes (or differentiates)
+        the full-vocab projection.  Numerics match
+        :func:`masked_cross_entropy` on the gathered logits."""
+        from ..parallel.mesh import AXIS_TENSOR
+
+        c = self.config
+        _, labels = self.batch_labels(batch)
+        hidden = _rms_norm(x, params["final_norm"].astype(c.dtype),
+                           c.rms_norm_eps)
+        W = params["lm_head"].astype(c.dtype)          # [H, V/tp] local
+        vshard = W.shape[-1]
+        rank = jax.lax.axis_index(AXIS_TENSOR)
+
+        def chunk_nll(hid, lab):
+            """(Σ nll over valid, valid count) for one sequence chunk."""
+            logits = jnp.einsum("bsH,HV->bsV", _tp_copy(hid),
+                                W).astype(jnp.float32)
+            valid = lab != -100
+            # max-shift across shards; zero-grad (d lse/dm is 0)
+            m = _tp_max(jnp.max(logits, axis=-1))       # [B, s]
+            se = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+            lse = jnp.log(_tp_reduce(se)) + m
+            # gold logit lives on exactly one shard
+            off = jnp.where(valid, lab, 0) - rank * vshard
+            in_shard = (off >= 0) & (off < vshard)
+            gold_loc = jnp.take_along_axis(
+                logits, jnp.clip(off, 0, vshard - 1)[..., None],
+                -1)[..., 0]
+            gold = _tp_reduce(jnp.where(in_shard, gold_loc, 0.0))
+            nll = lse - gold
+            return (jnp.sum(jnp.where(valid, nll, 0.0)),
+                    jnp.sum(valid).astype(jnp.int32))
+
+        T = c.loss_tiles
+        if T > 1 and hidden.shape[1] % T == 0:
+            # ALST sequence tiling, vocab-parallel flavor: each tile's
+            # [B, S/T, V/tp] logits live only inside its (rematerialized)
+            # scan step — the same memory bound head_loss gets from
+            # sequence_tiled_loss
+            B, S, H = hidden.shape
+            hs = jnp.moveaxis(hidden.reshape(B, T, S // T, H), 1, 0)
+            ls = jnp.moveaxis(labels.reshape(B, T, S // T), 1, 0)
+
+            def body(carry, xs):
+                tot, cnt = carry
+                t, n = jax.checkpoint(chunk_nll)(xs[0], xs[1])
+                return (tot + t, cnt + n), None
+
+            (tot, cnt), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), jnp.int32(0)), (hs, ls))
+        else:
+            tot, cnt = chunk_nll(hidden, labels)
+        return tot / jnp.maximum(cnt, 1)
 
     def loss(self, params: Any, batch: Any) -> jnp.ndarray:
         """Next-token cross entropy.  ``batch`` is ``{"input_ids": [B, S]}``

@@ -159,7 +159,8 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
                         microbatches: Any,
                         mesh: Mesh,
                         manual_axes: tuple = (),
-                        trunk_specs: Any = None):
+                        trunk_specs: Any = None,
+                        head_specs: Any = None):
     """1F1B training schedule: mean loss + grads in ONE pass with O(pp)
     stashed activations per stage — vs GPipe-through-autodiff, which keeps
     all M microbatch activations live until the backward drain.
@@ -328,19 +329,19 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
     # (decoder_layer_manual_tp) — because tensor GSPMD constraints inside
     # the partial-manual region trip the XLA partitioner CHECK the engine
     # routing documents.  ``trunk_specs`` carries the model's pipe+tensor
-    # placement for the stacked layer params in that mode.  Known trade:
-    # embed/head enter replicated over tensor (P()), so each tensor rank
-    # computes the full-vocab head loss + its vjp redundantly — a
-    # vocab-parallel head (Megatron g on the logits) inside the manual
-    # region is the follow-up that removes the duplicated flops.
+    # placement for the stacked layer params in that mode.
+    # ``head_specs`` lets the head params enter tensor-SHARDED (the
+    # vocab-parallel Megatron cross entropy, head_loss_manual_tp);
+    # embed stays replicated (its per-micro gather is cheap).
     trunk_spec = (trunk_specs if trunk_specs is not None
                   else pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)))
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+    head_spec = head_specs if head_specs is not None else rep(head_params)
     loss, g_trunk, g_emb, g_head = jax.shard_map(
         per_stage, mesh=mesh,
-        in_specs=(trunk_spec, rep(embed_params), rep(head_params),
+        in_specs=(trunk_spec, rep(embed_params), head_spec,
                   rep(microbatches)),
-        out_specs=(P(), trunk_spec, rep(embed_params), rep(head_params)),
+        out_specs=(P(), trunk_spec, rep(embed_params), head_spec),
         check_vma=False,
         axis_names={AXIS_PIPE, *manual_axes})(
             stacked_params, embed_params, head_params, microbatches)

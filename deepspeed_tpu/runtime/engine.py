@@ -481,6 +481,14 @@ class DeepSpeedEngine:
         manual_tp = getattr(self, "_pp_1f1b_manual_tp", False)
         layer_impl = (mod.decoder_layer_manual_tp if manual_tp
                       else mod.decoder_layer)
+        vocab_parallel = (
+            manual_tp
+            and callable(getattr(mod, "head_loss_manual_tp", None))
+            and not getattr(getattr(mod, "config", None), "tie_embeddings",
+                            True)
+            and "lm_head" in resident)
+        head_impl = (mod.head_loss_manual_tp if vocab_parallel
+                     else mod.head_loss)
 
         def layer_fn(lp, act):
             x, aux = act
@@ -489,13 +497,14 @@ class DeepSpeedEngine:
 
         def head_fn(hp, act, mb):
             x, aux = act
-            loss = mod.head_loss(hp, x, mb) + aux_coef * aux
+            loss = head_impl(hp, x, mb) + aux_coef * aux
             # fp16 loss scaling INSIDE the schedule: the 1/M cotangent
             # seed then carries the scale through every stage's fp16 vjp
             return loss * scale if scale is not None else loss
 
         manual_axes: tuple = ()
         trunk_specs = None
+        head_specs = None
         if manual_tp:
             # tensor joins the manual set; the trunk in/out specs carry
             # the model's pipe+tensor placement (manual axes only — dp/
@@ -520,14 +529,33 @@ class DeepSpeedEngine:
             trunk_specs = jax.tree.map(
                 manual_only, mod.param_specs()["layers"],
                 is_leaf=lambda s: isinstance(s, P))
+            if vocab_parallel:
+                # vocab-parallel head (Megatron parallel CE): lm_head
+                # enters column-sharded over tensor; every other
+                # resident leaf stays replicated
+                head_specs = {k: jax.tree.map(lambda _: P(), v)
+                              for k, v in resident.items()}
+                head_specs["lm_head"] = P(None, _AT2)
+
+        # under the vocab-parallel head the EMBED argument must not carry
+        # the full lm_head into the manual region (embed_fwd never reads
+        # it): a replicated [H, V] copy + its fp32 zero-grad scan carry
+        # per device is exactly the footprint the sharded head removes
+        embed_resident = ({k: v for k, v in resident.items()
+                           if k != "lm_head"} if vocab_parallel
+                          else resident)
 
         loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(
-            layer_fn, compute_params["layers"], embed_fn, resident,
+            layer_fn, compute_params["layers"], embed_fn, embed_resident,
             head_fn, resident, micro, self.mesh,
-            manual_axes=manual_axes, trunk_specs=trunk_specs)
+            manual_axes=manual_axes, trunk_specs=trunk_specs,
+            head_specs=head_specs)
         self.last_pipe_stats = dict(stats, schedule="1f1b",
-                                    manual_tp=manual_tp)
-        grads = dict(jax.tree.map(jnp.add, g_emb, g_head))
+                                    manual_tp=manual_tp,
+                                    vocab_parallel_head=vocab_parallel)
+        grads = {k: (jax.tree.map(jnp.add, g_emb[k], v) if k in g_emb
+                     else v)
+                 for k, v in g_head.items()}
         grads["layers"] = g_trunk
         return grads, loss
 

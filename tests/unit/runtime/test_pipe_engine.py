@@ -181,6 +181,9 @@ def test_1f1b_under_tensor_axes_manual_tp():
     assert eng.last_pipe_stats is not None
     assert eng.last_pipe_stats["schedule"] == "1f1b"
     assert eng.last_pipe_stats["manual_tp"] is True
+    # untied head -> the vocab-parallel Megatron cross entropy runs
+    # (lm_head column-sharded inside the manual region)
+    assert eng.last_pipe_stats["vocab_parallel_head"] is True
     assert eng.last_pipe_stats["stash_depth"] == 2 * 2 - 1
 
     _, losses_gpipe = _llama_pp("gpipe", tp=2)
