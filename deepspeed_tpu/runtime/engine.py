@@ -481,12 +481,17 @@ class DeepSpeedEngine:
         manual_tp = getattr(self, "_pp_1f1b_manual_tp", False)
         layer_impl = (mod.decoder_layer_manual_tp if manual_tp
                       else mod.decoder_layer)
+        tp_now = int(self.mesh.shape.get("tensor", 1))
         vocab_parallel = (
             manual_tp
             and callable(getattr(mod, "head_loss_manual_tp", None))
             and not getattr(getattr(mod, "config", None), "tie_embeddings",
                             True)
-            and "lm_head" in resident)
+            and "lm_head" in resident
+            # shard_map hard-errors on non-divisible dims: a GPT-2-like
+            # vocab (50257) must keep the replicated head, not crash
+            and np.shape(jax.tree.leaves(resident["lm_head"])[0])[-1]
+            % max(tp_now, 1) == 0)
         head_impl = (mod.head_loss_manual_tp if vocab_parallel
                      else mod.head_loss)
 
@@ -537,25 +542,35 @@ class DeepSpeedEngine:
                               for k, v in resident.items()}
                 head_specs["lm_head"] = P(None, _AT2)
 
-        # under the vocab-parallel head the EMBED argument must not carry
-        # the full lm_head into the manual region (embed_fwd never reads
-        # it): a replicated [H, V] copy + its fp32 zero-grad scan carry
-        # per device is exactly the footprint the sharded head removes
-        embed_resident = ({k: v for k, v in resident.items()
-                           if k != "lm_head"} if vocab_parallel
-                          else resident)
+        # under the vocab-parallel head each manual-region argument
+        # carries ONLY what its role reads: the embed side drops lm_head
+        # (embed_fwd never touches it), the head side drops embed
+        # (head_loss_manual_tp reads final_norm + lm_head) — a redundant
+        # replicated [V, H]-scale copy PLUS its fp32 zero-grad scan-carry
+        # accumulator per device is the footprint at stake on each side
+        embed_resident = resident
+        head_resident = resident
+        if vocab_parallel:
+            embed_resident = {k: v for k, v in resident.items()
+                              if k != "lm_head"}
+            head_resident = {k: v for k, v in resident.items()
+                             if k in ("final_norm", "lm_head")}
+            head_specs = {k: head_specs[k] for k in head_resident}
 
         loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(
             layer_fn, compute_params["layers"], embed_fn, embed_resident,
-            head_fn, resident, micro, self.mesh,
+            head_fn, head_resident, micro, self.mesh,
             manual_axes=manual_axes, trunk_specs=trunk_specs,
             head_specs=head_specs)
         self.last_pipe_stats = dict(stats, schedule="1f1b",
                                     manual_tp=manual_tp,
                                     vocab_parallel_head=vocab_parallel)
-        grads = {k: (jax.tree.map(jnp.add, g_emb[k], v) if k in g_emb
-                     else v)
-                 for k, v in g_head.items()}
+        grads = {}
+        for k in set(g_emb) | set(g_head):
+            if k in g_emb and k in g_head:
+                grads[k] = jax.tree.map(jnp.add, g_emb[k], g_head[k])
+            else:
+                grads[k] = g_emb[k] if k in g_emb else g_head[k]
         grads["layers"] = g_trunk
         return grads, loss
 

@@ -193,6 +193,10 @@ _AGENT = textwrap.dedent("""
 def _spawn_agent(tmp_path, endpoint, node_id, worker_py, log,
                  min_nodes, ttl=5.0, run_s=1.0):
     env = dict(os.environ)
+    # CPU-only subprocess: without this the axon sitecustomize registers
+    # the tunneled TPU backend in the agent — a dead tunnel then hangs
+    # the interpreter at import (same guard as tests/unit/multiprocess)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({
         "DS_RDZV_ENDPOINT": endpoint,
         "DS_ELASTIC_NODE_ID": node_id,
