@@ -729,6 +729,11 @@ class LlamaModel:
                            c.rms_norm_eps)
         return self._ce_from_hidden(params, hidden, labels)
 
+    #: resident leaves head_loss_manual_tp reads — the engine narrows the
+    #: manual-region head argument to exactly these (a module reading more
+    #: must extend this, or the key goes missing inside the shard_map)
+    manual_tp_head_param_keys = ("final_norm", "lm_head")
+
     def head_loss_manual_tp(self, params: Any, x: jnp.ndarray, batch: Any
                             ) -> jnp.ndarray:
         """Vocab-parallel loss tail for the manual-TP 1F1B region:

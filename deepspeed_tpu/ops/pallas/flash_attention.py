@@ -314,7 +314,7 @@ def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
-                      window):
+                      window, interpret: bool = False):
     """Kernel backward: dq + dk/dv passes with VMEM-resident scores.
 
     Replaces the jnp chunked scan, which materialized [B, h, S, block]
@@ -357,6 +357,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+        interpret=interpret,
     )(qr, dor, kr, vr, lse_r, delta_r)
 
     dk, dv = pl.pallas_call(
@@ -378,6 +379,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
         ],
         out_shape=[jax.ShapeDtypeStruct((B * h, S, d), k.dtype),
                    jax.ShapeDtypeStruct((B * h, S, d), v.dtype)],
+        interpret=interpret,
     )(qr, dor, kr, vr, lse_r, delta_r)
 
     back = lambda a: a.reshape(B, h, S, d).transpose(0, 2, 1, 3)

@@ -481,7 +481,9 @@ class DeepSpeedEngine:
         manual_tp = getattr(self, "_pp_1f1b_manual_tp", False)
         layer_impl = (mod.decoder_layer_manual_tp if manual_tp
                       else mod.decoder_layer)
-        tp_now = int(self.mesh.shape.get("tensor", 1))
+        from ..parallel.mesh import AXIS_TENSOR as _ATg
+
+        tp_now = int(self.mesh.shape.get(_ATg, 1))
         vocab_parallel = (
             manual_tp
             and callable(getattr(mod, "head_loss_manual_tp", None))
@@ -553,8 +555,11 @@ class DeepSpeedEngine:
         if vocab_parallel:
             embed_resident = {k: v for k, v in resident.items()
                               if k != "lm_head"}
+            head_keys = tuple(getattr(
+                mod, "manual_tp_head_param_keys",
+                ("final_norm", "lm_head")))
             head_resident = {k: v for k, v in resident.items()
-                             if k in ("final_norm", "lm_head")}
+                             if k in head_keys}
             head_specs = {k: head_specs[k] for k in head_resident}
 
         loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(

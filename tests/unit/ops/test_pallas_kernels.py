@@ -88,3 +88,41 @@ def test_quantize_zero_rows():
     assert np.all(np.asarray(q) == 0)
     back = dequantize_int8(q, s)
     assert np.all(np.asarray(back) == 0)
+
+
+def test_flash_backward_kernels_match_reference_all_modes():
+    """The Pallas flash backward pair (_fa_bwd_dq_kernel/_fa_bwd_dkv_kernel,
+    interpret mode here; on-chip via bench --selfcheck) == the reference
+    vjp for causal, non-causal, and windowed attention — the kernels that
+    took the 110M headline from 30.2% to 40.6% MFU must stay testable
+    without a chip."""
+    import importlib
+
+    import numpy as np
+
+    fa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.flash_attention")
+
+    rng = np.random.default_rng(0)
+    B, S, h, d = 1, 256, 2, 64
+    q = jnp.asarray(rng.normal(size=(B, S, h, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, h, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, h, d)) * 0.3, jnp.float32)
+    do = jnp.asarray(rng.normal(size=(B, S, h, d)), jnp.float32)
+
+    for causal, window in ((True, None), (False, None), (True, 128),
+                           (False, 128)):
+        out, lse = fa._reference_fwd_with_lse(q, k, v, causal, window)
+        got = fa._flash_bwd_pallas(q, k, v, out, lse, do, causal, 64, 64,
+                                   window, interpret=True)
+
+        def f(q_, k_, v_):
+            return fa._reference_fwd_with_lse(q_, k_, v_, causal,
+                                              window)[0]
+
+        _, vjp = jax.vjp(f, q, k, v)
+        want = vjp(do)
+        for a, b, nm in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{nm} causal={causal} window={window}")
