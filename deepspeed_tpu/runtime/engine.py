@@ -484,16 +484,42 @@ class DeepSpeedEngine:
         from ..parallel.mesh import AXIS_TENSOR as _ATg
 
         tp_now = int(self.mesh.shape.get(_ATg, 1))
+        # the module declares which resident leaves its manual-TP head
+        # reads; the TENSOR-SHARDED ones among them (from the module's own
+        # param_specs — no key names hardcoded here) are the vocab-scale
+        # leaves the split exists to keep off the replicated path
+        head_keys = tuple(getattr(mod, "manual_tp_head_param_keys", ()))
+        base = self.base_specs or {}
+
+        def _tensor_dim(key):
+            spec = base.get(key)
+            if spec is None:
+                return None
+            ent = tuple(spec)
+            for i, e in enumerate(ent):
+                axes = e if isinstance(e, (tuple, list)) else (e,)
+                if any(a == _ATg for a in axes if a):
+                    return i
+            return None
+
+        sharded_head_keys = [k for k in head_keys
+                             if k in resident and _tensor_dim(k) is not None]
+
+        def _divides(key):
+            dim = _tensor_dim(key)
+            shape = np.shape(jax.tree.leaves(resident[key])[0])
+            return shape[dim] % max(tp_now, 1) == 0
+
         vocab_parallel = (
             manual_tp
             and callable(getattr(mod, "head_loss_manual_tp", None))
             and not getattr(getattr(mod, "config", None), "tie_embeddings",
                             True)
-            and "lm_head" in resident
+            and bool(sharded_head_keys)
+            and all(k in resident for k in head_keys)
             # shard_map hard-errors on non-divisible dims: a GPT-2-like
             # vocab (50257) must keep the replicated head, not crash
-            and np.shape(jax.tree.leaves(resident["lm_head"])[0])[-1]
-            % max(tp_now, 1) == 0)
+            and all(_divides(k) for k in sharded_head_keys))
         head_impl = (mod.head_loss_manual_tp if vocab_parallel
                      else mod.head_loss)
 
@@ -537,12 +563,14 @@ class DeepSpeedEngine:
                 manual_only, mod.param_specs()["layers"],
                 is_leaf=lambda s: isinstance(s, P))
             if vocab_parallel:
-                # vocab-parallel head (Megatron parallel CE): lm_head
-                # enters column-sharded over tensor; every other
-                # resident leaf stays replicated
-                head_specs = {k: jax.tree.map(lambda _: P(), v)
-                              for k, v in resident.items()}
-                head_specs["lm_head"] = P(None, _AT2)
+                # vocab-parallel head (Megatron parallel CE): the
+                # module's tensor-sharded head leaves enter with their
+                # OWN param_specs placement (manual axes only); the rest
+                # stay replicated
+                head_specs = {
+                    k: (manual_only(base[k]) if k in sharded_head_keys
+                        else jax.tree.map(lambda _: P(), resident[k]))
+                    for k in head_keys}
 
         # under the vocab-parallel head each manual-region argument
         # carries ONLY what its role reads: the embed side drops lm_head
@@ -554,13 +582,9 @@ class DeepSpeedEngine:
         head_resident = resident
         if vocab_parallel:
             embed_resident = {k: v for k, v in resident.items()
-                              if k != "lm_head"}
-            head_keys = tuple(getattr(
-                mod, "manual_tp_head_param_keys",
-                ("final_norm", "lm_head")))
+                              if k not in sharded_head_keys}
             head_resident = {k: v for k, v in resident.items()
                              if k in head_keys}
-            head_specs = {k: head_specs[k] for k in head_resident}
 
         loss, (g_trunk, g_emb, g_head), stats = pipeline_train_1f1b(
             layer_fn, compute_params["layers"], embed_fn, embed_resident,
