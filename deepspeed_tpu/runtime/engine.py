@@ -134,10 +134,7 @@ class DeepSpeedEngine:
                 raise NotImplementedError(
                     "layer streaming is itself layer-sequential; combine it "
                     "with dp/tp/sp axes, not pipe")
-            if config.fp16.enabled is True:
-                raise NotImplementedError(
-                    "fp16 loss scaling is not implemented in layer-streaming "
-                    "(Infinity) mode — use bf16 (TPU-preferred) or fp32")
+
         self.compute_dtype = config.dtype()
         self.fp16_enabled = config.fp16.enabled is True
         self.bf16_enabled = config.bf16.enabled is True
@@ -297,13 +294,8 @@ class DeepSpeedEngine:
         # scale itself, and f16 max is 65504 — a 2^16 seed is inf before the
         # first multiply. (The dynamic grower may probe 2^16 and back off.)
         fp16 = config.fp16
-        self.loss_scaler = DynamicLossScaler(
-            initial_scale_power=min(fp16.initial_scale_power, 15),
-            loss_scale_window=fp16.loss_scale_window,
-            hysteresis=fp16.hysteresis, min_loss_scale=fp16.min_loss_scale,
-            static_scale=fp16.loss_scale,
-            consecutive_hysteresis=fp16.consecutive_hysteresis
-        ) if self.fp16_enabled else None
+        self.loss_scaler = (DynamicLossScaler.from_config(fp16)
+                            if self.fp16_enabled else None)
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -980,9 +972,10 @@ class DeepSpeedEngine:
         batch = self._feed_batch(batch)
         if self.infinity is not None:
             metrics = self.infinity.train_step(batch)
+            stepped = 0 if bool(metrics.get("overflow", False)) else 1
             self.state = self.state._replace(
                 params=self.infinity.resident,
-                step=self.state.step + 1)
+                step=self.state.step + stepped)
         elif self.offload_enabled:
             metrics = self._offload_train_step(batch)
         elif (self.onebit_enabled

@@ -40,6 +40,20 @@ class DynamicLossScaler:
         self.static = static_scale > 0
         self.consecutive_hysteresis = consecutive_hysteresis
 
+    @classmethod
+    def from_config(cls, fp16) -> "DynamicLossScaler":
+        """ONE home for FP16Config → scaler construction (fused engine
+        and Infinity streaming).  Caps ``initial_scale_power`` at 15: the
+        loss cotangent enters the f16 subgraph carrying the scale, and
+        f16 max is 65504 — a 2^16 seed would saturate immediately."""
+        return cls(
+            initial_scale_power=min(fp16.initial_scale_power, 15),
+            loss_scale_window=fp16.loss_scale_window,
+            hysteresis=fp16.hysteresis,
+            min_loss_scale=fp16.min_loss_scale,
+            static_scale=fp16.loss_scale,
+            consecutive_hysteresis=fp16.consecutive_hysteresis)
+
     def init_state(self) -> LossScaleState:
         return LossScaleState(scale=jnp.float32(self.init_scale),
                               growth_counter=jnp.int32(0),

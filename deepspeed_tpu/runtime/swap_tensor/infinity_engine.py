@@ -67,6 +67,18 @@ class LayerStreamingEngine:
         self.mesh = mesh
         self.L = int(c.num_layers)
         self.compute_dtype = config.dtype()
+        # fp16 loss scaling (reference fp16 + Infinity coexist): the
+        # scaler state lives HOST-SIDE — the streamed step is a Python
+        # pipeline, so the skip/backoff decision is eager.  fp16 routes
+        # through the STASH path (updates deferred until the overflow
+        # vote), never the fused write-behind.  Scaler counters are not
+        # persisted across checkpoint resume (the scale re-warms).
+        self.fp16 = config.fp16.enabled is True
+        if self.fp16:
+            from ..precision import DynamicLossScaler
+
+            self.scaler = DynamicLossScaler.from_config(config.fp16)
+            self.scale_state = self.scaler.init_state()
         wire_dtype = (self.compute_dtype
                       if self.compute_dtype != jnp.float32 else jnp.float32)
 
@@ -361,18 +373,21 @@ class LayerStreamingEngine:
         elif name == "layer_bwd":
             aux_coef = self.aux_coef
 
-            def bwd(lp, x, dx):
+            def bwd(lp, x, dx, ls):
                 # cotangents: dx from downstream + d(total_loss)/d(aux) =
-                # aux_coef — this is how the router balancing loss reaches
-                # the layer params without a second pass
+                # aux_coef·ls — this is how the router balancing loss
+                # reaches the layer params without a second pass (ls = the
+                # fp16 loss scale riding every cotangent; 1 otherwise)
                 (out, aux), vjp = jax.vjp(model.decoder_layer, lp, x)
                 del out, aux
-                dlp, dx_prev = vjp((dx, jnp.float32(aux_coef)))
+                dlp, dx_prev = vjp((dx, jnp.float32(aux_coef) * ls))
                 return dx_prev, dlp
             fn = jax.jit(bwd)
         elif name == "head_grad":
-            def head(res, x, batch):
-                return model.head_loss(cast_res(res), x, batch)
+            def head(res, x, batch, ls):
+                # fp16: the SCALED loss is what gets differentiated, so
+                # cotangents stay in fp16 range through every layer
+                return model.head_loss(cast_res(res), x, batch) * ls
             fn = jax.jit(jax.value_and_grad(head, argnums=(0, 1)))
         elif name == "embed_grad":
             V = int(self.model.config.vocab_size)
@@ -428,10 +443,14 @@ class LayerStreamingEngine:
         layer_bwd = self._fn("layer_bwd")
         sq_norm = self._fn("sq_norm")
         # fused mode: update each layer during backward (write-behind).
-        # gas > 1 and global clipping both need the full gradient before any
-        # update, so they stash grad planes and run a second (update) pass —
-        # the reference separates backward and optimizer.step() the same way.
-        fused = (gas == 1 and self.clip <= 0.0)
+        # gas > 1, global clipping, AND fp16 all need the full gradient
+        # before any update (fp16: the overflow vote must precede every
+        # apply), so they stash grad planes and run a second (update) pass
+        # — the reference separates backward and optimizer.step() the same
+        # way.
+        fused = (gas == 1 and self.clip <= 0.0 and not self.fp16)
+        ls = float(self.scale_state.scale) if self.fp16 else 1.0
+        ls_dev = jnp.float32(ls)
 
         lr = float(self.schedule(self.global_steps))
         sw.begin_step()
@@ -471,15 +490,16 @@ class LayerStreamingEngine:
                 aux_sum = aux_sum + aux
                 sw.release(i)
 
-            loss, (g_res, dx) = self._fn("head_grad")(self.resident, x, mb)
-            loss_sum = loss_sum + loss + self.aux_coef * aux_sum
+            loss, (g_res, dx) = self._fn("head_grad")(self.resident, x,
+                                                      mb, ls_dev)
+            loss_sum = loss_sum + loss / ls_dev + self.aux_coef * aux_sum
 
             # ---- backward: stream in reverse, update/stash behind ---------
             sw.prefetch(L - 1, full=fused)
             for i in reversed(range(L)):
                 lp = sw.get_device(i)
                 sw.prefetch(i - 1, full=fused)
-                dx, dlp = layer_bwd(lp, acts[i], dx)
+                dx, dlp = layer_bwd(lp, acts[i], dx, ls_dev)
                 acts[i] = None  # free the activation once consumed
                 if fused:
                     norm_sq_dev = norm_sq_dev + sq_norm(dlp)
@@ -500,37 +520,50 @@ class LayerStreamingEngine:
 
         # ---- global grad norm, clip scale, deferred update pass -----------
         res_sq = float(sq_norm(g_res_acc))
+        overflow = False
         if fused:
             grad_norm = float(np.sqrt(float(norm_sq_dev) + res_sq))
             scale = 1.0
         else:
-            # gplanes/g_res_acc hold SUMS over micros; the mean-loss grad is
-            # that sum / gas, so the norm divides by gas once.  Sharded
-            # planes are disjoint chunks → the global norm is the cross-
-            # process sum of local dots
+            # gplanes/g_res_acc hold SUMS over micros scaled by ls; the
+            # mean-loss grad is that sum / (gas·ls), so the norm divides
+            # by gas·ls once.  Sharded planes are disjoint chunks → the
+            # global norm is the cross-process sum of local dots
             trunk_sq = self._host_sum(sw.stashed_sq_norm())
-            grad_norm = float(np.sqrt(trunk_sq + res_sq)) / gas
-            scale = 1.0 / gas
-            if self.clip > 0.0 and grad_norm > self.clip:
-                scale *= self.clip / grad_norm
-            sw.prefetch(0, full=True)
-            for i in range(L):
-                sw.prefetch(i + 1, full=True)
-                # pipelined: layer i's C++ Adam overlaps layer i+1's
-                # read-ahead (and, nvme tier, i-1's write-behind)
-                sw.apply_stashed_async(i, lr=lr, scale=scale)
+            grad_norm = float(np.sqrt(trunk_sq + res_sq)) / (gas * ls)
+            # fp16 overflow vote: any non-finite stashed/resident grad
+            # poisons the norm — skip EVERY update, drop the stashed
+            # planes, roll back the Adam step counter, back the scaler off
+            overflow = self.fp16 and not np.isfinite(grad_norm)
+            scale = 1.0 / (gas * ls)
+            if not overflow:
+                if self.clip > 0.0 and grad_norm > self.clip:
+                    scale *= self.clip / grad_norm
+                sw.prefetch(0, full=True)
+                for i in range(L):
+                    sw.prefetch(i + 1, full=True)
+                    # pipelined: layer i's C++ Adam overlaps layer i+1's
+                    # read-ahead (and, nvme tier, i-1's write-behind)
+                    sw.apply_stashed_async(i, lr=lr, scale=scale)
+            else:
+                sw.discard_stashed()
+                sw.cancel_step()
 
-        self.resident, self.res_opt_state = self._fn("res_update")(
-            self.resident, self.res_opt_state, g_res_acc,
-            jnp.float32(scale))
+        if not overflow:
+            self.resident, self.res_opt_state = self._fn("res_update")(
+                self.resident, self.res_opt_state, g_res_acc,
+                jnp.float32(scale))
+            self.global_steps += 1
+        if self.fp16:
+            self.scale_state = self.scaler.update(self.scale_state,
+                                                  jnp.bool_(overflow))
 
         sw.flush()
-        self.global_steps += 1
         metrics = {"loss": jnp.asarray(loss_sum) / gas,
                    "lr": jnp.float32(lr),
                    "grad_norm": jnp.float32(grad_norm),
-                   "loss_scale": jnp.float32(1.0),
-                   "overflow": jnp.bool_(False)}
+                   "loss_scale": jnp.float32(ls),
+                   "overflow": jnp.bool_(overflow)}
         self.last_metrics = metrics
         return metrics
 

@@ -613,6 +613,17 @@ class PartitionedParamSwapper:
             accumulate = False
         self._flatten_grads(g, grads_tree, accumulate=accumulate)
 
+    def discard_stashed(self) -> None:
+        """Drop every stashed grad plane without applying (fp16 overflow
+        skip: the step never happened)."""
+        self._gplanes.clear()
+
+    def cancel_step(self) -> None:
+        """Roll back :meth:`begin_step`'s counter bump (fp16 overflow
+        skip — Adam bias correction must not advance on a skipped step)."""
+        self.drain_updates()
+        self.state_step = max(self.state_step - 1, 0)
+
     def stashed_sq_norm(self) -> float:
         """Σ‖g‖² over every stashed grad plane — THE place that knows where
         grad planes live (today host RAM; if they ever spill to NVMe this

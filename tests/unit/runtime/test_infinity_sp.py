@@ -175,3 +175,57 @@ def test_streaming_sp_tiled_loss_matches():
     eng2 = _build({"sp": 2}, streaming=True, loss_tiles=1)
     flat = _trajectory(eng2, b, steps=2)
     np.testing.assert_allclose(tiled, flat, rtol=2e-4, atol=2e-4)
+
+
+def test_fp16_streaming_matches_fused_and_skips_on_overflow():
+    """fp16 loss scaling through layer streaming (the reference runs fp16
+    Infinity): cotangents ride scaled through every per-layer vjp, host
+    planes unscale before the C++ Adam, and the overflow vote precedes
+    every update — trajectory == fused fp16 ZeRO-3; a poisoned resident
+    param skips the step (global_steps AND the Adam counter hold) and
+    backs the scaler off."""
+    b = {"input_ids": jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, size=(8, 32)))}
+
+    def build(streaming):
+        groups.reset_mesh()
+        mesh = groups.initialize_mesh(MeshLayout.infer(8))
+        cfg = LlamaConfig.tiny(num_layers=3, dtype=jnp.float16)
+        model = LlamaModel(cfg, mesh=mesh)
+        params = model.init_params(jax.random.PRNGKey(0))
+        zo = {"stage": 3}
+        if streaming:
+            zo["offload_param"] = {"device": "cpu"}
+        eng, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, mesh=mesh,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "fp16": {"enabled": True, "initial_scale_power": 8,
+                             "hysteresis": 1, "loss_scale_window": 2},
+                    "zero_optimization": zo})
+        return eng
+
+    e1 = build(True)
+    assert e1.infinity is not None and e1.infinity.fp16
+    l1 = [float(e1.train_step(b)["loss"]) for _ in range(4)]
+    e2 = build(False)
+    l2 = [float(e2.train_step(b)["loss"]) for _ in range(4)]
+    np.testing.assert_allclose(l1, l2, rtol=5e-3, atol=5e-3)
+    assert l1[-1] < l1[0]
+
+    # overflow skip: poison a resident master -> fp16 cast inf
+    e3 = build(True)
+    m0 = e3.train_step(b)
+    scale0 = float(m0["loss_scale"])
+    steps_before = e3.infinity.global_steps
+    adam_before = e3.infinity.swapper.state_step
+    engine_step_before = int(e3.state.step)
+    poisoned = dict(e3.infinity.resident)
+    poisoned["embed"] = e3.infinity.resident["embed"] * 1e38
+    e3.infinity.resident = poisoned
+    m = e3.train_step(b)
+    assert bool(m["overflow"]) is True
+    assert e3.infinity.global_steps == steps_before
+    assert e3.infinity.swapper.state_step == adam_before
+    assert int(e3.state.step) == engine_step_before
+    assert float(e3.infinity.scale_state.scale) == scale0 / 2
