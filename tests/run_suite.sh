@@ -20,9 +20,10 @@ SHARDS=(
   "tests/unit/inference"
   "tests/unit/launcher tests/unit/models"
   "tests/unit/moe tests/unit/ops tests/unit/parallel"
-  "tests/unit/runtime --ignore=tests/unit/runtime/test_infinity.py --ignore=tests/unit/runtime/test_infinity_sp.py --ignore=tests/unit/runtime/test_pipe_engine.py"
+  "tests/unit/runtime --ignore=tests/unit/runtime/test_infinity.py --ignore=tests/unit/runtime/test_infinity_sp.py --ignore=tests/unit/runtime/test_infinity_opt_fp16.py --ignore=tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/runtime/test_infinity.py"
   "tests/unit/runtime/test_infinity_sp.py"
+  "tests/unit/runtime/test_infinity_opt_fp16.py"
   "tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
   "tests/unit/multiprocess"
