@@ -145,6 +145,10 @@ def build_engine(cfg, batch, zero_stage=0, offload=False, bf16=True,
         "zero_optimization": zero,
         "bf16": {"enabled": bf16},
         "steps_per_print": 0,
+        # engine-side StepRecords are THE measured numbers (ISSUE 1: bench
+        # reports what the engine logged, so artifacts and telemetry can
+        # never disagree); in-memory only — no file exporters in a bench
+        "telemetry": {"enabled": True, "jsonl": False, "prometheus": False},
     }
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config, mesh=mesh)
@@ -175,12 +179,31 @@ def measure(engine, batch, seq, vocab, steps, segments=3,
     per_step = max(time.perf_counter() - t0, 1e-4)
     steps = max(1, min(steps, int(budget_s / (segments * per_step))))
     rates = []
+    records = getattr(engine, "step_records", None)
     for _ in range(segments):
+        # step-id marker, not a length index: the deque's maxlen eviction
+        # would freeze a length-based cursor once it wraps
+        mark = records[-1].step if records else 0
         t0 = time.perf_counter()
         for _ in range(steps):
             m = engine.train_step(data)
         _sync(m)
-        rates.append(batch * seq * steps / (time.perf_counter() - t0))
+        wall = time.perf_counter() - t0
+        segment = ([r for r in records if r.step > mark and r.device_fenced]
+                   if records is not None else [])
+        if segment:
+            # the engine's OWN device-fenced StepRecords are the measured
+            # numbers — the bench just aggregates them, so the emitted
+            # metric line and the engine telemetry cannot disagree.
+            # Cross-check against wall: record assembly/export overhead
+            # is real run cost, so if the per-step device sum diverges
+            # from wall by >5% the (cross-round-comparable, conservative)
+            # wall number wins.
+            dev_s = sum(r.step_time_ms for r in segment) / 1e3
+            denom = dev_s if abs(wall - dev_s) <= 0.05 * wall else wall
+            rates.append(batch * seq * len(segment) / max(denom, 1e-9))
+        else:  # engine without telemetry: fall back to wall clock
+            rates.append(batch * seq * steps / wall)
     return sorted(rates)[len(rates) // 2]
 
 
@@ -881,8 +904,9 @@ def main() -> None:
                       dtype=jnp.bfloat16, attn_impl="flash")
     batch, seq = 8, 2048
     engine = build_engine(cfg, batch)
-    tps = measure(engine, batch, seq, cfg.vocab_size, steps=20)
     flops = step_flops(engine, batch, seq, cfg.vocab_size, cfg)
+    engine.flops_per_step = flops  # StepRecords then carry TFLOPS/MFU too
+    tps = measure(engine, batch, seq, cfg.vocab_size, steps=20)
     peak = peak_flops_per_chip()
     mfu = (flops * tps / (batch * seq)) / peak
     extras["mfu"] = round(mfu, 4)

@@ -36,7 +36,7 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..utils import groups as groups_mod
@@ -145,6 +145,16 @@ class CommsLogger:
 
     def summary(self) -> dict[str, dict[str, float]]:
         return self.stats
+
+    def total_bytes(self) -> int:
+        """Cumulative bytes over every call-site record (eager timing +
+        trace-time census); the engine's StepRecord carries this so BENCH
+        artifacts and the telemetry registry report one number.  Execution-
+        probe bytes are a separate measure — see :meth:`exec_summary`."""
+        return int(sum(e.get("bytes", 0) for e in self.stats.values()))
+
+    def total_ops(self) -> int:
+        return int(sum(e.get("count", 0) for e in self.stats.values()))
 
     def exec_summary(self) -> dict[str, dict[str, float]]:
         """Per-execution stats; counts are per local device shard per run

@@ -239,9 +239,31 @@ class ElasticRendezvous:
     def bump_round(self, reason: str = "") -> int:
         r = self.c.add("rdzv/round", 1)
         log_dist(f"rendezvous round bumped to {r} ({reason})")
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "elastic/round_bumps",
+            help="rendezvous round counter bumps (membership churn)")
         return r
 
     def next_round(self) -> Tuple[int, int, int, str]:
+        """(Re-)join; blocks until a round seals with this node inside —
+        telemetry: the wait is one span, a sealed join bumps
+        ``elastic/rounds_joined`` and sets the ``elastic/world`` gauge."""
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        with tel.span("elastic/next_round", args={"node": self.node_id}):
+            out = self._next_round_impl()
+        tel.inc_counter("elastic/rounds_joined",
+                        help="rendezvous rounds this node sealed into")
+        tel.set_gauge("elastic/world", out[2],
+                      help="world size of the current round")
+        tel.set_gauge("elastic/round", out[0],
+                      help="current rendezvous round id")
+        return out
+
+    def _next_round_impl(self) -> Tuple[int, int, int, str]:
         deadline = time.monotonic() + self.timeout_s
         my_host = _my_host(self.c._addr)
         while True:
@@ -375,6 +397,12 @@ class ElasticRendezvous:
             self._hb_missing.pop(pid, None)
             if now - float(ts) > ttl_s:
                 stale.append(pid)
+        if stale:
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "elastic/stale_peers_detected", v=len(stale),
+                help="peers whose heartbeat went stale (suspected deaths)")
         return stale
 
 

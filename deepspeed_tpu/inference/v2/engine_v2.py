@@ -326,6 +326,9 @@ class RaggedInferenceEngineV2:
         are in, decodes run ``decode_burst`` steps per dispatch.  Returns
         the number of tokens processed."""
         del rng  # sampling is in-graph now; kept for API compat
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
         chunks, decode = self.scheduler.plan_step()
         temp = jnp.float32(temperature)
         n_tokens = 0
@@ -341,15 +344,20 @@ class RaggedInferenceEngineV2:
                 tables[i] = self.scheduler.table_row(ch.request)
                 start[i] = ch.start_pos
                 last[i] = max(ch.n_valid - 1, 0)
-            sampled, self.pool = self._prefill(
-                self.params, self.pool, jnp.asarray(tokens),
-                jnp.asarray(tables), jnp.asarray(start), jnp.asarray(last),
-                temp, self._next_key(), kb=self._prefill_bucket(chunks))
-            sampled = np.asarray(sampled)
+            with tel.span("inference/prefill",
+                          args={"chunks": len(chunks)}):
+                sampled, self.pool = self._prefill(
+                    self.params, self.pool, jnp.asarray(tokens),
+                    jnp.asarray(tables), jnp.asarray(start),
+                    jnp.asarray(last), temp, self._next_key(),
+                    kb=self._prefill_bucket(chunks))
+                sampled = np.asarray(sampled)
             for i, ch in enumerate(chunks):
                 first = int(sampled[i]) if ch.is_last else None
                 self.scheduler.chunk_done(ch, first, eos_token_id)
                 n_tokens += ch.n_valid
+            tel.inc_counter("inference/prefill_tokens", v=n_tokens,
+                            help="prompt tokens written through prefill")
         if decode:
             # exactly TWO decode program shapes ever compile (1 and
             # decode_burst): over-running a request's budget inside a
@@ -369,13 +377,18 @@ class RaggedInferenceEngineV2:
                 kv_lens[s] = req.prefilled + len(req.generated) - 1
                 max_pos[s] = len(req.prompt) + req.max_new_tokens - 1
                 tables[s] = self.scheduler.table_row(req)
-            toks, self.pool = self._decode(burst)(
-                self.params, self.pool, jnp.asarray(tokens),
-                jnp.asarray(kv_lens), jnp.asarray(tables),
-                jnp.asarray(max_pos), temp, self._next_key())
-            toks = np.asarray(toks)  # [burst, B]
-            n_tokens += self.scheduler.decode_burst_done(decode, toks,
-                                                         eos_token_id)
+            with tel.span("inference/decode_burst",
+                          args={"burst": burst, "batch": len(decode)}):
+                toks, self.pool = self._decode(burst)(
+                    self.params, self.pool, jnp.asarray(tokens),
+                    jnp.asarray(kv_lens), jnp.asarray(tables),
+                    jnp.asarray(max_pos), temp, self._next_key())
+                toks = np.asarray(toks)  # [burst, B]
+            accepted = self.scheduler.decode_burst_done(decode, toks,
+                                                        eos_token_id)
+            n_tokens += accepted
+            tel.inc_counter("inference/decode_tokens", v=accepted,
+                            help="decode tokens accepted by the scheduler")
         return n_tokens
 
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,
@@ -392,6 +405,11 @@ class RaggedInferenceEngineV2:
             total += self.step(temperature, eos_token_id)
         dt = time.perf_counter() - t0
         self.last_throughput = total / dt if dt > 0 else 0.0
+        from ...telemetry import get_telemetry
+
+        get_telemetry().set_gauge(
+            "inference/tokens_per_sec", self.last_throughput,
+            help="tokens/sec of the last generate() drive")
         return [r.generated for r in reqs]
 
 

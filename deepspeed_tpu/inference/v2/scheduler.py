@@ -106,6 +106,10 @@ class RaggedScheduler:
                       max_new_tokens=max_new_tokens)
         self._uid += 1
         self.waiting.append(req)
+        from ...telemetry import get_telemetry
+
+        get_telemetry().inc_counter("inference/requests",
+                                    help="requests admitted to the queue")
         return req
 
     @property
@@ -141,10 +145,31 @@ class RaggedScheduler:
             self.slots[slot] = req
             self.prefilling.append(req)
 
+    def telemetry_gauges(self) -> dict:
+        """Scheduler occupancy numbers, published each ``plan_step``:
+        queue depth, decode-slot occupancy, and KV-pool utilization (the
+        pool is the 'cache' — utilization is pages committed to live
+        sequences over the allocatable pool)."""
+        occupied = sum(1 for s in self.slots if s is not None)
+        allocatable = self.cache.num_blocks - 1  # page 0 reserved
+        return {
+            "inference/queue_depth": float(len(self.waiting)),
+            "inference/prefilling": float(len(self.prefilling)),
+            "inference/batch_occupancy": occupied / max(self.max_slots, 1),
+            "inference/kv_pool_utilization":
+                (allocatable - self.allocator.num_free) / max(allocatable, 1),
+        }
+
     def plan_step(self) -> tuple:
         """→ (list[PrefillChunk] (≤ ``prefill_batch``, one chunk per
         distinct prefilling request), decode_requests) for this step."""
         self._admit()
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel.enabled:
+            for name, v in self.telemetry_gauges().items():
+                tel.set_gauge(name, v)
         chunks: List[PrefillChunk] = []
         for req in list(self.prefilling)[:self.prefill_batch]:
             start = req.prefilled
@@ -212,6 +237,11 @@ class RaggedScheduler:
             if req.slot >= 0:
                 self.slots[req.slot] = None
                 req.slot = -1
+            from ...telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "inference/requests_done",
+                help="requests finished (EOS or budget)")
 
     def table_row(self, req: Request) -> np.ndarray:
         row = np.zeros((self.cache.max_blocks_per_seq,), np.int32)

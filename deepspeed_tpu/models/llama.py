@@ -335,7 +335,9 @@ class LlamaModel:
         from ..parallel.mesh import strip_manual_axes
 
         stripped = strip_manual_axes(*spec)
-        am = jax.sharding.get_abstract_mesh()
+        from ..utils.jax_compat import abstract_mesh_or_none
+
+        am = abstract_mesh_or_none()
         if am is not None and not am.empty:
             # inside a (partial-manual) shard_map / set_mesh scope: a bare
             # PartitionSpec binds to the CONTEXT mesh — a concrete-mesh

@@ -1,9 +1,11 @@
-"""Monitor fan-out: (tag, value, step) events → TensorBoard / W&B / CSV.
+"""Monitor fan-out: (tag, value, step) events → TensorBoard / W&B / CSV /
+the unified telemetry registry.
 
 Capability parity with the reference ``deepspeed/monitor/`` [K]:
 ``MonitorMaster`` dispatches to every enabled backend; config groups
-``tensorboard``, ``wandb``, ``csv_monitor`` (§5.5).  Comet/nebula are
-documented gaps (SURVEY §7 non-ported list).
+``tensorboard``, ``wandb``, ``csv_monitor`` plus the repo-native
+``telemetry`` group (§5.5).  Comet/nebula are documented gaps (SURVEY §7
+non-ported list).
 """
 
 from __future__ import annotations
@@ -83,6 +85,34 @@ class CSVMonitor:
                 w.writerow([tag, float(value), step])
 
 
+class TelemetryMonitor:
+    """Fourth backend: events land in the unified telemetry registry
+    (``deepspeed_tpu/telemetry/``) as gauges + JSONL ``monitor`` events —
+    so the existing ``monitor.write_events`` flow and the engine's
+    per-step records share one exporter pipeline."""
+
+    def __init__(self, cfg) -> None:
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self.hub = None
+        if self.enabled:
+            try:
+                from ..telemetry import configure_from_config
+
+                self.hub = configure_from_config(cfg)
+            except Exception as e:  # degrade like the other backends
+                logger.warning(f"telemetry monitor disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.hub is None:
+            return
+        for tag, value, step in events:
+            self.hub.set_gauge(tag, float(value))
+            self.hub.emit_event("monitor", {"tag": tag,
+                                            "value": float(value),
+                                            "step": int(step)})
+
+
 class MonitorMaster:
     """Fans every event out to all enabled backends (reference name)."""
 
@@ -91,7 +121,9 @@ class MonitorMaster:
         self.tb = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb = WandbMonitor(ds_config.wandb)
         self.csv = CSVMonitor(ds_config.csv_monitor)
-        for backend in (self.tb, self.wandb, self.csv):
+        self.telemetry = TelemetryMonitor(getattr(ds_config, "telemetry",
+                                                  None))
+        for backend in (self.tb, self.wandb, self.csv, self.telemetry):
             if backend.enabled:
                 self.backends.append(backend)
 

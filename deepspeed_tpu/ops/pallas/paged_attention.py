@@ -23,6 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...utils.jax_compat import shard_map as _shard_map
 
 
 def paged_decode_reference(q, k_pool, v_pool, block_tables, lengths,
@@ -200,7 +201,7 @@ def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, lengths,
     def local(q_, kp, vp, bt, ln):
         return paged_decode_attention(q_, kp, vp, bt, ln, window=window)
 
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh,
         in_specs=(P(None, AXIS_TENSOR, None),
                   P(None, None, AXIS_TENSOR, None),

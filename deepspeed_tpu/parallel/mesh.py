@@ -204,10 +204,9 @@ def strip_manual_axes(*entries) -> PartitionSpec:
     constraint for the still-GSPMD axes (tensor/seq) and is a no-op
     otherwise.
     """
-    manual = set()
-    am = jax.sharding.get_abstract_mesh()
-    if am is not None:
-        manual = set(getattr(am, "manual_axes", ()) or ())
+    from ..utils.jax_compat import current_manual_axes
+
+    manual = current_manual_axes()
     if not manual:
         return PartitionSpec(*entries)
     out = []

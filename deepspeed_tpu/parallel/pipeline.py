@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
 from .mesh import AXIS_PIPE
+from ..utils.jax_compat import shard_map as _shard_map
 
 P = PartitionSpec
 
@@ -143,7 +144,7 @@ def _interleaved_apply(layer_fn, stacked_params, microbatches, mesh,
 
     in_specs = (pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)),
                 jax.tree.map(lambda _: P(), microbatches))
-    return jax.shard_map(per_stage, mesh=mesh,
+    return _shard_map(per_stage, mesh=mesh,
                          in_specs=in_specs,
                          out_specs=jax.tree.map(lambda _: P(), microbatches),
                          check_vma=False,
@@ -337,7 +338,7 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
                   else pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)))
     rep = lambda tree: jax.tree.map(lambda _: P(), tree)
     head_spec = head_specs if head_specs is not None else rep(head_params)
-    loss, g_trunk, g_emb, g_head = jax.shard_map(
+    loss, g_trunk, g_emb, g_head = _shard_map(
         per_stage, mesh=mesh,
         in_specs=(trunk_spec, rep(embed_params), head_spec,
                   rep(microbatches)),
@@ -426,7 +427,7 @@ def pipeline_apply_stages(stage_fns: Any, params: Any, microbatches: Any,
             outs)
         return outs
 
-    return jax.shard_map(
+    return _shard_map(
         per_stage, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(), params),
                   jax.tree.map(lambda _: P(), microbatches)),
@@ -518,7 +519,7 @@ def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
     in_specs = (pipeline_spec(jax.tree.map(jnp.ndim, stacked_params)),
                 jax.tree.map(lambda _: P(), microbatches))
-    return jax.shard_map(per_stage, mesh=mesh,
+    return _shard_map(per_stage, mesh=mesh,
                          in_specs=in_specs, out_specs=jax.tree.map(
                              lambda _: P(), microbatches),
                          check_vma=False,

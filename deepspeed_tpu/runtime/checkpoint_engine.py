@@ -22,6 +22,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..utils.logging import log_dist
+from ..utils.jax_compat import ckpt_metadata_tree
 
 
 class CheckpointEngine:
@@ -68,7 +69,7 @@ class TorchCheckpointEngine(CheckpointEngine):
              map_location: Any = None) -> Any:
         with ocp.StandardCheckpointer() as loader:
             if target is None:
-                meta = loader.metadata(path).item_metadata.tree
+                meta = ckpt_metadata_tree(loader, path)
                 target = jax.tree.map(
                     lambda am: jax.ShapeDtypeStruct(tuple(am.shape),
                                                     am.dtype), meta)

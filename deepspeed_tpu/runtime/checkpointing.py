@@ -26,6 +26,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..utils.logging import log_dist, logger
+from ..utils.jax_compat import ckpt_metadata_tree
 
 LATEST_FILE = "latest"
 
@@ -77,6 +78,17 @@ def _globalize_state(engine):
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
+    from ..telemetry import get_telemetry
+
+    tel = get_telemetry()
+    with tel.span("checkpoint/save", args={"dir": save_dir}):
+        path = _save_checkpoint_impl(engine, save_dir, tag, client_state)
+    tel.inc_counter("checkpoint/saves", help="engine checkpoint saves")
+    return path
+
+
+def _save_checkpoint_impl(engine, save_dir: str, tag: Optional[str],
+                          client_state: Optional[Dict[str, Any]]) -> str:
     _globalize_state(engine)
     tag = _tag_for(engine, tag)
     ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
@@ -173,6 +185,21 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_module_only: bool = False
                     ) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
+    from ..telemetry import get_telemetry
+
+    tel = get_telemetry()
+    with tel.span("checkpoint/load", args={"dir": load_dir}):
+        out = _load_checkpoint_impl(engine, load_dir, tag,
+                                    load_optimizer_states, load_module_only)
+    if out[0] is not None:
+        tel.inc_counter("checkpoint/loads", help="engine checkpoint loads")
+    return out
+
+
+def _load_checkpoint_impl(engine, load_dir: str, tag: Optional[str],
+                          load_optimizer_states: bool,
+                          load_module_only: bool
+                          ) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
     tag = _resolve_tag(load_dir, tag)
     if tag is None:
         logger.warning(f"no checkpoint found under {load_dir}")
@@ -197,7 +224,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             # module-only load works against a DIFFERENT optimizer than the
             # one that saved (reference: load_module_only skips optimizer
             # state [K]); only the params subtree binds to engine shardings.
-            meta = loader.metadata(state_path).item_metadata.tree
+            meta = ckpt_metadata_tree(loader, state_path)
             target = jax.tree.map(
                 lambda am: jax.ShapeDtypeStruct(tuple(am.shape), am.dtype),
                 meta)
@@ -216,7 +243,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             with ocp.StandardCheckpointer() as loader:
                 for i in range(sw.L):  # layer-at-a-time, like the save
                     lp = os.path.join(trunk_path, f"layer_{i:05d}")
-                    meta_tree = loader.metadata(lp).item_metadata.tree
+                    meta_tree = ckpt_metadata_tree(loader, lp)
                     target = jax.tree.map(
                         lambda am: jax.ShapeDtypeStruct(tuple(am.shape),
                                                         am.dtype),
@@ -248,8 +275,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                           if k != "step"}
                 # legacy checkpoints (pre-round-3) carry no 'master' entry;
                 # probe the saved tree instead of masking restore errors
-                saved_keys = set(loader.metadata(offload_path)
-                                 .item_metadata.tree)
+                saved_keys = set(ckpt_metadata_tree(loader, offload_path))
                 if "master" not in saved_keys:
                     target.pop("master", None)
                     log_dist("offload restore: legacy checkpoint without "

@@ -163,6 +163,34 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` config group — the unified telemetry subsystem
+    (``deepspeed_tpu/telemetry/``): span tracer + metrics registry +
+    per-step records, exported as JSONL / Prometheus text / Chrome trace.
+    Registered as a fourth ``MonitorMaster`` backend, so it composes with
+    the ``tensorboard``/``wandb``/``csv_monitor`` groups."""
+
+    enabled: bool = False
+    output_path: str = ""            # base dir (default: telemetry_logs/)
+    job_name: str = "DeepSpeedJobName"
+    #: append one JSON object per event/step to <out>/events.jsonl
+    jsonl: bool = True
+    #: write Prometheus text exposition to <out>/metrics.prom on flush()
+    prometheus: bool = True
+    #: export host spans as <out>/trace.json (Chrome-trace JSON,
+    #: correlatable with profiling/collective_trace.py device lanes)
+    chrome_trace: bool = False
+    #: assemble a per-optimizer-step StepRecord in the engine
+    step_records: bool = True
+    #: fence the device (fetch the loss scalar) before stamping step time —
+    #: step_time_ms then measures DEVICE time, not dispatch backpressure.
+    #: false = ASYNC recording: no per-step sync at all — records keep
+    #: dispatch time + comm/memory stats but carry NaN metric fields and
+    #: no rates (pulling loss would block; the whole point is overlap)
+    device_fence: bool = True
+    max_span_events: int = 100000
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -321,6 +349,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = Field(

@@ -22,6 +22,8 @@ fusion.
 from __future__ import annotations
 
 import collections
+import os
+import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
@@ -41,6 +43,7 @@ from .precision import (DynamicLossScaler, LossScaleState, cast_tree,
                         clip_grads_by_global_norm, global_grad_norm,
                         has_overflow)
 from .zero.sharder import ZeroShardingPolicy
+from ..utils.jax_compat import shard_map as _shard_map
 
 LossFn = Callable[[Any, Any], jnp.ndarray]  # (params, batch) -> scalar loss
 
@@ -297,6 +300,33 @@ class DeepSpeedEngine:
         self.loss_scaler = (DynamicLossScaler.from_config(fp16)
                             if self.fp16_enabled else None)
 
+        # --- unified telemetry (telemetry/) ------------------------------
+        # (before state init so placement spans of the build are captured)
+        from ..telemetry import configure_from_config, get_telemetry
+
+        if config.telemetry.enabled:
+            configure_from_config(config.telemetry)
+        elif "enabled" in config.telemetry.model_fields_set:
+            # an EXPLICIT {"telemetry": {"enabled": false}} turns the
+            # process-global hub off (a defaulted-off config leaves a hub
+            # another job enabled alone)
+            get_telemetry().configure(enabled=False)
+        self.telemetry = get_telemetry()
+        self._telemetry_steps = bool(config.telemetry.enabled
+                                     and config.telemetry.step_records)
+        self._telemetry_fence = bool(config.telemetry.device_fence)
+        #: recent per-step records (bench/autotuner read the SAME numbers
+        #: the engine logged — they can never disagree)
+        self.step_records: collections.deque = collections.deque(maxlen=512)
+        self.last_step_record = None
+        #: analytic model FLOPs per optimizer step; callers that know the
+        #: model shape set it so StepRecords carry TFLOPS/MFU
+        self.flops_per_step = 0.0
+        # ADVICE round-5: under `deepspeed --autotuning` candidate profiling
+        # every step is fenced, so samples/sec ranks candidates by DEVICE
+        # step time instead of host dispatch/queue backpressure
+        self._autotuning_fence = bool(os.environ.get("DS_AUTOTUNING_RESULT"))
+
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
         if self.qgz_enabled:
@@ -369,7 +399,13 @@ class DeepSpeedEngine:
                               skipped_steps=jnp.int32(0))
         params = jax.tree.map(jnp.asarray, params)
         param_shardings = self.policy.param_shardings(params, self.base_specs)
-        params = jax.device_put(params, param_shardings)
+        with self.telemetry.span("zero/param_placement",
+                                 args={"stage": self.policy.stage}):
+            params = jax.device_put(params, param_shardings)
+            if self.telemetry.enabled:
+                # block on the placed tree so the span measures the
+                # transfer, not the enqueue (device_put is async)
+                jax.block_until_ready(params)
 
         if self.offload_enabled:
             # optimizer states live on the HOST (ZeRO-Offload): fp32 master +
@@ -777,7 +813,7 @@ class DeepSpeedEngine:
                     mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
                     return mean_loss, grads
 
-                mean_loss, grads = jax.shard_map(
+                mean_loss, grads = _shard_map(
                     local3, mesh=mesh,
                     in_specs=(pin_tree, P(None, DP_AXES)),
                     out_specs=(P(), gout_tree),
@@ -810,7 +846,7 @@ class DeepSpeedEngine:
                     return mean_loss, grads, new_res
 
                 res_spec = P(DP_AXES) if onebit else P()
-                mean_loss, grads, new_comm = jax.shard_map(
+                mean_loss, grads, new_comm = _shard_map(
                     local, mesh=mesh,
                     in_specs=(P(), P(None, DP_AXES), res_spec),
                     out_specs=(P(), P(), res_spec),
@@ -963,13 +999,9 @@ class DeepSpeedEngine:
         sh = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
         return jax.tree.map(lambda x: global_feed(x, sh), batch)
 
-    def train_step(self, batch) -> Dict[str, Any]:
-        """Run ONE full optimizer step (fwd+bwd over all microbatches + update)
-        as a single compiled program.  ``batch`` holds the full global batch
-        (micro × gas × dp_world leading dim) — or, multi-process, this
-        process's local rows (see :meth:`_feed_batch`)."""
-        self.tput_timer.start()
-        batch = self._feed_batch(batch)
+    def _dispatch_train_step(self, batch) -> Dict[str, Any]:
+        """Route the (assembled, global) batch to the right compiled-step
+        family and return its metrics."""
         if self.infinity is not None:
             metrics = self.infinity.train_step(batch)
             stepped = 0 if bool(metrics.get("overflow", False)) else 1
@@ -1004,38 +1036,56 @@ class DeepSpeedEngine:
             if self._train_step_fn is None:
                 self._train_step_fn = self._build_train_step()
             self.state, metrics = self._train_step_fn(self.state, batch)
-        if self.config.wall_clock_breakdown:
-            # breakdown mode trades throughput for truth (the reference
-            # inserts barriers the same way): a scalar fetch is the only
-            # reliable fence, so the timer sees DEVICE step time instead of
-            # host dispatch time
+        return metrics
+
+    def train_step(self, batch) -> Dict[str, Any]:
+        """Run ONE full optimizer step (fwd+bwd over all microbatches + update)
+        as a single compiled program.  ``batch`` holds the full global batch
+        (micro × gas × dp_world leading dim) — or, multi-process, this
+        process's local rows (see :meth:`_feed_batch`)."""
+        self.tput_timer.start()
+        t_step0 = time.perf_counter()
+        batch = self._feed_batch(batch)
+        with self.telemetry.span("engine/train_step",
+                                 args={"step": self.global_steps}):
+            metrics = self._dispatch_train_step(batch)
+        fenced = (self.config.wall_clock_breakdown
+                  or self._autotuning_fence
+                  or (self._telemetry_steps and self._telemetry_fence))
+        if fenced:
+            # breakdown/autotuning/telemetry trade throughput for truth
+            # (the reference inserts barriers the same way): a scalar fetch
+            # is the only reliable fence, so timers and StepRecords see
+            # DEVICE step time instead of host dispatch time
             float(metrics["loss"])
+        step_time_s = time.perf_counter() - t_step0
         self.tput_timer.stop(sync=False)
         from ..utils import debug as _debug
 
         if _debug.enabled():
             _debug.check_step(metrics)
         self.global_steps += 1
-        import os as _os
-
-        result_path = _os.environ.get("DS_AUTOTUNING_RESULT")
+        result_path = os.environ.get("DS_AUTOTUNING_RESULT")
         if (result_path and self.global_steps
-                == int(_os.environ.get("DS_AUTOTUNING_STEPS", "8"))):
-            # candidate profiling run under `deepspeed --autotuning`: fence
-            # the async steps, report measured throughput, and let the
-            # orchestrator reap the process
+                == int(os.environ.get("DS_AUTOTUNING_STEPS", "8"))):
+            # candidate profiling run under `deepspeed --autotuning`: every
+            # step was fenced above (_autotuning_fence), so per-step
+            # timings are device times; report and let the orchestrator
+            # reap the process
             import json as _json
 
-            float(metrics["loss"])  # real device fence
+            float(metrics["loss"])  # drain any unfenced tail
             t = self.tput_timer
             tmp = result_path + ".tmp"
             with open(tmp, "w") as f:
                 _json.dump({"samples_per_sec": t.samples_per_sec(),
                             "avg_step_time_s": t.avg_step_time(),
                             "steps": self.global_steps}, f)
-            _os.replace(tmp, result_path)  # atomic: no torn reads
+            os.replace(tmp, result_path)  # atomic: no torn reads
         self.lr_scheduler.last_step = self.global_steps
         self.last_metrics = metrics
+        if self._telemetry_steps:
+            self._record_step_telemetry(batch, metrics, step_time_s, fenced)
         if self.steps_per_print and self.global_steps % int(
                 self.steps_per_print) == 0:
             m = {k: float(v) for k, v in metrics.items()}
@@ -1063,6 +1113,64 @@ class DeepSpeedEngine:
         if fp.enabled and self.global_steps == int(fp.profile_step):
             self._emit_module_profile(batch, fp)
         return metrics
+
+    def _record_step_telemetry(self, batch, metrics: Dict[str, Any],
+                               step_time_s: float, fenced: bool) -> None:
+        """Assemble + publish this step's :class:`~..telemetry.StepRecord`
+        (the numbers are device-true when ``fenced``; the float() pulls
+        below force the same sync anyway)."""
+        from ..comm.comm import comms_logger
+        from ..telemetry import StepRecord, collect_memory_stats
+
+        leaves = [l for l in jax.tree.leaves(batch)
+                  if getattr(l, "ndim", 0) >= 1]
+        rows = int(leaves[0].shape[0]) if leaves else 0
+        seq = (int(leaves[0].shape[1])
+               if leaves and leaves[0].ndim >= 2 else 1)
+        dt = max(step_time_s, 1e-9)
+        tflops = mfu = 0.0
+        # rate/TFLOPS/MFU fields only when the step was fenced: an
+        # unfenced step_time is host DISPATCH time, and a rate derived
+        # from it would overstate throughput by orders of magnitude
+        if self.flops_per_step and fenced:
+            tflops = self.flops_per_step / dt / 1e12
+            try:
+                from ..profiling.flops_profiler.profiler import (
+                    peak_flops_per_chip)
+
+                peak = float(peak_flops_per_chip())
+                if peak > 0:
+                    mfu = self.flops_per_step / dt / peak
+            except Exception:
+                pass
+        nan = float("nan")
+        rec = StepRecord(
+            step=self.global_steps,
+            step_time_ms=step_time_s * 1e3,
+            device_fenced=bool(fenced),
+            samples_per_sec=rows / dt if fenced else 0.0,
+            tokens_per_sec=rows * seq / dt if fenced else 0.0,
+            # unfenced mode is the ASYNC-recording path (device_fence:
+            # false buys back dispatch/execute overlap) — scalar pulls
+            # would block on the step, so metric fields stay NaN there
+            loss=float(metrics.get("loss", 0.0)) if fenced else nan,
+            grad_norm=float(metrics.get("grad_norm", 0.0)) if fenced
+            else nan,
+            lr=float(metrics.get("lr", 0.0)) if fenced else nan,
+            loss_scale=float(metrics.get("loss_scale", 1.0)) if fenced
+            else nan,
+            overflow=bool(metrics.get("overflow", False)) if fenced
+            else False,
+            skipped_steps=int(self.state.skipped_steps) if fenced else -1,
+            comm_bytes=comms_logger.total_bytes(),
+            comm_ops=comms_logger.total_ops(),
+            tflops=tflops, mfu=mfu,
+            # live-buffer census every 16th step only (O(all buffers))
+            memory=collect_memory_stats(
+                include_live_buffers=self.global_steps % 16 == 1))
+        self.last_step_record = rec
+        self.step_records.append(rec)
+        self.telemetry.record_step(rec)
 
     def _emit_module_profile(self, batch, fp) -> None:
         """One-shot per-module flops/latency table at ``profile_step``

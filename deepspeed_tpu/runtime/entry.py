@@ -52,7 +52,17 @@ def _resolve_config(config, config_params) -> DeepSpeedConfig:
             node = payload
             parts = dotted.split(".")
             for p in parts[:-1]:
-                nxt = dict(node.get(p) or {})
+                cur = node.get(p)
+                if cur is not None and not isinstance(cur, dict):
+                    # a dotted path must traverse objects; walking through
+                    # e.g. a string would die later in an opaque TypeError
+                    # that aborts the whole candidate run
+                    raise ValueError(
+                        f"DS_AUTOTUNING_CONFIG_OVERRIDE key {dotted!r}: "
+                        f"config node {p!r} holds the non-object value "
+                        f"{cur!r} ({type(cur).__name__}) — cannot set a "
+                        f"nested key under it")
+                nxt = dict(cur or {})
                 node[p] = nxt
                 node = nxt
             node[parts[-1]] = value
@@ -120,6 +130,13 @@ def initialize(args: Any = None,
         comm.comms_logger.configure(
             enabled=True, verbose=cfg.comms_logger.verbose,
             exec_counts=cfg.comms_logger.exec_counts)
+
+    if cfg.telemetry.enabled:
+        # configure the hub BEFORE engine construction so state-placement /
+        # compile spans of the build itself are captured
+        from ..telemetry import configure_from_config
+
+        configure_from_config(cfg.telemetry)
 
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec
 
 from ...parallel.mesh import AXIS_SEQ, DP_AXES
 from ...utils import groups as groups_mod
+from ...utils.jax_compat import shard_map as _shard_map
 
 P = PartitionSpec
 
@@ -114,12 +115,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # manualize ONLY the seq axis (batch/dp stays GSPMD-auto) — same
     # partial-manual convention as ulysses_attention so the two compose
     # with the surrounding engine shardings identically
-    ctx = jax.sharding.get_abstract_mesh()
+    from ...utils.jax_compat import abstract_mesh_or_none
+
+    ctx = abstract_mesh_or_none()
     sm_mesh = ctx if ctx is not None and ctx.shape else mesh
     body = partial(_ring_attention_local, axis_name=AXIS_SEQ, sp=sp,
                    causal=causal, window=window)
     spec = P(None, AXIS_SEQ, None, None)
-    return jax.shard_map(body, mesh=sm_mesh, in_specs=(spec, spec, spec),
+    return _shard_map(body, mesh=sm_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False,
                          axis_names={AXIS_SEQ})(q, k, v)
 

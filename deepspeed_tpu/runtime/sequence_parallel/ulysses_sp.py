@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ...parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
 from ...utils import groups as groups_mod
+from ...utils.jax_compat import shard_map as _shard_map
 
 P = PartitionSpec
 
@@ -53,7 +54,9 @@ def ulysses_attention(attn_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     # Manualize ONLY the seq axis: batch/head sharding stays with GSPMD, and
     # the partial-manual form composes under an enclosing pipeline shard_map
     # (whose context mesh must be reused — a concrete Mesh would mismatch).
-    ctx = jax.sharding.get_abstract_mesh()
+    from ...utils.jax_compat import abstract_mesh_or_none
+
+    ctx = abstract_mesh_or_none()
     sm_mesh = ctx if ctx is not None and ctx.shape else mesh
     spec = P(None, AXIS_SEQ, None, None)
 
@@ -70,7 +73,7 @@ def ulysses_attention(attn_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
         return jax.lax.all_to_all(ol, AXIS_SEQ, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    return jax.shard_map(inner, mesh=sm_mesh,
+    return _shard_map(inner, mesh=sm_mesh,
                          in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={AXIS_SEQ},
                          check_vma=False)(q, k, v)

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.op_builder import CPUAdamBuilder
+from ...telemetry import get_telemetry
 from ...utils.logging import log_dist
 
 _f32p = ctypes.POINTER(ctypes.c_float)
@@ -187,6 +188,14 @@ class PartitionedParamSwapper:
         self.wire_np_dtype = np.dtype(wire_dtype)
         self._wire_is_bf16 = wire_dtype == jnp.bfloat16
         self.nvme_dir = nvme_path
+        if pipeline and int(buffer_count) < 2:
+            # the worker pins the layer it is mid-update on; with a single
+            # staging slot every eviction candidate could be pinned and the
+            # read-ahead would deadlock against the update it overlaps
+            raise ValueError(
+                f"buffer_count={buffer_count} is too small for the "
+                f"pipelined optimizer (pipeline=True needs >= 2: one slot "
+                f"for the in-flight update, one for read-ahead)")
         self.buffer_count = max(2, int(buffer_count))
 
         hp = dict(adam_hparams or {})
@@ -360,11 +369,22 @@ class PartitionedParamSwapper:
         # never evict a layer the pipeline worker is mid-update on (its
         # planes object must stay that slot's); buffer_count >= 2 and at
         # most one in-flight update guarantee an unpinned victim exists
-        victim = next(l for l in self._lru if l not in self._pinned)
+        victim = next((l for l in self._lru if l not in self._pinned), None)
+        if victim is None:
+            raise RuntimeError(
+                f"swap: no evictable staging slot — all "
+                f"{len(self._lru)} resident layers are pinned by in-flight "
+                f"optimizer updates (buffer_count={self.buffer_count}, "
+                f"pinned={sorted(self._pinned)}); raise buffer_count "
+                f"(pipelined updates need >= 2) or drain_updates() before "
+                f"prefetching more layers")
         self._lru.remove(victim)
         slot = self._slot_of.pop(victim)
         self._slot_state.pop(victim, None)
         self._device_cache.pop(victim, None)
+        get_telemetry().inc_counter(
+            "swap/evictions", help="staging-slot evictions (LRU victim "
+            "written back and reused for a new layer)")
         return slot
 
     # ------------------------------------------------------------------
@@ -519,6 +539,11 @@ class PartitionedParamSwapper:
                    lr: Optional[float] = None) -> None:
         """Fused host update of layer ``i`` from device grads: d2h, C++
         Adam(W) over master/m/v, bf16 wire emit, NVMe write-behind."""
+        with get_telemetry().span("swap/step_layer", args={"layer": i}):
+            return self._step_layer_impl(i, grads_tree, lr)
+
+    def _step_layer_impl(self, i: int, grads_tree: Any,
+                         lr: Optional[float] = None) -> None:
         planes = self._ensure_host(i, full=True)
         # ONE shared scratch plane for the fused path (grads are consumed
         # immediately) — per-layer grad planes are stash-path-only

@@ -30,6 +30,7 @@ from typing import Any, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...utils.jax_compat import axis_size as _axis_size
 
 GROUP = 256  # quantization group size (scale granularity)
 
@@ -55,7 +56,7 @@ def quantized_allreduce(g: jnp.ndarray, axis_names: Sequence[str]
     names = tuple(axis_names)
     world = 1
     for ax in names:
-        world *= jax.lax.axis_size(ax)
+        world *= _axis_size(ax)
     if world == 1:
         return g
 
@@ -98,7 +99,7 @@ def quantized_reduce_scatter(g: jnp.ndarray, axis_names: Sequence[str],
     names = tuple(axis_names)
     world = 1
     for ax in names:
-        world *= jax.lax.axis_size(ax)
+        world *= _axis_size(ax)
     if world == 1:
         return g
 

@@ -171,9 +171,13 @@ class ZeroShardingPolicy:
         return jax.tree.map(fn, tree, base_specs)
 
     def param_shardings(self, params: Any, base_specs: Any = None) -> Any:
-        return self._map_with_base(
-            lambda p, b: NamedSharding(self.mesh, self.param_spec(p, b)),
-            params, base_specs)
+        from ...telemetry import get_telemetry
+
+        with get_telemetry().span("zero/param_shardings",
+                                  args={"stage": self.stage}):
+            return self._map_with_base(
+                lambda p, b: NamedSharding(self.mesh, self.param_spec(p, b)),
+                params, base_specs)
 
     def param_specs(self, params: Any, base_specs: Any = None) -> Any:
         return self._map_with_base(
@@ -190,6 +194,14 @@ class ZeroShardingPolicy:
         counters replicate.  With model ``base_specs`` the param↔state
         correspondence comes from ``optax.tree_map_params`` so TP axes carry
         into the mirrored moments."""
+        from ...telemetry import get_telemetry
+
+        with get_telemetry().span("zero/opt_state_shardings",
+                                  args={"stage": self.stage}):
+            return self._opt_state_shardings(opt_state, tx, base_specs)
+
+    def _opt_state_shardings(self, opt_state: Any, tx: Any = None,
+                             base_specs: Any = None) -> Any:
         if base_specs is not None and tx is not None:
             import optax
 

@@ -1,0 +1,177 @@
+"""Unified telemetry: span tracing + metrics registry + step records.
+
+ONE pipeline correlating what used to be fragments (ISSUE 1):
+
+* :mod:`.tracer` — nested host-side spans (``telemetry.span("zero/...")``)
+  with optional device-fence close, exported as Chrome-trace JSON that
+  merges with ``profiling/collective_trace.py``'s XLA device lanes.
+* :mod:`.metrics` — counters / gauges / fixed-bucket histograms with a
+  JSONL event log and Prometheus text exposition.
+* :mod:`.step_record` — the per-optimizer-step record the engine emits
+  (device-fenced step time, throughput, loss, comm bytes, memory), the
+  single source every consumer (bench, autotuner, monitors) reads.
+
+The module-level hub is a process-global singleton, DISABLED by default:
+``span()`` returns a shared no-op context manager and the counter/gauge
+helpers early-return, so instrumented hot paths cost one attribute read
+when telemetry is off.  Enable via the ``telemetry`` config group
+(``{"telemetry": {"enabled": true, ...}}``) — wired through
+``MonitorMaster`` as a fourth backend — or programmatically with
+:func:`configure`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                      JSONLExporter, MetricsRegistry, parse_prometheus_text,
+                      prom_name)
+from .step_record import (StepRecord, collect_memory_stats,
+                          publish_step_record)
+from .tracer import NOOP_SPAN, SpanTracer, device_fence
+
+__all__ = [
+    "Telemetry", "StepRecord", "MetricsRegistry", "SpanTracer",
+    "Counter", "Gauge", "Histogram", "JSONLExporter",
+    "configure", "configure_from_config", "get_telemetry", "span",
+    "publish_step_record", "collect_memory_stats", "parse_prometheus_text",
+    "prom_name", "device_fence", "DEFAULT_BUCKETS",
+]
+
+
+class Telemetry:
+    """The hub: one tracer + one registry + output plumbing."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.tracer = SpanTracer()
+        self.registry = MetricsRegistry()
+        self.output_path: Optional[str] = None
+        self.chrome_trace = False
+        self.prometheus = True
+        self.device_fence_steps = True
+        self._lock = threading.Lock()
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, enabled: bool = True, output_path: str = "",
+                  job_name: str = "DeepSpeedJobName", jsonl: bool = True,
+                  prometheus: bool = True, chrome_trace: bool = False,
+                  device_fence: bool = True,
+                  max_span_events: int = 100_000) -> "Telemetry":
+        with self._lock:
+            self.enabled = bool(enabled)
+            self.prometheus = bool(prometheus)
+            self.chrome_trace = bool(chrome_trace)
+            self.device_fence_steps = bool(device_fence)
+            self.tracer.max_events = int(max_span_events)
+            if not jsonl and self.registry.event_log is not None:
+                # a reconfigure to in-memory-only must stop appending to
+                # the PREVIOUS job's events.jsonl
+                self.registry.event_log.close()
+                self.registry.event_log = None
+            if enabled and (jsonl or prometheus or chrome_trace):
+                base = os.path.join(output_path or "telemetry_logs", job_name)
+                self.output_path = base
+                if jsonl:
+                    self.registry.attach_event_log(
+                        os.path.join(base, "events.jsonl"))
+            elif not enabled:
+                self.output_path = None
+        return self
+
+    def reset(self) -> None:
+        """Test isolation: drop all metrics/spans and disable."""
+        with self._lock:
+            if self.registry.event_log is not None:
+                self.registry.event_log.close()
+            self.enabled = False
+            self.output_path = None
+            self.tracer = SpanTracer(self.tracer.max_events)
+            self.registry = MetricsRegistry()
+
+    # -- hot-path surface (cheap no-ops when disabled) ---------------------
+
+    def span(self, name: str, fence: bool = False,
+             args: Optional[Dict[str, Any]] = None):
+        if not self.enabled:
+            return NOOP_SPAN()
+        return self.tracer.span(name, fence=fence, args=args)
+
+    def inc_counter(self, name: str, v: float = 1.0, help: str = "") -> None:
+        if not self.enabled:
+            return
+        self.registry.counter(name, help).inc(v)
+
+    def set_gauge(self, name: str, v: float, help: str = "") -> None:
+        if not self.enabled:
+            return
+        self.registry.gauge(name, help).set(v)
+
+    def observe(self, name: str, v: float, help: str = "",
+                buckets=DEFAULT_BUCKETS) -> None:
+        if not self.enabled:
+            return
+        self.registry.histogram(name, help, buckets=buckets).observe(v)
+
+    def emit_event(self, kind: str, payload: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        self.registry.emit_event(kind, payload)
+
+    def record_step(self, rec: StepRecord) -> None:
+        if not self.enabled:
+            return
+        publish_step_record(self.registry, rec)
+
+    # -- export ------------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
+    def flush(self) -> Dict[str, str]:
+        """Write the configured exports (Prometheus textfile, Chrome trace)
+        under ``output_path``; returns {kind: path}."""
+        out: Dict[str, str] = {}
+        if not (self.enabled and self.output_path):
+            return out
+        if self.prometheus:
+            out["prometheus"] = self.registry.save_prometheus(
+                os.path.join(self.output_path, "metrics.prom"))
+        if self.chrome_trace:
+            out["chrome_trace"] = self.tracer.save_chrome_trace(
+                os.path.join(self.output_path, "trace.json"))
+        return out
+
+
+_default = Telemetry()
+
+
+def get_telemetry() -> Telemetry:
+    return _default
+
+
+def configure(**kw) -> Telemetry:
+    return _default.configure(**kw)
+
+
+def configure_from_config(tcfg: Any) -> Telemetry:
+    """Configure the hub from a ``TelemetryConfig`` (runtime/config.py)."""
+    return _default.configure(
+        enabled=bool(getattr(tcfg, "enabled", False)),
+        output_path=getattr(tcfg, "output_path", "") or "",
+        job_name=getattr(tcfg, "job_name", "DeepSpeedJobName"),
+        jsonl=bool(getattr(tcfg, "jsonl", True)),
+        prometheus=bool(getattr(tcfg, "prometheus", True)),
+        chrome_trace=bool(getattr(tcfg, "chrome_trace", False)),
+        device_fence=bool(getattr(tcfg, "device_fence", True)),
+        max_span_events=int(getattr(tcfg, "max_span_events", 100_000)))
+
+
+def span(name: str, fence: bool = False,
+         args: Optional[Dict[str, Any]] = None):
+    """Module-level convenience: ``with telemetry.span("zero/gather"): ...``"""
+    return _default.span(name, fence=fence, args=args)

@@ -17,6 +17,7 @@ import argparse
 import sys
 import time
 from typing import List
+from .jax_compat import shard_map as _shard_map
 
 
 def _bench_collective(op: str, n_elems: int, trials: int, mesh) -> dict:
@@ -49,7 +50,7 @@ def _bench_collective(op: str, n_elems: int, trials: int, mesh) -> dict:
                 axis)
         raise ValueError(op)
 
-    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    fn = jax.jit(_shard_map(body, mesh=mesh, in_specs=P(axis),
                                out_specs=P() if op == "all_reduce"
                                else P(axis),
                                check_vma=False))

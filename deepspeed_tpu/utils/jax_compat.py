@@ -1,0 +1,104 @@
+"""Version-portable wrappers over jax APIs that moved between releases.
+
+The codebase is written against the jax >= 0.9 surface (``jax.shard_map``
+with ``axis_names=``/``check_vma=``); older installs (0.4.x) carry the
+same capability as ``jax.experimental.shard_map.shard_map`` with the
+inverse knobs (``auto=`` lists the axes that STAY automatic instead of
+``axis_names=`` listing the manual ones, and replication checking is
+``check_rep=``).  Import ``shard_map`` from here everywhere so one
+translation covers both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  axis_names: Optional[Set[Any]] = None,
+                  check_vma: bool = False):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, **kw)
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  axis_names: Optional[Set[Any]] = None,
+                  check_vma: bool = False):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - set(axis_names)
+            if auto:
+                kw["auto"] = auto
+        return _shard_map(f, **kw)
+
+
+def partial_manual_shard_map_ok() -> bool:
+    """Whether this jax/jaxlib can compile PARTIAL-manual ``shard_map``
+    (manual over a subset of axes) when some AUTO axis has size > 1.
+    jaxlib 0.4.x CHECK-fails in the SPMD partitioner on that combination
+    (``spmd_partitioner.cc: target.IsManualSubgroup() ==
+    sharding().IsManualSubgroup()``) — an uncatchable process abort, so
+    tests exercising those paths (Ulysses/ring SP, 1F1B pipeline + dp)
+    must skip rather than crash the suite.  Size-1 auto axes are fine
+    everywhere."""
+    return hasattr(jax, "shard_map")
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (size of a named mesh axis at the current
+    trace point) for releases that predate it: a psum of 1 over the axis
+    is statically evaluated to the same number."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def abstract_mesh_or_none():
+    """The context AbstractMesh (inside ``jax.set_mesh``/``shard_map``
+    scopes) on jax >= 0.7; None on releases without the concept — callers
+    fall back to their concrete mesh."""
+    try:
+        return jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        return None
+
+
+def current_manual_axes() -> Set[Any]:
+    """Mesh axes that are MANUAL at the current trace point (we are inside
+    a ``shard_map`` over them).  jax >= 0.7 exposes this on the abstract
+    mesh; 0.4.x carries the same information in the axis environment."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        am = None
+    if am is not None:
+        return set(getattr(am, "manual_axes", ()) or ())
+    try:
+        from jax._src.core import get_axis_env
+
+        return set(get_axis_env().axis_sizes)
+    except Exception:
+        return set()
+
+
+def ckpt_metadata_tree(loader, path):
+    """Orbax moved checkpoint metadata between releases: newer
+    StandardCheckpointer returns an object with ``.item_metadata.tree``,
+    older ones hand back the tree (dict) directly."""
+    meta = loader.metadata(path)
+    im = getattr(meta, "item_metadata", None)
+    if im is not None:
+        return im.tree
+    tree = getattr(meta, "tree", None)
+    if tree is not None:
+        return tree
+    return meta

@@ -10,6 +10,7 @@ from deepspeed_tpu.models import LlamaConfig, LlamaModel
 from deepspeed_tpu.ops import onebit
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
 
 pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
 
@@ -59,7 +60,7 @@ def test_onebit_allreduce_matches_mean_of_decompressed(mesh8):
             g_local[0], r_local[0], ("expert", "data"))
         return out[None], new_r[None]
 
-    out, new_r = jax.shard_map(
+    out, new_r = _shard_map(
         f, mesh=mesh8, in_specs=(P(("expert", "data")),) * 2,
         out_specs=(P(("expert", "data")), P(("expert", "data"))),
         check_vma=False)(g, r)

@@ -11,6 +11,7 @@ from deepspeed_tpu.models import LlamaConfig, LlamaModel
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.runtime.zero import qgz
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
 
 pytestmark = pytest.mark.slow  # jit/engine-heavy; smoke tier runs -m "not slow"
 
@@ -24,7 +25,7 @@ def test_quantized_allreduce_close_to_exact(mesh8):
         return qgz.quantized_allreduce(g_local[0],
                                        ("expert", "data"))[None]
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         f, mesh=mesh8, in_specs=(P(("expert", "data")),),
         out_specs=P(("expert", "data")), check_vma=False))(g)
     exact = np.asarray(g).mean(axis=0)
@@ -88,7 +89,7 @@ def test_quantized_reduce_scatter_close_to_exact(mesh8):
         return qgz.quantized_reduce_scatter(
             g_local[0], ("expert", "data"), 0)[None]
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_shard_map(
         f, mesh=mesh8, in_specs=(P(("expert", "data")),),
         out_specs=P(("expert", "data")),
         check_vma=False))

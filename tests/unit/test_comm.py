@@ -6,6 +6,7 @@ import pytest
 from deepspeed_tpu import comm
 from deepspeed_tpu.parallel import MeshLayout
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
 
 
 @pytest.fixture(autouse=True)
@@ -50,7 +51,7 @@ def test_all_to_all_transpose():
 
 
 def test_in_graph_collectives_shard_map():
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = groups.get_mesh()
@@ -93,7 +94,7 @@ def test_profile_collectives_device_table():
     compiled step."""
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     from deepspeed_tpu.profiling.collective_trace import profile_collectives
 
@@ -132,7 +133,7 @@ def test_comms_logger_execution_counts():
                 return comm.psum(v, group="data")
             from jax.sharding import PartitionSpec as P
 
-            return jax.shard_map(local, mesh=mesh, in_specs=P("data"),
+            return _shard_map(local, mesh=mesh, in_specs=P("data"),
                                  out_specs=P("data"),
                                  check_vma=False)(x)
 
