@@ -864,7 +864,46 @@ def _probe_devices_or_die(timeout_s: float = 180.0):
     os._exit(3)
 
 
+def _emit_crash_line(e: BaseException, reason: str = "bench unhandled "
+                     "exception") -> str:
+    """Crash path of the one-JSON-line contract (ISSUE 2): dump a flight-
+    recorder debug bundle and record its path in the BENCH artifact so a
+    dead bench leaves the operator a post-mortem, not just an exit code.
+    Returns the bundle path ("" if even the dump failed)."""
+    import traceback
+
+    from deepspeed_tpu.telemetry import get_flight_recorder
+
+    path = ""
+    try:
+        path = get_flight_recorder().dump(
+            f"{reason}: {type(e).__name__}: {e}",
+            extra={"traceback": traceback.format_exc()})
+    except Exception:
+        pass  # the JSON line below must go out regardless
+    print(json.dumps({
+        "metric": "llama_110m_train_tokens_per_sec",
+        "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+        "error": f"{type(e).__name__}: {e}"[:300],
+        "debug_bundle": path,
+    }))
+    sys.stdout.flush()
+    return path
+
+
 def main() -> None:
+    try:
+        _main()
+    except SystemExit:
+        raise
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:
+        _emit_crash_line(e)
+        sys.exit(4)
+
+
+def _main() -> None:
     from deepspeed_tpu.models import LlamaConfig
 
     _setup_compile_cache()

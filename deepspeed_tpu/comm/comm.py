@@ -76,8 +76,8 @@ class CommsLogger:
       compiled program — ``exec_summary()`` counts scale with runs (a
       trace-time census cannot).  Counts are per LOCAL DEVICE SHARD per
       run (an 8-device mesh bumps a collective 8× per step; multi-host,
-      each process counts its own shards) — divide by
-      ``jax.local_device_count()`` for per-step numbers.  Opt-in: each
+      each process counts its own shards) — ``exec_summary(per_step=
+      True)`` normalizes by ``jax.local_device_count()``.  Opt-in: each
       callback is a device→host hop, meaningful overhead on
       remote/tunneled platforms — a diagnostics switch, like the
       reference's comms_logger.  Per-collective DEVICE timing still
@@ -156,26 +156,55 @@ class CommsLogger:
     def total_ops(self) -> int:
         return int(sum(e.get("count", 0) for e in self.stats.values()))
 
-    def exec_summary(self) -> dict[str, dict[str, float]]:
-        """Per-execution stats; counts are per local device shard per run
-        (see class docstring) — divide by ``jax.local_device_count()``
-        for per-step numbers."""
+    #: class-wide: log the first effects_barrier failure only — the
+    #: fallback (stale-by-one counts) is benign, but silence hid real
+    #: backend breakage behind a bare `except: pass` for two rounds
+    _barrier_logged = False
+
+    def _flush_effects(self, where: str) -> None:
+        """Flush in-flight debug callbacks; on failure keep the fallback
+        (counts may lag by the in-flight runs) but say so ONCE at debug
+        level instead of swallowing the exception bare."""
         try:
-            # debug callbacks are asynchronous; flush in-flight effects so
-            # the summary reflects every completed run
             jax.effects_barrier()
-        except Exception:
-            pass
-        return self.exec_stats
+        except Exception as e:
+            if not CommsLogger._barrier_logged:
+                CommsLogger._barrier_logged = True
+                logger.debug(
+                    f"comms_logger: jax.effects_barrier() failed in {where} "
+                    f"({e!r}); execution counts may lag in-flight runs")
+
+    def exec_summary(self, per_step: bool = False
+                     ) -> dict[str, dict[str, float]]:
+        """Per-execution stats.  Raw counts are per LOCAL DEVICE SHARD per
+        run (see class docstring); ``per_step=True`` returns a normalized
+        copy — counts/bytes divided by ``jax.local_device_count()`` — so
+        callers stop hand-dividing (the engine's StepRecord comm-exec
+        fields use this path)."""
+        # debug callbacks are asynchronous; flush in-flight effects so
+        # the summary reflects every completed run
+        self._flush_effects("exec_summary")
+        if not per_step:
+            return self.exec_stats
+        n = max(1, jax.local_device_count())
+        with self._exec_lock:
+            snap = {name: dict(e) for name, e in self.exec_stats.items()}
+        return {name: {k: v / n for k, v in e.items()}
+                for name, e in snap.items()}
+
+    def exec_totals(self, per_step: bool = False) -> Tuple[float, float]:
+        """(ops, bytes) summed over every probed collective; normalized
+        per local device shard when ``per_step``."""
+        summary = self.exec_summary(per_step=per_step)
+        ops = sum(e.get("count", 0) for e in summary.values())
+        nbytes = sum(e.get("bytes", 0) for e in summary.values())
+        return ops, nbytes
 
     def reset(self) -> None:
         self.stats = {}
-        try:
-            # flush in-flight callbacks first, or counts from PRE-reset
-            # runs would land in the fresh dict after the swap
-            jax.effects_barrier()
-        except Exception:
-            pass
+        # flush in-flight callbacks first, or counts from PRE-reset
+        # runs would land in the fresh dict after the swap
+        self._flush_effects("reset")
         with self._exec_lock:
             # same lock the execution probes take: a concurrent callback
             # must not land its increment in an abandoned dict

@@ -86,7 +86,38 @@ class DSElasticAgent:
                 max_nodes=int(os.environ.get("DS_ELASTIC_MAX_NODES", "64")))
         self.rdzv = rdzv
         self._round = -1
+        self._rank = 0
         self._peers: List[str] = []
+
+    def _hb_payload(self):
+        """The local watchdog's liveness summary (step index, step-time
+        EWMA, progress age), folded into every rendezvous heartbeat so
+        rank 0 can publish straggler-skew gauges; None when no watchdog
+        is installed (payload-less heartbeats, round-2 behavior)."""
+        from ..telemetry import get_watchdog
+
+        wd = get_watchdog()
+        return wd.heartbeat_payload() if wd is not None else None
+
+    def _heartbeat_tick(self) -> None:
+        """One liveness beat: heartbeat (+watchdog payload); rank 0 also
+        folds peer payloads into the straggler-skew gauges."""
+        self.rdzv.heartbeat(self._hb_payload())
+        if self._rank == 0 and len(self._peers) > 1:
+            try:
+                self.rdzv.publish_straggler_stats(self._peers)
+            except Exception:
+                pass  # store hiccup; the next tick retries
+
+    def _record_stale_peers(self, stale: List[str]) -> None:
+        """Satellite (ISSUE 2): stale-peer detections at the AGENT level
+        (where they trigger teardown) get their own counter, distinct
+        from rendezvous-level detections."""
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "elastic/agent_stale_peer_events", v=len(stale),
+            help="stale peer heartbeats that triggered an agent restart")
 
     # -- rendezvous --------------------------------------------------------
 
@@ -97,6 +128,7 @@ class DSElasticAgent:
         if self.rdzv is not None:
             r, rank, world, coord = self.rdzv.next_round()
             self._round = r
+            self._rank = rank
             # monitor the FROZEN gang, not the raw members key: a node
             # squeezed out by max_nodes appended itself to members but is
             # parked as standby and never heartbeats — treating it as a
@@ -109,6 +141,17 @@ class DSElasticAgent:
             os.environ["PROCESS_ID"] = str(rank)
             log_dist(f"elastic rendezvous: round={r} rank={rank}/{world} "
                      f"coordinator={coord}")
+            # per-node heartbeat ages in every future debug bundle: a
+            # watchdog hang dump then distinguishes "my host stalled"
+            # from "a peer died" (satellite, ISSUE 2)
+            from ..telemetry import get_flight_recorder
+
+            get_flight_recorder().register_context(
+                "heartbeat_ages",
+                lambda: self.rdzv.peer_heartbeat_ages(self._peers))
+            get_flight_recorder().annotate(
+                "rendezvous", {"round": r, "rank": rank, "world": world,
+                               "coordinator": coord})
         coord = os.environ.get("COORDINATOR_ADDRESS")
         if not coord or self.spec.cmd is not None:
             return  # subprocess workers init jax.distributed themselves
@@ -178,7 +221,7 @@ class DSElasticAgent:
         def beat():
             while not stop.wait(spec.monitor_interval):
                 try:
-                    self.rdzv.heartbeat()
+                    self._heartbeat_tick()
                     if self.rdzv.current_round() != self._round:
                         # the attempt is already doomed; latch and stop so
                         # we never bump a round someone else already moved
@@ -190,6 +233,7 @@ class DSElasticAgent:
                         # bump ONCE, then latch — re-bumping every tick
                         # would storm the counter past the round peers
                         # are trying to re-form on
+                        self._record_stale_peers(stale)
                         self.rdzv.bump_round(f"stale peers {stale}")
                         round_moved.set()
                         return
@@ -233,7 +277,7 @@ class DSElasticAgent:
                         f"worker exited with code {rc}")
                 if self.rdzv is not None:
                     try:
-                        self.rdzv.heartbeat()
+                        self._heartbeat_tick()
                         moved = self.rdzv.current_round() != self._round
                         stale = self.rdzv.stale_peers(self._peers,
                                                       spec.heartbeat_ttl)
@@ -245,6 +289,7 @@ class DSElasticAgent:
                         raise _RestartSignal(
                             f"membership round moved past {self._round}")
                     if stale:
+                        self._record_stale_peers(stale)
                         self.rdzv.bump_round(f"stale peers {stale}")
                         raise _RestartSignal(f"peers {stale} went silent")
                 time.sleep(spec.monitor_interval)

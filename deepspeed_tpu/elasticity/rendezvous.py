@@ -361,10 +361,58 @@ class ElasticRendezvous:
 
     # -- failure detection -------------------------------------------------
 
-    def heartbeat(self) -> None:
+    def heartbeat(self, payload: Optional[Dict[str, Any]] = None) -> None:
         # stamped by the STORE's clock (op=hb), not this host's — see
         # stale_peers: all staleness math happens on one clock
         self.c.hb(f"rdzv/hb/{self.node_id}")
+        if payload:
+            # liveness summary riding the heartbeat (the watchdog's step
+            # index / step-time EWMA): rank 0 folds every peer's payload
+            # into straggler-skew gauges (publish_straggler_stats)
+            self.c.set(f"rdzv/hbinfo/{self.node_id}", payload)
+
+    def peer_heartbeat_ages(self, peer_ids: List[str]
+                            ) -> Dict[str, Dict[str, Any]]:
+        """Per-node last-heartbeat age (store clock) + the last payload —
+        embedded in watchdog debug bundles so a hang dump distinguishes
+        "my host stalled" from "a peer died"."""
+        now = self.c.now()
+        out: Dict[str, Dict[str, Any]] = {}
+        for pid in peer_ids:
+            ts = self.c.get(f"rdzv/hb/{pid}")
+            out[pid] = {
+                "age_s": None if ts is None else round(now - float(ts), 3),
+                "left": bool(self.c.get(f"rdzv/left/{pid}")),
+                "info": self.c.get(f"rdzv/hbinfo/{pid}"),
+            }
+        return out
+
+    def publish_straggler_stats(self, peer_ids: List[str]
+                                ) -> Dict[str, float]:
+        """Rank 0 only: fold every peer's heartbeat payload into skew
+        gauges — ``elastic/straggler_step_skew`` (max-min step index
+        across hosts) and ``elastic/straggler_ewma_ratio`` (slowest
+        host's step-time EWMA over the median's)."""
+        infos = [self.c.get(f"rdzv/hbinfo/{pid}") for pid in peer_ids]
+        steps = [int(i["step"]) for i in infos
+                 if isinstance(i, dict) and "step" in i]
+        ewmas = [float(i["step_time_ewma_ms"]) for i in infos
+                 if isinstance(i, dict) and i.get("step_time_ewma_ms")]
+        stats: Dict[str, float] = {}
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if len(steps) >= 2:
+            stats["step_skew"] = float(max(steps) - min(steps))
+            tel.set_gauge("elastic/straggler_step_skew", stats["step_skew"],
+                          help="max-min per-host step index across the gang")
+        if len(ewmas) >= 2:
+            med = sorted(ewmas)[len(ewmas) // 2]
+            stats["ewma_ratio"] = max(ewmas) / max(med, 1e-9)
+            tel.set_gauge(
+                "elastic/straggler_ewma_ratio", stats["ewma_ratio"],
+                help="slowest host step-time EWMA over the median host's")
+        return stats
 
     def leave(self) -> None:
         """Graceful departure: a finished node stops heartbeating but must

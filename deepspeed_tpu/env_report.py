@@ -3,6 +3,10 @@
 Reference: ``deepspeed/env_report.py`` [K] — torch/cuda/nccl versions and a
 per-op compatibility matrix.  TPU edition: jax/jaxlib/libtpu/flax/optax/orbax
 versions, device inventory, native-op toolchain probes.
+
+:func:`collect` returns the same report as a JSON-able dict — the flight
+recorder (``telemetry/flight_recorder.py``) embeds it in every debug
+bundle so a post-mortem carries the exact environment it ran in.
 """
 
 from __future__ import annotations
@@ -10,6 +14,10 @@ from __future__ import annotations
 import importlib
 import shutil
 import sys
+from typing import Any, Dict
+
+_MODULES = ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+            "numpy", "torch")
 
 
 def _version(mod: str) -> str:
@@ -20,29 +28,53 @@ def _version(mod: str) -> str:
         return "not installed"
 
 
-def cli_main() -> None:
-    print("-" * 60)
-    print("DeepSpeed-TPU environment report")
-    print("-" * 60)
-    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
-                "numpy", "torch"):
-        print(f"{mod:>18}: {_version(mod)}")
+def collect() -> Dict[str, Any]:
+    """The environment report as a dict (what ``ds_report`` prints)."""
+    out: Dict[str, Any] = {
+        "python": sys.version,
+        "versions": {mod: _version(mod) for mod in _MODULES},
+    }
     try:
         import jax
 
-        print(f"{'backend':>18}: {jax.default_backend()}")
-        print(f"{'devices':>18}: {jax.devices()}")
-        print(f"{'device_count':>18}: {jax.device_count()}")
+        out["backend"] = jax.default_backend()
+        out["devices"] = [str(d) for d in jax.devices()]
+        out["device_count"] = jax.device_count()
+        out["process_count"] = jax.process_count()
     except Exception as e:
-        print(f"{'jax devices':>18}: unavailable ({e})")
+        out["devices_error"] = str(e)
+    ops: Dict[str, Any] = {"g++": shutil.which("g++") or "MISSING"}
+    try:
+        from .ops.op_builder.builder import _BUILDERS
+
+        for name, builder in _BUILDERS.items():
+            try:
+                ops[name] = ("compatible" if builder.is_compatible()
+                             else "INCOMPATIBLE")
+            except Exception as e:
+                ops[name] = f"probe failed: {e}"
+    except Exception as e:
+        ops["error"] = str(e)
+    out["native_ops"] = ops
+    return out
+
+
+def cli_main() -> None:
+    report = collect()
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    for mod, ver in report["versions"].items():
+        print(f"{mod:>18}: {ver}")
+    if "devices_error" in report:
+        print(f"{'jax devices':>18}: unavailable ({report['devices_error']})")
+    else:
+        print(f"{'backend':>18}: {report['backend']}")
+        print(f"{'devices':>18}: {report['devices']}")
+        print(f"{'device_count':>18}: {report['device_count']}")
     print("-" * 60)
     print("native op compatibility")
-    from .ops.op_builder.builder import _BUILDERS
-
-    gxx = shutil.which("g++")
-    print(f"{'g++':>18}: {gxx or 'MISSING'}")
-    for name, builder in _BUILDERS.items():
-        status = "compatible" if builder.is_compatible() else "INCOMPATIBLE"
+    for name, status in report["native_ops"].items():
         print(f"{name:>18}: {status}")
     print("-" * 60)
 

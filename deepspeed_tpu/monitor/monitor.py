@@ -134,3 +134,11 @@ class MonitorMaster:
     def write_events(self, events: List[Event]) -> None:
         for backend in self.backends:
             backend.write_events(events)
+
+    def write_health_events(self, events) -> None:
+        """Fan out :class:`~..telemetry.health.HealthEvent` anomalies as
+        ``Health/<kind>`` scalars (the event's statistic — z-score,
+        ratio, scale — as the value) so a TensorBoard/W&B dashboard shows
+        anomaly markers on the same step axis as the training curves."""
+        self.write_events([(f"Health/{e.kind}", float(e.value), int(e.step))
+                           for e in events])

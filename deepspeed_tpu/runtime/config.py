@@ -163,6 +163,56 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class TelemetryWatchdogConfig(DeepSpeedConfigModel):
+    """``telemetry.watchdog`` — hang/straggler watchdog
+    (``telemetry/watchdog.py``).  Fed by ``engine.train_step`` progress
+    notifications (comms-logger activity is a secondary liveness
+    signal); on no progress within ``hang_timeout_s`` it dumps a
+    flight-recorder debug bundle and runs ``action``.  Independent of
+    ``telemetry.enabled`` — a production run can keep the hub off and
+    the watchdog on."""
+
+    enabled: bool = False
+    hang_timeout_s: float = 300.0
+    #: 0 → hang_timeout_s / 4, capped at 10s
+    poll_interval_s: float = 0.0
+    action: Literal["log", "raise", "exit"] = "log"
+    #: treat comms-logger counter movement as liveness (a long compile or
+    #: giant eager collective is slow, not hung)
+    comm_liveness: bool = True
+
+
+class TelemetryHealthConfig(DeepSpeedConfigModel):
+    """``telemetry.health`` — streaming anomaly detectors over the
+    engine's StepRecords (``telemetry/health.py``): NaN/Inf loss,
+    loss-spike z-score, grad-norm explosion, fp16 loss-scale collapse,
+    throughput regression.  Active when telemetry step records are on."""
+
+    enabled: bool = True
+    window: int = 32
+    min_points: int = 8
+    loss_spike_zscore: float = 6.0
+    grad_norm_ratio: float = 10.0
+    loss_scale_floor: float = 1.0
+    consecutive_scale_drops: int = 3
+    throughput_frac: float = 0.5
+
+
+class FlightRecorderConfig(DeepSpeedConfigModel):
+    """``telemetry.flight_recorder`` — the black box
+    (``telemetry/flight_recorder.py``): bounded rings of recent
+    StepRecords/HealthEvents/annotations, dumped as a debug bundle
+    (manifest + Chrome-trace slice + env report + per-thread stacks) on
+    demand, fatal signal, unhandled exception, or watchdog trip."""
+
+    enabled: bool = True
+    max_records: int = 256
+    #: default: <telemetry.output_path>/<job_name>/debug_bundles
+    output_path: str = ""
+    #: install SIGTERM/SIGABRT handlers + sys.excepthook at initialize()
+    install_handlers: bool = True
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``telemetry`` config group — the unified telemetry subsystem
     (``deepspeed_tpu/telemetry/``): span tracer + metrics registry +
@@ -189,6 +239,12 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #: no rates (pulling loss would block; the whole point is overlap)
     device_fence: bool = True
     max_span_events: int = 100000
+    watchdog: TelemetryWatchdogConfig = Field(
+        default_factory=TelemetryWatchdogConfig)
+    health: TelemetryHealthConfig = Field(
+        default_factory=TelemetryHealthConfig)
+    flight_recorder: FlightRecorderConfig = Field(
+        default_factory=FlightRecorderConfig)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

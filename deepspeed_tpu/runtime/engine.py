@@ -318,6 +318,9 @@ class DeepSpeedEngine:
         #: recent per-step records (bench/autotuner read the SAME numbers
         #: the engine logged — they can never disagree)
         self.step_records: collections.deque = collections.deque(maxlen=512)
+        #: last comms_logger exec_totals snapshot — StepRecords carry the
+        #: per-step DELTA (the cumulative number is already comm_bytes)
+        self._last_exec_totals = (0.0, 0.0)
         self.last_step_record = None
         #: analytic model FLOPs per optimizer step; callers that know the
         #: model shape set it so StepRecords carry TFLOPS/MFU
@@ -326,6 +329,47 @@ class DeepSpeedEngine:
         # every step is fenced, so samples/sec ranks candidates by DEVICE
         # step time instead of host dispatch/queue backpressure
         self._autotuning_fence = bool(os.environ.get("DS_AUTOTUNING_RESULT"))
+
+        # --- active diagnostics: flight recorder / watchdog / health ------
+        # (telemetry/{flight_recorder,watchdog,health}.py — ISSUE 2)
+        tcfg = config.telemetry
+        self.flight_recorder = None
+        self.watchdog = None
+        self.health = None
+        wd_cfg, h_cfg = tcfg.watchdog, tcfg.health
+        from ..telemetry.flight_recorder import recorder_from_config
+
+        self.flight_recorder = recorder_from_config(tcfg)
+        if wd_cfg.enabled:
+            from ..telemetry import HangWatchdog, set_watchdog
+
+            self.watchdog = HangWatchdog(
+                hang_timeout_s=wd_cfg.hang_timeout_s,
+                poll_interval_s=wd_cfg.poll_interval_s,
+                action=wd_cfg.action, comm_liveness=wd_cfg.comm_liveness,
+                # None when the recorder is disabled — the watchdog then
+                # trips WITHOUT writing bundles (the operator said no)
+                recorder=self.flight_recorder)
+            # process-global handle: the elastic agent folds the
+            # watchdog's heartbeat_payload into rendezvous heartbeats
+            set_watchdog(self.watchdog)
+            # start NOW, not after the first step: the most common hang
+            # (a misconfigured mesh's first collective) happens INSIDE
+            # the first train_step, before any progress notification
+            self.watchdog.start()
+        if h_cfg.enabled and self._telemetry_steps:
+            from ..telemetry import HealthMonitor
+
+            self.health = HealthMonitor(
+                window=h_cfg.window, min_points=h_cfg.min_points,
+                loss_spike_zscore=h_cfg.loss_spike_zscore,
+                grad_norm_ratio=h_cfg.grad_norm_ratio,
+                loss_scale_floor=h_cfg.loss_scale_floor,
+                consecutive_scale_drops=h_cfg.consecutive_scale_drops,
+                throughput_frac=h_cfg.throughput_frac,
+                registry=(self.telemetry.registry if self.telemetry.enabled
+                          else None),
+                recorder=self.flight_recorder)
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -1084,6 +1128,9 @@ class DeepSpeedEngine:
             os.replace(tmp, result_path)  # atomic: no torn reads
         self.lr_scheduler.last_step = self.global_steps
         self.last_metrics = metrics
+        if self.watchdog is not None:
+            # a completed step IS progress (the daemon started at build)
+            self.watchdog.notify_progress(self.global_steps, step_time_s)
         if self._telemetry_steps:
             self._record_step_telemetry(batch, metrics, step_time_s, fenced)
         if self.steps_per_print and self.global_steps % int(
@@ -1144,6 +1191,18 @@ class DeepSpeedEngine:
             except Exception:
                 pass
         nan = float("nan")
+        extra: Dict[str, Any] = {}
+        if comms_logger.enabled and comms_logger.exec_counts:
+            # THIS step's execution-probe activity: shard-normalized
+            # cumulative totals (satellite: no more hand-dividing by
+            # jax.local_device_count()), diffed against the previous
+            # record's snapshot; clamped so a mid-run logger reset
+            # can't go negative
+            eops, ebytes = comms_logger.exec_totals(per_step=True)
+            prev = self._last_exec_totals
+            self._last_exec_totals = (eops, ebytes)
+            extra["comm_exec_ops"] = max(0.0, eops - prev[0])
+            extra["comm_exec_bytes"] = max(0.0, ebytes - prev[1])
         rec = StepRecord(
             step=self.global_steps,
             step_time_ms=step_time_s * 1e3,
@@ -1167,10 +1226,17 @@ class DeepSpeedEngine:
             tflops=tflops, mfu=mfu,
             # live-buffer census every 16th step only (O(all buffers))
             memory=collect_memory_stats(
-                include_live_buffers=self.global_steps % 16 == 1))
+                include_live_buffers=self.global_steps % 16 == 1),
+            extra=extra)
         self.last_step_record = rec
         self.step_records.append(rec)
         self.telemetry.record_step(rec)
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_step(rec)
+        if self.health is not None:
+            events = self.health.observe(rec)
+            if events and self.monitor is not None:
+                self.monitor.write_health_events(events)
 
     def _emit_module_profile(self, batch, fp) -> None:
         """One-shot per-module flops/latency table at ``profile_step``

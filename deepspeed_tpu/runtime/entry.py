@@ -138,6 +138,15 @@ def initialize(args: Any = None,
 
         configure_from_config(cfg.telemetry)
 
+    # flight recorder BEFORE engine construction: a crash during state
+    # placement / first compile still gets a debug bundle, and the
+    # fatal-signal + unhandled-exception hooks cover the whole run
+    from ..telemetry.flight_recorder import recorder_from_config
+
+    recorder = recorder_from_config(cfg.telemetry)
+    if recorder is not None and cfg.telemetry.flight_recorder.install_handlers:
+        recorder.install()
+
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time
 

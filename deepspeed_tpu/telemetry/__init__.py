@@ -26,12 +26,17 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from .flight_recorder import (FlightRecorder, configure_flight_recorder,
+                              get_flight_recorder, load_bundle)
+from .health import HealthEvent, HealthMonitor
 from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       JSONLExporter, MetricsRegistry, parse_prometheus_text,
                       prom_name)
 from .step_record import (StepRecord, collect_memory_stats,
                           publish_step_record)
 from .tracer import NOOP_SPAN, SpanTracer, device_fence
+from .watchdog import (HangWatchdog, WatchdogTimeout, get_watchdog,
+                       set_watchdog)
 
 __all__ = [
     "Telemetry", "StepRecord", "MetricsRegistry", "SpanTracer",
@@ -39,6 +44,9 @@ __all__ = [
     "configure", "configure_from_config", "get_telemetry", "span",
     "publish_step_record", "collect_memory_stats", "parse_prometheus_text",
     "prom_name", "device_fence", "DEFAULT_BUCKETS",
+    "FlightRecorder", "configure_flight_recorder", "get_flight_recorder",
+    "load_bundle", "HealthEvent", "HealthMonitor",
+    "HangWatchdog", "WatchdogTimeout", "get_watchdog", "set_watchdog",
 ]
 
 

@@ -1,0 +1,228 @@
+"""Training-health anomaly detection over the engine's StepRecords.
+
+Streaming detectors — O(window) state, no history files — that turn the
+per-step record stream into structured :class:`HealthEvent`\\ s:
+
+* ``nan_loss``               — NaN/Inf loss (critical; the run is dead)
+* ``loss_spike``             — z-score vs a rolling loss window
+* ``grad_norm_explosion``    — non-finite, or ratio vs rolling median
+* ``loss_scale_collapse``    — fp16 scale at the floor or in free-fall
+* ``throughput_regression``  — tokens/sec vs rolling median (a silent
+  straggler/thermal/backpressure signal the loss can't show)
+
+Events are published everywhere an operator could be looking: counters +
+a last-event gauge in the metrics registry, a ``kind="health"`` JSONL
+event, the flight recorder's health ring (so the last anomalies are in
+every debug bundle), and — via ``MonitorMaster.write_health_events`` —
+the TensorBoard/W&B/CSV backends.
+
+Detectors only read **device-fenced** records: the async-recording path
+(``telemetry.device_fence: false``) carries NaN metric fields BY DESIGN
+(pulling the loss would block), and must not fire ``nan_loss``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from .step_record import StepRecord
+
+SEV_WARNING = "warning"
+SEV_CRITICAL = "critical"
+
+
+@dataclasses.dataclass
+class HealthEvent:
+    kind: str
+    severity: str
+    step: int
+    message: str
+    value: float      # the observed statistic (z-score, ratio, scale...)
+    threshold: float  # the limit it crossed
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class HealthMonitor:
+    """Feed :meth:`observe` every StepRecord; get events back (also
+    published through the registry/recorder/monitor handed in)."""
+
+    def __init__(self, window: int = 32, min_points: int = 8,
+                 loss_spike_zscore: float = 6.0,
+                 grad_norm_ratio: float = 10.0,
+                 loss_scale_floor: float = 1.0,
+                 consecutive_scale_drops: int = 3,
+                 throughput_frac: float = 0.5,
+                 registry: Optional[Any] = None,
+                 recorder: Optional[Any] = None):
+        self.min_points = max(2, int(min_points))
+        self.loss_spike_zscore = float(loss_spike_zscore)
+        self.grad_norm_ratio = float(grad_norm_ratio)
+        self.loss_scale_floor = float(loss_scale_floor)
+        self.consecutive_scale_drops = int(consecutive_scale_drops)
+        self.throughput_frac = float(throughput_frac)
+        self.registry = registry
+        self.recorder = recorder
+        w = max(int(window), self.min_points)
+        self._losses: "collections.deque[float]" = collections.deque(maxlen=w)
+        self._grad_norms: "collections.deque[float]" = collections.deque(
+            maxlen=w)
+        self._tps: "collections.deque[float]" = collections.deque(maxlen=w)
+        self._prev_scale: Optional[float] = None
+        self._scale_drops = 0
+        self._scale_collapsed = False  # fire the floor crossing once
+        #: consecutive anomalous samples per windowed detector — once a
+        #: streak reaches min_points the "spike" is a LEVEL SHIFT and the
+        #: samples start entering the window, so the baseline re-bases
+        #: instead of alerting on every step forever
+        self._loss_anoms = 0
+        self._gn_anoms = 0
+        self.events_total = 0
+
+    # -- detectors ---------------------------------------------------------
+
+    def _check_loss(self, rec: StepRecord, out: List[HealthEvent]) -> None:
+        loss = float(rec.loss)
+        if not math.isfinite(loss):
+            out.append(HealthEvent(
+                "nan_loss", SEV_CRITICAL, rec.step,
+                f"step {rec.step}: non-finite loss {loss}", loss, 0.0))
+            return  # a NaN must never enter the rolling window
+        if len(self._losses) >= self.min_points:
+            mean = sum(self._losses) / len(self._losses)
+            var = sum((x - mean) ** 2
+                      for x in self._losses) / len(self._losses)
+            # relative std floor: a near-constant loss window must not
+            # turn fp jitter into an infinite z-score
+            std = max(math.sqrt(var), 1e-3 * max(abs(mean), 1e-6))
+            z = (loss - mean) / std
+            if z >= self.loss_spike_zscore:
+                out.append(HealthEvent(
+                    "loss_spike", SEV_WARNING, rec.step,
+                    f"step {rec.step}: loss {loss:.4g} is {z:.1f} sigma "
+                    f"above the rolling mean {mean:.4g}",
+                    z, self.loss_spike_zscore))
+                self._loss_anoms += 1
+                if self._loss_anoms < self.min_points:
+                    # keep the baseline clean of a TRANSIENT spike; a
+                    # sustained streak falls through and re-bases
+                    return
+            else:
+                self._loss_anoms = 0
+        self._losses.append(loss)
+
+    def _check_grad_norm(self, rec: StepRecord,
+                         out: List[HealthEvent]) -> None:
+        gn = float(rec.grad_norm)
+        if not math.isfinite(gn):
+            out.append(HealthEvent(
+                "grad_norm_explosion", SEV_CRITICAL, rec.step,
+                f"step {rec.step}: non-finite grad norm {gn}", gn, 0.0))
+            return
+        if len(self._grad_norms) >= self.min_points:
+            med = max(_median(list(self._grad_norms)), 1e-12)
+            ratio = gn / med
+            if ratio >= self.grad_norm_ratio:
+                out.append(HealthEvent(
+                    "grad_norm_explosion", SEV_WARNING, rec.step,
+                    f"step {rec.step}: grad norm {gn:.4g} is {ratio:.1f}x "
+                    f"the rolling median {med:.4g}",
+                    ratio, self.grad_norm_ratio))
+                self._gn_anoms += 1
+                if self._gn_anoms < self.min_points:
+                    return  # transient; a sustained streak re-bases
+            else:
+                self._gn_anoms = 0
+        self._grad_norms.append(gn)
+
+    def _check_loss_scale(self, rec: StepRecord,
+                          out: List[HealthEvent]) -> None:
+        scale = float(rec.loss_scale)
+        if not math.isfinite(scale):
+            return  # overflow step artifacts; the loss check covers these
+        prev = self._prev_scale
+        self._prev_scale = scale
+        if prev is None:
+            return
+        if scale < prev:
+            self._scale_drops += 1
+        elif scale > prev:
+            self._scale_drops = 0
+            self._scale_collapsed = False
+        hit_floor = (scale <= self.loss_scale_floor
+                     and prev > self.loss_scale_floor)
+        free_fall = self._scale_drops >= self.consecutive_scale_drops
+        if (hit_floor or free_fall) and not self._scale_collapsed:
+            self._scale_collapsed = True
+            why = ("hit the floor" if hit_floor else
+                   f"halved {self._scale_drops} steps in a row")
+            out.append(HealthEvent(
+                "loss_scale_collapse", SEV_CRITICAL, rec.step,
+                f"step {rec.step}: fp16 loss scale {scale:.4g} {why} "
+                f"(every recent step overflowed)",
+                scale, self.loss_scale_floor))
+
+    def _check_throughput(self, rec: StepRecord,
+                          out: List[HealthEvent]) -> None:
+        tps = float(rec.tokens_per_sec)
+        if not (math.isfinite(tps) and tps > 0):
+            return  # async records carry no rates
+        if len(self._tps) >= self.min_points:
+            med = _median(list(self._tps))
+            if med > 0 and tps < self.throughput_frac * med:
+                out.append(HealthEvent(
+                    "throughput_regression", SEV_WARNING, rec.step,
+                    f"step {rec.step}: {tps:.0f} tokens/s is below "
+                    f"{self.throughput_frac:.0%} of the rolling median "
+                    f"{med:.0f}", tps / med, self.throughput_frac))
+        # regressed samples DO enter the window: a sustained slowdown
+        # fires ~min_points events then becomes the new baseline instead
+        # of alerting forever
+        self._tps.append(tps)
+
+    # -- the feed ----------------------------------------------------------
+
+    def observe(self, rec: StepRecord) -> List[HealthEvent]:
+        out: List[HealthEvent] = []
+        if rec.device_fenced:
+            self._check_loss(rec, out)
+            self._check_grad_norm(rec, out)
+            self._check_loss_scale(rec, out)
+        self._check_throughput(rec, out)
+        for ev in out:
+            self._publish(ev)
+        return out
+
+    def _publish(self, ev: HealthEvent) -> None:
+        self.events_total += 1
+        if self.recorder is not None:
+            try:
+                self.recorder.record_health(ev)
+            except Exception:
+                pass
+        reg = self.registry
+        if reg is None:
+            return
+        try:
+            reg.counter("health/events_total",
+                        "training-health anomaly events").inc()
+            reg.counter(f"health/{ev.kind}_total",
+                        f"{ev.kind} anomaly events").inc()
+            reg.gauge("health/last_event_step",
+                      "step of the most recent health event").set(ev.step)
+            reg.emit_event("health", ev.to_dict())
+        except Exception:
+            pass

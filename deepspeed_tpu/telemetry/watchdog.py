@@ -1,0 +1,222 @@
+"""Hang / straggler watchdog over train-step progress notifications.
+
+MegaScale (arXiv:2402.15627) attributes most of its >90% effective
+training time to automated hang diagnosis; the failure mode it targets —
+a collective that never completes, a host that silently stalls — leaves
+NO error anywhere, just a process that stops making progress.  This
+watchdog is that detector for this runtime:
+
+* the engine calls :meth:`HangWatchdog.notify_progress` after every
+  completed ``train_step`` (step index + step time, folded into an EWMA);
+* a daemon thread (or an explicit :meth:`check` call — the tests drive a
+  **fake clock** through it, no sleeps) compares the injectable clock
+  against the last progress stamp;
+* ``comms_logger`` activity is a secondary liveness signal: a long
+  compile or a giant eager collective moves comm counters without
+  finishing a step, and must not be declared a hang;
+* on trip it dumps a flight-recorder debug bundle (last spans,
+  StepRecords, per-thread stacks, peer heartbeat ages) and runs the
+  configured action: ``log`` (keep running), ``raise``
+  (:class:`WatchdogTimeout` — from the daemon thread this interrupts the
+  main thread), or ``exit`` (``os._exit(2)`` for supervisors that
+  restart on death, e.g. the elastic agent).
+
+The per-host :meth:`heartbeat_payload` (step index, step-time EWMA,
+progress age) is what the elastic agent folds into its rendezvous
+heartbeat so rank 0 can publish straggler-skew gauges across hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.logging import logger
+
+ACTIONS = ("log", "raise", "exit")
+
+
+class WatchdogTimeout(RuntimeError):
+    """No train-step progress within ``hang_timeout_s``."""
+
+
+class HangWatchdog:
+    #: default ``recorder``: resolve the process-global flight recorder
+    #: at trip time.  Pass an explicit ``None`` to trip WITHOUT dumping
+    #: (the engine does when ``telemetry.flight_recorder`` is disabled).
+    GLOBAL_RECORDER = object()
+
+    def __init__(self, hang_timeout_s: float = 300.0,
+                 poll_interval_s: float = 0.0,
+                 action: str = "log",
+                 comm_liveness: bool = True,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder: Any = GLOBAL_RECORDER):
+        if action not in ACTIONS:
+            raise ValueError(f"watchdog action {action!r} not in {ACTIONS}")
+        self.hang_timeout_s = float(hang_timeout_s)
+        #: 0 → a quarter of the timeout, capped at 10s (fast enough to
+        #: catch a hang within ~1.25x the configured budget)
+        self.poll_interval_s = (float(poll_interval_s) if poll_interval_s
+                                else min(self.hang_timeout_s / 4.0, 10.0))
+        self.action = action
+        self.comm_liveness = bool(comm_liveness)
+        self._clock = clock
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._last_progress = self._clock()
+        self._last_step = -1
+        self._ewma_ms = 0.0
+        self._last_comm_ops = self._comm_ops()
+        self._tripped = False
+        self.trips = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- progress feed (engine hot path: one lock + a few floats) ----------
+
+    def notify_progress(self, step: int,
+                        step_time_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._last_progress = self._clock()
+            self._last_step = int(step)
+            if step_time_s is not None:
+                ms = float(step_time_s) * 1e3
+                self._ewma_ms = (ms if self._ewma_ms == 0.0
+                                 else 0.9 * self._ewma_ms + 0.1 * ms)
+            self._tripped = False  # re-arm: progress resumed
+
+    def heartbeat_payload(self) -> Dict[str, float]:
+        """Per-host liveness summary for the rendezvous heartbeat: rank 0
+        folds every peer's payload into straggler-skew gauges."""
+        with self._lock:
+            return {"step": self._last_step,
+                    "step_time_ewma_ms": round(self._ewma_ms, 3),
+                    "progress_age_s": round(
+                        self._clock() - self._last_progress, 3)}
+
+    # -- the check ---------------------------------------------------------
+
+    def _comm_ops(self) -> int:
+        try:
+            from ..comm.comm import comms_logger
+
+            ops = comms_logger.total_ops()
+            for e in comms_logger.exec_stats.values():
+                ops += int(e.get("count", 0))
+            return ops
+        except Exception:
+            return 0
+
+    def check(self) -> bool:
+        """One watchdog tick against the injected clock.  Returns True if
+        this call tripped; the configured action runs on the trip edge
+        only (re-armed by the next :meth:`notify_progress`)."""
+        now = self._clock()
+        if self.comm_liveness:
+            ops = self._comm_ops()
+            with self._lock:
+                if ops != self._last_comm_ops:
+                    # collectives are still flowing — a long compile or a
+                    # giant eager gather is slow, not hung
+                    self._last_comm_ops = ops
+                    self._last_progress = now
+        with self._lock:
+            age = now - self._last_progress
+            if age <= self.hang_timeout_s or self._tripped:
+                return False
+            self._tripped = True
+            step, ewma = self._last_step, self._ewma_ms
+        self._trip(age, step, ewma)
+        return True
+
+    def _trip(self, age: float, step: int, ewma_ms: float) -> None:
+        reason = (f"watchdog: no train_step progress for {age:.1f}s "
+                  f"(hang_timeout_s={self.hang_timeout_s}, last step "
+                  f"{step}, step-time EWMA {ewma_ms:.1f}ms)")
+        bundle = None
+        recorder = self._recorder
+        if recorder is HangWatchdog.GLOBAL_RECORDER:
+            from .flight_recorder import get_flight_recorder
+
+            recorder = get_flight_recorder()
+        if recorder is not None:  # None = flight recorder disabled
+            try:
+                bundle = recorder.dump(reason, extra={
+                    "last_step": step, "step_time_ewma_ms": ewma_ms,
+                    "progress_age_s": age})
+            except Exception as e:
+                logger.error(f"watchdog: bundle dump failed: {e!r}")
+        # bump AFTER the dump: a monitor polling `trips` may read the
+        # bundle path the moment the counter moves
+        self.trips += 1
+        try:
+            from . import get_telemetry
+
+            get_telemetry().inc_counter(
+                "watchdog/trips", help="hang watchdog trips")
+        except Exception:
+            pass
+        msg = f"{reason}; debug bundle: {bundle}"
+        if self.action == "exit":
+            logger.error(msg + " — exiting (watchdog action=exit)")
+            os._exit(2)
+        if self.action == "raise":
+            raise WatchdogTimeout(msg)
+        logger.error(msg)
+
+    # -- daemon thread -----------------------------------------------------
+
+    @property
+    def started(self) -> bool:
+        return self._thread is not None
+
+    def start(self) -> None:
+        """Idempotent: spawn the daemon poll thread (real clock mode)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ds-hang-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.check()
+            except WatchdogTimeout as e:
+                # action="raise" from the daemon thread: the exception
+                # cannot cross threads, so interrupt the main thread (a
+                # KeyboardInterrupt at its next bytecode boundary) after
+                # logging — a hung COLLECTIVE won't be interruptible, but
+                # the bundle is already on disk either way
+                logger.error(f"watchdog: {e}")
+                import _thread
+
+                _thread.interrupt_main()
+                return
+            except Exception as e:
+                logger.warning(f"watchdog check failed: {e!r}")
+
+
+_watchdog: Optional[HangWatchdog] = None
+
+
+def get_watchdog() -> Optional[HangWatchdog]:
+    """The process-global watchdog, if one was installed (the elastic
+    agent reads it to fold progress into rendezvous heartbeats)."""
+    return _watchdog
+
+
+def set_watchdog(wd: Optional[HangWatchdog]) -> None:
+    global _watchdog
+    _watchdog = wd

@@ -26,6 +26,7 @@ SHARDS=(
   "tests/unit/runtime/test_infinity_opt_fp16.py"
   "tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/monitor"
+  "tests/unit/telemetry"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
   "tests/unit/multiprocess"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"

@@ -1,0 +1,22 @@
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_diagnostics():
+    """Isolation for the process-global diagnostic singletons: the
+    telemetry hub, the watchdog handle, and the flight recorder (whose
+    rings would otherwise carry StepRecords from earlier engine tests
+    into this shard's bundle assertions)."""
+    from deepspeed_tpu.telemetry import (get_flight_recorder, get_telemetry,
+                                         get_watchdog, set_watchdog)
+
+    get_telemetry().reset()
+    get_flight_recorder().reset()
+    set_watchdog(None)
+    yield
+    wd = get_watchdog()
+    if wd is not None:
+        wd.stop()
+    set_watchdog(None)
+    get_flight_recorder().reset()
+    get_telemetry().reset()

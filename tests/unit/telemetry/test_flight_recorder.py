@@ -1,0 +1,132 @@
+"""Flight recorder (ISSUE 2 tentpole): bounded rings, bundle dump/reload
+round trip, crash hooks, and bench.py's exception path recording its
+bundle in the BENCH artifact."""
+
+import json
+import os
+import signal
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, StepRecord,
+                                     configure_flight_recorder,
+                                     get_flight_recorder, get_telemetry,
+                                     load_bundle)
+
+
+def _rec(step, **over):
+    kw = dict(step=step, step_time_ms=200.0, device_fenced=True,
+              samples_per_sec=20.0, tokens_per_sec=2048.0, loss=1.0,
+              grad_norm=0.5, lr=1e-3, loss_scale=1.0, overflow=False,
+              skipped_steps=0, comm_bytes=4096, comm_ops=2)
+    kw.update(over)
+    return StepRecord(**kw)
+
+
+def test_dump_reload_round_trip(tmp_path):
+    hub = get_telemetry()
+    hub.configure(enabled=True, jsonl=False, prometheus=False)
+    with hub.span("engine/train_step", args={"step": 1}):
+        pass
+    hub.inc_counter("train/steps_total")
+
+    fr = FlightRecorder(max_records=8, output_path=str(tmp_path))
+    for s in range(1, 4):
+        fr.record_step(_rec(s))
+    fr.record_health({"kind": "loss_spike", "step": 2, "value": 7.0})
+    fr.annotate("rendezvous", {"round": 0, "rank": 0})
+    fr.register_context("heartbeat_ages",
+                        lambda: {"node-b": {"age_s": 42.0, "left": False}})
+
+    path = fr.dump("operator requested", extra={"note": "round trip"})
+    assert path == fr.last_bundle_path and os.path.isdir(path)
+
+    bundle = load_bundle(path)
+    m = bundle["manifest"]
+    assert m["reason"] == "operator requested"
+    assert m["extra"]["note"] == "round trip"
+    assert [s["step"] for s in m["steps"]] == [1, 2, 3]
+    assert m["steps"][-1]["tokens_per_sec"] == 2048.0
+    assert m["health_events"][0]["kind"] == "loss_spike"
+    assert m["annotations"][0]["kind"] == "rendezvous"
+    assert m["context"]["heartbeat_ages"]["node-b"]["age_s"] == 42.0
+    assert "train_steps_total 1" in m["metrics_prom"]
+    assert m["comm"]["total_bytes"] >= 0
+    # side files: Chrome-trace slice, env snapshot, per-thread stacks
+    assert any(e["name"] == "engine/train_step"
+               for e in bundle["trace"]["traceEvents"])
+    assert "jax" in bundle["env_report"]["versions"]
+    assert "File" in bundle["stacks"]  # faulthandler stack frames
+
+
+def test_ring_is_bounded_and_keeps_the_tail(tmp_path):
+    fr = FlightRecorder(max_records=4, output_path=str(tmp_path))
+    for s in range(10):
+        fr.record_step(_rec(s))
+    m = load_bundle(fr.dump("bounded"))["manifest"]
+    assert [s["step"] for s in m["steps"]] == [6, 7, 8, 9]
+
+
+def test_broken_context_provider_does_not_kill_the_dump(tmp_path):
+    fr = FlightRecorder(output_path=str(tmp_path))
+    fr.register_context("dead", lambda: 1 / 0)
+    m = load_bundle(fr.dump("resilience"))["manifest"]
+    assert "ZeroDivisionError" in m["context"]["dead"]["error"]
+
+
+def test_excepthook_dumps_then_chains(tmp_path, capsys):
+    fr = FlightRecorder(output_path=str(tmp_path))
+    fr.install(signals=False, excepthook=True)
+    try:
+        try:
+            raise RuntimeError("induced crash")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        fr.uninstall()
+    assert fr.last_bundle_path is not None
+    m = load_bundle(fr.last_bundle_path)["manifest"]
+    assert "induced crash" in m["reason"]
+    assert "induced crash" in m["extra"]["traceback"]
+    # the previous excepthook ran after the dump (traceback on stderr)
+    assert "induced crash" in capsys.readouterr().err
+
+
+def test_signal_handlers_install_and_restore():
+    fr = FlightRecorder()
+    prev_term = signal.getsignal(signal.SIGTERM)
+    fr.install(signals=True, excepthook=False)
+    try:
+        assert signal.getsignal(signal.SIGTERM) == fr._signal_handler
+        assert signal.getsignal(signal.SIGABRT) == fr._signal_handler
+    finally:
+        fr.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+
+
+def test_bench_exception_path_writes_bundle(tmp_path, capsys, monkeypatch):
+    """Acceptance (ISSUE 2): bench.py's exception path writes a debug
+    bundle and records its path in the one-line BENCH artifact."""
+    import bench
+
+    configure_flight_recorder(output_path=str(tmp_path))
+
+    def boom():
+        raise RuntimeError("induced bench crash")
+
+    monkeypatch.setattr(bench, "_main", boom)
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 4
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "llama_110m_train_tokens_per_sec"
+    assert doc["value"] == 0.0
+    assert doc["error"].startswith("RuntimeError: induced bench crash")
+    assert doc["debug_bundle"] and os.path.isdir(doc["debug_bundle"])
+    m = load_bundle(doc["debug_bundle"])["manifest"]
+    assert "bench unhandled exception" in m["reason"]
+    assert "induced bench crash" in m["extra"]["traceback"]
+    # the crash bundle came from the process-global recorder
+    assert get_flight_recorder().last_bundle_path == doc["debug_bundle"]

@@ -1,0 +1,154 @@
+"""Training-health anomaly detectors (ISSUE 2): one unit test per
+detector, publication into the registry/recorder, and the async-record
+(NaN-by-design) guard."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, HealthMonitor,
+                                     MetricsRegistry, StepRecord)
+
+
+def _rec(step, loss=1.0, grad_norm=0.5, loss_scale=65536.0,
+         tokens_per_sec=1000.0, device_fenced=True):
+    return StepRecord(step=step, step_time_ms=100.0,
+                      device_fenced=device_fenced, samples_per_sec=10.0,
+                      tokens_per_sec=tokens_per_sec, loss=loss,
+                      grad_norm=grad_norm, lr=1e-3, loss_scale=loss_scale,
+                      overflow=False, skipped_steps=0, comm_bytes=0,
+                      comm_ops=0)
+
+
+def _monitor(**over):
+    kw = dict(window=16, min_points=4, loss_spike_zscore=6.0,
+              grad_norm_ratio=10.0, loss_scale_floor=1.0,
+              consecutive_scale_drops=3, throughput_frac=0.5)
+    kw.update(over)
+    return HealthMonitor(**kw)
+
+
+def _warm(hm, n=6, start=1):
+    """Feed n unremarkable steps so every rolling window is primed."""
+    for i in range(start, start + n):
+        assert hm.observe(_rec(i, loss=1.0 + 0.01 * (i % 3),
+                               grad_norm=0.5 + 0.01 * (i % 2))) == []
+    return start + n
+
+
+def test_nan_loss_detector():
+    hm = _monitor()
+    events = hm.observe(_rec(1, loss=float("nan"), grad_norm=0.5))
+    kinds = [e.kind for e in events]
+    assert "nan_loss" in kinds
+    ev = events[kinds.index("nan_loss")]
+    assert ev.severity == "critical" and ev.step == 1
+    # Inf counts too
+    assert any(e.kind == "nan_loss"
+               for e in hm.observe(_rec(2, loss=float("inf"))))
+
+
+def test_loss_spike_detector():
+    hm = _monitor()
+    step = _warm(hm)
+    events = hm.observe(_rec(step, loss=10.0))
+    assert [e.kind for e in events] == ["loss_spike"]
+    ev = events[0]
+    assert ev.severity == "warning"
+    assert ev.value >= hm.loss_spike_zscore  # the z-score it crossed
+    # the spike did not poison the baseline: a normal step after is quiet
+    assert hm.observe(_rec(step + 1, loss=1.01)) == []
+
+
+def test_grad_norm_explosion_detector():
+    hm = _monitor()
+    step = _warm(hm)
+    events = hm.observe(_rec(step, grad_norm=50.0))
+    assert [e.kind for e in events] == ["grad_norm_explosion"]
+    assert events[0].value == pytest.approx(50.0 / 0.5, rel=0.1)
+    # non-finite grad norm is critical even with a cold window
+    hm2 = _monitor()
+    events = hm2.observe(_rec(1, grad_norm=float("inf")))
+    assert events[0].kind == "grad_norm_explosion"
+    assert events[0].severity == "critical"
+
+
+def test_loss_scale_collapse_free_fall():
+    hm = _monitor()
+    scale = 65536.0
+    assert hm.observe(_rec(1, loss_scale=scale)) == []
+    events = []
+    for step in range(2, 6):
+        scale /= 2.0  # overflow every step: the scaler halves repeatedly
+        events += hm.observe(_rec(step, loss_scale=scale))
+    assert [e.kind for e in events] == ["loss_scale_collapse"]
+    assert events[0].severity == "critical"
+    # latched: continued decay does not re-fire until the scale recovers
+    assert hm.observe(_rec(6, loss_scale=scale / 2)) == []
+
+
+def test_loss_scale_collapse_floor_crossing():
+    hm = _monitor()
+    assert hm.observe(_rec(1, loss_scale=2.0)) == []
+    events = hm.observe(_rec(2, loss_scale=1.0))
+    assert [e.kind for e in events] == ["loss_scale_collapse"]
+    # a constant non-fp16 scale (1.0 forever) never fires
+    hm2 = _monitor()
+    for step in range(1, 8):
+        assert hm2.observe(_rec(step, loss_scale=1.0)) == []
+
+
+def test_throughput_regression_detector():
+    hm = _monitor()
+    step = _warm(hm)
+    events = hm.observe(_rec(step, tokens_per_sec=300.0))
+    assert [e.kind for e in events] == ["throughput_regression"]
+    ev = events[0]
+    assert ev.severity == "warning"
+    assert ev.value == pytest.approx(0.3, rel=0.1)  # tps / rolling median
+
+
+def test_async_records_do_not_false_alarm():
+    """device_fence:false records carry NaN metric fields BY DESIGN —
+    they must not fire nan_loss/grad detectors."""
+    hm = _monitor()
+    nan = float("nan")
+    for step in range(1, 8):
+        events = hm.observe(_rec(step, loss=nan, grad_norm=nan,
+                                 loss_scale=nan, tokens_per_sec=0.0,
+                                 device_fenced=False))
+        assert events == []
+
+
+def test_events_publish_to_registry_and_recorder(tmp_path):
+    reg = MetricsRegistry()
+    fr = FlightRecorder(output_path=str(tmp_path))
+    hm = _monitor(registry=reg, recorder=fr)
+    hm.observe(_rec(1, loss=float("nan")))
+    assert reg.counter("health/events_total").value == 1
+    assert reg.counter("health/nan_loss_total").value == 1
+    assert reg.gauge("health/last_event_step").value == 1
+    assert hm.events_total == 1
+    # the recorder's health ring feeds every future debug bundle
+    from deepspeed_tpu.telemetry import load_bundle
+
+    m = load_bundle(fr.dump("check"))["manifest"]
+    assert m["health_events"][0]["kind"] == "nan_loss"
+    assert math.isnan(m["health_events"][0]["value"])
+
+
+def test_sustained_level_shift_rebases_instead_of_alerting_forever():
+    """A permanent loss plateau change (data-mix switch, resume) must
+    fire a bounded burst of loss_spike events, then become the new
+    baseline — not an unbounded alert storm."""
+    hm = _monitor()
+    step = _warm(hm, n=8)
+    fired = 0
+    for i in range(40):  # sustained new regime, ~10x the old loss
+        events = hm.observe(_rec(step + i, loss=10.0))
+        fired += sum(1 for e in events if e.kind == "loss_spike")
+    assert 0 < fired < 30, fired  # bounded burst, not every step
+    # the tail of the run is quiet: the window re-based on the new level
+    for i in range(5):
+        events = hm.observe(_rec(step + 40 + i, loss=10.0))
+        assert all(e.kind != "loss_spike" for e in events)

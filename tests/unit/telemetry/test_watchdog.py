@@ -1,0 +1,136 @@
+"""Hang watchdog (ISSUE 2): trips on a stalled fake train loop under a
+deterministic fake clock (no sleeps), dumps a complete debug bundle, and
+treats comms-logger activity as a secondary liveness signal."""
+
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, HangWatchdog,
+                                     StepRecord, WatchdogTimeout,
+                                     get_telemetry, load_bundle)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _rec(step):
+    return StepRecord(step=step, step_time_ms=200.0, device_fenced=True,
+                      samples_per_sec=20.0, tokens_per_sec=2048.0, loss=1.0,
+                      grad_norm=0.5, lr=1e-3, loss_scale=1.0, overflow=False,
+                      skipped_steps=0, comm_bytes=0, comm_ops=0)
+
+
+def test_watchdog_trips_on_stalled_fake_train_loop(tmp_path):
+    """Acceptance (ISSUE 2): a stalled fake engine step loop trips the
+    watchdog within the (fake-clock) timeout and writes a debug bundle
+    containing the last spans, StepRecords, a stack dump, and heartbeat
+    ages."""
+    clock = FakeClock()
+    fr = FlightRecorder(max_records=16, output_path=str(tmp_path))
+    fr.register_context(
+        "heartbeat_ages",
+        lambda: {"node-b": {"age_s": 1.2, "left": False},
+                 "node-c": {"age_s": 97.0, "left": False}})
+    hub = get_telemetry()
+    hub.configure(enabled=True, jsonl=False, prometheus=False)
+
+    wd = HangWatchdog(hang_timeout_s=30.0, action="log",
+                      comm_liveness=False, clock=clock, recorder=fr)
+    # healthy fake train loop: each completed step notifies progress
+    for step in range(1, 4):
+        with hub.span("engine/train_step", args={"step": step}):
+            pass
+        fr.record_step(_rec(step))
+        clock.advance(5.0)
+        wd.notify_progress(step, step_time_s=0.2)
+        assert wd.check() is False
+
+    # the loop stalls: fake clock runs past the timeout, no progress
+    clock.advance(31.0)
+    assert wd.check() is True
+    assert wd.trips == 1
+
+    bundle = load_bundle(fr.last_bundle_path)
+    m = bundle["manifest"]
+    assert "watchdog: no train_step progress" in m["reason"]
+    assert m["extra"]["last_step"] == 3
+    assert m["extra"]["step_time_ewma_ms"] > 0
+    # the last StepRecords and spans made it into the bundle
+    assert [s["step"] for s in m["steps"]] == [1, 2, 3]
+    names = [e["name"] for e in bundle["trace"]["traceEvents"]]
+    assert names.count("engine/train_step") == 3
+    # per-thread stack dump + per-peer heartbeat ages ("my host stalled"
+    # vs "a peer died")
+    assert "File" in bundle["stacks"]
+    assert m["context"]["heartbeat_ages"]["node-c"]["age_s"] == 97.0
+    # trip counter landed in the hub registry
+    assert hub.registry.counter("watchdog/trips").value == 1
+
+    # edge-triggered: no re-dump while still stalled...
+    clock.advance(100.0)
+    assert wd.check() is False
+    # ...and progress re-arms the trip
+    wd.notify_progress(4, step_time_s=0.2)
+    clock.advance(31.0)
+    assert wd.check() is True
+    assert wd.trips == 2
+
+
+def test_watchdog_action_raise(tmp_path):
+    clock = FakeClock()
+    fr = FlightRecorder(output_path=str(tmp_path))
+    wd = HangWatchdog(hang_timeout_s=10.0, action="raise",
+                      comm_liveness=False, clock=clock, recorder=fr)
+    wd.notify_progress(1, 0.1)
+    clock.advance(5.0)
+    wd.check()  # healthy
+    clock.advance(6.0)
+    with pytest.raises(WatchdogTimeout, match="no train_step progress"):
+        wd.check()
+    assert fr.last_bundle_path is not None  # bundle BEFORE the raise
+
+
+def test_watchdog_rejects_bad_action():
+    with pytest.raises(ValueError, match="action"):
+        HangWatchdog(action="explode")
+
+
+def test_comm_activity_is_secondary_liveness(tmp_path):
+    """A long compile / giant eager collective moves comm counters
+    without completing a step — that is slow, not hung."""
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    clock = FakeClock()
+    fr = FlightRecorder(output_path=str(tmp_path))
+    comms_logger.reset()
+    comms_logger.configure(enabled=True)
+    try:
+        wd = HangWatchdog(hang_timeout_s=10.0, action="log",
+                          comm_liveness=True, clock=clock, recorder=fr)
+        wd.notify_progress(1, 0.1)
+        clock.advance(11.0)
+        comms_logger.record("psum", 128)  # collectives still flowing
+        assert wd.check() is False        # comm movement deferred the trip
+        clock.advance(11.0)               # now genuinely silent
+        assert wd.check() is True
+    finally:
+        comms_logger.configure(enabled=False)
+        comms_logger.reset()
+
+
+def test_heartbeat_payload_shape():
+    clock = FakeClock()
+    wd = HangWatchdog(hang_timeout_s=60.0, comm_liveness=False, clock=clock)
+    wd.notify_progress(7, step_time_s=0.25)
+    clock.advance(3.0)
+    p = wd.heartbeat_payload()
+    assert p["step"] == 7
+    assert p["step_time_ewma_ms"] == pytest.approx(250.0)
+    assert p["progress_age_s"] == pytest.approx(3.0)

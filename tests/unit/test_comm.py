@@ -149,3 +149,28 @@ def test_comms_logger_execution_counts():
     finally:
         comms_logger.configure(enabled=False)
         comms_logger.reset()
+
+
+def test_exec_summary_per_step_normalization():
+    """Satellite (ISSUE 2): ``exec_summary(per_step=True)`` divides the
+    per-local-shard execution counts by ``jax.local_device_count()`` so
+    callers (the engine's StepRecord comm-exec fields) stop hand-dividing
+    as the old docstring instructed."""
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    comms_logger.reset()
+    comms_logger.configure(enabled=True, exec_counts=True)
+    try:
+        n = jax.local_device_count()
+        for _ in range(2 * n):  # two "runs" of an n-shard collective
+            comms_logger.record_exec("all_gather", 128)
+        assert comms_logger.exec_summary()["all_gather"]["count"] == 2 * n
+        per = comms_logger.exec_summary(per_step=True)
+        assert per["all_gather"]["count"] == 2
+        assert per["all_gather"]["bytes"] == 2 * 128
+        assert comms_logger.exec_totals(per_step=True) == (2, 256)
+        # normalization returns a copy; the raw stats stay per-shard
+        assert comms_logger.exec_summary()["all_gather"]["count"] == 2 * n
+    finally:
+        comms_logger.configure(enabled=False)
+        comms_logger.reset()

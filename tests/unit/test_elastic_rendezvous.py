@@ -322,3 +322,59 @@ def test_scale_up_new_node_triggers_reformation(tmp_path):
             if a.poll() is None:
                 a.kill()
         srv.shutdown()
+
+
+def test_heartbeat_payload_ages_and_straggler_stats():
+    """ISSUE 2: heartbeats can carry the watchdog's liveness payload;
+    peer_heartbeat_ages feeds debug bundles, and rank 0 folds payloads
+    into straggler-skew gauges."""
+    from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+    hub = get_telemetry()
+    hub.reset()
+    hub.configure(enabled=True, jsonl=False, prometheus=False)
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        r = ElasticRendezvous(c, "a", min_nodes=1, settle_s=0.05)
+        r.next_round()
+        r.heartbeat({"step": 10, "step_time_ewma_ms": 120.0})
+        # two peers that joined elsewhere published their own payloads
+        c.set("rdzv/hbinfo/b", {"step": 4, "step_time_ewma_ms": 360.0})
+        c.set("rdzv/hbinfo/c", {"step": 9, "step_time_ewma_ms": 130.0})
+
+        ages = r.peer_heartbeat_ages(["a", "b"])
+        assert ages["a"]["age_s"] is not None and ages["a"]["age_s"] < 60
+        assert ages["a"]["info"]["step"] == 10
+        assert ages["b"]["age_s"] is None  # b never wrote a heartbeat
+        assert ages["b"]["left"] is False
+
+        stats = r.publish_straggler_stats(["a", "b", "c"])
+        assert stats["step_skew"] == 6.0            # 10 - 4
+        assert stats["ewma_ratio"] == pytest.approx(360.0 / 130.0)
+        parsed = parse_prometheus_text(hub.prometheus_text())
+        assert parsed["elastic_straggler_step_skew"] == 6.0
+        assert parsed["elastic_straggler_ewma_ratio"] == pytest.approx(
+            360.0 / 130.0, rel=1e-6)
+    finally:
+        srv.shutdown()
+        hub.reset()
+
+
+def test_agent_records_stale_peer_counter():
+    """Satellite (ISSUE 2): stale-peer detection at the agent level bumps
+    a telemetry counter before tearing the attempt down."""
+    from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                        WorkerSpec)
+    from deepspeed_tpu.telemetry import get_telemetry
+
+    hub = get_telemetry()
+    hub.reset()
+    hub.configure(enabled=True, jsonl=False, prometheus=False)
+    try:
+        agent = DSElasticAgent(WorkerSpec(fn=lambda *a: 0))
+        agent._record_stale_peers(["b", "c"])
+        counter = hub.registry.counter("elastic/agent_stale_peer_events")
+        assert counter.value == 2
+    finally:
+        hub.reset()
