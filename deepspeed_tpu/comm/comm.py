@@ -90,6 +90,11 @@ class CommsLogger:
         self.exec_counts = False
         self.stats: dict[str, dict[str, float]] = {}
         self.exec_stats: dict[str, dict[str, float]] = {}
+        #: optional CollectiveLedger (telemetry/collective_ledger.py) fed
+        #: INDEPENDENTLY of `enabled` — desync forensics must not depend
+        #: on the stats logger being switched on.  Attached via
+        #: telemetry.collective_ledger.attach_collective_ledger().
+        self.ledger = None
         import threading
 
         self._exec_lock = threading.Lock()
@@ -101,6 +106,12 @@ class CommsLogger:
         self.exec_counts = exec_counts
 
     def record(self, name: str, nbytes: int, seconds: float = 0.0) -> None:
+        led = self.ledger
+        if led is not None:
+            # call-site order is deterministic per host (identical
+            # programs issue identical sequences), which is what makes
+            # cross-rank ledger comparison meaningful
+            led.record(name, nbytes, source="census")
         if not self.enabled:
             return
         entry = self.stats.setdefault(name, {"count": 0, "bytes": 0, "seconds": 0.0})
@@ -115,6 +126,12 @@ class CommsLogger:
         # programs must stop counting the moment the logger is disabled.
         # Locked: unordered debug callbacks may fire concurrently from
         # several device shards, and += is not atomic.
+        led = self.ledger
+        if led is not None and getattr(led, "exec_feed", False):
+            # opt-in: execution probes fire from UNORDERED device
+            # callbacks, so their interleaving is not comparable across
+            # ranks — only useful for per-host sequence forensics
+            led.record(name, nbytes, source="exec")
         if not (self.enabled and self.exec_counts):
             return
         with self._exec_lock:
@@ -378,6 +395,12 @@ def _timed(name: str, fn, x):
         leaf = jax.tree.leaves(out)[0]
         np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)])
         comms_logger.record(name, _nbytes(x), time.perf_counter() - t0)
+    elif comms_logger.ledger is not None:
+        # stats logger off: record() is a stats no-op but still feeds the
+        # collective ledger (desync forensics must see eager verbs too);
+        # no fence — timing is only honest when the logger is on.  Guarded
+        # so the everything-off default stays zero-cost per call.
+        comms_logger.record(name, _nbytes(x))
     return out
 
 

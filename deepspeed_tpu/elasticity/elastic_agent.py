@@ -93,19 +93,38 @@ class DSElasticAgent:
         """The local watchdog's liveness summary (step index, step-time
         EWMA, progress age), folded into every rendezvous heartbeat so
         rank 0 can publish straggler-skew gauges; None when no watchdog
-        is installed (payload-less heartbeats, round-2 behavior)."""
-        from ..telemetry import get_watchdog
+        is installed (payload-less heartbeats, round-2 behavior).  The
+        collective ledger's ``coll_seq``/``coll_hash`` ride along
+        whenever the ledger is on — with or without a watchdog — so
+        rank 0 can flag a collective desync live."""
+        from ..telemetry import get_collective_ledger, get_watchdog
 
         wd = get_watchdog()
-        return wd.heartbeat_payload() if wd is not None else None
+        payload = wd.heartbeat_payload() if wd is not None else None
+        led = get_collective_ledger()
+        if led.enabled and (payload is None or "coll_seq" not in payload):
+            payload = dict(payload or {})
+            payload.update(led.heartbeat_summary())
+        return payload
 
     def _heartbeat_tick(self) -> None:
-        """One liveness beat: heartbeat (+watchdog payload); rank 0 also
-        folds peer payloads into the straggler-skew gauges."""
+        """One liveness beat: heartbeat (+watchdog/ledger payload); the
+        bundle publisher answers collect requests and pushes fresh trip
+        bundles; rank 0 also folds peer payloads into the straggler-skew
+        gauges and runs the live collective-desync check."""
         self.rdzv.heartbeat(self._hb_payload())
+        from ..telemetry.aggregator import check_desync_live, get_publisher
+
+        pub = get_publisher()
+        if pub is not None:
+            try:
+                pub.tick(self.rdzv.c)
+            except Exception:
+                pass  # store hiccup / dump failure; the next tick retries
         if self._rank == 0 and len(self._peers) > 1:
             try:
                 self.rdzv.publish_straggler_stats(self._peers)
+                check_desync_live(self.rdzv.c, self._peers)
             except Exception:
                 pass  # store hiccup; the next tick retries
 

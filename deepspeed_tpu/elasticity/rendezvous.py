@@ -125,12 +125,22 @@ class RendezvousServer:
 
 
 class RendezvousClient:
-    """One persistent connection to the store (reconnects on failure)."""
+    """One persistent connection to the store (reconnects on failure).
 
-    def __init__(self, endpoint: str, timeout: float = 60.0):
+    Calls retry with bounded exponential backoff on TRANSIENT transport
+    errors (ECONNRESET on a store restart, EINTR, a half-closed socket):
+    a debug-bundle collector sweeping N hosts must not die because one
+    request hit a reset — exactly the moment sweeps happen is the moment
+    networks are unhappy.  ``retries`` bounds the extra attempts;
+    the final failure propagates."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0,
+                 retries: int = 3, backoff_s: float = 0.05):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -143,7 +153,14 @@ class RendezvousClient:
 
     def _call(self, **req) -> Dict[str, Any]:
         with self._lock:
-            for attempt in (0, 1):
+            last: Optional[BaseException] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    # bounded exponential backoff, capped so a long
+                    # retry budget never stalls a heartbeat loop for
+                    # more than ~2s per wait
+                    time.sleep(min(self.backoff_s * (2 ** (attempt - 1)),
+                                   2.0))
                 try:
                     self._connect()
                     self._file.write((json.dumps(req) + "\n").encode())
@@ -152,11 +169,14 @@ class RendezvousClient:
                     if not line:
                         raise ConnectionError("store closed connection")
                     return json.loads(line)
-                except (OSError, ConnectionError):
+                except (OSError, ConnectionError, ValueError) as e:
+                    # ValueError: a line truncated by a mid-reply close
+                    # parses as bad JSON — same transient as the reset
+                    last = e
                     self.close()
-                    if attempt:
-                        raise
-        raise ConnectionError("unreachable")
+            raise ConnectionError(
+                f"store call failed after {self.retries + 1} attempts: "
+                f"{last!r}") from last
 
     def close(self) -> None:
         if self._sock is not None:

@@ -211,6 +211,34 @@ class FlightRecorderConfig(DeepSpeedConfigModel):
     output_path: str = ""
     #: install SIGTERM/SIGABRT handlers + sys.excepthook at initialize()
     install_handlers: bool = True
+    #: keep only the newest N bundle dirs per dump dir (repeated watchdog
+    #: trips must not fill the disk); <= 0 keeps everything
+    retain_bundles: int = 5
+
+
+class TelemetryAggregationConfig(DeepSpeedConfigModel):
+    """``telemetry.aggregation`` — the cross-host observability plane
+    (``telemetry/{aggregator,collective_ledger}.py``): each host
+    publishes its debug bundle through the elastic rendezvous store
+    (shared-FS fallback) and rank 0 / the operator CLI assembles ONE
+    cluster archive; a per-rank collective ledger rides the heartbeats
+    for live desync detection and lands full tails in the archive."""
+
+    enabled: bool = False
+    #: store-value chunk size for published bundle tarballs
+    chunk_bytes: int = 262144
+    #: size cap per published bundle (largest side files dropped first;
+    #: the manifest always ships)
+    max_bundle_bytes: int = 33554432
+    #: shared-filesystem fallback drop dir ("" = store transport only)
+    shared_fs_path: str = ""
+    #: rank-0 / operator collect timeout
+    collect_timeout_s: float = 30.0
+    #: per-rank monotonic ledger of collectives fed by the comms logger
+    ledger_enabled: bool = True
+    ledger_max_entries: int = 4096
+    #: ledger entries embedded in each debug bundle (comparison window)
+    ledger_tail: int = 64
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -245,6 +273,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=TelemetryHealthConfig)
     flight_recorder: FlightRecorderConfig = Field(
         default_factory=FlightRecorderConfig)
+    aggregation: TelemetryAggregationConfig = Field(
+        default_factory=TelemetryAggregationConfig)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

@@ -357,6 +357,19 @@ class DeepSpeedEngine:
             # (a misconfigured mesh's first collective) happens INSIDE
             # the first train_step, before any progress notification
             self.watchdog.start()
+        # collective ledger (telemetry/collective_ledger.py — ISSUE 3):
+        # every comms-logger record feeds a monotonic per-rank ledger
+        # whose tail hash rides elastic heartbeats (live desync) and
+        # whose tail lands in every debug bundle (offline divergence)
+        self.collective_ledger = None
+        agg_cfg = tcfg.aggregation
+        if agg_cfg.enabled and agg_cfg.ledger_enabled:
+            from ..telemetry import configure_collective_ledger
+
+            self.collective_ledger = configure_collective_ledger(
+                max_entries=agg_cfg.ledger_max_entries,
+                tail=agg_cfg.ledger_tail,
+                recorder=self.flight_recorder)
         if h_cfg.enabled and self._telemetry_steps:
             from ..telemetry import HealthMonitor
 

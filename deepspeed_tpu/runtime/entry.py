@@ -147,6 +147,30 @@ def initialize(args: Any = None,
     if recorder is not None and cfg.telemetry.flight_recorder.install_handlers:
         recorder.install()
 
+    # cross-host observability plane (telemetry/{aggregator,
+    # collective_ledger}.py): the ledger hooks into the comms logger
+    # BEFORE engine construction so state-placement / first-compile
+    # collectives are in the sequence; the publisher is the process-global
+    # service the elastic agent's heartbeat loop drives
+    if cfg.telemetry.aggregation.enabled:
+        from ..telemetry.aggregator import publisher_from_config
+
+        publisher = publisher_from_config(cfg.telemetry)
+        # subprocess deployments: THIS (worker) process owns the recorder
+        # and ledger, but the elastic agent heartbeats in its own process
+        # where get_publisher() is None — so the worker services the
+        # store itself through the endpoint the agent exported
+        rdzv_endpoint = os.environ.get("DS_RDZV_ENDPOINT")
+        if publisher is not None and rdzv_endpoint:
+            publisher.start_daemon(rdzv_endpoint)
+        if cfg.telemetry.aggregation.ledger_enabled:
+            from ..telemetry import configure_collective_ledger
+
+            configure_collective_ledger(
+                max_entries=cfg.telemetry.aggregation.ledger_max_entries,
+                tail=cfg.telemetry.aggregation.ledger_tail,
+                recorder=recorder)
+
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time
 

@@ -26,12 +26,19 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from .collective_ledger import (CollectiveLedger, attach_collective_ledger,
+                                configure_collective_ledger,
+                                desync_from_heartbeats,
+                                find_first_divergence,
+                                format_divergence_report,
+                                get_collective_ledger)
 from .flight_recorder import (FlightRecorder, configure_flight_recorder,
                               get_flight_recorder, load_bundle)
 from .health import HealthEvent, HealthMonitor
 from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
-                      JSONLExporter, MetricsRegistry, parse_prometheus_text,
-                      prom_name)
+                      JSONLExporter, MetricsRegistry, escape_help,
+                      escape_label_value, format_labels,
+                      parse_prometheus_text, prom_name)
 from .step_record import (StepRecord, collect_memory_stats,
                           publish_step_record)
 from .tracer import NOOP_SPAN, SpanTracer, device_fence
@@ -47,6 +54,11 @@ __all__ = [
     "FlightRecorder", "configure_flight_recorder", "get_flight_recorder",
     "load_bundle", "HealthEvent", "HealthMonitor",
     "HangWatchdog", "WatchdogTimeout", "get_watchdog", "set_watchdog",
+    "CollectiveLedger", "attach_collective_ledger",
+    "configure_collective_ledger", "get_collective_ledger",
+    "desync_from_heartbeats", "find_first_divergence",
+    "format_divergence_report",
+    "escape_help", "escape_label_value", "format_labels",
 ]
 
 

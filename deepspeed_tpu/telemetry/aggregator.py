@@ -1,0 +1,577 @@
+"""Cluster debug-bundle aggregation — N black boxes, ONE artifact.
+
+PR 2 left an N-host incident as N scattered local bundle directories;
+this module is the cross-host half (the ROADMAP follow-up): each host
+publishes its flight-recorder bundle through the elastic rendezvous
+key-value store (chunked + size-capped — the store is a control plane,
+not a blob store), and rank 0 / an operator assembles ONE cluster
+archive::
+
+    cluster-<utc>/
+      cluster_manifest.json     # per-host step index, heartbeat ages,
+                                # straggler stats, comm-census deltas,
+                                # collective-desync report
+      hosts/<node>/bundle-*/    # every host's full debug bundle
+
+Store protocol (all JSON values through ``RendezvousClient``):
+
+* ``debug/req``              — collect-request counter; the operator (or
+  rank 0) bumps it, every host's :class:`BundlePublisher` answers with a
+  FRESH dump.
+* ``debug/chunk/<node>/<i>`` — base64 chunks of the host's tar.gz.
+* ``debug/pub/<node>``       — publication meta (``req``, chunk count,
+  bytes, dropped files); written LAST, so it is the commit point.
+
+A shared-filesystem path is the fallback transport for deployments where
+hosts mount common storage but the store is gone (post-crash collection).
+
+Publishing is also event-driven: the publisher's periodic ``tick`` (the
+elastic agent calls it from its heartbeat loop) notices a new local
+bundle (watchdog trip, crash hook) and pushes it without an operator
+request — the archive a collect later assembles already holds the trip
+evidence even if the tripping host died in between.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+from .collective_ledger import (find_first_divergence,
+                                format_divergence_report)
+from .flight_recorder import BUNDLE_MANIFEST
+
+CLUSTER_MANIFEST = "cluster_manifest.json"
+_REQ_KEY = "debug/req"
+
+
+def _meta_key(node_id: str) -> str:
+    return f"debug/pub/{node_id}"
+
+
+def _chunk_key(node_id: str, i: int) -> str:
+    return f"debug/chunk/{node_id}/{i}"
+
+
+# ---------------------------------------------------------------------------
+# publish side (every host)
+# ---------------------------------------------------------------------------
+
+def _tar_bundle(bundle_dir: str, max_bytes: int) -> tuple:
+    """tar.gz the bundle dir into memory, smallest files first under the
+    size cap — ``bundle.json`` (the manifest, with the ledger tail and
+    comm census) is always included; a blown-up ``trace.json`` is what
+    gets dropped.  Returns ``(data, dropped_names)``."""
+    name = os.path.basename(bundle_dir.rstrip(os.sep))
+    files = sorted(
+        (f for f in os.listdir(bundle_dir)
+         if os.path.isfile(os.path.join(bundle_dir, f))),
+        key=lambda f: (f != BUNDLE_MANIFEST,
+                       os.path.getsize(os.path.join(bundle_dir, f))))
+    dropped: List[str] = []
+    buf = io.BytesIO()
+    budget = int(max_bytes)
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for f in files:
+            p = os.path.join(bundle_dir, f)
+            size = os.path.getsize(p)
+            # raw-size budget (compression only helps); manifest always in
+            if f != BUNDLE_MANIFEST and size > budget:
+                dropped.append(f)
+                continue
+            tar.add(p, arcname=f"{name}/{f}")
+            budget -= size
+    return buf.getvalue(), dropped
+
+
+def publish_bundle(client: Any, node_id: str, bundle_dir: str,
+                   req_id: int = 0, chunk_bytes: int = 256 * 1024,
+                   max_bundle_bytes: int = 32 * 1024 * 1024) -> Dict[str, Any]:
+    """Push one host's bundle through the store; returns the meta dict."""
+    data, dropped = _tar_bundle(bundle_dir, max_bundle_bytes)
+    b64 = base64.b64encode(data).decode("ascii")
+    step = max(1, int(chunk_bytes))
+    chunks = [b64[i:i + step] for i in range(0, len(b64), step)] or [""]
+    for i, ch in enumerate(chunks):
+        client.set(_chunk_key(node_id, i), ch)
+    meta = {"req": int(req_id), "bundle": os.path.basename(bundle_dir),
+            "n": len(chunks), "bytes": len(data), "dropped": dropped,
+            "ts": time.time()}
+    client.set(_meta_key(node_id), meta)  # commit point: meta LAST
+    return meta
+
+
+def _safe_extract(tar: tarfile.TarFile, out_dir: str) -> None:
+    for m in tar.getmembers():
+        p = os.path.normpath(m.name)
+        if p.startswith("..") or os.path.isabs(p) or not (m.isfile()
+                                                          or m.isdir()):
+            raise ValueError(f"unsafe tar member {m.name!r}")
+    tar.extractall(out_dir)
+
+
+def fetch_bundle(client: Any, node_id: str, out_dir: str) -> Optional[str]:
+    """Pull + unpack one host's published bundle into ``out_dir``;
+    returns the extracted bundle path, or None if nothing is published."""
+    meta = client.get(_meta_key(node_id))
+    if not isinstance(meta, dict):
+        return None
+    b64 = "".join(client.get(_chunk_key(node_id, i)) or ""
+                  for i in range(int(meta["n"])))
+    data = base64.b64decode(b64)
+    os.makedirs(out_dir, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        _safe_extract(tar, out_dir)
+    return os.path.join(out_dir, meta["bundle"])
+
+
+def publish_bundle_fs(node_id: str, bundle_dir: str, shared_fs_path: str,
+                      req_id: int = 0) -> str:
+    """Shared-filesystem fallback transport: copy the bundle under
+    ``<shared>/<node>/`` and stamp a meta file (same commit-last rule)."""
+    import shutil
+
+    dest_root = os.path.join(shared_fs_path, node_id)
+    dest = os.path.join(dest_root, os.path.basename(bundle_dir))
+    os.makedirs(dest_root, exist_ok=True)
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+    shutil.copytree(bundle_dir, dest)
+    with open(os.path.join(dest_root, "meta.json"), "w") as fh:
+        json.dump({"req": int(req_id),
+                   "bundle": os.path.basename(bundle_dir),
+                   "ts": time.time()}, fh)
+    return dest
+
+
+class BundlePublisher:
+    """Host-side service: answer collect requests and push fresh local
+    bundles.  The elastic agent calls :meth:`tick` from its heartbeat
+    loop; anything with a ``RendezvousClient``-shaped object can drive
+    it (the acceptance test runs three in one process)."""
+
+    def __init__(self, node_id: str, recorder: Any = None,
+                 chunk_bytes: int = 256 * 1024,
+                 max_bundle_bytes: int = 32 * 1024 * 1024,
+                 shared_fs_path: str = ""):
+        self.node_id = node_id
+        #: None = resolve the process-global recorder at tick time (the
+        #: ledger reaches bundles through its flight-recorder context
+        #: provider, so the publisher never touches it directly)
+        self._recorder = recorder
+        self.chunk_bytes = int(chunk_bytes)
+        self.max_bundle_bytes = int(max_bundle_bytes)
+        self.shared_fs_path = shared_fs_path
+        # start at 0, not the current counter: an outstanding request from
+        # before this host joined still deserves an answer (one redundant
+        # dump beats a collector timing out on a silent host)
+        self._last_req_served = 0
+        self._last_published: Optional[str] = None
+        # the agent's heartbeat loop and the worker-side daemon (subprocess
+        # mode) may drive the same publisher — one beat at a time
+        self._tick_lock = threading.Lock()
+        self._daemon: Optional[threading.Thread] = None
+        self._daemon_stop = threading.Event()
+
+    def recorder(self) -> Any:
+        if self._recorder is not None:
+            return self._recorder
+        from .flight_recorder import get_flight_recorder
+
+        return get_flight_recorder()
+
+    def _publish(self, client: Any, bundle_dir: str, req_id: int) -> None:
+        publish_bundle(client, self.node_id, bundle_dir, req_id=req_id,
+                       chunk_bytes=self.chunk_bytes,
+                       max_bundle_bytes=self.max_bundle_bytes)
+        if self.shared_fs_path:
+            try:
+                publish_bundle_fs(self.node_id, bundle_dir,
+                                  self.shared_fs_path, req_id=req_id)
+            except OSError as e:
+                logger.warning(f"aggregator: shared-fs publish failed: "
+                               f"{e!r}")
+        self._last_published = bundle_dir
+
+    def tick(self, client: Any) -> Optional[str]:
+        """One service beat: answer a pending collect request with a
+        FRESH dump, else push a not-yet-published local bundle (watchdog
+        trip / crash hook).  Returns the published path, if any."""
+        with self._tick_lock:
+            req = int(client.get(_REQ_KEY) or 0)
+            rec = self.recorder()
+            if req > self._last_req_served:
+                # dump BEFORE marking served: a failed dump (ENOSPC mid-
+                # incident) leaves the request pending so the next tick
+                # really does retry; a failed PUBLISH after a good dump
+                # self-heals via the last_bundle_path branch below
+                bundle = rec.dump(f"operator collect request #{req}")
+                self._last_req_served = req
+                self._publish(client, bundle, req)
+                return bundle
+            last = getattr(rec, "last_bundle_path", None)
+            if last and last != self._last_published \
+                    and os.path.isdir(last):
+                self._publish(client, last, self._last_req_served)
+                return last
+            return None
+
+    # -- worker-side daemon (subprocess deployments) -----------------------
+
+    def start_daemon(self, endpoint: str,
+                     interval_s: float = 1.0) -> None:
+        """Drive :meth:`tick` from a daemon thread with this process's
+        OWN store client.  This is how the publisher runs in subprocess
+        deployments: ``entry.initialize`` executes in the WORKER process
+        (which owns the flight recorder and ledger), while the elastic
+        agent heartbeats in a different process — its ``get_publisher()``
+        is None there.  Idempotent."""
+        if self._daemon is not None:
+            return
+        from ..elasticity.rendezvous import RendezvousClient
+
+        client = RendezvousClient(endpoint)
+        self._daemon_stop.clear()
+
+        def loop():
+            while not self._daemon_stop.wait(interval_s):
+                try:
+                    self.tick(client)
+                except Exception:
+                    pass  # store hiccup / dump failure; next beat retries
+
+        self._daemon = threading.Thread(target=loop, daemon=True,
+                                        name="ds-bundle-publisher")
+        self._daemon.start()
+
+    def stop_daemon(self) -> None:
+        self._daemon_stop.set()
+        t = self._daemon
+        self._daemon = None
+        if t is not None:
+            t.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# collect side (rank 0 / operator)
+# ---------------------------------------------------------------------------
+
+def _heartbeat_view(client: Any, peer_ids: List[str]
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Store-clock heartbeat ages + last payload per host at collect time
+    (standalone twin of ``ElasticRendezvous.peer_heartbeat_ages`` — the
+    collector may not be a rendezvous member)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    try:
+        now = client.now()
+    except Exception:
+        return out
+    for pid in peer_ids:
+        ts = client.get(f"rdzv/hb/{pid}")
+        out[pid] = {
+            "age_s": None if ts is None else round(now - float(ts), 3),
+            "left": bool(client.get(f"rdzv/left/{pid}")),
+            "info": client.get(f"rdzv/hbinfo/{pid}"),
+        }
+    return out
+
+
+def _new_archive_dir(out_dir: str) -> str:
+    """A fresh, never-colliding ``cluster-<utc>`` dir: a second-granular
+    stamp alone merges two collects issued in the same second (scripted
+    sweeps, retry loops), so disambiguate with an ``-NNN`` suffix when
+    the plain name is taken."""
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+    base = os.path.join(out_dir, f"cluster-{stamp}")
+    for i in range(1000):
+        candidate = base if i == 0 else f"{base}-{i:03d}"
+        try:
+            os.makedirs(candidate, exist_ok=False)
+            return candidate
+        except FileExistsError:
+            continue
+    raise OSError(f"could not allocate an archive dir under {out_dir}")
+
+
+def sealed_members(client: Any) -> List[str]:
+    """The current round's frozen gang — the default peer set for a
+    collect against a live rendezvous."""
+    r = int(client.get("rdzv/round") or 0)
+    sealed = client.get(f"rdzv/round/{r}/sealed")
+    return list(sealed[0]) if sealed else []
+
+
+def collect_cluster_archive(client: Any, peer_ids: Optional[List[str]] = None,
+                            out_dir: str = "cluster_archives",
+                            timeout_s: float = 30.0,
+                            request: bool = True) -> str:
+    """Assemble ONE operator-facing cluster archive from a live store.
+
+    Bumps the collect-request counter (unless ``request=False`` — then
+    whatever is already published is taken as-is), waits for every peer's
+    publication meta to reach the new request id, pulls and unpacks each
+    bundle, and writes the cluster manifest.  Hosts that never answer
+    (dead, hung harder than their publisher thread) are recorded in the
+    manifest as ``missing`` — absence at collect time is itself evidence.
+    """
+    peer_ids = list(peer_ids) if peer_ids else sealed_members(client)
+    if not peer_ids:
+        raise ValueError("collect: no peers (store has no sealed round; "
+                         "pass peer ids explicitly)")
+    req_id = int(client.add(_REQ_KEY, 1)) if request else 0
+    archive = _new_archive_dir(out_dir)
+    hosts_dir = os.path.join(archive, "hosts")
+    os.makedirs(hosts_dir, exist_ok=True)
+
+    def try_fetch(pid: str) -> Optional[str]:
+        # one host's corrupt / mid-overwrite publication (chunks are
+        # rewritten in place; we may race a re-publish) must not abort
+        # the whole collect — that host retries or lands in `missing`,
+        # which is itself evidence
+        try:
+            return fetch_bundle(client, pid, os.path.join(hosts_dir, pid))
+        except Exception as e:
+            logger.warning(f"aggregator: fetch from {pid} failed "
+                           f"({e!r}); retrying / marking missing")
+            return None
+
+    deadline = time.monotonic() + float(timeout_s)
+    pending = set(peer_ids)
+    got: Dict[str, str] = {}
+    while pending and time.monotonic() < deadline:
+        for pid in sorted(pending):
+            meta = client.get(_meta_key(pid))
+            if isinstance(meta, dict) and int(meta.get("req", -1)) >= req_id:
+                path = try_fetch(pid)
+                if path:
+                    got[pid] = path
+                    pending.discard(pid)
+        if pending:
+            time.sleep(0.05)
+    # a silent host may still have an OLDER publication (its last trip
+    # bundle before it died) — better than nothing in the archive
+    for pid in sorted(pending):
+        path = try_fetch(pid)
+        if path:
+            got[pid] = path
+    missing = sorted(set(peer_ids) - set(got))
+    build_cluster_manifest(archive,
+                           heartbeat_ages=_heartbeat_view(client, peer_ids),
+                           missing=missing, req_id=req_id)
+    logger.error(f"aggregator: cluster archive written to {archive} "
+                 f"({len(got)}/{len(peer_ids)} hosts"
+                 + (f", missing {missing}" if missing else "") + ")")
+    return archive
+
+
+def collect_cluster_archive_fs(shared_fs_path: str,
+                               out_dir: str = "cluster_archives") -> str:
+    """Shared-filesystem collection: assemble an archive from whatever
+    bundles hosts copied under ``<shared>/<node>/`` (the post-crash path
+    — no live store required)."""
+    import shutil
+
+    nodes = sorted(d for d in os.listdir(shared_fs_path)
+                   if os.path.isdir(os.path.join(shared_fs_path, d)))
+    if not nodes:
+        raise ValueError(f"collect: no host dirs under {shared_fs_path}")
+    archive = _new_archive_dir(out_dir)
+    for node in nodes:
+        meta_p = os.path.join(shared_fs_path, node, "meta.json")
+        bundle = None
+        if os.path.exists(meta_p):
+            with open(meta_p) as fh:
+                bundle = json.load(fh).get("bundle")
+        if bundle is None:  # fall back to the newest bundle dir
+            cands = sorted(d for d in os.listdir(
+                os.path.join(shared_fs_path, node)) if d.startswith("bundle"))
+            bundle = cands[-1] if cands else None
+        if bundle is None:
+            continue
+        src = os.path.join(shared_fs_path, node, bundle)
+        dst = os.path.join(archive, "hosts", node, bundle)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copytree(src, dst)
+    build_cluster_manifest(archive)
+    return archive
+
+
+# ---------------------------------------------------------------------------
+# cluster manifest
+# ---------------------------------------------------------------------------
+
+def load_host_manifests(archive: str) -> Dict[str, Dict[str, Any]]:
+    """``{node_id: bundle manifest}`` from an archive's ``hosts/`` tree."""
+    out: Dict[str, Dict[str, Any]] = {}
+    hosts_dir = os.path.join(archive, "hosts")
+    if not os.path.isdir(hosts_dir):
+        return out
+    for node in sorted(os.listdir(hosts_dir)):
+        node_dir = os.path.join(hosts_dir, node)
+        for bundle in sorted(os.listdir(node_dir)):
+            mp = os.path.join(node_dir, bundle, BUNDLE_MANIFEST)
+            if os.path.exists(mp):
+                with open(mp) as fh:
+                    out[node] = json.load(fh)
+                break
+    return out
+
+
+def _ledger_tails(manifests: Dict[str, Dict[str, Any]]
+                  ) -> Dict[str, List[Dict[str, Any]]]:
+    tails = {}
+    for node, m in manifests.items():
+        led = (m.get("context") or {}).get("collective_ledger")
+        if isinstance(led, dict) and isinstance(led.get("tail"), list):
+            tails[node] = led["tail"]
+    return tails
+
+
+def build_cluster_manifest(archive: str,
+                           heartbeat_ages: Optional[Dict[str, Any]] = None,
+                           missing: Optional[List[str]] = None,
+                           req_id: int = 0,
+                           persist: bool = True) -> Dict[str, Any]:
+    """Fold every host bundle in ``archive`` into one manifest: per-host
+    step index / reason / comm totals, cross-host step skew, comm-census
+    deltas, and the collective-desync report.  Written to
+    ``<archive>/cluster_manifest.json`` (unless ``persist=False`` — the
+    read-only CLI path) and returned."""
+    manifests = load_host_manifests(archive)
+    hosts: Dict[str, Any] = {}
+    census: Dict[str, Dict[str, float]] = {}
+    for node, m in manifests.items():
+        steps = m.get("steps") or []
+        last = steps[-1] if steps else {}
+        comm = m.get("comm") or {}
+        led = (m.get("context") or {}).get("collective_ledger") or {}
+        hosts[node] = {
+            "reason": m.get("reason"),
+            "time_utc": m.get("time_utc"),
+            "host": m.get("host"),
+            "last_step": last.get("step"),
+            "step_time_ms": last.get("step_time_ms"),
+            "steps_recorded": len(steps),
+            "health_events": len(m.get("health_events") or []),
+            "comm_ops": comm.get("total_ops"),
+            "comm_bytes": comm.get("total_bytes"),
+            "ledger_seq": led.get("seq"),
+            "ledger_tail_hash": led.get("tail_hash"),
+        }
+        for op, e in (comm.get("summary") or {}).items():
+            census.setdefault(op, {})[node] = float(e.get("count", 0))
+    last_steps = [h["last_step"] for h in hosts.values()
+                  if isinstance(h.get("last_step"), (int, float))]
+    comm_delta = {
+        op: {"per_host": by, "delta": max(by.values()) - min(by.values())}
+        for op, by in sorted(census.items()) if len(by) >= 2}
+    desync = find_first_divergence(_ledger_tails(manifests))
+    manifest: Dict[str, Any] = {
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "collect_request": int(req_id),
+        "hosts": hosts,
+        "missing_hosts": list(missing or []),
+        "step_skew": (max(last_steps) - min(last_steps)
+                      if len(last_steps) >= 2 else 0),
+        "comm_census_delta": comm_delta,
+        "heartbeat_ages": heartbeat_ages or {},
+        "desync": desync,
+        "desync_report": format_divergence_report(desync),
+    }
+    if persist:
+        os.makedirs(archive, exist_ok=True)
+        with open(os.path.join(archive, CLUSTER_MANIFEST), "w") as fh:
+            json.dump(manifest, fh, indent=2, default=str)
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# live desync check (rank 0's heartbeat loop)
+# ---------------------------------------------------------------------------
+
+def check_desync_live(client: Any, peer_ids: List[str]) -> Optional[dict]:
+    """Rank 0, every heartbeat tick: compare the ``coll_seq``/``coll_hash``
+    riding each peer's heartbeat payload.  Publishes
+    ``elastic/collective_seq_skew`` and, on a desync, bumps
+    ``elastic/collective_desync_events`` and annotates the local flight
+    recorder (the NEXT bundle then says when rank 0 first saw it)."""
+    from .collective_ledger import desync_from_heartbeats
+
+    payloads = {pid: client.get(f"rdzv/hbinfo/{pid}") for pid in peer_ids}
+    report = desync_from_heartbeats(payloads)
+    if report is None:
+        return None
+    from . import get_telemetry
+
+    tel = get_telemetry()
+    tel.set_gauge("elastic/collective_seq_skew", report["seq_skew"],
+                  help="max-min collective ledger seq across the gang")
+    if report.get("desync"):
+        tel.inc_counter(
+            "elastic/collective_desync_events",
+            help="heartbeat ledger hashes disagreed at the same seq")
+        from .flight_recorder import get_flight_recorder
+
+        get_flight_recorder().annotate("collective_desync", report)
+        logger.error(f"aggregator: live collective desync detected: "
+                     f"{report.get('mismatch')}")
+    return report
+
+
+# ---------------------------------------------------------------------------
+# process-global publisher + config wiring
+# ---------------------------------------------------------------------------
+
+_publisher: Optional[BundlePublisher] = None
+
+
+def get_publisher() -> Optional[BundlePublisher]:
+    """The installed publisher, if any — the elastic agent drives its
+    ``tick`` from the heartbeat loop."""
+    return _publisher
+
+
+def set_publisher(pub: Optional[BundlePublisher]) -> None:
+    global _publisher
+    prev = _publisher
+    _publisher = pub
+    if prev is not None and prev is not pub:
+        prev.stop_daemon()  # a replaced publisher must not leak its thread
+
+
+def publisher_from_config(tcfg: Any, node_id: Optional[str] = None
+                          ) -> Optional[BundlePublisher]:
+    """Resolve the ``telemetry.aggregation`` config sub-group into the
+    installed process-global publisher (None when disabled).  Also None
+    when the flight recorder is disabled by config — the publisher's
+    whole job is dumping and shipping bundles, and 'the operator said
+    no' to bundles must not be bypassed through the global recorder."""
+    agg = tcfg.aggregation
+    if not agg.enabled:
+        set_publisher(None)
+        return None
+    from .flight_recorder import recorder_from_config
+
+    recorder = recorder_from_config(tcfg)
+    if recorder is None:
+        logger.warning("telemetry.aggregation enabled but the flight "
+                       "recorder is disabled — no bundles to publish; "
+                       "publisher not installed")
+        set_publisher(None)
+        return None
+    pub = BundlePublisher(
+        node_id=node_id or os.environ.get("DS_ELASTIC_NODE_ID",
+                                          f"node-{os.getpid()}"),
+        recorder=recorder,
+        chunk_bytes=agg.chunk_bytes,
+        max_bundle_bytes=agg.max_bundle_bytes,
+        shared_fs_path=agg.shared_fs_path)
+    set_publisher(pub)
+    return pub

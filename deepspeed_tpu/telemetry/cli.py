@@ -1,0 +1,321 @@
+"""Operator CLI — ``python -m deepspeed_tpu.telemetry <cmd>``.
+
+The read side of the observability plane, for humans at 3am:
+
+* ``collect``  — pull a cluster archive from a LIVE rendezvous store
+  (or a shared-filesystem drop dir): request fresh bundles from every
+  host, assemble one ``cluster-<utc>/`` archive + manifest.
+* ``summary``  — one bundle OR one cluster archive: reason, last N
+  steps, health events, slowest spans, desync verdict.
+* ``diff``     — two hosts' bundles: step skew, comm-census deltas,
+  ledger seq delta (the "which host is behind, doing what" question).
+* ``desync``   — offline collective-divergence analysis over an
+  archive's ledger tails; names the lagging rank and the first
+  mismatched collective.  Exit code 3 when a desync is found (script-
+  able), 0 when clean.
+
+Every command works on plain directories — no store, no JAX device
+needed beyond what importing the package costs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from .aggregator import (CLUSTER_MANIFEST, build_cluster_manifest,
+                         collect_cluster_archive, collect_cluster_archive_fs,
+                         load_host_manifests)
+from .collective_ledger import (find_first_divergence,
+                                format_divergence_report)
+from .flight_recorder import BUNDLE_MANIFEST, BUNDLE_TRACE
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
+def _is_bundle(path: str) -> bool:
+    return os.path.exists(os.path.join(path, BUNDLE_MANIFEST))
+
+
+def _is_archive(path: str) -> bool:
+    return (os.path.exists(os.path.join(path, CLUSTER_MANIFEST))
+            or os.path.isdir(os.path.join(path, "hosts")))
+
+
+def _resolve_bundle(path: str) -> Optional[str]:
+    """Accept a bundle dir, or a dir holding exactly one ``bundle-*``
+    (a host dir inside an archive, or a one-trip dump dir)."""
+    if _is_bundle(path):
+        return path
+    if os.path.isdir(path):
+        cands = sorted(d for d in os.listdir(path)
+                       if _is_bundle(os.path.join(path, d)))
+        if cands:
+            return os.path.join(path, cands[-1])  # newest by name stamp
+    return None
+
+
+def _load_manifest(bundle: str) -> Dict[str, Any]:
+    with open(os.path.join(bundle, BUNDLE_MANIFEST)) as fh:
+        return json.load(fh)
+
+
+def _slowest_spans(bundle: str, n: int = 5) -> List[Dict[str, Any]]:
+    p = os.path.join(bundle, BUNDLE_TRACE)
+    if not os.path.exists(p):
+        return []
+    try:
+        with open(p) as fh:
+            events = json.load(fh).get("traceEvents", [])
+    except (OSError, ValueError):
+        return []
+    spans = [e for e in events if isinstance(e.get("dur"), (int, float))]
+    spans.sort(key=lambda e: -e["dur"])
+    return spans[:n]
+
+
+# ---------------------------------------------------------------------------
+# summary
+# ---------------------------------------------------------------------------
+
+def _print_bundle_summary(bundle: str, last_n: int) -> None:
+    m = _load_manifest(bundle)
+    print(f"bundle: {bundle}")
+    print(f"  reason: {m.get('reason')}")
+    print(f"  host: {m.get('host')}  pid: {m.get('pid')}  "
+          f"time: {m.get('time_utc')}")
+    steps = m.get("steps") or []
+    print(f"  steps recorded: {len(steps)}")
+    for s in steps[-last_n:]:
+        print(f"    step {s.get('step')}: loss={s.get('loss')} "
+              f"step_time_ms={s.get('step_time_ms')} "
+              f"tokens/s={s.get('tokens_per_sec')}")
+    health = m.get("health_events") or []
+    print(f"  health events: {len(health)}")
+    for h in health[-last_n:]:
+        print(f"    {h.get('kind')}@step {h.get('step')}: "
+              f"{h.get('message', '')}")
+    led = (m.get("context") or {}).get("collective_ledger")
+    if isinstance(led, dict):
+        print(f"  collective ledger: seq {led.get('seq')} "
+              f"tail_hash {led.get('tail_hash')} "
+              f"(tail of {len(led.get('tail') or [])})")
+    spans = _slowest_spans(bundle)
+    if spans:
+        print("  slowest spans:")
+        for e in spans:
+            print(f"    {e.get('name')}: {e['dur'] / 1e3:.3f} ms")
+    ann = m.get("annotations") or []
+    if ann:
+        print(f"  annotations: {len(ann)} "
+              f"(last: {ann[-1].get('kind')})")
+
+
+def _print_archive_summary(archive: str, last_n: int) -> int:
+    mp = os.path.join(archive, CLUSTER_MANIFEST)
+    if os.path.exists(mp):
+        with open(mp) as fh:
+            cm = json.load(fh)
+    else:  # hand-assembled archive (shared-FS copy) — compute in memory;
+        # summary is a READ command and must work on a read-only mount
+        cm = build_cluster_manifest(archive, persist=False)
+    print(f"cluster archive: {archive}")
+    print(f"  created: {cm.get('created_utc')}  "
+          f"hosts: {len(cm.get('hosts') or {})}  "
+          f"missing: {cm.get('missing_hosts') or 'none'}")
+    print(f"  step skew across hosts: {cm.get('step_skew')}")
+    for node, h in sorted((cm.get("hosts") or {}).items()):
+        print(f"  [{node}] step {h.get('last_step')} "
+              f"ledger_seq {h.get('ledger_seq')} "
+              f"comm_ops {h.get('comm_ops')} — {h.get('reason')}")
+    deltas = cm.get("comm_census_delta") or {}
+    skewed = {op: d for op, d in deltas.items() if d.get("delta")}
+    if skewed:
+        print("  comm census deltas (op: max-min call count):")
+        for op, d in sorted(skewed.items()):
+            print(f"    {op}: {d['delta']} {d['per_host']}")
+    print("  desync analysis:")
+    for line in (cm.get("desync_report") or "").splitlines():
+        print(f"    {line}")
+    hosts_dir = os.path.join(archive, "hosts")
+    if os.path.isdir(hosts_dir):
+        for node in sorted(os.listdir(hosts_dir)):
+            b = _resolve_bundle(os.path.join(hosts_dir, node))
+            if b:
+                print()
+                _print_bundle_summary(b, last_n)
+    return 0
+
+
+def cmd_summary(args: argparse.Namespace) -> int:
+    path = args.path
+    if _is_archive(path):
+        return _print_archive_summary(path, args.steps)
+    bundle = _resolve_bundle(path)
+    if bundle is None:
+        return _fail(f"{path}: neither a debug bundle nor a cluster archive")
+    _print_bundle_summary(bundle, args.steps)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+def cmd_diff(args: argparse.Namespace) -> int:
+    a, b = _resolve_bundle(args.a), _resolve_bundle(args.b)
+    if a is None or b is None:
+        return _fail("diff needs two debug bundle directories")
+    ma, mb = _load_manifest(a), _load_manifest(b)
+
+    def last_step(m):
+        steps = m.get("steps") or []
+        return steps[-1].get("step") if steps else None
+
+    la, lb = last_step(ma), last_step(mb)
+    print(f"A: {a}\n   reason: {ma.get('reason')}  last step: {la}")
+    print(f"B: {b}\n   reason: {mb.get('reason')}  last step: {lb}")
+    if isinstance(la, (int, float)) and isinstance(lb, (int, float)):
+        print(f"step skew (A-B): {la - lb}")
+    ca = (ma.get("comm") or {}).get("summary") or {}
+    cb = (mb.get("comm") or {}).get("summary") or {}
+    ops = sorted(set(ca) | set(cb))
+    if ops:
+        print("comm census (op: A count / B count / delta):")
+        for op in ops:
+            na = float((ca.get(op) or {}).get("count", 0))
+            nb = float((cb.get(op) or {}).get("count", 0))
+            print(f"  {op}: {na:g} / {nb:g} / {na - nb:+g}")
+    la_led = (ma.get("context") or {}).get("collective_ledger") or {}
+    lb_led = (mb.get("context") or {}).get("collective_ledger") or {}
+    if la_led or lb_led:
+        print(f"collective ledger: A seq {la_led.get('seq')} "
+              f"hash {la_led.get('tail_hash')} | "
+              f"B seq {lb_led.get('seq')} hash {lb_led.get('tail_hash')}")
+        tails = {}
+        if la_led.get("tail"):
+            tails["A"] = la_led["tail"]
+        if lb_led.get("tail"):
+            tails["B"] = lb_led["tail"]
+        if len(tails) == 2:
+            print(format_divergence_report(find_first_divergence(tails)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# desync
+# ---------------------------------------------------------------------------
+
+def cmd_desync(args: argparse.Namespace) -> int:
+    if not _is_archive(args.archive):
+        return _fail(f"{args.archive}: not a cluster archive")
+    manifests = load_host_manifests(args.archive)
+    if not manifests:
+        return _fail(f"{args.archive}: no host bundles found")
+    # same filter as the cluster manifest (aggregator._ledger_tails):
+    # a host whose bundle has NO ledger context (ledger off / pre-ledger
+    # bundle) must not enter the analysis as an empty ledger — it would
+    # read as "lagging by everything".  A PRESENT-but-empty tail is real
+    # data ("this host never issued a collective") and stays in.
+    tails = {}
+    no_ledger = []
+    for node, m in manifests.items():
+        tail = ((m.get("context") or {}).get("collective_ledger") or {}) \
+            .get("tail")
+        if isinstance(tail, list):
+            tails[node] = tail
+        else:
+            no_ledger.append(node)
+    if no_ledger:
+        print(f"(no ledger data from: {', '.join(sorted(no_ledger))} — "
+              f"excluded from the analysis)")
+    report = find_first_divergence(tails)
+    print(format_divergence_report(report))
+    return 3 if report.get("desync") else 0
+
+
+# ---------------------------------------------------------------------------
+# collect
+# ---------------------------------------------------------------------------
+
+def cmd_collect(args: argparse.Namespace) -> int:
+    if args.shared_fs:
+        archive = collect_cluster_archive_fs(args.shared_fs,
+                                             out_dir=args.out)
+        print(archive)
+        return 0
+    if not args.endpoint:
+        return _fail("collect needs --endpoint host:port (live store) "
+                     "or --shared-fs <dir>")
+    from ..elasticity.rendezvous import RendezvousClient
+
+    client = RendezvousClient(args.endpoint)
+    peers = ([p for p in args.peers.split(",") if p]
+             if args.peers else None)
+    try:
+        archive = collect_cluster_archive(
+            client, peer_ids=peers, out_dir=args.out,
+            timeout_s=args.timeout, request=not args.no_request)
+    except (ValueError, ConnectionError, OSError) as e:
+        return _fail(str(e))
+    print(archive)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry",
+        description="cluster observability: collect / summarize / diff "
+                    "debug bundles, analyze collective desync")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("collect", help="pull a cluster archive from a live "
+                                       "rendezvous store or a shared FS dir")
+    c.add_argument("--endpoint", default=os.environ.get("DS_RDZV_ENDPOINT"),
+                   help="rendezvous store host:port "
+                        "(default: $DS_RDZV_ENDPOINT)")
+    c.add_argument("--peers", default="",
+                   help="comma-separated node ids (default: the store's "
+                        "current sealed round)")
+    c.add_argument("--out", default="cluster_archives")
+    c.add_argument("--timeout", type=float, default=30.0)
+    c.add_argument("--no-request", action="store_true",
+                   help="take already-published bundles as-is instead of "
+                        "requesting fresh dumps")
+    c.add_argument("--shared-fs", default="",
+                   help="assemble from a shared-filesystem drop dir "
+                        "instead of a live store")
+    c.set_defaults(fn=cmd_collect)
+
+    s = sub.add_parser("summary", help="summarize a bundle or archive")
+    s.add_argument("path")
+    s.add_argument("--steps", type=int, default=5,
+                   help="last N steps/events to print")
+    s.set_defaults(fn=cmd_summary)
+
+    d = sub.add_parser("diff", help="compare two hosts' bundles")
+    d.add_argument("a")
+    d.add_argument("b")
+    d.set_defaults(fn=cmd_diff)
+
+    y = sub.add_parser("desync", help="offline collective-divergence "
+                                      "analysis over an archive "
+                                      "(exit 3 when desync found)")
+    y.add_argument("archive")
+    y.set_defaults(fn=cmd_desync)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

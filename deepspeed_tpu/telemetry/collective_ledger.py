@@ -1,0 +1,313 @@
+"""Per-rank collective ledger — the desync half of the black box.
+
+MegaScale (arXiv:2402.15627) and PyTorch's NCCL flight recorder both
+diagnose production hangs the same way: every rank keeps a monotonic
+record of the collectives it issued, and the post-mortem question is
+*which rank diverged, and on which collective?*  This module is that
+record for this runtime:
+
+* :class:`CollectiveLedger` — a bounded ring of ``(seq, op, bytes)``
+  entries fed by ``CommsLogger.record`` (call-site/census order, which
+  is deterministic per host — identical programs issue identical
+  sequences) and, opt-in, by ``record_exec`` (execution probes fire from
+  unordered device callbacks, so their interleaving is NOT comparable
+  across ranks — off by default for exactly that reason).
+* A **rolling tail hash**: each entry chains
+  ``h = sha1(h_prev | "op:bytes")``, so two ranks that issued the same
+  sequence agree on one short string.  ``heartbeat_summary()`` returns
+  ``{coll_seq, coll_hash}`` to ride the elastic rendezvous heartbeat —
+  rank 0 compares payloads live (:func:`desync_from_heartbeats`) and
+  flags "same seq, different hash" the tick it happens.
+* :func:`find_first_divergence` — the offline analysis over full ledger
+  tails (one per host, pulled from debug bundles by the aggregator):
+  names the lagging rank (lowest sequence number — the host stuck in or
+  before that collective) and the first mismatched collective
+  (desync: ranks disagreeing on what the N-th collective even was).
+
+The ledger is cheap enough to leave on (one lock + a sha1 over ~30
+bytes per *call-site* record; trace-time census records fire once per
+compile, not per step) and is a process-global singleton like the rest
+of the telemetry stack — but every piece also takes explicit instances
+so N in-process "hosts" can be tested in one process.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+GENESIS_HASH = "0" * 16
+
+
+def _chain(prev: str, sig: str) -> str:
+    return hashlib.sha1(f"{prev}|{sig}".encode()).hexdigest()[:16]
+
+
+def entry_signature(op: str, nbytes: int) -> str:
+    """The cross-rank comparison key for one collective."""
+    return f"{op}:{int(nbytes)}"
+
+
+class CollectiveLedger:
+    """Monotonic per-rank ledger of issued collectives."""
+
+    def __init__(self, max_entries: int = 4096, tail: int = 64,
+                 enabled: bool = False, exec_feed: bool = False):
+        self.enabled = bool(enabled)
+        #: also ingest execution-probe records (CommsLogger.record_exec).
+        #: Off by default: exec callbacks are UNORDERED across device
+        #: shards, so an exec-fed chain is per-host forensics only —
+        #: never compare it across ranks.
+        self.exec_feed = bool(exec_feed)
+        self.max_entries = int(max_entries)
+        #: entries embedded in snapshots/bundles (the comparison window)
+        self.tail_entries = int(tail)
+        self._entries: "collections.deque" = collections.deque(
+            maxlen=self.max_entries)
+        self._seq = 0
+        self._hash = GENESIS_HASH
+        self._lock = threading.Lock()
+
+    def configure(self, enabled: Optional[bool] = None,
+                  max_entries: Optional[int] = None,
+                  tail: Optional[int] = None) -> "CollectiveLedger":
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if tail:
+                self.tail_entries = int(tail)
+            if max_entries and int(max_entries) != self.max_entries:
+                self.max_entries = int(max_entries)
+                self._entries = collections.deque(self._entries,
+                                                  maxlen=self.max_entries)
+        return self
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._seq = 0
+            self._hash = GENESIS_HASH
+
+    # -- recording (fed by CommsLogger.record / record_exec) ---------------
+
+    def record(self, op: str, nbytes: int, source: str = "census") -> None:
+        if not self.enabled:
+            return
+        sig = entry_signature(op, nbytes)
+        with self._lock:
+            self._seq += 1
+            self._hash = _chain(self._hash, sig)
+            self._entries.append({"seq": self._seq, "op": op,
+                                  "bytes": int(nbytes), "hash": self._hash,
+                                  "src": source, "ts": time.time()})
+
+    # -- read side ---------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    @property
+    def tail_hash(self) -> str:
+        return self._hash
+
+    def heartbeat_summary(self) -> Dict[str, Any]:
+        """``{coll_seq, coll_hash}`` — rides the rendezvous heartbeat
+        payload so rank 0 can detect desync live without pulling full
+        ledgers."""
+        with self._lock:
+            return {"coll_seq": self._seq, "coll_hash": self._hash}
+
+    def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = list(self._entries)
+        n = self.tail_entries if n is None else int(n)
+        return entries[-n:] if n > 0 else entries
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The flight-recorder context-provider payload: landed in every
+        bundle manifest under ``context["collective_ledger"]`` so the
+        cluster aggregator can run divergence analysis offline."""
+        with self._lock:
+            entries = list(self._entries)[-self.tail_entries:]
+            return {"seq": self._seq, "tail_hash": self._hash,
+                    "tail": entries}
+
+
+# ---------------------------------------------------------------------------
+# divergence analysis
+# ---------------------------------------------------------------------------
+
+def desync_from_heartbeats(payloads: Dict[str, Any]
+                           ) -> Optional[Dict[str, Any]]:
+    """Live check over heartbeat payloads (``{node: hbinfo}``): two ranks
+    reporting the SAME ``coll_seq`` with DIFFERENT ``coll_hash`` issued
+    different collectives somewhere in their history — a desync, even
+    though both are still making progress.  Returns ``None`` when fewer
+    than two payloads carry ledger fields."""
+    seqs: Dict[str, int] = {}
+    hashes: Dict[int, Dict[str, str]] = {}
+    for node, info in payloads.items():
+        if not (isinstance(info, dict) and "coll_seq" in info):
+            continue
+        s = int(info["coll_seq"])
+        seqs[node] = s
+        hashes.setdefault(s, {})[node] = str(info.get("coll_hash", ""))
+    if len(seqs) < 2:
+        return None
+    out: Dict[str, Any] = {
+        "per_rank_seq": seqs,
+        "seq_skew": max(seqs.values()) - min(seqs.values()),
+        "desync": False,
+    }
+    for s, by_node in sorted(hashes.items()):
+        if len(by_node) >= 2 and len(set(by_node.values())) > 1:
+            out["desync"] = True
+            out["mismatch"] = {"seq": s, "hashes": by_node}
+            break
+    return out
+
+
+def find_first_divergence(ledgers: Dict[str, List[Dict[str, Any]]]
+                          ) -> Dict[str, Any]:
+    """Offline analysis over per-rank ledger tails: name the lagging rank
+    and the first mismatched collective.
+
+    ``ledgers`` maps node id → entry list (each entry at least
+    ``{seq, op, bytes}``; ``hash`` strengthens the verdict).  Tails are
+    bounded rings, so only the overlapping seq window is comparable; a
+    hash disagreement at the window start with identical signatures
+    inside it means the divergence predates the retained window, and is
+    reported as such instead of silently missed."""
+    per_seq: Dict[str, int] = {}
+    first: Dict[str, int] = {}
+    by_seq: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    for node, entries in ledgers.items():
+        per_seq[node] = max((int(e["seq"]) for e in entries), default=0)
+        first[node] = min((int(e["seq"]) for e in entries), default=0)
+        by_seq[node] = {int(e["seq"]): e for e in entries}
+    report: Dict[str, Any] = {
+        "per_rank_seq": per_seq,
+        "lagging_rank": None,
+        "seq_skew": 0,
+        "first_mismatch": None,
+        "desync": False,
+    }
+    if not per_seq:
+        return report
+    lo_rank = min(sorted(per_seq), key=lambda n: per_seq[n])
+    report["seq_skew"] = max(per_seq.values()) - per_seq[lo_rank]
+    if report["seq_skew"] > 0:
+        report["lagging_rank"] = lo_rank
+    # comparable window: seqs every POPULATED ledger retains, up to the
+    # slowest populated rank's head — a host with no entries at all
+    # (crashed pre-collective, ledger off) must not collapse the window
+    # and mask a real desync between the ranks that do have data
+    populated = [n for n in ledgers if by_seq[n]]
+    if len(populated) < 2:
+        return report
+    lo = max(first[n] for n in populated)
+    hi = min(per_seq[n] for n in populated)
+    report["overlap"] = [lo, hi]
+    for s in range(lo, hi + 1):
+        sigs = {n: entry_signature(by_seq[n][s]["op"], by_seq[n][s]["bytes"])
+                for n in populated if s in by_seq[n]}
+        if len(sigs) >= 2 and len(set(sigs.values())) > 1:
+            counts = collections.Counter(sigs.values())
+            top_sig, top_n = counts.most_common(1)[0]
+            if list(counts.values()).count(top_n) > 1:
+                # no strict majority (e.g. a 2-rank 1-1 split): the
+                # disagreement is symmetric — name every participant
+                # rather than pretending one side is canonical
+                divergent = sorted(sigs)
+            else:
+                divergent = sorted(n for n, v in sigs.items()
+                                   if v != top_sig)
+            report["desync"] = True
+            report["first_mismatch"] = {
+                "seq": s,
+                "signatures": sigs,
+                "divergent_ranks": divergent,
+            }
+            return report
+    # signatures agree across the window — but do the hash chains?  A
+    # disagreement here means the fork happened before the retained tail.
+    for s in (lo, hi):
+        hs = {n: by_seq[n][s].get("hash") for n in populated
+              if s in by_seq[n] and by_seq[n][s].get("hash")}
+        if len(hs) >= 2 and len(set(hs.values())) > 1:
+            report["desync"] = True
+            report["first_mismatch"] = {
+                "seq": None,
+                "note": ("hash chains disagree at seq "
+                         f"{s} but retained signatures match — the "
+                         "divergence predates the retained ledger window"),
+                "hashes_at_seq": {str(s): hs},
+            }
+            return report
+    return report
+
+
+def format_divergence_report(report: Dict[str, Any]) -> str:
+    """Human rendering of :func:`find_first_divergence` — the text the
+    ``desync`` CLI prints and the cluster manifest embeds."""
+    lines = []
+    seqs = report.get("per_rank_seq", {})
+    for node in sorted(seqs):
+        lines.append(f"  rank {node}: collective seq {seqs[node]}")
+    if report.get("lagging_rank"):
+        lines.append(f"lagging rank: {report['lagging_rank']} "
+                     f"(behind by {report['seq_skew']} collectives)")
+    else:
+        lines.append("no lagging rank (all ranks at the same seq)")
+    fm = report.get("first_mismatch")
+    if not report.get("desync"):
+        lines.append("no collective desync detected in the compared window")
+    elif fm and fm.get("seq") is not None:
+        sigs = ", ".join(f"{n}={v}" for n, v in sorted(fm["signatures"]
+                                                       .items()))
+        lines.append(f"FIRST MISMATCHED COLLECTIVE: seq {fm['seq']} "
+                     f"({sigs}); divergent rank(s): "
+                     f"{', '.join(fm['divergent_ranks'])}")
+    elif fm:
+        lines.append(f"DESYNC: {fm['note']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# process-global instance + wiring
+# ---------------------------------------------------------------------------
+
+_default = CollectiveLedger()
+
+
+def get_collective_ledger() -> CollectiveLedger:
+    return _default
+
+
+def attach_collective_ledger(ledger: Optional[CollectiveLedger]) -> None:
+    """Point ``comms_logger`` at ``ledger`` (or detach with ``None``) —
+    every call-site record then feeds the ledger regardless of whether
+    the comms logger itself is enabled."""
+    from ..comm.comm import comms_logger
+
+    comms_logger.ledger = ledger
+
+
+def configure_collective_ledger(enabled: bool = True,
+                                max_entries: Optional[int] = None,
+                                tail: Optional[int] = None,
+                                recorder: Any = None) -> CollectiveLedger:
+    """Resolve config into the global ledger: enable it, hook it into the
+    comms logger, and (when a flight recorder is given) register the
+    snapshot as a bundle context provider so every future debug bundle
+    carries this rank's ledger tail.  Idempotent."""
+    led = _default.configure(enabled=enabled, max_entries=max_entries,
+                             tail=tail)
+    attach_collective_ledger(led if enabled else None)
+    if recorder is not None and enabled:
+        recorder.register_context("collective_ledger", led.snapshot)
+    return led

@@ -60,9 +60,13 @@ class FlightRecorder:
     """Bounded in-memory black box with on-demand bundle dumps."""
 
     def __init__(self, max_records: int = 256,
-                 output_path: str = "debug_bundles"):
+                 output_path: str = "debug_bundles", retain: int = 5):
         self.max_records = int(max_records)
         self.output_path = output_path
+        #: keep only the newest N bundle dirs under ``output_path`` —
+        #: a watchdog stuck in trip/re-arm cycles must not fill the disk.
+        #: <= 0 disables pruning.
+        self.retain = int(retain)
         self._steps: "collections.deque" = collections.deque(
             maxlen=self.max_records)
         self._health: "collections.deque" = collections.deque(
@@ -85,10 +89,13 @@ class FlightRecorder:
         self.last_bundle_path: Optional[str] = None
 
     def configure(self, max_records: Optional[int] = None,
-                  output_path: Optional[str] = None) -> "FlightRecorder":
+                  output_path: Optional[str] = None,
+                  retain: Optional[int] = None) -> "FlightRecorder":
         with self._lock:
             if output_path:
                 self.output_path = output_path
+            if retain is not None:
+                self.retain = int(retain)
             if max_records and int(max_records) != self.max_records:
                 self.max_records = int(max_records)
                 for name in ("_steps", "_health", "_annotations"):
@@ -225,9 +232,33 @@ class FlightRecorder:
             logger.warning(f"flight recorder: stack dump failed: {e!r}")
 
         self.last_bundle_path = bundle_dir
+        self._prune_bundles()
         logger.error(f"flight recorder: debug bundle written to "
                      f"{bundle_dir} ({reason})")
         return bundle_dir
+
+    def _prune_bundles(self) -> None:
+        """Retention: drop the oldest bundle dirs beyond ``retain`` —
+        best-effort, a failed prune must never fail the dump."""
+        if self.retain <= 0:
+            return
+        try:
+            dirs = [os.path.join(self.output_path, d)
+                    for d in os.listdir(self.output_path)
+                    if d.startswith("bundle-")
+                    and os.path.isdir(os.path.join(self.output_path, d))]
+            # mtime with the (stamp, seq) name as tiebreak — several dumps
+            # inside one mtime granule still prune oldest-first
+            dirs.sort(key=lambda p: (os.path.getmtime(p),
+                                     os.path.basename(p)))
+            import shutil
+
+            for stale in dirs[:-self.retain]:
+                if stale == self.last_bundle_path:
+                    continue
+                shutil.rmtree(stale, ignore_errors=True)
+        except OSError:
+            pass
 
     # -- crash hooks -------------------------------------------------------
 
@@ -316,10 +347,11 @@ def get_flight_recorder() -> FlightRecorder:
 
 
 def configure_flight_recorder(max_records: Optional[int] = None,
-                              output_path: Optional[str] = None
+                              output_path: Optional[str] = None,
+                              retain: Optional[int] = None
                               ) -> FlightRecorder:
     return _default.configure(max_records=max_records,
-                              output_path=output_path)
+                              output_path=output_path, retain=retain)
 
 
 def recorder_from_config(tcfg: Any) -> Optional[FlightRecorder]:
@@ -334,4 +366,5 @@ def recorder_from_config(tcfg: Any) -> Optional[FlightRecorder]:
         max_records=fr.max_records,
         output_path=fr.output_path or os.path.join(
             tcfg.output_path or "telemetry_logs", tcfg.job_name,
-            "debug_bundles"))
+            "debug_bundles"),
+        retain=fr.retain_bundles)

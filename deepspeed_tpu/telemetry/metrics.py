@@ -41,6 +41,29 @@ def prom_name(name: str) -> str:
     return out
 
 
+def escape_help(text: str) -> str:
+    """Exposition-format HELP escaping: backslash and newline (a raw
+    newline in help text would truncate the comment line and leave the
+    remainder as a malformed sample)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value: Any) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote,
+    newline — the three characters that can break out of ``v="..."``."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(labels: Dict[str, Any]) -> str:
+    """Render ``{k="v",...}`` with escaped values ('' for no labels)."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{prom_name(str(k))}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -147,7 +170,7 @@ class Histogram:
         base = prom_name(self.name)
         out = []
         for ub, cum in self.bucket_counts().items():
-            out.append((base + "_bucket", f'{{le="{ub}"}}', cum))
+            out.append((base + "_bucket", format_labels({"le": ub}), cum))
         out.append((base + "_sum", "", self._sum))
         out.append((base + "_count", "", self._count))
         return out
@@ -251,7 +274,7 @@ class MetricsRegistry:
             m = snapshot[name]
             base = prom_name(name)
             if m.help:
-                lines.append(f"# HELP {base} {m.help}")
+                lines.append(f"# HELP {base} {escape_help(m.help)}")
             lines.append(f"# TYPE {base} {m.kind}")
             for sample_name, labels, value in m.samples():
                 lines.append(f"{sample_name}{labels} {_render_value(value)}")

@@ -90,12 +90,20 @@ class HangWatchdog:
 
     def heartbeat_payload(self) -> Dict[str, float]:
         """Per-host liveness summary for the rendezvous heartbeat: rank 0
-        folds every peer's payload into straggler-skew gauges."""
+        folds every peer's payload into straggler-skew gauges.  When the
+        collective ledger is on, its ``coll_seq``/``coll_hash`` ride
+        along so rank 0 can detect collective desync live."""
         with self._lock:
-            return {"step": self._last_step,
-                    "step_time_ewma_ms": round(self._ewma_ms, 3),
-                    "progress_age_s": round(
-                        self._clock() - self._last_progress, 3)}
+            payload = {"step": self._last_step,
+                       "step_time_ewma_ms": round(self._ewma_ms, 3),
+                       "progress_age_s": round(
+                           self._clock() - self._last_progress, 3)}
+        from .collective_ledger import get_collective_ledger
+
+        led = get_collective_ledger()
+        if led.enabled:
+            payload.update(led.heartbeat_summary())
+        return payload
 
     # -- the check ---------------------------------------------------------
 
@@ -143,10 +151,21 @@ class HangWatchdog:
 
             recorder = get_flight_recorder()
         if recorder is not None:  # None = flight recorder disabled
+            extra = {"last_step": step, "step_time_ewma_ms": ewma_ms,
+                     "progress_age_s": age}
             try:
-                bundle = recorder.dump(reason, extra={
-                    "last_step": step, "step_time_ewma_ms": ewma_ms,
-                    "progress_age_s": age})
+                from .collective_ledger import get_collective_ledger
+
+                led = get_collective_ledger()
+                if led.enabled:
+                    # the hang headline names the last collective this
+                    # rank issued — the first thing a desync post-mortem
+                    # compares across hosts
+                    extra.update(led.heartbeat_summary())
+            except Exception:
+                pass
+            try:
+                bundle = recorder.dump(reason, extra=extra)
             except Exception as e:
                 logger.error(f"watchdog: bundle dump failed: {e!r}")
         # bump AFTER the dump: a monitor polling `trips` may read the

@@ -48,5 +48,28 @@ for shard in "${SHARDS[@]}"; do
   fi
   rm -f "$log"
 done
+# Operator-CLI smoke (ISSUE 3): a freshly generated debug bundle must
+# summarize cleanly through `python -m deepspeed_tpu.telemetry`.
+echo "=== CLI smoke: telemetry summary"
+smoke_dir=$(mktemp -d)
+bundle=$(python - "$smoke_dir" <<'PYEOF'
+import sys
+from deepspeed_tpu.telemetry import FlightRecorder
+
+fr = FlightRecorder(output_path=sys.argv[1])
+fr.annotate("cli_smoke", {"ok": True})
+fr.record_step({"step": 1, "step_time_ms": 1.0, "loss": 0.5})
+print(fr.dump("run_suite CLI smoke"))
+PYEOF
+)
+bundle=$(echo "$bundle" | tail -1)
+if python -m deepspeed_tpu.telemetry summary "$bundle" >/dev/null; then
+  echo "=== CLI smoke passed"
+else
+  echo "=== CLI smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
 echo "=== total passed: $total_pass; fail=$fail"
 exit $fail

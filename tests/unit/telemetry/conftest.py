@@ -4,19 +4,29 @@ import pytest
 @pytest.fixture(autouse=True)
 def _fresh_diagnostics():
     """Isolation for the process-global diagnostic singletons: the
-    telemetry hub, the watchdog handle, and the flight recorder (whose
+    telemetry hub, the watchdog handle, the flight recorder (whose
     rings would otherwise carry StepRecords from earlier engine tests
-    into this shard's bundle assertions)."""
-    from deepspeed_tpu.telemetry import (get_flight_recorder, get_telemetry,
+    into this shard's bundle assertions), the collective ledger (and
+    its comms-logger hook), and the aggregation publisher."""
+    from deepspeed_tpu.telemetry import (attach_collective_ledger,
+                                         get_collective_ledger,
+                                         get_flight_recorder, get_telemetry,
                                          get_watchdog, set_watchdog)
+    from deepspeed_tpu.telemetry.aggregator import set_publisher
 
-    get_telemetry().reset()
-    get_flight_recorder().reset()
-    set_watchdog(None)
+    def scrub():
+        get_telemetry().reset()
+        get_flight_recorder().reset()
+        set_watchdog(None)
+        led = get_collective_ledger()
+        led.reset()
+        led.enabled = False
+        attach_collective_ledger(None)
+        set_publisher(None)
+
+    scrub()
     yield
     wd = get_watchdog()
     if wd is not None:
         wd.stop()
-    set_watchdog(None)
-    get_flight_recorder().reset()
-    get_telemetry().reset()
+    scrub()

@@ -1,0 +1,187 @@
+"""Collective ledger (ISSUE 3): monotonic seq + rolling tail hash,
+comms-logger feed, and first-divergence detection over forged ledgers."""
+
+import pytest
+
+from deepspeed_tpu.telemetry.collective_ledger import (
+    GENESIS_HASH, CollectiveLedger, attach_collective_ledger,
+    desync_from_heartbeats, find_first_divergence,
+    format_divergence_report)
+
+
+def _forge(ops, start_hash=GENESIS_HASH):
+    """Build a ledger entry list by replaying ops through a real ledger
+    (so hashes are the production chain, not hand-rolled)."""
+    led = CollectiveLedger(enabled=True, tail=len(ops) + 1)
+    for op, nbytes in ops:
+        led.record(op, nbytes)
+    return led.tail()
+
+
+OPS = [("psum", 1024), ("all_gather", 2048), ("reduce_scatter", 512),
+       ("psum", 1024), ("all_to_all", 4096), ("psum", 1024),
+       ("all_gather", 2048), ("psum", 1024)]
+
+
+def test_ledger_seq_hash_and_bounded_tail():
+    led = CollectiveLedger(enabled=True, max_entries=4, tail=3)
+    assert led.seq == 0 and led.tail_hash == GENESIS_HASH
+    h = []
+    for op, n in OPS[:6]:
+        led.record(op, n)
+        h.append(led.tail_hash)
+    assert led.seq == 6
+    assert len(set(h)) == 6              # every record moves the chain
+    assert len(led.tail(999)) == 4       # ring bounded by max_entries
+    assert len(led.tail()) == 3          # default tail window
+    assert led.tail()[-1]["seq"] == 6
+    hb = led.heartbeat_summary()
+    assert hb == {"coll_seq": 6, "coll_hash": led.tail_hash}
+
+
+def test_ledger_disabled_records_nothing():
+    led = CollectiveLedger(enabled=False)
+    led.record("psum", 1024)
+    assert led.seq == 0 and led.tail() == []
+
+
+def test_identical_sequences_agree_on_hash():
+    a = _forge(OPS)
+    b = _forge(OPS)
+    assert a[-1]["hash"] == b[-1]["hash"]
+    # one different byte count anywhere forks the chain permanently
+    c = _forge(OPS[:3] + [("psum", 1025)] + OPS[4:])
+    assert c[-1]["hash"] != a[-1]["hash"]
+
+
+def test_comms_logger_feeds_ledger_independent_of_enabled():
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    led = CollectiveLedger(enabled=True)
+    comms_logger.ledger = led
+    was_enabled, was_exec = comms_logger.enabled, comms_logger.exec_counts
+    try:
+        comms_logger.configure(enabled=False)  # stats logger OFF
+        comms_logger.record("psum", 2048)
+        assert led.seq == 1
+        assert led.tail()[-1]["op"] == "psum"
+        assert led.tail()[-1]["bytes"] == 2048
+        assert led.tail()[-1]["src"] == "census"
+        # exec probes only feed when exec_feed is opted into (unordered
+        # device callbacks are not cross-rank comparable)
+        comms_logger.configure(enabled=True, exec_counts=True)
+        comms_logger.record_exec("psum", 2048)
+        assert led.seq == 1
+        led.exec_feed = True
+        comms_logger.record_exec("psum", 2048)
+        assert led.seq == 2
+        assert led.tail()[-1]["src"] == "exec"
+    finally:
+        comms_logger.ledger = None
+        comms_logger.configure(enabled=was_enabled, exec_counts=was_exec)
+        comms_logger.reset()
+
+
+def test_attach_collective_ledger_round_trip():
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    led = CollectiveLedger(enabled=True)
+    attach_collective_ledger(led)
+    try:
+        assert comms_logger.ledger is led
+    finally:
+        attach_collective_ledger(None)
+    assert comms_logger.ledger is None
+
+
+# ---------------------------------------------------------------------------
+# forged-ledger divergence detection (satellite, ISSUE 3)
+# ---------------------------------------------------------------------------
+
+def test_divergence_identical_ledgers_is_clean():
+    rep = find_first_divergence({"a": _forge(OPS), "b": _forge(OPS),
+                                 "c": _forge(OPS)})
+    assert rep["desync"] is False
+    assert rep["first_mismatch"] is None
+    assert rep["lagging_rank"] is None
+    assert rep["seq_skew"] == 0
+    assert "no collective desync" in format_divergence_report(rep)
+
+
+def test_divergence_names_lagging_rank_and_first_mismatch():
+    """The acceptance shape: one rank issued a DIFFERENT collective at
+    seq 5 and then stalled — the report must name it and the seq."""
+    forged = {"a": _forge(OPS),
+              "b": _forge(OPS[:4] + [("all_to_all", 999)]),
+              "c": _forge(OPS)}
+    rep = find_first_divergence(forged)
+    assert rep["lagging_rank"] == "b"
+    assert rep["seq_skew"] == len(OPS) - 5
+    assert rep["desync"] is True
+    assert rep["first_mismatch"]["seq"] == 5
+    assert rep["first_mismatch"]["divergent_ranks"] == ["b"]
+    assert rep["first_mismatch"]["signatures"]["b"] == "all_to_all:999"
+    text = format_divergence_report(rep)
+    assert "lagging rank: b" in text
+    assert "seq 5" in text and "all_to_all:999" in text
+
+
+def test_divergence_lag_without_mismatch():
+    """A rank merely BEHIND (same prefix, fewer entries) lags but does
+    not desync."""
+    rep = find_first_divergence({"a": _forge(OPS), "b": _forge(OPS[:5])})
+    assert rep["lagging_rank"] == "b"
+    assert rep["seq_skew"] == 3
+    assert rep["desync"] is False
+
+
+def test_divergence_predating_retained_window_is_reported():
+    """Signatures in the overlap window agree, but the hash chains carry
+    history — a fork BEFORE the window must not read as clean."""
+    # same retained ops, different chain seed (simulates a pre-window fork)
+    a = _forge(OPS)
+    b = _forge([("ppermute", 7)] + OPS[1:])  # first op differs
+    # keep only the agreeing suffix in both (window = seq 2..8)
+    a_tail = [e for e in a if e["seq"] >= 2]
+    b_tail = [e for e in b if e["seq"] >= 2]
+    rep = find_first_divergence({"a": a_tail, "b": b_tail})
+    assert rep["desync"] is True
+    assert rep["first_mismatch"]["seq"] is None
+    assert "predates" in rep["first_mismatch"]["note"]
+
+
+def test_desync_from_heartbeats():
+    """Live path: same coll_seq + different coll_hash = desync the tick
+    it is observed; plain skew is lag, not desync."""
+    base = {"step": 5, "step_time_ewma_ms": 100.0}
+    clean = desync_from_heartbeats({
+        "a": {**base, "coll_seq": 8, "coll_hash": "aaaa"},
+        "b": {**base, "coll_seq": 6, "coll_hash": "bbbb"}})
+    assert clean["desync"] is False and clean["seq_skew"] == 2
+    bad = desync_from_heartbeats({
+        "a": {**base, "coll_seq": 8, "coll_hash": "aaaa"},
+        "b": {**base, "coll_seq": 8, "coll_hash": "cccc"},
+        "c": {**base, "coll_seq": 8, "coll_hash": "aaaa"}})
+    assert bad["desync"] is True
+    assert bad["mismatch"]["seq"] == 8
+    assert set(bad["mismatch"]["hashes"]) == {"a", "b", "c"}
+    # payloads without ledger fields (watchdog-only heartbeats) → None
+    assert desync_from_heartbeats({"a": base, "b": base}) is None
+    assert desync_from_heartbeats({"a": {**base, "coll_seq": 1,
+                                         "coll_hash": "x"}}) is None
+
+
+def test_empty_ledger_host_does_not_mask_desync():
+    """A host with NO ledger entries (crashed pre-collective / ledger
+    off) must not collapse the comparison window: the desync between the
+    populated ranks is still found, and the empty host reads as the
+    lagging rank."""
+    forged = {"a": _forge(OPS),
+              "b": _forge(OPS[:4] + [("all_to_all", 999)] + OPS[5:]),
+              "c": []}
+    rep = find_first_divergence(forged)
+    assert rep["lagging_rank"] == "c"
+    assert rep["desync"] is True
+    assert rep["first_mismatch"]["seq"] == 5
+    # a 2-rank disagreement is symmetric — both sides are named
+    assert rep["first_mismatch"]["divergent_ranks"] == ["a", "b"]

@@ -104,3 +104,39 @@ def test_engine_nan_loss_fires_health_event(tmp_path):
     # the anomaly is in the flight recorder's ring for the next bundle
     m = load_bundle(engine.flight_recorder.dump("post-nan"))["manifest"]
     assert any(e["kind"] == "nan_loss" for e in m["health_events"])
+
+
+def test_engine_wires_collective_ledger(tmp_path):
+    """ISSUE 3: with ``telemetry.aggregation`` on, the engine attaches
+    the collective ledger to the comms logger (train-step collectives
+    land in the sequence), its summary rides the watchdog heartbeat
+    payload, and every debug bundle carries the ledger tail."""
+    engine, data = _tiny_engine(
+        tmp_path, {"watchdog": {"enabled": True, "hang_timeout_s": 600.0},
+                   "aggregation": {"enabled": True, "ledger_tail": 32}})
+    try:
+        from deepspeed_tpu.comm.comm import comms_logger
+        from deepspeed_tpu.telemetry import get_collective_ledger
+
+        led = get_collective_ledger()
+        assert engine.collective_ledger is led
+        assert comms_logger.ledger is led and led.enabled
+        engine.train_step(data)
+        # a world-of-1 step issues no collectives; an eager verb must
+        # land in the ledger even with the stats logger off
+        import deepspeed_tpu as dst
+        import jax.numpy as _jnp
+
+        dst.comm.all_reduce(_jnp.ones((4,), _jnp.float32))
+        assert led.seq > 0
+        assert led.tail()[-1]["op"] == "all_reduce"
+        payload = engine.watchdog.heartbeat_payload()
+        assert payload["coll_seq"] == led.seq
+        assert payload["coll_hash"] == led.tail_hash
+        m = load_bundle(engine.flight_recorder.dump("op"))["manifest"]
+        ctx = m["context"]["collective_ledger"]
+        assert ctx["seq"] == led.seq
+        assert ctx["tail"][-1]["hash"] == led.tail_hash
+        assert m["extra"] == {}  # on-demand dump; trip extras are wd-only
+    finally:
+        engine.watchdog.stop()

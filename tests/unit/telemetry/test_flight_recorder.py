@@ -130,3 +130,46 @@ def test_bench_exception_path_writes_bundle(tmp_path, capsys, monkeypatch):
     assert "induced bench crash" in m["extra"]["traceback"]
     # the crash bundle came from the process-global recorder
     assert get_flight_recorder().last_bundle_path == doc["debug_bundle"]
+
+
+def test_bundle_retention_prunes_to_newest_k(tmp_path):
+    """Satellite (ISSUE 3): repeated dumps keep only the newest
+    ``retain`` bundle dirs — a watchdog stuck in trip cycles cannot
+    fill the disk."""
+    fr = FlightRecorder(output_path=str(tmp_path), retain=3)
+    dumped = [fr.dump(f"trip {i}") for i in range(6)]
+    kept = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("bundle-"))
+    assert len(kept) == 3
+    # the newest three survived, oldest three are gone
+    assert kept == sorted(os.path.basename(p) for p in dumped[-3:])
+    assert fr.last_bundle_path == dumped[-1]
+    assert os.path.isdir(fr.last_bundle_path)
+
+
+def test_bundle_retention_disabled_keeps_all(tmp_path):
+    fr = FlightRecorder(output_path=str(tmp_path), retain=0)
+    for i in range(4):
+        fr.dump(f"r{i}")
+    assert len([d for d in os.listdir(tmp_path)
+                if d.startswith("bundle-")]) == 4
+
+
+def test_retention_configurable_via_config(tmp_path):
+    """The ``telemetry.flight_recorder.retain_bundles`` knob reaches the
+    configured global recorder through recorder_from_config."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.telemetry.flight_recorder import recorder_from_config
+
+    cfg = DeepSpeedConfig.model_validate({
+        "train_batch_size": 8,
+        "telemetry": {"enabled": True,
+                      "flight_recorder": {"enabled": True,
+                                          "output_path": str(tmp_path),
+                                          "retain_bundles": 2}}})
+    fr = recorder_from_config(cfg.telemetry)
+    assert fr is not None and fr.retain == 2
+    for i in range(4):
+        fr.dump(f"r{i}")
+    assert len([d for d in os.listdir(tmp_path)
+                if d.startswith("bundle-")]) == 2

@@ -1,0 +1,66 @@
+"""Prometheus text exporter (satellite, ISSUE 3): HELP/TYPE emission,
+label-value escaping, and a full round-trip parse of a registry that
+contains every character class the exposition format can break on."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.telemetry import (MetricsRegistry, escape_help,
+                                     escape_label_value, format_labels,
+                                     parse_prometheus_text)
+
+
+def test_escape_help_and_label_value():
+    assert escape_help("a\nb\\c") == "a\\nb\\\\c"
+    assert escape_label_value('say "hi"\nback\\slash') == \
+        'say \\"hi\\"\\nback\\\\slash'
+    assert format_labels({}) == ""
+    assert format_labels({"le": 2.5}) == '{le="2.5"}'
+    assert format_labels({"op": 'a"b'}) == '{op="a\\"b"}'
+
+
+def test_help_and_type_lines_with_escaped_help():
+    reg = MetricsRegistry()
+    reg.counter("engine/steps", help="optimizer steps\nsecond line "
+                                     "with back\\slash").inc(3)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert "# HELP engine_steps optimizer steps\\nsecond line " \
+           "with back\\\\slash" in lines
+    assert "# TYPE engine_steps counter" in lines
+    assert "engine_steps 3" in lines
+    # the raw newline must NOT appear as its own (malformed) line
+    assert "second line with back\\slash" not in lines
+
+
+def test_round_trip_parse():
+    """Acceptance for the satellite: a registry holding a counter, a
+    gauge (with hostile help), and a histogram renders exposition text
+    that the parser reads back VALUE-EXACT."""
+    reg = MetricsRegistry()
+    reg.counter("comm/ops", help="collective ops").inc(42)
+    reg.gauge("elastic/world", help="gang size\nwith newline").set(3)
+    h = reg.histogram("step/time_ms", help="per-step ms",
+                      buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    parsed = parse_prometheus_text(text)
+    assert parsed["comm_ops"] == 42
+    assert parsed["elastic_world"] == 3
+    assert parsed['step_time_ms_bucket{le="1.0"}'] == 1
+    assert parsed['step_time_ms_bucket{le="10.0"}'] == 2
+    assert parsed['step_time_ms_bucket{le="100.0"}'] == 3
+    assert parsed['step_time_ms_bucket{le="+Inf"}'] == 4
+    assert parsed["step_time_ms_count"] == 4
+    assert parsed["step_time_ms_sum"] == pytest.approx(555.5)
+
+
+def test_round_trip_survives_nonfinite_samples():
+    reg = MetricsRegistry()
+    reg.gauge("loss", help="may go NaN").set(float("nan"))
+    reg.gauge("grad_norm").set(float("inf"))
+    parsed = parse_prometheus_text(reg.prometheus_text())
+    assert math.isnan(parsed["loss"])
+    assert math.isinf(parsed["grad_norm"])

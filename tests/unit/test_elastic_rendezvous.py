@@ -378,3 +378,42 @@ def test_agent_records_stale_peer_counter():
         assert counter.value == 2
     finally:
         hub.reset()
+
+
+def test_client_retries_transient_errors_with_backoff(monkeypatch):
+    """Satellite (ISSUE 3): a transient connect/read failure (store
+    restart, ECONNRESET, EINTR) is retried with bounded backoff instead
+    of killing the caller — a debug-bundle collector sweep must survive
+    one reset.  The retry budget is bounded: a store that is GONE still
+    fails, with the last error chained."""
+    import socket as socket_mod
+
+    from deepspeed_tpu.elasticity import rendezvous as rdzv_mod
+
+    srv = RendezvousServer()
+    try:
+        real_connect = socket_mod.create_connection
+        fails = {"n": 0}
+
+        def flaky(addr, timeout=None):
+            if fails["n"] < 2:
+                fails["n"] += 1
+                raise ConnectionResetError("transient reset")
+            return real_connect(addr, timeout=timeout)
+
+        monkeypatch.setattr(rdzv_mod.socket, "create_connection", flaky)
+        c = RendezvousClient(srv.endpoint, retries=3, backoff_s=0.001)
+        c.set("k", {"v": 1})          # survived two resets
+        assert c.get("k") == {"v": 1}
+        assert fails["n"] == 2
+
+        def always_down(addr, timeout=None):
+            raise ConnectionResetError("store is gone")
+
+        monkeypatch.setattr(rdzv_mod.socket, "create_connection",
+                            always_down)
+        c2 = RendezvousClient(srv.endpoint, retries=2, backoff_s=0.001)
+        with pytest.raises(ConnectionError, match="after 3 attempts"):
+            c2.get("k")
+    finally:
+        srv.shutdown()
