@@ -44,7 +44,9 @@ class WorkerSpec:
                  args: tuple = (), cmd: Optional[List[str]] = None,
                  max_restarts: int = 3, monitor_interval: float = 0.1,
                  heartbeat_ttl: float = 5.0,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 restart_backoff_s: float = 1.0,
+                 restart_backoff_max_s: float = 30.0):
         if (fn is None) == (cmd is None):
             raise ValueError("WorkerSpec needs exactly one of fn= or cmd=")
         self.fn = fn
@@ -54,6 +56,14 @@ class WorkerSpec:
         self.monitor_interval = float(monitor_interval)
         self.heartbeat_ttl = float(heartbeat_ttl)
         self.checkpoint_dir = checkpoint_dir
+        #: capped exponential backoff between FAILURE restarts
+        #: (membership churn restarts stay prompt): delay =
+        #: min(backoff * 2^(failures-1), backoff_max).  A worker dying
+        #: instantly on startup (bad ckpt, OOM loop) must not respawn
+        #: hot — it would burn the restart budget in milliseconds and
+        #: hammer the rendezvous store
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.restart_backoff_max_s = float(restart_backoff_max_s)
 
 
 class _RestartSignal(Exception):
@@ -88,6 +98,8 @@ class DSElasticAgent:
         self._round = -1
         self._rank = 0
         self._peers: List[str] = []
+        #: injectable for tests (fake-clock backoff assertions)
+        self._sleep: Callable[[float], None] = time.sleep
 
     def _hb_payload(self):
         """The local watchdog's liveness summary (step index, step-time
@@ -280,6 +292,10 @@ class DSElasticAgent:
         spec = self.spec
         env = dict(os.environ)
         env["DS_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+        # the worker must present the SAME node id the agent sealed into
+        # the ring: the resilience tier-2 buddy lookup and the bundle
+        # publisher both key their store slots on it
+        env["DS_ELASTIC_NODE_ID"] = self.node_id
         if spec.checkpoint_dir:
             env["DS_ELASTIC_CHECKPOINT_DIR"] = spec.checkpoint_dir
         proc = subprocess.Popen(spec.cmd, env=env)
@@ -325,17 +341,36 @@ class DSElasticAgent:
                        budgeted: bool = True) -> None:
         spec = self.spec
         self.restart_count += 1
+        delay = spec.monitor_interval
         if budgeted:
             self.failure_count += 1
             if self.failure_count > spec.max_restarts:
                 logger.error(f"elastic agent: giving up after "
                              f"{spec.max_restarts} failures ({e!r})")
                 raise e
+            # capped exponential backoff between FAILURE restarts: a
+            # crash-looping worker must not respawn hot (membership-churn
+            # restarts keep the prompt monitor_interval delay — peers are
+            # actively waiting in the new round)
+            delay = min(
+                spec.restart_backoff_s * (2 ** (self.failure_count - 1)),
+                spec.restart_backoff_max_s)
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "elastic/worker_restarts_total",
+            help="elastic worker restarts (membership churn + failures)")
+        if budgeted:
+            get_telemetry().inc_counter(
+                "elastic/worker_failure_restarts_total",
+                help="elastic worker restarts that consumed the failure "
+                     "budget")
         level = logger.warning if announce else logger.info
         level(f"elastic agent[{self.node_id}]: restarting (attempt "
               f"{self.restart_count}, failures "
-              f"{self.failure_count}/{spec.max_restarts}): {e!r}")
-        time.sleep(spec.monitor_interval)
+              f"{self.failure_count}/{spec.max_restarts}, backoff "
+              f"{delay:.2f}s): {e!r}")
+        self._sleep(delay)
 
 
 def launch_elastic(fn: Callable[..., Any], args: tuple = (),

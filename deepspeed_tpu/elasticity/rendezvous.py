@@ -434,6 +434,21 @@ class ElasticRendezvous:
                 help="slowest host step-time EWMA over the median host's")
         return stats
 
+    def buddy(self) -> Optional[str]:
+        """This node's snapshot buddy: the NEXT node id in the current
+        round's sealed ring (deterministic on every host — same sorted
+        gang), or None when the gang has a single member.  Tier-2
+        replication uploads this node's snapshot into the rendezvous
+        store under ITS OWN node id; the buddy is the peer expected to
+        ADOPT that slot when this host dies (a gang of one has nobody
+        to adopt anything, so replication is skipped)."""
+        r = self.current_round()
+        sealed = self.c.get(self._sealed_key(r))
+        gang = list(sealed[0]) if sealed else []
+        if self.node_id not in gang or len(gang) < 2:
+            return None
+        return gang[(gang.index(self.node_id) + 1) % len(gang)]
+
     def leave(self) -> None:
         """Graceful departure: a finished node stops heartbeating but must
         not be mistaken for a death — peers skip left nodes in

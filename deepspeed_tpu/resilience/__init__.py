@@ -1,0 +1,37 @@
+"""Self-healing resilience plane (ISSUE 4).
+
+Three pillars that turn the observability stack's DETECTIONS (watchdog
+trips, NaN'd losses, dead peers) into a bounded amount of lost work:
+
+* :mod:`.snapshot` — tiered async snapshots of the full training state
+  (tier 0 host memory, tier 1 checksummed disk flush through the
+  checkpoint engine, tier 2 buddy-host replication over the rendezvous
+  store).
+* :mod:`.policy` — the automatic recovery state machine: rollback on
+  NaN/loss-scale collapse with the offending data window skipped,
+  emergency-save on watchdog trip, resume-from-newest-valid-snapshot on
+  elastic restart, capped backoff + give-up budget.
+* :mod:`.faults` — deterministic, config/env-driven fault injection
+  (kill a rank, stall a step, NaN the loss, corrupt a snapshot) so the
+  whole loop is provable in CI.
+
+Operator CLI: ``python -m deepspeed_tpu.resilience {ls,verify}``.
+"""
+
+from .faults import (Fault, FaultInjector, InjectedFault,
+                     corrupt_newest_snapshot, parse_fault, parse_faults)
+from .policy import (RecoveryPolicy, ResilienceGiveUp, ST_GAVE_UP,
+                     ST_RECOVERING, ST_RUNNING)
+from .snapshot import (Snapshot, SnapshotManager, choose_resume_snapshot,
+                       fetch_buddy_snapshot, list_snapshots,
+                       replicate_snapshot, verify_snapshot)
+
+__all__ = [
+    "Snapshot", "SnapshotManager", "choose_resume_snapshot",
+    "list_snapshots", "verify_snapshot", "replicate_snapshot",
+    "fetch_buddy_snapshot",
+    "RecoveryPolicy", "ResilienceGiveUp",
+    "ST_RUNNING", "ST_RECOVERING", "ST_GAVE_UP",
+    "Fault", "FaultInjector", "InjectedFault", "parse_fault",
+    "parse_faults", "corrupt_newest_snapshot",
+]

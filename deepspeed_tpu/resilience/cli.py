@@ -1,0 +1,117 @@
+"""Operator CLI — ``python -m deepspeed_tpu.resilience <cmd>``.
+
+The 3am read side of the resilience plane:
+
+* ``ls <dir>``      — inventory the snapshot dir: tag, step, age,
+  bytes, and whether each snapshot passes the checksum gate.
+* ``verify <path>`` — full integrity check of one snapshot dir, or of
+  every snapshot under a root dir.  Exit codes are scriptable: 0 when
+  the NEWEST snapshot is valid, 3 when the newest is corrupt but an
+  older valid one exists (a resume would silently lose extra steps —
+  worth an alert), 4 when nothing restorable remains.
+
+Both commands are plain-directory reads — no store, no engine, no
+device needed beyond importing the package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .snapshot import list_snapshots, verify_snapshot
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
+
+
+def _is_snapshot(path: str) -> bool:
+    from .snapshot import SNAPSHOT_MANIFEST
+
+    return os.path.exists(os.path.join(path, SNAPSHOT_MANIFEST))
+
+
+def cmd_ls(args: argparse.Namespace) -> int:
+    snaps = list_snapshots(args.dir)
+    if not snaps:
+        print(f"no committed snapshots under {args.dir}")
+        return 0
+    now = time.time()
+    print(f"{'TAG':<24} {'STEP':>8} {'AGE':>10} {'SIZE':>10}  STATUS")
+    for entry in snaps:
+        ok, detail = verify_snapshot(entry["path"])
+        age = now - float(entry.get("ts") or now)
+        size = _dir_bytes(entry["path"])
+        status = "valid" if ok else f"CORRUPT — {detail}"
+        print(f"{entry['tag']:<24} {entry['step']:>8} "
+              f"{age:>9.0f}s {size / 2**20:>9.1f}M  {status}")
+    return 0
+
+
+def cmd_verify(args: argparse.Namespace) -> int:
+    path = args.path
+    if _is_snapshot(path):
+        ok, detail = verify_snapshot(path)
+        print(f"{path}: {'valid' if ok else 'CORRUPT'} — {detail}")
+        return 0 if ok else 4
+    if not os.path.isdir(path):
+        return _fail(f"{path}: not a snapshot dir or snapshot root")
+    snaps = list_snapshots(path)
+    if not snaps:
+        print(f"{path}: no committed snapshots")
+        return 4
+    results = [(entry, *verify_snapshot(entry["path"])) for entry in snaps]
+    for entry, ok, detail in results:
+        print(f"{entry['tag']}: {'valid' if ok else 'CORRUPT'} — {detail}")
+    newest_ok = results[0][1]
+    any_ok = any(ok for _e, ok, _d in results)
+    if newest_ok:
+        return 0
+    if any_ok:
+        print("WARNING: newest snapshot is corrupt; a resume would fall "
+              "back to an older one (extra lost work)")
+        return 3
+    print("FATAL: no restorable snapshot remains")
+    return 4
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.resilience",
+        description="resilience plane operator CLI: inventory and "
+                    "verify tiered training-state snapshots")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ls = sub.add_parser("ls", help="list committed snapshots with "
+                                   "validity status")
+    ls.add_argument("dir", nargs="?", default="resilience_snapshots")
+    ls.set_defaults(fn=cmd_ls)
+
+    v = sub.add_parser("verify",
+                       help="checksum-verify one snapshot or a whole "
+                            "snapshot dir (exit 0 newest-valid / 3 "
+                            "fallback-only / 4 none)")
+    v.add_argument("path")
+    v.set_defaults(fn=cmd_verify)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -1,0 +1,230 @@
+"""Deterministic fault injection — make the recovery loop PROVABLE.
+
+ISSUE 4 tentpole, pillar 3.  A resilience plane nobody can trigger is a
+resilience plane nobody can trust: this harness injects the exact
+failures the policy claims to survive, deterministically (fault specs
+name a step, not a probability), driven by config
+(``resilience.faults``) or the ``DS_FAULTS`` env var so CI and chaos
+drills run the SAME loop production would.
+
+Spec grammar (comma-free ``kind@step[:key=value,...]``)::
+
+    kill_rank@120:rank=1         # worker death at step 120 on rank 1
+    kill_rank@120:rank=1,mode=exit   # hard os._exit instead of raising
+    nan_loss@64                  # poison step 64's batch with NaN
+    stall@32:seconds=90          # stall the step path (watchdog food)
+    corrupt_snapshot@40          # flip bytes in the newest tier-1 snap
+
+Faults fire ONCE (per process) at the step they name; ``rank=`` guards
+restrict kill faults to one worker.  Every firing lands in telemetry
+(``resilience/faults_injected_total``) and the flight recorder, so a
+chaos run's debug bundle says what was injected, where.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+
+KINDS = ("kill_rank", "kill", "nan_loss", "stall", "corrupt_snapshot")
+
+
+class InjectedFault(RuntimeError):
+    """A kill fault fired in ``raise`` mode — the supervisor (elastic
+    agent) sees a worker failure exactly as it would a real crash."""
+
+
+class Fault:
+    __slots__ = ("kind", "step", "params", "fired")
+
+    def __init__(self, kind: str, step: int, params: Dict[str, str]):
+        self.kind = kind
+        self.step = int(step)
+        self.params = params
+        self.fired = False
+
+    def __repr__(self):
+        kv = ",".join(f"{k}={v}" for k, v in self.params.items())
+        return f"{self.kind}@{self.step}" + (f":{kv}" if kv else "")
+
+
+def parse_fault(spec: str) -> Fault:
+    """``kind@step[:k=v,...]`` → :class:`Fault`; raises ``ValueError``
+    with the offending spec on any malformation (a chaos drill with a
+    typo'd spec must fail loudly, not silently not inject)."""
+    text = spec.strip()
+    head, _, tail = text.partition(":")
+    kind, at, step_s = head.partition("@")
+    if not at or not kind or not step_s:
+        raise ValueError(f"fault spec {spec!r}: expected kind@step[:k=v,...]")
+    if kind not in KINDS:
+        raise ValueError(f"fault spec {spec!r}: unknown kind {kind!r} "
+                         f"(known: {', '.join(KINDS)})")
+    try:
+        step = int(step_s)
+    except ValueError:
+        raise ValueError(f"fault spec {spec!r}: step {step_s!r} is not an "
+                         f"integer")
+    params: Dict[str, str] = {}
+    if tail:
+        for part in tail.split(","):
+            k, eq, v = part.partition("=")
+            if not eq or not k:
+                raise ValueError(f"fault spec {spec!r}: bad param "
+                                 f"{part!r} (expected key=value)")
+            params[k.strip()] = v.strip()
+    return Fault("kill_rank" if kind == "kill" else kind, step, params)
+
+
+def parse_faults(specs: List[str], env: Optional[str] = None) -> List[Fault]:
+    """Config specs + the ``DS_FAULTS`` env var (``;``-separated)."""
+    merged = list(specs or [])
+    env_val = os.environ.get(env or "DS_FAULTS", "")
+    merged += [s for s in env_val.split(";") if s.strip()]
+    return [parse_fault(s) for s in merged]
+
+
+class FaultInjector:
+    """Engine-driven: ``apply(step, batch)`` runs at the top of every
+    ``train_step`` and fires any fault scheduled for that step."""
+
+    def __init__(self, faults: List[Fault], rank: Optional[int] = None,
+                 recorder: Any = None,
+                 sleep: Any = time.sleep):
+        self.faults = list(faults)
+        #: explicit rank wins; else resolved lazily from the launcher
+        #: env at fire time (the elastic agent exports PROCESS_ID after
+        #: rendezvous, which may be AFTER engine construction)
+        self._rank = rank
+        self.recorder = recorder
+        self._sleep = sleep
+        self.injected = 0
+
+    @classmethod
+    def from_config(cls, rcfg: Any, recorder: Any = None
+                    ) -> Optional["FaultInjector"]:
+        faults = parse_faults(list(rcfg.faults or []))
+        if not faults:
+            return None
+        return cls(faults, recorder=recorder)
+
+    def rank(self) -> int:
+        if self._rank is not None:
+            return int(self._rank)
+        env = os.environ.get("PROCESS_ID")
+        if env:
+            try:
+                return int(env)
+            except ValueError:
+                pass  # malformed launcher env — fall through
+        try:
+            import jax
+
+            return int(jax.process_index())
+        except Exception:
+            return 0
+
+    # -- firing ------------------------------------------------------------
+
+    def _record(self, fault: Fault) -> None:
+        fault.fired = True
+        self.injected += 1
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "resilience/faults_injected_total",
+            help="deterministic faults fired by the injection harness")
+        if self.recorder is not None:
+            try:
+                self.recorder.annotate("fault_injected",
+                                       {"fault": repr(fault)})
+            except Exception:
+                pass
+        logger.warning(f"fault injection: firing {fault!r}")
+
+    def apply(self, step: int, batch: Any, engine: Any = None) -> Any:
+        """Fire every not-yet-fired fault scheduled for ``step``;
+        returns the (possibly poisoned) batch."""
+        for fault in self.faults:
+            if fault.fired or fault.step != step:
+                continue
+            if fault.kind == "kill_rank":
+                want = fault.params.get("rank")
+                if want is not None and int(want) != self.rank():
+                    fault.fired = True  # this step is this fault's only shot
+                    continue
+                self._record(fault)
+                if fault.params.get("mode", "raise") == "exit":
+                    # a real SIGKILL-ish death: no cleanup, exit code 113
+                    # for the supervisor to count as a failure
+                    os._exit(113)
+                raise InjectedFault(
+                    f"injected worker death at step {step} "
+                    f"(rank {self.rank()})")
+            if fault.kind == "stall":
+                self._record(fault)
+                self._sleep(float(fault.params.get("seconds", 60.0)))
+            elif fault.kind == "nan_loss":
+                self._record(fault)
+                batch = _poison_batch(batch)
+            elif fault.kind == "corrupt_snapshot":
+                self._record(fault)
+                snap_dir = None
+                if engine is not None and getattr(engine, "snapshots",
+                                                  None) is not None:
+                    engine.snapshots.wait()  # corrupt a COMMITTED flush
+                    snap_dir = engine.snapshots.snapshot_dir
+                corrupt_newest_snapshot(
+                    fault.params.get("dir") or snap_dir or "")
+        return batch
+
+
+def _poison_batch(batch: Any) -> Any:
+    """NaN the first floating leaf — the loss of any reasonable model
+    goes NaN with it, which is exactly the anomaly the health monitor
+    and the recovery policy key on."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(batch)
+    for i, leaf in enumerate(leaves):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+            leaves[i] = leaf * jnp.float32(float("nan")).astype(dt)
+            return jax.tree.unflatten(treedef, leaves)
+    logger.warning("fault injection: nan_loss found no floating batch "
+                   "leaf to poison — fault had no effect")
+    return batch
+
+
+def corrupt_newest_snapshot(snapshot_dir: str) -> Optional[str]:
+    """Flip bytes in the newest committed snapshot's LARGEST payload
+    file (never the manifests — the point is that the CHECKSUM catches
+    it, not that the marker disappears).  Returns the corrupted file."""
+    from .snapshot import SNAPSHOT_MANIFEST, list_snapshots
+
+    snaps = list_snapshots(snapshot_dir)
+    if not snaps:
+        logger.warning(f"fault injection: no committed snapshot under "
+                       f"{snapshot_dir!r} to corrupt")
+        return None
+    root = snaps[0]["path"]
+    candidates = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f in (SNAPSHOT_MANIFEST, "ds_manifest.json"):
+                continue
+            p = os.path.join(dirpath, f)
+            candidates.append((os.path.getsize(p), p))
+    if not candidates:
+        return None
+    _, victim = max(candidates)
+    with open(victim, "r+b") as fh:
+        data = fh.read(64)
+        fh.seek(0)
+        fh.write(bytes(b ^ 0xFF for b in data))
+    logger.warning(f"fault injection: corrupted {victim}")
+    return victim

@@ -1,0 +1,558 @@
+"""Tiered async snapshots of the FULL training state.
+
+The recovery half of a production training stack (ISSUE 4 tentpole,
+pillar 1).  Checkpoints answer "resume tomorrow"; snapshots answer
+"lose at most ``snapshot_interval`` steps to a NaN, a kill -9, or a
+host loss".  Three tiers, each a strictly cheaper/closer copy:
+
+* **tier 0 — host memory**: a double-buffered ``jax.device_get`` of the
+  whole :class:`~..runtime.engine.TrainState` (params, optimizer state,
+  loss-scale, step, comm residuals) plus engine bookkeeping
+  (global/micro steps, LR-scheduler state, registered data-sampler
+  cursors, host RNG states).  Rollback from tier 0 is a ``device_put``
+  — milliseconds, no storage round-trip.
+* **tier 1 — local disk**: the tier-0 copy flushed through
+  ``runtime/checkpoint_engine.py`` (async by default: the WHOLE job —
+  serialize, hash, commit, replicate, prune — runs on one background
+  worker thread over the already-taken immutable host copy, so the step
+  path never blocks on storage).  Every flush commits a
+  ``snapshot.json`` marker ONLY after the checksummed sidecar manifest
+  is durable — restores are checksum-gated, torn flushes are invisible.
+* **tier 2 — off-host replica**: the flushed snapshot dir shipped into
+  the rendezvous store (chunked transport shared with debug bundles,
+  ``telemetry/aggregator.py``) under this node's slot, so a dead host's
+  state survives the host and its replacement — or the NEXT node in the
+  sealed ring (the "buddy", the expected adopter) — can pull it.  The
+  replica lives on the store host; surviving store loss via true
+  peer-to-peer placement is a ROADMAP follow-up.
+
+The manager is engine-owned (``engine.snapshots``) and driven from
+``train_step`` (:meth:`maybe_snapshot`); the recovery policy
+(``policy.py``) consumes :meth:`latest`, :meth:`restore`, and the
+module-level :func:`choose_resume_snapshot` tier-fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.checkpoint_engine import (CheckpointCorruptionError,
+                                         TorchCheckpointEngine,
+                                         verify_sidecar_manifest)
+from ..utils.logging import log_dist, logger
+
+#: per-snapshot commit marker (meta + "the flush completed durably")
+SNAPSHOT_MANIFEST = "snapshot.json"
+#: tier-2 store key prefixes (mirrors the debug/-bundle transport)
+RESIL_META_KEY = "resil/pub/{node}"
+RESIL_CHUNK_PREFIX = "resil/chunk/{node}"
+
+
+class Snapshot:
+    """One tier-0 capture: the host-side state tree + JSON-able meta."""
+
+    __slots__ = ("step", "global_steps", "state", "meta", "ts")
+
+    def __init__(self, step: int, global_steps: int, state: Any,
+                 meta: Dict[str, Any]):
+        self.step = int(step)              # applied optimizer step
+        self.global_steps = int(global_steps)
+        self.state = state                 # host numpy TrainState tree
+        self.meta = meta
+        self.ts = time.time()
+
+
+def _tag(step: int, emergency: bool = False) -> str:
+    return f"snap-{step:08d}" + ("-emergency" if emergency else "")
+
+
+class SnapshotManager:
+    """Engine-driven tiered snapshots.  Hot-path cost: one deque-free
+    double buffer write every ``snapshot_interval`` steps; everything
+    else (serialization, hashing, replication) is off the step path."""
+
+    def __init__(self, engine: Any, cfg: Any,
+                 recorder: Any = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.engine = engine
+        self.cfg = cfg
+        self.recorder = recorder
+        self._clock = clock
+        self.snapshot_interval = max(1, int(cfg.snapshot_interval))
+        self.snapshot_dir = cfg.snapshot_dir
+        self.keep = max(1, int(cfg.keep_snapshots))
+        # tier 0: double buffer — the newest capture never overwrites
+        # the previous one in place, so a crash MID-capture still leaves
+        # one intact copy
+        self._buffers: List[Optional[Snapshot]] = [None, None]
+        self._active = 0
+        #: name -> (capture_fn() -> jsonable, restore_fn(payload)) for
+        #: state the engine doesn't own (data-sampler cursors, user
+        #: counters); registered by entry.initialize / user code
+        self._meta_hooks: Dict[str, Tuple[Callable[[], Any],
+                                          Optional[Callable[[Any], None]]]] \
+            = {}
+        #: async = the WHOLE tier-1 job (serialize, hash, commit,
+        #: replicate, prune) runs on one background worker thread; the
+        #: step path only pays the already-taken host copy.  Each flush
+        #: uses its own throwaway sync engine, so the emergency path
+        #: never races a shared engine's pending state.
+        self._async = str(cfg.flush_engine) == "async"
+        self._flush_pool = None
+        self._pending_flush = None
+        #: tier-2 plumbing, attached when an elastic rendezvous exists
+        self._rdzv = None
+        self.snapshots_taken = 0
+        self.flushes = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register_meta(self, name: str, capture: Callable[[], Any],
+                      restore: Optional[Callable[[Any], None]] = None
+                      ) -> None:
+        """Attach a named (capture, restore) hook: ``capture()`` is
+        folded into every snapshot's meta under ``extras[name]``;
+        ``restore(payload)`` (optional) runs on rollback/resume."""
+        self._meta_hooks[name] = (capture, restore)
+
+    def attach_rendezvous(self, rdzv: Any) -> None:
+        """Enable tier 2 against this elastic rendezvous (its client is
+        the transport, its sealed ring names the buddy)."""
+        self._rdzv = rdzv
+
+    # -- capture (tier 0) --------------------------------------------------
+
+    def _collect_meta(self) -> Dict[str, Any]:
+        eng = self.engine
+        extras: Dict[str, Any] = {}
+        for name, (capture, _restore) in self._meta_hooks.items():
+            try:
+                extras[name] = capture()
+            except Exception as e:  # a dead hook must not lose the snapshot
+                extras[name] = {"error": repr(e)}
+        return {
+            "global_steps": int(eng.global_steps),
+            "micro_steps": int(eng.micro_steps),
+            "lr_scheduler": eng.lr_scheduler.state_dict(),
+            "skipped_steps": int(eng.state.skipped_steps),
+            "rng": {
+                # host RNG driving data order/augmentation; pickled+hex so
+                # the tuple structure survives the JSON manifest
+                "python_random": pickle.dumps(random.getstate()).hex(),
+                "numpy_global": pickle.dumps(np.random.get_state()).hex(),
+            },
+            "extras": extras,
+        }
+
+    def take(self, emergency: bool = False) -> Snapshot:
+        """Capture tier 0 NOW (device→host copy of the full state) and,
+        when the disk tier is on, hand it to the async flusher."""
+        import jax
+
+        eng = self.engine
+        t0 = self._clock()
+        host_state = jax.tree.map(lambda x: np.asarray(jax.device_get(x)),
+                                  eng.state)
+        snap = Snapshot(step=int(host_state.step),
+                        global_steps=eng.global_steps,
+                        state=host_state, meta=self._collect_meta())
+        # double buffer: write the NON-active slot, then flip
+        self._active ^= 1
+        self._buffers[self._active] = snap
+        self.snapshots_taken += 1
+        dt_ms = (self._clock() - t0) * 1e3
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter("resilience/snapshots_total",
+                        help="tier-0 training-state snapshots taken")
+        tel.set_gauge("resilience/snapshot_last_ms", dt_ms,
+                      help="device->host capture latency of the last "
+                           "snapshot")
+        tel.set_gauge("resilience/snapshot_last_step", snap.global_steps,
+                      help="global step of the newest snapshot")
+        if self.recorder is not None:
+            self.recorder.annotate("snapshot", {
+                "step": snap.global_steps, "capture_ms": round(dt_ms, 3),
+                "emergency": emergency})
+        if self.cfg.disk_tier:
+            self.flush(snap, emergency=emergency)
+        return snap
+
+    def maybe_snapshot(self) -> Optional[Snapshot]:
+        """The engine's per-step hook: snapshot on the configured
+        cadence (cheap no-op between intervals)."""
+        if self.engine.global_steps % self.snapshot_interval:
+            return None
+        return self.take()
+
+    def latest(self) -> Optional[Snapshot]:
+        """Newest tier-0 snapshot (the double buffer's active slot)."""
+        return self._buffers[self._active] or self._buffers[self._active ^ 1]
+
+    def buffered(self) -> List[Snapshot]:
+        """Both tier-0 buffers, newest first."""
+        out = [self._buffers[self._active], self._buffers[self._active ^ 1]]
+        return [s for s in out if s is not None]
+
+    def discard_newest(self) -> Optional[Snapshot]:
+        """Drop the newest tier-0 buffer (the policy calls this when a
+        restored snapshot immediately fails again — the capture itself
+        is suspect, e.g. params that were already NaN when a later
+        step's finite loss let the snapshot through).  Returns the
+        discarded snapshot."""
+        dropped = self._buffers[self._active]
+        self._buffers[self._active] = None
+        if self._buffers[self._active ^ 1] is not None:
+            self._active ^= 1
+        return dropped
+
+    # -- flush (tier 1) ----------------------------------------------------
+
+    def flush(self, snap: Optional[Snapshot] = None,
+              emergency: bool = False) -> Optional[str]:
+        """Flush ``snap`` (default: newest tier-0) under
+        ``snapshot_dir/snap-<step>/``.  Async mode hands the ENTIRE job
+        (serialize → checksummed sidecar → commit marker → tier-2
+        replicate → prune) to the background worker; the step path only
+        joins a still-running PREVIOUS flush (queue depth 1, like the
+        reference decoupled engine — bounds host memory to two copies).
+        A dir without the ``snapshot.json`` marker is an aborted flush
+        and never restores."""
+        snap = snap or self.latest()
+        if snap is None:
+            return None
+        path = os.path.join(self.snapshot_dir,
+                            _tag(snap.global_steps, emergency=emergency))
+        if not self._async or emergency:
+            return self._flush_sync(snap, emergency)
+        t0 = self._clock()
+        self.wait()  # queue depth 1
+        if self._flush_pool is None:
+            import concurrent.futures
+
+            self._flush_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ds-snapshot-flush")
+        self._pending_flush = self._flush_pool.submit(
+            self._flush_sync, snap, emergency)
+        from ..telemetry import get_telemetry
+
+        get_telemetry().set_gauge(
+            "resilience/snapshot_flush_dispatch_ms",
+            (self._clock() - t0) * 1e3,
+            help="step-path cost of dispatching the tier-1 flush "
+                 "(async: excludes the background write)")
+        return path
+
+    def _flush_sync(self, snap: Snapshot, emergency: bool) -> str:
+        """The full tier-1 job, on whatever thread calls it.  Uses a
+        throwaway sync engine per call: concurrent emergency + regular
+        flushes target different dirs and share no writer state."""
+        tag = _tag(snap.global_steps, emergency=emergency)
+        path = os.path.join(self.snapshot_dir, tag)
+        os.makedirs(path, exist_ok=True)
+        t0 = self._clock()
+        state_path = os.path.join(path, "state")
+        TorchCheckpointEngine().save(snap.state, state_path)
+        # sha256 sidecar on EVERY host: the engine only stamps it on
+        # process 0 (user checkpoints share one tree), but snapshots are
+        # per-host local trees — each host gates its own restores.
+        # (process 0's save already stamped it; don't hash twice)
+        from ..runtime.checkpoint_engine import (_is_write_coordinator,
+                                                 write_sidecar_manifest)
+
+        if not _is_write_coordinator():
+            write_sidecar_manifest(state_path)
+        manifest = {"tag": tag, "step": snap.step,
+                    "global_steps": snap.global_steps,
+                    "emergency": bool(emergency),
+                    "ts": snap.ts, "meta": snap.meta}
+        tmp = os.path.join(path, SNAPSHOT_MANIFEST + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=1, default=str)
+        os.replace(tmp, os.path.join(path, SNAPSHOT_MANIFEST))  # commit
+        self.flushes += 1
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter("resilience/snapshot_flushes_total",
+                        help="tier-1 snapshot flushes committed durably")
+        tel.set_gauge("resilience/snapshot_flush_ms",
+                      (self._clock() - t0) * 1e3,
+                      help="wall time of the last tier-1 flush "
+                           "(background thread in async mode)")
+        self._replicate(path)
+        self._prune()
+        return path
+
+    def wait(self) -> None:
+        """Join any in-flight async flush (tests / teardown / before a
+        deliberate corruption or a restore decision)."""
+        pending, self._pending_flush = self._pending_flush, None
+        if pending is not None:
+            try:
+                pending.result()
+            except Exception as e:
+                # a failed background flush must surface (loudly) but
+                # not kill the training step that joined it — the next
+                # interval retries with a fresh snapshot
+                logger.error(f"resilience: background snapshot flush "
+                             f"failed: {e!r}")
+
+    def emergency_flush(self) -> Optional[str]:
+        """Watchdog-trip path: the device may be hung, but the newest
+        tier-0 HOST copy is already taken — make it durable NOW, on the
+        calling (watchdog) thread with its own sync writer (the
+        background flusher may be the thing that is stuck)."""
+        snap = self.latest()
+        if snap is None:
+            return None
+        path = self._flush_sync(snap, emergency=True)
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "resilience/emergency_saves_total",
+            help="emergency snapshot flushes on watchdog trip")
+        if self.recorder is not None:
+            self.recorder.annotate("resilience_emergency_save",
+                                   {"path": path})
+        return path
+
+    def _prune(self) -> None:
+        """Keep the newest ``keep`` committed snapshot dirs (plus any
+        still-uncommitted flush target) — best-effort."""
+        try:
+            snaps = list_snapshots(self.snapshot_dir)
+            for entry in snaps[self.keep:]:
+                shutil.rmtree(entry["path"], ignore_errors=True)
+        except OSError:
+            pass
+
+    # -- replicate (tier 2) ------------------------------------------------
+
+    def _replicate(self, path: str) -> None:
+        if not (self.cfg.buddy_tier and self._rdzv is not None):
+            return
+        try:
+            buddy = self._rdzv.buddy()
+            if buddy is None:
+                return  # no surviving peer could ever adopt the replica
+            meta = replicate_snapshot(self._rdzv.c, self._rdzv.node_id,
+                                      path,
+                                      chunk_bytes=self.cfg.buddy_chunk_bytes,
+                                      max_bytes=self.cfg.buddy_max_bytes)
+            if meta.get("dropped"):
+                # a size-capped tar that dropped state files is a TORN
+                # replica — it can never pass the checksum gate, so it
+                # must not count as a successful replication
+                logger.warning(
+                    f"resilience: tier-2 replica of {path} exceeds "
+                    f"buddy_max_bytes ({self.cfg.buddy_max_bytes}); "
+                    f"dropped {meta['dropped']} — replica NOT restorable, "
+                    f"raise the cap or disable buddy_tier")
+                return
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "resilience/buddy_replications_total",
+                help="tier-2 snapshot replications through the store")
+        except Exception as e:
+            # replication is the LAST tier; its failure must never fail
+            # the flush that tier 1 already committed
+            logger.warning(f"resilience: buddy replication failed: {e!r}")
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, snap: Snapshot) -> None:
+        """Roll the ENGINE back to ``snap``: device_put the host tree
+        onto the engine's current shardings, rewind the bookkeeping, and
+        run every registered restore hook."""
+        import jax
+
+        eng = self.engine
+        shardings = eng._state_shardings(eng.state)
+        eng.state = jax.device_put(snap.state, shardings)
+        self._restore_meta(snap.meta)
+        log_dist(f"resilience: restored training state to step "
+                 f"{snap.global_steps}")
+
+    def _restore_meta(self, meta: Dict[str, Any]) -> None:
+        eng = self.engine
+        eng.global_steps = int(meta["global_steps"])
+        eng.micro_steps = int(meta["micro_steps"])
+        eng.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        eng.last_metrics = {}
+        rng = meta.get("rng") or {}
+        try:
+            if rng.get("python_random"):
+                random.setstate(pickle.loads(
+                    bytes.fromhex(rng["python_random"])))
+            if rng.get("numpy_global"):
+                np.random.set_state(pickle.loads(
+                    bytes.fromhex(rng["numpy_global"])))
+        except Exception as e:
+            logger.warning(f"resilience: host RNG restore failed: {e!r}")
+        extras = meta.get("extras") or {}
+        for name, (_capture, restore_fn) in self._meta_hooks.items():
+            if restore_fn is not None and name in extras:
+                try:
+                    restore_fn(extras[name])
+                except Exception as e:
+                    logger.warning(f"resilience: meta hook {name!r} "
+                                   f"restore failed: {e!r}")
+
+    def load_from_disk(self, path: str) -> Snapshot:
+        """Checksum-gated tier-1 restore: verify the commit marker and
+        the sidecar, load the state tree INTO the engine's sharded
+        layout, apply it, and return the reconstructed snapshot."""
+        import jax
+
+        manifest = read_snapshot_manifest(path)  # raises when torn
+        state_path = os.path.join(path, "state")
+        verify_sidecar_manifest(state_path, strict=True)
+        eng = self.engine
+
+        def abstract(x):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                        sharding=getattr(x, "sharding",
+                                                         None))
+
+        target = jax.tree.map(abstract, eng.state)
+        # the sync loader verifies + restores resharded onto this
+        # engine's mesh (orbax reshard-on-load)
+        eng.state = TorchCheckpointEngine().load(state_path, target)
+        self._restore_meta(manifest["meta"])
+        snap = Snapshot(step=int(manifest["step"]),
+                        global_steps=int(manifest["global_steps"]),
+                        state=jax.tree.map(
+                            lambda x: np.asarray(jax.device_get(x)),
+                            eng.state),
+                        meta=manifest["meta"])
+        # seed tier 0 so the next rollback needn't touch disk
+        self._active ^= 1
+        self._buffers[self._active] = snap
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# on-disk inventory + validation (policy + operator CLI)
+# ---------------------------------------------------------------------------
+
+def read_snapshot_manifest(path: str) -> Dict[str, Any]:
+    mp = os.path.join(path, SNAPSHOT_MANIFEST)
+    if not os.path.exists(mp):
+        raise CheckpointCorruptionError(
+            f"snapshot {path!r} has no {SNAPSHOT_MANIFEST} commit marker "
+            f"— the flush never completed")
+    try:
+        with open(mp) as fh:
+            return json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            f"snapshot {path!r}: unreadable {SNAPSHOT_MANIFEST} "
+            f"({e!r})") from e
+
+
+def verify_snapshot(path: str) -> Tuple[bool, str]:
+    """Full integrity check of one snapshot dir.  Returns
+    ``(valid, detail)`` — detail is the human-readable failure."""
+    try:
+        manifest = read_snapshot_manifest(path)
+        verify_sidecar_manifest(os.path.join(path, "state"), strict=True)
+        return True, f"ok (step {manifest.get('global_steps')})"
+    except CheckpointCorruptionError as e:
+        return False, str(e)
+
+
+def list_snapshots(snapshot_dir: str) -> List[Dict[str, Any]]:
+    """Committed snapshots under ``snapshot_dir``, NEWEST first (by
+    step, emergency flushes ranked beneath a regular flush of the same
+    step).  Uncommitted dirs (no marker) are skipped."""
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(snapshot_dir):
+        return out
+    for d in os.listdir(snapshot_dir):
+        path = os.path.join(snapshot_dir, d)
+        if not (d.startswith("snap-") and os.path.isdir(path)):
+            continue
+        try:
+            m = read_snapshot_manifest(path)
+        except CheckpointCorruptionError:
+            continue
+        out.append({"path": path, "tag": m.get("tag", d),
+                    "step": int(m.get("global_steps", -1)),
+                    "emergency": bool(m.get("emergency")),
+                    "ts": m.get("ts")})
+    out.sort(key=lambda e: (e["step"], not e["emergency"], e["tag"]),
+             reverse=True)
+    return out
+
+
+def choose_resume_snapshot(snapshot_dir: str,
+                           client: Any = None,
+                           node_id: Optional[str] = None,
+                           fetch_dir: Optional[str] = None
+                           ) -> Optional[str]:
+    """The policy's tier-fallback: newest LOCAL snapshot that passes the
+    checksum gate; when none survives and a store client is given, pull
+    the tier-2 buddy replica of ``node_id`` into ``fetch_dir`` (default:
+    the snapshot dir) and validate that.  Returns a verified snapshot
+    path or None."""
+    for entry in list_snapshots(snapshot_dir):
+        ok, detail = verify_snapshot(entry["path"])
+        if ok:
+            return entry["path"]
+        logger.warning(f"resilience: skipping invalid snapshot "
+                       f"{entry['path']}: {detail}")
+    if client is not None and node_id:
+        try:
+            pulled = fetch_buddy_snapshot(client, node_id,
+                                          fetch_dir or snapshot_dir)
+        except Exception as e:
+            logger.warning(f"resilience: buddy snapshot fetch failed: "
+                           f"{e!r}")
+            pulled = None
+        if pulled:
+            ok, detail = verify_snapshot(pulled)
+            if ok:
+                return pulled
+            logger.warning(f"resilience: buddy replica invalid: {detail}")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# tier-2 transport (chunked store, shared with debug bundles)
+# ---------------------------------------------------------------------------
+
+def replicate_snapshot(client: Any, node_id: str, snap_dir: str,
+                       chunk_bytes: int = 256 * 1024,
+                       max_bytes: int = 256 * 1024 * 1024) -> Dict[str, Any]:
+    """Push one committed snapshot dir to this node's store slot (its
+    buddy — any surviving host — can pull it)."""
+    from ..telemetry.aggregator import push_dir_chunked
+
+    return push_dir_chunked(
+        client, RESIL_META_KEY.format(node=node_id),
+        RESIL_CHUNK_PREFIX.format(node=node_id), snap_dir,
+        chunk_bytes=chunk_bytes, max_bytes=max_bytes,
+        priority_file=SNAPSHOT_MANIFEST, recursive=True)
+
+
+def fetch_buddy_snapshot(client: Any, node_id: str,
+                         out_dir: str) -> Optional[str]:
+    """Pull ``node_id``'s replicated snapshot out of the store into
+    ``out_dir``; returns the extracted snapshot path, or None when that
+    node never replicated."""
+    from ..telemetry.aggregator import fetch_dir_chunked
+
+    return fetch_dir_chunked(
+        client, RESIL_META_KEY.format(node=node_id),
+        RESIL_CHUNK_PREFIX.format(node=node_id), out_dir)

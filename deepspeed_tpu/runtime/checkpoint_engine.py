@@ -15,14 +15,129 @@ engine classes here supply the reference's lifecycle surface
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, logger
 from ..utils.jax_compat import ckpt_metadata_tree
+
+#: sidecar integrity manifest written next to every saved checkpoint tree
+SIDECAR_MANIFEST = "ds_manifest.json"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity validation (truncated / corrupt /
+    missing files).  The message names the first offending file — the
+    resilience tier-fallback catches this and tries the next snapshot
+    instead of restoring garbage."""
+
+
+def _iter_payload_files(path: str):
+    """Every regular file under ``path`` except the sidecar itself,
+    as (relative_name, absolute_path), deterministic order."""
+    for root, _dirs, files in os.walk(path):
+        for f in sorted(files):
+            rel = os.path.relpath(os.path.join(root, f), path)
+            if rel in (SIDECAR_MANIFEST, SIDECAR_MANIFEST + ".tmp"):
+                continue
+            yield rel, os.path.join(root, f)
+
+
+def _sha256_file(p: str) -> str:
+    h = hashlib.sha256()
+    with open(p, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _is_write_coordinator() -> bool:
+    """Multi-controller: exactly ONE process may stamp the sidecar —
+    N processes writing (and hashing files mid-finalize on other hosts)
+    over one shared tree would race each other into a manifest that
+    matches nobody.  Orbax's save barrier has completed by the time the
+    engines call this, so process 0 sees the finished tree."""
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True  # single-controller / distributed not initialized
+
+
+def write_sidecar_manifest(path: str) -> Dict[str, Any]:
+    """Stamp ``<path>/ds_manifest.json`` with per-file size + sha256 of
+    everything the serializer wrote.  Called AFTER the write is complete
+    (sync: right after save; async: after wait_until_finished) and
+    BEFORE any durability marker, so a manifest's existence implies the
+    payload it describes was fully on disk at stamp time."""
+    files = {rel: {"bytes": os.path.getsize(p), "sha256": _sha256_file(p)}
+             for rel, p in _iter_payload_files(path)}
+    manifest = {"version": 1, "files": files}
+    tmp = os.path.join(path, SIDECAR_MANIFEST + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    os.replace(tmp, os.path.join(path, SIDECAR_MANIFEST))  # atomic
+    return manifest
+
+
+def verify_sidecar_manifest(path: str, strict: bool = False,
+                            deep: Optional[bool] = None) -> bool:
+    """Validate ``path`` against its sidecar manifest.
+
+    Returns True when a sidecar exists and every file matches.  Without
+    a sidecar: False when ``strict`` (resilience snapshots REQUIRE the
+    manifest — a missing one means the flush never committed), else
+    True (legacy checkpoints predate the sidecar).  Raises
+    :class:`CheckpointCorruptionError` naming the first mismatch.
+
+    ``deep`` (default: same as ``strict``) controls whether file
+    CONTENTS are re-hashed.  The shallow pass (existence + size) is one
+    ``stat`` per file and catches torn/truncated trees; the deep pass
+    re-reads everything — right for the resilience checksum gate, too
+    expensive to impose on every ordinary multi-GB checkpoint load.
+    """
+    deep = strict if deep is None else deep
+    mp = os.path.join(path, SIDECAR_MANIFEST)
+    if not os.path.isdir(path):
+        raise CheckpointCorruptionError(
+            f"checkpoint {path!r} does not exist or is not a directory")
+    if not os.path.exists(mp):
+        if strict:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path!r} has no {SIDECAR_MANIFEST} sidecar — "
+                f"the save never completed (or predates integrity "
+                f"manifests)")
+        return True
+    try:
+        with open(mp) as fh:
+            manifest = json.load(fh)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint {path!r}: unreadable sidecar manifest "
+            f"{SIDECAR_MANIFEST} ({e!r})") from e
+    for rel, meta in sorted(files.items()):
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            raise CheckpointCorruptionError(
+                f"checkpoint {path!r}: file {rel!r} listed in the "
+                f"manifest is missing (torn/partial checkpoint)")
+        size = os.path.getsize(p)
+        if size != int(meta["bytes"]):
+            raise CheckpointCorruptionError(
+                f"checkpoint {path!r}: file {rel!r} is {size} bytes, "
+                f"manifest says {meta['bytes']} (truncated write)")
+        if deep and _sha256_file(p) != meta["sha256"]:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path!r}: file {rel!r} fails its sha256 "
+                f"checksum (bit-rot or partial overwrite)")
+    return True
 
 
 class CheckpointEngine:
@@ -62,18 +177,77 @@ class TorchCheckpointEngine(CheckpointEngine):
              commit_fn: Optional[Any] = None) -> None:
         with ocp.StandardCheckpointer() as saver:
             saver.save(path, state_tree, force=True)
+        # integrity sidecar BEFORE the durability marker: a manifest's
+        # existence implies the payload it hashes was fully written.
+        # Process 0 only — the tree is shared, the stamp must not race
+        if _is_write_coordinator():
+            write_sidecar_manifest(path)
         if commit_fn is not None:
             commit_fn()
 
     def load(self, path: str, target: Any = None,
              map_location: Any = None) -> Any:
+        # integrity-gate the read: a truncated/torn file raises a
+        # DESCRIPTIVE CheckpointCorruptionError here instead of orbax
+        # deserializing garbage.  Shallow (stat-only) by design — the
+        # resilience restore path layers the deep sha256 pass on top
+        # (verify strict=True); ordinary checkpoint loads must not pay
+        # a full re-read of a multi-GB tree
+        verify_sidecar_manifest(path)
         with ocp.StandardCheckpointer() as loader:
             if target is None:
                 meta = ckpt_metadata_tree(loader, path)
                 target = jax.tree.map(
                     lambda am: jax.ShapeDtypeStruct(tuple(am.shape),
                                                     am.dtype), meta)
-            return loader.restore(path, target)
+            try:
+                return loader.restore(path, target)
+            except CheckpointCorruptionError:
+                raise
+            except Exception as e:
+                # orbax's failure on a torn tree is opaque — but only
+                # claim corruption when the bytes actually fail a DEEP
+                # verify; a clean-hashing tree means the failure is
+                # structural (wrong target/shape/dtype) and must surface
+                # as the programming error it is, not get silently
+                # discarded by the resilience tier fallback
+                try:
+                    verify_sidecar_manifest(path, deep=True)
+                except CheckpointCorruptionError as ce:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint {path!r} failed to restore "
+                        f"({type(e).__name__}: {e}); integrity check "
+                        f"agrees: {ce}") from e
+                raise
+
+
+#: process-wide in-flight async saves, keyed by absolute path.  A READER
+#: must never race a background writer — even one owned by a different
+#: engine instance (a fresh engine loading the tag another engine is
+#: still flushing).  Relying on GC to __del__-join the writer is a race.
+#: Values are WEAK references: an engine abandoned mid-save still joins
+#: through its __del__ (pre-existing behavior); the registry must not
+#: pin it — and its checkpointer — for the process lifetime.
+_inflight_lock = threading.Lock()
+_inflight: Dict[str, Any] = {}  # path -> weakref to the engine
+
+
+def join_inflight_save(path: str) -> None:
+    """Join ANY engine's in-flight async save of ``path`` or a tree
+    above/below it.  Called by every load path before reading."""
+    path = os.path.abspath(path)
+    with _inflight_lock:
+        engines = set()
+        for p in list(_inflight):
+            if (p == path or p.startswith(path + os.sep)
+                    or path.startswith(p + os.sep)):
+                eng = _inflight[p]()
+                if eng is None:
+                    _inflight.pop(p, None)  # collected; __del__ joined it
+                else:
+                    engines.add(eng)
+    for eng in engines:
+        eng.wait()
 
 
 class DecoupledCheckpointEngine(CheckpointEngine):
@@ -96,11 +270,16 @@ class DecoupledCheckpointEngine(CheckpointEngine):
                          force=True)
         self._pending = path
         self._pending_commit = commit_fn
+        import weakref
+
+        with _inflight_lock:
+            _inflight[os.path.abspath(path)] = weakref.ref(self)
         log_dist(f"async checkpoint save started: {path}")
 
     def load(self, path: str, target: Any = None,
              map_location: Any = None) -> Any:
-        self.wait()  # never read a tag that is still being written
+        self.wait()                # our own in-flight write
+        join_inflight_save(path)   # ...and any OTHER engine's
         return TorchCheckpointEngine().load(path, target)
 
     def commit(self, tag: str) -> bool:
@@ -110,7 +289,19 @@ class DecoupledCheckpointEngine(CheckpointEngine):
     def wait(self) -> None:
         if self._pending is not None:
             self._ckptr.wait_until_finished()
-            self._pending = None
+            pending, self._pending = self._pending, None
+            with _inflight_lock:
+                ref = _inflight.get(os.path.abspath(pending))
+                if ref is not None and ref() in (self, None):
+                    _inflight.pop(os.path.abspath(pending), None)
+            try:
+                # the background writer just finished: hash what it wrote
+                # before the commit marker can name it (process 0 only)
+                if _is_write_coordinator():
+                    write_sidecar_manifest(pending)
+            except OSError as e:
+                logger.warning(f"async checkpoint: sidecar manifest for "
+                               f"{pending} failed ({e!r})")
             if self._pending_commit is not None:
                 commit, self._pending_commit = self._pending_commit, None
                 commit()

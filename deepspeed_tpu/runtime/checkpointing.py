@@ -206,7 +206,13 @@ def _load_checkpoint_impl(engine, load_dir: str, tag: Optional[str],
         return None, None
     ckpt_dir = os.path.abspath(os.path.join(load_dir, tag))
     # join any in-flight async save before reading (it may be this tag)
+    # — including one dispatched by a DIFFERENT engine instance (a fresh
+    # engine resuming a tag its predecessor is still flushing; waiting
+    # only on our own engine leaves that torn-read race to GC timing)
     _ckpt_engine_for(engine).wait()
+    from .checkpoint_engine import join_inflight_save
+
+    join_inflight_save(ckpt_dir)
     _globalize_state(engine)  # restore targets must be globally shardable
 
     # Restore INTO the engine's current sharded layout: orbax reshards on

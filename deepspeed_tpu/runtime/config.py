@@ -277,6 +277,54 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=TelemetryAggregationConfig)
 
 
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``resilience`` config group — the self-healing plane
+    (``deepspeed_tpu/resilience/``): tiered async snapshots of the full
+    training state, an automatic recovery policy (rollback on NaN/scale
+    collapse, resume-from-snapshot on restart, emergency save on
+    watchdog trip), and a deterministic fault-injection harness."""
+
+    enabled: bool = False
+    #: engine-driven snapshot cadence (optimizer steps)
+    snapshot_interval: int = 50
+    #: tier-1 flush root (``<dir>/snap-<step>[-emergency]/``)
+    snapshot_dir: str = "resilience_snapshots"
+    #: newest tier-1 snapshot dirs kept on disk (double-buffered default)
+    keep_snapshots: int = 2
+    #: tier 0 (double-buffered in-host-memory copies) is structurally
+    #: required — tiers 1/2 flush FROM it — so it has no off switch.
+    #: tier 1: async background flush through the checkpoint engine,
+    #: checksummed manifest gating every restore
+    disk_tier: bool = True
+    #: "sync" | "async" — tier-1 flush mode (async = the whole flush
+    #: job runs on a background worker thread over the tier-0 host
+    #: copy; only the device→host capture blocks the step path)
+    flush_engine: Literal["sync", "async"] = "async"
+    #: tier 2: replicate each flushed snapshot to the buddy host's store
+    #: slot via the chunked rendezvous transport (needs an elastic store)
+    buddy_tier: bool = False
+    buddy_chunk_bytes: int = 262144
+    buddy_max_bytes: int = 268435456
+    #: health-event kinds that trigger an automatic rollback
+    rollback_on: List[str] = Field(default_factory=lambda: [
+        "nan_loss", "loss_scale_collapse"])
+    #: recoveries (rollbacks + resumes) before the policy gives up
+    max_recoveries: int = 3
+    #: capped exponential backoff between recoveries
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 60.0
+    #: healthy steps after which the recovery budget re-arms
+    recovery_reset_steps: int = 100
+    #: flush the newest tier-0 snapshot to disk when the watchdog trips
+    #: (the host is responsive enough to run the listener; params may be
+    #: hung on device, but the host copy is already taken)
+    emergency_save_on_trip: bool = True
+    #: deterministic fault specs (``kind@step[:k=v,...]``), e.g.
+    #: ``kill_rank@120:rank=1``, ``nan_loss@64``, ``stall@32:seconds=90``,
+    #: ``corrupt_snapshot@40``; the DS_FAULTS env var appends more
+    faults: List[str] = Field(default_factory=list)
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -436,6 +484,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = Field(

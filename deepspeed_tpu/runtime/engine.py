@@ -390,6 +390,58 @@ class DeepSpeedEngine:
             q, f = self._log_qgz_bytes(self.state.params)
             log_dist(f"qgZ: DP grad reduction wire bytes {f/2**20:.1f} MiB "
                      f"→ {q/2**20:.1f} MiB per step ({f/q:.1f}× reduction)")
+
+        # --- self-healing resilience plane (resilience/ — ISSUE 4) -------
+        # snapshots + recovery policy + fault injection.  The injector is
+        # independent of `resilience.enabled`: injecting faults WITHOUT
+        # recovery is how you prove the failure actually breaks a run.
+        self.snapshots = None
+        self.resilience = None
+        from ..resilience.faults import FaultInjector
+
+        self.fault_injector = FaultInjector.from_config(
+            config.resilience, recorder=self.flight_recorder)
+        rcfg = config.resilience
+        if rcfg.enabled:
+            if self.offload_enabled or self.infinity is not None:
+                raise NotImplementedError(
+                    "resilience snapshots cover the on-device TrainState; "
+                    "ZeRO-Offload / Infinity keep optimizer state host-"
+                    "side in their own engines — snapshot support for "
+                    "those paths is a ROADMAP follow-up")
+            from ..resilience import RecoveryPolicy, SnapshotManager
+
+            self.snapshots = SnapshotManager(
+                self, rcfg, recorder=self.flight_recorder)
+            self.resilience = RecoveryPolicy(
+                self, self.snapshots, rcfg, recorder=self.flight_recorder)
+            if self.watchdog is not None:
+                # emergency-save-if-responsive on the trip edge (runs on
+                # the watchdog thread BEFORE its raise/exit action)
+                self.watchdog.add_trip_listener(
+                    self.resilience.on_watchdog_trip)
+            elif rcfg.emergency_save_on_trip:
+                logger.warning(
+                    "resilience: emergency_save_on_trip is set but the "
+                    "hang watchdog is off — hangs will NOT trigger an "
+                    "emergency snapshot (enable telemetry.watchdog)")
+            # the policy checks the loss scalar itself, but every OTHER
+            # rollback trigger arrives as a HealthMonitor event — which
+            # only exists when telemetry step records are on
+            inert = [k for k in rcfg.rollback_on
+                     if k != "nan_loss" and self.health is None]
+            if inert:
+                logger.warning(
+                    f"resilience: rollback_on includes {inert} but the "
+                    f"health monitor is off (it needs telemetry.enabled "
+                    f"+ step_records + health.enabled) — those triggers "
+                    f"will never fire; only the direct NaN-loss check "
+                    f"is active")
+            log_dist(f"resilience: snapshots every "
+                     f"{rcfg.snapshot_interval} steps -> "
+                     f"{rcfg.snapshot_dir} (tiers: memory"
+                     + (", disk" if rcfg.disk_tier else "")
+                     + (", buddy" if rcfg.buddy_tier else "") + ")")
         self._train_step_fn = None  # compiled lazily (first call)
         #: forced-partial-boundary programs, keyed by microbatch count
         self._partial_step_fns: Dict[int, Any] = {}
@@ -428,6 +480,7 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.last_metrics: Dict[str, Any] = {}
+        self._last_health_events: List[Any] = []
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=int(self.train_batch_size or 1))
@@ -1103,6 +1156,16 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         t_step0 = time.perf_counter()
         batch = self._feed_batch(batch)
+        if self.snapshots is not None and self.snapshots.snapshots_taken == 0:
+            # step-0 baseline: a failure inside the FIRST snapshot
+            # interval must roll back to init, not give up for want of
+            # any snapshot at all
+            self.snapshots.take()
+        if self.fault_injector is not None:
+            # chaos harness: fire any fault scheduled for THIS step
+            # (kill/stall/NaN-poison/corrupt-snapshot) before dispatch
+            batch = self.fault_injector.apply(self.global_steps + 1, batch,
+                                              engine=self)
         with self.telemetry.span("engine/train_step",
                                  args={"step": self.global_steps}):
             metrics = self._dispatch_train_step(batch)
@@ -1146,8 +1209,22 @@ class DeepSpeedEngine:
             self.watchdog.notify_progress(self.global_steps, step_time_s)
         if self._telemetry_steps:
             self._record_step_telemetry(batch, metrics, step_time_s, fenced)
-        if self.steps_per_print and self.global_steps % int(
-                self.steps_per_print) == 0:
+        rolled_back = False
+        if self.resilience is not None:
+            # recovery policy: a NaN'd loss / scale collapse rolls the
+            # engine back to the last good snapshot (the offending data
+            # window is skipped — this batch is never refed); healthy
+            # steps feed the snapshot cadence instead.  observe_step
+            # pulls the loss scalar — resilience trades overlap for
+            # catching the NaN before it ages another interval.
+            rolled_back = self.resilience.observe_step(
+                metrics, self._last_health_events)
+            if rolled_back:
+                metrics = dict(metrics, rolled_back=True)
+            else:
+                self.snapshots.maybe_snapshot()
+        if not rolled_back and self.steps_per_print and self.global_steps \
+                % int(self.steps_per_print) == 0:
             m = {k: float(v) for k, v in metrics.items()}
             line = (f"step={self.global_steps} loss={m['loss']:.4f} "
                     f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
@@ -1165,10 +1242,14 @@ class DeepSpeedEngine:
                          f"samples/s={t.samples_per_sec():.1f} "
                          f"hbm={mem.get('device_in_use_GB', 0):.2f}GB")
             log_dist(line)
-        if self.monitor is not None:
+        if self.monitor is not None and not rolled_back:
+            # a rolled-back step's metrics are the FAILED step's (NaN
+            # loss) while global_steps already points at the restored
+            # step — logging them would stamp a NaN onto a healthy step
             self.monitor.write_events(
                 [(f"Train/{k}", v, self.global_steps)
-                 for k, v in metrics.items() if k != "overflow"])
+                 for k, v in metrics.items()
+                 if k not in ("overflow", "rolled_back")])
         fp = self.config.flops_profiler
         if fp.enabled and self.global_steps == int(fp.profile_step):
             self._emit_module_profile(batch, fp)
@@ -1248,6 +1329,7 @@ class DeepSpeedEngine:
             self.flight_recorder.record_step(rec)
         if self.health is not None:
             events = self.health.observe(rec)
+            self._last_health_events = events  # resilience policy input
             if events and self.monitor is not None:
                 self.monitor.write_health_events(events)
 

@@ -237,6 +237,34 @@ def initialize(args: Any = None,
                      f"{sched.min}→{sched.max} over "
                      f"{getattr(sched, 'total', '?')} steps")
 
+    # --- resilience plane (resilience/ — ISSUE 4) -------------------------
+    # wired LAST so resume-from-snapshot sees the fully-assembled engine
+    # (and the dataloader's cursor hook is registered before any restore)
+    if getattr(engine, "resilience", None) is not None:
+        if dataloader is not None:
+            dl = dataloader  # bind the (possibly curriculum-wrapped) loader
+            inner = getattr(dl, "loader", dl)
+            engine.snapshots.register_meta(
+                "data_sampler",
+                lambda: {"epoch": int(getattr(inner, "_epoch", 0))},
+                restore=lambda p: setattr(inner, "_epoch",
+                                          int(p.get("epoch", 0))))
+        if cfg.resilience.buddy_tier and os.environ.get("DS_RDZV_ENDPOINT"):
+            # tier 2 from the WORKER process: the sealed ring + buddy
+            # slot live in the store, so a plain client suffices even
+            # when the elastic agent heartbeats in a different process
+            from ..elasticity.rendezvous import (ElasticRendezvous,
+                                                 RendezvousClient)
+
+            engine.snapshots.attach_rendezvous(ElasticRendezvous(
+                RendezvousClient(os.environ["DS_RDZV_ENDPOINT"]),
+                node_id=os.environ.get("DS_ELASTIC_NODE_ID",
+                                       f"node-{os.getpid()}")))
+        # elastic restart path: the agent exported DS_ELASTIC_RESTART_COUNT;
+        # a restarted worker resumes from the policy-chosen newest VALID
+        # snapshot (checksum-gated, tier fallback)
+        engine.resilience.resume_if_restarted()
+
     log_dist(f"deepspeed_tpu.initialize: stage={cfg.zero_optimization.stage} "
              f"dtype={cfg.dtype().__name__} mesh={dict(mesh.shape)} "
              f"batch={cfg.train_batch_size}(micro={cfg.train_micro_batch_size_per_gpu}"

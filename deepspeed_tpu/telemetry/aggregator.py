@@ -56,34 +56,43 @@ def _meta_key(node_id: str) -> str:
     return f"debug/pub/{node_id}"
 
 
-def _chunk_key(node_id: str, i: int) -> str:
-    return f"debug/chunk/{node_id}/{i}"
+def _partial_key(node_id: str) -> str:
+    return f"debug/partial/{node_id}"
 
 
 # ---------------------------------------------------------------------------
 # publish side (every host)
 # ---------------------------------------------------------------------------
 
-def _tar_bundle(bundle_dir: str, max_bytes: int) -> tuple:
-    """tar.gz the bundle dir into memory, smallest files first under the
-    size cap — ``bundle.json`` (the manifest, with the ledger tail and
-    comm census) is always included; a blown-up ``trace.json`` is what
-    gets dropped.  Returns ``(data, dropped_names)``."""
-    name = os.path.basename(bundle_dir.rstrip(os.sep))
-    files = sorted(
-        (f for f in os.listdir(bundle_dir)
-         if os.path.isfile(os.path.join(bundle_dir, f))),
-        key=lambda f: (f != BUNDLE_MANIFEST,
-                       os.path.getsize(os.path.join(bundle_dir, f))))
+def _tar_dir(src_dir: str, max_bytes: int, priority_file: str = "",
+             recursive: bool = False) -> tuple:
+    """tar.gz ``src_dir`` into memory, smallest files first under the
+    size cap — ``priority_file`` (e.g. the bundle manifest) is always
+    included; the biggest side file is what gets dropped.  Returns
+    ``(data, dropped_names)``.  The generic half of the store transport
+    — the resilience plane ships snapshot trees (``recursive=True``)
+    through the same path debug bundles use."""
+    name = os.path.basename(src_dir.rstrip(os.sep))
+    if recursive:
+        entries = []
+        for root, _dirs, files in os.walk(src_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                entries.append(os.path.relpath(p, src_dir))
+    else:
+        entries = [f for f in os.listdir(src_dir)
+                   if os.path.isfile(os.path.join(src_dir, f))]
+    entries.sort(key=lambda f: (f != priority_file,
+                                os.path.getsize(os.path.join(src_dir, f))))
     dropped: List[str] = []
     buf = io.BytesIO()
     budget = int(max_bytes)
     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
-        for f in files:
-            p = os.path.join(bundle_dir, f)
+        for f in entries:
+            p = os.path.join(src_dir, f)
             size = os.path.getsize(p)
-            # raw-size budget (compression only helps); manifest always in
-            if f != BUNDLE_MANIFEST and size > budget:
+            # raw-size budget (compression only helps); priority always in
+            if f != priority_file and size > budget:
                 dropped.append(f)
                 continue
             tar.add(p, arcname=f"{name}/{f}")
@@ -91,21 +100,55 @@ def _tar_bundle(bundle_dir: str, max_bytes: int) -> tuple:
     return buf.getvalue(), dropped
 
 
-def publish_bundle(client: Any, node_id: str, bundle_dir: str,
-                   req_id: int = 0, chunk_bytes: int = 256 * 1024,
-                   max_bundle_bytes: int = 32 * 1024 * 1024) -> Dict[str, Any]:
-    """Push one host's bundle through the store; returns the meta dict."""
-    data, dropped = _tar_bundle(bundle_dir, max_bundle_bytes)
+def push_dir_chunked(client: Any, meta_key: str, chunk_prefix: str,
+                     src_dir: str, chunk_bytes: int = 256 * 1024,
+                     max_bytes: int = 32 * 1024 * 1024,
+                     priority_file: str = "", recursive: bool = False,
+                     meta_extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Ship a directory through the key-value store as base64 tar.gz
+    chunks under ``<chunk_prefix>/<i>``, committing with ``meta_key``
+    written LAST.  Shared by bundle publication and resilience buddy
+    snapshot replication."""
+    data, dropped = _tar_dir(src_dir, max_bytes, priority_file=priority_file,
+                             recursive=recursive)
     b64 = base64.b64encode(data).decode("ascii")
     step = max(1, int(chunk_bytes))
     chunks = [b64[i:i + step] for i in range(0, len(b64), step)] or [""]
     for i, ch in enumerate(chunks):
-        client.set(_chunk_key(node_id, i), ch)
-    meta = {"req": int(req_id), "bundle": os.path.basename(bundle_dir),
-            "n": len(chunks), "bytes": len(data), "dropped": dropped,
-            "ts": time.time()}
-    client.set(_meta_key(node_id), meta)  # commit point: meta LAST
+        client.set(f"{chunk_prefix}/{i}", ch)
+    meta = {"bundle": os.path.basename(src_dir), "n": len(chunks),
+            "bytes": len(data), "dropped": dropped, "ts": time.time(),
+            **(meta_extra or {})}
+    client.set(meta_key, meta)  # commit point: meta LAST
     return meta
+
+
+def fetch_dir_chunked(client: Any, meta_key: str, chunk_prefix: str,
+                      out_dir: str) -> Optional[str]:
+    """Inverse of :func:`push_dir_chunked`: pull + unpack into
+    ``out_dir``; returns the extracted directory, or None when nothing
+    is published under ``meta_key``."""
+    meta = client.get(meta_key)
+    if not isinstance(meta, dict):
+        return None
+    b64 = "".join(client.get(f"{chunk_prefix}/{i}") or ""
+                  for i in range(int(meta["n"])))
+    data = base64.b64decode(b64)
+    os.makedirs(out_dir, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        _safe_extract(tar, out_dir)
+    return os.path.join(out_dir, meta["bundle"])
+
+
+def publish_bundle(client: Any, node_id: str, bundle_dir: str,
+                   req_id: int = 0, chunk_bytes: int = 256 * 1024,
+                   max_bundle_bytes: int = 32 * 1024 * 1024) -> Dict[str, Any]:
+    """Push one host's bundle through the store; returns the meta dict."""
+    return push_dir_chunked(
+        client, _meta_key(node_id), f"debug/chunk/{node_id}", bundle_dir,
+        chunk_bytes=chunk_bytes, max_bytes=max_bundle_bytes,
+        priority_file=BUNDLE_MANIFEST, meta_extra={"req": int(req_id)})
 
 
 def _safe_extract(tar: tarfile.TarFile, out_dir: str) -> None:
@@ -120,16 +163,8 @@ def _safe_extract(tar: tarfile.TarFile, out_dir: str) -> None:
 def fetch_bundle(client: Any, node_id: str, out_dir: str) -> Optional[str]:
     """Pull + unpack one host's published bundle into ``out_dir``;
     returns the extracted bundle path, or None if nothing is published."""
-    meta = client.get(_meta_key(node_id))
-    if not isinstance(meta, dict):
-        return None
-    b64 = "".join(client.get(_chunk_key(node_id, i)) or ""
-                  for i in range(int(meta["n"])))
-    data = base64.b64decode(b64)
-    os.makedirs(out_dir, exist_ok=True)
-    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
-        _safe_extract(tar, out_dir)
-    return os.path.join(out_dir, meta["bundle"])
+    return fetch_dir_chunked(client, _meta_key(node_id),
+                             f"debug/chunk/{node_id}", out_dir)
 
 
 def publish_bundle_fs(node_id: str, bundle_dir: str, shared_fs_path: str,
@@ -174,6 +209,8 @@ class BundlePublisher:
         # dump beats a collector timing out on a silent host)
         self._last_req_served = 0
         self._last_published: Optional[str] = None
+        #: watchdog trips already answered with a PARTIAL push
+        self._trips_pushed = 0
         # the agent's heartbeat loop and the worker-side daemon (subprocess
         # mode) may drive the same publisher — one beat at a time
         self._tick_lock = threading.Lock()
@@ -200,11 +237,78 @@ class BundlePublisher:
                                f"{e!r}")
         self._last_published = bundle_dir
 
+    def _partial_payload(self, wd: Any) -> Dict[str, Any]:
+        """A hung host's last words: the watchdog's liveness summary
+        (step index + collective-ledger seq/hash), the ledger TAIL, and
+        every thread's Python stack — small enough to ship as ONE store
+        value even when the host can't complete a full bundle dump."""
+        payload: Dict[str, Any] = {"ts": time.time(), "node": self.node_id,
+                                   "trips": int(getattr(wd, "trips", 0)),
+                                   "reason": "watchdog trip"}
+        try:
+            payload["liveness"] = wd.heartbeat_payload()
+        except Exception as e:
+            payload["liveness"] = {"error": repr(e)}
+        try:
+            from .collective_ledger import get_collective_ledger
+
+            led = get_collective_ledger()
+            if led.enabled:
+                payload["ledger_tail"] = led.tail()
+        except Exception as e:
+            payload["ledger_tail"] = {"error": repr(e)}
+        try:
+            # pure-python stack walk: faulthandler needs a real fd, and a
+            # heartbeat thread mid-incident may not be able to open one
+            import sys as _sys
+            import traceback as _tb
+
+            frames = _sys._current_frames()
+            names = {t.ident: t.name for t in threading.enumerate()}
+            stacks = []
+            for ident, frame in frames.items():
+                stacks.append(f"--- thread {names.get(ident, ident)}\n"
+                              + "".join(_tb.format_stack(frame)))
+            payload["stacks"] = "\n".join(stacks)[:32768]
+        except Exception as e:
+            payload["stacks"] = f"unavailable: {e!r}"
+        return payload
+
+    def _maybe_push_partial(self, client: Any) -> None:
+        """ROADMAP follow-up (ISSUE 4 satellite): when the watchdog
+        trips, event-push a best-effort PARTIAL ledger (tail + stacks)
+        straight from the heartbeat thread — the worker may be hung too
+        hard to answer a collect request or finish a full dump, but one
+        ``client.set`` of pre-collected state almost always lands."""
+        from .watchdog import get_watchdog
+
+        wd = get_watchdog()
+        if wd is None:
+            return
+        trips = int(getattr(wd, "trips", 0))
+        if trips <= self._trips_pushed:
+            return
+        client.set(_partial_key(self.node_id), self._partial_payload(wd))
+        # mark served only once the set SUCCEEDED: a store hiccup (likely
+        # mid-incident) must leave the push pending for the next beat
+        self._trips_pushed = trips
+        from . import get_telemetry
+
+        get_telemetry().inc_counter(
+            "aggregator/partial_pushes",
+            help="best-effort partial-ledger publications on watchdog trip")
+
     def tick(self, client: Any) -> Optional[str]:
         """One service beat: answer a pending collect request with a
         FRESH dump, else push a not-yet-published local bundle (watchdog
         trip / crash hook).  Returns the published path, if any."""
         with self._tick_lock:
+            try:
+                # FIRST and unconditionally: the cheap partial push must
+                # not wait behind a full dump that may itself be stuck
+                self._maybe_push_partial(client)
+            except Exception:
+                pass  # best-effort by definition
             req = int(client.get(_REQ_KEY) or 0)
             rec = self.recorder()
             if req > self._last_req_served:
@@ -362,9 +466,30 @@ def collect_cluster_archive(client: Any, peer_ids: Optional[List[str]] = None,
         if path:
             got[pid] = path
     missing = sorted(set(peer_ids) - set(got))
+    # PARTIAL publications (a hung host's heartbeat-thread last words —
+    # ledger tail + stacks): persist each one next to its host's bundles;
+    # for a MISSING host this is the only evidence in the archive
+    partials: Dict[str, Any] = {}
+    for pid in peer_ids:
+        try:
+            part = client.get(_partial_key(pid))
+        except Exception:
+            part = None
+        if isinstance(part, dict):
+            partials[pid] = {k: part.get(k) for k in
+                             ("ts", "trips", "reason", "liveness")}
+            try:
+                pdir = os.path.join(hosts_dir, pid)
+                os.makedirs(pdir, exist_ok=True)
+                with open(os.path.join(pdir, "partial.json"), "w") as fh:
+                    json.dump(part, fh, indent=2, default=str)
+            except OSError as e:
+                logger.warning(f"aggregator: partial for {pid} not "
+                               f"persisted ({e!r})")
     build_cluster_manifest(archive,
                            heartbeat_ages=_heartbeat_view(client, peer_ids),
-                           missing=missing, req_id=req_id)
+                           missing=missing, req_id=req_id,
+                           partials=partials)
     logger.error(f"aggregator: cluster archive written to {archive} "
                  f"({len(got)}/{len(peer_ids)} hosts"
                  + (f", missing {missing}" if missing else "") + ")")
@@ -438,7 +563,9 @@ def build_cluster_manifest(archive: str,
                            heartbeat_ages: Optional[Dict[str, Any]] = None,
                            missing: Optional[List[str]] = None,
                            req_id: int = 0,
-                           persist: bool = True) -> Dict[str, Any]:
+                           persist: bool = True,
+                           partials: Optional[Dict[str, Any]] = None
+                           ) -> Dict[str, Any]:
     """Fold every host bundle in ``archive`` into one manifest: per-host
     step index / reason / comm totals, cross-host step skew, comm-census
     deltas, and the collective-desync report.  Written to
@@ -478,6 +605,7 @@ def build_cluster_manifest(archive: str,
         "collect_request": int(req_id),
         "hosts": hosts,
         "missing_hosts": list(missing or []),
+        "partials": partials or {},
         "step_skew": (max(last_steps) - min(last_steps)
                       if len(last_steps) >= 2 else 0),
         "comm_census_delta": comm_delta,

@@ -129,6 +129,15 @@ def _print_archive_summary(archive: str, last_n: int) -> int:
     print(f"  created: {cm.get('created_utc')}  "
           f"hosts: {len(cm.get('hosts') or {})}  "
           f"missing: {cm.get('missing_hosts') or 'none'}")
+    partials = cm.get("partials") or {}
+    for node in cm.get("missing_hosts") or []:
+        p = partials.get(node)
+        if p:
+            live = p.get("liveness") or {}
+            print(f"  [{node}] PARTIAL only (watchdog trip "
+                  f"#{p.get('trips')}): step {live.get('step')} "
+                  f"coll_seq {live.get('coll_seq')} — see "
+                  f"hosts/{node}/partial.json")
     print(f"  step skew across hosts: {cm.get('step_skew')}")
     for node, h in sorted((cm.get("hosts") or {}).items()):
         print(f"  [{node}] step {h.get('last_step')} "

@@ -92,6 +92,21 @@ class HealthMonitor:
         self._gn_anoms = 0
         self.events_total = 0
 
+    def reset_windows(self) -> None:
+        """Drop the rolling baselines (losses / grad norms / throughput /
+        scale streaks).  The resilience policy calls this after a
+        rollback: the pre-rollback window saw the anomaly that triggered
+        it, and replayed steps must be judged against a fresh baseline,
+        not compared with the poisoned history."""
+        self._losses.clear()
+        self._grad_norms.clear()
+        self._tps.clear()
+        self._prev_scale = None
+        self._scale_drops = 0
+        self._scale_collapsed = False
+        self._loss_anoms = 0
+        self._gn_anoms = 0
+
     # -- detectors ---------------------------------------------------------
 
     def _check_loss(self, rec: StepRecord, out: List[HealthEvent]) -> None:

@@ -72,8 +72,18 @@ class HangWatchdog:
         self._last_comm_ops = self._comm_ops()
         self._tripped = False
         self.trips = 0
+        #: fns called on every trip edge with (reason, bundle_path_or_None)
+        #: — the resilience policy's emergency-save subscribes here; ran
+        #: BEFORE the configured action (an action="exit" must not skip
+        #: the emergency flush), each guarded so one listener's failure
+        #: cannot mask another's
+        self._trip_listeners: list = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def add_trip_listener(self, fn: Callable[[str, Optional[str]], Any]
+                          ) -> None:
+        self._trip_listeners.append(fn)
 
     # -- progress feed (engine hot path: one lock + a few floats) ----------
 
@@ -168,6 +178,11 @@ class HangWatchdog:
                 bundle = recorder.dump(reason, extra=extra)
             except Exception as e:
                 logger.error(f"watchdog: bundle dump failed: {e!r}")
+        for listener in list(self._trip_listeners):
+            try:
+                listener(reason, bundle)
+            except Exception as e:
+                logger.error(f"watchdog: trip listener failed: {e!r}")
         # bump AFTER the dump: a monitor polling `trips` may read the
         # bundle path the moment the counter moves
         self.trips += 1

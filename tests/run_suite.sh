@@ -27,6 +27,7 @@ SHARDS=(
   "tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/monitor"
   "tests/unit/telemetry"
+  "tests/unit/resilience"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
   "tests/unit/multiprocess"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
@@ -67,6 +68,64 @@ if python -m deepspeed_tpu.telemetry summary "$bundle" >/dev/null; then
   echo "=== CLI smoke passed"
 else
   echo "=== CLI smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
+# Fault-injection smoke (ISSUE 4): an env-var fault must drive the WHOLE
+# recovery loop — NaN injected, rollback taken, recovery counter moves.
+echo "=== fault-injection smoke: env-driven NaN -> rollback"
+smoke_dir=$(mktemp -d)
+if DS_FAULTS="nan_loss@3" JAX_PLATFORMS=cpu python - "$smoke_dir" <<'PYEOF'
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.parallel import MeshLayout
+from deepspeed_tpu.utils import groups
+
+out = sys.argv[1]
+mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+rng = np.random.default_rng(0)
+params = {"w": jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32))}
+cfg = {"train_micro_batch_size_per_gpu": 4,
+       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+       "steps_per_print": 0,
+       "telemetry": {"enabled": True, "output_path": out, "job_name": "smoke",
+                     "flight_recorder": {"install_handlers": False}},
+       "resilience": {"enabled": True, "snapshot_interval": 1,
+                      "snapshot_dir": out + "/snaps", "flush_engine": "sync",
+                      "backoff_base_s": 0.0}}
+engine, *_ = dst.initialize(model=lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+                            model_parameters=params, config=cfg, mesh=mesh)
+i = 0
+while engine.global_steps < 5:
+    x = jnp.asarray(np.random.default_rng(i).normal(size=(4, 8)).astype(np.float32))
+    engine.train_step((x, jnp.zeros((4, 1), jnp.float32)))
+    i += 1
+from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+assert parsed["resilience_faults_injected_total"] >= 1, parsed
+assert parsed["resilience_rollbacks_total"] >= 1, parsed
+assert float(engine.last_metrics["loss"]) == float(engine.last_metrics["loss"])  # finite again
+print("fault smoke: rollback recovered, counters:",
+      {k: v for k, v in parsed.items() if k.startswith("resilience")})
+PYEOF
+then
+  echo "=== fault smoke passed"
+else
+  echo "=== fault smoke FAILED"
+  fail=1
+fi
+# the snapshot CLI must read the smoke run's artifacts cleanly
+if python -m deepspeed_tpu.resilience ls "$smoke_dir/snaps" >/dev/null \
+   && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" >/dev/null; then
+  echo "=== resilience CLI smoke passed"
+else
+  echo "=== resilience CLI smoke FAILED"
   fail=1
 fi
 rm -rf "$smoke_dir"

@@ -1,0 +1,59 @@
+"""Operator CLI: ``python -m deepspeed_tpu.resilience {ls,verify}``
+over real snapshot dirs, scriptable exit codes."""
+
+from deepspeed_tpu.resilience import cli, corrupt_newest_snapshot
+
+
+def _make_snaps(tiny_engine_factory, n_steps=4):
+    engine, batches = tiny_engine_factory(
+        "cliw", resilience={"snapshot_interval": 2, "keep_snapshots": 4})
+    for b in batches[:n_steps]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    return engine.snapshots.snapshot_dir
+
+
+def test_ls_lists_with_validity(tiny_engine_factory, capsys):
+    snap_dir = _make_snaps(tiny_engine_factory)
+    assert cli.main(["ls", snap_dir]) == 0
+    out = capsys.readouterr().out
+    assert "snap-00000004" in out and "snap-00000002" in out
+    assert out.count("valid") == 3  # baseline + the two interval snaps
+
+
+def test_verify_exit_codes(tiny_engine_factory, capsys):
+    snap_dir = _make_snaps(tiny_engine_factory)
+    assert cli.main(["verify", snap_dir]) == 0  # newest valid
+    corrupt_newest_snapshot(snap_dir)
+    assert cli.main(["verify", snap_dir]) == 3  # fallback-only
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "older" in out
+    # corrupt the remaining one too -> nothing restorable
+    from deepspeed_tpu.resilience import list_snapshots, verify_snapshot
+
+    for entry in list_snapshots(snap_dir):
+        if verify_snapshot(entry["path"])[0]:
+            import os
+
+            state = os.path.join(entry["path"], "state")
+            for root, _d, files in os.walk(state):
+                for f in files:
+                    if f != "ds_manifest.json":
+                        p = os.path.join(root, f)
+                        with open(p, "r+b") as fh:
+                            head = fh.read(32)
+                            fh.seek(0)
+                            fh.write(bytes(b ^ 0xFF for b in head))
+    assert cli.main(["verify", snap_dir]) == 4
+
+
+def test_verify_single_snapshot_and_ls_empty(tmp_path, capsys,
+                                             tiny_engine_factory):
+    snap_dir = _make_snaps(tiny_engine_factory, n_steps=2)
+    from deepspeed_tpu.resilience import list_snapshots
+
+    entry = list_snapshots(snap_dir)[0]
+    assert cli.main(["verify", entry["path"]]) == 0
+    assert cli.main(["ls", str(tmp_path / "nothing")]) == 0
+    assert "no committed snapshots" in capsys.readouterr().out
+    assert cli.main(["verify", str(tmp_path / "nothing")]) == 2

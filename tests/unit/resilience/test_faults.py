@@ -1,0 +1,80 @@
+"""Fault-injection harness: spec grammar, determinism, every fault
+kind's observable effect."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.resilience import (FaultInjector, InjectedFault,
+                                      parse_fault, parse_faults)
+
+
+def test_parse_grammar():
+    f = parse_fault("kill_rank@120:rank=1,mode=exit")
+    assert (f.kind, f.step, f.params) == (
+        "kill_rank", 120, {"rank": "1", "mode": "exit"})
+    assert parse_fault("kill@5").kind == "kill_rank"  # alias
+    assert parse_fault(" nan_loss@64 ").step == 64
+    for bad in ("nan_loss", "nan_loss@x", "typo@3", "stall@3:seconds",
+                "@5", "stall@"):
+        with pytest.raises(ValueError, match="fault spec"):
+            parse_fault(bad)
+
+
+def test_env_var_appends(monkeypatch):
+    monkeypatch.setenv("DS_FAULTS", "nan_loss@7;stall@9:seconds=1")
+    faults = parse_faults(["kill_rank@3"])
+    assert [f.kind for f in faults] == ["kill_rank", "nan_loss", "stall"]
+
+
+def test_kill_rank_guard_and_raise():
+    inj = FaultInjector(parse_faults(["kill_rank@2:rank=1"]), rank=0)
+    assert inj.apply(2, "batch") == "batch"  # other rank: no fire
+    assert inj.injected == 0
+    inj2 = FaultInjector(parse_faults(["kill_rank@2:rank=1"]), rank=1)
+    with pytest.raises(InjectedFault, match="step 2"):
+        inj2.apply(2, "batch")
+    assert inj2.injected == 1
+
+
+def test_faults_fire_once():
+    sleeps = []
+    inj = FaultInjector(parse_faults(["stall@3:seconds=5"]), rank=0,
+                        sleep=sleeps.append)
+    inj.apply(3, None)
+    inj.apply(3, None)  # same step again (post-rollback replay)
+    assert sleeps == [5.0]
+
+
+def test_nan_poison_hits_first_float_leaf():
+    import jax.numpy as jnp
+    import numpy as np
+
+    inj = FaultInjector(parse_faults(["nan_loss@1"]), rank=0)
+    batch = {"ids": jnp.arange(4), "x": jnp.ones((4, 2), jnp.float32)}
+    out = inj.apply(1, batch)
+    assert np.array_equal(np.asarray(out["ids"]), np.arange(4))
+    assert math.isnan(float(jnp.sum(out["x"])))
+
+
+def test_corrupt_snapshot_fault_defeats_checksum(tiny_engine_factory):
+    """corrupt_snapshot@S flips bytes in the newest COMMITTED flush;
+    the checksum gate must catch it on the next restore attempt."""
+    engine, batches = tiny_engine_factory(
+        "corrupt", resilience={"snapshot_interval": 1,
+                               "keep_snapshots": 3,
+                               "faults": ["corrupt_snapshot@3"]})
+    for b in batches[:3]:
+        engine.train_step(b)
+    from deepspeed_tpu.resilience import (choose_resume_snapshot,
+                                          list_snapshots, verify_snapshot)
+
+    engine.snapshots.wait()
+    snaps = list_snapshots(engine.snapshots.snapshot_dir)
+    # the fault fired at step 3 BEFORE that step's own snapshot, so the
+    # newest snapshot at fire time (step 2) is the corrupted one
+    by_step = {s["step"]: s["path"] for s in snaps}
+    ok2, detail = verify_snapshot(by_step[2])
+    assert not ok2 and "checksum" in detail or "sha256" in detail
+    chosen = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    assert chosen == by_step[3]  # newest valid wins, corrupt one skipped

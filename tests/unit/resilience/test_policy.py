@@ -1,0 +1,205 @@
+"""Recovery-policy acceptance: NaN-injection → rollback with the
+offending window skipped and the resumed trajectory matching a clean
+run; backoff + give-up budget; emergency save on watchdog trip."""
+
+import math
+import os
+
+import pytest
+
+from deepspeed_tpu.resilience import ResilienceGiveUp
+from deepspeed_tpu.telemetry import (get_telemetry, load_bundle,
+                                     parse_prometheus_text)
+
+
+def _run(engine, batches, total):
+    """Feed batches in order until the engine reaches ``total`` applied
+    steps; returns [(step, loss)] for steps that were KEPT (rolled-back
+    steps excluded — their update was discarded)."""
+    out, i = [], 0
+    while engine.global_steps < total:
+        m = engine.train_step(batches[i])
+        i += 1
+        if not m.get("rolled_back", False):
+            out.append((engine.global_steps, float(m["loss"])))
+    return out
+
+
+def test_nan_injection_rolls_back_and_matches_clean_run(
+        tiny_engine_factory):
+    """E2E chaos acceptance (NaN half): with ``nan_loss@3`` injected,
+    training auto-recovers, loses ≤ snapshot_interval steps, and the
+    post-resume loss/step sequence EQUALS an uninterrupted run that
+    never saw the poisoned batch; counters + debug bundle record it."""
+    engine, batches = tiny_engine_factory(
+        "chaos", resilience={"snapshot_interval": 2,
+                             "faults": ["nan_loss@3"]})
+    kept = _run(engine, batches, total=6)
+    # fault at step 3, last snapshot at step 2: exactly 1 step of work
+    # lost (≤ snapshot_interval), and the poisoned batch was skipped
+    assert engine.resilience.rollbacks_total == 1
+    assert [s for s, _l in kept] == [1, 2, 3, 4, 5, 6]
+
+    # clean reference: same seed/model, SAME batch order minus the
+    # poisoned one (batches[2] died with the rollback)
+    clean, cbatches = tiny_engine_factory("clean", resilience={
+        "snapshot_interval": 2})
+    clean_seq = [float(clean.train_step(b)["loss"])
+                 for b in (cbatches[:2] + cbatches[3:7])]
+    assert [l for _s, l in kept] == clean_seq[:len(kept)]
+
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_rollbacks_total"] == 1.0
+    assert parsed["resilience_faults_injected_total"] == 1.0
+    assert parsed["resilience_steps_skipped_total"] >= 1.0
+    # the debug bundle tells the story: fault fired, rollback annotated
+    m = load_bundle(engine.flight_recorder.dump("post-chaos"))["manifest"]
+    kinds = [a["kind"] for a in m["annotations"]]
+    assert "fault_injected" in kinds and "resilience_rollback" in kinds
+    rb = next(a for a in m["annotations"]
+              if a["kind"] == "resilience_rollback")
+    assert rb["trigger"] == "nan_loss"
+    assert rb["failed_step"] == 3 and rb["restored_step"] == 2
+
+
+def test_nan_triggers_health_event_and_window_reset(tiny_engine_factory):
+    """The health monitor fires nan_loss on the poisoned step and the
+    policy resets its windows so replayed steps are judged fresh."""
+    engine, batches = tiny_engine_factory(
+        "health", resilience={"snapshot_interval": 1,
+                              "faults": ["nan_loss@4"]})
+    _run(engine, batches, total=6)
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["health_nan_loss_total"] >= 1
+    assert engine.health is not None
+    assert len(engine.health._losses) <= 3  # reset at the rollback
+
+
+def test_give_up_after_budget(tiny_engine_factory):
+    """Recovery budget: every step NaNs (injector at steps 2,3,4 with
+    max_recoveries=2) → the third recovery raises ResilienceGiveUp."""
+    engine, batches = tiny_engine_factory(
+        "giveup", resilience={
+            "snapshot_interval": 1, "max_recoveries": 2,
+            "faults": ["nan_loss@2", "nan_loss@3", "nan_loss@4"]})
+    sleeps = []
+    engine.resilience._sleep = sleeps.append
+    with pytest.raises(ResilienceGiveUp, match="giving up"):
+        _run(engine, batches, total=6)
+    assert engine.resilience.state == "gave_up"
+    # capped exponential backoff between the recoveries that did run
+    assert sleeps == [engine.resilience.backoff_base_s,
+                      min(engine.resilience.backoff_base_s * 2,
+                          engine.resilience.backoff_max_s)]
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_give_ups_total"] == 1.0
+
+
+def test_backoff_caps_and_rearms(tiny_engine_factory):
+    engine, _ = tiny_engine_factory("rearm", resilience={
+        "max_recoveries": 10, "backoff_base_s": 1.0, "backoff_max_s": 4.0,
+        "recovery_reset_steps": 5})
+    pol = engine.resilience
+    sleeps = []
+    pol._sleep = sleeps.append
+    for _ in range(4):
+        pol._charge_recovery("test")
+    assert sleeps == [1.0, 2.0, 4.0, 4.0]  # capped
+    # healthy distance past the reset window re-arms the budget
+    engine.global_steps = pol._last_recovery_step + 5
+    pol._maybe_rearm()
+    assert pol.recoveries == 0
+
+
+def test_nan_before_first_interval_rolls_back_to_baseline(
+        tiny_engine_factory):
+    """A NaN BEFORE the first snapshot interval rolls back to the
+    step-0 baseline the engine captured before its first step — early
+    failures must not be the one window the plane can't cover."""
+    engine, batches = tiny_engine_factory(
+        "early", resilience={"snapshot_interval": 100,
+                             "faults": ["nan_loss@1"]})
+    m = engine.train_step(batches[0])
+    assert m.get("rolled_back") is True and engine.global_steps == 0
+    m2 = engine.train_step(batches[1])
+    assert engine.global_steps == 1 and math.isfinite(float(m2["loss"]))
+
+
+def test_poisoned_snapshot_burned_on_immediate_refailure(
+        tiny_engine_factory):
+    """A snapshot that fails AGAIN right after being restored (params
+    were already NaN under a still-finite loss when it was captured) is
+    discarded, and the next rollback digs to the older buffer instead
+    of re-restoring the poison until the budget burns out."""
+    import numpy as np
+
+    engine, batches = tiny_engine_factory(
+        "burn", resilience={"snapshot_interval": 1, "max_recoveries": 5})
+    for b in batches[:3]:
+        engine.train_step(b)  # tier-0 buffers: snap@3 (newest), snap@2
+    newest = engine.snapshots.latest()
+    assert newest.global_steps == 3
+    # poison the newest capture (device_get views are read-only)
+    newest.state = newest.state._replace(params={
+        "w": np.full_like(np.asarray(newest.state.params["w"]), np.nan)})
+    engine.resilience.rollback("nan_loss")  # restores poisoned snap@3
+    assert engine.global_steps == 3
+    m = engine.train_step(batches[3])  # NaN again -> burn snap@3
+    assert m.get("rolled_back") is True
+    assert engine.global_steps == 2  # fell back to the OLDER buffer
+    m2 = engine.train_step(batches[4])  # clean state: healthy again
+    assert not m2.get("rolled_back")
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_rollback_without_any_snapshot_gives_up(tiny_engine_factory):
+    """No snapshot in ANY tier (nothing ever ran): an explicit give-up
+    without a pointless backoff sleep, not garbage."""
+    engine, _ = tiny_engine_factory("nosnap")
+    sleeps = []
+    engine.resilience._sleep = sleeps.append
+    with pytest.raises(ResilienceGiveUp, match="no valid snapshot"):
+        engine.resilience.rollback("manual")
+    assert sleeps == []  # budget not charged when nothing is restorable
+
+
+def test_watchdog_trip_emergency_save(tiny_engine_factory):
+    """The trip listener flushes the newest tier-0 copy durably with a
+    SYNC writer — even when the async flusher might be the stuck part."""
+    engine, batches = tiny_engine_factory(
+        "trip", resilience={"snapshot_interval": 1},
+        telemetry={"watchdog": {"enabled": True, "hang_timeout_s": 600.0}})
+    try:
+        for b in batches[:3]:
+            engine.train_step(b)
+        # force the trip edge: age the last-progress stamp far past the
+        # timeout (a fake absolute clock would race the host's uptime)
+        engine.watchdog._last_progress -= 100_000.0
+        assert engine.watchdog.check() is True
+        from deepspeed_tpu.resilience import list_snapshots
+
+        snaps = list_snapshots(engine.snapshots.snapshot_dir)
+        assert any(s["emergency"] for s in snaps)
+        parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+        assert parsed["resilience_emergency_saves_total"] == 1.0
+    finally:
+        engine.watchdog.stop()
+
+
+def test_resume_if_restarted_uses_env(tiny_engine_factory, monkeypatch):
+    """The elastic restart path: DS_ELASTIC_RESTART_COUNT>0 makes a
+    fresh engine resume from the newest valid snapshot on disk."""
+    engine, batches = tiny_engine_factory("resume")
+    for b in batches[:4]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    snap_dir = engine.snapshots.snapshot_dir
+
+    engine2, _ = tiny_engine_factory("resume2")
+    engine2.snapshots.snapshot_dir = snap_dir
+    monkeypatch.setenv("DS_ELASTIC_RESTART_COUNT", "1")
+    path = engine2.resilience.resume_if_restarted()
+    assert path is not None and engine2.global_steps == 4
+    assert engine2.resilience.resumes_total == 1
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_resumes_total"] == 1.0

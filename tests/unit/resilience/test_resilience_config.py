@@ -1,0 +1,57 @@
+"""``resilience.*`` config group: parsing, defaults, validation, and
+the unsupported-engine gates."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_resilience_group_parses():
+    cfg = DeepSpeedConfig.model_validate({
+        "train_micro_batch_size_per_gpu": 1,
+        "resilience": {
+            "enabled": True, "snapshot_interval": 25,
+            "snapshot_dir": "/tmp/snaps", "flush_engine": "sync",
+            "buddy_tier": True, "max_recoveries": 5,
+            "rollback_on": ["nan_loss"],
+            "faults": ["nan_loss@7", "kill_rank@9:rank=1"]}})
+    r = cfg.resilience
+    assert r.enabled and r.snapshot_interval == 25
+    assert r.flush_engine == "sync" and r.buddy_tier
+    assert r.rollback_on == ["nan_loss"]
+    assert r.faults == ["nan_loss@7", "kill_rank@9:rank=1"]
+    # defaults: off, async flush, double-buffered disk retention
+    d = DeepSpeedConfig.model_validate({"train_batch_size": 8}).resilience
+    assert not d.enabled and d.flush_engine == "async"
+    assert d.keep_snapshots == 2 and d.emergency_save_on_trip
+
+    from pydantic import ValidationError
+
+    with pytest.raises(ValidationError):
+        DeepSpeedConfig.model_validate(
+            {"resilience": {"flush_engine": "carrier-pigeon"}})
+
+
+def test_resilience_rejects_offload(tmp_path):
+    """Snapshots cover the on-device TrainState; host-side optimizer
+    engines (offload/infinity) are gated with a descriptive error."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    params = {"w": jnp.zeros((4, 1), jnp.float32)}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1, "offload_optimizer":
+                              {"device": "cpu"}},
+        "resilience": {"enabled": True,
+                       "snapshot_dir": str(tmp_path / "s")},
+    }
+    with pytest.raises(NotImplementedError, match="resilience"):
+        dst.initialize(model=lambda p, b: jnp.sum(p["w"]),
+                       model_parameters=params, config=cfg, mesh=mesh)

@@ -145,6 +145,66 @@ def test_three_host_collect_names_the_culprit(gang, tmp_path, capsys):
     assert "seq 5" in text
 
 
+def test_watchdog_trip_pushes_partial_ledger(tmp_path):
+    """ROADMAP follow-up (ISSUE 4 satellite): when the watchdog trips,
+    the publisher's next heartbeat tick pushes a PARTIAL payload
+    (liveness + ledger tail + per-thread stacks) as ONE store value —
+    evidence that survives even if the host can never answer a collect
+    — and a collect that finds the host silent records it."""
+    from deepspeed_tpu.telemetry import (HangWatchdog,
+                                         configure_collective_ledger,
+                                         get_telemetry,
+                                         parse_prometheus_text,
+                                         set_watchdog)
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        _led, fr, _last = _make_host(tmp_path, "hung", False)
+        led = configure_collective_ledger(tail=16)
+        for op, n in OPS:
+            led.record(op, n)
+        wd = HangWatchdog(hang_timeout_s=60.0, recorder=None)  # no dump
+        wd.notify_progress(7, 0.1)
+        set_watchdog(wd)
+        pub = agg.BundlePublisher("hung", recorder=fr)
+        pub.tick(c)
+        assert c.get("debug/partial/hung") is None  # no trip yet
+        wd._last_progress -= 100_000.0  # age past the timeout
+        assert wd.check() is True
+        pub.tick(c)
+        part = c.get("debug/partial/hung")
+        assert part["trips"] == 1 and part["liveness"]["step"] == 7
+        assert part["liveness"]["coll_seq"] == led.seq
+        assert part["ledger_tail"][-1]["op"] == "all_gather"
+        assert "thread" in part["stacks"]
+        pub.tick(c)  # same trip: pushed once, not every beat
+        parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+        assert parsed["aggregator_partial_pushes"] == 1.0
+
+        # a collect with this host SILENT (no publisher answering) still
+        # lands the partial in the archive + manifest
+        archive = agg.collect_cluster_archive(
+            RendezvousClient(srv.endpoint), ["hung"], timeout_s=0.3,
+            out_dir=str(tmp_path / "arch"))
+        with open(os.path.join(archive, CLUSTER_MANIFEST)) as fh:
+            cm = json.load(fh)
+        assert cm["missing_hosts"] == ["hung"]
+        assert cm["partials"]["hung"]["trips"] == 1
+        with open(os.path.join(archive, "hosts", "hung",
+                               "partial.json")) as fh:
+            saved = json.load(fh)
+        assert saved["liveness"]["step"] == 7
+        from deepspeed_tpu.telemetry import cli as tcli
+
+        assert tcli.main(["summary", archive]) == 0
+    finally:
+        set_watchdog(None)
+        srv.shutdown()
+
+
 def test_publisher_pushes_trip_bundle_without_request(gang, tmp_path):
     """Event-driven publish: a local dump (watchdog trip / crash hook)
     is pushed on the next tick with NO operator request, so a later
