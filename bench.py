@@ -207,6 +207,34 @@ def measure(engine, batch, seq, vocab, steps, segments=3,
     return sorted(rates)[len(rates) // 2]
 
 
+def _perf_extras(engine) -> dict:
+    """Perf-sentinel fields for the BENCH line (telemetry/perf):
+    step-time p50 from the engine's own device-fenced StepRecords,
+    cumulative compile seconds from the compile tracker, and the run's
+    goodput fraction — the metrics `telemetry perf check` gates on."""
+    out: dict = {}
+    try:
+        recs = [r for r in getattr(engine, "step_records", [])
+                if r.device_fenced]
+        if recs:
+            times = sorted(r.step_time_ms for r in recs)
+            out["step_time_p50_ms"] = round(times[len(times) // 2], 2)
+        from deepspeed_tpu.telemetry.perf import (get_compile_tracker,
+                                                  get_goodput_ledger)
+
+        trk = get_compile_tracker()
+        if trk.enabled and trk.events_total:
+            out["compile_time_s"] = round(trk.time_ms_total / 1e3, 3)
+            out["compile_events"] = trk.events_total
+            out["recompile_events"] = trk.recompiles_total
+        gp = get_goodput_ledger()
+        if gp.enabled and gp.total_seconds() > 0:
+            out["goodput"] = round(gp.goodput(), 4)
+    except Exception as e:
+        out["perf_extras_error"] = str(e)[:120]
+    return out
+
+
 def step_flops(engine, batch, seq, vocab, cfg) -> float:
     """MODEL FLOPs per step — the analytic 6N + attention formula (the MFU
     convention: remat recompute and optimizer math don't count, so neither
@@ -923,7 +951,7 @@ def _main() -> None:
         print(json.dumps({
             "metric": "llama_tiny_cpu_train_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec/chip",
-            "vs_baseline": 1.0}))
+            "vs_baseline": 1.0, **_perf_extras(engine)}))
         return
 
     _mark("selfcheck")
@@ -950,6 +978,7 @@ def _main() -> None:
     mfu = (flops * tps / (batch * seq)) / peak
     extras["mfu"] = round(mfu, 4)
     extras["device_kind"] = jax.devices()[0].device_kind
+    extras.update(_perf_extras(engine))
     del engine
     free_hbm()  # engine sits in a jit-closure reference cycle
 
@@ -1529,14 +1558,23 @@ def _main() -> None:
     except Exception:
         pass
 
-    # history file for local tracking (the cross-round ratio uses R01)
+    # perf baseline for local tracking + the regression sentinel (the
+    # cross-round ratio uses R01; `python -m deepspeed_tpu.telemetry
+    # perf check --baseline .bench_baseline.json` gates later runs)
     hist = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         ".bench_baseline.json")
     try:
-        with open(hist, "w") as f:
-            json.dump({"tokens_per_sec": tps, "mfu": extras["mfu"]}, f)
+        from deepspeed_tpu.telemetry.perf import save_baseline
+
+        save_baseline(hist, {"metric": "llama_110m_train_tokens_per_sec",
+                             "value": tps, **extras},
+                      source="bench.py headline")
     except Exception:
-        pass
+        try:  # the sentinel must never cost the bench its artifact line
+            with open(hist, "w") as f:
+                json.dump({"tokens_per_sec": tps, "mfu": extras["mfu"]}, f)
+        except Exception:
+            pass
 
     print(json.dumps({
         "metric": "llama_110m_train_tokens_per_sec",

@@ -130,8 +130,10 @@ class CommsLogger:
         if led is not None and getattr(led, "exec_feed", False):
             # opt-in: execution probes fire from UNORDERED device
             # callbacks, so their interleaving is not comparable across
-            # ranks — only useful for per-host sequence forensics
-            led.record(name, nbytes, source="exec")
+            # ranks — they land in the ledger's separate EXEC lane
+            # (per-host sequence forensics), never in the census chain
+            # the live desync detection hashes
+            led.record_exec(name, nbytes, source="exec_probe")
         if not (self.enabled and self.exec_counts):
             return
         with self._exec_lock:

@@ -432,6 +432,19 @@ class ElasticRendezvous:
             tel.set_gauge(
                 "elastic/straggler_ewma_ratio", stats["ewma_ratio"],
                 help="slowest host step-time EWMA over the median host's")
+        # per-host rolling goodput rides the same payload
+        # (telemetry/perf/goodput.py): publish the cluster view — the
+        # worst host bounds the gang (every collective waits for it)
+        gps = [float(i["goodput"]) for i in infos
+               if isinstance(i, dict) and i.get("goodput") is not None]
+        if gps:
+            stats["goodput_min"] = min(gps)
+            stats["goodput_mean"] = sum(gps) / len(gps)
+            tel.set_gauge("elastic/cluster_goodput_min", stats["goodput_min"],
+                          help="worst per-host rolling goodput fraction")
+            tel.set_gauge("elastic/cluster_goodput_mean",
+                          stats["goodput_mean"],
+                          help="mean per-host rolling goodput fraction")
         return stats
 
     def buddy(self) -> Optional[str]:

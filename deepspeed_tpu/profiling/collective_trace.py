@@ -38,16 +38,23 @@ COLLECTIVE_PATTERNS = (
 )
 
 
-def parse_trace(trace_dir: str,
-                patterns: Sequence[str] = COLLECTIVE_PATTERNS
-                ) -> Dict[str, Dict[str, float]]:
-    """Aggregate collective op durations from a ``jax.profiler.trace``
-    output dir → ``{op_name: {count, total_us, mean_us}}``.  Only events
-    on device/XLA lanes count — host Python frames are excluded."""
+def parse_trace_events(trace_dir: str,
+                       patterns: Sequence[str] = COLLECTIVE_PATTERNS
+                       ) -> list:
+    """Individual collective op events from a ``jax.profiler.trace``
+    output dir, in device-timestamp order →
+    ``[{ts_us, dur_us, name, lane}, ...]``.  Only events on device/XLA
+    lanes count — host Python frames are excluded.
+
+    The ordering is what makes this the EXECUTION-order source: within
+    one device lane, XLA runs a compiled program's thunks in a
+    deterministic sequence, so two ranks executing the same SPMD
+    program see the same collective order here — unlike the
+    ``comms_logger`` execution probes, whose host callbacks interleave
+    arbitrarily across device shards."""
     files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                       recursive=True)
-    durs: Dict[str, float] = collections.defaultdict(float)
-    counts: collections.Counter = collections.Counter()
+    out = []
     for fp in files:
         with gzip.open(fp) as f:
             tr = json.load(f)
@@ -69,11 +76,68 @@ def parse_trace(trace_dir: str,
             if low.startswith("end:"):
                 continue  # CPU tracer emits paired end markers
             if any(p in low for p in patterns):
-                durs[name] += float(e.get("dur", 0.0))
-                counts[name] += 1
+                out.append({"ts_us": float(e.get("ts", 0.0)),
+                            "dur_us": float(e.get("dur", 0.0)),
+                            "name": name, "lane": lane})
+    out.sort(key=lambda ev: (ev["ts_us"], ev["name"]))
+    return out
+
+
+def parse_trace(trace_dir: str,
+                patterns: Sequence[str] = COLLECTIVE_PATTERNS
+                ) -> Dict[str, Dict[str, float]]:
+    """Aggregate collective op durations from a ``jax.profiler.trace``
+    output dir → ``{op_name: {count, total_us, mean_us}}``.  Only events
+    on device/XLA lanes count — host Python frames are excluded."""
+    durs: Dict[str, float] = collections.defaultdict(float)
+    counts: collections.Counter = collections.Counter()
+    for ev in parse_trace_events(trace_dir, patterns):
+        durs[ev["name"]] += ev["dur_us"]
+        counts[ev["name"]] += 1
     return {n: {"count": float(counts[n]), "total_us": round(durs[n], 1),
                 "mean_us": round(durs[n] / max(counts[n], 1), 2)}
             for n in durs}
+
+
+def feed_exec_census(trace_dir: str, ledger: Optional[Any] = None,
+                     patterns: Sequence[str] = COLLECTIVE_PATTERNS,
+                     dedupe_lanes: bool = True) -> int:
+    """Opt-in execution-order census (ROADMAP item): replay a profiler
+    trace's device-lane collective events, in timestamp order, into the
+    :class:`~..telemetry.collective_ledger.CollectiveLedger` EXEC lane.
+
+    The exec chain hashes only op identity (timings differ across ranks
+    by nature), so two ranks that ran the same compiled program under
+    the profiler agree on ``exec_tail_hash`` — this lane IS cross-rank
+    comparable, unlike the unordered ``record_exec`` probe feed.  With
+    ``dedupe_lanes`` (default) only the first device lane is replayed:
+    in a single-process multi-device mesh every shard's lane shows the
+    same program, and feeding all of them would count each collective
+    ``local_device_count`` times.  Returns the number of entries fed.
+    """
+    if ledger is None:
+        from ..telemetry.collective_ledger import get_collective_ledger
+
+        ledger = get_collective_ledger()
+    if not ledger.enabled:
+        # calling the census IS the opt-in: an offline post-mortem
+        # process never ran telemetry config, and a disabled ledger
+        # would silently swallow every record_exec while this function
+        # still reported N entries fed
+        ledger.configure(enabled=True)
+    events = parse_trace_events(trace_dir, patterns)
+    if not events:
+        logger.warning(
+            "feed_exec_census: no device collective events in the trace "
+            "(remote/tunneled chips may not export device lanes)")
+        return 0
+    if dedupe_lanes:
+        first_lane = events[0]["lane"]
+        events = [ev for ev in events if ev["lane"] == first_lane]
+    for ev in events:
+        ledger.record_exec(ev["name"], 0, dur_us=ev["dur_us"],
+                           ts_us=ev["ts_us"], source="exec_trace")
+    return len(events)
 
 
 def profile_collectives(fn: Callable[..., Any], *args,

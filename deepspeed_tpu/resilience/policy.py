@@ -150,6 +150,7 @@ class RecoveryPolicy:
         valid tier-1 dir → tier-2 buddy replica."""
         eng = self.engine
         failed_step = eng.global_steps
+        t_rollback0 = self._clock()
         self.state = ST_RECOVERING
         if self._unproven_restore:
             # the snapshot restored by the PREVIOUS rollback failed
@@ -184,6 +185,7 @@ class RecoveryPolicy:
         self._counter("resilience/steps_skipped_total",
                       "training steps lost to rollbacks (the skipped "
                       "data window)", v=max(skipped, 0))
+        self._charge_goodput_recovery(failed_step, skipped, t_rollback0)
         self._annotate("resilience_rollback", {
             "trigger": kind, "detail": detail, "failed_step": failed_step,
             "restored_step": eng.global_steps,
@@ -193,6 +195,41 @@ class RecoveryPolicy:
             f"step {eng.global_steps}; data window "
             f"({eng.global_steps + 1}..{failed_step}) skipped")
         self.state = ST_RUNNING
+
+    def _charge_goodput_recovery(self, failed_step: int, skipped: int,
+                                 t_rollback0: float) -> None:
+        """Account the rollback in the goodput ledger (telemetry/perf):
+        the rollback/backoff wall time goes to the ``recovery`` bucket,
+        and the skipped window's step time — charged ``productive`` as
+        those steps ran — is RECLASSIFIED to ``recovery``: the rollback
+        just proved that work was lost."""
+        try:
+            from ..telemetry.perf import get_goodput_ledger
+
+            gp = get_goodput_ledger()
+            if not gp.enabled:
+                return
+            gp.add("recovery", max(self._clock() - t_rollback0, 0.0))
+            lost_prod_s = lost_compile_s = 0.0
+            records = getattr(self.engine, "step_records", None) or []
+            window = {failed_step - i for i in range(max(skipped, 0))}
+            for rec in records:
+                if rec.step not in window:
+                    continue
+                # split like add_step did: the compile share of a lost
+                # step was charged "compile", not "productive" — each
+                # bucket gives back exactly what it was credited
+                step_s = float(rec.step_time_ms) / 1e3
+                comp_s = min(float(rec.extra.get("compile_ms", 0.0) or 0.0)
+                             / 1e3, step_s)
+                lost_compile_s += comp_s
+                lost_prod_s += step_s - comp_s
+            if lost_prod_s > 0.0:
+                gp.reclassify("productive", "recovery", lost_prod_s)
+            if lost_compile_s > 0.0:
+                gp.reclassify("compile", "recovery", lost_compile_s)
+        except Exception as e:
+            logger.debug(f"resilience: goodput accounting failed: {e!r}")
 
     def _best_snapshot(self) -> tuple:
         """Newest restorable snapshot across tiers, as ``(snap,

@@ -170,7 +170,11 @@ class SnapshotManager:
         self.snapshots_taken += 1
         dt_ms = (self._clock() - t0) * 1e3
         from ..telemetry import get_telemetry
+        from ..telemetry.perf import get_goodput_ledger
 
+        # the device→host capture blocks the step loop: checkpoint time
+        # in the goodput account (the async flush that follows does not)
+        get_goodput_ledger().add("checkpoint", dt_ms / 1e3)
         tel = get_telemetry()
         tel.inc_counter("resilience/snapshots_total",
                         help="tier-0 training-state snapshots taken")

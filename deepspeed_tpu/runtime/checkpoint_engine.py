@@ -19,6 +19,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -169,12 +170,28 @@ class CheckpointEngine:
         pass
 
 
+def _charge_checkpoint_goodput(seconds: float) -> None:
+    """Feed blocking checkpoint time into the goodput ledger
+    (telemetry/perf) — MAIN-thread saves only: a background flush
+    (async snapshot worker, watchdog emergency writer) overlaps the
+    step loop and charging it would double-count wall time."""
+    try:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        from ..telemetry.perf import get_goodput_ledger
+
+        get_goodput_ledger().add("checkpoint", max(seconds, 0.0))
+    except Exception:
+        pass
+
+
 class TorchCheckpointEngine(CheckpointEngine):
     """Synchronous save (reference name kept for config parity; the
     serialization is orbax, not torch)."""
 
     def save(self, state_tree: Any, path: str,
              commit_fn: Optional[Any] = None) -> None:
+        t0 = time.perf_counter()
         with ocp.StandardCheckpointer() as saver:
             saver.save(path, state_tree, force=True)
         # integrity sidecar BEFORE the durability marker: a manifest's
@@ -184,6 +201,7 @@ class TorchCheckpointEngine(CheckpointEngine):
             write_sidecar_manifest(path)
         if commit_fn is not None:
             commit_fn()
+        _charge_checkpoint_goodput(time.perf_counter() - t0)
 
     def load(self, path: str, target: Any = None,
              map_location: Any = None) -> Any:
@@ -265,9 +283,13 @@ class DecoupledCheckpointEngine(CheckpointEngine):
 
     def save(self, state_tree: Any, path: str,
              commit_fn: Optional[Any] = None) -> None:
+        t0 = time.perf_counter()
         self.wait()
         self._ckptr.save(path, args=ocp.args.StandardSave(state_tree),
                          force=True)
+        # only the BLOCKING part (join previous + device→host snapshot)
+        # counts as checkpoint time; the storage write overlaps training
+        _charge_checkpoint_goodput(time.perf_counter() - t0)
         self._pending = path
         self._pending_commit = commit_fn
         import weakref

@@ -196,6 +196,13 @@ class TelemetryHealthConfig(DeepSpeedConfigModel):
     loss_scale_floor: float = 1.0
     consecutive_scale_drops: int = 3
     throughput_frac: float = 0.5
+    #: steps whose compile_ms >= frac * step_time are compile-dominated:
+    #: excluded from the throughput-regression window (and from the
+    #: watchdog step-time EWMA)
+    compile_dominated_frac: float = 0.5
+    #: recompile events within `window` steps that raise a
+    #: recompile_storm health event; <= 0 disables the rule
+    recompile_storm_threshold: int = 3
 
 
 class FlightRecorderConfig(DeepSpeedConfigModel):
@@ -239,6 +246,30 @@ class TelemetryAggregationConfig(DeepSpeedConfigModel):
     ledger_max_entries: int = 4096
     #: ledger entries embedded in each debug bundle (comparison window)
     ledger_tail: int = 64
+    #: also feed the ledger's EXEC lane from execution probes
+    #: (comms_logger.record_exec).  Off by default: device callbacks are
+    #: unordered, so the exec chain is per-host forensics only — the
+    #: trace-sourced census (profiling.collective_trace.feed_exec_census)
+    #: is the cross-rank-comparable execution-order source
+    ledger_exec_feed: bool = False
+
+
+class TelemetryPerfConfig(DeepSpeedConfigModel):
+    """``telemetry.perf`` — the performance observability plane
+    (``telemetry/perf/``): compile/recompile tracking over every engine
+    jit site, the goodput wall-clock ledger, and the perf-regression
+    sentinel's knobs.  Active when ``telemetry.enabled`` is on."""
+
+    enabled: bool = True
+    #: tracked_jit at every engine jit site: compile events, recompile
+    #: cause diffs, per-site program table in debug bundles
+    compile_tracker: bool = True
+    compile_max_events: int = 512
+    #: classify step-loop wall time into productive/compile/stall/
+    #: recovery/checkpoint buckets; rolling goodput rides heartbeats
+    goodput: bool = True
+    #: rolling-goodput window (seconds) for the heartbeat fraction
+    goodput_window_s: float = 600.0
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -275,6 +306,7 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=FlightRecorderConfig)
     aggregation: TelemetryAggregationConfig = Field(
         default_factory=TelemetryAggregationConfig)
+    perf: TelemetryPerfConfig = Field(default_factory=TelemetryPerfConfig)
 
 
 class ResilienceConfig(DeepSpeedConfigModel):

@@ -369,6 +369,7 @@ class DeepSpeedEngine:
             self.collective_ledger = configure_collective_ledger(
                 max_entries=agg_cfg.ledger_max_entries,
                 tail=agg_cfg.ledger_tail,
+                exec_feed=agg_cfg.ledger_exec_feed,
                 recorder=self.flight_recorder)
         if h_cfg.enabled and self._telemetry_steps:
             from ..telemetry import HealthMonitor
@@ -380,9 +381,33 @@ class DeepSpeedEngine:
                 loss_scale_floor=h_cfg.loss_scale_floor,
                 consecutive_scale_drops=h_cfg.consecutive_scale_drops,
                 throughput_frac=h_cfg.throughput_frac,
+                compile_dominated_frac=h_cfg.compile_dominated_frac,
+                recompile_storm_threshold=h_cfg.recompile_storm_threshold,
                 registry=(self.telemetry.registry if self.telemetry.enabled
                           else None),
                 recorder=self.flight_recorder)
+
+        # --- performance observability plane (telemetry/perf — ISSUE 5) --
+        # compile/recompile tracking over every engine jit site + the
+        # goodput wall-clock ledger.  Configured BEFORE _init_state so
+        # the build-time programs (optimizer init, bf16 wire cast, 1-bit
+        # residuals) are in the compile table too.
+        self.compile_tracker = None
+        self.goodput = None
+        self._compile_dominated_frac = float(h_cfg.compile_dominated_frac)
+        pcfg = tcfg.perf
+        if pcfg.enabled and tcfg.enabled:
+            from ..telemetry.perf import (configure_compile_tracker,
+                                          configure_goodput_ledger)
+
+            if pcfg.compile_tracker:
+                self.compile_tracker = configure_compile_tracker(
+                    enabled=True, max_events=pcfg.compile_max_events,
+                    recorder=self.flight_recorder)
+            if pcfg.goodput:
+                self.goodput = configure_goodput_ledger(
+                    enabled=True, window_s=pcfg.goodput_window_s,
+                    recorder=self.flight_recorder)
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -491,6 +516,19 @@ class DeepSpeedEngine:
     # state construction
     # ------------------------------------------------------------------
 
+    def _jit(self, fn, site: str, static_context=None, **jit_kwargs):
+        """``jax.jit`` through the compile tracker (telemetry/perf):
+        every engine program gets a compile event with lower/compile
+        timing, and a recompile of the same site records a structured
+        cause diff.  ``static_context`` names the closure-baked statics
+        (gas, 1-bit warmup flag, LTD keep bucket) so a recompile caused
+        by one of THOSE is named, not just 'signature changed'.  With
+        the tracker off this IS ``jax.jit``."""
+        from ..telemetry.perf import tracked_jit
+
+        return tracked_jit(fn, site=site, tracker=self.compile_tracker,
+                           static_context=static_context, **jit_kwargs)
+
     def _init_state(self, params: Any) -> TrainState:
         if self._infinity_requested:
             # ZeRO-Infinity: trunk params NEVER touch the device whole —
@@ -541,14 +579,15 @@ class DeepSpeedEngine:
                 # bf16 wire: the device copy lives in bf16 (fp32 masters are
                 # host-side) — halves HBM and h2d bytes, same compute as the
                 # on-device bf16 path which casts fp32→bf16 every step
-                params = jax.jit(lambda t: cast_tree(t, jnp.bfloat16),
-                                 out_shardings=param_shardings)(params)
+                params = self._jit(lambda t: cast_tree(t, jnp.bfloat16),
+                                   "engine/bf16_wire_cast",
+                                   out_shardings=param_shardings)(params)
         else:
             opt_shapes = jax.eval_shape(self.optimizer.init, params)
             opt_shardings = self.policy.opt_state_shardings(
                 opt_shapes, tx=self.optimizer, base_specs=self.base_specs)
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=opt_shardings)(params)
+            opt_state = self._jit(self.optimizer.init, "engine/opt_init",
+                                  out_shardings=opt_shardings)(params)
 
         scale_state = (self.loss_scaler.init_state() if self.loss_scaler
                        else LossScaleState(jnp.float32(1.0), jnp.int32(0),
@@ -564,8 +603,9 @@ class DeepSpeedEngine:
             res_shardings = jax.tree.map(
                 lambda _: NamedSharding(self.mesh, PartitionSpec(DP_AXES)),
                 params)
-            comm_state = jax.jit(
+            comm_state = self._jit(
                 lambda: init_residuals(params, dp_world),
+                "engine/onebit_residuals",
                 out_shardings=res_shardings)()
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.int32(0), loss_scale=scale_state,
@@ -1027,8 +1067,17 @@ class DeepSpeedEngine:
 
         state_shardings = self._state_shardings(self.state)
         batch_sharding = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
-        return jax.jit(
-            step_fn,
+        onebit_now = self.onebit_enabled if onebit is None else bool(onebit)
+        return self._jit(
+            step_fn, "engine/train_step",
+            # the documented recompile hazards, named so a recompile's
+            # cause diff says WHICH boundary was crossed: tail-batch gas,
+            # the 1-bit warmup edge, the active LTD keep bucket
+            static_context={
+                "gas": self.gradient_accumulation_steps,
+                "onebit": onebit_now,
+                "ltd_keep": getattr(self.module, "ltd_keep", None),
+            },
             in_shardings=(state_shardings, batch_sharding),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
@@ -1069,8 +1118,11 @@ class DeepSpeedEngine:
 
         state_shardings = self._state_shardings(self.state)
         batch_sharding = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
-        return jax.jit(grad_fn,
-                       in_shardings=(state_shardings, batch_sharding))
+        return self._jit(
+            grad_fn, "engine/grad_step",
+            static_context={"gas": self.gradient_accumulation_steps,
+                            "wire_bf16": wire_bf16},
+            in_shardings=(state_shardings, batch_sharding))
 
     def _offload_train_step(self, batch) -> Dict[str, Any]:
         if self._train_step_fn is None:
@@ -1166,6 +1218,15 @@ class DeepSpeedEngine:
             # (kill/stall/NaN-poison/corrupt-snapshot) before dispatch
             batch = self.fault_injector.apply(self.global_steps + 1, batch,
                                               engine=self)
+        trk = self.compile_tracker
+        if trk is not None:
+            # marks for per-step compile attribution: whatever the
+            # tracker records between here and the fence happened INSIDE
+            # this step's wall time
+            _c_ev0, _c_rc0 = trk.events_total, trk.recompiles_total
+            _c_ms0 = trk.time_ms_total
+        _stall0_s = (self.goodput.totals()["stall"]
+                     if self.goodput is not None else 0.0)
         with self.telemetry.span("engine/train_step",
                                  args={"step": self.global_steps}):
             metrics = self._dispatch_train_step(batch)
@@ -1179,6 +1240,26 @@ class DeepSpeedEngine:
             # DEVICE step time instead of host dispatch time
             float(metrics["loss"])
         step_time_s = time.perf_counter() - t_step0
+        compile_ms, compile_events, recompile_events = 0.0, 0, 0
+        if trk is not None:
+            compile_events = trk.events_total - _c_ev0
+            recompile_events = trk.recompiles_total - _c_rc0
+            compile_ms = trk.time_ms_total - _c_ms0
+        #: this step spent most of its wall time in XLA lower/compile —
+        #: excluded from the watchdog EWMA and the health throughput
+        #: window (a first-step or rebucketing compile must not skew
+        #: straggler ratios or trip a false throughput regression)
+        compile_dominated = (
+            compile_ms > 0.0
+            and compile_ms >= self._compile_dominated_frac
+            * step_time_s * 1e3)
+        if self.goodput is not None:
+            # any stall the watchdog charged DURING this step (a tripped
+            # hang that later unblocked) is already accounted — charge
+            # only the remainder, or the interval would count twice
+            stalled_s = self.goodput.totals()["stall"] - _stall0_s
+            self.goodput.add_step(max(step_time_s - stalled_s, 0.0),
+                                  compile_ms / 1e3)
         self.tput_timer.stop(sync=False)
         from ..utils import debug as _debug
 
@@ -1205,10 +1286,17 @@ class DeepSpeedEngine:
         self.lr_scheduler.last_step = self.global_steps
         self.last_metrics = metrics
         if self.watchdog is not None:
-            # a completed step IS progress (the daemon started at build)
-            self.watchdog.notify_progress(self.global_steps, step_time_s)
+            # a completed step IS progress (the daemon started at build);
+            # a compile-dominated step still notifies but contributes no
+            # EWMA sample — its time was the compiler's, not the step's
+            self.watchdog.notify_progress(
+                self.global_steps,
+                None if compile_dominated else step_time_s)
         if self._telemetry_steps:
-            self._record_step_telemetry(batch, metrics, step_time_s, fenced)
+            self._record_step_telemetry(
+                batch, metrics, step_time_s, fenced,
+                compile_ms=compile_ms, compile_events=compile_events,
+                recompile_events=recompile_events)
         rolled_back = False
         if self.resilience is not None:
             # recovery policy: a NaN'd loss / scale collapse rolls the
@@ -1256,7 +1344,10 @@ class DeepSpeedEngine:
         return metrics
 
     def _record_step_telemetry(self, batch, metrics: Dict[str, Any],
-                               step_time_s: float, fenced: bool) -> None:
+                               step_time_s: float, fenced: bool,
+                               compile_ms: float = 0.0,
+                               compile_events: int = 0,
+                               recompile_events: int = 0) -> None:
         """Assemble + publish this step's :class:`~..telemetry.StepRecord`
         (the numbers are device-true when ``fenced``; the float() pulls
         below force the same sync anyway)."""
@@ -1286,6 +1377,14 @@ class DeepSpeedEngine:
                 pass
         nan = float("nan")
         extra: Dict[str, Any] = {}
+        if compile_events or compile_ms:
+            # compile attribution (telemetry/perf): lets the health
+            # monitor exclude compile-dominated steps from the
+            # throughput window and operators see where step N's wall
+            # time actually went
+            extra["compile_ms"] = round(compile_ms, 3)
+            extra["compile_events"] = int(compile_events)
+            extra["recompile_events"] = int(recompile_events)
         if comms_logger.enabled and comms_logger.exec_counts:
             # THIS step's execution-probe activity: shard-normalized
             # cumulative totals (satellite: no more hand-dividing by
@@ -1365,7 +1464,7 @@ class DeepSpeedEngine:
                 p = cast_tree(params, dtype) if dtype != jnp.float32 else params
                 return self.loss_fn(p, b)
 
-            self._eval_loss_fn = jax.jit(fwd)
+            self._eval_loss_fn = self._jit(fwd, "engine/eval_loss")
         return self._eval_loss_fn(self.state.params, batch)
 
     # ------------------------------------------------------------------

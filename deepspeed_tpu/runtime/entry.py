@@ -169,6 +169,7 @@ def initialize(args: Any = None,
             configure_collective_ledger(
                 max_entries=cfg.telemetry.aggregation.ledger_max_entries,
                 tail=cfg.telemetry.aggregation.ledger_tail,
+                exec_feed=cfg.telemetry.aggregation.ledger_exec_feed,
                 recorder=recorder)
 
     # --- resolve the model into a loss_fn --------------------------------

@@ -39,6 +39,9 @@ from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       JSONLExporter, MetricsRegistry, escape_help,
                       escape_label_value, format_labels,
                       parse_prometheus_text, prom_name)
+from .perf import (CompileTracker, GoodputLedger, configure_compile_tracker,
+                   configure_goodput_ledger, get_compile_tracker,
+                   get_goodput_ledger, tracked_jit)
 from .step_record import (StepRecord, collect_memory_stats,
                           publish_step_record)
 from .tracer import NOOP_SPAN, SpanTracer, device_fence
@@ -59,6 +62,9 @@ __all__ = [
     "desync_from_heartbeats", "find_first_divergence",
     "format_divergence_report",
     "escape_help", "escape_label_value", "format_labels",
+    "CompileTracker", "configure_compile_tracker", "get_compile_tracker",
+    "tracked_jit", "GoodputLedger", "configure_goodput_ledger",
+    "get_goodput_ledger",
 ]
 
 

@@ -579,6 +579,8 @@ def build_cluster_manifest(archive: str,
         last = steps[-1] if steps else {}
         comm = m.get("comm") or {}
         led = (m.get("context") or {}).get("collective_ledger") or {}
+        goodput = (m.get("context") or {}).get("goodput") or {}
+        ct = (m.get("context") or {}).get("compile_programs") or {}
         hosts[node] = {
             "reason": m.get("reason"),
             "time_utc": m.get("time_utc"),
@@ -591,11 +593,19 @@ def build_cluster_manifest(archive: str,
             "comm_bytes": comm.get("total_bytes"),
             "ledger_seq": led.get("seq"),
             "ledger_tail_hash": led.get("tail_hash"),
+            # per-host wall-clock budget (telemetry/perf): where this
+            # host's time went, and how much of it was the compiler's
+            "goodput": goodput.get("goodput"),
+            "goodput_buckets_s": goodput.get("buckets_s"),
+            "compile_events": ct.get("events_total"),
+            "compile_time_ms": ct.get("time_ms_total"),
         }
         for op, e in (comm.get("summary") or {}).items():
             census.setdefault(op, {})[node] = float(e.get("count", 0))
     last_steps = [h["last_step"] for h in hosts.values()
                   if isinstance(h.get("last_step"), (int, float))]
+    goodputs = [float(h["goodput"]) for h in hosts.values()
+                if isinstance(h.get("goodput"), (int, float))]
     comm_delta = {
         op: {"per_host": by, "delta": max(by.values()) - min(by.values())}
         for op, by in sorted(census.items()) if len(by) >= 2}
@@ -608,6 +618,9 @@ def build_cluster_manifest(archive: str,
         "partials": partials or {},
         "step_skew": (max(last_steps) - min(last_steps)
                       if len(last_steps) >= 2 else 0),
+        "goodput_min": min(goodputs) if goodputs else None,
+        "goodput_mean": (sum(goodputs) / len(goodputs)
+                         if goodputs else None),
         "comm_census_delta": comm_delta,
         "heartbeat_ages": heartbeat_ages or {},
         "desync": desync,

@@ -13,6 +13,11 @@ The read side of the observability plane, for humans at 3am:
   archive's ledger tails; names the lagging rank and the first
   mismatched collective.  Exit code 3 when a desync is found (script-
   able), 0 when clean.
+* ``perf``     — the perf-regression sentinel (``telemetry/perf``):
+  ``perf show`` prints a run's sentinel metrics, ``perf baseline``
+  stores them, ``perf check`` compares a run against the stored
+  baseline and exits 3 on regression beyond tolerance — the gate that
+  turns BENCH_r*.json from a log into a trajectory.
 
 Every command works on plain directories — no store, no JAX device
 needed beyond what importing the package costs.
@@ -106,6 +111,32 @@ def _print_bundle_summary(bundle: str, last_n: int) -> None:
         print(f"  collective ledger: seq {led.get('seq')} "
               f"tail_hash {led.get('tail_hash')} "
               f"(tail of {len(led.get('tail') or [])})")
+        if led.get("exec_seq"):
+            print(f"  exec-order census: seq {led.get('exec_seq')} "
+                  f"tail_hash {led.get('exec_tail_hash')}")
+    gp = (m.get("context") or {}).get("goodput")
+    if isinstance(gp, dict):
+        buckets = gp.get("buckets_s") or {}
+        budget = "  ".join(f"{k}={v:.1f}s" for k, v in sorted(
+            buckets.items()) if v)
+        print(f"  goodput: {gp.get('goodput')} "
+              f"(rolling {gp.get('rolling_goodput')})"
+              + (f" — {budget}" if budget else ""))
+    ct = (m.get("context") or {}).get("compile_programs")
+    if isinstance(ct, dict):
+        print(f"  compiles: {ct.get('events_total')} events "
+              f"({ct.get('recompiles_total')} recompiles, "
+              f"{float(ct.get('time_ms_total') or 0) / 1e3:.1f}s)")
+        for site, progs in sorted((ct.get("sites") or {}).items()):
+            for p in progs:
+                if p.get("kind") != "recompile":
+                    continue
+                from .perf.compile_tracker import format_cause
+
+                causes = "; ".join(
+                    format_cause(c) for c in (p.get("causes") or [])[:3])
+                print(f"    RECOMPILE {site} #{p.get('program')}: "
+                      f"{causes or 'unknown cause'}")
     spans = _slowest_spans(bundle)
     if spans:
         print("  slowest spans:")
@@ -139,10 +170,15 @@ def _print_archive_summary(archive: str, last_n: int) -> int:
                   f"coll_seq {live.get('coll_seq')} — see "
                   f"hosts/{node}/partial.json")
     print(f"  step skew across hosts: {cm.get('step_skew')}")
+    if cm.get("goodput_min") is not None:
+        print(f"  cluster goodput: min {cm.get('goodput_min')} "
+              f"mean {round(cm.get('goodput_mean'), 4)}")
     for node, h in sorted((cm.get("hosts") or {}).items()):
+        gp = (f" goodput {h.get('goodput')}"
+              if h.get("goodput") is not None else "")
         print(f"  [{node}] step {h.get('last_step')} "
               f"ledger_seq {h.get('ledger_seq')} "
-              f"comm_ops {h.get('comm_ops')} — {h.get('reason')}")
+              f"comm_ops {h.get('comm_ops')}{gp} — {h.get('reason')}")
     deltas = cm.get("comm_census_delta") or {}
     skewed = {op: d for op, d in deltas.items() if d.get("delta")}
     if skewed:
@@ -278,6 +314,60 @@ def cmd_collect(args: argparse.Namespace) -> int:
 
 
 # ---------------------------------------------------------------------------
+# perf — the regression sentinel
+# ---------------------------------------------------------------------------
+
+def cmd_perf(args: argparse.Namespace) -> int:
+    from .perf import baseline as perfmod
+
+    try:
+        run = perfmod.load_run(args.run)
+    except (OSError, ValueError) as e:
+        return _fail(f"perf {args.perf_cmd}: {e}")
+    metrics = perfmod.extract_perf(run)
+
+    if args.perf_cmd == "show":
+        if not metrics:
+            return _fail(f"{args.run}: no sentinel metrics "
+                         f"({', '.join(perfmod.PERF_METRICS)})")
+        print(f"run: {args.run}")
+        for name in perfmod.PERF_METRICS:
+            if name in metrics:
+                print(f"  {name}: {metrics[name]:g}")
+        return 0
+
+    if args.perf_cmd == "baseline":
+        try:
+            doc = perfmod.save_baseline(args.out, run, source=args.run)
+        except ValueError as e:
+            return _fail(str(e))
+        print(f"baseline written: {args.out} "
+              f"({', '.join(sorted(doc['metrics']))})")
+        return 0
+
+    # check
+    try:
+        base = perfmod.load_baseline(args.baseline)
+    except OSError as e:
+        return _fail(f"perf check: cannot read baseline "
+                     f"{args.baseline} ({e}); run `perf baseline` first")
+    try:
+        tol = perfmod.parse_tolerances(args.tol)
+    except ValueError as e:
+        return _fail(str(e))
+    result = perfmod.check_regression(metrics, base, tolerances=tol)
+    print(perfmod.format_check_report(result))
+    if not result["compared"]:
+        return _fail("perf check: run and baseline share no metrics")
+    if result["regressions"]:
+        print(f"PERF REGRESSION: {len(result['regressions'])} metric(s) "
+              f"beyond tolerance vs {args.baseline}")
+        return 3
+    print("perf check passed")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
 
@@ -322,6 +412,31 @@ def build_parser() -> argparse.ArgumentParser:
                                       "(exit 3 when desync found)")
     y.add_argument("archive")
     y.set_defaults(fn=cmd_desync)
+
+    from .perf.baseline import DEFAULT_BASELINE
+
+    f = sub.add_parser("perf", help="perf-regression sentinel: show/"
+                                    "baseline/check bench runs "
+                                    "(check exits 3 on regression)")
+    fsub = f.add_subparsers(dest="perf_cmd", required=True)
+    fs = fsub.add_parser("show", help="print a run's sentinel metrics")
+    fs.add_argument("run", help="bench JSON line, BENCH_r*.json artifact, "
+                                "or saved baseline")
+    fs.set_defaults(fn=cmd_perf)
+    fb = fsub.add_parser("baseline", help="store a run as the baseline")
+    fb.add_argument("run")
+    fb.add_argument("--out", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    fb.set_defaults(fn=cmd_perf)
+    fc = fsub.add_parser("check", help="compare a run vs the baseline; "
+                                       "exit 3 on regression")
+    fc.add_argument("run")
+    fc.add_argument("--baseline", default=DEFAULT_BASELINE)
+    fc.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="override a tolerance, e.g. --tol mfu=0.05 "
+                         "(repeatable)")
+    fc.set_defaults(fn=cmd_perf)
     return p
 
 

@@ -9,9 +9,18 @@ record for this runtime:
 * :class:`CollectiveLedger` — a bounded ring of ``(seq, op, bytes)``
   entries fed by ``CommsLogger.record`` (call-site/census order, which
   is deterministic per host — identical programs issue identical
-  sequences) and, opt-in, by ``record_exec`` (execution probes fire from
-  unordered device callbacks, so their interleaving is NOT comparable
-  across ranks — off by default for exactly that reason).
+  sequences).
+* A separate **exec lane** (:meth:`CollectiveLedger.record_exec`) with
+  its own ring and hash chain, recording EXECUTION order.  Two feeds:
+  ``CommsLogger.record_exec`` probes (opt-in via ``exec_feed`` — device
+  callbacks are unordered across shards, so that feed is per-host
+  forensics only), and the trace-sourced census
+  (``profiling.collective_trace.feed_exec_census``) which replays a
+  profiler trace's device-lane collectives in timestamp order — device
+  execution order of one compiled SPMD program is deterministic, so the
+  trace-fed exec chain IS cross-rank comparable.  Keeping the lane
+  separate means exec entries can never fork the census chain that the
+  live desync detection hashes.
 * A **rolling tail hash**: each entry chains
   ``h = sha1(h_prev | "op:bytes")``, so two ranks that issued the same
   sequence agree on one short string.  ``heartbeat_summary()`` returns
@@ -69,20 +78,30 @@ class CollectiveLedger:
             maxlen=self.max_entries)
         self._seq = 0
         self._hash = GENESIS_HASH
+        #: execution-order lane: own ring + chain (see module docstring)
+        self._exec_entries: "collections.deque" = collections.deque(
+            maxlen=self.max_entries)
+        self._exec_seq = 0
+        self._exec_hash = GENESIS_HASH
         self._lock = threading.Lock()
 
     def configure(self, enabled: Optional[bool] = None,
                   max_entries: Optional[int] = None,
-                  tail: Optional[int] = None) -> "CollectiveLedger":
+                  tail: Optional[int] = None,
+                  exec_feed: Optional[bool] = None) -> "CollectiveLedger":
         with self._lock:
             if enabled is not None:
                 self.enabled = bool(enabled)
+            if exec_feed is not None:
+                self.exec_feed = bool(exec_feed)
             if tail:
                 self.tail_entries = int(tail)
             if max_entries and int(max_entries) != self.max_entries:
                 self.max_entries = int(max_entries)
                 self._entries = collections.deque(self._entries,
                                                   maxlen=self.max_entries)
+                self._exec_entries = collections.deque(
+                    self._exec_entries, maxlen=self.max_entries)
         return self
 
     def reset(self) -> None:
@@ -90,6 +109,9 @@ class CollectiveLedger:
             self._entries.clear()
             self._seq = 0
             self._hash = GENESIS_HASH
+            self._exec_entries.clear()
+            self._exec_seq = 0
+            self._exec_hash = GENESIS_HASH
 
     # -- recording (fed by CommsLogger.record / record_exec) ---------------
 
@@ -103,6 +125,29 @@ class CollectiveLedger:
             self._entries.append({"seq": self._seq, "op": op,
                                   "bytes": int(nbytes), "hash": self._hash,
                                   "src": source, "ts": time.time()})
+
+    def record_exec(self, op: str, nbytes: int = 0,
+                    dur_us: Optional[float] = None,
+                    ts_us: Optional[float] = None,
+                    source: str = "exec") -> None:
+        """Append to the EXEC lane (execution order).  The chain covers
+        only ``(op, bytes)`` — never timings, which legitimately differ
+        across ranks running the same program; two ranks that executed
+        the same collective sequence agree on one ``exec_tail_hash``."""
+        if not self.enabled:
+            return
+        sig = entry_signature(op, nbytes)
+        with self._lock:
+            self._exec_seq += 1
+            self._exec_hash = _chain(self._exec_hash, sig)
+            entry: Dict[str, Any] = {
+                "seq": self._exec_seq, "op": op, "bytes": int(nbytes),
+                "hash": self._exec_hash, "src": source}
+            if dur_us is not None:
+                entry["dur_us"] = round(float(dur_us), 3)
+            if ts_us is not None:
+                entry["ts_us"] = round(float(ts_us), 3)
+            self._exec_entries.append(entry)
 
     # -- read side ---------------------------------------------------------
 
@@ -127,14 +172,36 @@ class CollectiveLedger:
         n = self.tail_entries if n is None else int(n)
         return entries[-n:] if n > 0 else entries
 
+    @property
+    def exec_seq(self) -> int:
+        return self._exec_seq
+
+    @property
+    def exec_tail_hash(self) -> str:
+        return self._exec_hash
+
+    def exec_tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = list(self._exec_entries)
+        n = self.tail_entries if n is None else int(n)
+        return entries[-n:] if n > 0 else entries
+
     def snapshot(self) -> Dict[str, Any]:
         """The flight-recorder context-provider payload: landed in every
         bundle manifest under ``context["collective_ledger"]`` so the
-        cluster aggregator can run divergence analysis offline."""
+        cluster aggregator can run divergence analysis offline.  The
+        exec lane rides along when populated — an exec-order desync
+        check is :func:`find_first_divergence` over the exec tails."""
         with self._lock:
             entries = list(self._entries)[-self.tail_entries:]
-            return {"seq": self._seq, "tail_hash": self._hash,
-                    "tail": entries}
+            out = {"seq": self._seq, "tail_hash": self._hash,
+                   "tail": entries}
+            if self._exec_seq:
+                out["exec_seq"] = self._exec_seq
+                out["exec_tail_hash"] = self._exec_hash
+                out["exec_tail"] = list(
+                    self._exec_entries)[-self.tail_entries:]
+            return out
 
 
 # ---------------------------------------------------------------------------
@@ -300,13 +367,14 @@ def attach_collective_ledger(ledger: Optional[CollectiveLedger]) -> None:
 def configure_collective_ledger(enabled: bool = True,
                                 max_entries: Optional[int] = None,
                                 tail: Optional[int] = None,
+                                exec_feed: Optional[bool] = None,
                                 recorder: Any = None) -> CollectiveLedger:
     """Resolve config into the global ledger: enable it, hook it into the
     comms logger, and (when a flight recorder is given) register the
     snapshot as a bundle context provider so every future debug bundle
     carries this rank's ledger tail.  Idempotent."""
     led = _default.configure(enabled=enabled, max_entries=max_entries,
-                             tail=tail)
+                             tail=tail, exec_feed=exec_feed)
     attach_collective_ledger(led if enabled else None)
     if recorder is not None and enabled:
         recorder.register_context("collective_ledger", led.snapshot)

@@ -9,6 +9,15 @@ per-step record stream into structured :class:`HealthEvent`\\ s:
 * ``loss_scale_collapse``    — fp16 scale at the floor or in free-fall
 * ``throughput_regression``  — tokens/sec vs rolling median (a silent
   straggler/thermal/backpressure signal the loss can't show)
+* ``recompile_storm``        — too many recompile events within the
+  window (a shape/dtype/static leak is re-tracing programs that should
+  be cached; every one stalls the step loop for a compile)
+
+Compile-dominated steps (``extra["compile_ms"]`` at or above
+``compile_dominated_frac`` of the step time — the CompileTracker's
+per-step attribution) are EXCLUDED from the throughput window: a
+first-step or rebucketing compile is expected cost, and letting it into
+the rolling median would trip a false ``throughput_regression``.
 
 Events are published everywhere an operator could be looking: counters +
 a last-event gauge in the metrics registry, a ``kind="health"`` JSONL
@@ -66,6 +75,8 @@ class HealthMonitor:
                  loss_scale_floor: float = 1.0,
                  consecutive_scale_drops: int = 3,
                  throughput_frac: float = 0.5,
+                 compile_dominated_frac: float = 0.5,
+                 recompile_storm_threshold: int = 3,
                  registry: Optional[Any] = None,
                  recorder: Optional[Any] = None):
         self.min_points = max(2, int(min_points))
@@ -74,6 +85,12 @@ class HealthMonitor:
         self.loss_scale_floor = float(loss_scale_floor)
         self.consecutive_scale_drops = int(consecutive_scale_drops)
         self.throughput_frac = float(throughput_frac)
+        #: a step whose compile_ms is at least this fraction of its step
+        #: time is compile-dominated: progress, but not throughput signal
+        self.compile_dominated_frac = float(compile_dominated_frac)
+        #: RECOMPILE events (not first compiles) within the window that
+        #: constitute a storm; <= 0 disables the rule
+        self.recompile_storm_threshold = int(recompile_storm_threshold)
         self.registry = registry
         self.recorder = recorder
         w = max(int(window), self.min_points)
@@ -81,6 +98,9 @@ class HealthMonitor:
         self._grad_norms: "collections.deque[float]" = collections.deque(
             maxlen=w)
         self._tps: "collections.deque[float]" = collections.deque(maxlen=w)
+        #: per-step recompile counts over the window (storm detector)
+        self._recompiles: "collections.deque[int]" = collections.deque(
+            maxlen=w)
         self._prev_scale: Optional[float] = None
         self._scale_drops = 0
         self._scale_collapsed = False  # fire the floor crossing once
@@ -101,6 +121,7 @@ class HealthMonitor:
         self._losses.clear()
         self._grad_norms.clear()
         self._tps.clear()
+        self._recompiles.clear()
         self._prev_scale = None
         self._scale_drops = 0
         self._scale_collapsed = False
@@ -190,11 +211,26 @@ class HealthMonitor:
                 f"(every recent step overflowed)",
                 scale, self.loss_scale_floor))
 
+    def _compile_dominated(self, rec: StepRecord) -> bool:
+        try:
+            compile_ms = float(rec.extra.get("compile_ms", 0.0) or 0.0)
+        except (AttributeError, TypeError, ValueError):
+            return False
+        step_ms = float(rec.step_time_ms)
+        return (compile_ms > 0.0 and step_ms > 0.0
+                and compile_ms >= self.compile_dominated_frac * step_ms)
+
     def _check_throughput(self, rec: StepRecord,
                           out: List[HealthEvent]) -> None:
         tps = float(rec.tokens_per_sec)
         if not (math.isfinite(tps) and tps > 0):
             return  # async records carry no rates
+        if self._compile_dominated(rec):
+            # the step spent its time in XLA lower/compile, not in the
+            # program: real progress (the watchdog agrees), but neither a
+            # regression to alert on nor a baseline sample to keep —
+            # StepRecord.extra["compile_ms"] carries the attribution
+            return
         if len(self._tps) >= self.min_points:
             med = _median(list(self._tps))
             if med > 0 and tps < self.throughput_frac * med:
@@ -208,6 +244,28 @@ class HealthMonitor:
         # of alerting forever
         self._tps.append(tps)
 
+    def _check_recompile_storm(self, rec: StepRecord,
+                               out: List[HealthEvent]) -> None:
+        if self.recompile_storm_threshold <= 0:
+            return
+        try:
+            n = int(rec.extra.get("recompile_events", 0) or 0)
+        except (AttributeError, TypeError, ValueError):
+            n = 0
+        self._recompiles.append(n)
+        storm = sum(self._recompiles)
+        if storm >= self.recompile_storm_threshold:
+            out.append(HealthEvent(
+                "recompile_storm", SEV_WARNING, rec.step,
+                f"step {rec.step}: {storm} recompiles within the last "
+                f"{len(self._recompiles)} steps — a shape/dtype/static "
+                f"leak is re-tracing programs that should be cached "
+                f"(see context.compile_programs in the debug bundle)",
+                float(storm), float(self.recompile_storm_threshold)))
+            # one storm, one event: restart the count so a persistent
+            # leak re-alerts per window instead of on every step
+            self._recompiles.clear()
+
     # -- the feed ----------------------------------------------------------
 
     def observe(self, rec: StepRecord) -> List[HealthEvent]:
@@ -217,6 +275,7 @@ class HealthMonitor:
             self._check_grad_norm(rec, out)
             self._check_loss_scale(rec, out)
         self._check_throughput(rec, out)
+        self._check_recompile_storm(rec, out)
         for ev in out:
             self._publish(ev)
         return out

@@ -1,0 +1,36 @@
+"""Performance observability plane (ISSUE 5).
+
+Three cooperating pieces that turn "it trains" observability into "it
+trains at the speed the hardware allows" observability:
+
+* :mod:`.compile_tracker` — ``tracked_jit`` at every engine jit site:
+  per-program compile events with structured recompile-cause diffs,
+  compile counters/gauges, a per-site program table in debug bundles.
+* :mod:`.goodput` — the wall-clock account: productive / compile /
+  stall / recovery / checkpoint buckets fed by the engine, the
+  resilience policy, the watchdog, and the checkpoint engine; the
+  rolling fraction rides watchdog heartbeats cluster-wide.
+* :mod:`.baseline` — the perf-regression sentinel behind
+  ``python -m deepspeed_tpu.telemetry perf {show,baseline,check}``
+  (exit 3 on regression vs the stored baseline).
+"""
+
+from .baseline import (ABS_FLOORS, DEFAULT_BASELINE, PERF_METRICS,
+                       check_regression, extract_perf, format_check_report,
+                       load_baseline, load_run, parse_tolerances,
+                       save_baseline)
+from .compile_tracker import (CompileEvent, CompileTracker,
+                              configure_compile_tracker, diff_signatures,
+                              get_compile_tracker, signature_of, tracked_jit)
+from .goodput import (BUCKETS, GoodputLedger, configure_goodput_ledger,
+                      get_goodput_ledger)
+
+__all__ = [
+    "CompileEvent", "CompileTracker", "configure_compile_tracker",
+    "get_compile_tracker", "tracked_jit", "signature_of", "diff_signatures",
+    "GoodputLedger", "configure_goodput_ledger", "get_goodput_ledger",
+    "BUCKETS",
+    "PERF_METRICS", "ABS_FLOORS", "DEFAULT_BASELINE", "load_run",
+    "extract_perf", "save_baseline", "load_baseline", "check_regression",
+    "format_check_report", "parse_tolerances",
+]

@@ -1,0 +1,184 @@
+"""Perf-regression sentinel — baseline persistence + tolerance check.
+
+``BENCH_r*.json`` has been a *log*: every round appends a number, nobody
+is forced to look when it drifts down.  This module makes it a *gated
+trajectory*: ``bench.py`` persists a perf baseline (step-time p50, MFU,
+compile seconds, goodput, tokens/sec) and
+``python -m deepspeed_tpu.telemetry perf {show,baseline,check}``
+compares any later run against it, exiting **3** on regression beyond
+configurable tolerances — the same scriptable-exit-code contract as the
+``desync`` command.
+
+A *run file* is a bench JSON line (the object ``bench.py`` prints), a
+driver ``BENCH_r*.json`` artifact (the same object under ``"parsed"``),
+or a previously saved baseline file — all three carry the same metric
+keys at top level or under ``metrics``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: metric -> (direction, default relative tolerance).  "higher" means
+#: higher is better (a drop beyond tol regresses); "lower" the reverse.
+PERF_METRICS: Dict[str, Tuple[str, float]] = {
+    "tokens_per_sec": ("higher", 0.10),
+    "mfu": ("higher", 0.10),
+    "goodput": ("higher", 0.05),
+    "step_time_p50_ms": ("lower", 0.10),
+    "compile_time_s": ("lower", 0.25),
+}
+
+#: ignore regressions on metrics whose baseline is this close to zero —
+#: a 0.001s compile baseline must not flag a 0.002s run
+ABS_FLOORS: Dict[str, float] = {
+    "compile_time_s": 1.0,
+    "step_time_p50_ms": 1.0,
+}
+
+DEFAULT_BASELINE = "PERF_BASELINE.json"
+
+
+def load_run(path: str) -> Dict[str, Any]:
+    """Load a run file and normalize to a flat dict of values."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]  # driver BENCH_r*.json artifact
+    if isinstance(data, dict) and isinstance(data.get("metrics"), dict):
+        merged = dict(data)
+        merged.update(data["metrics"])  # saved baseline file
+        data = merged
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return data
+
+
+def extract_perf(run: Dict[str, Any]) -> Dict[str, float]:
+    """Pull the sentinel metrics out of a normalized run dict.  The
+    bench headline value doubles as tokens_per_sec when the metric name
+    says so."""
+    out: Dict[str, float] = {}
+    metric = str(run.get("metric", ""))
+    if "tokens_per_sec" in metric and "value" in run:
+        try:
+            v = float(run["value"])
+            if v > 0:
+                out["tokens_per_sec"] = v
+        except (TypeError, ValueError):
+            pass
+    for name in PERF_METRICS:
+        if name in run:
+            try:
+                out[name] = float(run[name])
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def save_baseline(path: str, run: Dict[str, Any],
+                  source: str = "") -> Dict[str, Any]:
+    metrics = extract_perf(run)
+    if not metrics:
+        raise ValueError(
+            "run carries none of the sentinel metrics "
+            f"({', '.join(PERF_METRICS)}) — nothing to baseline")
+    doc = {"created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "source": source, "metrics": metrics}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2)
+    os.replace(tmp, path)  # atomic: a concurrent check never sees a torn file
+    return doc
+
+
+def load_baseline(path: str) -> Dict[str, float]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    metrics = doc.get("metrics", doc)
+    return {k: float(v) for k, v in metrics.items() if k in PERF_METRICS}
+
+
+def check_regression(current: Dict[str, float], baseline: Dict[str, float],
+                     tolerances: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
+    """Compare run vs baseline metric-by-metric.
+
+    Returns ``{regressions: [...], improvements: [...], compared: [...],
+    skipped: [...]}`` — a metric present in only one side is *skipped*
+    (named, never silently dropped), so adding a new bench field does
+    not fail every old baseline."""
+    tolerances = tolerances or {}
+    out: Dict[str, Any] = {"regressions": [], "improvements": [],
+                           "compared": [], "skipped": []}
+    for name, (direction, default_tol) in PERF_METRICS.items():
+        if name not in current or name not in baseline:
+            if name in current or name in baseline:
+                out["skipped"].append(name)
+            continue
+        cur, base = current[name], baseline[name]
+        tol = float(tolerances.get(name, default_tol))
+        floor = ABS_FLOORS.get(name, 0.0)
+        entry = {"metric": name, "current": cur, "baseline": base,
+                 "tolerance": tol, "direction": direction}
+        out["compared"].append(name)
+        if direction == "higher":
+            limit = base * (1.0 - tol)
+            entry["limit"] = limit
+            if cur < limit:
+                entry["delta_frac"] = (cur - base) / base if base else 0.0
+                out["regressions"].append(entry)
+            elif cur > base:
+                out["improvements"].append(entry)
+        else:
+            limit = base * (1.0 + tol)
+            entry["limit"] = limit
+            if cur > limit and cur - base > floor:
+                entry["delta_frac"] = (cur - base) / base if base else 0.0
+                out["regressions"].append(entry)
+            elif cur < base:
+                out["improvements"].append(entry)
+    return out
+
+
+def format_check_report(result: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    for r in result["regressions"]:
+        arrow = "dropped" if r["direction"] == "higher" else "grew"
+        lines.append(
+            f"REGRESSION {r['metric']}: {r['baseline']:g} -> "
+            f"{r['current']:g} ({arrow} {abs(r['delta_frac']):.1%}, "
+            f"tolerance {r['tolerance']:.0%})")
+    for r in result["improvements"]:
+        lines.append(f"improved {r['metric']}: {r['baseline']:g} -> "
+                     f"{r['current']:g}")
+    ok = [m for m in result["compared"]
+          if m not in {r["metric"] for r in result["regressions"]}
+          and m not in {r["metric"] for r in result["improvements"]}]
+    if ok:
+        lines.append(f"within tolerance: {', '.join(ok)}")
+    if result["skipped"]:
+        lines.append("not comparable (present on one side only): "
+                     + ", ".join(result["skipped"]))
+    if not result["compared"]:
+        lines.append("no overlapping metrics between run and baseline")
+    return "\n".join(lines)
+
+
+def parse_tolerances(specs: List[str]) -> Dict[str, float]:
+    """``["mfu=0.05", "step_time_p50_ms=0.2"]`` → dict; unknown metric
+    names are an error (a typo must not silently widen nothing)."""
+    out: Dict[str, float] = {}
+    for spec in specs or []:
+        if "=" not in spec:
+            raise ValueError(f"--tol {spec!r}: expected metric=fraction")
+        name, _, frac = spec.partition("=")
+        name = name.strip()
+        if name not in PERF_METRICS:
+            raise ValueError(f"--tol {name!r}: unknown metric "
+                             f"(one of {', '.join(PERF_METRICS)})")
+        out[name] = float(frac)
+    return out

@@ -1,0 +1,184 @@
+"""Goodput ledger — where the wall-clock actually goes.
+
+MegaScale (arXiv:2402.15627) makes *goodput* — productive training time
+over total time — the headline SLO for large runs, because every other
+number (step time, MFU) silently excludes the time the run was NOT
+stepping: compiles, stalls, rollbacks, restarts, checkpoint flushes.
+PR 4 added rollbacks/restarts/skipped windows that consume real time no
+metric accounted for; this ledger is that account.
+
+Wall time is classified into buckets:
+
+* ``productive`` — step execution time net of compile (the engine feeds
+  ``step_time - compile_time`` per step);
+* ``compile``    — lower+compile wall time (from the CompileTracker);
+* ``stall``      — watchdog-detected no-progress intervals;
+* ``recovery``   — resilience rollback/backoff time PLUS the lost work
+  of the skipped data window (the policy reclassifies the failed
+  window's step time from ``productive`` to ``recovery`` — those steps
+  LOOKED productive until the rollback discarded them);
+* ``checkpoint`` — blocking checkpoint/snapshot save time (the async
+  engine only charges its blocking device→host capture).
+
+``goodput() = productive / total``; a rolling fraction over the last
+``window_s`` rides the watchdog ``heartbeat_payload`` so rank 0 can
+publish cluster-wide goodput and the cluster manifest shows per-host
+budgets.  Like every singleton in the telemetry stack it is cheap when
+disabled (one attribute read) and explicit instances are testable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+BUCKETS = ("productive", "compile", "stall", "recovery", "checkpoint")
+
+
+class GoodputLedger:
+    """Bucketed wall-clock account with a rolling window."""
+
+    def __init__(self, enabled: bool = False, window_s: float = 600.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.enabled = bool(enabled)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        #: (ts, bucket, seconds) ring for the rolling fraction;
+        #: reclassifications append a negative compensating entry
+        self._window: "collections.deque" = collections.deque(maxlen=4096)
+
+    def configure(self, enabled: Optional[bool] = None,
+                  window_s: Optional[float] = None) -> "GoodputLedger":
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if window_s:
+                self.window_s = float(window_s)
+        return self
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals = {b: 0.0 for b in BUCKETS}
+            self._window.clear()
+
+    # -- feeds -------------------------------------------------------------
+
+    def add(self, bucket: str, seconds: float) -> None:
+        if not self.enabled or seconds == 0.0:
+            return
+        if bucket not in self._totals:
+            raise ValueError(f"unknown goodput bucket {bucket!r} "
+                             f"(one of {BUCKETS})")
+        s = float(seconds)
+        with self._lock:
+            self._totals[bucket] += s
+            self._window.append((self._clock(), bucket, s))
+        self._publish()
+
+    def add_step(self, step_time_s: float, compile_s: float = 0.0) -> None:
+        """Engine feed: one optimizer step's wall time, compile share
+        split out (a compile-dominated first/rebucketed step must not
+        read as productive throughput)."""
+        compile_s = min(max(compile_s, 0.0), max(step_time_s, 0.0))
+        self.add("compile", compile_s)
+        self.add("productive", max(step_time_s - compile_s, 0.0))
+
+    def reclassify(self, src: str, dst: str, seconds: float) -> None:
+        """Move time between buckets after the fact — the rollback path:
+        the skipped window's steps were charged ``productive`` as they
+        ran, and the rollback proves that work was lost."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        with self._lock:
+            moved = min(float(seconds), max(self._totals.get(src, 0.0), 0.0))
+            self._totals[src] -= moved
+            self._totals[dst] = self._totals.get(dst, 0.0) + moved
+            now = self._clock()
+            self._window.append((now, src, -moved))
+            self._window.append((now, dst, moved))
+        self._publish()
+
+    # -- read side ---------------------------------------------------------
+
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return {b: round(max(v, 0.0), 6)
+                    for b, v in self._totals.items()}
+
+    def total_seconds(self) -> float:
+        with self._lock:
+            return sum(max(v, 0.0) for v in self._totals.values())
+
+    def goodput(self) -> float:
+        """Cumulative productive fraction; 1.0 when nothing is recorded
+        yet (an empty account is not a regression)."""
+        with self._lock:
+            total = sum(max(v, 0.0) for v in self._totals.values())
+            if total <= 0.0:
+                return 1.0
+            return max(self._totals["productive"], 0.0) / total
+
+    def rolling_goodput(self) -> float:
+        """Productive fraction over the last ``window_s`` seconds — the
+        number that rides heartbeats (a 3-day-old compile must not mask
+        a stall happening NOW)."""
+        cutoff = self._clock() - self.window_s
+        sums: Dict[str, float] = {}
+        with self._lock:
+            for ts, bucket, s in self._window:
+                if ts >= cutoff:
+                    sums[bucket] = sums.get(bucket, 0.0) + s
+        total = sum(max(v, 0.0) for v in sums.values())
+        if total <= 0.0:
+            return 1.0
+        return max(sums.get("productive", 0.0), 0.0) / total
+
+    def heartbeat_summary(self) -> Dict[str, float]:
+        return {"goodput": round(self.rolling_goodput(), 4),
+                "goodput_total": round(self.goodput(), 4)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Bundle context provider payload (→ cluster manifest per-host
+        budgets)."""
+        return {"buckets_s": self.totals(),
+                "goodput": round(self.goodput(), 4),
+                "rolling_goodput": round(self.rolling_goodput(), 4),
+                "window_s": self.window_s}
+
+    def _publish(self) -> None:
+        try:
+            from .. import get_telemetry
+
+            tel = get_telemetry()
+            if not tel.enabled:
+                return
+            for b, v in self.totals().items():
+                tel.set_gauge(f"goodput/{b}_seconds_total", v,
+                              help=f"wall seconds classified {b}")
+            tel.set_gauge("goodput/fraction", self.goodput(),
+                          help="productive / total wall time")
+        except Exception:
+            pass
+
+
+_default = GoodputLedger()
+
+
+def get_goodput_ledger() -> GoodputLedger:
+    return _default
+
+
+def configure_goodput_ledger(enabled: bool = True,
+                             window_s: Optional[float] = None,
+                             recorder: Any = None) -> GoodputLedger:
+    """Resolve config into the global ledger; with a flight recorder the
+    snapshot lands in every debug bundle (context ``goodput``), which is
+    how the cluster manifest learns per-host budgets."""
+    led = _default.configure(enabled=enabled, window_s=window_s)
+    if recorder is not None and enabled:
+        recorder.register_context("goodput", led.snapshot)
+    return led

@@ -113,6 +113,14 @@ class HangWatchdog:
         led = get_collective_ledger()
         if led.enabled:
             payload.update(led.heartbeat_summary())
+        from .perf.goodput import get_goodput_ledger
+
+        gp = get_goodput_ledger()
+        if gp.enabled:
+            # rolling goodput rides the heartbeat: rank 0 folds every
+            # host's fraction into cluster gauges
+            # (rendezvous.publish_straggler_stats)
+            payload.update(gp.heartbeat_summary())
         return payload
 
     # -- the check ---------------------------------------------------------
@@ -154,6 +162,15 @@ class HangWatchdog:
         reason = (f"watchdog: no train_step progress for {age:.1f}s "
                   f"(hang_timeout_s={self.hang_timeout_s}, last step "
                   f"{step}, step-time EWMA {ewma_ms:.1f}ms)")
+        try:
+            from .perf.goodput import get_goodput_ledger
+
+            # the no-progress interval is detected stall time: charge it
+            # so cluster goodput reflects the hang even if the process
+            # survives (action="log")
+            get_goodput_ledger().add("stall", age)
+        except Exception:
+            pass
         bundle = None
         recorder = self._recorder
         if recorder is HangWatchdog.GLOBAL_RECORDER:

@@ -28,6 +28,8 @@ SHARDS=(
   "tests/unit/monitor"
   "tests/unit/telemetry"
   "tests/unit/resilience"
+  "tests/unit/perf"
+  "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
   "tests/unit/multiprocess"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
@@ -126,6 +128,36 @@ if python -m deepspeed_tpu.resilience ls "$smoke_dir/snaps" >/dev/null \
   echo "=== resilience CLI smoke passed"
 else
   echo "=== resilience CLI smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
+# Perf-sentinel smoke (ISSUE 5): baseline-then-check on the same run
+# must exit 0; a forced-regression fixture must exit 3.
+echo "=== perf sentinel smoke: baseline / check exit codes"
+smoke_dir=$(mktemp -d)
+cat > "$smoke_dir/run.json" <<'EOF'
+{"metric": "llama_110m_train_tokens_per_sec", "value": 35000.0,
+ "unit": "tokens/sec/chip", "mfu": 0.42, "step_time_p50_ms": 120.0,
+ "compile_time_s": 30.0, "goodput": 0.95}
+EOF
+cat > "$smoke_dir/regressed.json" <<'EOF'
+{"metric": "llama_110m_train_tokens_per_sec", "value": 24000.0,
+ "unit": "tokens/sec/chip", "mfu": 0.42, "step_time_p50_ms": 240.0,
+ "compile_time_s": 30.0, "goodput": 0.95}
+EOF
+perf_ok=1
+python -m deepspeed_tpu.telemetry perf baseline "$smoke_dir/run.json" \
+    --out "$smoke_dir/base.json" >/dev/null || perf_ok=0
+python -m deepspeed_tpu.telemetry perf check "$smoke_dir/run.json" \
+    --baseline "$smoke_dir/base.json" >/dev/null || perf_ok=0
+python -m deepspeed_tpu.telemetry perf check "$smoke_dir/regressed.json" \
+    --baseline "$smoke_dir/base.json" >/dev/null
+[ $? -eq 3 ] || perf_ok=0
+if [ $perf_ok -eq 1 ]; then
+  echo "=== perf sentinel smoke passed"
+else
+  echo "=== perf sentinel smoke FAILED"
   fail=1
 fi
 rm -rf "$smoke_dir"

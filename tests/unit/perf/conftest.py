@@ -4,9 +4,8 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _fresh_diagnostics():
-    """Same isolation as the telemetry shard: scrub the process-global
-    diagnostic singletons (hub, recorder, watchdog, ledger, publisher)
-    the resilience plane feeds, before and after every test."""
+    """Same isolation as the telemetry shard, plus the perf plane's own
+    singletons (compile tracker, goodput ledger)."""
     from deepspeed_tpu.telemetry import (attach_collective_ledger,
                                          get_collective_ledger,
                                          get_compile_tracker,
@@ -41,18 +40,15 @@ def _fresh_diagnostics():
 
 @pytest.fixture()
 def tiny_engine_factory(tmp_path):
-    """Factory for deterministic 1-device engines with the resilience
-    plane on: ``make(name, **resilience_overrides)`` returns
-    ``(engine, batches)`` — same seed everywhere, so two engines fed the
-    same batch sequence produce identical losses."""
+    """Deterministic 1-device engines with telemetry (and so the perf
+    plane) on; resilience opt-in per call."""
     import jax.numpy as jnp
 
     import deepspeed_tpu as dst
     from deepspeed_tpu.parallel import MeshLayout
     from deepspeed_tpu.utils import groups
 
-    def make(name, n_batches=10, resilience=None, telemetry=None,
-             steps_per_print=0):
+    def make(name, resilience=None, telemetry=None):
         mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
         rng = np.random.default_rng(7)
         params = {"w": jnp.asarray(
@@ -62,25 +58,22 @@ def tiny_engine_factory(tmp_path):
             x, y = batch
             return jnp.mean((x @ p["w"] - y) ** 2)
 
-        res = {"enabled": True, "snapshot_interval": 2,
-               "snapshot_dir": str(tmp_path / name / "snaps"),
-               "flush_engine": "sync",
-               "backoff_base_s": 0.0, "backoff_max_s": 0.0}
-        res.update(resilience or {})
         tel = {"enabled": True, "output_path": str(tmp_path / name),
                "job_name": "job",
                "flight_recorder": {"install_handlers": False}}
         tel.update(telemetry or {})
         cfg = {"train_micro_batch_size_per_gpu": 4,
                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-               "steps_per_print": steps_per_print,
-               "telemetry": tel, "resilience": res}
+               "steps_per_print": 0, "telemetry": tel}
+        if resilience is not None:
+            res = {"enabled": True, "snapshot_interval": 2,
+                   "snapshot_dir": str(tmp_path / name / "snaps"),
+                   "flush_engine": "sync",
+                   "backoff_base_s": 0.0, "backoff_max_s": 0.0}
+            res.update(resilience)
+            cfg["resilience"] = res
         engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
                                     config=cfg, mesh=mesh)
-        brng = np.random.default_rng(13)
-        batches = [(jnp.asarray(brng.normal(size=(4, 8)).astype(np.float32)),
-                    jnp.zeros((4, 1), jnp.float32))
-                   for _ in range(n_batches)]
-        return engine, batches
+        return engine
 
     return make

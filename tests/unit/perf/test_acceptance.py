@@ -1,0 +1,201 @@
+"""ISSUE 5 acceptance: the perf plane observed through a REAL engine.
+
+* a two-phase run that forces a recompile (batch-shape change) produces
+  a compile event whose cause diff names the changed dimension;
+* a fault-injected NaN rollback yields a GoodputLedger with
+  goodput < 1.0 and the lost time attributed to the recovery bucket;
+* compile-dominated steps are annotated with ``compile_ms`` and kept
+  out of the watchdog EWMA and the health throughput window.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def batch_of(rows, seed=13):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.normal(size=(rows, 8)).astype(np.float32)),
+            jnp.zeros((rows, 1), jnp.float32))
+
+
+def test_two_phase_recompile_names_changed_dimension(tiny_engine_factory):
+    from deepspeed_tpu.telemetry.perf import get_compile_tracker
+
+    engine = tiny_engine_factory("recompile")
+    assert engine.compile_tracker is not None
+    for _ in range(3):
+        engine.train_step(batch_of(8))
+    trk = get_compile_tracker()
+    events_before = trk.events_total
+    # phase 2: the tail batch — 8 rows -> 4 rows
+    engine.train_step(batch_of(4))
+    assert trk.events_total == events_before + 1
+    ev = trk.events()[-1]
+    assert ev.site == "engine/train_step" and ev.kind == "recompile"
+    shape = [c for c in ev.causes if c["kind"] == "shape_change"]
+    assert shape, f"no shape cause in {ev.causes}"
+    assert shape[0]["dim"] == 0
+    assert shape[0]["old"] == 8 and shape[0]["new"] == 4
+    # the per-site table shows both programs, each actually called
+    progs = trk.table()["sites"]["engine/train_step"]
+    assert len(progs) == 2
+    assert all(p["calls"] >= 1 for p in progs)
+
+
+def test_step_records_carry_compile_attribution(tiny_engine_factory):
+    engine = tiny_engine_factory("attrib")
+    engine.train_step(batch_of(8))
+    first = engine.step_records[0]
+    # the first step compiled: annotated, and (on CPU) compile-dominated
+    assert first.extra.get("compile_ms", 0) > 0
+    assert first.extra.get("compile_events", 0) >= 1
+    engine.train_step(batch_of(8))
+    warm = engine.step_records[-1]
+    assert warm.extra.get("compile_events", 0) == 0
+
+
+def test_goodput_ledger_fed_by_engine(tiny_engine_factory):
+    from deepspeed_tpu.telemetry.perf import get_goodput_ledger
+
+    engine = tiny_engine_factory("goodput")
+    assert engine.goodput is not None
+    for _ in range(3):
+        engine.train_step(batch_of(8))
+    gp = get_goodput_ledger()
+    t = gp.totals()
+    assert t["compile"] > 0      # the first step's compile
+    assert t["productive"] > 0   # the warm steps
+    assert 0.0 < gp.goodput() <= 1.0
+
+
+def test_nan_rollback_attributes_lost_time_to_recovery(
+        tiny_engine_factory):
+    from deepspeed_tpu.telemetry.perf import get_goodput_ledger
+
+    engine = tiny_engine_factory(
+        "nanroll", resilience={"faults": ["nan_loss@3"]})
+    i = 0
+    while engine.global_steps < 5:
+        engine.train_step(batch_of(8, seed=100 + i))
+        i += 1
+        assert i < 20
+    assert engine.resilience.rollbacks_total >= 1
+    gp = get_goodput_ledger()
+    t = gp.totals()
+    assert t["recovery"] > 0.0, t
+    assert gp.goodput() < 1.0
+    # snapshots went through the checkpoint engine: capture time counted
+    assert t["checkpoint"] > 0.0, t
+    # the run recovered — the final loss is finite again
+    assert math.isfinite(float(engine.last_metrics["loss"]))
+
+
+def test_compile_dominated_step_excluded_from_watchdog_ewma(
+        tiny_engine_factory):
+    engine = tiny_engine_factory(
+        "wdewma", telemetry={"watchdog": {"enabled": True,
+                                          "hang_timeout_s": 3600.0}})
+    engine.train_step(batch_of(8))  # compile-dominated on CPU
+    # no EWMA sample from the compiled step: only progress
+    assert engine.watchdog._ewma_ms == 0.0
+    engine.train_step(batch_of(8))
+    assert engine.watchdog._ewma_ms > 0.0
+    engine.watchdog.stop()
+
+
+def test_health_throughput_window_skips_compile_dominated():
+    from deepspeed_tpu.telemetry import HealthMonitor, StepRecord
+
+    hm = HealthMonitor(window=8, min_points=3,
+                       recompile_storm_threshold=0)
+
+    def rec(step, tps, step_ms=100.0, compile_ms=0.0):
+        extra = {"compile_ms": compile_ms} if compile_ms else {}
+        return StepRecord(step=step, step_time_ms=step_ms,
+                          device_fenced=True, samples_per_sec=tps / 4,
+                          tokens_per_sec=tps, loss=1.0, grad_norm=1.0,
+                          lr=1e-3, loss_scale=1.0, overflow=False,
+                          skipped_steps=0, comm_bytes=0, comm_ops=0,
+                          extra=extra)
+
+    for s in range(4):
+        assert hm.observe(rec(s, 1000.0)) == []
+    # a compile-dominated slow step: NOT a throughput regression
+    evs = hm.observe(rec(4, 100.0, step_ms=1000.0, compile_ms=900.0))
+    assert evs == []
+    # the same slow step withOUT the compile excuse IS one
+    evs = hm.observe(rec(5, 100.0, step_ms=1000.0))
+    assert [e.kind for e in evs] == ["throughput_regression"]
+
+
+def test_recompile_storm_health_rule():
+    from deepspeed_tpu.telemetry import HealthMonitor, StepRecord
+
+    hm = HealthMonitor(window=16, min_points=3,
+                       recompile_storm_threshold=3)
+
+    def rec(step, recompiles):
+        return StepRecord(step=step, step_time_ms=50.0, device_fenced=True,
+                          samples_per_sec=0.0, tokens_per_sec=0.0,
+                          loss=1.0, grad_norm=1.0, lr=1e-3, loss_scale=1.0,
+                          overflow=False, skipped_steps=0, comm_bytes=0,
+                          comm_ops=0,
+                          extra={"recompile_events": recompiles})
+
+    assert hm.observe(rec(1, 1)) == []
+    assert hm.observe(rec(2, 1)) == []
+    evs = hm.observe(rec(3, 1))
+    assert [e.kind for e in evs] == ["recompile_storm"]
+    # the counter restarted: no immediate re-fire
+    assert hm.observe(rec(4, 1)) == []
+
+
+def test_bundle_carries_compile_table_and_goodput(tiny_engine_factory,
+                                                  tmp_path):
+    from deepspeed_tpu.telemetry import load_bundle
+
+    engine = tiny_engine_factory("bundle")
+    engine.train_step(batch_of(8))
+    engine.train_step(batch_of(4))  # forces a recompile
+    bundle = engine.flight_recorder.dump("perf acceptance")
+    ctx = load_bundle(bundle)["manifest"]["context"]
+    ct = ctx["compile_programs"]
+    assert ct["events_total"] >= 2
+    assert "engine/train_step" in ct["sites"]
+    recompiled = [p for p in ct["sites"]["engine/train_step"]
+                  if p["kind"] == "recompile"]
+    assert recompiled and recompiled[0]["causes"]
+    gp = ctx["goodput"]
+    assert 0.0 < gp["goodput"] <= 1.0
+    assert gp["buckets_s"]["compile"] > 0
+
+
+def test_perf_check_gates_an_engine_run(tiny_engine_factory, tmp_path):
+    """End-to-end sentinel: metrics from a real run, baseline, clean
+    rerun passes, injected step-time regression exits 3."""
+    import json
+
+    from deepspeed_tpu.telemetry.cli import main as cli_main
+    from deepspeed_tpu.telemetry.perf import get_goodput_ledger
+
+    engine = tiny_engine_factory("gate")
+    for _ in range(4):
+        engine.train_step(batch_of(8))
+    recs = [r for r in engine.step_records if r.device_fenced]
+    times = sorted(r.step_time_ms for r in recs)
+    run = {"metric": "train_tokens_per_sec",
+           "step_time_p50_ms": times[len(times) // 2],
+           "goodput": get_goodput_ledger().goodput()}
+    run_p = tmp_path / "run.json"
+    run_p.write_text(json.dumps(run))
+    base_p = str(tmp_path / "base.json")
+    assert cli_main(["perf", "baseline", str(run_p), "--out", base_p]) == 0
+    assert cli_main(["perf", "check", str(run_p),
+                     "--baseline", base_p]) == 0
+    slow = dict(run, step_time_p50_ms=run["step_time_p50_ms"] * 10 + 100)
+    slow_p = tmp_path / "slow.json"
+    slow_p.write_text(json.dumps(slow))
+    assert cli_main(["perf", "check", str(slow_p),
+                     "--baseline", base_p]) == 3

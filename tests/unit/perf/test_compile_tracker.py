@@ -1,0 +1,111 @@
+"""CompileTracker / tracked_jit unit tests (no engine needed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.telemetry.perf import (CompileTracker, diff_signatures,
+                                          signature_of, tracked_jit)
+
+
+def test_tracked_jit_first_call_records_compile():
+    trk = CompileTracker(enabled=True)
+    f = tracked_jit(lambda x: x * 2, site="t/double", tracker=trk)
+    out = f(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert trk.events_total == 1
+    assert trk.recompiles_total == 0
+    ev = trk.events()[-1]
+    assert ev.site == "t/double" and ev.kind == "compile"
+    assert ev.total_ms > 0
+    # lower and compile are timed apart on the AOT path
+    assert not ev.fallback
+    assert ev.lower_ms >= 0 and ev.compile_ms >= 0
+
+
+def test_tracked_jit_cache_hit_no_new_event():
+    trk = CompileTracker(enabled=True)
+    f = tracked_jit(lambda x: x + 1, site="t/inc", tracker=trk)
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))
+    assert trk.events_total == 1
+    assert trk.table()["sites"]["t/inc"][0]["calls"] == 3
+
+
+def test_recompile_names_changed_dimension():
+    trk = CompileTracker(enabled=True)
+    f = tracked_jit(lambda x: x.sum(), site="t/sum", tracker=trk)
+    f(jnp.ones((8, 16)))
+    f(jnp.ones((4, 16)))  # tail batch: dim 0 shrinks
+    assert trk.recompiles_total == 1
+    ev = trk.events()[-1]
+    assert ev.kind == "recompile"
+    shape_causes = [c for c in ev.causes if c["kind"] == "shape_change"]
+    assert shape_causes, ev.causes
+    c = shape_causes[0]
+    assert c["dim"] == 0 and c["old"] == 8 and c["new"] == 4
+
+
+def test_recompile_names_dtype_change():
+    trk = CompileTracker(enabled=True)
+    f = tracked_jit(lambda x: x * 1, site="t/dtype", tracker=trk)
+    f(jnp.ones((4,), jnp.float32))
+    f(jnp.ones((4,), jnp.bfloat16))
+    ev = trk.events()[-1]
+    kinds = {c["kind"] for c in ev.causes}
+    assert "dtype_change" in kinds
+
+
+def test_static_context_change_is_named():
+    trk = CompileTracker(enabled=True)
+    f1 = tracked_jit(lambda x: x * 2, site="t/static", tracker=trk,
+                     static_context={"ltd_keep": None})
+    f1(jnp.ones((4,)))
+    f2 = tracked_jit(lambda x: x * 2, site="t/static", tracker=trk,
+                     static_context={"ltd_keep": 96})
+    f2(jnp.ones((4,)))
+    ev = trk.events()[-1]
+    assert ev.kind == "recompile"
+    statics = [c for c in ev.causes if c["kind"] == "static_change"]
+    assert statics and statics[0]["key"] == "ltd_keep"
+    assert statics[0]["old"] is None and statics[0]["new"] == 96
+
+
+def test_disabled_tracker_is_plain_jit():
+    f = tracked_jit(lambda x: x * 2, site="t/plain", tracker=None)
+    # tracker=None returns the raw jax.jit object
+    assert isinstance(f, type(jax.jit(lambda x: x)))
+    out = f(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_diff_signatures_structure_change():
+    a = signature_of((jnp.ones((2,)),), {}, {}, ())
+    b = signature_of(({"k": jnp.ones((2,))},), {}, {}, ())
+    causes = diff_signatures(a, b)
+    assert any(c["kind"] == "structure_change" for c in causes)
+
+
+def test_counters_reach_metrics_registry():
+    from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    trk = CompileTracker(enabled=True)
+    f = tracked_jit(lambda x: x - 1, site="t/metrics", tracker=trk)
+    f(jnp.ones((4,)))
+    f(jnp.ones((5,)))
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["compile_events_total"] == 2
+    assert parsed["compile_recompiles_total"] == 1
+    assert parsed["compile_time_ms_total"] > 0
+    assert parsed["compile_live_programs"] == 2
+
+
+def test_listener_sees_events():
+    trk = CompileTracker(enabled=True)
+    seen = []
+    trk.add_listener(seen.append)
+    f = tracked_jit(lambda x: x, site="t/listen", tracker=trk)
+    f(jnp.ones((3,)))
+    assert len(seen) == 1 and seen[0].site == "t/listen"

@@ -1,0 +1,128 @@
+"""GoodputLedger unit tests (fake clock, no engine)."""
+
+import pytest
+
+from deepspeed_tpu.telemetry.perf import BUCKETS, GoodputLedger
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_buckets_and_goodput_fraction():
+    led = GoodputLedger(enabled=True)
+    led.add("productive", 9.0)
+    led.add("compile", 1.0)
+    assert led.goodput() == pytest.approx(0.9)
+    totals = led.totals()
+    assert totals["productive"] == pytest.approx(9.0)
+    assert totals["compile"] == pytest.approx(1.0)
+    assert set(totals) == set(BUCKETS)
+
+
+def test_add_step_splits_compile_share():
+    led = GoodputLedger(enabled=True)
+    led.add_step(2.0, compile_s=1.5)
+    t = led.totals()
+    assert t["compile"] == pytest.approx(1.5)
+    assert t["productive"] == pytest.approx(0.5)
+    # compile share can never exceed the step time
+    led.reset()
+    led.add_step(1.0, compile_s=5.0)
+    t = led.totals()
+    assert t["compile"] == pytest.approx(1.0)
+    assert t["productive"] == pytest.approx(0.0)
+
+
+def test_empty_ledger_reads_one():
+    led = GoodputLedger(enabled=True)
+    assert led.goodput() == 1.0
+    assert led.rolling_goodput() == 1.0
+
+
+def test_disabled_ledger_records_nothing():
+    led = GoodputLedger(enabled=False)
+    led.add("productive", 5.0)
+    assert led.total_seconds() == 0.0
+
+
+def test_unknown_bucket_raises():
+    led = GoodputLedger(enabled=True)
+    with pytest.raises(ValueError):
+        led.add("coffee", 1.0)
+
+
+def test_reclassify_moves_productive_to_recovery():
+    led = GoodputLedger(enabled=True)
+    led.add("productive", 10.0)
+    led.reclassify("productive", "recovery", 4.0)
+    t = led.totals()
+    assert t["productive"] == pytest.approx(6.0)
+    assert t["recovery"] == pytest.approx(4.0)
+    assert led.goodput() == pytest.approx(0.6)
+    # clamped: can never move more than the source holds
+    led.reclassify("productive", "recovery", 100.0)
+    t = led.totals()
+    assert t["productive"] == pytest.approx(0.0)
+    assert t["recovery"] == pytest.approx(10.0)
+
+
+def test_rolling_window_forgets_old_time():
+    clock = FakeClock()
+    led = GoodputLedger(enabled=True, window_s=60.0, clock=clock)
+    led.add("stall", 100.0)       # an old incident
+    clock.t += 120.0              # ...two minutes ago
+    led.add("productive", 10.0)
+    assert led.rolling_goodput() == pytest.approx(1.0)
+    # cumulative goodput still remembers the stall
+    assert led.goodput() == pytest.approx(10.0 / 110.0)
+
+
+def test_heartbeat_summary_keys():
+    led = GoodputLedger(enabled=True)
+    led.add("productive", 1.0)
+    hb = led.heartbeat_summary()
+    assert set(hb) == {"goodput", "goodput_total"}
+
+
+def test_watchdog_payload_carries_goodput():
+    from deepspeed_tpu.telemetry import HangWatchdog
+    from deepspeed_tpu.telemetry.perf import get_goodput_ledger
+
+    gp = get_goodput_ledger()
+    gp.configure(enabled=True)
+    gp.add("productive", 3.0)
+    gp.add("stall", 1.0)
+    wd = HangWatchdog(hang_timeout_s=999, recorder=None)
+    payload = wd.heartbeat_payload()
+    assert payload["goodput_total"] == pytest.approx(0.75)
+    assert "goodput" in payload
+
+
+def test_watchdog_trip_charges_stall():
+    from deepspeed_tpu.telemetry import HangWatchdog
+    from deepspeed_tpu.telemetry.perf import get_goodput_ledger
+
+    gp = get_goodput_ledger()
+    gp.configure(enabled=True)
+    t = [0.0]
+    wd = HangWatchdog(hang_timeout_s=10.0, action="log",
+                      comm_liveness=False, clock=lambda: t[0],
+                      recorder=None)
+    wd.notify_progress(1, 0.1)
+    t[0] = 20.0
+    assert wd.check()
+    assert gp.totals()["stall"] == pytest.approx(20.0)
+
+
+def test_snapshot_shape_for_bundles():
+    led = GoodputLedger(enabled=True)
+    led.add("productive", 2.0)
+    snap = led.snapshot()
+    assert set(snap) == {"buckets_s", "goodput", "rolling_goodput",
+                        "window_s"}
+    assert snap["buckets_s"]["productive"] == pytest.approx(2.0)

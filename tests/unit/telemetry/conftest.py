@@ -10,7 +10,9 @@ def _fresh_diagnostics():
     its comms-logger hook), and the aggregation publisher."""
     from deepspeed_tpu.telemetry import (attach_collective_ledger,
                                          get_collective_ledger,
-                                         get_flight_recorder, get_telemetry,
+                                         get_compile_tracker,
+                                         get_flight_recorder,
+                                         get_goodput_ledger, get_telemetry,
                                          get_watchdog, set_watchdog)
     from deepspeed_tpu.telemetry.aggregator import set_publisher
 
@@ -23,6 +25,12 @@ def _fresh_diagnostics():
         led.enabled = False
         attach_collective_ledger(None)
         set_publisher(None)
+        trk = get_compile_tracker()
+        trk.reset()
+        trk.enabled = False
+        gp = get_goodput_ledger()
+        gp.reset()
+        gp.enabled = False
 
     scrub()
     yield

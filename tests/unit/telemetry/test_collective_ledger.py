@@ -68,14 +68,17 @@ def test_comms_logger_feeds_ledger_independent_of_enabled():
         assert led.tail()[-1]["bytes"] == 2048
         assert led.tail()[-1]["src"] == "census"
         # exec probes only feed when exec_feed is opted into (unordered
-        # device callbacks are not cross-rank comparable)
+        # device callbacks are not cross-rank comparable) — and land in
+        # the separate EXEC lane, never the census chain
         comms_logger.configure(enabled=True, exec_counts=True)
         comms_logger.record_exec("psum", 2048)
         assert led.seq == 1
+        assert led.exec_seq == 0
         led.exec_feed = True
         comms_logger.record_exec("psum", 2048)
-        assert led.seq == 2
-        assert led.tail()[-1]["src"] == "exec"
+        assert led.seq == 1  # census chain untouched
+        assert led.exec_seq == 1
+        assert led.exec_tail()[-1]["src"] == "exec_probe"
     finally:
         comms_logger.ledger = None
         comms_logger.configure(enabled=was_enabled, exec_counts=was_exec)
