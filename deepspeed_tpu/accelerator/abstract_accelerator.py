@@ -160,8 +160,13 @@ class DeepSpeedAccelerator(abc.ABC):
 
                 _np.asarray(jnp.zeros(()) + 1.0)
                 jax.effects_barrier()
-            except Exception:
-                pass  # no device / not initialized — host-only semantics
+            except Exception as e:
+                # no device / not initialized — host-only semantics
+                from ..utils.logging import debug_once
+
+                debug_once("accelerator/event_drain",
+                           f"Event drain skipped ({e!r}); "
+                           f"host-only timing semantics")
 
         def record(self, stream=None):
             import time as _time

@@ -1,0 +1,39 @@
+"""dslint — the JAX/TPU-aware static-analysis plane (ISSUE 6).
+
+PRs 1-5 detect this stack's recurring failure classes at RUNTIME
+(recompile storms, desynced collectives, watchdog/publisher/snapshot
+races); this package recognizes the same hazard classes in SOURCE, at
+review time:
+
+* :mod:`.jax_rules` — untracked jit sites, recompile hazards,
+  host-sync-in-hot-path, donated-buffer reuse, raw collectives outside
+  ``comm/``.
+* :mod:`.hygiene` — bare/silent ``except`` discipline.
+* :mod:`.races` — thread-safety audit over classes reachable from
+  thread entry points.
+* :mod:`.lockcheck` — test-time instrumented locks that fail on
+  lock-order inversion.
+* :mod:`.baseline` — the reviewed true-but-deferred ledger the CLI
+  gates against (exit 3 on anything new).
+
+CLI: ``python -m deepspeed_tpu.analysis {lint,races,baseline,explain}``;
+config: the ``[tool.dslint]`` stanza in pyproject.toml; suppression:
+``# dslint: disable=<rule>`` (line) / ``# dslint: disable-file=<rule>``.
+
+Import-light on purpose: the analyzers never import the code they
+inspect (no jax at lint time), so the CI gate is cheap.
+"""
+
+from .baseline import load_baseline, partition, write_baseline
+from .core import (RULES, AnalysisConfig, Finding, Rule, SourceModule,
+                   find_repo_root, iter_modules, load_config, run_rules)
+from .lockcheck import (InstrumentedLock, LockOrderInversion,
+                        LockOrderMonitor, instrument_locks)
+
+__all__ = [
+    "AnalysisConfig", "Finding", "Rule", "RULES", "SourceModule",
+    "find_repo_root", "iter_modules", "load_config", "run_rules",
+    "load_baseline", "partition", "write_baseline",
+    "InstrumentedLock", "LockOrderInversion", "LockOrderMonitor",
+    "instrument_locks",
+]

@@ -1,0 +1,93 @@
+"""Findings baseline — the reviewed debt ledger dslint gates against.
+
+The baseline is a checked-in JSON file mapping known findings to (per
+entry, optional) written justifications.  ``analysis lint`` exits 3 on
+any finding NOT in the baseline; ``analysis baseline`` regenerates the
+file from the current findings, PRESERVING justifications of entries
+that still match — so re-baselining after a cleanup never loses the
+reasoning attached to what remains.
+
+Matching is by ``Finding.key()`` — ``(rule, path, symbol, message)``,
+never line numbers (every unrelated edit above a finding would
+otherwise churn the file).  Stale entries (baselined findings that no
+longer fire) are reported as a note, not an error: deleting them is the
+next ``baseline`` run's job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str, str],
+                                     Dict[str, Any]]:
+    """Baseline entries keyed for matching; {} when the file is absent
+    (first run: everything is new)."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r") as fh:
+        data = json.load(fh)
+    out = {}
+    for entry in data.get("entries", []):
+        key = (entry["rule"], entry["path"], entry.get("symbol", ""),
+               entry["message"])
+        out[key] = entry
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> int:
+    """Write the baseline for ``findings``, carrying over justifications
+    from a pre-existing file where the entry still matches."""
+    old = load_baseline(path)
+    entries = []
+    for f in sorted(set(f.key() for f in findings)):
+        rule, rel, symbol, message = f
+        entry: Dict[str, Any] = {"rule": rule, "path": rel,
+                                 "symbol": symbol, "message": message}
+        prev = old.get(f)
+        if prev and prev.get("justification"):
+            entry["justification"] = prev["justification"]
+        entries.append(entry)
+    payload = {
+        "version": BASELINE_VERSION,
+        "tool": "python -m deepspeed_tpu.analysis baseline",
+        "note": ("Known findings dslint tolerates.  Every entry is "
+                 "true-but-deferred; `justification` says why it is "
+                 "deferred.  Fix the code, then re-run `baseline` to "
+                 "shrink this file — never hand-add entries to silence "
+                 "a new finding."),
+        "entries": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def partition(findings: List[Finding], baseline: Dict,
+              ran_rules: Any = None) -> Tuple[
+        List[Finding], List[Finding], List[Dict[str, Any]]]:
+    """(new, known, stale): findings not in the baseline, findings in
+    it, and baseline entries nothing matched.  ``ran_rules`` scopes the
+    staleness check to rules that actually executed — ``lint`` must not
+    call the races entries stale (and vice versa)."""
+    new, known = [], []
+    matched = set()
+    for f in findings:
+        if f.key() in baseline:
+            known.append(f)
+            matched.add(f.key())
+        else:
+            new.append(f)
+    stale = [entry for key, entry in baseline.items()
+             if key not in matched
+             and (ran_rules is None or key[0] in ran_rules)]
+    return new, known, stale

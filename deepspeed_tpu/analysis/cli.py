@@ -1,0 +1,175 @@
+"""Operator CLI — ``python -m deepspeed_tpu.analysis {lint,races,
+baseline,explain}``.
+
+Exit codes (shared with the telemetry/resilience CLIs' convention):
+
+* ``0`` — clean (every finding is baselined or suppressed)
+* ``2`` — usage error (unknown rule, unreadable root)
+* ``3`` — findings not in the baseline (the CI-gate signal)
+
+``lint`` runs the JAX/TPU + hygiene rules; ``races`` runs the
+thread-safety audit; both gate against the same baseline file, so a
+single ``baseline`` run captures the full reviewed-debt ledger.
+``explain <rule>`` prints the intent doc — the text a reviewer reads
+before deciding fix vs suppress vs baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .core import (RULES, AnalysisConfig, _load_all_rules, active_rules,
+                   find_repo_root, load_config, run_rules)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis",
+        description="dslint — JAX/TPU-aware static analysis")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("paths", nargs="*",
+                        help="files/dirs to analyze (default: config "
+                             "paths)")
+        sp.add_argument("--root", default=None,
+                        help="repo root (default: nearest pyproject.toml)")
+        sp.add_argument("--baseline", default=None,
+                        help="baseline file (default: config)")
+        sp.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignore the baseline")
+        sp.add_argument("--format", choices=("text", "json"),
+                        default="text")
+
+    common(sub.add_parser(
+        "lint", help="JAX/TPU correctness + hygiene rules"))
+    common(sub.add_parser(
+        "races", help="thread-safety audit (shared attrs off the lock)"))
+    common(sub.add_parser(
+        "baseline", help="regenerate the findings baseline (lint+races)"))
+    exp = sub.add_parser("explain", help="print a rule's intent doc")
+    exp.add_argument("rule", nargs="?", default=None,
+                     help="rule id (omit to list all rules)")
+    return p
+
+
+def _scope_tuple(paths, root: str):
+    """Repo-relative prefixes for a path-scoped run, resolved exactly as
+    ``iter_modules`` resolves them (non-absolute paths join onto root,
+    NOT onto cwd) — the staleness and carry-over checks must agree with
+    the scan about what was observed."""
+    import os
+
+    return tuple(
+        os.path.relpath(p if os.path.isabs(p) else os.path.join(root, p),
+                        root).replace(os.sep, "/").rstrip("/")
+        for p in paths)
+
+
+def _in_scope(rel_path: str, scope) -> bool:
+    return any(rel_path == s or rel_path.startswith(s + "/")
+               for s in scope)
+
+
+def _gate(findings, cfg: AnalysisConfig, root: str, args,
+          family: str) -> int:
+    import os
+
+    bl_path = args.baseline or os.path.join(root, cfg.baseline)
+    if args.no_baseline:
+        new, known, stale = findings, [], []
+    else:
+        bl = baseline_mod.load_baseline(bl_path)
+        ran = {r.id for r in active_rules(cfg, family)}
+        new, known, stale = baseline_mod.partition(findings, bl, ran)
+        if args.paths:
+            # a path-scoped run saw only a slice of the tree — entries
+            # outside it are unobserved, not stale
+            scope = _scope_tuple(args.paths, root)
+            stale = [e for e in stale if _in_scope(e["path"], scope)]
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in known],
+            "stale_baseline_entries": stale}, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if known:
+            print(f"-- {len(known)} baselined finding(s) tolerated "
+                  f"({bl_path})")
+        if stale:
+            print(f"-- note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} no longer "
+                  f"match anything — rerun `baseline` to drop them")
+        if new:
+            print(f"== {len(new)} NEW finding(s) — fix, suppress with "
+                  f"`# dslint: disable=<rule>`, or (true-but-deferred "
+                  f"only) re-baseline")
+        else:
+            print("== clean")
+    return 3 if new else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.cmd == "explain":
+        _load_all_rules()
+        if args.rule is None:
+            for rule in sorted(RULES.values(), key=lambda r: r.id):
+                print(f"{rule.id:22s} [{rule.family}] {rule.summary}")
+            return 0
+        rule = RULES.get(args.rule)
+        if rule is None:
+            print(f"unknown rule {args.rule!r}; known: "
+                  f"{', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+        print(f"{rule.id} [{rule.family}] — {rule.summary}\n")
+        print(rule.explain)
+        return 0
+
+    root = find_repo_root(args.root)
+    cfg = load_config(root)
+    paths = args.paths or None
+
+    try:
+        if args.cmd == "lint":
+            findings = run_rules(cfg, root, "lint", paths)
+            return _gate(findings, cfg, root, args, "lint")
+        if args.cmd == "races":
+            findings = run_rules(cfg, root, "races", paths)
+            return _gate(findings, cfg, root, args, "races")
+        if args.cmd == "baseline":
+            import os
+
+            from .core import Finding
+
+            findings = (run_rules(cfg, root, "lint", paths)
+                        + run_rules(cfg, root, "races", paths))
+            bl_path = args.baseline or os.path.join(root, cfg.baseline)
+            if args.paths:
+                # a path-scoped rebaseline saw only a slice of the tree:
+                # out-of-scope entries were not re-observed, not fixed —
+                # carry them (and their justifications) over verbatim
+                scope = _scope_tuple(args.paths, root)
+                for entry in baseline_mod.load_baseline(
+                        bl_path).values():
+                    if not _in_scope(entry["path"], scope):
+                        findings.append(Finding(
+                            rule=entry["rule"], path=entry["path"],
+                            line=0, symbol=entry.get("symbol", ""),
+                            message=entry["message"]))
+            n = baseline_mod.write_baseline(bl_path, findings)
+            print(f"baseline: {n} entr{'y' if n == 1 else 'ies'} -> "
+                  f"{bl_path}")
+            return 0
+    except FileNotFoundError as e:
+        # a typo'd path must FAIL the gate loudly, never report clean
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 2

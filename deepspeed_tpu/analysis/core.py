@@ -1,0 +1,392 @@
+"""dslint core — findings, rule registry, suppressions, config.
+
+The static-analysis plane's spine.  Everything here is deliberately
+AST-only and import-free: rules never import the modules they inspect
+(importing ``runtime/engine.py`` would drag jax/XLA into a lint run and
+make CI linting as heavy as a test shard).  The trade-off is that all
+resolution (what does ``self._jit`` mean? is ``lax`` ``jax.lax``?) is
+name-based heuristics — which is exactly why findings are gated through
+a reviewed baseline instead of hard-failing on first sight.
+
+Layers:
+
+* :class:`Finding` — one report, keyed for baseline matching by
+  ``(rule, path, symbol, message)`` (NOT line number: lines drift on
+  every unrelated edit, symbols and messages don't).
+* :class:`SourceModule` — parsed file + enclosing-qualname index +
+  suppression table (``# dslint: disable=<rule>[,<rule>]`` trailing a
+  line, ``# dslint: disable-file=<rule>`` anywhere).
+* :class:`Rule` / :func:`register` — the registry the CLI and tests
+  enumerate; each rule declares a family (``lint`` or ``races``) so
+  ``analysis lint`` and ``analysis races`` run disjoint sets.
+* :class:`AnalysisConfig` — the ``[tool.dslint]`` stanza of
+  pyproject.toml (rule enable/disable, hot-path roots, lock-name
+  conventions) parsed with a self-contained mini-TOML reader because
+  this container's Python 3.10 predates ``tomllib``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer report, anchored for baseline matching."""
+
+    rule: str
+    path: str        # repo-relative, forward slashes
+    line: int
+    symbol: str      # enclosing qualname ("" at module level)
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Baseline identity — everything except the (drifting) line."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym}: {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# parsed source + suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dslint:\s*(disable|disable-file)\s*=\s*([\w\-, ]+)")
+
+
+class SourceModule:
+    """One parsed file: AST, per-node qualnames, suppression table."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._qual: Dict[int, str] = {}       # id(node) -> qualname
+        self._index_qualnames(self.tree, [])
+        #: line -> set of rule ids disabled on that line
+        self.line_disable: Dict[int, set] = {}
+        self.file_disable: set = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disable |= rules
+            else:
+                self.line_disable.setdefault(i, set()).update(rules)
+
+    def _index_qualnames(self, node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = stack + [child.name]
+                self._qual[id(child)] = ".".join(qual)
+                self._index_qualnames(child, qual)
+            else:
+                if stack:
+                    self._qual[id(child)] = ".".join(stack)
+                self._index_qualnames(child, stack)
+
+    def qualname(self, node: ast.AST) -> str:
+        return self._qual.get(id(node), "")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disable:
+            return True
+        return rule in self.line_disable.get(line, set())
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.rel,
+                       line=getattr(node, "lineno", 0),
+                       symbol=self.qualname(node), message=message)
+
+
+def iter_modules(root: str, paths: Iterable[str]) -> List[SourceModule]:
+    """Parse every ``*.py`` under ``paths`` (files or dirs, relative to
+    ``root``).  Unparseable files are skipped — a syntax error is the
+    interpreter's job to report, not the linter's.  A path that does not
+    exist raises: a typo'd root silently reporting "clean" would turn a
+    CI gate into a no-op."""
+    mods: List[SourceModule] = []
+    seen = set()
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            raise FileNotFoundError(f"analysis path does not exist: {full}")
+        if os.path.isfile(full):
+            files = [full]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        for f in sorted(files):
+            f = os.path.abspath(f)
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root)
+            try:
+                with open(f, "r") as fh:
+                    text = fh.read()
+                mods.append(SourceModule(f, rel, text))
+            except (OSError, SyntaxError, ValueError):
+                continue
+    return mods
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    family: str        # "lint" | "races"
+    summary: str       # one line, for `explain` listings
+    explain: str       # full intent doc, for `explain <rule>`
+    check: Callable[[List[SourceModule], "AnalysisConfig"], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    RULES[rule.id] = rule
+    return rule
+
+
+def _load_all_rules() -> None:
+    # import for registration side effects; idempotent
+    from . import hygiene, jax_rules, races  # noqa: F401
+
+
+def active_rules(cfg: "AnalysisConfig", family: str) -> List[Rule]:
+    _load_all_rules()
+    out = []
+    for rule in RULES.values():
+        if rule.family != family:
+            continue
+        if cfg.enable and rule.id not in cfg.enable:
+            continue
+        if rule.id in cfg.disable:
+            continue
+        out.append(rule)
+    return sorted(out, key=lambda r: r.id)
+
+
+def run_rules(cfg: "AnalysisConfig", root: str, family: str,
+              paths: Optional[List[str]] = None) -> List[Finding]:
+    """Run one family's rules over the configured (or given) paths and
+    filter through suppression comments.  Baseline gating is the
+    caller's job (:mod:`.baseline`)."""
+    mods = iter_modules(root, paths or cfg.paths)
+    by_rel = {m.rel: m for m in mods}
+    findings: List[Finding] = []
+    for rule in active_rules(cfg, family):
+        for f in rule.check(mods, cfg):
+            mod = by_rel.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# config — the [tool.dslint] pyproject stanza
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AnalysisConfig:
+    """Everything operators may tune without touching analyzer code."""
+
+    #: roots the linter walks by default
+    paths: List[str] = dataclasses.field(
+        default_factory=lambda: ["deepspeed_tpu"])
+    #: when non-empty, ONLY these rules run
+    enable: List[str] = dataclasses.field(default_factory=list)
+    disable: List[str] = dataclasses.field(default_factory=list)
+    #: checked-in findings baseline (repo-relative)
+    baseline: str = ".dslint-baseline.json"
+    #: dirs where every jax.jit must ride the compile tracker
+    jit_roots: List[str] = dataclasses.field(
+        default_factory=lambda: ["deepspeed_tpu/runtime",
+                                 "deepspeed_tpu/inference"])
+    #: wrapper names that ARE the tracked path
+    tracked_jit_names: List[str] = dataclasses.field(
+        default_factory=lambda: ["tracked_jit", "_jit"])
+    #: the one package allowed to touch jax.lax collectives directly
+    collective_home: str = "deepspeed_tpu/comm"
+    #: hot-path entry points, "relative/path.py::Qual.name"
+    hot_path_roots: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "deepspeed_tpu/runtime/engine.py::DeepSpeedEngine.train_step"])
+    #: functions/methods the host-sync rule neither scans nor descends
+    #: into (the deliberate telemetry fences + diagnostics surfaces)
+    host_sync_allow: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "DeepSpeedEngine._record_step_telemetry",
+            "RecoveryPolicy.observe_step",
+        ])
+    #: parameter-name globs the static-argnums hazard treats as
+    #: array-valued
+    array_param_names: List[str] = dataclasses.field(
+        default_factory=lambda: ["param*", "state*", "batch*", "grad*",
+                                 "tensor*", "arr*", "*tree*", "pool*",
+                                 "cache*"])
+    #: attribute-name globs that count as "the class's declared lock"
+    lock_name_patterns: List[str] = dataclasses.field(
+        default_factory=lambda: ["*lock*", "*_mu", "*mutex*", "*cond*"])
+    #: extra thread entry points the AST can't see (callback indirection),
+    #: "relative/path.py::Qual.name"
+    thread_roots: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "deepspeed_tpu/telemetry/watchdog.py::HangWatchdog._loop",
+            "deepspeed_tpu/telemetry/aggregator.py::BundlePublisher.tick",
+            "deepspeed_tpu/resilience/snapshot.py::SnapshotManager._flush_sync",
+        ])
+    #: attribute-name globs the races audit never reports (counters whose
+    #: worst case is a benign off-by-one in diagnostics output)
+    races_ignore_attrs: List[str] = dataclasses.field(default_factory=list)
+
+    def lock_like(self, attr: str) -> bool:
+        return any(fnmatch.fnmatch(attr, pat)
+                   for pat in self.lock_name_patterns)
+
+    def arrayish(self, name: str) -> bool:
+        return any(fnmatch.fnmatch(name, pat)
+                   for pat in self.array_param_names)
+
+
+def _strip_toml_comment(line: str) -> str:
+    """Cut an inline ``#`` comment — but only OUTSIDE quoted strings
+    (paths legitimately contain ``#``-free but quote-sensitive text;
+    a comment swallowed into a joined multi-line list would silently
+    drop the whole key)."""
+    quote: Optional[str] = None
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def _parse_toml_section(text: str, section: str) -> Dict[str, Any]:
+    """Mini-TOML: just enough for our own stanza (string/bool/int
+    scalars and string lists, single- or multi-line, inline comments).
+    Python 3.10 has no tomllib and the container must not grow
+    dependencies."""
+    lines = text.splitlines()
+    in_section = False
+    buf: List[str] = []
+    logical: List[str] = []
+    depth = 0
+    for raw in lines:
+        line = _strip_toml_comment(raw).strip()
+        if line.startswith("["):
+            if in_section and depth == 0:
+                break
+            in_section = line == f"[{section}]"
+            continue
+        if not in_section or not line:
+            continue
+        buf.append(line)
+        depth += line.count("[") - line.count("]")
+        if depth <= 0:
+            logical.append(" ".join(buf))
+            buf, depth = [], 0
+    out: Dict[str, Any] = {}
+    for entry in logical:
+        if "=" not in entry:
+            continue
+        key, _, value = entry.partition("=")
+        value = value.strip()
+        # only a bare scalar bool is rewritten — a blanket regex would
+        # corrupt string values that happen to contain true/false
+        if value in ("true", "false"):
+            value = value.capitalize()
+        try:
+            out[key.strip()] = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            continue
+    return out
+
+
+def load_config(root: str) -> AnalysisConfig:
+    cfg = AnalysisConfig()
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.isfile(pyproject):
+        return cfg
+    with open(pyproject, "r") as fh:
+        data = _parse_toml_section(fh.read(), "tool.dslint")
+    for field in dataclasses.fields(AnalysisConfig):
+        if field.name in data:
+            setattr(cfg, field.name, data[field.name])
+    return cfg
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor with a pyproject.toml (falls back to cwd)."""
+    cur = os.path.abspath(start or os.getcwd())
+    probe = cur
+    while True:
+        if os.path.isfile(os.path.join(probe, "pyproject.toml")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return cur
+        probe = parent
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several rule modules)
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for an Attribute/Name chain; None when the chain
+    bottoms out in anything but a Name (a call result, a subscript)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def parse_root_spec(spec: str) -> Tuple[str, str]:
+    """Split a "relative/path.py::Qual.name" config entry."""
+    path, _, qual = spec.partition("::")
+    return path.replace(os.sep, "/"), qual

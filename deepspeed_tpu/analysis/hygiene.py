@@ -1,0 +1,68 @@
+"""Hygiene lints — exception-handling discipline.
+
+One rule, ``bare-except``: a bare ``except:`` anywhere, or an
+``except Exception/BaseException:`` whose body does NOTHING (only
+``pass``/``continue``).  Silent swallows are how the repo once hid real
+backend breakage for two rounds (the ``effects_barrier`` case now
+documented in comm.py) — a broad handler is fine as a last-resort
+fallback, but it must either narrow the type or say what it ate, once,
+with context.  Handlers that log, re-raise, set a fallback value, or
+return are not flagged: those made a decision; ``pass`` made none.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import AnalysisConfig, Finding, Rule, SourceModule, register
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _check_bare_except(mods: List[SourceModule],
+                       cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(mod.finding(
+                    "bare-except", node,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "too — name the exception type, or at minimum "
+                    "`except Exception` with a logged reason"))
+                continue
+            if not (isinstance(node.type, ast.Name)
+                    and node.type.id in _BROAD):
+                continue
+            silent = all(isinstance(stmt, (ast.Pass, ast.Continue))
+                         for stmt in node.body)
+            if silent:
+                out.append(mod.finding(
+                    "bare-except", node,
+                    f"`except {node.type.id}: pass` swallows every "
+                    f"failure silently — narrow the exception type, or "
+                    f"log once with context (utils.logging.debug_once) "
+                    f"so breakage is visible the first time it happens"))
+    return out
+
+
+register(Rule(
+    id="bare-except", family="lint",
+    summary="bare `except:` and silent `except Exception: pass` blocks",
+    explain=(
+        "A broad handler that does nothing converts every future bug in "
+        "the protected block into silence — the repo's comms logger once "
+        "hid a broken jax.effects_barrier behind exactly this shape for "
+        "two rounds.  The rule flags (1) bare `except:` (which also eats "
+        "SystemExit and KeyboardInterrupt) and (2) `except Exception:` / "
+        "`except BaseException:` whose body is only pass/continue.  A "
+        "handler that narrows the type, logs (see "
+        "utils.logging.debug_once for the log-once-with-context idiom), "
+        "re-raises, returns, or assigns a fallback is deliberate and "
+        "passes.  Best-effort telemetry paths where even a log line is "
+        "wrong belong behind an inline "
+        "`# dslint: disable=bare-except` with a justification comment."),
+    check=_check_bare_except))

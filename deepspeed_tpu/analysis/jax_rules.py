@@ -1,0 +1,632 @@
+"""JAX/TPU correctness lints — the failure classes PRs 1-5 built runtime
+detectors for, caught at review time instead of step 40k.
+
+Five rules, each an AST heuristic over :class:`~.core.SourceModule`:
+
+* ``untracked-jit`` — a ``jax.jit`` under ``runtime/``/``inference/``
+  that bypasses ``engine._jit``/``tracked_jit`` is a compile site the
+  PR-5 tracker cannot see: its recompiles show up only as mysteriously
+  slow steps.
+* ``recompile-hazard`` — the three statically-visible recompile causes
+  the tracker's cause diffs keep naming after the fact: Python scalars
+  closed over inside jitted fns (baked into the trace), shape-dependent
+  Python branching (one program per shape class), and ``static_argnums``
+  pointing at array-valued parameters (hashed by value — a recompile per
+  batch).
+* ``host-sync-hot-path`` — ``float()`` / ``.item()`` / ``np.asarray`` /
+  ``device_get`` / ``block_until_ready`` reachable from ``train_step``
+  serializes device and host; only the declared telemetry fences may do
+  it (config ``host_sync_allow`` + inline suppressions).
+* ``donated-after-use`` — an array passed at a donated position is dead
+  the moment the call dispatches; a later read is use-after-free that
+  XLA may or may not catch depending on backend.
+* ``raw-collective`` — a ``jax.lax`` collective outside ``comm/``
+  bypasses the CommsLogger and silently corrupts the PR-3 desync
+  ledger's call-site sequence (two ranks tracing different censuses is
+  indistinguishable from a real desync).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .core import (AnalysisConfig, Finding, Rule, SourceModule, call_name,
+                   dotted_name, parse_root_spec, register)
+
+# ---------------------------------------------------------------------------
+# shared jit-site discovery
+# ---------------------------------------------------------------------------
+
+#: data-moving collectives (axis_index/axis_size are topology queries —
+#: no bytes move, the ledger doesn't want them)
+COLLECTIVE_OPS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                  "psum_scatter", "all_to_all", "ppermute"}
+
+SYNC_CALLS = {"jax.device_get", "jax.block_until_ready",
+              "np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+SYNC_METHODS = {"item", "block_until_ready"}
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name in ("jax.jit", "jit")
+
+
+def _is_jit_wrapper(call: ast.Call, cfg: AnalysisConfig) -> bool:
+    """Any jit-ish call: jax.jit OR a tracked wrapper (tracked_jit,
+    self._jit, engine._jit...)."""
+    if _is_jax_jit(call):
+        return True
+    name = call_name(call)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in cfg.tracked_jit_names
+
+
+def _jit_target(mod: SourceModule, call: ast.Call
+                ) -> Optional[ast.AST]:
+    """The function being jitted: an inline Lambda/FunctionDef, resolved
+    by name within the module when possible."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Lambda):
+        return arg
+    name = dotted_name(arg)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == leaf:
+            return node
+    return None
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+# ---------------------------------------------------------------------------
+# untracked-jit
+# ---------------------------------------------------------------------------
+
+
+def _check_untracked_jit(mods: List[SourceModule],
+                         cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    roots = tuple(r.rstrip("/") + "/" for r in cfg.jit_roots)
+    for mod in mods:
+        if not mod.rel.startswith(roots):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _is_jax_jit(node)):
+                continue
+            qual = mod.qualname(node)
+            leaf = qual.rsplit(".", 1)[-1] if qual else ""
+            if leaf in cfg.tracked_jit_names:
+                continue  # this IS the tracked wrapper
+            out.append(mod.finding(
+                "untracked-jit", node,
+                f"jax.jit bypasses the compile tracker — route through "
+                f"tracked_jit(fn, site=..., tracker=get_compile_tracker()) "
+                f"or engine._jit so recompiles at this site are recorded "
+                f"with cause diffs"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def _shape_bearing_names(fn: ast.AST, params: Set[str]) -> Set[str]:
+    """Local names assigned from expressions that mention ``.shape`` (or
+    ``len(<param>)``/``np.shape``) — transitively shape-derived."""
+    derived: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _mentions_shape(node.value, params, derived):
+                continue
+            for tgt in node.targets:
+                for name_node in ast.walk(tgt):
+                    if isinstance(name_node, ast.Name) \
+                            and name_node.id not in derived:
+                        derived.add(name_node.id)
+                        changed = True
+    return derived
+
+
+def _mentions_shape(expr: ast.AST, params: Set[str],
+                    derived: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("np.shape", "numpy.shape", "jnp.shape"):
+                return True
+            if name == "len" and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                return True
+        if isinstance(node, ast.Name) and node.id in derived:
+            return True
+    return False
+
+
+def _enclosing_function(mod: SourceModule,
+                        target: ast.AST) -> Optional[ast.AST]:
+    """The innermost FunctionDef strictly containing ``target``."""
+    best: Optional[ast.AST] = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not target:
+            if any(child is target for child in ast.walk(node)):
+                if best is None or (node.lineno > best.lineno):
+                    best = node
+    return best
+
+
+def _check_recompile_hazard(mods: List[SourceModule],
+                            cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in mods:
+        for call in ast.walk(mod.tree):
+            if not (isinstance(call, ast.Call)
+                    and _is_jit_wrapper(call, cfg)):
+                continue
+            fn = _jit_target(mod, call)
+
+            # (c) static_argnums/static_argnames over array-valued params
+            for kw in call.keywords:
+                if kw.arg == "static_argnums" and fn is not None:
+                    params = _params_of(fn)
+                    for idx in _int_elems(kw.value):
+                        if 0 <= idx < len(params) \
+                                and cfg.arrayish(params[idx]):
+                            out.append(mod.finding(
+                                "recompile-hazard", call,
+                                f"static_argnums={idx} points at "
+                                f"parameter '{params[idx]}' which looks "
+                                f"array-valued — static args are hashed "
+                                f"by VALUE, so every new array is a "
+                                f"recompile (and unhashable arrays are a "
+                                f"TypeError)"))
+                if kw.arg == "static_argnames":
+                    for name in _str_elems(kw.value):
+                        if cfg.arrayish(name):
+                            out.append(mod.finding(
+                                "recompile-hazard", call,
+                                f"static_argnames '{name}' looks "
+                                f"array-valued — static args are hashed "
+                                f"by VALUE, so every new array is a "
+                                f"recompile"))
+
+            if fn is None:
+                continue
+            params = set(_params_of(fn))
+
+            # (a) shape-dependent Python branching inside the jitted fn
+            derived = _shape_bearing_names(fn, params)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)) \
+                        and _mentions_shape(node.test, params, derived):
+                    out.append(mod.finding(
+                        "recompile-hazard", node,
+                        f"Python `{type(node).__name__.lower()}` on a "
+                        f"traced shape inside a jitted function — every "
+                        f"distinct shape class traces a separate program "
+                        f"(the PR-5 tracker will log these as "
+                        f"shape_change recompiles); hoist the branch out "
+                        f"of the jit or pad to a fixed shape"))
+
+            # (b) Python scalars closed over from the enclosing function
+            enclosing = _enclosing_function(mod, fn)
+            target_fn = fn
+            if enclosing is not None:
+                scalar_locals = _scalar_locals(enclosing)
+                local_names = _bound_names(target_fn) | params
+                reported: Set[str] = set()
+                for node in ast.walk(target_fn):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.id in scalar_locals \
+                            and node.id not in local_names \
+                            and node.id not in reported:
+                        reported.add(node.id)
+                        out.append(mod.finding(
+                            "recompile-hazard", node,
+                            f"Python scalar '{node.id}' closed over "
+                            f"inside a jitted function is baked into the "
+                            f"trace — a different value silently "
+                            f"recompiles (pass it as a traced argument, "
+                            f"or name it in static_context so the "
+                            f"tracker's cause diff can say so)"))
+    return out
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _str_elems(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _scalar_locals(fn: ast.AST) -> Set[str]:
+    """Names the enclosing function binds to Python scalars: numeric
+    literals, int()/float()/len() results, or for-loop indices."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_scalar_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, ast.Call) \
+                and call_name(node.iter) == "range":
+            out.add(node.target.id)
+    return out
+
+
+def _is_scalar_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant) \
+            and isinstance(expr.value, (int, float)) \
+            and not isinstance(expr.value, bool):
+        return True
+    if isinstance(expr, ast.Call) \
+            and call_name(expr) in ("int", "float", "len"):
+        return True
+    if isinstance(expr, ast.BinOp):
+        return _is_scalar_expr(expr.left) or _is_scalar_expr(expr.right)
+    return False
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-sync-hot-path
+# ---------------------------------------------------------------------------
+
+
+class _ModuleIndex:
+    """Name → def tables for one module (methods keyed per class)."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.functions: Dict[str, ast.AST] = {}
+        self.methods: Dict[str, Dict[str, ast.AST]] = {}
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                table = self.methods.setdefault(node.name, {})
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        table[item.name] = item
+
+    def resolve(self, qual: str) -> Optional[ast.AST]:
+        if "." in qual:
+            cls, _, meth = qual.partition(".")
+            return self.methods.get(cls, {}).get(meth)
+        return self.functions.get(qual)
+
+
+def _check_host_sync(mods: List[SourceModule],
+                     cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    by_rel = {m.rel: m for m in mods}
+    allow = set(cfg.host_sync_allow)
+
+    def allowed(qual: str) -> bool:
+        return qual in allow or qual.rsplit(".", 1)[-1] in allow
+
+    for spec in cfg.hot_path_roots:
+        rel, root_qual = parse_root_spec(spec)
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        index = _ModuleIndex(mod)
+        root = index.resolve(root_qual)
+        if root is None:
+            continue
+        cls_name = root_qual.partition(".")[0] if "." in root_qual else None
+        # reachability: same-class methods via self.X(), same-module
+        # functions by name.  Cross-module descent is deliberately out of
+        # scope (name-based guessing across files produces noise, and the
+        # hot path's host syncs live in the engine module); add more
+        # hot_path_roots to cover indirection.
+        seen: Set[str] = set()
+        queue: List[Tuple[str, ast.AST]] = [(root_qual, root)]
+        while queue:
+            qual, fn = queue.pop()
+            if qual in seen or allowed(qual):
+                continue
+            seen.add(qual)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if name.startswith("self.") and name.count(".") == 1 \
+                        and cls_name is not None:
+                    meth = name.split(".", 1)[1]
+                    target = index.methods.get(cls_name, {}).get(meth)
+                    if target is not None:
+                        queue.append((f"{cls_name}.{meth}", target))
+                elif "." not in name and name in index.functions:
+                    queue.append((name, index.functions[name]))
+                # sync detection at this call site
+                msg = _sync_message(node, name)
+                if msg is not None:
+                    out.append(mod.finding(
+                        "host-sync-hot-path", node,
+                        f"{msg} reachable from {root_qual} — a device→"
+                        f"host sync serializes dispatch on the step hot "
+                        f"path; move it behind the telemetry fence "
+                        f"(host_sync_allow) or out of the step"))
+    return out
+
+
+def _sync_message(call: ast.Call, name: str) -> Optional[str]:
+    if name in SYNC_CALLS:
+        return f"{name}(...)"
+    leaf = name.rsplit(".", 1)[-1]
+    if "." in name and leaf in SYNC_METHODS:
+        return f".{leaf}()"
+    if name == "float" and call.args \
+            and not isinstance(call.args[0], ast.Constant):
+        return "float(<traced value>)"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# donated-after-use
+# ---------------------------------------------------------------------------
+
+
+def _donate_spec(call: ast.Call) -> Tuple[Tuple[int, ...],
+                                          Tuple[str, ...]]:
+    """(positions, keyword names) donated by a jit call — both spellings
+    can appear on one call and donate different arguments."""
+    pos: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            pos = tuple(_int_elems(kw.value))
+        elif kw.arg == "donate_argnames":
+            names = tuple(_str_elems(kw.value))
+    return pos, names
+
+
+def _check_donated_reuse(mods: List[SourceModule],
+                         cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in mods:
+        for scope in ast.walk(mod.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            # donated callables bound in THIS scope: name -> (pos, names)
+            donators: Dict[str, Tuple[Tuple[int, ...],
+                                      Tuple[str, ...]]] = {}
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_jit_wrapper(node.value, cfg):
+                    spec = _donate_spec(node.value)
+                    if not (spec[0] or spec[1]):
+                        continue
+                    for tgt in node.targets:
+                        name = dotted_name(tgt)
+                        if name is not None:
+                            donators[name] = spec
+            if not donators:
+                continue
+            # call sites + later reads of the donated argument
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func)
+                if callee not in donators:
+                    continue
+                d_pos, d_names = donators[callee]
+                donated_args = []
+                for pos in d_pos:
+                    if pos < len(node.args):
+                        donated_args.append((f"position {pos}",
+                                             node.args[pos]))
+                for kw in node.keywords:
+                    if kw.arg in d_names:
+                        donated_args.append((f"argname '{kw.arg}'",
+                                             kw.value))
+                for where, arg in donated_args:
+                    donated = dotted_name(arg)
+                    if donated is None:
+                        continue
+                    # `x = f(x)` rebinds the name to the RESULT — later
+                    # reads see the new buffer, not the donated one
+                    rebound = _rebinds(scope, node, donated)
+                    if rebound:
+                        continue
+                    for later in ast.walk(scope):
+                        if getattr(later, "lineno", 0) <= node.lineno:
+                            continue
+                        if isinstance(later, (ast.Name, ast.Attribute)) \
+                                and isinstance(getattr(later, "ctx", None),
+                                               ast.Load) \
+                                and dotted_name(later) == donated:
+                            out.append(mod.finding(
+                                "donated-after-use", later,
+                                f"'{donated}' was donated to "
+                                f"{callee}(...) (donate {where}) "
+                                f"and read afterwards — donated buffers "
+                                f"are invalidated at dispatch; rebind "
+                                f"the result or drop the donation"))
+                            break
+    return out
+
+
+def _rebinds(scope: ast.AST, call: ast.Call, name: str) -> bool:
+    """Does any assignment in ``scope`` whose value contains ``call``
+    rebind ``name``?  (the `x = f(x)` / `self.pool = f(self.pool)`
+    donation idiom)"""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) \
+                and any(child is call for child in ast.walk(node.value)):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if dotted_name(sub) == name:
+                        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# raw-collective
+# ---------------------------------------------------------------------------
+
+
+def _check_raw_collective(mods: List[SourceModule],
+                          cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    home = cfg.collective_home.rstrip("/") + "/"
+    for mod in mods:
+        if mod.rel.startswith(home):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if parts[-1] in COLLECTIVE_OPS and len(parts) >= 2 \
+                    and parts[-2] == "lax":
+                verb = {"psum_scatter": "reduce_scatter_in_graph",
+                        "all_gather": "all_gather_in_graph",
+                        "all_to_all": "all_to_all_in_graph"}.get(
+                            parts[-1], parts[-1])
+                fix = (f"use deepspeed_tpu.comm.{verb}"
+                       if parts[-1] != "pmin" else
+                       "comm/ has no pmin verb yet — add an instrumented "
+                       "wrapper there (mirroring pmax) rather than "
+                       "calling lax directly")
+                out.append(mod.finding(
+                    "raw-collective", node,
+                    f"raw {name} outside comm/ bypasses the CommsLogger "
+                    f"— it never reaches the CollectiveLedger, so two "
+                    f"ranks tracing it see different censuses and the "
+                    f"desync detector reports a phantom divergence; "
+                    f"{fix}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registration
+# ---------------------------------------------------------------------------
+
+register(Rule(
+    id="untracked-jit", family="lint",
+    summary="jax.jit in runtime//inference/ outside the compile tracker",
+    explain=(
+        "PR 5 wired every ENGINE jit site through tracked_jit so each "
+        "compile/recompile lands in the tracker with a structured cause "
+        "diff.  Any jax.jit under runtime/ or inference/ that does not "
+        "ride that path is a blind spot: its recompiles burn step time "
+        "with no event, no cause, no bundle entry.  Fix: "
+        "tracked_jit(fn, site='pkg/what', tracker=get_compile_tracker(), "
+        "**jit_kwargs) — with tracking disabled this IS jax.jit, so the "
+        "rewrite costs nothing.  Config: jit_roots, tracked_jit_names."),
+    check=_check_untracked_jit))
+
+register(Rule(
+    id="recompile-hazard", family="lint",
+    summary="trace-baked Python scalars, shape branches, static arrays",
+    explain=(
+        "Three statically-visible causes of the recompiles the PR-5 "
+        "tracker keeps diagnosing at runtime: (1) a Python scalar closed "
+        "over inside a jitted fn is baked into the trace — changing it "
+        "recompiles with a 'static' cause at best, silently at worst; "
+        "(2) an `if`/`while` on a traced .shape forks one XLA program "
+        "per shape class; (3) static_argnums over an array-valued "
+        "parameter hashes arrays by value — a recompile per batch.  "
+        "Findings here are heuristic (name-based resolution, no type "
+        "inference): suppress with `# dslint: disable=recompile-hazard` "
+        "where the scalar is deliberately static and named in "
+        "static_context.  Config: array_param_names."),
+    check=_check_recompile_hazard))
+
+register(Rule(
+    id="host-sync-hot-path", family="lint",
+    summary="device→host syncs reachable from train_step",
+    explain=(
+        "float()/.item()/np.asarray/jax.device_get/block_until_ready on "
+        "the step hot path force the host to wait for the device and "
+        "kill dispatch pipelining — the goodput ledger then charges the "
+        "wait to 'productive' time where nobody looks for it.  The "
+        "engine's DELIBERATE fences (device-true step timing for "
+        "telemetry/autotuning) are declared in host_sync_allow or "
+        "suppressed inline where the fence is the point.  Reachability "
+        "is same-module only (self.* methods + module functions from "
+        "each hot_path_roots entry); add roots to cover indirection."),
+    check=_check_host_sync))
+
+register(Rule(
+    id="donated-after-use", family="lint",
+    summary="reads of a buffer after passing it at a donated position",
+    explain=(
+        "donate_argnums hands the argument's buffer to XLA for reuse — "
+        "after the call dispatches, the Python array is logically dead. "
+        "Reading it again returns garbage or raises depending on "
+        "backend/timing (the worst kind of bug: passes on CPU tests, "
+        "corrupts on TPU).  The rule tracks donated callables bound in "
+        "the same function scope (f = jax.jit(..., donate_argnums=...)) "
+        "and flags later reads of donated arguments; the `x = f(x)` "
+        "rebinding idiom is recognized as safe."),
+    check=_check_donated_reuse))
+
+register(Rule(
+    id="raw-collective", family="lint",
+    summary="jax.lax collectives invoked outside comm/",
+    explain=(
+        "comm/ wraps every in-graph collective so the CommsLogger census "
+        "feeds the CollectiveLedger — the hash-chained per-rank sequence "
+        "the PR-3 desync detector compares across hosts.  A raw jax.lax "
+        "collective anywhere else is invisible to that census: ranks "
+        "executing it still move bytes, but their ledgers no longer "
+        "describe the same program, so first-divergence analysis points "
+        "at the wrong collective.  Fix: the matching comm verb (psum, "
+        "pmean, pmax, all_gather_in_graph, reduce_scatter_in_graph, "
+        "all_to_all_in_graph, ppermute) — same lax op underneath, plus "
+        "the census record.  axis_index/axis_size are topology queries, "
+        "not collectives, and are not flagged.  Config: collective_home."),
+    check=_check_raw_collective))

@@ -1,0 +1,196 @@
+"""Instrumented-lock shim — lock-order inversion caught at test time.
+
+The static audit (:mod:`.races`) sees missing locks; it cannot see the
+dual failure, *deadlock by inconsistent acquisition order* (thread A
+takes L1→L2, thread B takes L2→L1 — each waits on the other under
+load, never in the fast unit test).  This shim catches the ORDER, which
+is visible on every single-threaded pass through the code:
+
+* :class:`LockOrderMonitor` keeps a process-wide directed graph of
+  observed acquisition edges (holding A while acquiring B ⇒ edge A→B).
+  An acquisition that would close a cycle raises
+  :class:`LockOrderInversion` immediately — no actual deadlock needed.
+* :class:`InstrumentedLock` wraps ``threading.Lock``/``RLock`` and
+  reports to a monitor.
+* :func:`instrument_locks` is the test harness entry: a context manager
+  that monkeypatches ``threading.Lock``/``RLock`` so every lock built
+  inside it (watchdog, metrics registry, flight recorder...) is
+  instrumented, named by its creation site.
+
+Test-time only by design: the bookkeeping is a dict hit per acquire —
+fine for tests, not for the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderInversion(AssertionError):
+    """Two locks were taken in both orders — a latent deadlock."""
+
+
+#: the real primitives, captured at import — InstrumentedLock must keep
+#: working while instrument_locks() has the module-level names patched
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderMonitor:
+    """Process-wide acquisition-order graph over instrumented locks."""
+
+    def __init__(self) -> None:
+        self._graph_mu = _REAL_LOCK()
+        #: edge (a, b): some thread held a while acquiring b
+        self._edges: Dict[str, Set[str]] = {}
+        #: first stack that created each edge, for the error message
+        self._witness: Dict[Tuple[str, str], str] = {}
+        self._held = threading.local()
+
+    # -- per-thread held stack --------------------------------------------
+
+    def _stack(self) -> List[str]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def acquired(self, name: str) -> None:
+        stack = self._stack()
+        # RLock re-entry (the lock is ANYWHERE in the held stack, not
+        # just on top) can never block — no ordering edge
+        if stack and name not in stack:
+            self._add_edge(stack[-1], name)
+        stack.append(name)
+
+    def released(self, name: str) -> None:
+        stack = self._stack()
+        # release may be out of LIFO order (rare but legal) — drop the
+        # newest matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- the graph ---------------------------------------------------------
+
+    def _add_edge(self, a: str, b: str) -> None:
+        with self._graph_mu:
+            if b in self._edges.setdefault(a, set()):
+                return
+            path = self._find_path(b, a)
+            if path is not None:
+                chain = " -> ".join(path + [b])
+                prior = self._witness.get((path[0], path[1])) if \
+                    len(path) > 1 else None
+                raise LockOrderInversion(
+                    f"lock-order inversion: acquiring '{b}' while "
+                    f"holding '{a}', but the reverse order "
+                    f"{chain} was already observed"
+                    + (f"\nfirst observed at:\n{prior}" if prior else ""))
+            self._edges[a].add(b)
+            self._witness[(a, b)] = "".join(
+                traceback.format_stack(limit=8)[:-2])
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS path src→dst in the edge graph (caller holds _graph_mu)."""
+        seen = {src}
+        stack: List[List[str]] = [[src]]
+        while stack:
+            path = stack.pop()
+            node = path[-1]
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(path + [nxt])
+        return None
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._graph_mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+
+class InstrumentedLock:
+    """A Lock/RLock that reports acquisition order to a monitor.
+
+    Duck-types the threading lock surface the repo uses (acquire/
+    release/context manager/locked).  ``name`` must be UNIQUE per lock
+    object: the monitor distinguishes RLock re-entry from a second lock
+    by name, so two locks sharing one name would alias in the graph and
+    hide inter-instance inversions (:func:`instrument_locks` guarantees
+    uniqueness with a per-lock counter)."""
+
+    def __init__(self, monitor: LockOrderMonitor, name: str,
+                 reentrant: bool = False):
+        self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._monitor = monitor
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # order is checked BEFORE blocking: the inversion must surface
+        # even when this run wins the race that would deadlock another
+        self._monitor.acquired(self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            self._monitor.released(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._monitor.released(self.name)
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked is not None else False
+
+
+@contextlib.contextmanager
+def instrument_locks(monitor: Optional[LockOrderMonitor] = None):
+    """Swap ``threading.Lock``/``RLock`` for instrumented ones, named by
+    creation site (``file:line``).  Yields the monitor so the test can
+    assert on :meth:`LockOrderMonitor.edges` — an inversion raises
+    :class:`LockOrderInversion` from the acquiring thread the moment the
+    cycle would close.
+
+    Restores the real constructors on exit; locks created inside keep
+    working (they wrap real primitives)."""
+    mon = monitor or LockOrderMonitor()
+    real_lock, real_rlock = _REAL_LOCK, _REAL_RLOCK
+    counter = itertools.count()
+
+    def _site() -> str:
+        for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+            if __file__ not in frame.filename \
+                    and "threading" not in frame.filename:
+                return f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+        return "unknown"
+
+    # the #N suffix keeps names unique across instances created at ONE
+    # site (`self._lock = threading.Lock()` in __init__): without it,
+    # holding inst1's lock while taking inst2's would read as re-entry
+    # and the classic inter-instance A->B/B->A deadlock would be
+    # invisible to the graph
+    def make_lock():
+        return InstrumentedLock(mon, f"Lock@{_site()}#{next(counter)}")
+
+    def make_rlock():
+        return InstrumentedLock(mon, f"RLock@{_site()}#{next(counter)}",
+                                reentrant=True)
+
+    threading.Lock, threading.RLock = make_lock, make_rlock
+    try:
+        yield mon
+    finally:
+        threading.Lock, threading.RLock = real_lock, real_rlock

@@ -1,0 +1,311 @@
+"""Thread-safety audit — shared mutable attributes off the lock.
+
+The stack runs real threads: the hang-watchdog poll loop, the bundle
+publisher daemon, async checkpoint/snapshot flush workers, the offload
+update pool.  PR 4's in-flight save registry exists because one
+unlocked cross-thread read shipped; this audit finds the same shape in
+source before it ships.
+
+Method (per class, pure AST):
+
+1. **Thread entry points** — methods passed to ``threading.Thread(
+   target=...)``/``Timer``/``Executor.submit`` anywhere in the module,
+   plus the config's ``thread_roots`` (callback indirection the AST
+   cannot see, e.g. the watchdog tick driven by a fake clock in tests).
+2. **Reachability** — closure of ``self.X()`` calls from those entries:
+   everything those methods run executes on a non-main thread.
+3. **Attribute table** — every ``self.attr`` read/write per method,
+   annotated with the set of lock attributes held (``with self._lock:``
+   blocks, lock-ness decided by the config's ``lock_name_patterns``).
+4. **Findings** — an attribute WRITTEN on a thread path and touched in
+   any other method where the two accesses share no common lock.
+   ``__init__`` accesses are exempt (they happen before the thread
+   exists); attributes never written after ``__init__`` are exempt
+   (immutable-after-publish).
+
+This is an over-approximation by construction (no happens-before, no
+Event-gating recognition) — that is what the baseline's per-entry
+justification field is for: every surviving finding is either fixed
+with a lock or explained in writing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (AnalysisConfig, Finding, Rule, SourceModule, call_name,
+                   dotted_name, parse_root_spec, register)
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    kind: str          # "read" | "write"
+    line: int
+    locks: frozenset   # lock attr names held at this access
+
+
+class _ClassAudit:
+    """Attribute-access table + thread reachability for one class."""
+
+    def __init__(self, mod: SourceModule, node: ast.ClassDef,
+                 cfg: AnalysisConfig):
+        self.mod = mod
+        self.node = node
+        self.cfg = cfg
+        self.methods: Dict[str, ast.AST] = {
+            item.name: item for item in node.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        #: method -> accesses
+        self.table: Dict[str, List[_Access]] = {
+            name: self._accesses(fn) for name, fn in self.methods.items()}
+        self.entries: Set[str] = set()
+
+    # -- access extraction -------------------------------------------------
+
+    def _accesses(self, fn: ast.AST) -> List[_Access]:
+        out: List[_Access] = []
+        self._visit(fn.body, frozenset(), out)
+        return out
+
+    def _visit(self, stmts: List[ast.stmt], locks: frozenset,
+               out: List[_Access]) -> None:
+        for stmt in stmts:
+            held = locks
+            if isinstance(stmt, ast.With):
+                acquired = set()
+                for item in stmt.items:
+                    name = dotted_name(item.context_expr)
+                    if name and name.startswith("self.") \
+                            and self.cfg.lock_like(name[5:]):
+                        acquired.add(name[5:])
+                if acquired:
+                    self._collect_exprs(stmt.items, held, out)
+                    self._visit(stmt.body, held | frozenset(acquired), out)
+                    continue
+            # expressions on this statement (incl. nested defs' bodies —
+            # a closure handed to a thread shares the same attrs)
+            self._collect_exprs([stmt], held, out,
+                                skip_bodies=isinstance(
+                                    stmt, (ast.With, ast.If, ast.For,
+                                           ast.While, ast.Try)))
+            for child_block in _child_blocks(stmt):
+                self._visit(child_block, held, out)
+
+    def _collect_exprs(self, nodes, locks: frozenset,
+                       out: List[_Access], skip_bodies: bool = False
+                       ) -> None:
+        for root in nodes:
+            for node in ast.walk(root) if not skip_bodies \
+                    else _walk_no_blocks(root):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    if self.cfg.lock_like(node.attr):
+                        continue  # the lock object itself
+                    kind = ("write" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read")
+                    out.append(_Access(node.attr, kind, node.lineno, locks))
+                # augmented assign parses target as Store only; the read
+                # half of `self.x += 1` must count too
+                if isinstance(node, ast.AugAssign) \
+                        and isinstance(node.target, ast.Attribute) \
+                        and isinstance(node.target.value, ast.Name) \
+                        and node.target.value.id == "self" \
+                        and not self.cfg.lock_like(node.target.attr):
+                    out.append(_Access(node.target.attr, "read",
+                                       node.lineno, locks))
+
+    # -- thread reachability ----------------------------------------------
+
+    def find_entries(self) -> None:
+        """Methods handed to Thread/Timer/submit anywhere in this class."""
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                cands: List[ast.AST] = []
+                if leaf in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            cands.append(kw.value)
+                    if leaf == "Timer" and len(node.args) >= 2:
+                        cands.append(node.args[1])
+                elif leaf == "submit" and node.args:
+                    cands.append(node.args[0])
+                elif leaf == "add_done_callback" and node.args:
+                    cands.append(node.args[0])
+                for cand in cands:
+                    target = dotted_name(cand)
+                    if target and target.startswith("self."):
+                        meth = target[5:]
+                        if meth in self.methods:
+                            self.entries.add(meth)
+                    elif isinstance(cand, ast.Name) \
+                            and cand.id in _local_defs(fn):
+                        # a nested closure runs on the thread; its
+                        # self.X() calls count as entries too
+                        for sub in ast.walk(_local_defs(fn)[cand.id]):
+                            if isinstance(sub, ast.Call):
+                                sname = call_name(sub) or ""
+                                if sname.startswith("self.") \
+                                        and sname[5:] in self.methods:
+                                    self.entries.add(sname[5:])
+
+    def thread_reachable(self) -> Set[str]:
+        seen: Set[str] = set()
+        queue = list(self.entries)
+        while queue:
+            meth = queue.pop()
+            if meth in seen or meth not in self.methods:
+                continue
+            seen.add(meth)
+            for node in ast.walk(self.methods[meth]):
+                if isinstance(node, ast.Call):
+                    name = call_name(node) or ""
+                    if name.startswith("self.") and name.count(".") == 1:
+                        queue.append(name[5:])
+        return seen
+
+
+def _child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    blocks = []
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            blocks.append(b)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    return blocks
+
+
+def _walk_no_blocks(root: ast.AST):
+    """Walk one statement's expressions without descending into nested
+    statement blocks (those are visited with their own lock context)."""
+    stack = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(node, ast.stmt) \
+                and _child_blocks(node):
+            continue
+        first = False
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt) and _child_blocks(child):
+                continue
+            stack.append(child)
+
+
+def _local_defs(fn: ast.AST) -> Dict[str, ast.AST]:
+    return {n.name: n for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn}
+
+
+def _check_thread_safety(mods: List[SourceModule],
+                         cfg: AnalysisConfig) -> List[Finding]:
+    out: List[Finding] = []
+    import fnmatch as _fn
+    roots_by_rel: Dict[str, Set[str]] = {}
+    for spec in cfg.thread_roots:
+        rel, qual = parse_root_spec(spec)
+        roots_by_rel.setdefault(rel, set()).add(qual)
+    for mod in mods:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            audit = _ClassAudit(mod, node, cfg)
+            audit.find_entries()
+            for qual in roots_by_rel.get(mod.rel, ()):
+                cls, _, meth = qual.partition(".")
+                if cls == node.name and meth in audit.methods:
+                    audit.entries.add(meth)
+            if not audit.entries:
+                continue
+            reach = audit.thread_reachable()
+            findings = _shared_attr_findings(audit, reach, cfg)
+            for attr, writer, wline, other, locks_msg in findings:
+                if any(_fn.fnmatch(attr, pat)
+                       for pat in cfg.races_ignore_attrs):
+                    continue
+                f = Finding(
+                    rule="thread-unsafe-attr", path=mod.rel, line=wline,
+                    symbol=f"{node.name}.{writer}",
+                    message=(
+                        f"self.{attr} is written on a thread path "
+                        f"({node.name}.{writer}) and accessed in "
+                        f"{node.name}.{other} with no common lock"
+                        f"{locks_msg} — torn/stale reads across the "
+                        f"{'/'.join(sorted(audit.entries))} thread "
+                        f"boundary"))
+                if not mod.suppressed(f.rule, wline):
+                    out.append(f)
+    return out
+
+
+def _shared_attr_findings(audit: _ClassAudit, reach: Set[str],
+                          cfg: AnalysisConfig
+                          ) -> List[Tuple[str, str, int, str, str]]:
+    # attr -> [(method, access)]
+    by_attr: Dict[str, List[Tuple[str, _Access]]] = {}
+    for meth, accesses in audit.table.items():
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append((meth, acc))
+    results: List[Tuple[str, str, int, str, str]] = []
+    seen_attr: Set[str] = set()
+    for attr, uses in sorted(by_attr.items()):
+        # __init__ happens before any thread exists
+        live = [(m, a) for m, a in uses if m != "__init__"]
+        thread_writes = [(m, a) for m, a in live
+                         if m in reach and a.kind == "write"]
+        if not thread_writes:
+            continue
+        others = [(m, a) for m, a in live
+                  if m not in reach or (m, a.line) not in
+                  {(tm, ta.line) for tm, ta in thread_writes}]
+        # at least one access OUTSIDE the writing method
+        cross = [(m, a) for m, a in others
+                 if m not in {tm for tm, _ in thread_writes}]
+        if not cross:
+            continue
+        for wm, wa in thread_writes:
+            for om, oa in cross:
+                if wa.locks & oa.locks:
+                    continue
+                if attr in seen_attr:
+                    break
+                seen_attr.add(attr)
+                locks_msg = ""
+                if wa.locks or oa.locks:
+                    locks_msg = (f" (writer holds "
+                                 f"{sorted(wa.locks) or 'nothing'}, "
+                                 f"{om} holds "
+                                 f"{sorted(oa.locks) or 'nothing'})")
+                results.append((attr, wm, wa.line, om, locks_msg))
+                break
+    return results
+
+
+register(Rule(
+    id="thread-unsafe-attr", family="races",
+    summary="shared mutable attrs written on a thread path off the lock",
+    explain=(
+        "Builds an attribute-access table over every class that hands a "
+        "method to threading.Thread/Timer/Executor.submit (plus the "
+        "config's thread_roots for callback indirection), closes "
+        "reachability over self.X() calls, and flags attributes written "
+        "on a thread path and touched elsewhere with no common lock "
+        "held.  Lock-ness of `with self.<attr>:` is decided by "
+        "lock_name_patterns; __init__ accesses are exempt "
+        "(pre-thread), as are attributes never written after __init__.  "
+        "The analysis has no happens-before model — Event-gated and "
+        "join()-ordered accesses are reported anyway — so every real "
+        "finding is either fixed with the class's lock or baselined "
+        "with a written justification (the baseline file's "
+        "`justification` field)."),
+    check=_check_thread_safety))

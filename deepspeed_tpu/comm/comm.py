@@ -508,8 +508,13 @@ def init_distributed(dist_backend: str = "xla",
             # substrate for multi-controller runs; TPU rides ICI/DCN and
             # ignores this).  Must be set before the backend exists.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:
-            pass  # backend already up or knob absent — TPU path
+        except Exception as e:
+            # backend already up or knob absent — TPU path
+            from ..utils.logging import debug_once
+
+            debug_once("comm/gloo_knob",
+                       f"jax_cpu_collectives_implementation not set "
+                       f"({e!r}); TPU path or backend already built")
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -31,7 +31,7 @@ import sys
 import time
 from typing import Any, Callable, List, Optional
 
-from ..utils.logging import log_dist, logger
+from ..utils.logging import debug_once, log_dist, logger
 from .rendezvous import ElasticRendezvous, RendezvousClient, RendezvousServer
 
 
@@ -131,14 +131,20 @@ class DSElasticAgent:
         if pub is not None:
             try:
                 pub.tick(self.rdzv.c)
-            except Exception:
-                pass  # store hiccup / dump failure; the next tick retries
+            except Exception as e:
+                # store hiccup / dump failure; the next tick retries
+                debug_once("elastic/publisher_tick",
+                           f"bundle publisher tick failed ({e!r}); "
+                           f"retrying next heartbeat")
         if self._rank == 0 and len(self._peers) > 1:
             try:
                 self.rdzv.publish_straggler_stats(self._peers)
                 check_desync_live(self.rdzv.c, self._peers)
-            except Exception:
-                pass  # store hiccup; the next tick retries
+            except Exception as e:
+                # store hiccup; the next tick retries
+                debug_once("elastic/straggler_stats",
+                           f"straggler/desync publication failed ({e!r}); "
+                           f"retrying next heartbeat")
 
     def _record_stale_peers(self, stale: List[str]) -> None:
         """Satellite (ISSUE 2): stale-peer detections at the AGENT level
@@ -190,8 +196,10 @@ class DSElasticAgent:
 
         try:
             jax.distributed.shutdown()
-        except Exception:
-            pass  # not initialized yet
+        except Exception as e:
+            # not initialized yet
+            debug_once("elastic/dist_shutdown",
+                       f"jax.distributed.shutdown before re-init: {e!r}")
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
@@ -268,8 +276,11 @@ class DSElasticAgent:
                         self.rdzv.bump_round(f"stale peers {stale}")
                         round_moved.set()
                         return
-                except Exception:
-                    pass  # store hiccup — keep the attempt running
+                except Exception as e:
+                    # store hiccup — keep the attempt running
+                    debug_once("elastic/heartbeat_beat",
+                               f"worker heartbeat failed ({e!r}); "
+                               f"retrying next interval")
 
         t = threading.Thread(target=beat, daemon=True)
         t.start()

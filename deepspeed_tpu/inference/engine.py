@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..parallel.mesh import MeshLayout
+from ..telemetry.perf import get_compile_tracker, tracked_jit
 from ..utils import groups as groups_mod
 from ..utils.logging import log_dist
 
@@ -52,8 +53,10 @@ class InferenceEngine:
                 lambda s: NamedSharding(mesh, s), specs)
             params = jax.device_put(params, shardings)
         self.params = params
-        self._prefill = jax.jit(model.prefill)
-        self._decode = jax.jit(model.decode_step)
+        self._prefill = tracked_jit(model.prefill, "inference/prefill",
+                                    tracker=get_compile_tracker())
+        self._decode = tracked_jit(model.decode_step, "inference/decode",
+                                   tracker=get_compile_tracker())
         log_dist(f"init_inference: tp={tp} dtype={config.dtype} "
                  f"kernel_inject={config.replace_with_kernel_inject}")
 

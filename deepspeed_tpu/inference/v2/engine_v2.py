@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.pallas.paged_attention import paged_decode_attention
+from ...telemetry.perf import get_compile_tracker, tracked_jit
 from ...utils.logging import log_dist
 from .adapters import ModelAdapterV2, make_adapter
 from .kv_cache import KVCacheConfig, init_kv_pool
@@ -116,8 +117,9 @@ class RaggedInferenceEngineV2:
             pool_sharding = NamedSharding(
                 mesh, PartitionSpec(None, None, None, "tensor", None))
             ad, cc = self.adapter, self.cache_config
-            self.pool = jax.jit(
-                lambda: init_kv_pool(ad, cc),
+            self.pool = tracked_jit(
+                lambda: init_kv_pool(ad, cc), "inference_v2/pool_init",
+                tracker=get_compile_tracker(),
                 out_shardings={"k": pool_sharding, "v": pool_sharding})()
         else:
             self.pool = init_kv_pool(self.adapter, self.cache_config)
@@ -125,8 +127,11 @@ class RaggedInferenceEngineV2:
         self.chunk = prefill_chunk
         self.prefill_batch = max(1, prefill_batch)
         self.decode_burst = max(1, decode_burst)
-        self._prefill = jax.jit(self._prefill_batch_fn, donate_argnums=(1,),
-                                static_argnames=("kb",))
+        self._prefill = tracked_jit(self._prefill_batch_fn,
+                                    "inference_v2/prefill",
+                                    tracker=get_compile_tracker(),
+                                    donate_argnums=(1,),
+                                    static_argnames=("kb",))
         self._decode_jits: Dict[int, Callable] = {}
         self._key = jax.random.PRNGKey(0)
         log_dist(f"inference v2: pool={self.cache_config.num_blocks}"
@@ -286,9 +291,12 @@ class RaggedInferenceEngineV2:
     def _decode(self, n_steps: int) -> Callable:
         fn = self._decode_jits.get(n_steps)
         if fn is None:
-            fn = jax.jit(functools.partial(self._decode_burst_fn,
-                                           n_steps=n_steps),
-                         donate_argnums=(1,))
+            fn = tracked_jit(functools.partial(self._decode_burst_fn,
+                                               n_steps=n_steps),
+                             "inference_v2/decode_burst",
+                             tracker=get_compile_tracker(),
+                             static_context={"n_steps": n_steps},
+                             donate_argnums=(1,))
             self._decode_jits[n_steps] = fn
         return fn
 

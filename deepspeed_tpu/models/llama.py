@@ -141,9 +141,10 @@ def _tp_copy_fwd(x):
 
 
 def _tp_copy_bwd(_, g):
+    from ..comm.comm import psum
     from ..parallel.mesh import AXIS_TENSOR
 
-    return (jax.lax.psum(g, AXIS_TENSOR),)
+    return (psum(g, AXIS_TENSOR),)
 
 
 _tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
@@ -156,9 +157,10 @@ def _tp_reduce(x: jnp.ndarray) -> jnp.ndarray:
     cotangent is already the full value on every rank).  Explicit because
     ``lax.psum``'s autodiff transpose under ``check_vma=False`` shard_map
     is another psum — which would scale row-parallel cotangents by tp."""
+    from ..comm.comm import psum
     from ..parallel.mesh import AXIS_TENSOR
 
-    return jax.lax.psum(x, AXIS_TENSOR)
+    return psum(x, AXIS_TENSOR)
 
 
 def _tp_reduce_fwd(x):
@@ -178,9 +180,10 @@ def _tp_max(x: jnp.ndarray) -> jnp.ndarray:
     backward — used only for the log-sum-exp shift, whose derivative
     w.r.t. the shift is identically 0 (``lax.pmax`` has no autodiff rule
     at all, so the no-op cotangent must be spelled out)."""
+    from ..comm.comm import pmax
     from ..parallel.mesh import AXIS_TENSOR
 
-    return jax.lax.pmax(x, AXIS_TENSOR)
+    return pmax(x, AXIS_TENSOR)
 
 
 def _tp_max_fwd(x):

@@ -89,8 +89,11 @@ class AIOHandle:
             if getattr(self, "_h", None):
                 self.lib.ds_aio_free(self._h)
                 self._h = None
-        except Exception:
-            pass
+        except Exception as e:  # interpreter teardown: lib may be gone
+            from ...utils.logging import debug_once
+
+            debug_once("aio/free", f"ds_aio_free failed in __del__ "
+                                   f"({e!r}); handle leaked at exit")
 
 
 def aio_handle(block_size: int = 1 << 20, queue_depth: int = 32,

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..comm.comm import all_gather_in_graph
+
 _POW2 = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], np.uint8)
 
 
@@ -81,8 +83,8 @@ def onebit_allreduce(grad: jnp.ndarray, residual: jnp.ndarray,
     gathered = packed
     gscale = scale
     for ax in names:
-        gathered = jax.lax.all_gather(gathered, ax)
-        gscale = jax.lax.all_gather(gscale, ax)
+        gathered = all_gather_in_graph(gathered, ax, tiled=False)
+        gscale = all_gather_in_graph(gscale, ax, tiled=False)
     world = int(np.prod(gathered.shape[:len(names)]))
     gathered = gathered.reshape(world, -1)
     gscale = gscale.reshape(world)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from ..comm.comm import ppermute as _ppermute, psum as _psum
 from .mesh import AXIS_PIPE
 from ..utils.jax_compat import shard_map as _shard_map
 
@@ -131,14 +132,14 @@ def _interleaved_apply(layer_fn, stacked_params, microbatches, mesh,
             pos = jnp.where(active, pos + 1, pos)
             # -- circulate (activation + its position/microbatch id)
             ring = [(i, (i + 1) % pp) for i in range(pp)]
-            act = tmap(lambda a: jax.lax.ppermute(a, AXIS_PIPE, ring), act)
-            pos = jax.lax.ppermute(pos, AXIS_PIPE, ring)
-            mb = jax.lax.ppermute(mb, AXIS_PIPE, ring)
+            act = tmap(lambda a: _ppermute(a, ring, AXIS_PIPE), act)
+            pos = _ppermute(pos, ring, AXIS_PIPE)
+            mb = _ppermute(mb, ring, AXIS_PIPE)
             return (act, pos, mb, next_mb, outs), None
 
         init = (zero, jnp.int32(-1), jnp.int32(0), jnp.int32(0), outs0)
         (_, _, _, _, outs), _ = jax.lax.scan(tick, init, None, length=T)
-        outs = tmap(lambda o: jax.lax.psum(
+        outs = tmap(lambda o: _psum(
             jnp.where(stage == 0, o, jnp.zeros_like(o)), AXIS_PIPE), outs)
         return outs
 
@@ -309,9 +310,9 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
             b_send = tmap(
                 lambda o: jnp.where(b_active | (is_last & f_active), o,
                                     jnp.zeros(o.shape, o.dtype)), b_out)
-            f_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, fwd_ring),
+            f_recv = tmap(lambda o: _ppermute(o, fwd_ring, AXIS_PIPE),
                           f_send)
-            b_recv = tmap(lambda o: jax.lax.ppermute(o, AXIS_PIPE, bwd_ring),
+            b_recv = tmap(lambda o: _ppermute(o, bwd_ring, AXIS_PIPE),
                           b_send)
             return (f_recv, b_recv, stash, gacc, ge, gh, loss_acc), None
 
@@ -320,9 +321,9 @@ def pipeline_train_1f1b(layer_fn: Callable[[Any, Any], Any],
         (_, _, _, gacc, ge, gh, loss_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(T))
         # loss / embed / head grads live on one stage each → psum replicates
-        loss = jax.lax.psum(loss_acc, AXIS_PIPE) / M
-        ge = tmap(lambda a: jax.lax.psum(a, AXIS_PIPE), ge)
-        gh = tmap(lambda a: jax.lax.psum(a, AXIS_PIPE), gh)
+        loss = _psum(loss_acc, AXIS_PIPE) / M
+        ge = tmap(lambda a: _psum(a, AXIS_PIPE), ge)
+        gh = tmap(lambda a: _psum(a, AXIS_PIPE), gh)
         return loss, gacc, ge, gh
 
     # ``manual_axes`` (1F1B × TP): the tensor axis joins the manual set —
@@ -416,13 +417,13 @@ def pipeline_apply_stages(stage_fns: Any, params: Any, microbatches: Any,
                         acc, o, jnp.clip(idx, 0, M - 1), 0),
                     acc),
                 outs, fin_out)
-            nxt = tmap(lambda o: jax.lax.ppermute(
-                o, AXIS_PIPE, [(i, (i + 1) % pp) for i in range(pp)]),
+            nxt = tmap(lambda o: _ppermute(
+                o, [(i, (i + 1) % pp) for i in range(pp)], AXIS_PIPE),
                 ring_out)
             return (nxt, outs), None
 
         (_, outs), _ = jax.lax.scan(tick, (zero_hid, outs0), jnp.arange(T))
-        outs = tmap(lambda o: jax.lax.psum(
+        outs = tmap(lambda o: _psum(
             jnp.where(stage == pp - 1, o, jnp.zeros_like(o)), AXIS_PIPE),
             outs)
         return outs
@@ -506,13 +507,13 @@ def pipeline_apply(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                         acc, o, jnp.clip(idx, 0, M - 1), 0),
                     acc),
                 outs, out)
-            nxt = tmap(lambda o: jax.lax.ppermute(
-                o, AXIS_PIPE, [(i, (i + 1) % pp) for i in range(pp)]), out)
+            nxt = tmap(lambda o: _ppermute(
+                o, [(i, (i + 1) % pp) for i in range(pp)], AXIS_PIPE), out)
             return (nxt, outs), None
 
         (_, outs), _ = jax.lax.scan(tick, (zero, outs0), jnp.arange(T))
         # replicate the last stage's outputs across the pipe axis
-        outs = tmap(lambda o: jax.lax.psum(
+        outs = tmap(lambda o: _psum(
             jnp.where(stage == pp - 1, o, jnp.zeros_like(o)), AXIS_PIPE),
             outs)
         return outs

@@ -22,12 +22,14 @@ from .faults import (Fault, FaultInjector, InjectedFault,
                      corrupt_newest_snapshot, parse_fault, parse_faults)
 from .policy import (RecoveryPolicy, ResilienceGiveUp, ST_GAVE_UP,
                      ST_RECOVERING, ST_RUNNING)
-from .snapshot import (Snapshot, SnapshotManager, choose_resume_snapshot,
+from .snapshot import (Snapshot, SnapshotManager, SnapshotUnsupportedError,
+                       check_snapshot_support, choose_resume_snapshot,
                        fetch_buddy_snapshot, list_snapshots,
                        replicate_snapshot, verify_snapshot)
 
 __all__ = [
-    "Snapshot", "SnapshotManager", "choose_resume_snapshot",
+    "Snapshot", "SnapshotManager", "SnapshotUnsupportedError",
+    "check_snapshot_support", "choose_resume_snapshot",
     "list_snapshots", "verify_snapshot", "replicate_snapshot",
     "fetch_buddy_snapshot",
     "RecoveryPolicy", "ResilienceGiveUp",

@@ -141,8 +141,11 @@ class FaultInjector:
             try:
                 self.recorder.annotate("fault_injected",
                                        {"fault": repr(fault)})
-            except Exception:
-                pass
+            except Exception as e:  # annotation must not mask the fault
+                from ..utils.logging import debug_once
+
+                debug_once("faults/annotate",
+                           f"fault annotation failed ({e!r})")
         logger.warning(f"fault injection: firing {fault!r}")
 
     def apply(self, step: int, batch: Any, engine: Any = None) -> Any:

@@ -315,5 +315,8 @@ class RecoveryPolicy:
         if self.recorder is not None:
             try:
                 self.recorder.annotate(kind, payload)
-            except Exception:
-                pass
+            except Exception as e:  # diagnostics must not block recovery
+                from ..utils.logging import debug_once
+
+                debug_once("resilience/annotate",
+                           f"recovery annotation '{kind}' failed ({e!r})")

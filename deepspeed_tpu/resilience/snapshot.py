@@ -51,6 +51,41 @@ from ..utils.logging import log_dist, logger
 
 #: per-snapshot commit marker (meta + "the flush completed durably")
 SNAPSHOT_MANIFEST = "snapshot.json"
+
+
+class SnapshotUnsupportedError(RuntimeError):
+    """Tiered snapshots cannot cover this engine's state.
+
+    Raised by :func:`check_snapshot_support` when part of the training
+    state lives outside the on-device TrainState (ZeRO-Offload / ZeRO-
+    Infinity keep optimizer masters host-side in their own engines).
+    The engine catches this and DEGRADES — logs once, disables
+    snapshots/recovery, keeps training — instead of refusing to start
+    (ROADMAP item 5: real snapshot support for those engines is the
+    follow-up; until then a running job beats an error)."""
+
+
+def check_snapshot_support(engine: Any) -> None:
+    """Raise :class:`SnapshotUnsupportedError` naming the engine and the
+    workaround when tiered snapshots cannot capture its full state."""
+    if getattr(engine, "infinity", None) is not None:
+        raise SnapshotUnsupportedError(
+            "resilience snapshots cover the on-device TrainState, but "
+            "ZeRO-Infinity streams trunk params and keeps optimizer "
+            "masters in per-layer host/NVMe planes outside it — a "
+            "snapshot would silently miss them.  Workaround: rely on "
+            "ordinary checkpoints (save_checkpoint covers Infinity "
+            "state), or disable offload_param/Infinity to get tiered "
+            "snapshots.  (ROADMAP item 5 tracks native support.)")
+    if getattr(engine, "offload_enabled", False):
+        raise SnapshotUnsupportedError(
+            "resilience snapshots cover the on-device TrainState, but "
+            "ZeRO-Offload keeps fp32 masters and moments host-side in "
+            "the C++ optimizer — a snapshot would capture stale device "
+            "params and no optimizer state.  Workaround: rely on "
+            "ordinary checkpoints (save_checkpoint covers offload "
+            "state), or disable offload_optimizer to get tiered "
+            "snapshots.  (ROADMAP item 5 tracks native support.)")
 #: tier-2 store key prefixes (mirrors the debug/-bundle transport)
 RESIL_META_KEY = "resil/pub/{node}"
 RESIL_CHUNK_PREFIX = "resil/chunk/{node}"

@@ -181,8 +181,11 @@ def _charge_checkpoint_goodput(seconds: float) -> None:
         from ..telemetry.perf import get_goodput_ledger
 
         get_goodput_ledger().add("checkpoint", max(seconds, 0.0))
-    except Exception:
-        pass
+    except Exception as e:  # accounting is optional; the save is not
+        from ..utils.logging import debug_once
+
+        debug_once("checkpoint/goodput",
+                   f"checkpoint goodput charge failed ({e!r})")
 
 
 class TorchCheckpointEngine(CheckpointEngine):
@@ -332,8 +335,13 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         try:
             self.wait()
             self._ckptr.close()
-        except Exception:
-            pass
+        except Exception as e:  # interpreter teardown
+            from ..utils.logging import debug_once
+
+            debug_once("checkpoint/del",
+                       f"async checkpointer close in __del__ failed "
+                       f"({e!r}); a background save may be truncated "
+                       f"(the manifest gate will refuse it on load)")
 
 
 def make_checkpoint_engine(config) -> CheckpointEngine:

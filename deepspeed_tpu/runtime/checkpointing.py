@@ -159,8 +159,14 @@ def _save_checkpoint_impl(engine, save_dir: str, tag: Optional[str],
         from ..utils import zero_to_fp32 as z2f
 
         shutil.copy(z2f.__file__, os.path.join(save_dir, "zero_to_fp32.py"))
-    except Exception:  # non-fatal convenience copy
-        pass
+    except Exception as e:
+        # non-fatal convenience copy: broad on purpose — __file__ can be
+        # None (frozen/zipapp) raising TypeError, and NOTHING here may
+        # fail the real checkpoint that was just written
+        from ..utils.logging import debug_once
+
+        debug_once("checkpoint/zero_to_fp32_copy",
+                   f"zero_to_fp32.py convenience copy skipped ({e!r})")
     log_dist(f"saved checkpoint {ckpt_dir}")
     return ckpt_dir
 

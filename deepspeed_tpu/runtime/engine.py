@@ -32,6 +32,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..comm import comm as dist
 from ..parallel.mesh import DP_AXES, MeshLayout
 from ..utils import groups as groups_mod
 from ..utils.logging import log_dist, logger
@@ -428,14 +429,20 @@ class DeepSpeedEngine:
             config.resilience, recorder=self.flight_recorder)
         rcfg = config.resilience
         if rcfg.enabled:
-            if self.offload_enabled or self.infinity is not None:
-                raise NotImplementedError(
-                    "resilience snapshots cover the on-device TrainState; "
-                    "ZeRO-Offload / Infinity keep optimizer state host-"
-                    "side in their own engines — snapshot support for "
-                    "those paths is a ROADMAP follow-up")
-            from ..resilience import RecoveryPolicy, SnapshotManager
+            from ..resilience import (RecoveryPolicy, SnapshotManager,
+                                      SnapshotUnsupportedError,
+                                      check_snapshot_support)
 
+            try:
+                check_snapshot_support(self)
+            except SnapshotUnsupportedError as e:
+                # degrade, don't die: the job still trains (and ordinary
+                # checkpoints still cover it) — only the self-healing
+                # rollback/resume loop is unavailable on this engine
+                logger.warning(
+                    f"resilience: snapshots DISABLED for this run — {e}")
+                rcfg = None
+        if rcfg is not None and rcfg.enabled:
             self.snapshots = SnapshotManager(
                 self, rcfg, recorder=self.flight_recorder)
             self.resilience = RecoveryPolicy(
@@ -604,7 +611,9 @@ class DeepSpeedEngine:
                 lambda _: NamedSharding(self.mesh, PartitionSpec(DP_AXES)),
                 params)
             comm_state = self._jit(
-                lambda: init_residuals(params, dp_world),
+                # dp_world is static by design: a mesh change rebuilds
+                # the engine (fresh jit sites), never retraces this one
+                lambda: init_residuals(params, dp_world),  # dslint: disable=recompile-hazard
                 "engine/onebit_residuals",
                 out_shardings=res_shardings)()
         return TrainState(params=params, opt_state=opt_state,
@@ -636,7 +645,8 @@ class DeepSpeedEngine:
         from ..parallel.pipeline import pipeline_train_1f1b
 
         mod = self.module
-        aux_coef = float(getattr(mod, "aux_loss_coef", 0.0))
+        # host attribute, not a device value — no sync happens here
+        aux_coef = float(getattr(mod, "aux_loss_coef", 0.0))  # dslint: disable=host-sync-hot-path
         gas = self.gradient_accumulation_steps
         pp = int(self.mesh.shape[AXIS_PIPE])
         rows = jax.tree.leaves(batch)[0].shape[0]
@@ -948,8 +958,8 @@ class DeepSpeedEngine:
                     def gather(p, i):
                         if i["pdim"] is None:
                             return p
-                        return jax.lax.all_gather(p, i["paxes"],
-                                                  axis=i["pdim"], tiled=True)
+                        return dist.all_gather_in_graph(
+                            p, i["paxes"], axis=i["pdim"], tiled=True)
                     params_full = jax.tree.map(gather, params_shards, info)
                     loss_sum, grads = microbatch_scan(params_full,
                                                       micro_local, scale)
@@ -960,7 +970,7 @@ class DeepSpeedEngine:
                         return quantized_reduce_scatter(g, i["gaxes"],
                                                         i["gdim"])
                     grads = jax.tree.map(reduce, grads, info)
-                    mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
+                    mean_loss = dist.pmean(loss_sum, DP_AXES)
                     return mean_loss, grads
 
                 mean_loss, grads = _shard_map(
@@ -992,7 +1002,7 @@ class DeepSpeedEngine:
                     else:
                         grads = qgz_reduce_tree(grads, DP_AXES)
                         new_res = residuals
-                    mean_loss = jax.lax.pmean(loss_sum, DP_AXES)
+                    mean_loss = dist.pmean(loss_sum, DP_AXES)
                     return mean_loss, grads, new_res
 
                 res_spec = P(DP_AXES) if onebit else P()
@@ -1238,7 +1248,7 @@ class DeepSpeedEngine:
             # (the reference inserts barriers the same way): a scalar fetch
             # is the only reliable fence, so timers and StepRecords see
             # DEVICE step time instead of host dispatch time
-            float(metrics["loss"])
+            float(metrics["loss"])  # dslint: disable=host-sync-hot-path — the fence IS the point
         step_time_s = time.perf_counter() - t_step0
         compile_ms, compile_events, recompile_events = 0.0, 0, 0
         if trk is not None:
@@ -1275,7 +1285,7 @@ class DeepSpeedEngine:
             # reap the process
             import json as _json
 
-            float(metrics["loss"])  # drain any unfenced tail
+            float(metrics["loss"])  # drain any unfenced tail  # dslint: disable=host-sync-hot-path
             t = self.tput_timer
             tmp = result_path + ".tmp"
             with open(tmp, "w") as f:
@@ -1313,7 +1323,9 @@ class DeepSpeedEngine:
                 self.snapshots.maybe_snapshot()
         if not rolled_back and self.steps_per_print and self.global_steps \
                 % int(self.steps_per_print) == 0:
-            m = {k: float(v) for k, v in metrics.items()}
+            # printing requires the values; the pull is gated to the
+            # steps_per_print cadence
+            m = {k: float(v) for k, v in metrics.items()}  # dslint: disable=host-sync-hot-path
             line = (f"step={self.global_steps} loss={m['loss']:.4f} "
                     f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
                     f"loss_scale={m['loss_scale']:.0f}")
@@ -1373,8 +1385,12 @@ class DeepSpeedEngine:
                 peak = float(peak_flops_per_chip())
                 if peak > 0:
                     mfu = self.flops_per_step / dt / peak
-            except Exception:
-                pass
+            except Exception as e:  # unknown device kind — MFU stays None
+                from ..utils.logging import debug_once
+
+                debug_once("telemetry/mfu_peak",
+                           f"peak-FLOPs lookup failed ({e!r}); "
+                           f"StepRecord.mfu omitted")
         nan = float("nan")
         extra: Dict[str, Any] = {}
         if compile_events or compile_ms:

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..telemetry.perf import get_compile_tracker, tracked_jit
 from ..utils.logging import log_dist
 
 
@@ -44,8 +45,10 @@ class DeepSpeedHybridEngine:
         self.engine = engine
         self.module = engine.module
         self.max_out_tokens = int(max_out_tokens)
-        self._prefill = jax.jit(self.module.prefill)
-        self._decode = jax.jit(self.module.decode_step)
+        self._prefill = tracked_jit(self.module.prefill, "hybrid/prefill",
+                                    tracker=get_compile_tracker())
+        self._decode = tracked_jit(self.module.decode_step, "hybrid/decode",
+                                   tracker=get_compile_tracker())
         self._gen_tokens = 0
         self._gen_time = 0.0
         self._train_time = 0.0
@@ -127,8 +130,10 @@ class DeepSpeedHybridEngine:
     def release_inference_cache(self) -> None:
         """Reference API: drop inference buffers between phases.  Caches
         here are per-call locals, so this only clears the jit caches."""
-        self._prefill = jax.jit(self.module.prefill)
-        self._decode = jax.jit(self.module.decode_step)
+        self._prefill = tracked_jit(self.module.prefill, "hybrid/prefill",
+                                    tracker=get_compile_tracker())
+        self._decode = tracked_jit(self.module.decode_step, "hybrid/decode",
+                                   tracker=get_compile_tracker())
 
     def print_latency_log(self) -> None:
         tps = self._gen_tokens / self._gen_time if self._gen_time else 0.0

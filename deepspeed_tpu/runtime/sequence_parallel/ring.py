@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
+from ...comm.comm import ppermute as _ppermute
 from ...parallel.mesh import AXIS_SEQ, DP_AXES
 from ...utils import groups as groups_mod
 from ...utils.jax_compat import shard_map as _shard_map
@@ -80,8 +81,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int,
         # rotate K/V to the next rank (a no-op compute-wise on the last
         # visit, but keeping the scan body uniform lets XLA overlap the
         # permute with the next visit's einsum)
-        kb = jax.lax.ppermute(kb, axis_name, ring)
-        vb = jax.lax.ppermute(vb, axis_name, ring)
+        kb = _ppermute(kb, ring, axis_name)
+        vb = _ppermute(vb, ring, axis_name)
         return (kb, vb, m, l, acc), None
 
     m0 = jnp.full((B, h, Sl), -jnp.inf, jnp.float32)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ...comm.comm import all_to_all_in_graph
 from ...parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
 from ...utils import groups as groups_mod
 from ...utils.jax_compat import shard_map as _shard_map
@@ -62,16 +63,16 @@ def ulysses_attention(attn_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
 
     def inner(ql, kl, vl):
         # local [B, S/sp, h, d] → [B, S, h/sp, d]
-        ql = jax.lax.all_to_all(ql, AXIS_SEQ, split_axis=2, concat_axis=1,
-                                tiled=True)
-        kl = jax.lax.all_to_all(kl, AXIS_SEQ, split_axis=2, concat_axis=1,
-                                tiled=True)
-        vl = jax.lax.all_to_all(vl, AXIS_SEQ, split_axis=2, concat_axis=1,
-                                tiled=True)
+        ql = all_to_all_in_graph(ql, AXIS_SEQ, split_axis=2,
+                                 concat_axis=1, tiled=True)
+        kl = all_to_all_in_graph(kl, AXIS_SEQ, split_axis=2,
+                                 concat_axis=1, tiled=True)
+        vl = all_to_all_in_graph(vl, AXIS_SEQ, split_axis=2,
+                                 concat_axis=1, tiled=True)
         ol = attn_fn(ql, kl, vl)
         # back: [B, S, h/sp, d] → [B, S/sp, h, d]
-        return jax.lax.all_to_all(ol, AXIS_SEQ, split_axis=1, concat_axis=2,
-                                  tiled=True)
+        return all_to_all_in_graph(ol, AXIS_SEQ, split_axis=1,
+                                   concat_axis=2, tiled=True)
 
     return _shard_map(inner, mesh=sm_mesh,
                          in_specs=(spec, spec, spec),

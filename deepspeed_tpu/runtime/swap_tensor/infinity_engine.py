@@ -34,8 +34,17 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ...telemetry.perf import get_compile_tracker, tracked_jit
 from ...utils.logging import log_dist, logger
 from .partitioned_param_swapper import PartitionedParamSwapper
+
+
+def _jit(fn, site: str, **jit_kwargs):
+    """Every streaming-engine program rides the compile tracker — the
+    per-layer fwd/bwd programs are exactly the kind of high-count jit
+    sites whose recompiles (a new layer shape bucket) must be named."""
+    return tracked_jit(fn, site=site, tracker=get_compile_tracker(),
+                       **jit_kwargs)
 
 
 class LayerStreamingEngine:
@@ -295,7 +304,7 @@ class LayerStreamingEngine:
                      .reshape(s) for s, off in layout]
             return jax.tree.unflatten(treedef, views)
 
-        assemble_jit = jax.jit(assemble, out_shardings=out_sh)
+        assemble_jit = _jit(assemble, "infinity/assemble", out_shardings=out_sh)
 
         def scatter(tree):
             leaves = jax.tree.leaves(tree)
@@ -303,7 +312,7 @@ class LayerStreamingEngine:
                 [l.reshape(-1).astype(jnp.float32) for l in leaves])
             return jnp.pad(flat, (0, n_pad - n_elems))
 
-        scatter_jit = jax.jit(scatter, out_shardings=flat_sh)
+        scatter_jit = _jit(scatter, "infinity/scatter", out_shardings=flat_sh)
 
         def local_chunk(garr) -> np.ndarray:
             # shards land in the plane at their segment's offset — the
@@ -367,9 +376,11 @@ class LayerStreamingEngine:
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, res)
 
         if name == "embed":
-            fn = jax.jit(lambda res, ids: model.embed_fwd(cast_res(res), ids))
+            fn = _jit(lambda res, ids: model.embed_fwd(cast_res(res), ids),
+                      "infinity/embed")
         elif name == "layer_fwd":
-            fn = jax.jit(lambda lp, x: model.decoder_layer(lp, x))
+            fn = _jit(lambda lp, x: model.decoder_layer(lp, x),
+                      "infinity/layer_fwd")
         elif name == "layer_bwd":
             aux_coef = self.aux_coef
 
@@ -382,22 +393,24 @@ class LayerStreamingEngine:
                 del out, aux
                 dlp, dx_prev = vjp((dx, jnp.float32(aux_coef) * ls))
                 return dx_prev, dlp
-            fn = jax.jit(bwd)
+            fn = _jit(bwd, "infinity/layer_bwd")
         elif name == "head_grad":
             def head(res, x, batch, ls):
                 # fp16: the SCALED loss is what gets differentiated, so
                 # cotangents stay in fp16 range through every layer
                 return model.head_loss(cast_res(res), x, batch) * ls
-            fn = jax.jit(jax.value_and_grad(head, argnums=(0, 1)))
+            fn = _jit(jax.value_and_grad(head, argnums=(0, 1)),
+                      "infinity/head_grad")
         elif name == "embed_grad":
+            # static by design: vocab size is fixed for a model's life
             V = int(self.model.config.vocab_size)
 
             def embed_grad(ids, dx):
                 flat_ids = ids.reshape(-1)
                 flat_dx = dx.reshape(-1, dx.shape[-1]).astype(jnp.float32)
-                return jnp.zeros((V, dx.shape[-1]),
+                return jnp.zeros((V, dx.shape[-1]),  # dslint: disable=recompile-hazard
                                  jnp.float32).at[flat_ids].add(flat_dx)
-            fn = jax.jit(embed_grad)
+            fn = _jit(embed_grad, "infinity/embed_grad")
         elif name == "res_update":
             tx = self.res_tx
 
@@ -406,13 +419,14 @@ class LayerStreamingEngine:
                     lambda g: g.astype(jnp.float32) * scale, grads)
                 updates, new_state = tx.update(grads, opt_state, res)
                 return optax.apply_updates(res, updates), new_state
-            fn = jax.jit(res_update, donate_argnums=(0, 1))
+            fn = _jit(res_update, "infinity/res_update",
+                      donate_argnums=(0, 1))
         elif name == "sq_norm":
             def sq_norm(tree):
                 leaves = jax.tree.leaves(tree)
                 return sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                            for l in leaves)
-            fn = jax.jit(sq_norm)
+            fn = _jit(sq_norm, "infinity/sq_norm")
         else:
             raise KeyError(name)
         self._jits[name] = fn
@@ -601,11 +615,12 @@ class LayerStreamingEngine:
             sw.release(i)
         if "head_loss_only" not in self._jits:
             model, dtype = self.model, self.compute_dtype
-            self._jits["head_loss_only"] = jax.jit(
+            self._jits["head_loss_only"] = _jit(
                 lambda res, x_, b: model.head_loss(
                     jax.tree.map(lambda p: p.astype(dtype)
                                  if jnp.issubdtype(p.dtype, jnp.floating)
-                                 else p, res), x_, b))
+                                 else p, res), x_, b),
+                "infinity/head_loss_only")
         loss = self._jits["head_loss_only"](self.resident, x, batch)
         return loss + self.aux_coef * aux_sum
 

@@ -485,8 +485,11 @@ class PartitionedParamSwapper:
         try:
             if getattr(self, "_pipe", None) is not None:
                 self._pipe.close()
-        except Exception:
-            pass
+        except Exception as e:  # interpreter teardown
+            from ...utils.logging import debug_once
+
+            debug_once("swap/pipeline_del",
+                       f"opt-pipeline close in __del__ failed ({e!r})")
 
     def _flatten_grads(self, buf: np.ndarray, grads_tree: Any,
                        accumulate: bool = False) -> None:

@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from ...telemetry.perf import get_compile_tracker, tracked_jit
 from ...utils import groups as groups_mod
 from .config import DeepSpeedZeroConfig
 from .sharder import ZeroShardingPolicy
@@ -68,7 +69,9 @@ class Init:
             return init_fn(*args)
         shapes = jax.eval_shape(init_fn, *args)
         shardings = self.policy.param_shardings(shapes, base_specs)
-        return jax.jit(init_fn, out_shardings=shardings)(*args)
+        return tracked_jit(init_fn, "zero_init/materialize",
+                           tracker=get_compile_tracker(),
+                           out_shardings=shardings)(*args)
 
 
 class GatheredParameters:

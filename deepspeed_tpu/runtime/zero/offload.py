@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from ...telemetry.perf import get_compile_tracker, tracked_jit
 from ...utils.logging import log_dist
 
 
@@ -93,7 +94,9 @@ class CPUOffloadOptimizer:
         # Reshard params into the host-partition layout and pull ONLY the
         # process-addressable shards (multi-process safe by construction).
         host_sh_by_tree = jax.tree.unflatten(self.treedef, self.host_shardings)
-        to_host_layout = jax.jit(lambda t: t, out_shardings=host_sh_by_tree)
+        to_host_layout = tracked_jit(lambda t: t, "offload/to_host_layout",
+                                     tracker=get_compile_tracker(),
+                                     out_shardings=host_sh_by_tree)
         resharded = jax.tree.leaves(to_host_layout(params))
 
         flat_masters: List[np.ndarray] = []
@@ -141,8 +144,9 @@ class CPUOffloadOptimizer:
 
         # Cached reshard of the updated (host-layout) tree → param layout.
         param_sh_tree = jax.tree.unflatten(self.treedef, self.param_shardings)
-        self._to_param_layout = jax.jit(lambda t: t,
-                                        out_shardings=param_sh_tree)
+        self._to_param_layout = tracked_jit(
+            lambda t: t, "offload/to_param_layout",
+            tracker=get_compile_tracker(), out_shardings=param_sh_tree)
         self._to_host_layout = None  # built lazily for grad trees
 
         name = optimizer_name.lower()
@@ -218,8 +222,10 @@ class CPUOffloadOptimizer:
             if self._to_host_layout is None:
                 host_sh_tree = jax.tree.unflatten(self.treedef,
                                                   self.host_shardings)
-                self._to_host_layout = jax.jit(
-                    lambda t: t, out_shardings=host_sh_tree)
+                self._to_host_layout = tracked_jit(
+                    lambda t: t, "offload/grads_to_host_layout",
+                    tracker=get_compile_tracker(),
+                    out_shardings=host_sh_tree)
             grad_leaves = jax.tree.leaves(self._to_host_layout(grads))
 
         # one single-device array per unique shard, d2h started async up
@@ -327,7 +333,9 @@ class CPUOffloadOptimizer:
         """Refresh host master slices from (restored) device params."""
         host_sh_tree = jax.tree.unflatten(self.treedef, self.host_shardings)
         resharded = jax.tree.leaves(
-            jax.jit(lambda t: t, out_shardings=host_sh_tree)(params))
+            tracked_jit(lambda t: t, "offload/reseed_masters",
+                        tracker=get_compile_tracker(),
+                        out_shardings=host_sh_tree)(params))
         for leaf, entries in zip(resharded, self.layouts):
             by_key = {_index_key(s.index): s.data
                       for s in leaf.addressable_shards}

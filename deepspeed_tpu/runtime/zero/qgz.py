@@ -30,6 +30,7 @@ from typing import Any, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...comm.comm import all_gather_in_graph, all_to_all_in_graph
 from ...utils.jax_compat import axis_size as _axis_size
 
 GROUP = 256  # quantization group size (scale granularity)
@@ -69,17 +70,17 @@ def quantized_allreduce(g: jnp.ndarray, axis_names: Sequence[str]
 
     # hop 1: quantize chunks, all-to-all so worker w collects chunk w
     q, s = jax.vmap(_quant_groups)(chunks)    # [W, c] int8, [W, c/G] f32
-    q = jax.lax.all_to_all(q[:, None], names, split_axis=0, concat_axis=1,
-                           tiled=False)       # [1, W, c]
-    s = jax.lax.all_to_all(s[:, None], names, split_axis=0, concat_axis=1,
-                           tiled=False)
+    q = all_to_all_in_graph(q[:, None], names, split_axis=0, concat_axis=1,
+                            tiled=False)      # [1, W, c]
+    s = all_to_all_in_graph(s[:, None], names, split_axis=0, concat_axis=1,
+                            tiled=False)
     partial = jax.vmap(_dequant_groups)(q[0], s[0])   # [W, c] f32
     reduced = jnp.sum(partial, axis=0) / world        # [c] — my chunk, meaned
 
     # hop 2: quantize the reduced chunk, all-gather, dequantize
     q2, s2 = _quant_groups(reduced)
-    q2 = jax.lax.all_gather(q2, names, tiled=False)   # [W, c] (stacked axes
-    s2 = jax.lax.all_gather(s2, names, tiled=False)   # collapse to W)
+    q2 = all_gather_in_graph(q2, names, tiled=False)  # [W, c] (stacked axes
+    s2 = all_gather_in_graph(s2, names, tiled=False)  # collapse to W)
     q2 = q2.reshape(world, -1)
     s2 = s2.reshape(world, -1)
     out = jax.vmap(_dequant_groups)(q2, s2).reshape(-1)
@@ -113,10 +114,10 @@ def quantized_reduce_scatter(g: jnp.ndarray, axis_names: Sequence[str],
         flat = jnp.pad(flat, ((0, 0), (0, pad)))
 
     q, s = jax.vmap(_quant_groups)(flat)      # [W, n'] int8, [W, n'/G] f32
-    q = jax.lax.all_to_all(q[:, None], names, split_axis=0, concat_axis=1,
-                           tiled=False)        # [1, W, n']
-    s = jax.lax.all_to_all(s[:, None], names, split_axis=0, concat_axis=1,
-                           tiled=False)
+    q = all_to_all_in_graph(q[:, None], names, split_axis=0, concat_axis=1,
+                            tiled=False)       # [1, W, n']
+    s = all_to_all_in_graph(s[:, None], names, split_axis=0, concat_axis=1,
+                            tiled=False)
     partial = jax.vmap(_dequant_groups)(q[0], s[0])   # [W, n'] f32
     red = jnp.sum(partial, axis=0) / world
     if pad:

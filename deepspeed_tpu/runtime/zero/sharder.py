@@ -266,7 +266,11 @@ def sharded_zeros_like(policy: ZeroShardingPolicy, tree: Any, kind: str = "param
 
     def make(leaf):
         sharding = NamedSharding(policy.mesh, spec_fn(leaf))
-        return jax.jit(lambda: jax.numpy.zeros(np.shape(leaf), leaf.dtype),
+        # deliberately UNtracked: a fresh zero-arg lambda per leaf has an
+        # empty, identical signature at one site, so the tracker would
+        # misreport every leaf after the first as a causeless recompile
+        # and inflate compile/recompiles_total at init
+        return jax.jit(lambda: jax.numpy.zeros(np.shape(leaf), leaf.dtype),  # dslint: disable=untracked-jit
                        out_shardings=sharding)()
 
     return jax.tree.map(make, tree)

@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..utils.logging import logger
+from ..utils.logging import debug_once, logger
 from .collective_ledger import (find_first_divergence,
                                 format_divergence_report)
 from .flight_recorder import BUNDLE_MANIFEST
@@ -307,8 +307,11 @@ class BundlePublisher:
                 # FIRST and unconditionally: the cheap partial push must
                 # not wait behind a full dump that may itself be stuck
                 self._maybe_push_partial(client)
-            except Exception:
-                pass  # best-effort by definition
+            except Exception as e:
+                # best-effort by definition
+                debug_once("aggregator/partial_push",
+                           f"partial-ledger push failed ({e!r}); "
+                           f"retrying next tick")
             req = int(client.get(_REQ_KEY) or 0)
             rec = self.recorder()
             if req > self._last_req_served:
@@ -348,8 +351,10 @@ class BundlePublisher:
             while not self._daemon_stop.wait(interval_s):
                 try:
                     self.tick(client)
-                except Exception:
-                    pass  # store hiccup / dump failure; next beat retries
+                except Exception as e:
+                    # store hiccup / dump failure; next beat retries
+                    debug_once("aggregator/daemon_tick",
+                               f"publisher daemon tick failed ({e!r})")
 
         self._daemon = threading.Thread(target=loop, daemon=True,
                                         name="ds-bundle-publisher")

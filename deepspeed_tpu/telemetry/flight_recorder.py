@@ -300,16 +300,22 @@ class FlightRecorder:
             self.dump(f"unhandled exception: {exc_type.__name__}: {exc}",
                       extra={"traceback": "".join(
                           traceback.format_exception(exc_type, exc, tb))})
-        except Exception:
-            pass
+        except Exception as e:  # the original exception must still print
+            from ..utils.logging import debug_once
+
+            debug_once("flight_recorder/excepthook_dump",
+                       f"crash-bundle dump failed in excepthook ({e!r})")
         prev = self._prev_excepthook or sys.__excepthook__
         prev(exc_type, exc, tb)
 
     def _signal_handler(self, signum, frame) -> None:
         try:
             self.dump(f"fatal signal {signal.Signals(signum).name}")
-        except Exception:
-            pass
+        except Exception as e:  # the signal's default action must proceed
+            from ..utils.logging import debug_once
+
+            debug_once("flight_recorder/signal_dump",
+                       f"signal-bundle dump failed ({e!r})")
         prev = self._prev_signal_handlers.get(signum)
         if callable(prev):
             prev(signum, frame)

@@ -285,8 +285,11 @@ class HealthMonitor:
         if self.recorder is not None:
             try:
                 self.recorder.record_health(ev)
-            except Exception:
-                pass
+            except Exception as e:  # recorder trouble must not stop checks
+                from ..utils.logging import debug_once
+
+                debug_once("health/recorder",
+                           f"health-event recording failed ({e!r})")
         reg = self.registry
         if reg is None:
             return
@@ -298,5 +301,8 @@ class HealthMonitor:
             reg.gauge("health/last_event_step",
                       "step of the most recent health event").set(ev.step)
             reg.emit_event("health", ev.to_dict())
-        except Exception:
-            pass
+        except Exception as e:  # metrics trouble must not stop checks
+            from ..utils.logging import debug_once
+
+            debug_once("health/metrics",
+                       f"health-event metrics publish failed ({e!r})")

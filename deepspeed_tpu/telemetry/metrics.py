@@ -210,8 +210,8 @@ class JSONLExporter:
         with self._lock:
             try:
                 self._fh.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # flush-on-close of a dead fd; nothing left to save
 
 
 class MetricsRegistry:

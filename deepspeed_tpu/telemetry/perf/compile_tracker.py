@@ -246,8 +246,8 @@ class CompileTracker:
             tel.set_gauge("compile/live_programs", live_programs,
                           help="distinct compiled programs across sites")
             tel.emit_event("compile", ev.to_dict())
-        except Exception:
-            pass
+        except Exception as e:  # metrics publish is best-effort
+            logger.debug(f"compile tracker: metrics publish failed ({e!r})")
 
     # -- read side ---------------------------------------------------------
 

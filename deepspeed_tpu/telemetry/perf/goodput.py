@@ -161,8 +161,11 @@ class GoodputLedger:
                               help=f"wall seconds classified {b}")
             tel.set_gauge("goodput/fraction", self.goodput(),
                           help="productive / total wall time")
-        except Exception:
-            pass
+        except Exception as e:  # metrics publish is best-effort
+            from ...utils.logging import debug_once
+
+            debug_once("goodput/publish",
+                       f"goodput gauge publish failed ({e!r})")
 
 
 _default = GoodputLedger()

@@ -102,6 +102,9 @@ def collect_memory_stats(include_live_buffers: bool = False
             import jax
 
             out["live_buffers"] = float(len(jax.live_arrays()))
-        except Exception:
-            pass
+        except Exception as e:  # introspection API drift across jax
+            from ..utils.logging import debug_once
+
+            debug_once("step_record/live_buffers",
+                       f"live-buffer count unavailable ({e!r})")
     return out

@@ -39,8 +39,12 @@ def device_fence(value=None) -> None:
         if value is not None:
             jax.block_until_ready(value)
         jax.effects_barrier()
-    except Exception:
-        pass
+    except Exception as e:  # fence failure ⇒ host-time spans, say so once
+        from ..utils.logging import debug_once
+
+        debug_once("tracer/device_fence",
+                   f"device fence failed ({e!r}); span timings reflect "
+                   f"dispatch, not device completion")
 
 
 class SpanTracer:

@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ..utils.logging import logger
+from ..utils.logging import debug_once, logger
 
 ACTIONS = ("log", "raise", "exit")
 
@@ -169,8 +169,9 @@ class HangWatchdog:
             # so cluster goodput reflects the hang even if the process
             # survives (action="log")
             get_goodput_ledger().add("stall", age)
-        except Exception:
-            pass
+        except Exception as e:  # accounting is optional mid-incident
+            debug_once("watchdog/stall_charge",
+                       f"stall goodput charge failed ({e!r})")
         bundle = None
         recorder = self._recorder
         if recorder is HangWatchdog.GLOBAL_RECORDER:
@@ -189,8 +190,10 @@ class HangWatchdog:
                     # rank issued — the first thing a desync post-mortem
                     # compares across hosts
                     extra.update(led.heartbeat_summary())
-            except Exception:
-                pass
+            except Exception as e:  # the dump itself matters more
+                debug_once("watchdog/ledger_summary",
+                           f"ledger summary for trip bundle failed "
+                           f"({e!r})")
             try:
                 bundle = recorder.dump(reason, extra=extra)
             except Exception as e:
@@ -208,8 +211,9 @@ class HangWatchdog:
 
             get_telemetry().inc_counter(
                 "watchdog/trips", help="hang watchdog trips")
-        except Exception:
-            pass
+        except Exception as e:  # counter publish is best-effort
+            debug_once("watchdog/trip_counter",
+                       f"trip counter publish failed ({e!r})")
         msg = f"{reason}; debug bundle: {bundle}"
         if self.action == "exit":
             logger.error(msg + " — exiting (watchdog action=exit)")

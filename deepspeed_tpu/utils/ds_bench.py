@@ -35,17 +35,22 @@ def _bench_collective(op: str, n_elems: int, trials: int, mesh) -> dict:
     x = jnp.ones((world, m), jnp.float32)
     x = jax.device_put(x, NamedSharding(mesh, P(axis)))
 
+    # the comm verbs wrap the same lax collectives and feed the census —
+    # a fabric-validation run should appear in the ledger like any other
+    from ..comm.comm import (all_gather_in_graph, all_to_all_in_graph,
+                             psum)
+
     def body(v):
         if op == "all_reduce":
-            return jax.lax.psum(v, axis)
+            return psum(v, axis)
         if op == "all_gather":
-            return jax.lax.all_gather(v, axis)
+            return all_gather_in_graph(v, axis, tiled=False)
         if op == "all_to_all":
             # local shard is [1, m]: exchange m/world-sized chunks
-            return jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=0,
-                                      tiled=True)
+            return all_to_all_in_graph(v, axis, split_axis=1,
+                                       concat_axis=0, tiled=True)
         if op == "broadcast":
-            return jax.lax.psum(jnp.where(
+            return psum(jnp.where(
                 jax.lax.axis_index(axis[0]) == 0, v, jnp.zeros_like(v)),
                 axis)
         raise ValueError(op)
@@ -95,8 +100,9 @@ def main(argv: List[str] = None) -> int:
             import jax.extend.backend as jeb
 
             jeb.clear_backends()
-        except Exception:
-            pass
+        except (ImportError, AttributeError, RuntimeError):
+            pass  # older jax without clear_backends — flags still apply
+                  # to the first real backend build
         jax.config.update("jax_platforms", "cpu")
     import jax
     from jax.sharding import Mesh

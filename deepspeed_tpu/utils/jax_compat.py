@@ -59,7 +59,10 @@ def axis_size(axis_name) -> int:
     is statically evaluated to the same number."""
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
-    return jax.lax.psum(1, axis_name)
+    # a psum of the literal 1 is an axis-SIZE query the partitioner folds
+    # to a constant, not data movement — and this shim sits BELOW comm/
+    # in the import graph, so it cannot route through the comm verbs
+    return jax.lax.psum(1, axis_name)  # dslint: disable=raw-collective
 
 
 def abstract_mesh_or_none():

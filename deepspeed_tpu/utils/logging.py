@@ -59,3 +59,32 @@ def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.
 
 def set_log_level(level: int | str) -> None:
     logger.setLevel(level)
+
+
+#: keys already logged by the once-helpers — process-wide, so a failure
+#: that fires every step (a broken telemetry exporter, a flaky fence)
+#: says so exactly once instead of either flooding or staying silent
+_logged_once: set[str] = set()
+
+
+def _log_once(level: int, key: str, message: str) -> None:
+    if key in _logged_once:
+        return
+    _logged_once.add(key)
+    logger.log(level, message)
+
+
+def debug_once(key: str, message: str) -> None:
+    """Log ``message`` at DEBUG the first time ``key`` is seen.
+
+    The sanctioned body for best-effort ``except Exception`` blocks
+    (dslint's ``bare-except`` rule): failure paths that must never
+    escalate (telemetry export, diagnostics collection) still leave one
+    trace of the first breakage instead of swallowing it forever."""
+    _log_once(logging.DEBUG, key, message)
+
+
+def warn_once(key: str, message: str) -> None:
+    """Like :func:`debug_once` at WARNING — for fallbacks an operator
+    should hear about even without debug logging switched on."""
+    _log_once(logging.WARNING, key, message)

@@ -43,8 +43,12 @@ def memory_status() -> Dict[str, float]:
         out["device_in_use_GB"] = stats.get("bytes_in_use", 0) / 2 ** 30
         out["device_limit_GB"] = stats.get("bytes_limit", 0) / 2 ** 30
         out["device_peak_GB"] = stats.get("peak_bytes_in_use", 0) / 2 ** 30
-    except Exception:
-        pass
+    except Exception as e:  # platforms without memory_stats (CPU, tunnels)
+        from .logging import debug_once
+
+        debug_once("memory/device_stats",
+                   f"device memory_stats unavailable ({e!r}); "
+                   f"reporting host memory only")
     return out
 
 

@@ -20,8 +20,11 @@ def _sync() -> None:
 
         # effects_barrier waits for all dispatched computations on all devices.
         jax.effects_barrier()
-    except Exception:
-        pass
+    except Exception as e:  # timers must never kill the step they time
+        from .logging import debug_once
+
+        debug_once("timer/sync", f"timer device sync failed ({e!r}); "
+                                 f"timings may reflect dispatch, not device")
 
 
 class _Timer:

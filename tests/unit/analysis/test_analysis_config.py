@@ -1,0 +1,107 @@
+"""[tool.dslint] config: the mini-TOML reader and repo-root discovery —
+the tool must be configurable without code edits (ISSUE 6 satellite)."""
+
+import textwrap
+
+from deepspeed_tpu.analysis.core import (AnalysisConfig, find_repo_root,
+                                         load_config, _parse_toml_section)
+
+
+def test_parse_toml_section_scalars_lists_multiline():
+    text = textwrap.dedent("""
+        [project]
+        name = "x"
+
+        [tool.dslint]
+        baseline = ".custom.json"
+        disable = ["bare-except"]
+        jit_roots = [
+            "a/b",
+            "c/d",
+        ]
+        # a comment
+        collective_home = "a/comm"
+
+        [tool.other]
+        baseline = "NOT-OURS"
+    """)
+    data = _parse_toml_section(text, "tool.dslint")
+    assert data["baseline"] == ".custom.json"
+    assert data["disable"] == ["bare-except"]
+    assert data["jit_roots"] == ["a/b", "c/d"]
+    assert data["collective_home"] == "a/comm"
+    assert "name" not in data
+
+
+def test_load_config_overrides_defaults(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.dslint]
+        paths = ["src"]
+        lock_name_patterns = ["*guard*"]
+    """))
+    cfg = load_config(str(tmp_path))
+    assert cfg.paths == ["src"]
+    assert cfg.lock_like("_guard_x") and not cfg.lock_like("_lock")
+    # untouched fields keep their defaults
+    assert cfg.baseline == ".dslint-baseline.json"
+
+
+def test_load_config_defaults_without_pyproject(tmp_path):
+    cfg = load_config(str(tmp_path))
+    assert cfg.paths == AnalysisConfig().paths
+
+
+def test_find_repo_root_walks_up(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    nested = tmp_path / "a" / "b"
+    nested.mkdir(parents=True)
+    assert find_repo_root(str(nested)) == str(tmp_path)
+
+
+def test_repo_config_parses_and_names_real_roots():
+    """The checked-in [tool.dslint] stanza must resolve against the
+    actual tree (a typo'd hot_path_root silently disables a rule)."""
+    import os
+
+    import deepspeed_tpu
+
+    root = os.path.dirname(os.path.dirname(deepspeed_tpu.__file__))
+    cfg = load_config(root)
+    assert cfg.paths == ["deepspeed_tpu"]
+    for spec in cfg.hot_path_roots + cfg.thread_roots:
+        rel, _, qual = spec.partition("::")
+        assert os.path.isfile(os.path.join(root, rel)), spec
+        leaf = qual.rsplit(".", 1)[-1]
+        with open(os.path.join(root, rel)) as fh:
+            assert f"def {leaf}" in fh.read(), spec
+
+
+def test_bool_rewrite_does_not_corrupt_strings():
+    """Only a bare scalar true/false is a bool — string values containing
+    those words must come through verbatim."""
+    data = _parse_toml_section(textwrap.dedent("""
+        [tool.dslint]
+        flag = true
+        off = false
+        paths = ["true-positives/src", "false_starts"]
+    """), "tool.dslint")
+    assert data["flag"] is True and data["off"] is False
+    assert data["paths"] == ["true-positives/src", "false_starts"]
+
+
+def test_inline_comments_in_multiline_lists_do_not_drop_keys():
+    """Inline comments are valid TOML — one on a list line must not
+    swallow the rest of the joined logical line and silently revert a
+    gate-scoping key to defaults."""
+    data = _parse_toml_section(textwrap.dedent("""
+        [tool.dslint]
+        jit_roots = [
+            "a/runtime",   # engines
+            "a/inference",
+        ]
+        collective_home = "a/comm"  # trailing comment
+        hashy = ["x#y"]
+    """), "tool.dslint")
+    assert data["jit_roots"] == ["a/runtime", "a/inference"]
+    assert data["collective_home"] == "a/comm"
+    assert data["hashy"] == ["x#y"]  # '#' inside a string survives

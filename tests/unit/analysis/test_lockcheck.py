@@ -1,0 +1,134 @@
+"""Instrumented-lock shim: lock-order inversion caught on a single-
+threaded pass (no actual deadlock needed), plus the monkeypatching
+harness."""
+
+import threading
+
+import pytest
+
+from deepspeed_tpu.analysis.lockcheck import (InstrumentedLock,
+                                              LockOrderInversion,
+                                              LockOrderMonitor,
+                                              instrument_locks)
+
+
+def test_consistent_order_is_fine():
+    mon = LockOrderMonitor()
+    a = InstrumentedLock(mon, "A")
+    b = InstrumentedLock(mon, "B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert "B" in mon.edges().get("A", set())
+
+
+def test_inversion_raises_without_deadlocking():
+    mon = LockOrderMonitor()
+    a = InstrumentedLock(mon, "A")
+    b = InstrumentedLock(mon, "B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderInversion, match="A"):
+        with b:
+            with a:  # the reverse order closes the cycle
+                pass
+
+
+def test_transitive_inversion_detected():
+    mon = LockOrderMonitor()
+    a, b, c = (InstrumentedLock(mon, n) for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockOrderInversion):
+        with c:
+            with a:  # A->B->C->A
+                pass
+
+
+def test_rlock_reentry_is_not_an_edge():
+    mon = LockOrderMonitor()
+    r = InstrumentedLock(mon, "R", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert mon.edges() == {}
+
+
+def test_cross_thread_inversion_detected():
+    """Thread 1 establishes A->B; thread 2's B->A must raise (in thread
+    2) even though each thread alone is consistent."""
+    mon = LockOrderMonitor()
+    a = InstrumentedLock(mon, "A")
+    b = InstrumentedLock(mon, "B")
+
+    with a:
+        with b:
+            pass
+
+    caught = []
+
+    def worker():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockOrderInversion as e:
+            caught.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(5)
+    assert caught, "inversion from the second thread was not detected"
+
+
+def test_instrument_locks_patches_and_restores():
+    real_lock = threading.Lock
+    with instrument_locks() as mon:
+        lk = threading.Lock()
+        assert isinstance(lk, InstrumentedLock)
+        with lk:
+            pass
+        assert lk.name.startswith("Lock@")
+    assert threading.Lock is real_lock
+    assert isinstance(threading.Lock(), real_lock().__class__)
+    # edges observed inside are queryable after exit
+    assert isinstance(mon.edges(), dict)
+
+
+def test_rlock_reentry_below_stack_top_is_not_an_inversion():
+    """`with A: with B: with A:` (A reentrant) can never block — the
+    monitor must not fabricate a B->A edge from the re-entry."""
+    mon = LockOrderMonitor()
+    a = InstrumentedLock(mon, "A", reentrant=True)
+    b = InstrumentedLock(mon, "B")
+    with a:
+        with b:
+            with a:
+                pass
+    assert "A" not in mon.edges().get("B", set())
+
+
+def test_same_site_instances_get_distinct_names_and_inversions_fire():
+    """`self._lock = threading.Lock()` gives every instance the same
+    creation site — the monitor must still see inst1->inst2 vs
+    inst2->inst1 as an inversion, not as RLock re-entry."""
+    with instrument_locks() as mon:
+        def make():  # one source line -> one site for both locks
+            return threading.Lock()
+
+        a, b = make(), make()
+        assert a.name != b.name
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderInversion):
+            with b:
+                with a:
+                    pass
+    assert mon.edges()

@@ -1,0 +1,122 @@
+"""Thread-safety audit fixtures: a synthetic two-thread race it must
+flag, the locked variant it must not, plus entry-point discovery shapes
+(Thread target, executor submit, config thread_roots)."""
+
+import textwrap
+
+from deepspeed_tpu.analysis.core import AnalysisConfig, SourceModule
+from deepspeed_tpu.analysis.races import _check_thread_safety
+
+
+def mod(rel: str, src: str) -> SourceModule:
+    return SourceModule("/fake/" + rel, rel, textwrap.dedent(src))
+
+
+RACY = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            while True:
+                self.count = self.count + 1  # thread write, no lock
+
+        def read(self):
+            return self.count  # main-thread read, no lock
+"""
+
+LOCKED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.count = self.count + 1
+
+        def read(self):
+            with self._lock:
+                return self.count
+"""
+
+
+def test_unlocked_shared_write_flagged():
+    found = _check_thread_safety([mod("pkg/w.py", RACY)], AnalysisConfig())
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "thread-unsafe-attr"
+    assert "count" in f.message and f.symbol == "Worker._loop"
+
+
+def test_locked_variant_clean():
+    found = _check_thread_safety([mod("pkg/w.py", LOCKED)],
+                                 AnalysisConfig())
+    assert found == []
+
+
+def test_init_only_and_unshared_attrs_exempt():
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.mode = "fast"   # written pre-thread only
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                local = self.mode    # read-only after publish: fine
+                self._scratch = 1    # written on thread, never shared
+    """
+    found = _check_thread_safety([mod("pkg/w.py", src)], AnalysisConfig())
+    assert found == []
+
+
+def test_executor_submit_counts_as_entry():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Flusher:
+            def __init__(self):
+                self.pending = None
+                self._pool = ThreadPoolExecutor(max_workers=1)
+
+            def kick(self):
+                self._pool.submit(self._flush)
+
+            def _flush(self):
+                self.pending = "done"
+
+            def read(self):
+                return self.pending
+    """
+    found = _check_thread_safety([mod("pkg/f.py", src)], AnalysisConfig())
+    assert len(found) == 1 and "pending" in found[0].message
+
+
+def test_config_thread_roots_cover_callback_indirection():
+    src = """
+        class Ticker:
+            def __init__(self):
+                self.beats = 0
+
+            def tick(self):          # driven by an external daemon
+                self.beats = self.beats + 1
+
+            def read(self):
+                return self.beats
+    """
+    cfg = AnalysisConfig()
+    # without the root: no Thread() in sight, nothing flagged
+    assert _check_thread_safety([mod("pkg/t.py", src)], cfg) == []
+    cfg.thread_roots = ["pkg/t.py::Ticker.tick"]
+    found = _check_thread_safety([mod("pkg/t.py", src)], cfg)
+    assert len(found) == 1 and "beats" in found[0].message

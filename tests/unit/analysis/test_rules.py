@@ -1,0 +1,317 @@
+"""Per-rule positive/negative fixtures for the dslint analyzers.
+
+Each fixture is a small source string parsed into a SourceModule with a
+chosen repo-relative path (the path drives jit_roots/collective_home
+scoping), run through exactly one rule.
+"""
+
+import textwrap
+
+from deepspeed_tpu.analysis.core import AnalysisConfig, SourceModule
+from deepspeed_tpu.analysis.hygiene import _check_bare_except
+from deepspeed_tpu.analysis.jax_rules import (_check_donated_reuse,
+                                              _check_host_sync,
+                                              _check_raw_collective,
+                                              _check_recompile_hazard,
+                                              _check_untracked_jit)
+
+
+def mod(rel: str, src: str) -> SourceModule:
+    return SourceModule("/fake/" + rel, rel, textwrap.dedent(src))
+
+
+CFG = AnalysisConfig()
+
+
+# ---------------------------------------------------------------------------
+# untracked-jit
+# ---------------------------------------------------------------------------
+
+
+def test_untracked_jit_flags_raw_jit_under_runtime():
+    m = mod("deepspeed_tpu/runtime/thing.py", """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """)
+    found = _check_untracked_jit([m], CFG)
+    assert len(found) == 1 and found[0].rule == "untracked-jit"
+    assert found[0].symbol == "build"
+
+
+def test_untracked_jit_ignores_tracked_and_other_dirs():
+    tracked = mod("deepspeed_tpu/runtime/ok.py", """
+        from deepspeed_tpu.telemetry.perf import tracked_jit
+
+        def build(fn):
+            return tracked_jit(fn, "ok/site")
+    """)
+    elsewhere = mod("deepspeed_tpu/telemetry/x.py", """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """)
+    wrapper = mod("deepspeed_tpu/runtime/eng.py", """
+        import jax
+
+        class E:
+            def _jit(self, fn, site):
+                return jax.jit(fn)  # the wrapper body IS the tracked path
+    """)
+    assert _check_untracked_jit([tracked, elsewhere, wrapper], CFG) == []
+
+
+# ---------------------------------------------------------------------------
+# raw-collective
+# ---------------------------------------------------------------------------
+
+
+def test_raw_collective_flags_lax_outside_comm():
+    m = mod("deepspeed_tpu/runtime/sp.py", """
+        import jax
+
+        def reduce(x, axis):
+            return jax.lax.psum(x, axis)
+    """)
+    found = _check_raw_collective([m], CFG)
+    assert len(found) == 1
+    assert "comm" in found[0].message and "psum" in found[0].message
+
+
+def test_raw_collective_allows_comm_home_and_topology_queries():
+    home = mod("deepspeed_tpu/comm/comm.py", """
+        import jax
+
+        def psum(x, axis):
+            return jax.lax.psum(x, axis)
+    """)
+    query = mod("deepspeed_tpu/runtime/sp.py", """
+        import jax
+
+        def rank(axis):
+            return jax.lax.axis_index(axis)
+    """)
+    verbs = mod("deepspeed_tpu/runtime/ok.py", """
+        from deepspeed_tpu.comm.comm import psum
+
+        def reduce(x, axis):
+            return psum(x, axis)
+    """)
+    assert _check_raw_collective([home, query, verbs], CFG) == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_hazard_static_argnums_on_array_param():
+    m = mod("pkg/a.py", """
+        import jax
+
+        def step(params, n):
+            return params
+
+        f = jax.jit(step, static_argnums=(0,))
+    """)
+    found = _check_recompile_hazard([m], CFG)
+    assert any("static_argnums=0" in f.message and "params" in f.message
+               for f in found)
+
+
+def test_recompile_hazard_shape_branch():
+    m = mod("pkg/b.py", """
+        import jax
+
+        def step(x):
+            S = x.shape[0]
+            if S % 4:
+                x = x[:1]
+            return x
+
+        f = jax.jit(step)
+    """)
+    found = _check_recompile_hazard([m], CFG)
+    assert any("traced shape" in f.message for f in found)
+
+
+def test_recompile_hazard_closure_scalar():
+    m = mod("pkg/c.py", """
+        import jax
+
+        def build(cfg):
+            gas = int(cfg.gas)
+
+            def step(x):
+                return x * gas
+
+            return jax.jit(step)
+    """)
+    found = _check_recompile_hazard([m], CFG)
+    assert any("'gas'" in f.message for f in found)
+
+
+def test_recompile_hazard_clean_jit_passes():
+    m = mod("pkg/d.py", """
+        import jax
+
+        def step(x, scale):
+            return x * scale
+
+        f = jax.jit(step)
+    """)
+    assert _check_recompile_hazard([m], CFG) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync-hot-path
+# ---------------------------------------------------------------------------
+
+
+def _host_sync_cfg(rel):
+    cfg = AnalysisConfig()
+    cfg.hot_path_roots = [f"{rel}::Eng.train_step"]
+    cfg.host_sync_allow = ["Eng._fence"]
+    return cfg
+
+
+def test_host_sync_reachable_flagged_allowlist_skipped():
+    rel = "pkg/eng.py"
+    m = mod(rel, """
+        class Eng:
+            def train_step(self, batch):
+                out = self._dispatch(batch)
+                self._fence(out)
+                return out
+
+            def _dispatch(self, batch):
+                return float(batch["loss"])  # BAD: sync off the fence
+
+            def _fence(self, out):
+                return float(out)  # declared fence: allowed
+    """)
+    found = _check_host_sync([m], _host_sync_cfg(rel))
+    assert len(found) == 1
+    assert found[0].symbol == "Eng._dispatch"
+
+
+def test_host_sync_unreachable_not_flagged():
+    rel = "pkg/eng.py"
+    m = mod(rel, """
+        class Eng:
+            def train_step(self, batch):
+                return batch
+
+            def debug_dump(self, x):
+                return float(x)  # host-side tooling, not on the hot path
+    """)
+    assert _check_host_sync([m], _host_sync_cfg(rel)) == []
+
+
+# ---------------------------------------------------------------------------
+# donated-after-use
+# ---------------------------------------------------------------------------
+
+
+def test_donated_reuse_flagged():
+    m = mod("pkg/don.py", """
+        import jax
+
+        def run(fn, x):
+            f = jax.jit(fn, donate_argnums=(0,))
+            y = f(x)
+            return x + y  # x's buffer was donated
+    """)
+    found = _check_donated_reuse([m], CFG)
+    assert len(found) == 1 and "'x'" in found[0].message
+
+
+def test_donated_rebind_idiom_ok():
+    m = mod("pkg/don_ok.py", """
+        import jax
+
+        def run(fn, x):
+            f = jax.jit(fn, donate_argnums=(0,))
+            x = f(x)  # rebinding: later reads see the result
+            return x + 1
+    """)
+    assert _check_donated_reuse([m], CFG) == []
+
+
+# ---------------------------------------------------------------------------
+# bare-except
+# ---------------------------------------------------------------------------
+
+
+def test_bare_and_silent_broad_handlers_flagged():
+    m = mod("pkg/exc.py", """
+        def a():
+            try:
+                risky()
+            except:
+                pass
+
+        def b():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    found = _check_bare_except([m], CFG)
+    assert len(found) == 2
+
+
+def test_handlers_that_decide_are_fine():
+    m = mod("pkg/exc_ok.py", """
+        import logging
+
+        def a():
+            try:
+                risky()
+            except OSError:
+                pass  # narrowed: fine
+
+        def b():
+            try:
+                risky()
+            except Exception as e:
+                logging.debug("risky failed: %r", e)
+
+        def c():
+            try:
+                return risky()
+            except Exception:
+                return 0  # fallback value is a decision
+    """)
+    assert _check_bare_except([m], CFG) == []
+
+
+def test_donated_argnames_tracked_alongside_argnums():
+    m = mod("pkg/don_names.py", """
+        import jax
+
+        def run(fn, x, state):
+            f = jax.jit(fn, donate_argnames=("state",),
+                        donate_argnums=(0,))
+            y = f(x, state=state)
+            return state, x  # both donated buffers read afterwards
+    """)
+    found = _check_donated_reuse([m], CFG)
+    msgs = " | ".join(f.message for f in found)
+    assert "'state'" in msgs and "argname 'state'" in msgs
+    assert "'x'" in msgs and "position 0" in msgs
+
+
+def test_raw_collective_pmin_does_not_suggest_psum():
+    m = mod("deepspeed_tpu/runtime/sp.py", """
+        import jax
+
+        def reduce(x, axis):
+            return jax.lax.pmin(x, axis)
+    """)
+    found = _check_raw_collective([m], CFG)
+    assert len(found) == 1
+    assert "comm.psum" not in found[0].message
+    assert "pmin" in found[0].message

@@ -1,0 +1,189 @@
+"""Suppression comments + the baseline round-trip through the real CLI.
+
+The round-trip is the CI contract: `baseline` then `lint` exits 0; a
+freshly introduced violation exits 3; justifications survive
+re-baselining.
+"""
+
+import json
+import os
+import textwrap
+
+from deepspeed_tpu.analysis import cli
+from deepspeed_tpu.analysis.core import AnalysisConfig, SourceModule
+from deepspeed_tpu.analysis.jax_rules import _check_raw_collective
+
+CFG = AnalysisConfig()
+
+
+def test_line_suppression():
+    src = textwrap.dedent("""
+        import jax
+
+        def reduce(x, axis):
+            return jax.lax.psum(x, axis)  # dslint: disable=raw-collective
+    """)
+    m = SourceModule("/fake/pkg/a.py", "pkg/a.py", src)
+    found = [f for f in _check_raw_collective([m], CFG)
+             if not m.suppressed(f.rule, f.line)]
+    assert found == []
+
+
+def test_file_suppression_and_other_rules_unaffected():
+    src = textwrap.dedent("""
+        # dslint: disable-file=raw-collective
+        import jax
+
+        def reduce(x, axis):
+            return jax.lax.psum(x, axis)
+
+        def reduce2(x, axis):
+            return jax.lax.pmean(x, axis)
+    """)
+    m = SourceModule("/fake/pkg/b.py", "pkg/b.py", src)
+    found = [f for f in _check_raw_collective([m], CFG)
+             if not m.suppressed(f.rule, f.line)]
+    assert found == []
+    assert not m.suppressed("untracked-jit", 5)  # only the named rule
+
+
+# ---------------------------------------------------------------------------
+# CLI round-trip on a temp mini-repo
+# ---------------------------------------------------------------------------
+
+VIOLATION = """
+import jax
+
+def reduce(x, axis):
+    return jax.lax.psum(x, axis)
+"""
+
+SECOND_VIOLATION = """
+
+def later(x, axis):
+    import jax
+    return jax.lax.pmean(x, axis)
+"""
+
+
+def _mini_repo(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [project]
+        name = "mini"
+
+        [tool.dslint]
+        paths = ["pkg"]
+        baseline = ".dslint-baseline.json"
+    """))
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(VIOLATION)
+    return tmp_path
+
+
+def test_baseline_roundtrip_then_new_finding_exits_3(tmp_path, capsys):
+    root = _mini_repo(tmp_path)
+    args = ["--root", str(root)]
+
+    # un-baselined violation gates
+    assert cli.main(["lint", *args]) == 3
+
+    # baseline it; lint is now clean
+    assert cli.main(["baseline", *args]) == 0
+    assert cli.main(["lint", *args]) == 0
+
+    # a NEW violation exits 3 again (the old one stays tolerated)
+    mod = root / "pkg" / "mod.py"
+    mod.write_text(mod.read_text() + SECOND_VIOLATION)
+    assert cli.main(["lint", *args]) == 3
+    out = capsys.readouterr().out
+    assert "pmean" in out and "1 baselined" in out
+
+
+def test_baseline_preserves_justifications(tmp_path):
+    root = _mini_repo(tmp_path)
+    args = ["--root", str(root)]
+    assert cli.main(["baseline", *args]) == 0
+    bl_path = root / ".dslint-baseline.json"
+    data = json.loads(bl_path.read_text())
+    assert len(data["entries"]) == 1
+    data["entries"][0]["justification"] = "kept for the test"
+    bl_path.write_text(json.dumps(data))
+
+    # re-baselining must carry the justification over, not drop it
+    assert cli.main(["baseline", *args]) == 0
+    data2 = json.loads(bl_path.read_text())
+    assert data2["entries"][0]["justification"] == "kept for the test"
+
+
+def test_stale_entries_do_not_gate(tmp_path, capsys):
+    root = _mini_repo(tmp_path)
+    args = ["--root", str(root)]
+    assert cli.main(["baseline", *args]) == 0
+    # fix the violation: the baseline entry goes stale, lint stays 0
+    (root / "pkg" / "mod.py").write_text("def clean():\n    return 1\n")
+    assert cli.main(["lint", *args]) == 0
+    assert "stale" in capsys.readouterr().out
+
+
+def test_explain_lists_and_documents_rules(capsys):
+    assert cli.main(["explain"]) == 0
+    listing = capsys.readouterr().out
+    for rule in ("untracked-jit", "raw-collective", "bare-except",
+                 "thread-unsafe-attr"):
+        assert rule in listing
+    assert cli.main(["explain", "raw-collective"]) == 0
+    doc = capsys.readouterr().out
+    assert "CollectiveLedger" in doc
+    assert cli.main(["explain", "no-such-rule"]) == 2
+
+
+def test_nonexistent_path_is_a_usage_error_not_clean(tmp_path, capsys):
+    """A typo'd path must exit 2, never '== clean' — a renamed directory
+    in the CI races smoke would otherwise pass silently forever."""
+    root = _mini_repo(tmp_path)
+    rc = cli.main(["lint", "no/such/dir", "--root", str(root)])
+    assert rc == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_scoped_stale_check_resolves_paths_against_root(tmp_path, capsys,
+                                                        monkeypatch):
+    """Path scoping must join non-absolute paths onto --root (like the
+    scanner), not onto cwd — a genuinely stale entry inside the scanned
+    slice must be reported even when cwd is elsewhere."""
+    root = _mini_repo(tmp_path)
+    args = ["--root", str(root)]
+    assert cli.main(["baseline", *args]) == 0
+    (root / "pkg" / "mod.py").write_text("def clean():\n    return 1\n")
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+    assert cli.main(["lint", "pkg", *args]) == 0
+    assert "stale" in capsys.readouterr().out
+
+
+def test_scoped_rebaseline_preserves_out_of_scope_entries(tmp_path):
+    """`baseline <subdir>` must not delete (or strip justifications
+    from) entries outside the scanned slice — they were unobserved,
+    not fixed."""
+    root = _mini_repo(tmp_path)
+    other = root / "pkg" / "sub"
+    other.mkdir()
+    (other / "extra.py").write_text(VIOLATION)
+    args = ["--root", str(root)]
+    assert cli.main(["baseline", *args]) == 0
+    bl_path = root / ".dslint-baseline.json"
+    data = json.loads(bl_path.read_text())
+    assert len(data["entries"]) == 2
+    for e in data["entries"]:
+        e["justification"] = f"keep {e['path']}"
+    bl_path.write_text(json.dumps(data))
+
+    # rebaseline ONLY the subdir: the pkg/mod.py entry must survive
+    assert cli.main(["baseline", "pkg/sub", *args]) == 0
+    data2 = json.loads(bl_path.read_text())
+    paths = sorted(e["path"] for e in data2["entries"])
+    assert paths == ["pkg/mod.py", "pkg/sub/extra.py"]
+    assert all(e["justification"] == f"keep {e['path']}"
+               for e in data2["entries"])
