@@ -14,6 +14,16 @@ Extras in the same JSON line:
 - ``mfu``               — achieved model FLOP/s over the chip's bf16 peak
                           (analytic 6N + attention FLOPs; remat recompute
                           and optimizer math excluded per MFU convention).
+- ``peak_hbm_bytes``    — HBM high-water of the headline run
+                          (``memory_stats().peak_bytes_in_use``); gated
+                          by ``telemetry perf check`` (lower is better,
+                          10% tolerance + 64 MiB absolute floor).
+- ``hbm_headroom_frac`` — 1 - peak/limit: how much HBM the headline
+                          config leaves free (higher is better; the
+                          autotuning search budget).
+- ``environment_failure`` — present (true) ONLY on no-data error lines
+                          (device probe failed): tells ``perf check``
+                          to SKIP with the reason instead of gating.
 - ``variants``          — driver-ladder configs (BASELINE.md): BERT-large
                           ZeRO-2, llama3-8B-shaped ZeRO-3 slice, Mixtral
                           MoE on inference v2; plus the shape-tuned MFU
@@ -230,6 +240,16 @@ def _perf_extras(engine) -> dict:
         gp = get_goodput_ledger()
         if gp.enabled and gp.total_seconds() > 0:
             out["goodput"] = round(gp.goodput(), 4)
+        # memory plane (telemetry/memory): HBM high-water + headroom in
+        # the baseline, so `telemetry perf check` gates memory
+        # regressions the same way it gates throughput
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = int(stats.get("peak_bytes_in_use", 0) or 0)
+        limit = int(stats.get("bytes_limit", 0) or 0)
+        if peak:
+            out["peak_hbm_bytes"] = peak
+        if peak and limit:
+            out["hbm_headroom_frac"] = round(1.0 - peak / limit, 4)
     except Exception as e:
         out["perf_extras_error"] = str(e)[:120]
     return out
@@ -874,13 +894,26 @@ def _probe_devices_or_die(timeout_s: float = 180.0):
         return box["devices"]
     msg = box.get("error", f"jax.devices() unresponsive after "
                            f"{timeout_s:.0f}s (TPU tunnel down?)")
+    try:
+        # latch the verdict so nothing else in teardown walks into the
+        # same hang (telemetry/memory device-unresponsive gate)
+        from deepspeed_tpu.telemetry.memory import mark_device_unresponsive
+
+        mark_device_unresponsive(msg)
+    except Exception:
+        pass  # the JSON line below must go out regardless
+    # "environment_failure" marks a NO-DATA artifact (the r05 dead
+    # tunnel): `telemetry perf check` SKIPS it with this reason instead
+    # of silently passing or erroring on an empty run
     if "--selfcheck" in sys.argv:
         # keep the selfcheck output contract
-        print(json.dumps({"kernels_verified": False, "error": msg}))
+        print(json.dumps({"kernels_verified": False, "error": msg,
+                          "environment_failure": True}))
     else:
         print(json.dumps({"metric": "llama_110m_train_tokens_per_sec",
                           "value": 0.0, "unit": "tokens/sec/chip",
-                          "vs_baseline": 0.0, "error": msg}))
+                          "vs_baseline": 0.0, "error": msg,
+                          "environment_failure": True}))
     sys.stdout.flush()
     try:
         # os._exit skips atexit: clear the dirty-run sentinel ourselves or

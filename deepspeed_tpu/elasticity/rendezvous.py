@@ -445,6 +445,25 @@ class ElasticRendezvous:
             tel.set_gauge("elastic/cluster_goodput_mean",
                           stats["goodput_mean"],
                           help="mean per-host rolling goodput fraction")
+        # per-host HBM high-water + headroom ride the same payload
+        # (telemetry/memory): the fullest host is the one the next shape
+        # bump OOMs, and the smallest headroom bounds what autotuning
+        # may safely try cluster-wide
+        hbms = [float(i["hbm_frac"]) for i in infos
+                if isinstance(i, dict) and i.get("hbm_frac") is not None]
+        if hbms:
+            stats["hbm_max"] = max(hbms)
+            tel.set_gauge("elastic/cluster_hbm_max", stats["hbm_max"],
+                          help="fullest per-host HBM used fraction")
+        rooms = [float(i["hbm_headroom"]) for i in infos
+                 if isinstance(i, dict)
+                 and i.get("hbm_headroom") is not None]
+        if rooms:
+            stats["hbm_headroom_min"] = min(rooms)
+            tel.set_gauge("elastic/cluster_hbm_headroom_min",
+                          stats["hbm_headroom_min"],
+                          help="smallest per-host HBM headroom fraction "
+                               "(1 - peak/limit)")
         return stats
 
     def buddy(self) -> Optional[str]:

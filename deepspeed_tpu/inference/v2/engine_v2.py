@@ -123,6 +123,17 @@ class RaggedInferenceEngineV2:
                 out_shardings={"k": pool_sharding, "v": pool_sharding})()
         else:
             self.pool = init_kv_pool(self.adapter, self.cache_config)
+        from ...telemetry.memory import get_memory_ledger
+
+        _mem = get_memory_ledger()
+        if _mem.enabled:
+            # the paged KV pool is the serving plane's dominant HBM
+            # allocation — register it so `mem show` and OOM forensics
+            # name it instead of reporting one giant untracked array
+            _mem.register_tree(
+                "kv_cache", "inference_v2/kv_pool", self.pool,
+                tag=f"paged KV pool ({self.cache_config.num_blocks} x "
+                    f"{self.cache_config.block_size} tokens)")
         self.max_slots = max_batch_slots
         self.chunk = prefill_chunk
         self.prefill_batch = max(1, prefill_batch)

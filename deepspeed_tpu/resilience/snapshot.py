@@ -204,6 +204,18 @@ class SnapshotManager:
         self._buffers[self._active] = snap
         self.snapshots_taken += 1
         dt_ms = (self._clock() - t0) * 1e3
+        from ..telemetry.memory import get_memory_ledger
+
+        mem = get_memory_ledger()
+        if mem.enabled:
+            # tier-0 buffers are a full host copy of the TrainState per
+            # slot — the biggest host allocation most runs make; keyed
+            # per buffer slot so the double buffer accounts as two
+            # entries, each replaced in place on reuse
+            mem.register_tree(
+                "snapshot", f"resilience/tier0_buffer{self._active}",
+                host_state, space="host",
+                tag=f"tier-0 snapshot (step {snap.global_steps})")
         from ..telemetry import get_telemetry
         from ..telemetry.perf import get_goodput_ledger
 

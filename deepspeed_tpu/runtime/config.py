@@ -180,6 +180,12 @@ class TelemetryWatchdogConfig(DeepSpeedConfigModel):
     #: treat comms-logger counter movement as liveness (a long compile or
     #: giant eager collective is slow, not hung)
     comm_liveness: bool = True
+    #: bounded device-liveness check on the trip path: jax.devices()/
+    #: memory_stats() on a deadline thread, so a dead accelerator tunnel
+    #: yields a fail-fast bundle with a ``device_unresponsive``
+    #: annotation instead of a 180 s+ hang (BENCH_r05)
+    device_probe: bool = True
+    device_probe_timeout_s: float = 20.0
 
 
 class TelemetryHealthConfig(DeepSpeedConfigModel):
@@ -254,6 +260,32 @@ class TelemetryAggregationConfig(DeepSpeedConfigModel):
     ledger_exec_feed: bool = False
 
 
+class TelemetryMemoryConfig(DeepSpeedConfigModel):
+    """``telemetry.memory`` — the memory observability plane
+    (``telemetry/memory/``): the per-pool HBM/host byte ledger fed by
+    allocation-site hooks, per-step ``peak_hbm_bytes``/RSS/swap-IO on
+    StepRecords, OOM forensics (``memory.json`` + descriptive
+    ``HBMExhaustedError``), and the memory health rules.  Active when
+    ``telemetry.enabled`` is on or a flight recorder exists."""
+
+    enabled: bool = True
+    #: jax.live_arrays() census cadence in steps (O(all buffers) — too
+    #: expensive per step); <= 0 disables the census
+    live_census_every: int = 16
+    #: live arrays kept in forensics breakdowns (memory.json, `mem top`)
+    top_k: int = 10
+    #: memory_pressure health rule: HBM used fraction threshold and the
+    #: consecutive steps above it before the rule fires; frac <= 0
+    #: disables
+    pressure_frac: float = 0.92
+    pressure_steps: int = 8
+    #: host_memory_leak health rule: consecutive-growth window and the
+    #: minimum growth of the newest sample over the window median;
+    #: window < 2 disables
+    leak_window: int = 16
+    leak_frac: float = 0.05
+
+
 class TelemetryPerfConfig(DeepSpeedConfigModel):
     """``telemetry.perf`` — the performance observability plane
     (``telemetry/perf/``): compile/recompile tracking over every engine
@@ -307,6 +339,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     aggregation: TelemetryAggregationConfig = Field(
         default_factory=TelemetryAggregationConfig)
     perf: TelemetryPerfConfig = Field(default_factory=TelemetryPerfConfig)
+    memory: TelemetryMemoryConfig = Field(
+        default_factory=TelemetryMemoryConfig)
 
 
 class ResilienceConfig(DeepSpeedConfigModel):

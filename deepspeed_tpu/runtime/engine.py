@@ -350,7 +350,9 @@ class DeepSpeedEngine:
                 action=wd_cfg.action, comm_liveness=wd_cfg.comm_liveness,
                 # None when the recorder is disabled — the watchdog then
                 # trips WITHOUT writing bundles (the operator said no)
-                recorder=self.flight_recorder)
+                recorder=self.flight_recorder,
+                device_probe=wd_cfg.device_probe,
+                device_probe_timeout_s=wd_cfg.device_probe_timeout_s)
             # process-global handle: the elastic agent folds the
             # watchdog's heartbeat_payload into rendezvous heartbeats
             set_watchdog(self.watchdog)
@@ -384,6 +386,10 @@ class DeepSpeedEngine:
                 throughput_frac=h_cfg.throughput_frac,
                 compile_dominated_frac=h_cfg.compile_dominated_frac,
                 recompile_storm_threshold=h_cfg.recompile_storm_threshold,
+                memory_pressure_frac=tcfg.memory.pressure_frac,
+                memory_pressure_steps=tcfg.memory.pressure_steps,
+                host_leak_window=tcfg.memory.leak_window,
+                host_leak_frac=tcfg.memory.leak_frac,
                 registry=(self.telemetry.registry if self.telemetry.enabled
                           else None),
                 recorder=self.flight_recorder)
@@ -409,6 +415,23 @@ class DeepSpeedEngine:
                 self.goodput = configure_goodput_ledger(
                     enabled=True, window_s=pcfg.goodput_window_s,
                     recorder=self.flight_recorder)
+
+        # --- memory observability plane (telemetry/memory — ISSUE 7) -----
+        # per-pool byte ledger fed by the allocation sites below
+        # (_init_state placement, offload, swappers, KV pool, snapshots),
+        # per-step HBM/RSS/swap-IO samples on StepRecords, and the OOM
+        # catch around the step dispatch.  Configured BEFORE _init_state
+        # so placement registers into a live ledger.
+        self.memory_ledger = None
+        mem_cfg = tcfg.memory
+        if mem_cfg.enabled and (tcfg.enabled
+                                or self.flight_recorder is not None):
+            from ..telemetry.memory import configure_memory_ledger
+
+            self.memory_ledger = configure_memory_ledger(
+                enabled=True, top_k=mem_cfg.top_k,
+                recorder=self.flight_recorder)
+        self._mem_census_every = int(mem_cfg.live_census_every)
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -549,6 +572,14 @@ class DeepSpeedEngine:
                 base_specs=self.base_specs)
             scale_state = LossScaleState(jnp.float32(1.0), jnp.int32(0),
                                          jnp.int32(0))
+            if self.memory_ledger is not None:
+                # only the small resident subtree (embed/norm/head) lives
+                # on device; the trunk is the swapper's host planes,
+                # registered by PartitionedParamSwapper itself
+                self.memory_ledger.register_tree(
+                    "params", "infinity/resident_params",
+                    self.infinity.resident,
+                    tag="Infinity resident subtree (embed/norm/head)")
             return TrainState(params=self.infinity.resident, opt_state=(),
                               step=jnp.int32(0), loss_scale=scale_state,
                               skipped_steps=jnp.int32(0))
@@ -561,6 +592,24 @@ class DeepSpeedEngine:
                 # block on the placed tree so the span measures the
                 # transfer, not the enqueue (device_put is async)
                 jax.block_until_ready(params)
+        if self.memory_ledger is not None:
+            # the ZeRO placement site IS the params allocation: register
+            # the logical tree bytes (per-device residency is bytes/dp at
+            # stage 3 — the drift cross-check compares against the local
+            # device, so the snapshot records both views)
+            self.memory_ledger.register_tree(
+                "params", "engine/placed_params", params,
+                tag=f"zero stage {self.policy.stage} placed model params")
+            # stage >= 2 grads exist only INSIDE the compiled step in
+            # their reduce-scattered layout — tracked as transient fp32
+            # bytes so the breakdown names them without skewing the
+            # steady-state drift metric
+            grad_bytes = sum(
+                int(np.prod(np.shape(p))) * 4
+                for p in jax.tree.leaves(params))
+            self.memory_ledger.register(
+                "grads", "engine/step_grads", grad_bytes, transient=True,
+                tag="fp32 grad accumulators (transient, inside-step)")
 
         if self.offload_enabled:
             # optimizer states live on the HOST (ZeRO-Offload): fp32 master +
@@ -595,6 +644,10 @@ class DeepSpeedEngine:
                 opt_shapes, tx=self.optimizer, base_specs=self.base_specs)
             opt_state = self._jit(self.optimizer.init, "engine/opt_init",
                                   out_shardings=opt_shardings)(params)
+            if self.memory_ledger is not None:
+                self.memory_ledger.register_tree(
+                    "optimizer", "engine/opt_state", opt_state,
+                    tag=f"optax state (zero stage {self.policy.stage})")
 
         scale_state = (self.loss_scaler.init_state() if self.loss_scaler
                        else LossScaleState(jnp.float32(1.0), jnp.int32(0),
@@ -616,6 +669,10 @@ class DeepSpeedEngine:
                 lambda: init_residuals(params, dp_world),  # dslint: disable=recompile-hazard
                 "engine/onebit_residuals",
                 out_shardings=res_shardings)()
+            if self.memory_ledger is not None:
+                self.memory_ledger.register_tree(
+                    "collective_scratch", "engine/onebit_residuals",
+                    comm_state, tag="1-bit error-feedback residuals")
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.int32(0), loss_scale=scale_state,
                           skipped_steps=jnp.int32(0), comm_state=comm_state)
@@ -1237,18 +1294,31 @@ class DeepSpeedEngine:
             _c_ms0 = trk.time_ms_total
         _stall0_s = (self.goodput.totals()["stall"]
                      if self.goodput is not None else 0.0)
-        with self.telemetry.span("engine/train_step",
-                                 args={"step": self.global_steps}):
-            metrics = self._dispatch_train_step(batch)
         fenced = (self.config.wall_clock_breakdown
                   or self._autotuning_fence
                   or (self._telemetry_steps and self._telemetry_fence))
-        if fenced:
-            # breakdown/autotuning/telemetry trade throughput for truth
-            # (the reference inserts barriers the same way): a scalar fetch
-            # is the only reliable fence, so timers and StepRecords see
-            # DEVICE step time instead of host dispatch time
-            float(metrics["loss"])  # dslint: disable=host-sync-hot-path — the fence IS the point
+        try:
+            with self.telemetry.span("engine/train_step",
+                                     args={"step": self.global_steps}):
+                metrics = self._dispatch_train_step(batch)
+            if fenced:
+                # breakdown/autotuning/telemetry trade throughput for
+                # truth (the reference inserts barriers the same way): a
+                # scalar fetch is the only reliable fence, so timers and
+                # StepRecords see DEVICE step time, not dispatch time —
+                # and it is also where an async RESOURCE_EXHAUSTED from
+                # this step's program surfaces
+                float(metrics["loss"])  # dslint: disable=host-sync-hot-path — the fence IS the point
+        except Exception as e:
+            from ..telemetry.memory.oom import handle_oom, is_oom_error
+
+            if self.memory_ledger is None or not is_oom_error(e):
+                raise
+            # OOM forensics: ledger breakdown + top live arrays into the
+            # debug bundle (memory.json), re-raised as a descriptive
+            # error naming the top pools instead of a raw XLA traceback
+            raise handle_oom(e, recorder=self.flight_recorder,
+                             step=self.global_steps) from e
         step_time_s = time.perf_counter() - t_step0
         compile_ms, compile_events, recompile_events = 0.0, 0, 0
         if trk is not None:
@@ -1401,6 +1471,16 @@ class DeepSpeedEngine:
             extra["compile_ms"] = round(compile_ms, 3)
             extra["compile_events"] = int(compile_events)
             extra["recompile_events"] = int(recompile_events)
+        if self.memory_ledger is not None:
+            # per-step memory plane numbers ride extra (ISSUE 7):
+            # peak_hbm_bytes / hbm_frac / host_rss_bytes / swap_io_bytes
+            # (+ a live-array census every _mem_census_every steps) — the
+            # health monitor's memory_pressure and host_memory_leak
+            # rules read exactly these fields
+            census = (self._mem_census_every > 0
+                      and self.global_steps % self._mem_census_every
+                      == 1 % self._mem_census_every)  # every=1 → each step
+            extra.update(self.memory_ledger.step_sample(live_census=census))
         if comms_logger.enabled and comms_logger.exec_counts:
             # THIS step's execution-probe activity: shard-normalized
             # cumulative totals (satellite: no more hand-dividing by
@@ -1433,9 +1513,14 @@ class DeepSpeedEngine:
             comm_bytes=comms_logger.total_bytes(),
             comm_ops=comms_logger.total_ops(),
             tflops=tflops, mfu=mfu,
-            # live-buffer census every 16th step only (O(all buffers))
-            memory=collect_memory_stats(
-                include_live_buffers=self.global_steps % 16 == 1),
+            # with the memory ledger on, reuse the device/host readings
+            # step_sample just took (and its census already rode extra)
+            # — the record must not pay memory_stats + procfs twice;
+            # without it, the legacy path with its 16-step census
+            memory=(self.memory_ledger.status(cached=True)
+                    if self.memory_ledger is not None
+                    else collect_memory_stats(
+                        include_live_buffers=self.global_steps % 16 == 1)),
             extra=extra)
         self.last_step_record = rec
         self.step_records.append(rec)

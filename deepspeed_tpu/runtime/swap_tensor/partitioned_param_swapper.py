@@ -197,6 +197,12 @@ class PartitionedParamSwapper:
                 f"pipelined optimizer (pipeline=True needs >= 2: one slot "
                 f"for the in-flight update, one for read-ahead)")
         self.buffer_count = max(2, int(buffer_count))
+        # memory-plane handle BEFORE tier setup: the nvme branch below
+        # persists every layer through _write_layer_sync, which records
+        # its disk_write bytes against this ledger
+        from ...telemetry.memory import get_memory_ledger
+
+        self._mem = get_memory_ledger()
 
         hp = dict(adam_hparams or {})
         self.lr = float(hp.get("lr", 1e-3))
@@ -267,6 +273,16 @@ class PartitionedParamSwapper:
         log_dist(f"ZeRO-Infinity swapper: {self.L} layers × "
                  f"{self.n_elems:,} params, tier={tier}, "
                  f"host planes ≈ {host_mib:.0f} MiB")
+        # memory plane (telemetry/memory): the staging planes are the
+        # swap tier's real host allocation; NVMe/HBM traffic feeds the
+        # ledger's swap-IO lanes at the read/write/put sites above
+        if self._mem.enabled:
+            n_planes = self.buffer_count if self.nvme_dir else self.L
+            self._mem.register(
+                "swap_staging", "infinity/host_planes",
+                n_planes * per_layer, space="host",
+                tag=f"Infinity {tier}-tier staging planes "
+                    f"({n_planes} × {per_layer / 2**20:.0f} MiB)")
 
     # ------------------------------------------------------------------
     # plane helpers
@@ -352,6 +368,8 @@ class PartitionedParamSwapper:
         for kind, buf in (("wire", planes.wire), ("master", planes.master),
                           ("m", planes.m), ("v", planes.v)):
             self._aio.async_pwrite(buf, self._path(i, kind), truncate=True)
+            if self._mem.enabled:
+                self._mem.record_io("disk_write", buf.nbytes)
         failed = self._aio.wait()
         if failed:
             raise IOError(f"AIO write of layer {i} failed ({failed} ops)")
@@ -419,10 +437,15 @@ class PartitionedParamSwapper:
                 self._lru.append(i)
             planes = self._slots[self._slot_of[i]]
             self._aio.async_pread(planes.wire, self._path(i, "wire"))
+            read_bytes = planes.wire.nbytes
             if full:
                 self._aio.async_pread(planes.master, self._path(i, "master"))
                 self._aio.async_pread(planes.m, self._path(i, "m"))
                 self._aio.async_pread(planes.v, self._path(i, "v"))
+                read_bytes += (planes.master.nbytes + planes.m.nbytes
+                               + planes.v.nbytes)
+            if self._mem.enabled:
+                self._mem.record_io("disk_read", read_bytes)
             self._slot_state[i] = "reading" if not full else "full"
 
     def _ensure_host(self, i: int, full: bool = False) -> _Planes:
@@ -449,6 +472,8 @@ class PartitionedParamSwapper:
         """Device pytree of layer ``i``'s wire (compute-dtype) params."""
         if i not in self._device_cache:
             planes = self._ensure_host(i)
+            if self._mem.enabled:
+                self._mem.record_io("h2d", planes.wire.nbytes)
             if self.shard_world > 1:
                 # multi-controller: hand the executor the LOCAL flat chunk;
                 # it builds the device-sharded global plane and all-gathers
@@ -501,6 +526,8 @@ class PartitionedParamSwapper:
         addressable slice) — land it directly."""
         if self.shard_world > 1:
             g_np = np.asarray(grads_tree, dtype=np.float32).reshape(-1)
+            if self._mem.enabled:
+                self._mem.record_io("d2h", g_np.nbytes)
             if accumulate:
                 buf += g_np
             else:
@@ -513,6 +540,8 @@ class PartitionedParamSwapper:
         for g, (shape, off) in zip(grad_leaves, self.layout):
             n = int(np.prod(shape)) if shape else 1
             g_np = np.asarray(g).reshape(-1)
+            if self._mem.enabled:
+                self._mem.record_io("d2h", g_np.nbytes)
             if g_np.dtype != np.float32:
                 g_np = g_np.astype(np.float32)
             if accumulate:

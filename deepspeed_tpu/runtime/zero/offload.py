@@ -183,6 +183,27 @@ class CPUOffloadOptimizer:
                  f"({total / 2**20:.1f} MiB master slice/process, "
                  f"{self.num_slots} shards, "
                  f"dp-partitioned={policy is not None and policy.stage >= 1})")
+        # memory plane (telemetry/memory): the offload optimizer IS the
+        # allocation site for the host-side optimizer state — masters +
+        # moments under "optimizer", the bf16 wire staging under
+        # "swap_staging"; per-step d2h/h2d traffic feeds record_io
+        from ...telemetry.memory import get_memory_ledger
+
+        self._mem = get_memory_ledger()
+        if self._mem.enabled:
+            moments = sum(
+                sum(a.nbytes for a in getattr(self.opt, attr, []) or [])
+                for attr in ("exp_avg", "exp_avg_sq"))
+            self._mem.register(
+                "optimizer", "offload/host_masters", total + moments,
+                space="host",
+                tag=f"{name} fp32 masters + moments ({self.num_slots} "
+                    f"shards)")
+            if self._bf16_stage is not None:
+                self._mem.register(
+                    "swap_staging", "offload/bf16_stage",
+                    sum(s.nbytes for s in self._bf16_stage), space="host",
+                    tag="bf16 wire staging buffers")
 
     # ------------------------------------------------------------------
     # the per-step host round trip
@@ -206,6 +227,10 @@ class CPUOffloadOptimizer:
             self.last_timings["host_opt_s"] += t1 - t0
             h2d[slot] = [jax.device_put(src, d)
                          for d in self._slot_devices[slot]]
+            if self._mem.enabled:
+                # worker thread — record_io is lock-guarded
+                self._mem.record_io(
+                    "h2d", src.nbytes * len(self._slot_devices[slot]))
             t0 = time.perf_counter()
             self.last_timings["h2d_dispatch_s"] += t0 - t1
 
@@ -251,6 +276,8 @@ class CPUOffloadOptimizer:
             grads_np = []
             for slot in bucket:
                 g = np.asarray(shard_data[slot])  # blocks on THIS bucket only
+                if self._mem.enabled:
+                    self._mem.record_io("d2h", g.nbytes)
                 if g.dtype != np.float32:
                     g = g.astype(np.float32)  # bf16 wire → fp32 for the opt
                 grads_np.append(g)

@@ -273,4 +273,16 @@ def sharded_zeros_like(policy: ZeroShardingPolicy, tree: Any, kind: str = "param
         return jax.jit(lambda: jax.numpy.zeros(np.shape(leaf), leaf.dtype),  # dslint: disable=untracked-jit
                        out_shardings=sharding)()
 
-    return jax.tree.map(make, tree)
+    out = jax.tree.map(make, tree)
+    from ...telemetry.memory import get_memory_ledger, unique_key
+
+    led = get_memory_ledger()
+    if led.enabled:
+        # zero.Init materialization is a real allocation site: account
+        # the tree under its ZeRO role (unique key — callers materialize
+        # several trees through this site)
+        pool = {"param": "params", "grad": "grads",
+                "opt": "optimizer"}[kind]
+        led.register_tree(pool, unique_key(f"sharder/zeros_like/{kind}"),
+                          out, tag=f"sharded_zeros_like kind={kind}")
+    return out

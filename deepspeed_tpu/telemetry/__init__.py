@@ -39,6 +39,9 @@ from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       JSONLExporter, MetricsRegistry, escape_help,
                       escape_label_value, format_labels,
                       parse_prometheus_text, prom_name)
+from .memory import (HBMExhaustedError, MemoryLedger,
+                     configure_memory_ledger, get_memory_ledger,
+                     is_oom_error, probe_device_liveness)
 from .perf import (CompileTracker, GoodputLedger, configure_compile_tracker,
                    configure_goodput_ledger, get_compile_tracker,
                    get_goodput_ledger, tracked_jit)
@@ -65,6 +68,8 @@ __all__ = [
     "CompileTracker", "configure_compile_tracker", "get_compile_tracker",
     "tracked_jit", "GoodputLedger", "configure_goodput_ledger",
     "get_goodput_ledger",
+    "MemoryLedger", "configure_memory_ledger", "get_memory_ledger",
+    "HBMExhaustedError", "is_oom_error", "probe_device_liveness",
 ]
 
 

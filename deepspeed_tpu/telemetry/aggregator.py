@@ -586,6 +586,21 @@ def build_cluster_manifest(archive: str,
         led = (m.get("context") or {}).get("collective_ledger") or {}
         goodput = (m.get("context") or {}).get("goodput") or {}
         ct = (m.get("context") or {}).get("compile_programs") or {}
+        mem = (m.get("context") or {}).get("memory") or {}
+        mem_compact = None
+        if mem:
+            # per-host memory for the cluster view (telemetry/memory):
+            # the full breakdown stays in the host bundle; the manifest
+            # carries what an operator scans first
+            mem_compact = {k: mem.get(k) for k in (
+                "hbm_frac", "peak_hbm_bytes", "host_rss_bytes",
+                "tracked_bytes", "device_unresponsive") if
+                mem.get(k) is not None}
+            from .memory.oom import top_pools_of
+
+            top = top_pools_of(mem)
+            if top:
+                mem_compact["top_pools"] = top
         hosts[node] = {
             "reason": m.get("reason"),
             "time_utc": m.get("time_utc"),
@@ -604,6 +619,7 @@ def build_cluster_manifest(archive: str,
             "goodput_buckets_s": goodput.get("buckets_s"),
             "compile_events": ct.get("events_total"),
             "compile_time_ms": ct.get("time_ms_total"),
+            "memory": mem_compact,
         }
         for op, e in (comm.get("summary") or {}).items():
             census.setdefault(op, {})[node] = float(e.get("count", 0))

@@ -17,7 +17,12 @@ The read side of the observability plane, for humans at 3am:
   ``perf show`` prints a run's sentinel metrics, ``perf baseline``
   stores them, ``perf check`` compares a run against the stored
   baseline and exits 3 on regression beyond tolerance — the gate that
-  turns BENCH_r*.json from a log into a trajectory.
+  turns BENCH_r*.json from a log into a trajectory.  A no-data artifact
+  (an r05-style environment failure) is *skipped with a named reason*,
+  never a silent pass or a crash.
+* ``mem``      — the memory plane (``telemetry/memory``): ``mem show``
+  one bundle's pool breakdown, ``mem top`` its largest live arrays,
+  ``mem diff`` two bundles with a leak verdict (exit 3).
 
 Every command works on plain directories — no store, no JAX device
 needed beyond what importing the package costs.
@@ -114,6 +119,26 @@ def _print_bundle_summary(bundle: str, last_n: int) -> None:
         if led.get("exec_seq"):
             print(f"  exec-order census: seq {led.get('exec_seq')} "
                   f"tail_hash {led.get('exec_tail_hash')}")
+    mem = (m.get("context") or {}).get("memory")
+    if isinstance(mem, dict):
+        from .memory.oom import _fmt_bytes, top_pools_of
+
+        dev = mem.get("device") or {}
+        line = "  memory:"
+        if dev.get("bytes_limit"):
+            line += (f" hbm {_fmt_bytes(dev.get('bytes_in_use', 0))}/"
+                     f"{_fmt_bytes(dev['bytes_limit'])}")
+        if mem.get("host_rss_bytes") is not None:
+            line += f" rss {_fmt_bytes(mem['host_rss_bytes'])}"
+        if mem.get("tracked_bytes"):
+            line += f" tracked {_fmt_bytes(mem['tracked_bytes'])}"
+        top = top_pools_of(mem)
+        if top:
+            line += " — top: " + ", ".join(
+                f"{p}={_fmt_bytes(n)}" for p, n in top)
+        print(line)
+        if mem.get("device_unresponsive"):
+            print(f"    DEVICE UNRESPONSIVE: {mem['device_unresponsive']}")
     gp = (m.get("context") or {}).get("goodput")
     if isinstance(gp, dict):
         buckets = gp.get("buckets_s") or {}
@@ -176,9 +201,15 @@ def _print_archive_summary(archive: str, last_n: int) -> int:
     for node, h in sorted((cm.get("hosts") or {}).items()):
         gp = (f" goodput {h.get('goodput')}"
               if h.get("goodput") is not None else "")
+        mem = h.get("memory") or {}
+        mm = (f" hbm {mem['hbm_frac']:.0%}"
+              if mem.get("hbm_frac") is not None else "")
         print(f"  [{node}] step {h.get('last_step')} "
               f"ledger_seq {h.get('ledger_seq')} "
-              f"comm_ops {h.get('comm_ops')}{gp} — {h.get('reason')}")
+              f"comm_ops {h.get('comm_ops')}{gp}{mm} — {h.get('reason')}")
+        if mem.get("device_unresponsive"):
+            print(f"    [{node}] DEVICE UNRESPONSIVE: "
+                  f"{mem['device_unresponsive']}")
     deltas = cm.get("comm_census_delta") or {}
     skewed = {op: d for op, d in deltas.items() if d.get("delta")}
     if skewed:
@@ -346,6 +377,19 @@ def cmd_perf(args: argparse.Namespace) -> int:
         return 0
 
     # check
+    if not metrics:
+        # a run that produced NO sentinel metrics: an environment
+        # failure (r05: dead tunnel, value 0.0 + error) is a SKIP with a
+        # named reason — the bench never ran, so there is nothing to
+        # gate; anything else stays an error (a healthy run without
+        # metrics is a wiring bug the operator must see)
+        reason = perfmod.environment_failure_reason(run)
+        if reason:
+            print(f"perf check SKIPPED: run artifact carries no data — "
+                  f"environment failure ({reason}); nothing to gate")
+            return 0
+        return _fail(f"{args.run}: no sentinel metrics and no "
+                     f"environment-failure marker — not a bench artifact?")
     try:
         base = perfmod.load_baseline(args.baseline)
     except OSError as e:
@@ -437,6 +481,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override a tolerance, e.g. --tol mfu=0.05 "
                          "(repeatable)")
     fc.set_defaults(fn=cmd_perf)
+
+    from .memory.cli import add_mem_parser
+
+    add_mem_parser(sub)
     return p
 
 

@@ -45,6 +45,9 @@ BUNDLE_MANIFEST = "bundle.json"
 BUNDLE_TRACE = "trace.json"
 BUNDLE_ENV = "env_report.json"
 BUNDLE_STACKS = "stacks.txt"
+#: OOM forensics side file (telemetry/memory/oom.py) — present when the
+#: bundle was dumped for a recognized device OOM
+BUNDLE_MEMORY = "memory.json"
 
 
 def _jsonable(obj: Any) -> Any:
@@ -296,6 +299,13 @@ class FlightRecorder:
         self._prev_signal_handlers = {}
 
     def _excepthook(self, exc_type, exc, tb) -> None:
+        # an exception that already carries a bundle (the engine's OOM
+        # catch dumped one before re-raising HBMExhaustedError) must not
+        # produce a near-identical duplicate here
+        if getattr(exc, "ds_bundle_path", None):
+            prev = self._prev_excepthook or sys.__excepthook__
+            prev(exc_type, exc, tb)
+            return
         try:
             self.dump(f"unhandled exception: {exc_type.__name__}: {exc}",
                       extra={"traceback": "".join(
@@ -305,6 +315,18 @@ class FlightRecorder:
 
             debug_once("flight_recorder/excepthook_dump",
                        f"crash-bundle dump failed in excepthook ({e!r})")
+        try:
+            # OOM forensics (telemetry/memory): a RESOURCE_EXHAUSTED that
+            # escaped the engine's own catch (placement, first compile,
+            # user code) still gets memory.json next to the manifest
+            from .memory.oom import augment_bundle_on_oom
+
+            augment_bundle_on_oom(exc, self.last_bundle_path)
+        except Exception as e:
+            from ..utils.logging import debug_once
+
+            debug_once("flight_recorder/oom_augment",
+                       f"oom bundle augmentation failed ({e!r})")
         prev = self._prev_excepthook or sys.__excepthook__
         prev(exc_type, exc, tb)
 
@@ -335,6 +357,7 @@ def load_bundle(path: str) -> Dict[str, Any]:
         out: Dict[str, Any] = {"manifest": json.load(fh)}
     for key, name, is_json in (("trace", BUNDLE_TRACE, True),
                                ("env_report", BUNDLE_ENV, True),
+                               ("memory", BUNDLE_MEMORY, True),
                                ("stacks", BUNDLE_STACKS, False)):
         p = os.path.join(path, name)
         if not os.path.exists(p):
@@ -368,9 +391,16 @@ def recorder_from_config(tcfg: Any) -> Optional[FlightRecorder]:
     fr = tcfg.flight_recorder
     if not (fr.enabled and (tcfg.enabled or tcfg.watchdog.enabled)):
         return None
-    return configure_flight_recorder(
+    rec = configure_flight_recorder(
         max_records=fr.max_records,
         output_path=fr.output_path or os.path.join(
             tcfg.output_path or "telemetry_logs", tcfg.job_name,
             "debug_bundles"),
         retain=fr.retain_bundles)
+    # every bundle carries a memory snapshot (ISSUE 7 satellite): the
+    # same numbers see_memory_usage prints, honoring the ledger and the
+    # device-unresponsive latch — no separate enable gate needed
+    from ..utils.memory import memory_status
+
+    rec.register_context("memory_status", memory_status)
+    return rec

@@ -12,6 +12,12 @@ per-step record stream into structured :class:`HealthEvent`\\ s:
 * ``recompile_storm``        — too many recompile events within the
   window (a shape/dtype/static leak is re-tracing programs that should
   be cached; every one stalls the step loop for a compile)
+* ``memory_pressure``        — HBM used fraction above threshold for N
+  consecutive steps (the headroom signal autotuning and operators need
+  BEFORE the OOM, fed by the memory ledger's per-step samples)
+* ``host_memory_leak``       — monotonic host-RSS / live-array-count
+  growth vs the rolling median (a leak in host staging, snapshot
+  buffers, or un-freed jax arrays; quiet on flat or sawtooth usage)
 
 Compile-dominated steps (``extra["compile_ms"]`` at or above
 ``compile_dominated_frac`` of the step time — the CompileTracker's
@@ -77,6 +83,10 @@ class HealthMonitor:
                  throughput_frac: float = 0.5,
                  compile_dominated_frac: float = 0.5,
                  recompile_storm_threshold: int = 3,
+                 memory_pressure_frac: float = 0.92,
+                 memory_pressure_steps: int = 8,
+                 host_leak_window: int = 16,
+                 host_leak_frac: float = 0.05,
                  registry: Optional[Any] = None,
                  recorder: Optional[Any] = None):
         self.min_points = max(2, int(min_points))
@@ -91,6 +101,16 @@ class HealthMonitor:
         #: RECOMPILE events (not first compiles) within the window that
         #: constitute a storm; <= 0 disables the rule
         self.recompile_storm_threshold = int(recompile_storm_threshold)
+        #: HBM used fraction at or above which a step counts toward the
+        #: memory_pressure streak; <= 0 disables the rule
+        self.memory_pressure_frac = float(memory_pressure_frac)
+        self.memory_pressure_steps = max(1, int(memory_pressure_steps))
+        #: consecutive-growth window for the host-leak detector; the
+        #: rule needs EVERY pair in the window to grow (flat stays
+        #: quiet) AND the newest sample to clear the rolling median by
+        #: ``host_leak_frac``; window < 2 disables the rule
+        self.host_leak_window = int(host_leak_window)
+        self.host_leak_frac = float(host_leak_frac)
         self.registry = registry
         self.recorder = recorder
         w = max(int(window), self.min_points)
@@ -101,6 +121,11 @@ class HealthMonitor:
         #: per-step recompile counts over the window (storm detector)
         self._recompiles: "collections.deque[int]" = collections.deque(
             maxlen=w)
+        lw = max(self.host_leak_window, 2)
+        #: host-RSS and live-array-count series (leak detector)
+        self._rss: "collections.deque[float]" = collections.deque(maxlen=lw)
+        self._live: "collections.deque[float]" = collections.deque(maxlen=lw)
+        self._pressure_streak = 0
         self._prev_scale: Optional[float] = None
         self._scale_drops = 0
         self._scale_collapsed = False  # fire the floor crossing once
@@ -122,6 +147,9 @@ class HealthMonitor:
         self._grad_norms.clear()
         self._tps.clear()
         self._recompiles.clear()
+        self._rss.clear()
+        self._live.clear()
+        self._pressure_streak = 0
         self._prev_scale = None
         self._scale_drops = 0
         self._scale_collapsed = False
@@ -266,6 +294,101 @@ class HealthMonitor:
             # leak re-alerts per window instead of on every step
             self._recompiles.clear()
 
+    def _check_memory_pressure(self, rec: StepRecord,
+                               out: List[HealthEvent]) -> None:
+        if self.memory_pressure_frac <= 0:
+            return
+        frac = None
+        try:
+            frac = rec.extra.get("hbm_frac")
+        except AttributeError:
+            frac = None
+        if frac is None:
+            # fall back to the memory_status fields already on the record
+            used = float(rec.memory.get("device_in_use_GB", 0.0) or 0.0)
+            limit = float(rec.memory.get("device_limit_GB", 0.0) or 0.0)
+            frac = used / limit if limit > 0 else None
+        if frac is None:
+            return
+        frac = float(frac)
+        if frac < self.memory_pressure_frac:
+            self._pressure_streak = 0
+            return
+        self._pressure_streak += 1
+        if self._pressure_streak < self.memory_pressure_steps:
+            return
+        out.append(HealthEvent(
+            "memory_pressure", SEV_WARNING, rec.step,
+            f"step {rec.step}: HBM {frac:.0%} full for "
+            f"{self._pressure_streak} consecutive steps (threshold "
+            f"{self.memory_pressure_frac:.0%}) — the next shape bump or "
+            f"fragmentation event is an OOM; lower micro-batch / raise "
+            f"remat / shard further (see memory/pool_* gauges)",
+            frac, self.memory_pressure_frac))
+        # one streak, one event: restart the count so sustained pressure
+        # re-alerts every memory_pressure_steps instead of every step
+        self._pressure_streak = 0
+
+    @staticmethod
+    def _leaky(series: "collections.deque", frac: float) -> bool:
+        """True when the FULL window grew on every consecutive pair AND
+        the newest sample clears the rolling median by ``frac`` — flat
+        and sawtooth series stay quiet."""
+        if len(series) < series.maxlen:
+            return False
+        xs = list(series)
+        if any(b <= a for a, b in zip(xs, xs[1:])):
+            return False
+        return xs[-1] > _median(xs) * (1.0 + frac)
+
+    def _check_host_leak(self, rec: StepRecord,
+                         out: List[HealthEvent]) -> None:
+        if self.host_leak_window < 2:
+            return
+        rss = None
+        try:
+            rss = rec.extra.get("host_rss_bytes")
+        except AttributeError:
+            rss = None
+        if rss is None and rec.memory.get("process_rss_GB"):
+            rss = float(rec.memory["process_rss_GB"]) * 2 ** 30
+        if rss is not None:
+            self._rss.append(float(rss))
+            if self._leaky(self._rss, self.host_leak_frac):
+                xs = list(self._rss)
+                out.append(HealthEvent(
+                    "host_memory_leak", SEV_WARNING, rec.step,
+                    f"step {rec.step}: host RSS grew monotonically for "
+                    f"{len(xs)} samples ({xs[0] / 2**30:.2f} -> "
+                    f"{xs[-1] / 2**30:.2f} GB, "
+                    f"{(xs[-1] / max(_median(xs), 1.0) - 1):.1%} over the "
+                    f"rolling median) — a host-side buffer (staging, "
+                    f"snapshot, un-freed arrays) is accumulating",
+                    xs[-1], _median(xs) * (1.0 + self.host_leak_frac)))
+                self._rss.clear()  # re-alert per window, not per step
+        # live-array COUNT is sampled sparsely (every Nth step) — feed
+        # only when present; monotonic count growth is the same leak
+        # signature seen from the allocator's side
+        live = rec.memory.get("live_buffers")
+        if live is None:
+            try:
+                live = rec.extra.get("live_arrays")
+            except AttributeError:
+                live = None
+        if live is not None:
+            self._live.append(float(live))
+            if self._leaky(self._live, self.host_leak_frac):
+                xs = list(self._live)
+                out.append(HealthEvent(
+                    "host_memory_leak", SEV_WARNING, rec.step,
+                    f"step {rec.step}: live jax-array count grew "
+                    f"monotonically for {len(xs)} samples "
+                    f"({int(xs[0])} -> {int(xs[-1])}) — arrays are being "
+                    f"created without being freed (see `mem top` on a "
+                    f"debug bundle for the biggest ones)",
+                    xs[-1], _median(xs) * (1.0 + self.host_leak_frac)))
+                self._live.clear()
+
     # -- the feed ----------------------------------------------------------
 
     def observe(self, rec: StepRecord) -> List[HealthEvent]:
@@ -276,6 +399,8 @@ class HealthMonitor:
             self._check_loss_scale(rec, out)
         self._check_throughput(rec, out)
         self._check_recompile_storm(rec, out)
+        self._check_memory_pressure(rec, out)
+        self._check_host_leak(rec, out)
         for ev in out:
             self._publish(ev)
         return out

@@ -1,0 +1,556 @@
+"""Memory ledger — per-pool byte accounting for HBM and host memory.
+
+The stack can see *time* end-to-end (spans, StepRecords, the goodput
+account) and *collectives* (the ledger), but until this plane existed
+*memory* — the entire point of the ZeRO/offload/Infinity lineage — was a
+single print helper.  The :class:`MemoryLedger` is the missing account:
+
+* **Registration hooks at the real allocation sites** feed per-pool byte
+  totals: ZeRO sharder placement registers ``params``/``optimizer``,
+  ``offload`` registers its host-side masters and moments, the Infinity
+  swapper registers its staging planes, inference-v2 registers the KV
+  pool, the resilience plane registers tier-0 snapshot buffers.
+* **Cross-checks against the runtime** each sample: the tracked total is
+  compared with ``device.memory_stats()['bytes_in_use']`` and an
+  optional ``jax.live_arrays()`` census — the DRIFT between "what we
+  think we allocated" and "what XLA actually holds" is itself a metric
+  (``memory/ledger_drift_bytes``): steady growth there is a leak in
+  something the ledger doesn't know about.
+* **Per-step numbers** (``peak_hbm_bytes`` / ``host_rss_bytes`` /
+  ``swap_io_bytes``) ride ``StepRecord.extra``; a rolling HBM
+  high-water + headroom fraction rides the watchdog
+  ``heartbeat_payload`` so rank 0 publishes
+  ``elastic/cluster_hbm_{max,headroom_min}``.
+
+Like every singleton in the telemetry stack the global ledger is cheap
+when disabled (one attribute read) and explicit instances are testable.
+All mutation happens under one lock: registration sites run on the main
+thread, IO accounting runs on offload/swapper worker threads, and the
+watchdog thread reads summaries on trip.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ...utils.logging import debug_once
+
+#: the pool taxonomy — where training-run bytes live (README "Where the
+#: memory goes" documents each).  Registration is open (any string is
+#: accepted) but attribution quality is measured against THESE names.
+POOLS = ("params", "grads", "optimizer", "activations", "kv_cache",
+         "swap_staging", "snapshot", "collective_scratch", "other")
+
+#: IO lanes for ``record_io`` — swap traffic between tiers
+IO_KINDS = ("h2d", "d2h", "disk_read", "disk_write")
+
+_uniq = itertools.count()
+
+
+def unique_key(prefix: str) -> str:
+    """A collision-free registration key for sites that allocate in a
+    loop (e.g. the sharder's per-tree zero materialization)."""
+    return f"{prefix}#{next(_uniq)}"
+
+
+# ---------------------------------------------------------------------------
+# device-liveness probe (bounded: a dead TPU tunnel hangs jax.devices()
+# indefinitely — observed 180 s+ in BENCH_r05 — so every device call on a
+# failure path goes through here)
+# ---------------------------------------------------------------------------
+
+_unresponsive_lock = threading.Lock()
+_unresponsive_detail: Optional[str] = None
+
+
+def mark_device_unresponsive(detail: str) -> None:
+    """Process-global latch: once a bounded probe times out, every later
+    device introspection call (memory_status, ledger samples, bundle
+    context providers) skips the device instead of hanging the very
+    failure path that is trying to report the problem."""
+    global _unresponsive_detail
+    with _unresponsive_lock:
+        _unresponsive_detail = detail
+
+
+def clear_device_unresponsive() -> None:
+    global _unresponsive_detail
+    with _unresponsive_lock:
+        _unresponsive_detail = None
+
+
+def device_unresponsive() -> Optional[str]:
+    with _unresponsive_lock:
+        return _unresponsive_detail
+
+
+def _default_probe() -> Dict[str, Any]:
+    import jax
+
+    devs = jax.local_devices()
+    stats = {}
+    if devs:
+        try:
+            stats = devs[0].memory_stats() or {}
+        except Exception as e:  # CPU / tunnel backends without the API
+            stats = {"error": repr(e)}
+    return {"device_count": len(devs), "memory_stats": bool(stats)}
+
+
+def probe_device_liveness(timeout_s: float = 20.0,
+                          probe_fn: Optional[Callable[[], Any]] = None
+                          ) -> Dict[str, Any]:
+    """Bounded-timeout device health check (thread + deadline):
+    ``jax.devices()`` + ``memory_stats()`` run on a daemon thread, the
+    caller waits at most ``timeout_s``.  On timeout the process-global
+    unresponsive latch is set and ``{"alive": False, ...}`` returns —
+    the caller gets a fail-fast verdict instead of the 180 s+ hang a
+    dead TPU tunnel otherwise produces."""
+    box: Dict[str, Any] = {}
+    fn = probe_fn or _default_probe
+
+    def run():
+        try:
+            box["result"] = fn()
+        except Exception as e:
+            box["error"] = repr(e)
+
+    t0 = time.monotonic()
+    t = threading.Thread(target=run, daemon=True,
+                         name="ds-device-liveness-probe")
+    t.start()
+    t.join(timeout_s)
+    elapsed = round(time.monotonic() - t0, 3)
+    if "result" in box:
+        return {"alive": True, "elapsed_s": elapsed, "detail": box["result"]}
+    if "error" in box:
+        # the runtime ANSWERED (with an error) — responsive but unhealthy
+        return {"alive": False, "elapsed_s": elapsed, "detail": box["error"]}
+    detail = (f"device probe timed out after {timeout_s:.1f}s "
+              f"(jax.devices()/memory_stats() unresponsive — dead "
+              f"accelerator tunnel?)")
+    mark_device_unresponsive(detail)
+    return {"alive": False, "elapsed_s": elapsed, "detail": detail,
+            "timed_out": True}
+
+
+# ---------------------------------------------------------------------------
+# host / device sampling primitives
+# ---------------------------------------------------------------------------
+
+def host_memory_bytes() -> Dict[str, float]:
+    """Host-side numbers from procfs (bytes)."""
+    out: Dict[str, float] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            info = {line.split(":")[0]: line.split()[1] for line in f}
+        total = int(info["MemTotal"]) * 1024
+        avail = int(info["MemAvailable"]) * 1024
+        out["host_used_bytes"] = float(total - avail)
+        out["host_available_bytes"] = float(avail)
+    except (OSError, KeyError, ValueError, IndexError):
+        pass
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["host_rss_bytes"] = float(rss_pages
+                                      * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of a pytree of arrays (device or numpy)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = np.asarray(leaf).nbytes
+        total += int(n)
+    return total
+
+
+class MemoryLedger:
+    """Per-pool byte account with device/host cross-checks."""
+
+    def __init__(self, enabled: bool = False, top_k: int = 10):
+        self.enabled = bool(enabled)
+        self.top_k = int(top_k)
+        self._lock = threading.Lock()
+        #: (pool, key) -> entry dict
+        self._entries: Dict[tuple, Dict[str, Any]] = {}
+        #: (shape, dtype-str) -> pool, for live-array provenance tagging
+        self._shape_index: Dict[tuple, str] = {}
+        self._io: Dict[str, float] = {k: 0.0 for k in IO_KINDS}
+        self._peak_hbm_bytes = 0.0
+        self._last_device: Dict[str, float] = {}
+        self._last_host: Dict[str, float] = {}
+        self._last_live_count: Optional[int] = None
+        #: test seam — None uses jax.local_devices()[0].memory_stats()
+        self._device_stats_fn: Optional[Callable[[], Dict]] = None
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  top_k: Optional[int] = None) -> "MemoryLedger":
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if top_k is not None:
+            self.top_k = int(top_k)
+        return self
+
+    def reset(self) -> None:
+        """Test isolation: drop entries, IO totals, and the high-water."""
+        with self._lock:
+            self._entries = {}
+            self._shape_index = {}
+            self._io = {k: 0.0 for k in IO_KINDS}
+            self._peak_hbm_bytes = 0.0
+            self._last_device = {}
+            self._last_host = {}
+            self._last_live_count = None
+            self._device_stats_fn = None
+
+    # -- registration (the allocation-site hooks) --------------------------
+
+    def register(self, pool: str, key: str, nbytes: int,
+                 space: str = "hbm", tag: str = "",
+                 transient: bool = False) -> None:
+        """Account ``nbytes`` under ``pool`` at registration key ``key``
+        (re-registering the same key replaces — the double-buffer /
+        rebuild pattern).  ``space`` is ``"hbm"`` or ``"host"``;
+        ``transient=True`` marks bytes that only exist inside a step
+        (stage>=2 grads) — they stay in the breakdown but are excluded
+        from the steady-state drift cross-check."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._entries[(str(pool), str(key))] = {
+                "nbytes": int(nbytes), "space": str(space),
+                "tag": str(tag), "transient": bool(transient),
+                "ts": time.time()}
+
+    def register_tree(self, pool: str, key: str, tree: Any,
+                      space: str = "hbm", tag: str = "",
+                      transient: bool = False) -> int:
+        """Register a pytree of arrays; returns the byte total.  Leaf
+        (shape, dtype) signatures are indexed so a later live-array
+        census can attribute arrays back to this pool."""
+        if not self.enabled:
+            return 0
+        import jax
+        import numpy as np
+
+        total = 0
+        sigs = []
+        for leaf in jax.tree.leaves(tree):
+            n = getattr(leaf, "nbytes", None)
+            if n is None:
+                n = np.asarray(leaf).nbytes
+            total += int(n)
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            dtype = str(getattr(leaf, "dtype", ""))
+            if shape:
+                sigs.append((shape, dtype))
+        self.register(pool, key, total, space=space, tag=tag,
+                      transient=transient)
+        with self._lock:
+            for sig in sigs:
+                self._shape_index.setdefault(sig, str(pool))
+        return total
+
+    def release(self, pool: str, key: str) -> None:
+        with self._lock:
+            self._entries.pop((str(pool), str(key)), None)
+
+    def record_io(self, kind: str, nbytes: float) -> None:
+        """Swap traffic accounting (offload d2h grad pulls, h2d param
+        pushes, Infinity NVMe reads/writes)."""
+        if not self.enabled:
+            return
+        if kind not in self._io:
+            raise ValueError(f"unknown io kind {kind!r} (one of {IO_KINDS})")
+        with self._lock:
+            self._io[kind] += float(nbytes)
+
+    # -- accounting views --------------------------------------------------
+
+    def pool_bytes(self, space: Optional[str] = None,
+                   include_transient: bool = True) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (pool, _key), e in self._entries.items():
+                if space is not None and e["space"] != space:
+                    continue
+                if not include_transient and e["transient"]:
+                    continue
+                out[pool] = out.get(pool, 0) + e["nbytes"]
+        return out
+
+    def tracked_bytes(self, space: Optional[str] = None,
+                      include_transient: bool = False) -> int:
+        return sum(self.pool_bytes(space=space,
+                                   include_transient=include_transient)
+                   .values())
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e, pool=pool, key=key)
+                    for (pool, key), e in sorted(self._entries.items())]
+
+    def io_totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._io)
+
+    # -- runtime cross-checks ----------------------------------------------
+
+    def device_stats(self) -> Dict[str, float]:
+        """``memory_stats()`` of local device 0 (bytes), ``{}`` when the
+        platform has none or the device is latched unresponsive."""
+        if device_unresponsive() is not None:
+            return {}
+        fn = self._device_stats_fn
+        try:
+            if fn is not None:
+                stats = fn() or {}
+            else:
+                import jax
+
+                devs = jax.local_devices()
+                stats = (devs[0].memory_stats() or {}) if devs else {}
+        except Exception as e:  # CPU backends / tunnels without the API
+            debug_once("memory/device_stats",
+                       f"device memory_stats unavailable ({e!r})")
+            return {}
+        out = {}
+        for k in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use",
+                  "largest_free_block_bytes"):
+            if k in stats:
+                try:
+                    out[k] = float(stats[k])
+                except (TypeError, ValueError):
+                    continue
+        return out
+
+    def live_array_census(self, top_k: Optional[int] = None
+                          ) -> Dict[str, Any]:
+        """``jax.live_arrays()`` sweep: count, total bytes, and the
+        top-K arrays by nbytes with best-effort pool provenance (from
+        the registered (shape, dtype) index).  O(all live buffers) —
+        callers sample it, never run it per step."""
+        from ...utils.jax_compat import live_arrays
+
+        arrays = live_arrays()
+        total = 0
+        top: List[Dict[str, Any]] = []
+        with self._lock:
+            index = dict(self._shape_index)
+        for a in arrays:
+            try:
+                n = int(a.nbytes)
+                shape = tuple(a.shape)
+                dtype = str(a.dtype)
+            except Exception as e:  # deleted-buffer race mid-sweep
+                debug_once("memory/census_leaf",
+                           f"live-array introspection failed ({e!r})")
+                continue
+            total += n
+            top.append({"nbytes": n, "shape": list(shape), "dtype": dtype,
+                        "pool": index.get((shape, dtype), "untracked")})
+        top.sort(key=lambda e: -e["nbytes"])
+        k = self.top_k if top_k is None else int(top_k)
+        census = {"count": len(arrays), "total_bytes": total,
+                  "top": top[:k]}
+        with self._lock:
+            self._last_live_count = len(arrays)
+        return census
+
+    # -- sampling ----------------------------------------------------------
+
+    def step_sample(self, live_census: bool = False) -> Dict[str, float]:
+        """The per-step numbers that ride ``StepRecord.extra``.  Cheap:
+        one ``memory_stats()`` call + procfs reads; the live-array
+        census only when asked (the engine samples it every N steps)."""
+        if not self.enabled:
+            return {}
+        dev = self.device_stats()
+        host = host_memory_bytes()
+        out: Dict[str, float] = {}
+        in_use = dev.get("bytes_in_use", 0.0)
+        limit = dev.get("bytes_limit", 0.0)
+        peak = dev.get("peak_bytes_in_use", in_use)
+        with self._lock:
+            if peak > self._peak_hbm_bytes:
+                self._peak_hbm_bytes = float(peak)
+            rolled_peak = self._peak_hbm_bytes
+            self._last_device = dict(dev)
+            self._last_host = dict(host)
+            io_total = sum(self._io.values())
+        if dev:
+            out["peak_hbm_bytes"] = float(rolled_peak)
+            if limit > 0:
+                out["hbm_frac"] = round(in_use / limit, 4)
+                out["hbm_headroom_frac"] = round(1.0 - peak / limit, 4)
+            tracked = self.tracked_bytes(space="hbm")
+            if tracked:
+                out["ledger_drift_bytes"] = float(in_use - tracked)
+        if "host_rss_bytes" in host:
+            out["host_rss_bytes"] = host["host_rss_bytes"]
+        if io_total:
+            out["swap_io_bytes"] = io_total
+        if live_census:
+            census = self.live_array_census()
+            out["live_arrays"] = float(census["count"])
+        self._publish(out)
+        return out
+
+    def _publish(self, sample: Dict[str, float]) -> None:
+        try:
+            from .. import get_telemetry
+
+            tel = get_telemetry()
+            if not tel.enabled:
+                return
+            for name, help_txt in (
+                    ("peak_hbm_bytes", "rolling HBM high-water (bytes)"),
+                    ("hbm_frac", "HBM bytes_in_use / bytes_limit"),
+                    ("hbm_headroom_frac", "1 - peak HBM / limit"),
+                    ("host_rss_bytes", "process resident set (bytes)"),
+                    ("swap_io_bytes", "cumulative swap IO bytes"),
+                    ("ledger_drift_bytes",
+                     "device bytes_in_use minus ledger-tracked bytes")):
+                if name in sample:
+                    tel.set_gauge(f"memory/{name}", sample[name],
+                                  help=help_txt)
+            for pool, nbytes in self.pool_bytes().items():
+                tel.set_gauge(f"memory/pool_{pool}_bytes", nbytes,
+                              help=f"ledger-tracked bytes in pool {pool}")
+        except Exception as e:  # metrics publish is best-effort
+            debug_once("memory/publish",
+                       f"memory gauge publish failed ({e!r})")
+
+    def heartbeat_summary(self) -> Dict[str, float]:
+        """Rides the watchdog ``heartbeat_payload``: rank 0 folds every
+        host's values into ``elastic/cluster_hbm_{max,headroom_min}``.
+        Reads ONLY the cached sample from the last ``step_sample`` — the
+        heartbeat thread must never make a fresh (unbounded) device call:
+        if the tunnel died before the first sample, hanging here would
+        block the very heartbeat loop that reports the host alive."""
+        with self._lock:
+            dev = dict(self._last_device)
+        out: Dict[str, float] = {}
+        limit = dev.get("bytes_limit", 0.0)
+        if limit > 0:
+            with self._lock:
+                peak = max(self._peak_hbm_bytes,
+                           dev.get("peak_bytes_in_use", 0.0))
+            out["hbm_frac"] = round(dev.get("bytes_in_use", 0.0) / limit, 4)
+            out["hbm_headroom"] = round(1.0 - peak / limit, 4)
+        return out
+
+    # -- forensics ---------------------------------------------------------
+
+    def snapshot(self, live_census: bool = False) -> Dict[str, Any]:
+        """Bundle context payload: the full breakdown an operator reads
+        post-mortem (and the cluster manifest compacts per host)."""
+        pools_hbm = self.pool_bytes(space="hbm")
+        pools_host = self.pool_bytes(space="host")
+        tracked = sum(pools_hbm.values()) + sum(pools_host.values())
+        named = sum(n for p, n in list(pools_hbm.items())
+                    + list(pools_host.items()) if p in POOLS
+                    and p != "other")
+        dev = self.device_stats()
+        host = host_memory_bytes()
+        with self._lock:
+            peak = self._peak_hbm_bytes
+            live_count = self._last_live_count
+        out: Dict[str, Any] = {
+            "enabled": self.enabled,
+            "pools_hbm_bytes": pools_hbm,
+            "pools_host_bytes": pools_host,
+            "tracked_bytes": tracked,
+            "attributed_frac": round(named / tracked, 4) if tracked else 1.0,
+            "io_bytes": self.io_totals(),
+            "device": dev,
+            "host": host,
+            "peak_hbm_bytes": peak or dev.get("peak_bytes_in_use", 0.0),
+            "entries": self.entries(),
+        }
+        if dev.get("bytes_limit"):
+            out["hbm_frac"] = round(
+                dev.get("bytes_in_use", 0.0) / dev["bytes_limit"], 4)
+        if "host_rss_bytes" in host:
+            out["host_rss_bytes"] = host["host_rss_bytes"]
+        if dev.get("bytes_in_use") is not None and out["tracked_bytes"]:
+            out["ledger_drift_bytes"] = (
+                dev.get("bytes_in_use", 0.0)
+                - self.tracked_bytes(space="hbm"))
+        if live_count is not None:
+            out["live_arrays"] = live_count
+        if live_census:
+            out["live_census"] = self.live_array_census()
+        unresp = device_unresponsive()
+        if unresp:
+            out["device_unresponsive"] = unresp
+        return out
+
+    def status(self, cached: bool = False) -> Dict[str, float]:
+        """The ``utils.memory.memory_status()`` surface (GB floats) —
+        BOTH report the same numbers because both read this ledger.
+        ``cached=True`` reuses the device/host readings the last
+        :meth:`step_sample` already took — the engine assembles its
+        StepRecord right after sampling, and must not pay the
+        memory_stats RPC + procfs reads twice per step."""
+        with self._lock:
+            cached_host = dict(self._last_host)
+            cached_dev = dict(self._last_device)
+        host = (cached_host if cached and cached_host
+                else host_memory_bytes())
+        out: Dict[str, float] = {}
+        GB = float(2 ** 30)
+        if "host_used_bytes" in host:
+            out["host_used_GB"] = host["host_used_bytes"] / GB
+        if "host_available_bytes" in host:
+            out["host_available_GB"] = host["host_available_bytes"] / GB
+        if "host_rss_bytes" in host:
+            out["process_rss_GB"] = host["host_rss_bytes"] / GB
+        dev = cached_dev if cached else self.device_stats()
+        if dev:
+            out["device_in_use_GB"] = dev.get("bytes_in_use", 0.0) / GB
+            out["device_limit_GB"] = dev.get("bytes_limit", 0.0) / GB
+            out["device_peak_GB"] = dev.get("peak_bytes_in_use", 0.0) / GB
+        if self.enabled:
+            for pool, nbytes in sorted(self.pool_bytes().items()):
+                out[f"pool_{pool}_GB"] = nbytes / GB
+        return out
+
+
+_default = MemoryLedger()
+
+
+def get_memory_ledger() -> MemoryLedger:
+    return _default
+
+
+def configure_memory_ledger(enabled: bool = True,
+                            top_k: Optional[int] = None,
+                            recorder: Any = None) -> MemoryLedger:
+    """Resolve config into the global ledger; with a flight recorder the
+    breakdown lands in every debug bundle (context ``memory``) — which
+    is how the cluster manifest learns per-host memory."""
+    led = _default.configure(enabled=enabled, top_k=top_k)
+    if recorder is not None and enabled:
+        # census at DUMP time: live_arrays() is client-side metadata
+        # (never touches the device), and bundles are not a hot path —
+        # so every bundle's memory section supports `mem top`
+        recorder.register_context(
+            "memory", lambda: led.snapshot(live_census=True))
+    return led

@@ -1,0 +1,195 @@
+"""OOM forensics — turn a raw ``RESOURCE_EXHAUSTED`` into an answer.
+
+An XLA out-of-memory today dies with an allocator stack trace that names
+a buffer size and nothing else.  This module is the catch path:
+
+* :func:`is_oom_error` recognizes the XLA/jax OOM family
+  (``RESOURCE_EXHAUSTED``, allocator "Out of memory", pjrt allocation
+  failures) without importing backend-specific exception types.
+* :func:`handle_oom` snapshots the memory ledger breakdown plus the
+  top-K live arrays by nbytes (with pool provenance tags) into the
+  flight-recorder bundle — ``memory.json`` next to the manifest — and
+  builds an :class:`HBMExhaustedError` whose MESSAGE names the top
+  pools, so the traceback an operator first sees already says where the
+  bytes went.
+* The engine wraps its step dispatch with this path; the flight
+  recorder's excepthook calls :func:`augment_bundle_on_oom` so an OOM
+  outside the engine (state placement, first compile) gets the same
+  ``memory.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import debug_once, logger
+from .ledger import MemoryLedger, get_memory_ledger
+
+#: substrings that mark the XLA/jax OOM family (matched against the
+#: exception text and type name — backend exception classes moved
+#: between jaxlib releases, so duck-typing beats isinstance here)
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+               "Out of memory", "out of memory",
+               "Resource exhausted", "OOM when allocating",
+               "Failed to allocate")
+
+MEMORY_JSON = "memory.json"
+
+
+class HBMExhaustedError(RuntimeError):
+    """Device memory exhausted — raised with the ledger's verdict.
+
+    ``top_pools`` is the [(pool, bytes), ...] breakdown (largest first),
+    ``bundle_path`` the debug bundle carrying ``memory.json`` (None when
+    the flight recorder is off), ``report`` the full forensics dict."""
+
+    def __init__(self, message: str,
+                 top_pools: Optional[List] = None,
+                 bundle_path: Optional[str] = None,
+                 report: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.top_pools = top_pools or []
+        self.bundle_path = bundle_path
+        self.report = report or {}
+        #: the flight-recorder excepthook skips its own dump when the
+        #: exception already carries a bundle (avoids a duplicate)
+        self.ds_bundle_path = bundle_path
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    if exc is None:
+        return False
+    if isinstance(exc, (HBMExhaustedError, MemoryError)):
+        return True
+    text = f"{type(exc).__name__}: {exc}"
+    return any(marker in text for marker in OOM_MARKERS)
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def oom_report(ledger: Optional[MemoryLedger] = None,
+               top_k: Optional[int] = None) -> Dict[str, Any]:
+    """The ``memory.json`` payload: full ledger snapshot + live-array
+    census (bounded: the census enumerates live buffers, which is safe —
+    the allocation FAILED, so the device is responsive)."""
+    led = ledger or get_memory_ledger()
+    report = led.snapshot(live_census=True)
+    report["kind"] = "oom_forensics"
+    if top_k is not None and "live_census" in report:
+        report["live_census"]["top"] = \
+            report["live_census"]["top"][:int(top_k)]
+    return report
+
+
+def top_pools_of(report: Dict[str, Any], k: int = 3) -> List:
+    """[(pool, bytes), ...] over BOTH spaces, largest first."""
+    merged: Dict[str, float] = {}
+    for space_key in ("pools_hbm_bytes", "pools_host_bytes"):
+        for pool, nbytes in (report.get(space_key) or {}).items():
+            merged[pool] = merged.get(pool, 0.0) + float(nbytes)
+    ranked = sorted(merged.items(), key=lambda kv: -kv[1])
+    return ranked[:k]
+
+
+def write_memory_json(bundle_dir: str, report: Dict[str, Any]
+                      ) -> Optional[str]:
+    """Drop ``memory.json`` into an existing bundle dir (best-effort —
+    a failed write must never mask the OOM itself)."""
+    try:
+        path = os.path.join(bundle_dir, MEMORY_JSON)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        logger.error(f"oom forensics: memory.json write failed: {e!r}")
+        return None
+
+
+def describe_oom(exc: BaseException, report: Dict[str, Any],
+                 step: Optional[int] = None) -> str:
+    """The operator-facing headline: names the top pools and the device
+    numbers, so the raised traceback already answers 'where did the
+    bytes go'."""
+    pools = top_pools_of(report)
+    parts = []
+    if step is not None:
+        parts.append(f"step {step}")
+    dev = report.get("device") or {}
+    if dev.get("bytes_limit"):
+        parts.append(f"HBM {_fmt_bytes(dev.get('bytes_in_use', 0))} in use "
+                     f"of {_fmt_bytes(dev['bytes_limit'])}")
+    if pools:
+        pool_txt = ", ".join(f"{p}={_fmt_bytes(n)}" for p, n in pools)
+        parts.append(f"top pools: {pool_txt}")
+    drift = report.get("ledger_drift_bytes")
+    if drift is not None:
+        parts.append(f"untracked drift {_fmt_bytes(drift)}")
+    detail = "; ".join(parts) if parts else "no ledger data"
+    top = pools[0][0] if pools else "unknown"
+    return (f"device memory exhausted ({detail}) — biggest tracked pool "
+            f"is '{top}'; see memory.json in the debug bundle for the "
+            f"per-pool breakdown and top live arrays.  Original: "
+            f"{type(exc).__name__}: {str(exc)[:300]}")
+
+
+def handle_oom(exc: BaseException, recorder: Any = None,
+               ledger: Optional[MemoryLedger] = None,
+               step: Optional[int] = None) -> HBMExhaustedError:
+    """Build the forensics bundle for an OOM and return the descriptive
+    :class:`HBMExhaustedError` (the caller raises it ``from exc``)."""
+    led = ledger or get_memory_ledger()
+    try:
+        report = oom_report(ledger=led)
+    except Exception as e:  # forensics must never mask the OOM
+        debug_once("memory/oom_report", f"oom report failed ({e!r})")
+        report = {"kind": "oom_forensics", "error": repr(e)}
+    bundle = None
+    if recorder is not None:
+        try:
+            bundle = recorder.dump(
+                f"HBM exhausted: {type(exc).__name__}: {str(exc)[:200]}",
+                extra={"oom": True, "step": step,
+                       "top_pools": top_pools_of(report)})
+            write_memory_json(bundle, report)
+        except Exception as e:
+            logger.error(f"oom forensics: bundle dump failed: {e!r}")
+    msg = describe_oom(exc, report, step=step)
+    if bundle:
+        msg += f"  [debug bundle: {bundle}]"
+    try:
+        from .. import get_telemetry
+
+        get_telemetry().inc_counter(
+            "memory/oom_events_total", help="recognized device OOMs")
+    except Exception as e:
+        debug_once("memory/oom_counter",
+                   f"oom counter publish failed ({e!r})")
+    return HBMExhaustedError(msg, top_pools=top_pools_of(report),
+                             bundle_path=bundle, report=report)
+
+
+def augment_bundle_on_oom(exc: BaseException,
+                          bundle_dir: Optional[str]) -> bool:
+    """Excepthook half of the catch path: when the crash that just
+    dumped ``bundle_dir`` is an OOM, add ``memory.json`` so bundles from
+    OUTSIDE the engine's own catch (placement, first compile, user
+    code) carry the same forensics.  Returns True when written."""
+    if not bundle_dir or not is_oom_error(exc):
+        return False
+    try:
+        return write_memory_json(bundle_dir, oom_report()) is not None
+    except Exception as e:
+        debug_once("memory/oom_augment",
+                   f"oom bundle augmentation failed ({e!r})")
+        return False

@@ -16,9 +16,9 @@ trains at the speed the hardware allows" observability:
 """
 
 from .baseline import (ABS_FLOORS, DEFAULT_BASELINE, PERF_METRICS,
-                       check_regression, extract_perf, format_check_report,
-                       load_baseline, load_run, parse_tolerances,
-                       save_baseline)
+                       check_regression, environment_failure_reason,
+                       extract_perf, format_check_report, load_baseline,
+                       load_run, parse_tolerances, save_baseline)
 from .compile_tracker import (CompileEvent, CompileTracker,
                               configure_compile_tracker, diff_signatures,
                               get_compile_tracker, signature_of, tracked_jit)
@@ -33,4 +33,5 @@ __all__ = [
     "PERF_METRICS", "ABS_FLOORS", "DEFAULT_BASELINE", "load_run",
     "extract_perf", "save_baseline", "load_baseline", "check_regression",
     "format_check_report", "parse_tolerances",
+    "environment_failure_reason",
 ]

@@ -30,6 +30,10 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     "goodput": ("higher", 0.05),
     "step_time_p50_ms": ("lower", 0.10),
     "compile_time_s": ("lower", 0.25),
+    # memory plane (telemetry/memory): the same config suddenly holding
+    # more HBM is a regression long before it is an OOM
+    "peak_hbm_bytes": ("lower", 0.10),
+    "hbm_headroom_frac": ("higher", 0.10),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -37,6 +41,8 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
 ABS_FLOORS: Dict[str, float] = {
     "compile_time_s": 1.0,
     "step_time_p50_ms": 1.0,
+    # sub-64MiB HBM jitter (allocator rounding, cache growth) is noise
+    "peak_hbm_bytes": 64 * 1024 * 1024,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"
@@ -77,6 +83,32 @@ def extract_perf(run: Dict[str, Any]) -> Dict[str, float]:
             except (TypeError, ValueError):
                 continue
     return out
+
+
+def environment_failure_reason(run: Dict[str, Any]) -> Optional[str]:
+    """A *no-data* artifact's named reason, or ``None`` for a real run.
+
+    Matches two shapes: an explicit ``environment_failure`` marker
+    (``bench.py`` stamps it when its device probe fails), and the
+    LEGACY r05-style probe-failure line — ``value`` 0 with an ``error``
+    field and NO ``debug_bundle`` key.  The key matters: a bench that
+    *crashed* (a code regression — OOM, assertion) also emits value 0 +
+    error, but its line carries ``debug_bundle`` (``_emit_crash_line``)
+    and no marker — that must stay a LOUD failure of the gate, never a
+    skip.  ``perf check`` skips only genuine environment failures, with
+    the reason printed."""
+    if run.get("environment_failure"):
+        return str(run.get("error") or "environment_failure marker set")
+    err = run.get("error")
+    if not err or "debug_bundle" in run:
+        return None  # a crash artifact is a real failure, not a skip
+    try:
+        value = float(run.get("value", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        value = 0.0
+    if value == 0.0:
+        return str(err)
+    return None
 
 
 def save_baseline(path: str, run: Dict[str, Any],

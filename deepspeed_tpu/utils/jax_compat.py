@@ -93,6 +93,20 @@ def current_manual_axes() -> Set[Any]:
         return set()
 
 
+def live_arrays():
+    """``jax.live_arrays()`` — every live array the client tracks —
+    across releases; ``[]`` when the introspection API is absent (the
+    memory plane then reports device/host stats only)."""
+    try:
+        return list(jax.live_arrays())
+    except Exception as e:  # API drift across jax releases
+        from .logging import debug_once
+
+        debug_once("jax_compat/live_arrays",
+                   f"jax.live_arrays unavailable ({e!r})")
+        return []
+
+
 def ckpt_metadata_tree(loader, path):
     """Orbax moved checkpoint metadata between releases: newer
     StandardCheckpointer returns an object with ``.item_metadata.tree``,

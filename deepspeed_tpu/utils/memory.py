@@ -2,54 +2,29 @@
 
 Reference: ``deepspeed/runtime/utils.py:see_memory_usage(message, force)``
 [K]: prints allocator stats at checkpoints in the engine lifecycle (the
-single most-used debugging helper in reference issue reports).  TPU form:
-per-device HBM stats from the runtime + host RSS/available from procfs.
+single most-used debugging helper in reference issue reports).
+
+Since the memory plane landed (``telemetry/memory/``) this module is a
+thin veneer over the :class:`~..telemetry.memory.MemoryLedger`: BOTH
+report the same numbers because both read the same account — the ledger
+adds per-pool breakdowns (``pool_params_GB`` etc.) when it is enabled,
+and honors the device-unresponsive latch so a dead TPU tunnel cannot
+hang a memory print on a failure path.
 """
 
 from __future__ import annotations
 
-import os
 from typing import Dict
-
-import jax
 
 from .logging import log_dist
 
 
-def _host_memory() -> Dict[str, float]:
-    out = {}
-    try:
-        with open("/proc/meminfo") as f:
-            info = {line.split(":")[0]: line.split()[1] for line in f}
-        out["host_used_GB"] = (int(info["MemTotal"])
-                               - int(info["MemAvailable"])) / 2 ** 20
-        out["host_available_GB"] = int(info["MemAvailable"]) / 2 ** 20
-    except (OSError, KeyError):
-        pass
-    try:
-        with open(f"/proc/{os.getpid()}/statm") as f:
-            rss_pages = int(f.read().split()[1])
-        out["process_rss_GB"] = rss_pages * os.sysconf("SC_PAGE_SIZE") / 2 ** 30
-    except (OSError, ValueError):
-        pass
-    return out
-
-
 def memory_status() -> Dict[str, float]:
-    """Device + host memory numbers (GB)."""
-    out = _host_memory()
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        out["device_in_use_GB"] = stats.get("bytes_in_use", 0) / 2 ** 30
-        out["device_limit_GB"] = stats.get("bytes_limit", 0) / 2 ** 30
-        out["device_peak_GB"] = stats.get("peak_bytes_in_use", 0) / 2 ** 30
-    except Exception as e:  # platforms without memory_stats (CPU, tunnels)
-        from .logging import debug_once
+    """Device + host memory numbers (GB), via the memory ledger (plus
+    per-pool ``pool_*_GB`` fields when the ledger is enabled)."""
+    from ..telemetry.memory import get_memory_ledger
 
-        debug_once("memory/device_stats",
-                   f"device memory_stats unavailable ({e!r}); "
-                   f"reporting host memory only")
-    return out
+    return get_memory_ledger().status()
 
 
 def see_memory_usage(message: str, force: bool = False) -> None:

@@ -27,7 +27,8 @@ SHARDS=(
   "tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/monitor"
   "tests/unit/analysis"
-  "tests/unit/telemetry"
+  "tests/unit/telemetry --ignore=tests/unit/telemetry/test_memory_ledger.py --ignore=tests/unit/telemetry/test_memory_oom.py --ignore=tests/unit/telemetry/test_memory_health.py --ignore=tests/unit/telemetry/test_memory_cli.py --ignore=tests/unit/telemetry/test_memory_watchdog.py"
+  "tests/unit/telemetry/test_memory_ledger.py tests/unit/telemetry/test_memory_oom.py tests/unit/telemetry/test_memory_health.py tests/unit/telemetry/test_memory_cli.py tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/resilience"
   "tests/unit/perf"
   "tests/unit/profiling"
@@ -129,6 +130,44 @@ if python -m deepspeed_tpu.resilience ls "$smoke_dir/snaps" >/dev/null \
   echo "=== resilience CLI smoke passed"
 else
   echo "=== resilience CLI smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
+# Memory-plane CLI smoke (ISSUE 7): a ledger-carrying bundle must `mem
+# show` cleanly and `mem diff` against itself must exit 0 (and a grown
+# pool must verdict-exit 3 — the scriptable leak gate).
+echo "=== mem CLI smoke: show / diff exit codes"
+smoke_dir=$(mktemp -d)
+mem_ok=1
+bundles=$(python - "$smoke_dir" <<'PYEOF'
+import sys
+from deepspeed_tpu.telemetry import FlightRecorder
+from deepspeed_tpu.telemetry.memory import get_memory_ledger
+
+led = get_memory_ledger()
+led.configure(enabled=True)
+led.register("params", "p", 2 << 30)
+fr = FlightRecorder(output_path=sys.argv[1])
+fr.register_context("memory", led.snapshot)
+a = fr.dump("mem smoke A")
+led.register("snapshot", "t0", 4 << 30, space="host")
+b = fr.dump("mem smoke B")
+print(a)
+print(b)
+PYEOF
+)
+bundle_a=$(echo "$bundles" | tail -2 | head -1)
+bundle_b=$(echo "$bundles" | tail -1)
+python -m deepspeed_tpu.telemetry mem show "$bundle_a" >/dev/null || mem_ok=0
+python -m deepspeed_tpu.telemetry mem diff "$bundle_a" "$bundle_a" \
+    >/dev/null || mem_ok=0
+python -m deepspeed_tpu.telemetry mem diff "$bundle_a" "$bundle_b" >/dev/null
+[ $? -eq 3 ] || mem_ok=0
+if [ $mem_ok -eq 1 ]; then
+  echo "=== mem CLI smoke passed"
+else
+  echo "=== mem CLI smoke FAILED"
   fail=1
 fi
 rm -rf "$smoke_dir"

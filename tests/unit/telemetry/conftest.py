@@ -31,6 +31,13 @@ def _fresh_diagnostics():
         gp = get_goodput_ledger()
         gp.reset()
         gp.enabled = False
+        from deepspeed_tpu.telemetry.memory import (
+            clear_device_unresponsive, get_memory_ledger)
+
+        mem = get_memory_ledger()
+        mem.reset()
+        mem.enabled = False
+        clear_device_unresponsive()
 
     scrub()
     yield

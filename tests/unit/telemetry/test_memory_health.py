@@ -1,0 +1,121 @@
+"""Memory health rules — pressure streaks fire, leaks fire, flat stays quiet."""
+
+import math
+
+from deepspeed_tpu.telemetry import HealthMonitor, StepRecord
+
+
+def _rec(step, extra=None, memory=None):
+    return StepRecord(
+        step=step, step_time_ms=10.0, device_fenced=True,
+        samples_per_sec=100.0, tokens_per_sec=1000.0, loss=1.0,
+        grad_norm=1.0, lr=1e-3, loss_scale=1.0, overflow=False,
+        skipped_steps=0, comm_bytes=0, comm_ops=0,
+        memory=memory or {}, extra=extra or {})
+
+
+def _mon(**kw):
+    defaults = dict(window=64, min_points=4,
+                    memory_pressure_frac=0.9, memory_pressure_steps=3,
+                    host_leak_window=4, host_leak_frac=0.05,
+                    recompile_storm_threshold=0)
+    defaults.update(kw)
+    return HealthMonitor(**defaults)
+
+
+def test_memory_pressure_fires_after_streak():
+    mon = _mon()
+    events = []
+    for step in range(1, 3):
+        events += mon.observe(_rec(step, extra={"hbm_frac": 0.95}))
+    assert not events  # streak not yet long enough
+    events = mon.observe(_rec(3, extra={"hbm_frac": 0.95}))
+    assert [e.kind for e in events] == ["memory_pressure"]
+    assert "95%" in events[0].message
+    # the streak restarts after firing: no event on the very next step
+    assert not mon.observe(_rec(4, extra={"hbm_frac": 0.95}))
+
+
+def test_memory_pressure_streak_resets_below_threshold():
+    mon = _mon()
+    mon.observe(_rec(1, extra={"hbm_frac": 0.95}))
+    mon.observe(_rec(2, extra={"hbm_frac": 0.95}))
+    mon.observe(_rec(3, extra={"hbm_frac": 0.5}))  # dip resets
+    assert not mon.observe(_rec(4, extra={"hbm_frac": 0.95}))
+    assert not mon.observe(_rec(5, extra={"hbm_frac": 0.95}))
+    events = mon.observe(_rec(6, extra={"hbm_frac": 0.95}))
+    assert [e.kind for e in events] == ["memory_pressure"]
+
+
+def test_memory_pressure_falls_back_to_memory_status_fields():
+    mon = _mon(memory_pressure_steps=1)
+    events = mon.observe(_rec(1, memory={"device_in_use_GB": 15.0,
+                                         "device_limit_GB": 16.0}))
+    assert [e.kind for e in events] == ["memory_pressure"]
+
+
+def test_host_leak_fires_on_monotonic_rss_growth():
+    mon = _mon()
+    GB = 2 ** 30
+    events = []
+    # strictly growing, final sample well over the window median
+    for step, rss in enumerate([10 * GB, 11 * GB, 12 * GB, 14 * GB], 1):
+        events += mon.observe(_rec(step, extra={"host_rss_bytes": rss}))
+    assert [e.kind for e in events] == ["host_memory_leak"]
+    assert "RSS" in events[0].message
+    # window cleared after firing — quiet until it refills
+    assert not mon.observe(_rec(9, extra={"host_rss_bytes": 15 * GB}))
+
+
+def test_host_leak_quiet_on_flat_and_sawtooth():
+    mon = _mon()
+    GB = 2 ** 30
+    # flat — equal samples are NOT monotonic growth
+    for step in range(1, 9):
+        assert not mon.observe(_rec(step, extra={"host_rss_bytes": 10 * GB}))
+    # sawtooth — any dip breaks the monotonic requirement
+    mon2 = _mon()
+    saw = [10 * GB, 11 * GB, 10 * GB, 12 * GB, 11 * GB, 13 * GB,
+           12 * GB, 14 * GB]
+    for step, rss in enumerate(saw, 1):
+        assert not mon2.observe(_rec(step, extra={"host_rss_bytes": rss}))
+
+
+def test_host_leak_on_live_array_count_growth():
+    mon = _mon()
+    events = []
+    for step, live in enumerate([1000, 1100, 1300, 1600], 1):
+        events += mon.observe(_rec(step, memory={"live_buffers": live}))
+    assert [e.kind for e in events] == ["host_memory_leak"]
+    assert "live jax-array count" in events[0].message
+
+
+def test_memory_rules_disabled_by_config():
+    mon = _mon(memory_pressure_frac=0.0, host_leak_window=0)
+    GB = 2 ** 30
+    for step, rss in enumerate([10 * GB, 12 * GB, 15 * GB, 20 * GB], 1):
+        assert not mon.observe(_rec(
+            step, extra={"hbm_frac": 0.99, "host_rss_bytes": rss}))
+
+
+def test_reset_windows_clears_memory_state():
+    mon = _mon()
+    GB = 2 ** 30
+    for step, rss in enumerate([10 * GB, 11 * GB, 12 * GB], 1):
+        mon.observe(_rec(step, extra={"host_rss_bytes": rss,
+                                      "hbm_frac": 0.95}))
+    mon.reset_windows()
+    # post-rollback: both streak and window start fresh
+    assert mon._pressure_streak == 0
+    assert not mon._rss
+    events = mon.observe(_rec(4, extra={"host_rss_bytes": 14 * GB,
+                                        "hbm_frac": 0.95}))
+    assert not events
+
+
+def test_records_without_memory_fields_are_ignored():
+    mon = _mon(memory_pressure_steps=1)
+    rec = _rec(1)
+    rec.memory["device_in_use_GB"] = 0.0  # zero limit -> no frac
+    assert not mon.observe(rec)
+    assert not any(math.isnan(x) for x in mon._rss)
