@@ -68,7 +68,9 @@ class RaggedInferenceEngineV2:
                  max_batch_slots: int = 8, prefill_chunk: int = 128,
                  prefill_batch: int = 2, decode_burst: int = 8,
                  adapter: Optional[ModelAdapterV2] = None,
-                 mesh: Any = None):
+                 mesh: Any = None,
+                 scheduler_factory: Optional[Callable] = None,
+                 ledger_key: str = "inference_v2/kv_pool"):
         self.model = model
         self.adapter = adapter or make_adapter(model)
         self.config = model.config
@@ -99,8 +101,11 @@ class RaggedInferenceEngineV2:
             # clamps out-of-bounds starts, which would silently retarget a
             # chunk's KV writes onto the sequence's EARLIER pages
             raise ValueError("max_seq_len must be a multiple of prefill_chunk")
-        self.scheduler = RaggedScheduler(self.cache_config, max_batch_slots,
-                                         prefill_chunk, prefill_batch)
+        #: the serving plane swaps in its prefix-sharing scheduler here —
+        #: same planner surface, refcounted page reservations
+        make_sched = scheduler_factory or RaggedScheduler
+        self.scheduler = make_sched(self.cache_config, max_batch_slots,
+                                    prefill_chunk, prefill_batch)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -130,8 +135,10 @@ class RaggedInferenceEngineV2:
             # the paged KV pool is the serving plane's dominant HBM
             # allocation — register it so `mem show` and OOM forensics
             # name it instead of reporting one giant untracked array
+            # ledger_key is per-instance so multi-replica serving gets
+            # DISTINCT kv_cache sub-keys (same key would silently replace)
             _mem.register_tree(
-                "kv_cache", "inference_v2/kv_pool", self.pool,
+                "kv_cache", ledger_key, self.pool,
                 tag=f"paged KV pool ({self.cache_config.num_blocks} x "
                     f"{self.cache_config.block_size} tokens)")
         self.max_slots = max_batch_slots
@@ -438,10 +445,14 @@ def build_engine_v2(model: Any, params: Any = None,
                     prefill_chunk: int = 128,
                     prefill_batch: int = 2,
                     decode_burst: int = 8,
-                    mesh: Any = None) -> RaggedInferenceEngineV2:
+                    mesh: Any = None,
+                    scheduler_factory: Optional[Callable] = None,
+                    ledger_key: str = "inference_v2/kv_pool"
+                    ) -> RaggedInferenceEngineV2:
     if params is None:
         params = model.init_params(jax.random.PRNGKey(0))
     return RaggedInferenceEngineV2(model, params, cache_config,
                                    max_batch_slots, prefill_chunk,
                                    prefill_batch, decode_burst,
-                                   mesh=mesh)
+                                   mesh=mesh, scheduler_factory=scheduler_factory,
+                                   ledger_key=ledger_key)

@@ -57,6 +57,10 @@ class BlockAllocator:
             raise ValueError("need >= 2 blocks (page 0 is reserved)")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        #: O(1) membership for the double-free check — the free list grew
+        #: past linear-scan sizes once serving workloads started churning
+        #: pages through the prefix cache
+        self._free_set = set(self._free)
 
     @property
     def num_free(self) -> int:
@@ -66,12 +70,29 @@ class BlockAllocator:
         if n > len(self._free):
             raise MemoryError(f"KV pool exhausted: want {n} pages, "
                               f"{len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
+
+    def check_owned(self, b: int) -> None:
+        """Raise a descriptive error unless ``b`` is a currently-allocated
+        page id.  The serving plane's refcounting is built on this
+        invariant — a silent bad free there would corrupt a *shared*
+        prefix page that other requests are still reading."""
+        if not 0 < b < self.num_blocks:
+            raise ValueError(
+                f"free of out-of-range page id {b!r}: valid ids are "
+                f"1..{self.num_blocks - 1} (page 0 is the reserved scratch "
+                f"page and is never allocated or freed)")
+        if b in self._free_set:
+            raise ValueError(
+                f"double free of page {b}: it is already on the free list "
+                f"({len(self._free)} pages free of {self.num_blocks - 1}) — "
+                f"the caller freed a block table twice or freed a table it "
+                f"does not own")
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
-            if not 0 < b < self.num_blocks:
-                raise ValueError(f"bad page id {b}")
-            if b in self._free:
-                raise ValueError(f"double free of page {b}")
+            self.check_owned(b)
             self._free.append(b)
+            self._free_set.add(b)

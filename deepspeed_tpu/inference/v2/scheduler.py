@@ -40,6 +40,11 @@ class Request:
     blocks: List[int] = dataclasses.field(default_factory=list)
     prefilled: int = 0          # prompt tokens already written to the pool
     slot: int = -1              # decode batch slot while RUNNING
+    #: prefill-lattice priority (lower = sooner) — the serving plane maps
+    #: latency classes here so an interactive prompt's chunks are not
+    #: stuck behind a batch of background prefills; plain engine use
+    #: leaves everything at 0 (pure FIFO)
+    priority: int = 0
 
     @property
     def length(self) -> int:
@@ -77,7 +82,7 @@ class RaggedScheduler:
         if prefill_chunk % cache_config.block_size:
             raise ValueError("prefill_chunk must be a multiple of block_size")
         self.cache = cache_config
-        self.allocator = BlockAllocator(cache_config.num_blocks)
+        self.allocator = self._make_allocator(cache_config.num_blocks)
         self.chunk = prefill_chunk
         self.prefill_batch = max(1, prefill_batch)
         self.max_slots = max_batch_slots
@@ -86,11 +91,27 @@ class RaggedScheduler:
         self.prefilling: Deque[Request] = deque()
         self._uid = 0
 
+    def _make_allocator(self, num_blocks: int) -> BlockAllocator:
+        """Subclass hook: the serving scheduler swaps in its refcounted
+        allocator without constructing a discarded base one."""
+        return BlockAllocator(num_blocks)
+
     # -- request surface ---------------------------------------------------
 
-    def add_request(self, prompt: List[int], max_new_tokens: int) -> Request:
+    def validate(self, prompt: List[int], max_new_tokens: int) -> None:
+        """Reject malformed requests with an error naming the offending
+        field.  The serving front-end forwards user input directly into
+        this scheduler, so every invariant the planner relies on (a
+        non-empty prompt, a positive generation budget, a pool that can
+        ever hold the request) must be checked HERE, not discovered as a
+        has_work spin or a zero-length chunk later."""
         if not prompt:
-            raise ValueError("empty prompt")
+            raise ValueError("prompt: must be a non-empty token list")
+        if max_new_tokens <= 0:
+            raise ValueError(
+                f"max_new_tokens: must be >= 1, got {max_new_tokens} "
+                f"(a request that may generate nothing would occupy a "
+                f"decode slot forever)")
         total = len(prompt) + max_new_tokens
         if total > self.cache.max_seq_len:
             raise ValueError(f"request of {total} tokens exceeds "
@@ -102,6 +123,9 @@ class RaggedScheduler:
             raise ValueError(
                 f"request needs {need} pages but the pool only has "
                 f"{self.cache.num_blocks - 1}")
+
+    def add_request(self, prompt: List[int], max_new_tokens: int) -> Request:
+        self.validate(prompt, max_new_tokens)
         req = Request(uid=self._uid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens)
         self._uid += 1
@@ -125,6 +149,22 @@ class RaggedScheduler:
                 return i
         return -1
 
+    def _reserve(self, req: Request) -> bool:
+        """Reserve the request's full page budget; ``False`` defers
+        admission.  Subclass hook: the serving scheduler overrides this
+        to satisfy part of the reservation from shared prefix pages."""
+        need = req.pages_needed(self.cache.block_size)
+        if need > self.allocator.num_free:
+            return False
+        req.blocks = self.allocator.allocate(need)
+        return True
+
+    def _release(self, req: Request) -> None:
+        """Return a finished/cancelled request's pages.  Subclass hook:
+        the serving scheduler routes this through refcounts so shared
+        prefix pages survive until their last holder lets go."""
+        self.allocator.free(req.blocks)
+
     def _admit(self) -> None:
         """Move waiting → prefilling while a slot + enough pages exist.
         Pages for the FULL request (prompt + generation budget) are reserved
@@ -135,11 +175,9 @@ class RaggedScheduler:
             slot = self._free_slot()
             if slot < 0:
                 return
-            need = req.pages_needed(self.cache.block_size)
-            if need > self.allocator.num_free:
+            if not self._reserve(req):
                 return
             self.waiting.popleft()
-            req.blocks = self.allocator.allocate(need)
             req.state = RequestState.PREFILL
             req.slot = slot
             self.slots[slot] = req
@@ -232,7 +270,7 @@ class RaggedScheduler:
         if (len(req.generated) >= req.max_new_tokens
                 or (eos is not None and tok == eos)):
             req.state = RequestState.DONE
-            self.allocator.free(req.blocks)
+            self._release(req)
             req.blocks = []
             if req.slot >= 0:
                 self.slots[req.slot] = None
@@ -242,6 +280,29 @@ class RaggedScheduler:
             get_telemetry().inc_counter(
                 "inference/requests_done",
                 help="requests finished (EOS or budget)")
+
+    def cancel(self, req: Request) -> None:
+        """Abort a request in any pre-DONE state: pages come back, the
+        slot frees, and the planner never sees it again.  The serving
+        front-end's ``cancel`` verb lands here."""
+        if req.state is RequestState.DONE:
+            return
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req in self.prefilling:
+            self.prefilling.remove(req)
+        if req.blocks:
+            self._release(req)
+            req.blocks = []
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+        req.state = RequestState.DONE
+        from ...telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "inference/requests_cancelled",
+            help="requests aborted before completion")
 
     def table_row(self, req: Request) -> np.ndarray:
         row = np.zeros((self.cache.max_blocks_per_seq,), np.int32)

@@ -343,6 +343,45 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=TelemetryMemoryConfig)
 
 
+class ServingConfig(DeepSpeedConfigModel):
+    """``serving`` config group — the production serving plane
+    (``deepspeed_tpu/serving/``): paged prefix-sharing KV cache over the
+    inference-v2 block pool, an SLO-aware streaming front-end
+    (submit/stream/cancel with ``interactive``/``batch``/``background``
+    latency classes, admission control, preemptible decode slots), and
+    multi-replica routing (prefix affinity + least outstanding tokens,
+    replica health from the device-liveness latch / hang watchdog)."""
+
+    enabled: bool = False
+    #: engine replicas behind the router (each owns a full KV pool)
+    replicas: int = 1
+    #: share identical prompt-prefix pages across requests (the trie)
+    prefix_sharing: bool = True
+    #: cached (refcount-0, trie-indexed) pages kept at most; 0 = bounded
+    #: only by pool pressure (LRU reclaimed by allocation)
+    prefix_cache_max_blocks: int = 0
+    #: per-replica admitted-but-unfinished token budget
+    max_outstanding_tokens: int = 8192
+    #: fraction of the allocatable pool kept clear of batch/background
+    #: reservations so interactive admission never waits on pages
+    interactive_reserve_frac: float = 0.10
+    #: admit only interactive work when the memory ledger reports HBM
+    #: headroom below this fraction (0 disables the check)
+    min_hbm_headroom_frac: float = 0.0
+    #: interactive may preempt background decode slots (KV retained)
+    preemption: bool = True
+    #: router prefix-affinity threshold (tokens)
+    affinity_min_tokens: int = 16
+    #: decode sampling temperature (0 = greedy; greedy makes the
+    #: replica-death re-queue splice exact)
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    #: per-handle stream buffer (tokens)
+    stream_buffer: int = 4096
+    #: interactive TTFT target (ms), exported with the serving metrics
+    interactive_ttft_slo_ms: float = 500.0
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """``resilience`` config group — the self-healing plane
     (``deepspeed_tpu/resilience/``): tiered async snapshots of the full
@@ -550,6 +589,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)

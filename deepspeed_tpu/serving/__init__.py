@@ -1,0 +1,98 @@
+"""Production serving plane — paged prefix-sharing KV cache, SLO-aware
+streaming front-end, multi-replica routing (ROADMAP item 1, the
+DeepSpeed-FastGen/MII lineage's service layer, arXiv 2401.08671; prefix
+sharing after vLLM's PagedAttention, arXiv 2309.06180).
+
+Layering (each importable on its own):
+
+* :mod:`.prefix_cache` — refcounted page allocator + hash-trie prefix
+  index over ``inference/v2``'s block pool.
+* :mod:`.scheduler` — :class:`ServingScheduler`, the v2 ragged planner
+  with prefix-shared reservations and preemptible decode slots.
+* :mod:`.frontend` — submit/stream/cancel, latency-class queues,
+  admission control, preemption, replica drain.
+* :mod:`.router` — replica health + prefix-affine least-outstanding
+  routing.
+* :mod:`.synthetic` — the host-only engine for tests and dry-runs.
+
+``build_serving_frontend`` assembles the real thing: N v2 engine
+replicas over a model, each with its own KV pool registered in the
+memory ledger under distinct per-replica keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .frontend import (NoHealthyReplicaError, ServingFrontend,
+                       ServingHandle, ServingParams)
+from .metrics import CLASSES, LatencyTracker, ServingMetrics
+from .prefix_cache import PrefixCache, RefcountedBlockAllocator
+from .router import Replica, ReplicaRouter
+from .scheduler import ServingScheduler
+from .synthetic import FakeClock, SyntheticEngine, synthetic_token
+
+__all__ = [
+    "CLASSES", "FakeClock", "LatencyTracker", "NoHealthyReplicaError",
+    "PrefixCache", "RefcountedBlockAllocator", "Replica", "ReplicaRouter",
+    "ServingFrontend", "ServingHandle", "ServingMetrics", "ServingParams",
+    "ServingScheduler", "SyntheticEngine", "build_serving_frontend",
+    "params_from_config", "synthetic_token",
+]
+
+
+def params_from_config(scfg: Any) -> ServingParams:
+    """Map the ``serving.*`` config group onto :class:`ServingParams`."""
+    return ServingParams(
+        max_outstanding_tokens=int(
+            getattr(scfg, "max_outstanding_tokens", 8192)),
+        interactive_reserve_frac=float(
+            getattr(scfg, "interactive_reserve_frac", 0.10)),
+        min_hbm_headroom_frac=float(
+            getattr(scfg, "min_hbm_headroom_frac", 0.0)),
+        preemption=bool(getattr(scfg, "preemption", True)),
+        affinity_min_tokens=int(getattr(scfg, "affinity_min_tokens", 16)),
+        temperature=float(getattr(scfg, "temperature", 0.0)),
+        eos_token_id=getattr(scfg, "eos_token_id", None),
+        stream_buffer=int(getattr(scfg, "stream_buffer", 4096)),
+        interactive_ttft_slo_ms=float(
+            getattr(scfg, "interactive_ttft_slo_ms", 500.0)))
+
+
+def build_serving_frontend(model: Any, params: Any = None,
+                           replicas: int = 1,
+                           cache_config: Any = None,
+                           max_batch_slots: int = 8,
+                           prefill_chunk: int = 128,
+                           prefill_batch: int = 2,
+                           decode_burst: int = 8,
+                           prefix_sharing: bool = True,
+                           max_cached_blocks: int = 0,
+                           serving_params: Optional[ServingParams] = None,
+                           mesh: Any = None) -> ServingFrontend:
+    """N real v2 engine replicas behind one front-end.  Each replica
+    owns a full KV pool (HBM cost scales with ``replicas``) and is
+    registered in the memory ledger under ``serving/replica<i>/*``."""
+    import jax
+
+    from ..inference.v2 import build_engine_v2
+
+    if params is None:
+        params = model.init_params(jax.random.PRNGKey(0))
+
+    def factory(cc, slots, chunk, pbatch):
+        return ServingScheduler(cc, max_batch_slots=slots,
+                                prefill_chunk=chunk, prefill_batch=pbatch,
+                                prefix_sharing=prefix_sharing,
+                                max_cached_blocks=max_cached_blocks)
+
+    reps: List[Replica] = []
+    for i in range(int(replicas)):
+        eng = build_engine_v2(
+            model, params, cache_config=cache_config,
+            max_batch_slots=max_batch_slots, prefill_chunk=prefill_chunk,
+            prefill_batch=prefill_batch, decode_burst=decode_burst,
+            mesh=mesh, scheduler_factory=factory,
+            ledger_key=f"serving/replica{i}/kv_pool")
+        reps.append(Replica(eng, i))
+    return ServingFrontend(reps, params=serving_params)

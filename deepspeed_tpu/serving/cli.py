@@ -1,0 +1,176 @@
+"""Serving CLI — ``python -m deepspeed_tpu.serving bench [--dry-run]``.
+
+One deterministic multi-tenant workload, two execution modes:
+
+* ``--dry-run`` — synthetic replicas on a fake clock: zero device work,
+  finishes in milliseconds, numbers deterministic.  This is the CI
+  smoke (run_suite.sh) and the quickest way to see the serving metrics
+  end to end.
+* real mode — a tiny real model through ``build_serving_frontend`` on
+  whatever backend JAX has (CPU works): the same workload against the
+  actual compiled engine.  ``bench.py``'s serving variant reuses
+  :func:`run_workload` against a production-sized model.
+
+The emitted JSON line carries the gated serving metrics
+(``serving_p99_ttft_ms``, ``prefix_hit_rate``, ``tok_s_interactive``)
+in the exact shape ``telemetry perf check`` reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def run_workload(frontend: Any, clock, n_interactive: int = 12,
+                 n_background: int = 6, header_len: int = 128,
+                 interactive_new: int = 16, background_new: int = 96,
+                 warm_rounds: int = 4, seed: int = 0) -> Dict[str, Any]:
+    """Drive a shared-header, mixed-class workload to completion and
+    report the serving metrics.  Background requests saturate the decode
+    slots first; interactive requests then arrive one at a time and are
+    each driven to completion (so their TTFT reflects contention, not
+    batching of the probe stream itself)."""
+    from .metrics import ServingMetrics
+
+    rng = np.random.RandomState(seed)
+    header = rng.randint(2, 29000, size=header_len).tolist()
+
+    def prompt(tail: int) -> list:
+        return header + rng.randint(2, 29000, size=tail).tolist()
+
+    def hit_counts():
+        hits = looks = 0
+        for r in frontend.router.replicas:
+            p = getattr(r.scheduler, "prefix", None)
+            if p is not None:
+                hits += p.hit_tokens
+                looks += p.lookup_tokens
+        return hits, looks
+
+    # this workload's own window: fresh latency trackers, and the prefix
+    # hit rate as a delta (a warm-up pass must not pollute the p99 tail
+    # with compile time, nor dilute the hit rate)
+    frontend.metrics = ServingMetrics()
+    hits0, looks0 = hit_counts()
+    t0 = clock()
+    background = [frontend.submit(prompt(16), max_new_tokens=background_new,
+                                  klass="background")
+                  for _ in range(n_background)]
+    for _ in range(warm_rounds):
+        frontend.pump()
+    interactive = []
+    for _ in range(n_interactive):
+        h = frontend.submit(prompt(8), max_new_tokens=interactive_new,
+                            klass="interactive")
+        interactive.append(h)
+        for _ in range(100_000):
+            frontend.pump()
+            if h.status != "running" and h.status != "queued":
+                break
+        else:
+            raise RuntimeError("interactive request never completed")
+    frontend.run_until_idle()
+    elapsed = max(clock() - t0, 1e-9)
+
+    m = frontend.metrics
+    done = [h for h in interactive + background if h.status == "done"]
+    out = {
+        "serving_p99_ttft_ms": round(m.ttft["interactive"].percentile(99),
+                                     3),
+        "serving_p50_ttft_ms": round(m.ttft["interactive"].percentile(50),
+                                     3),
+        "background_p99_ttft_ms": round(
+            m.ttft["background"].percentile(99), 3),
+        "prefix_hit_rate": round(
+            (hit_counts()[0] - hits0)
+            / max(hit_counts()[1] - looks0, 1), 4),
+        "tok_s_interactive": round(m.tokens["interactive"] / elapsed, 1),
+        "tok_s_background": round(m.tokens["background"] / elapsed, 1),
+        "preemptions": m.counters["preemptions"],
+        "requests_completed": len(done),
+        "requests_submitted": m.counters["submitted"],
+        "elapsed_s": round(elapsed, 4),
+    }
+    return out
+
+
+def _dry_run_frontend(replicas: int, slots: int = 4):
+    from . import (FakeClock, Replica, ServingFrontend, ServingParams,
+                   SyntheticEngine)
+    from ..inference.v2 import KVCacheConfig
+
+    clock = FakeClock()
+    cache = KVCacheConfig(num_blocks=256, block_size=16, max_seq_len=512)
+    reps = [Replica(SyntheticEngine(cache, max_batch_slots=slots,
+                                    prefill_chunk=64, prefill_batch=2,
+                                    decode_burst=4, clock=clock), i)
+            for i in range(replicas)]
+    fe = ServingFrontend(reps, params=ServingParams(
+        interactive_reserve_frac=0.1), clock=clock)
+    return fe, clock
+
+
+def _real_frontend(replicas: int):
+    import time
+
+    import jax.numpy as jnp
+
+    from . import ServingParams, build_serving_frontend
+    from ..inference.v2 import KVCacheConfig
+    from ..models import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(num_layers=2, max_seq_len=256,
+                           dtype=jnp.float32)
+    fe = build_serving_frontend(
+        LlamaModel(cfg), replicas=replicas,
+        cache_config=KVCacheConfig(num_blocks=128, block_size=16,
+                                   max_seq_len=256),
+        max_batch_slots=4, prefill_chunk=32, prefill_batch=2,
+        decode_burst=4,
+        serving_params=ServingParams(interactive_reserve_frac=0.1))
+    return fe, time.monotonic
+
+
+def bench_command(args: argparse.Namespace) -> int:
+    if args.dry_run:
+        fe, clock = _dry_run_frontend(args.replicas)
+        header_len, inter_new, bg_new = 128, 16, 96
+    else:
+        fe, clock = _real_frontend(args.replicas)
+        # sized for a tiny model within its 256-token max_seq_len
+        header_len, inter_new, bg_new = 64, 8, 24
+    out = run_workload(fe, clock, n_interactive=args.interactive,
+                       n_background=args.background,
+                       header_len=header_len, interactive_new=inter_new,
+                       background_new=bg_new, seed=args.seed)
+    out["dry_run"] = bool(args.dry_run)
+    out["replicas"] = args.replicas
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.serving",
+        description="serving-plane operator commands")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("bench", help="mixed-class serving benchmark")
+    b.add_argument("--dry-run", action="store_true",
+                   help="synthetic replicas on a fake clock (no device)")
+    b.add_argument("--replicas", type=int, default=2)
+    b.add_argument("--interactive", type=int, default=12)
+    b.add_argument("--background", type=int, default=6)
+    b.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.cmd == "bench":
+        return bench_command(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,590 @@
+"""SLO-aware streaming front-end — submit / stream / cancel over
+latency-class queues with admission control and preemption.
+
+This is the layer that turns the v2 engine loop into a *service*:
+
+* **submit(prompt, klass)** validates the request (the scheduler's
+  field-naming validation runs at the front door), assigns it a
+  latency class (``interactive`` / ``batch`` / ``background``), and
+  queues it.  The returned :class:`ServingHandle` streams tokens as
+  they are accepted (``stream()``), collects them (``result()``), or
+  aborts (``cancel()``).
+* **Admission control** drains class queues in strict priority order
+  each pump: a request is admitted to its routed replica only when (a)
+  the replica has a free decode slot and enough KV pages (prefix
+  matches counted — a 90%-shared prompt is cheap to admit), (b) the
+  replica's outstanding-token budget has room, (c) for non-interactive
+  classes, admission leaves an interactive page reserve, and (d) the
+  PR-7 memory ledger's HBM headroom (when it has device numbers) is
+  above the configured floor — under memory pressure only interactive
+  work is admitted.
+* **Preemption**: when the interactive queue cannot place its head, a
+  RUNNING background request is bumped out of its decode slot
+  (``ServingScheduler.preempt`` — KV pages stay referenced, host state
+  intact) and re-queued at the front of its class; it resumes in place
+  later.  Interactive latency is bounded by a burst length, not by a
+  background request's remaining budget.
+* **Replica drain**: a replica that goes unhealthy (probe, device
+  latch, watchdog trip) has its in-flight work re-queued onto healthy
+  replicas.  Already-streamed tokens are not re-delivered: re-execution
+  regenerates the sequence and delivery resumes past the high-water
+  mark (exact for greedy decode; sampled streams may diverge at the
+  splice point, which is recorded on the handle).
+
+The front-end is driven either manually (``pump()`` — deterministic,
+what the tests and an external event loop use) or by its own thread
+(``start()``/``stop()``).  All mutable front-end state is guarded by
+one re-entrant lock; token delivery to consumers goes through
+per-handle thread-safe queues.  The clock is injectable, so SLO tests
+measure TTFT distributions deterministically against a fake clock
+advanced by the synthetic engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..utils.logging import log_dist, warn_once
+from .metrics import CLASSES, ServingMetrics
+from .router import Replica, ReplicaRouter
+
+_DONE = object()
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica behind the front-end is dead (probe / device latch
+    / watchdog) — pending work cannot make progress."""
+
+
+@dataclasses.dataclass
+class ServingParams:
+    """Resolved front-end knobs (the ``serving.*`` config group maps
+    onto this; tests construct it directly)."""
+
+    #: per-replica admitted-but-unfinished token budget
+    max_outstanding_tokens: int = 8192
+    #: fraction of the allocatable pool kept free of batch/background
+    #: reservations so interactive admission never waits on pages
+    interactive_reserve_frac: float = 0.10
+    #: admit only interactive work when the memory ledger reports HBM
+    #: headroom below this fraction (0 disables the check)
+    min_hbm_headroom_frac: float = 0.0
+    #: allow interactive to preempt background decode slots
+    preemption: bool = True
+    #: router prefix-affinity threshold (tokens)
+    affinity_min_tokens: int = 16
+    #: sampling temperature for every decode dispatch (0 = greedy;
+    #: greedy is what makes replica-death re-queue splice-exact)
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    #: per-handle stream buffer (tokens) — a stalled consumer blocks
+    #: its own stream, never the pump
+    stream_buffer: int = 4096
+    #: interactive TTFT target (ms) — exported with the metrics so the
+    #: bench/SLO gate reads the bound it asserts against
+    interactive_ttft_slo_ms: float = 500.0
+
+
+class ServingHandle:
+    """One submitted request: stream / result / cancel surface."""
+
+    def __init__(self, uid: int, prompt: List[int], max_new_tokens: int,
+                 klass: str, submitted_at: float, frontend:
+                 "ServingFrontend", stream_buffer: int):
+        self.uid = uid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.klass = klass
+        self.submitted_at = submitted_at
+        self.status = "queued"  # queued|running|done|cancelled|failed
+        self.replica_id: Optional[int] = None
+        self.request: Any = None          # live scheduler Request
+        self.preempted = False
+        self.pinned_replica: Optional[int] = None
+        self.delivered = 0                # tokens pushed to the stream
+        self.consumed = 0                 # tokens read off request
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.admitted_at: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.replays = 0                  # replica-death re-executions
+        self._frontend = frontend
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(
+            int(stream_buffer), max_new_tokens + 1))
+
+    # -- consumer surface --------------------------------------------------
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated tokens as they arrive; raises the handle's
+        error if the request failed.  With ``timeout`` per token."""
+        while True:
+            item = self._queue.get(timeout=timeout)
+            if item is _DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return list(self.stream(timeout=timeout))
+
+    def cancel(self) -> None:
+        self._frontend.cancel(self)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.submitted_at) * 1e3
+
+    def _push(self, tok: int) -> None:
+        try:
+            self._queue.put_nowait(tok)
+        except queue.Full:
+            # bounded stream, slow consumer: drop-oldest keeps the pump
+            # real-time; the consumer still sees completion
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:  # consumer drained it concurrently
+                pass
+            self._queue.put_nowait(tok)
+
+    def _finish(self, status: str,
+                error: Optional[BaseException] = None) -> None:
+        self.status = status
+        self.error = error
+        self._queue.put(_DONE)
+
+
+class ServingFrontend:
+    def __init__(self, replicas: List[Replica],
+                 params: Optional[ServingParams] = None,
+                 clock=time.monotonic):
+        self.params = params or ServingParams()
+        self.router = ReplicaRouter(
+            replicas, affinity_min_tokens=self.params.affinity_min_tokens)
+        self.clock = clock
+        self.metrics = ServingMetrics()
+        self._queues: Dict[str, List[ServingHandle]] = {
+            c: [] for c in CLASSES}
+        self._uid = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._drained: set = set()  # replica ids already drained
+        self._watchdogs: List[Any] = []  # for detach on close()
+        self._round = 0  # pump round counter: probe-memo invalidation
+        self._attach_recorder()
+
+    def _attach_recorder(self) -> None:
+        """Every debug bundle gets a ``serving`` section."""
+        try:
+            from ..telemetry import get_flight_recorder
+
+            rec = get_flight_recorder()
+            if rec is not None:
+                rec.register_context("serving", self.snapshot)
+        except Exception as e:
+            warn_once("serving/recorder",
+                      f"flight-recorder attach failed ({e!r})")
+
+    def attach_watchdog(self, watchdog: Any) -> None:
+        """Replica health rides the existing hang watchdog: a trip means
+        the process's device work is stuck, so every in-process replica
+        drains (their queued work would blackhole otherwise)."""
+        watchdog.add_trip_listener(self._on_watchdog_trip)
+        self._watchdogs.append(watchdog)
+
+    def close(self) -> None:
+        """Stop the pump thread and detach from the process-global hooks
+        (flight-recorder context provider, watchdog trip listeners).
+        Without this, those hooks keep the front-end — and through it
+        every replica's engine, model params, and KV pool — alive for
+        the life of the process."""
+        self.stop()
+        for wd in self._watchdogs:
+            try:
+                wd.remove_trip_listener(self._on_watchdog_trip)
+            except Exception as e:
+                warn_once("serving/watchdog-detach",
+                          f"watchdog detach failed ({e!r})")
+        self._watchdogs.clear()
+        try:
+            from ..telemetry import get_flight_recorder
+
+            rec = get_flight_recorder()
+            if rec is not None:
+                rec.unregister_context("serving")
+        except Exception as e:
+            warn_once("serving/recorder-detach",
+                      f"flight-recorder detach failed ({e!r})")
+
+    def _on_watchdog_trip(self, reason: str, bundle: Optional[str]) -> None:
+        # deliberately LOCKLESS: the trip fires precisely when a pump
+        # thread may be wedged inside a device call while holding
+        # self._lock — taking it here would deadlock the watchdog (and
+        # every listener behind us, including the emergency snapshot).
+        # mark_dead is a sticky one-shot attribute write on a replica
+        # list that never mutates; the pump observes it at its next
+        # health check.
+        for r in self.router.replicas:
+            if r.dead_reason is None:
+                r.mark_dead(f"watchdog trip: {reason}")
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 64,
+               klass: str = "interactive") -> ServingHandle:
+        if klass not in CLASSES:
+            raise ValueError(f"klass: unknown latency class {klass!r} "
+                             f"(one of {', '.join(CLASSES)})")
+        with self._lock:
+            healthy = self.router.healthy()
+            if not healthy:
+                raise NoHealthyReplicaError(
+                    "submit rejected: no healthy replica "
+                    + "; ".join(f"replica{r.id}: {r.dead_reason}"
+                                for r in self.router.replicas))
+            # field-naming validation at the front door (the scheduler's
+            # checks — empty prompt, max_new_tokens<=0, pool-impossible)
+            healthy[0].scheduler.validate(list(prompt), max_new_tokens)
+            h = ServingHandle(self._uid, list(prompt), int(max_new_tokens),
+                              klass, self.clock(), self,
+                              self.params.stream_buffer)
+            self._uid += 1
+            self._queues[klass].append(h)
+            self.metrics.inc("submitted")
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                f"serving/{klass}_submitted",
+                help="requests submitted per latency class")
+            return h
+
+    def cancel(self, handle: ServingHandle) -> None:
+        with self._lock:
+            if handle.status == "queued":
+                try:
+                    self._queues[handle.klass].remove(handle)
+                except ValueError:
+                    pass
+                if handle.request is not None:
+                    # preempted: pages are still reserved on its replica
+                    rep = self._replica_by_id(handle.pinned_replica)
+                    if rep is not None:
+                        rep.scheduler.cancel(handle.request)
+                self.metrics.inc("cancelled")
+                handle._finish("cancelled")
+            elif handle.status == "running":
+                rep = self._replica_by_id(handle.replica_id)
+                if rep is not None:
+                    rep.scheduler.cancel(handle.request)
+                    if handle in rep.active:
+                        rep.active.remove(handle)
+                self.metrics.inc("cancelled")
+                handle._finish("cancelled")
+
+    # -- the pump ----------------------------------------------------------
+
+    def pump(self) -> int:
+        """One serving round: drain dead replicas, admit (with
+        preemption), step every replica with work, deliver tokens.
+        Returns tokens processed — 0 means idle."""
+        with self._lock:
+            # one health-probe evaluation per replica per round: every
+            # healthy() call below this reuses the memoized verdict
+            self._round += 1
+            for r in self.router.replicas:
+                r.new_round(self._round)
+            self._drain_dead()
+            if not self.router.healthy():
+                # pump/start() mode has no caller to raise to (that is
+                # run_until_idle's job): fail pending handles so
+                # consumers parked in stream()/result() unblock instead
+                # of hanging forever
+                if any(self._queues.values()):
+                    self._fail_pending_no_replica()
+                return 0
+            self._admit_all()
+            if self.params.preemption and self._queues["interactive"]:
+                if self._preempt_for_interactive():
+                    self._admit_all()
+            n = 0
+            for rep in self.router.healthy():
+                if rep.scheduler.has_work:
+                    n += rep.engine.step(
+                        temperature=self.params.temperature,
+                        eos_token_id=self.params.eos_token_id)
+                self._deliver(rep)
+                rep.update_ledger()
+            self.metrics.publish(
+                {c: len(q) for c, q in self._queues.items()},
+                self._aggregate_hit_rate())
+            return n
+
+    def run_until_idle(self, max_rounds: int = 100_000) -> None:
+        """Pump until no queued or in-flight work remains.  Raises
+        :class:`NoHealthyReplicaError` if work is pending with every
+        replica dead."""
+        for _ in range(max_rounds):
+            with self._lock:
+                pending = (any(self._queues.values())
+                           or any(r.active for r in self.router.replicas))
+                if not pending:
+                    return
+                if not self.router.healthy():
+                    # fail the pending handles BEFORE raising: other
+                    # threads parked in stream()/result() would wait on
+                    # queues that will never see _DONE otherwise
+                    self._drain_dead()
+                    self._fail_pending_no_replica()
+                    raise NoHealthyReplicaError(
+                        "pending serving work but no healthy replica")
+            self.pump()
+        raise RuntimeError(f"run_until_idle: no quiescence in "
+                           f"{max_rounds} rounds")
+
+    # -- background drive --------------------------------------------------
+
+    def start(self, idle_sleep_s: float = 0.001) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._serve_loop, args=(idle_sleep_s,),
+                daemon=True, name="ds-serving-frontend")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=10.0)
+
+    def _serve_loop(self, idle_sleep_s: float) -> None:
+        log_dist("serving front-end loop started")
+        while not self._stop.is_set():
+            if self.pump() == 0:
+                self._stop.wait(idle_sleep_s)
+
+    # -- internals (lock held) ---------------------------------------------
+
+    def _replica_by_id(self, rid: Optional[int]) -> Optional[Replica]:
+        for r in self.router.replicas:
+            if r.id == rid:
+                return r
+        return None
+
+    def _aggregate_hit_rate(self) -> float:
+        hits = looks = 0
+        for r in self.router.replicas:
+            p = getattr(r.scheduler, "prefix", None)
+            if p is not None:
+                hits += p.hit_tokens
+                looks += p.lookup_tokens
+        return hits / looks if looks else 0.0
+
+    def _reset_for_replay(self, h: ServingHandle) -> None:
+        """The dead engine's scheduler state is unreachable; the handle
+        restarts from its prompt on a healthy replica, delivery resumes
+        past the already-streamed high-water mark."""
+        h.request = None
+        h.replica_id = None
+        h.pinned_replica = None
+        h.preempted = False
+        h.consumed = 0
+        h.replays += 1
+        h.status = "queued"
+
+    def _drain_dead(self) -> None:
+        for rep in self.router.replicas:
+            if rep.healthy() or rep.id in self._drained:
+                continue
+            self._drained.add(rep.id)
+            moved = 0
+            # preempted handles sit in the class queues (not rep.active)
+            # but are still pinned to this replica's now-unreachable KV
+            # pages — reset them in place so _try_admit restarts them on
+            # a healthy replica instead of retrying the dead pin forever
+            for q in self._queues.values():
+                for h in q:
+                    if h.request is not None and h.pinned_replica == rep.id:
+                        self._reset_for_replay(h)
+                        moved += 1
+            # re-queue in-flight work at the class front, earliest
+            # admission first (walk newest-first while inserting at 0)
+            for h in reversed(rep.active):
+                self._reset_for_replay(h)
+                self._queues[h.klass].insert(0, h)
+                moved += 1
+            rep.active.clear()
+            if moved:
+                self.metrics.inc("requeued_replica_death", moved)
+            log_dist(f"serving: replica{rep.id} drained "
+                     f"({rep.dead_reason}); {moved} requests re-queued")
+
+    def _fail_pending_no_replica(self) -> None:
+        err = NoHealthyReplicaError(
+            "all replicas dead: "
+            + "; ".join(f"replica{r.id}: {r.dead_reason}"
+                        for r in self.router.replicas))
+        n = 0
+        for q in self._queues.values():
+            for h in q:
+                self.metrics.inc("failed")
+                h._finish("failed", err)
+                n += 1
+            q.clear()
+        log_dist(f"serving: failed {n} pending requests — "
+                 f"no healthy replica")
+
+    def _headroom_degraded(self) -> bool:
+        floor = self.params.min_hbm_headroom_frac
+        if floor <= 0:
+            return False
+        from ..telemetry.memory import get_memory_ledger
+
+        led = get_memory_ledger()
+        if not led.enabled:
+            return False
+        hb = led.heartbeat_summary().get("hbm_headroom")
+        return hb is not None and hb < floor
+
+    def _admit_all(self) -> None:
+        degraded = self._headroom_degraded()
+        for klass in CLASSES:
+            if degraded and klass != "interactive":
+                if self._queues[klass]:
+                    self.metrics.inc("admission_deferred_headroom")
+                continue
+            q = self._queues[klass]
+            while q:
+                if not self._try_admit(q[0]):
+                    break  # FIFO within a class: no overtaking
+                q.pop(0)
+            if q:
+                # strict priority: a class that could not fully drain
+                # blocks lower classes this round (no SLO inversion) —
+                # unless nothing is seated anywhere: then only a
+                # lower-class admission/resume can ever complete and
+                # free the pages this head is waiting on, so blocking
+                # them would deadlock the whole service
+                if any(r.scheduler.has_work for r in self.router.healthy()):
+                    break
+
+    def _reserve_pages(self, rep: Replica, klass: str) -> int:
+        if klass == "interactive":
+            return 0
+        allocatable = rep.scheduler.cache.num_blocks - 1
+        return int(self.params.interactive_reserve_frac * allocatable)
+
+    def _try_admit(self, h: ServingHandle) -> bool:
+        if h.request is not None:
+            # preempted: pinned to the replica holding its KV pages
+            rep = self._replica_by_id(h.pinned_replica)
+            if rep is None or not rep.healthy():
+                return False
+            if not rep.scheduler.resume(h.request):
+                return False
+            h.status = "running"
+            h.replica_id = rep.id
+            rep.active.append(h)
+            return True
+        for rep in self.router.route_candidates(h.prompt):
+            if (rep.outstanding_tokens() + len(h.prompt)
+                    + h.max_new_tokens
+                    > self.params.max_outstanding_tokens):
+                continue
+            if not rep.scheduler.can_admit(
+                    h.prompt, h.max_new_tokens,
+                    reserve_pages=self._reserve_pages(rep, h.klass)):
+                continue
+            h.request = rep.engine.put(h.prompt, h.max_new_tokens)
+            h.request.priority = CLASSES.index(h.klass)
+            rep.scheduler.admit_now(h.request)
+            h.status = "running"
+            h.replica_id = rep.id
+            h.pinned_replica = rep.id
+            h.admitted_at = self.clock()
+            rep.active.append(h)
+            return True
+        return False
+
+    def _preempt_for_interactive(self) -> bool:
+        """Free a decode slot for the interactive head by bumping a
+        RUNNING background request; True when a preemption happened."""
+        head = self._queues["interactive"][0]
+        preempted = False
+        for rep in self.router.healthy():
+            if rep.scheduler.can_admit(head.prompt, head.max_new_tokens):
+                return False  # admissible without preemption
+        for rep in self.router.healthy():
+            if not rep.scheduler.can_admit(head.prompt,
+                                           head.max_new_tokens,
+                                           ignore_slots=True):
+                # the head is page-blocked here, not slot-blocked:
+                # preemption retains the victim's KV pages, so bumping
+                # it cannot free what the head needs — let the running
+                # work finish and release its pages instead
+                continue
+            victims = [h for h in rep.active
+                       if h.klass == "background" and h.request is not None
+                       and h.request.slot >= 0
+                       and h.request.state.value in ("running", "prefill")]
+            if not victims:
+                continue
+            # bump the request expected to hold its slot longest: decode
+            # with the most remaining budget first, else a prefill
+            victim = max(victims, key=lambda h: h.request.remaining_budget)
+            rep.scheduler.preempt(victim.request)
+            rep.active.remove(victim)
+            victim.status = "queued"
+            victim.preempted = True
+            self._queues["background"].insert(0, victim)
+            self.metrics.inc("preemptions")
+            preempted = True
+            break
+        return preempted
+
+    def _deliver(self, rep: Replica) -> None:
+        for h in list(rep.active):
+            req = h.request
+            new = req.generated[h.consumed:]
+            for tok in new:
+                h.consumed += 1
+                if h.consumed > h.delivered:
+                    if h.first_token_at is None:
+                        h.first_token_at = self.clock()
+                        self.metrics.record_ttft(h.klass, h.ttft_ms)
+                    h.delivered += 1
+                    h._push(int(tok))
+            if req.state.value == "done" and h.status == "running":
+                rep.active.remove(h)
+                h.finished_at = self.clock()
+                gen_s = (h.finished_at - (h.first_token_at
+                                          or h.finished_at))
+                self.metrics.record_completion(h.klass, h.delivered, gen_s)
+                from ..telemetry import get_telemetry
+
+                get_telemetry().inc_counter(
+                    f"serving/{h.klass}_tokens", v=h.delivered,
+                    help="generated tokens delivered per latency class")
+                h._finish("done")
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = self.metrics.snapshot()
+            out["queues"] = {c: len(q) for c, q in self._queues.items()}
+            out["router"] = self.router.snapshot()
+            out["prefix_hit_rate"] = round(self._aggregate_hit_rate(), 4)
+            out["params"] = dataclasses.asdict(self.params)
+            return out

@@ -1,0 +1,116 @@
+"""Serving observability — per-latency-class TTFT / per-token latency.
+
+The serving plane's SLOs are *distributional* (p50/p99 time-to-first-
+token per class), which the telemetry registry's fixed-bucket histograms
+approximate too coarsely to gate on.  :class:`LatencyTracker` keeps a
+bounded sample window and computes exact percentiles over it;
+:class:`ServingMetrics` owns one TTFT and one TPOT (time-per-output-
+token) tracker per class plus the serving counters, publishes gauges
+through the existing :class:`MetricsRegistry`, and renders the
+``serving`` section of debug bundles.
+
+All methods are called with the front-end's lock held (single writer);
+reads used by tests/CLI take point-in-time copies.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict
+
+#: latency classes in strict priority order — admission drains them
+#: left-to-right, preemption moves rightmost work out of the way
+CLASSES = ("interactive", "batch", "background")
+
+
+class LatencyTracker:
+    """Bounded sample window with exact percentiles (ms)."""
+
+    def __init__(self, max_samples: int = 512):
+        self._samples: deque = deque(maxlen=int(max_samples))
+
+    def observe(self, ms: float) -> None:
+        self._samples.append(float(ms))
+
+    @property
+    def count(self) -> int:
+        return len(self._samples)
+
+    def percentile(self, p: float) -> float:
+        """Exact percentile over the window (nearest-rank); 0.0 empty."""
+        if not self._samples:
+            return 0.0
+        ordered = sorted(self._samples)
+        rank = min(len(ordered) - 1,
+                   max(0, int(round(p / 100.0 * (len(ordered) - 1)))))
+        return ordered[rank]
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": float(self.count),
+                "p50_ms": round(self.percentile(50), 3),
+                "p99_ms": round(self.percentile(99), 3)}
+
+
+class ServingMetrics:
+    """The serving plane's numbers: per-class latency + global counters."""
+
+    def __init__(self, window: int = 512):
+        self.ttft = {c: LatencyTracker(window) for c in CLASSES}
+        self.tpot = {c: LatencyTracker(window) for c in CLASSES}
+        self.tokens = {c: 0 for c in CLASSES}
+        self.completed = {c: 0 for c in CLASSES}
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "cancelled": 0, "failed": 0,
+            "preemptions": 0, "requeued_replica_death": 0,
+            "admission_deferred_headroom": 0,
+        }
+
+    def inc(self, name: str, v: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + v
+
+    def record_ttft(self, klass: str, ms: float) -> None:
+        self.ttft[klass].observe(ms)
+
+    def record_completion(self, klass: str, n_tokens: int,
+                          gen_time_s: float) -> None:
+        self.completed[klass] += 1
+        self.tokens[klass] += int(n_tokens)
+        if n_tokens > 1 and gen_time_s > 0:
+            self.tpot[klass].observe(gen_time_s * 1e3 / (n_tokens - 1))
+
+    # -- export ------------------------------------------------------------
+
+    def publish(self, queue_depths: Dict[str, int],
+                prefix_hit_rate: float) -> None:
+        """Push the current numbers as gauges/counters through the
+        telemetry hub (no-op when telemetry is off)."""
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if not tel.enabled:
+            return
+        for c in CLASSES:
+            tel.set_gauge(f"serving/{c}_ttft_p50_ms",
+                          self.ttft[c].percentile(50),
+                          help="time-to-first-token p50 by class")
+            tel.set_gauge(f"serving/{c}_ttft_p99_ms",
+                          self.ttft[c].percentile(99),
+                          help="time-to-first-token p99 by class")
+            tel.set_gauge(f"serving/{c}_tpot_p50_ms",
+                          self.tpot[c].percentile(50),
+                          help="per-output-token latency p50 by class")
+            tel.set_gauge(f"serving/{c}_queue_depth",
+                          float(queue_depths.get(c, 0)),
+                          help="requests queued (not yet admitted)")
+        tel.set_gauge("serving/prefix_hit_rate", prefix_hit_rate,
+                      help="fraction of prompt tokens served from shared "
+                           "prefix pages")
+
+    def snapshot(self) -> Dict[str, Any]:
+        classes: Dict[str, Any] = {}
+        for c in CLASSES:
+            classes[c] = {"ttft": self.ttft[c].summary(),
+                          "tpot": self.tpot[c].summary(),
+                          "tokens": self.tokens[c],
+                          "completed": self.completed[c]}
+        return {"classes": classes, "counters": dict(self.counters)}

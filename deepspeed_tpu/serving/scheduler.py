@@ -1,0 +1,228 @@
+"""ServingScheduler — the v2 ragged planner with prefix sharing,
+refcounted pages, and preemptible decode slots.
+
+Same planner surface as :class:`RaggedScheduler` (the engine drives it
+through ``plan_step``/``chunk_done``/``decode_burst_done`` unchanged);
+the deltas are exactly the serving-plane primitives:
+
+* **Reservation** (`_reserve`): the prompt is matched against the prefix
+  trie first; matched whole blocks are *acquired* (refcount++) instead
+  of allocated, and the request's ``prefilled`` cursor starts past them
+  — prefill recomputes nothing the pool already holds.  The reuse
+  boundary is capped (a) strictly before the last prompt token (the
+  final token must run so the first sampled token exists) and (b) so
+  every remaining chunk start stays on a lattice where the engine's
+  page-table ``dynamic_slice`` cannot clamp (see the engine's
+  max_seq_len/prefill_chunk guard).
+* **Release** (`_release`): refcount decrements; pages reaching zero
+  that the trie still indexes enter the allocator's cached tier (LRU
+  reclaimed) instead of the free list.
+* **Indexing**: a request's full prompt pages are inserted into the trie
+  the moment its prefill completes (``chunk_done``) — concurrent
+  requests in the same batch can already share them.
+* **Preemption** (`preempt`/`resume`): a RUNNING request can be bumped
+  out of its decode slot; its pages stay referenced, its host state
+  (generated tokens, prefill cursor) is untouched, so ``resume`` is just
+  re-seating it in a free slot — decode continues from the same KV.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List
+
+from ..inference.v2.kv_cache import KVCacheConfig
+from ..inference.v2.scheduler import (RaggedScheduler, Request,
+                                      RequestState)
+from .prefix_cache import PrefixCache, RefcountedBlockAllocator
+
+
+class ServingScheduler(RaggedScheduler):
+    def __init__(self, cache_config: KVCacheConfig,
+                 max_batch_slots: int = 8, prefill_chunk: int = 128,
+                 prefill_batch: int = 1, prefix_sharing: bool = True,
+                 max_cached_blocks: int = 0):
+        self._max_cached_blocks = int(max_cached_blocks)
+        super().__init__(cache_config, max_batch_slots, prefill_chunk,
+                         prefill_batch)
+        self.allocator: RefcountedBlockAllocator
+        self.prefix = PrefixCache(self.allocator, cache_config.block_size,
+                                  enabled=prefix_sharing)
+        self.preemptions = 0
+
+    def _make_allocator(self, num_blocks: int) -> RefcountedBlockAllocator:
+        return RefcountedBlockAllocator(
+            num_blocks, max_cached=self._max_cached_blocks)
+
+    # -- prefix-shared reservation ----------------------------------------
+
+    def _reuse_cap(self, prompt_len: int, matched_tokens: int) -> int:
+        """Largest safe reuse boundary (tokens): block-aligned, at most
+        ``matched_tokens``, strictly before the last prompt token, and
+        placed so every later chunk start ``cap + k*chunk`` keeps
+        ``start + chunk <= max_seq_len`` (the engine's dynamic_slice
+        would silently clamp past that, retargeting KV writes onto the
+        sequence's earlier pages)."""
+        bs = self.cache.block_size
+        cap = min(matched_tokens, ((prompt_len - 1) // bs) * bs)
+        max_seq = self.cache.max_seq_len
+        while cap > 0:
+            last_start = cap + ((prompt_len - cap - 1) // self.chunk) \
+                * self.chunk
+            if last_start + self.chunk <= max_seq:
+                break
+            cap -= bs
+        return max(cap, 0)
+
+    def _reserve(self, req: Request) -> bool:
+        bs = self.cache.block_size
+        matched = self.prefix.match(req.prompt, count_cow=True)
+        reused = self._reuse_cap(len(req.prompt), len(matched) * bs)
+        shared = matched[:reused // bs]
+        need = req.pages_needed(bs)
+        fresh = need - len(shared)
+        # capacity: fresh pages may reclaim cached pages, EXCEPT the
+        # cached pages this very request is about to revive
+        cached_shared = sum(1 for b in shared if self.allocator.is_cached(b))
+        if fresh > (self.allocator.num_free
+                    + self.allocator.num_cached - cached_shared):
+            return False
+        self.prefix.acquire(shared)
+        req.blocks = shared + self.allocator.allocate(fresh)
+        req.prefilled = reused
+        self.prefix.record_lookup(len(req.prompt), reused)
+        return True
+
+    def can_admit(self, prompt: List[int], max_new_tokens: int,
+                  reserve_pages: int = 0,
+                  ignore_slots: bool = False) -> bool:
+        """Advisory capacity check for front-end admission control:
+        would ``_reserve`` + a free slot succeed right now, leaving at
+        least ``reserve_pages`` available afterwards?  Read-only.
+        ``ignore_slots`` answers the pages-only question — the
+        front-end uses it to tell slot-blocked (preemption helps) from
+        page-blocked (it cannot: preempted KV stays resident)."""
+        if not ignore_slots and self._free_slot() < 0:
+            return False
+        bs = self.cache.block_size
+        matched = self.prefix.match(prompt)
+        reused = self._reuse_cap(len(prompt), len(matched) * bs)
+        shared = matched[:reused // bs]
+        need = -(-(len(prompt) + max_new_tokens) // bs)
+        fresh = need - len(shared)
+        cached_shared = sum(1 for b in shared if self.allocator.is_cached(b))
+        avail = (self.allocator.num_free
+                 + self.allocator.num_cached - cached_shared)
+        return fresh + max(reserve_pages, 0) <= avail
+
+    def match_tokens(self, prompt: List[int]) -> int:
+        """Prefix-affinity signal for the router: how many tokens of
+        this prompt the local trie already holds (post-cap)."""
+        matched = self.prefix.match(prompt)
+        return self._reuse_cap(len(prompt), len(matched)
+                               * self.cache.block_size)
+
+    # -- release through refcounts ----------------------------------------
+
+    def _release(self, req: Request) -> None:
+        self.allocator.release(req.blocks, cache_fn=self.prefix.is_indexed)
+
+    def admit_now(self, req: Request) -> bool:
+        """Synchronously seat a just-added request, bypassing the FIFO
+        ``waiting`` deque.  The front-end checks capacity (`can_admit`),
+        preempts if needed, then calls this — deferring to the next
+        ``plan_step``'s FIFO `_admit` would let a lower-class resume
+        steal the very slot the preemption freed."""
+        if req not in self.waiting:
+            raise ValueError(f"admit_now: uid {req.uid} is not waiting")
+        slot = self._free_slot()
+        if slot < 0 or not self._reserve(req):
+            return False  # stays in waiting; _admit will retry in order
+        self.waiting.remove(req)
+        req.state = RequestState.PREFILL
+        req.slot = slot
+        self.slots[slot] = req
+        self.prefilling.append(req)
+        return True
+
+    # -- class-aware SplitFuse interleave ----------------------------------
+
+    def plan_step(self) -> tuple:
+        """Prefill chunks are planned in priority order (stable within a
+        class): an interactive prompt admitted behind N background
+        prefills jumps the chunk lattice, which is what bounds its TTFT
+        by a chunk, not by the whole background backlog."""
+        if len(self.prefilling) > 1:
+            self.prefilling = deque(
+                sorted(self.prefilling, key=lambda r: r.priority))
+        return super().plan_step()
+
+    # -- trie indexing at prefill completion -------------------------------
+
+    def chunk_done(self, chunk, first_token, eos_token_id=None) -> None:
+        req = chunk.request
+        super().chunk_done(chunk, first_token, eos_token_id)
+        if chunk.is_last:
+            # the full prompt's KV is now in the pool (the device call
+            # returned before chunk_done runs) — index every full prompt
+            # page; already-indexed chunks keep their shared page.  A
+            # request finishing inside this very call (max_new=1/EOS)
+            # has released its pages already — skip, nothing to index.
+            if req.state is not RequestState.DONE:
+                self.prefix.insert(req.prompt, req.blocks)
+
+    # -- preemptible decode slots ------------------------------------------
+
+    def preempt(self, req: Request) -> None:
+        """Bump a RUNNING or PREFILL request out of its slot.  Pages
+        stay referenced (all KV written so far is intact), generated
+        tokens and the prefill cursor stay accepted; the caller
+        re-queues the request and later calls :meth:`resume`, which
+        continues decode — or the chunk lattice — exactly where it
+        stopped."""
+        if req.state is RequestState.PREFILL:
+            self.prefilling.remove(req)
+        elif req.state is not RequestState.RUNNING:
+            raise ValueError(
+                f"can only preempt RUNNING/PREFILL requests, uid "
+                f"{req.uid} is {req.state.value}")
+        self.slots[req.slot] = None
+        req.slot = -1
+        req.state = RequestState.WAITING
+        self.preemptions += 1
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "serving/preemptions",
+            help="decode slots preempted for a higher latency class")
+
+    def resume(self, req: Request) -> bool:
+        """Re-seat a preempted request in a free slot; decode (or the
+        remaining prefill chunks) continue from the retained KV.  False
+        if no slot is free."""
+        if req.state is not RequestState.WAITING or not req.blocks:
+            raise ValueError(
+                f"resume expects a preempted request (WAITING with pages "
+                f"reserved), uid {req.uid} is {req.state.value}")
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        req.slot = slot
+        self.slots[slot] = req
+        if req.prefilled < len(req.prompt):
+            req.state = RequestState.PREFILL
+            self.prefilling.append(req)
+        else:
+            req.state = RequestState.RUNNING
+        return True
+
+    # -- introspection -----------------------------------------------------
+
+    def telemetry_gauges(self) -> dict:
+        # extends the base occupancy gauges, so the pool/prefix numbers
+        # publish through the existing plan_step path automatically
+        g = super().telemetry_gauges()
+        g["serving/kv_pages_cached"] = float(self.allocator.num_cached)
+        g["serving/kv_pages_free"] = float(self.allocator.num_free)
+        g["serving/prefix_hit_rate"] = self.prefix.hit_rate
+        return g

@@ -1,0 +1,111 @@
+"""Synthetic serving engine — the real scheduler, a fake device.
+
+Everything the serving plane *decides* (admission, prefix sharing,
+preemption, routing, draining) is host-side logic over the
+:class:`ServingScheduler`; only token *values* need a device.  The
+synthetic engine drives the REAL scheduler through the REAL planner
+surface (``plan_step`` / ``chunk_done`` / ``decode_burst_done``,
+including the SplitFuse burst-length rule) but invents tokens with a
+deterministic hash of (prompt, position) — so:
+
+* serving tests and the ``bench --dry-run`` CLI smoke run in
+  milliseconds with zero compilation and no accelerator;
+* a request re-executed after a replica death regenerates the *same*
+  token sequence, which is exactly the property the front-end's
+  seamless re-queue relies on (greedy decode has it on real hardware);
+* an injectable :class:`FakeClock` advances by a configurable cost per
+  prefill chunk / decode step, making TTFT distributions deterministic
+  for the SLO acceptance tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..inference.v2.kv_cache import KVCacheConfig
+from ..inference.v2.scheduler import Request
+from .scheduler import ServingScheduler
+
+
+class FakeClock:
+    """Injectable monotonic clock: ``clock()`` reads, ``advance`` moves."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def synthetic_token(prompt: List[int], index: int) -> int:
+    """Deterministic token for generation position ``index`` of a
+    request with this prompt — stable across re-execution."""
+    seed = 0
+    for t in prompt[:64]:
+        seed = (seed * 1000003 + int(t)) % (1 << 31)
+    return (seed * 31 + index * 2654435761) % 29000 + 2
+
+
+class SyntheticEngine:
+    """Drop-in replica engine: real ServingScheduler, no device."""
+
+    def __init__(self, cache_config: Optional[KVCacheConfig] = None,
+                 max_batch_slots: int = 8, prefill_chunk: int = 64,
+                 prefill_batch: int = 2, decode_burst: int = 4,
+                 prefix_sharing: bool = True,
+                 clock: Optional[FakeClock] = None,
+                 prefill_cost_s: float = 0.004,
+                 decode_cost_s: float = 0.002):
+        self.cache_config = cache_config or KVCacheConfig(
+            num_blocks=256, block_size=16, max_seq_len=1024)
+        self.scheduler = ServingScheduler(
+            self.cache_config, max_batch_slots=max_batch_slots,
+            prefill_chunk=prefill_chunk, prefill_batch=prefill_batch,
+            prefix_sharing=prefix_sharing)
+        self.decode_burst = max(1, int(decode_burst))
+        self.pool = None  # no device pool
+        self._clock = clock
+        self.prefill_cost_s = float(prefill_cost_s)
+        self.decode_cost_s = float(decode_cost_s)
+        self.steps = 0
+
+    # -- the engine surface the front-end drives ---------------------------
+
+    def put(self, prompt: List[int], max_new_tokens: int = 32) -> Request:
+        return self.scheduler.add_request(prompt, max_new_tokens)
+
+    def step(self, temperature: float = 0.0,
+             eos_token_id: Optional[int] = None) -> int:
+        """One planner step, mirroring the real engine's control flow
+        (burst 1 while prefill work interleaves, else decode_burst)."""
+        del temperature  # synthetic tokens are class-less
+        chunks, decode = self.scheduler.plan_step()
+        n = 0
+        cost = 0.0
+        for ch in chunks:
+            first = (synthetic_token(ch.request.prompt, 0)
+                     if ch.is_last else None)
+            self.scheduler.chunk_done(ch, first, eos_token_id)
+            n += ch.n_valid
+            cost += self.prefill_cost_s
+        if decode:
+            burst = 1 if (chunks or self.scheduler.prefilling) \
+                else self.decode_burst
+            toks = np.zeros((burst, self.scheduler.max_slots), np.int64)
+            for req in decode:
+                base = len(req.generated)
+                for t in range(burst):
+                    toks[t, req.slot] = synthetic_token(req.prompt,
+                                                        base + t)
+            n += self.scheduler.decode_burst_done(decode, toks,
+                                                  eos_token_id)
+            cost += self.decode_cost_s * burst
+        if self._clock is not None and cost:
+            self._clock.advance(cost)
+        self.steps += 1
+        return n

@@ -142,6 +142,15 @@ class FlightRecorder:
         with self._lock:
             self._context_providers[name] = fn
 
+    def unregister_context(self, name: str) -> None:
+        """Remove a provider added with :meth:`register_context` (no-op
+        if absent).  Providers are strong references — a provider bound
+        to an object with a shorter lifetime than the recorder (e.g. a
+        bench-scoped serving front-end) must unregister to be
+        collectable."""
+        with self._lock:
+            self._context_providers.pop(name, None)
+
     # -- dump --------------------------------------------------------------
 
     def _comm_snapshot(self) -> Dict[str, Any]:

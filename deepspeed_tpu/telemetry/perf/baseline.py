@@ -34,6 +34,14 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     # more HBM is a regression long before it is an OOM
     "peak_hbm_bytes": ("lower", 0.10),
     "hbm_headroom_frac": ("higher", 0.10),
+    # serving plane (deepspeed_tpu/serving): the multi-tenant SLO gate —
+    # interactive tail latency, shared-prefix effectiveness, per-class
+    # goodput.  TTFT tails are noisier than throughput medians, hence
+    # the wider tolerance + absolute floor.
+    "serving_p99_ttft_ms": ("lower", 0.25),
+    "prefix_hit_rate": ("higher", 0.10),
+    "tok_s_interactive": ("higher", 0.15),
+    "tok_s_background": ("higher", 0.25),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -43,6 +51,8 @@ ABS_FLOORS: Dict[str, float] = {
     "step_time_p50_ms": 1.0,
     # sub-64MiB HBM jitter (allocator rounding, cache growth) is noise
     "peak_hbm_bytes": 64 * 1024 * 1024,
+    # sub-50ms TTFT jitter is dispatch noise on a tunneled chip
+    "serving_p99_ttft_ms": 50.0,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

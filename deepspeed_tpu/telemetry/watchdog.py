@@ -96,6 +96,16 @@ class HangWatchdog:
                           ) -> None:
         self._trip_listeners.append(fn)
 
+    def remove_trip_listener(self, fn: Callable[[str, Optional[str]], Any]
+                             ) -> None:
+        """Detach a listener added with :meth:`add_trip_listener` (no-op
+        if absent) — listeners are strong references, so a subscriber
+        with a bounded lifetime must detach to be collectable."""
+        try:
+            self._trip_listeners.remove(fn)
+        except ValueError:
+            pass  # already removed / never added: detach is idempotent
+
     # -- progress feed (engine hot path: one lock + a few floats) ----------
 
     def notify_progress(self, step: int,

@@ -30,6 +30,7 @@ SHARDS=(
   "tests/unit/telemetry --ignore=tests/unit/telemetry/test_memory_ledger.py --ignore=tests/unit/telemetry/test_memory_oom.py --ignore=tests/unit/telemetry/test_memory_health.py --ignore=tests/unit/telemetry/test_memory_cli.py --ignore=tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/telemetry/test_memory_ledger.py tests/unit/telemetry/test_memory_oom.py tests/unit/telemetry/test_memory_health.py tests/unit/telemetry/test_memory_cli.py tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/resilience"
+  "tests/unit/serving"
   "tests/unit/perf"
   "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
@@ -171,6 +172,27 @@ else
   fail=1
 fi
 rm -rf "$smoke_dir"
+
+# Serving CLI smoke (ISSUE 8): the dry-run bench (real scheduler +
+# prefix cache + front-end on synthetic replicas, zero device work)
+# must emit the gated serving metrics cleanly.
+echo "=== serving CLI smoke: bench --dry-run"
+serving_line=$(JAX_PLATFORMS=cpu python -m deepspeed_tpu.serving bench \
+    --dry-run --interactive 4 --background 2 2>/dev/null | tail -1)
+if echo "$serving_line" | python -c '
+import json, sys
+
+line = json.loads(sys.stdin.read())
+for key in ("serving_p99_ttft_ms", "prefix_hit_rate",
+            "tok_s_interactive", "tok_s_background"):
+    assert key in line, key
+assert line["requests_completed"] == line["requests_submitted"] == 6, line
+'; then
+  echo "=== serving CLI smoke passed"
+else
+  echo "=== serving CLI smoke FAILED"
+  fail=1
+fi
 
 # Perf-sentinel smoke (ISSUE 5): baseline-then-check on the same run
 # must exit 0; a forced-regression fixture must exit 3.

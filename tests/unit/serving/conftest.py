@@ -1,0 +1,26 @@
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_serving_globals():
+    """Isolation for the process-global singletons the serving plane
+    touches: the telemetry hub, the memory ledger (replica pool keys),
+    the flight recorder (the front-end registers a ``serving`` context),
+    and the device-unresponsive latch (replica health consults it)."""
+    from deepspeed_tpu.telemetry import (get_flight_recorder, get_telemetry,
+                                         set_watchdog)
+    from deepspeed_tpu.telemetry.memory import (clear_device_unresponsive,
+                                                get_memory_ledger)
+
+    def scrub():
+        get_telemetry().reset()
+        get_flight_recorder().reset()
+        set_watchdog(None)
+        mem = get_memory_ledger()
+        mem.reset()
+        mem.enabled = False
+        clear_device_unresponsive()
+
+    scrub()
+    yield
+    scrub()

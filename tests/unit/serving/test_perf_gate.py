@@ -1,0 +1,63 @@
+"""The serving metrics ride the perf-regression sentinel: a forced
+serving regression must fail ``telemetry perf check`` with exit 3."""
+
+import json
+
+from deepspeed_tpu.telemetry.cli import main as cli_main
+from deepspeed_tpu.telemetry.perf.baseline import (check_regression,
+                                                   extract_perf)
+
+GOOD = {"metric": "llama_110m_train_tokens_per_sec", "value": 50000.0,
+        "serving_p99_ttft_ms": 120.0, "prefix_hit_rate": 0.62,
+        "tok_s_interactive": 900.0, "tok_s_background": 2500.0}
+
+
+def test_extract_perf_sees_serving_metrics():
+    got = extract_perf(GOOD)
+    assert got["serving_p99_ttft_ms"] == 120.0
+    assert got["prefix_hit_rate"] == 0.62
+    assert got["tok_s_interactive"] == 900.0
+
+
+def test_serving_regressions_flagged():
+    base = extract_perf(GOOD)
+    bad = dict(base, serving_p99_ttft_ms=400.0, prefix_hit_rate=0.2,
+               tok_s_interactive=500.0)
+    res = check_regression(bad, base)
+    names = {r["metric"] for r in res["regressions"]}
+    assert {"serving_p99_ttft_ms", "prefix_hit_rate",
+            "tok_s_interactive"} <= names
+
+
+def test_ttft_abs_floor_swallows_dispatch_jitter():
+    base = extract_perf(dict(GOOD, serving_p99_ttft_ms=20.0))
+    # 20 -> 60ms is 3x relative but under the 50ms absolute floor
+    res = check_regression(dict(base, serving_p99_ttft_ms=60.0), base)
+    assert not res["regressions"]
+
+
+def test_perf_check_cli_exits_3_on_serving_regression(tmp_path):
+    run = tmp_path / "run.json"
+    bad = tmp_path / "bad.json"
+    base = tmp_path / "base.json"
+    run.write_text(json.dumps(GOOD))
+    bad.write_text(json.dumps(dict(GOOD, serving_p99_ttft_ms=900.0)))
+    assert cli_main(["perf", "baseline", str(run), "--out",
+                     str(base)]) == 0
+    assert cli_main(["perf", "check", str(run), "--baseline",
+                     str(base)]) == 0
+    assert cli_main(["perf", "check", str(bad), "--baseline",
+                     str(base)]) == 3
+
+
+def test_serving_cli_dry_run_emits_gated_metrics(capsys):
+    from deepspeed_tpu.serving.cli import main as serving_main
+
+    assert serving_main(["bench", "--dry-run", "--interactive", "4",
+                         "--background", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for key in ("serving_p99_ttft_ms", "prefix_hit_rate",
+                "tok_s_interactive", "tok_s_background"):
+        assert key in out
+    assert out["dry_run"] is True
+    assert out["requests_completed"] == 6

@@ -1,0 +1,295 @@
+"""Refcounted allocator + prefix trie + serving scheduler unit tests."""
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig, RequestState
+from deepspeed_tpu.inference.v2.kv_cache import BlockAllocator
+from deepspeed_tpu.serving import (PrefixCache, RefcountedBlockAllocator,
+                                   ServingScheduler)
+
+
+# ---------------------------------------------------------------------------
+# base allocator invariants (ISSUE 8 satellite: descriptive free errors)
+# ---------------------------------------------------------------------------
+
+def test_base_allocator_double_free_is_descriptive():
+    a = BlockAllocator(8)
+    blocks = a.allocate(3)
+    a.free(blocks)
+    with pytest.raises(ValueError, match="double free of page"):
+        a.free([blocks[0]])
+
+
+@pytest.mark.parametrize("bad", [0, -3, 8, 999])
+def test_base_allocator_out_of_range_free_names_range(bad):
+    a = BlockAllocator(8)
+    with pytest.raises(ValueError, match="valid ids are 1..7"):
+        a.free([bad])
+
+
+# ---------------------------------------------------------------------------
+# refcounted allocator
+# ---------------------------------------------------------------------------
+
+def test_refcount_lifecycle_and_double_release():
+    a = RefcountedBlockAllocator(8)
+    b1, b2 = a.allocate(2)
+    assert a.refcount(b1) == 1
+    a.acquire(b1)
+    assert a.refcount(b1) == 2
+    assert a.release([b1]) == []          # still held
+    assert a.release([b1]) == [b1]        # now free
+    with pytest.raises(ValueError, match="not an active allocation"):
+        a.release([b1])
+    a.release([b2])
+    assert a.num_free == 7
+
+
+def test_free_of_shared_page_raises():
+    a = RefcountedBlockAllocator(8)
+    (b,) = a.allocate(1)
+    a.acquire(b)
+    with pytest.raises(ValueError, match="refcount 2"):
+        a.free([b])
+    a.release([b])
+    a.free([b])  # last holder: plain free works
+    assert a.num_free == 7
+
+
+def test_cached_tier_revive_and_lru_reclaim():
+    evicted = []
+    a = RefcountedBlockAllocator(6, evict_callback=evicted.append)
+    blocks = a.allocate(5)          # pool exhausted (page 0 reserved)
+    assert a.num_free == 0
+    # release all into the cached tier, oldest first
+    for b in blocks:
+        a.release([b], cache_fn=lambda _b: True)
+    assert (a.num_free, a.num_cached, a.num_available) == (0, 5, 5)
+    # revive one (a prefix hit across requests)
+    assert a.acquire(blocks[2]) is True
+    assert a.num_cached == 4
+    # fresh allocation reclaims the LRU-OLDEST cached pages
+    got = a.allocate(2)
+    assert got == [blocks[0], blocks[1]]
+    assert evicted == [blocks[0], blocks[1]]
+
+
+def test_cached_cap_enforced():
+    a = RefcountedBlockAllocator(8, max_cached=2)
+    blocks = a.allocate(4)
+    for b in blocks:
+        a.release([b], cache_fn=lambda _b: True)
+    assert a.num_cached == 2
+    assert a.num_free == 5  # 7 allocatable: 4 freed, 2 kept cached
+
+
+def test_allocate_prefers_truly_free_pages():
+    a = RefcountedBlockAllocator(8)
+    (b,) = a.allocate(1)
+    a.release([b], cache_fn=lambda _b: True)
+    got = a.allocate(3)
+    assert b not in got  # cached page untouched while free pages exist
+    assert a.num_cached == 1
+
+
+# ---------------------------------------------------------------------------
+# prefix trie
+# ---------------------------------------------------------------------------
+
+def _cache(num_blocks=32, bs=4):
+    a = RefcountedBlockAllocator(num_blocks)
+    return PrefixCache(a, bs), a
+
+
+def test_trie_insert_match_roundtrip():
+    cache, a = _cache()
+    prompt = list(range(100, 112))  # 3 full blocks of 4
+    blocks = a.allocate(3)
+    cache.insert(prompt, blocks)
+    assert cache.match(prompt) == blocks
+    # longest-prefix semantics: shared first block only
+    other = prompt[:4] + [1, 2, 3, 4, 9, 9, 9, 9]
+    assert cache.match(other) == blocks[:1]
+    # no match at all
+    assert cache.match([7] * 12) == []
+
+
+def test_trie_mid_block_divergence_counts_cow():
+    cache, a = _cache()
+    prompt = list(range(100, 108))
+    cache.insert(prompt, a.allocate(2))
+    # same first block, second block diverges at its LAST token: the
+    # divergence boundary falls mid-block -> recompute-as-CoW.  Counted
+    # only on the committed (count_cow) path — advisory matches from
+    # admission checks re-run every pump and must not inflate it.
+    diverged = prompt[:7] + [999]
+    assert cache.match(diverged) == cache.match(prompt)[:1]
+    assert cache.cow_events == 0        # advisory: not counted
+    assert cache.match(diverged, count_cow=True) == cache.match(prompt)[:1]
+    assert cache.cow_events == 1
+    # a clean block-boundary divergence is NOT CoW
+    cache.match(prompt[:4] + [5, 5, 5, 5], count_cow=True)
+    assert cache.cow_events == 1
+
+
+def test_trie_eviction_prunes_subtree():
+    cache, a = _cache(num_blocks=6)
+    prompt = list(range(100, 120))  # 5 blocks: fills the pool
+    blocks = a.allocate(5)
+    cache.insert(prompt, blocks)
+    a.release(blocks, cache_fn=cache.is_indexed)
+    assert a.num_cached == 5
+    # reclaiming the ROOT page kills the whole chain: descendants are
+    # unreachable without their parent, so they move to the plain free
+    # list and the trie empties
+    got = a.allocate(1)
+    assert got == [blocks[0]]
+    assert a.num_cached == 0
+    assert a.num_free == 4
+    assert cache.match(prompt) == []
+    assert cache.evictions == 5
+
+
+def test_trie_drop_all_reclaims_everything():
+    cache, a = _cache()
+    prompt = list(range(50, 62))
+    blocks = a.allocate(3)
+    cache.insert(prompt, blocks)
+    a.release(blocks, cache_fn=cache.is_indexed)
+    assert a.num_cached == 3
+    cache.drop_all()
+    assert (a.num_cached, a.num_free) == (0, 31)
+    assert cache.match(prompt) == []
+
+
+# ---------------------------------------------------------------------------
+# serving scheduler: prefix-shared reservations, preemption
+# ---------------------------------------------------------------------------
+
+def _sched(num_blocks=64, bs=4, slots=4, chunk=8, max_seq=64):
+    return ServingScheduler(
+        KVCacheConfig(num_blocks=num_blocks, block_size=bs,
+                      max_seq_len=max_seq),
+        max_batch_slots=slots, prefill_chunk=chunk)
+
+
+def _drive_prefill(s, eos=None):
+    """Run the planner's prefill lattice with fake tokens until no
+    chunks remain."""
+    while True:
+        chunks, _ = s.plan_step()
+        if not chunks:
+            return
+        for ch in chunks:
+            s.chunk_done(ch, 7 if ch.is_last else None, eos)
+
+
+def test_shared_header_allocated_once_refcount_2():
+    s = _sched()
+    header = list(range(200, 216))  # 4 full blocks
+    r1 = s.add_request(header + [1, 2, 3], max_new_tokens=4)
+    _drive_prefill(s)  # r1 prefilled -> header indexed in the trie
+    r2 = s.add_request(header + [9, 8, 7], max_new_tokens=4)
+    s.plan_step()      # admit r2 (reservation matches the trie)
+    assert r2.blocks[:4] == r1.blocks[:4]          # header pages shared
+    assert all(s.allocator.refcount(b) == 2 for b in r1.blocks[:4])
+    assert r2.prefilled == 16                       # prefill skips header
+    assert s.prefix.hit_tokens == 16
+    # both finish -> refcount 0, header pages land in the cached tier
+    for r in (r1, r2):
+        if r.state is not RequestState.DONE:
+            s.cancel(r)
+    assert all(s.allocator.refcount(b) == 0 for b in r1.blocks[:4])
+    assert s.allocator.num_cached == 4
+    assert s.allocator.num_available == 63          # fully reclaimable
+
+
+def test_prefix_survives_across_sequential_requests():
+    s = _sched()
+    header = list(range(300, 316))
+    r1 = s.add_request(header + [1, 2], max_new_tokens=2)
+    _drive_prefill(s)
+    s.cancel(r1)
+    assert s.allocator.num_cached == 4  # header cached, refcount 0
+    r2 = s.add_request(header + [5, 6], max_new_tokens=2)
+    s.plan_step()
+    assert r2.prefilled == 16           # revived from the cached tier
+    assert s.prefix.revivals == 4
+
+
+def test_reuse_capped_before_last_prompt_token():
+    s = _sched()
+    header = list(range(10, 26))  # 4 blocks, EXACTLY the whole prompt
+    r1 = s.add_request(list(header), max_new_tokens=4)
+    _drive_prefill(s)
+    r2 = s.add_request(list(header), max_new_tokens=4)
+    s.plan_step()
+    # a full-prompt match must still recompute the final block so the
+    # first sampled token exists: reuse capped at 12 of 16 tokens
+    assert r2.prefilled == 12
+    assert r2.blocks[:3] == r1.blocks[:3]
+    assert r2.blocks[3] != r1.blocks[3]
+
+
+def test_reuse_respects_chunk_lattice_near_max_seq():
+    # max_seq 32, chunk 8: a reuse boundary of 28 would plan a chunk
+    # starting at 28 (28+8 > 32) -> the cap walks it back to 24
+    s = _sched(num_blocks=32, bs=4, chunk=8, max_seq=32)
+    assert s._reuse_cap(prompt_len=30, matched_tokens=28) == 24
+    # plenty of room: block-granular reuse stands
+    assert s._reuse_cap(prompt_len=20, matched_tokens=16) == 16
+
+
+def test_preempt_resume_roundtrip_decode():
+    s = _sched(slots=1)
+    r1 = s.add_request(list(range(40, 50)), max_new_tokens=6)
+    _drive_prefill(s)
+    assert r1.state is RequestState.RUNNING
+    gen_before = list(r1.generated)
+    s.preempt(r1)
+    assert (r1.state, r1.slot) == (RequestState.WAITING, -1)
+    assert r1.blocks                       # KV retained via refcounts
+    assert s._free_slot() == 0
+    # another request uses the slot meanwhile
+    r2 = s.add_request([1, 2, 3], max_new_tokens=1)
+    _drive_prefill(s)
+    assert r2.state is RequestState.DONE
+    assert s.resume(r1) is True
+    assert r1.state is RequestState.RUNNING
+    assert r1.generated == gen_before      # nothing lost
+    assert s.preemptions == 1
+
+
+def test_preempt_mid_prefill_resumes_lattice():
+    s = _sched(slots=1, chunk=8)
+    r1 = s.add_request(list(range(60, 80)), max_new_tokens=2)  # 20 tokens
+    chunks, _ = s.plan_step()
+    s.chunk_done(chunks[0], None)          # 8 of 20 prefilled
+    s.preempt(r1)
+    assert r1.prefilled == 8
+    assert s.resume(r1) is True
+    assert r1.state is RequestState.PREFILL
+    _drive_prefill(s)
+    assert r1.state is RequestState.RUNNING
+    assert r1.prefilled == 20
+
+
+def test_admit_now_and_can_admit_reserve():
+    s = _sched(num_blocks=9, bs=4, slots=2, chunk=8, max_seq=32)
+    # 8 allocatable pages; request needs 3
+    assert s.can_admit([1] * 8, 4) is True
+    assert s.can_admit([1] * 8, 4, reserve_pages=6) is False
+    r = s.add_request([1] * 8, max_new_tokens=4)
+    assert s.admit_now(r) is True
+    assert r.state is RequestState.PREFILL
+    assert r not in s.waiting
+
+
+def test_scheduler_validation_names_fields():
+    s = _sched()
+    with pytest.raises(ValueError, match="prompt"):
+        s.add_request([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        s.add_request([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        s.add_request([1, 2], max_new_tokens=-3)

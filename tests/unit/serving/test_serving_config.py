@@ -1,0 +1,38 @@
+"""The ``serving.*`` config group parses and maps onto ServingParams."""
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.serving import ServingParams, params_from_config
+
+
+def test_serving_config_defaults():
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1}, world_size=1)
+    assert cfg.serving.enabled is False
+    assert cfg.serving.replicas == 1
+    assert cfg.serving.prefix_sharing is True
+    assert cfg.serving.preemption is True
+
+
+def test_serving_config_round_trip_to_params():
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1,
+         "serving": {"enabled": True, "replicas": 3,
+                     "max_outstanding_tokens": 4096,
+                     "interactive_reserve_frac": 0.25,
+                     "min_hbm_headroom_frac": 0.07,
+                     "preemption": False,
+                     "affinity_min_tokens": 32,
+                     "temperature": 0.7, "eos_token_id": 2,
+                     "interactive_ttft_slo_ms": 250.0}},
+        world_size=1)
+    assert cfg.serving.enabled and cfg.serving.replicas == 3
+    p = params_from_config(cfg.serving)
+    assert isinstance(p, ServingParams)
+    assert p.max_outstanding_tokens == 4096
+    assert p.interactive_reserve_frac == 0.25
+    assert p.min_hbm_headroom_frac == 0.07
+    assert p.preemption is False
+    assert p.affinity_min_tokens == 32
+    assert p.temperature == 0.7
+    assert p.eos_token_id == 2
+    assert p.interactive_ttft_slo_ms == 250.0
