@@ -376,7 +376,9 @@ class ServingConfig(DeepSpeedConfigModel):
     #: replica-death re-queue splice exact)
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
-    #: per-handle stream buffer (tokens)
+    #: per-handle stream bound (tokens): a consumer stalled past this
+    #: many unread tokens loses the oldest (drop-oldest; pump never
+    #: blocks)
     stream_buffer: int = 4096
     #: interactive TTFT target (ms), exported with the serving metrics
     interactive_ttft_slo_ms: float = 500.0

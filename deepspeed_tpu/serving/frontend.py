@@ -81,8 +81,11 @@ class ServingParams:
     #: greedy is what makes replica-death re-queue splice-exact)
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
-    #: per-handle stream buffer (tokens) — a stalled consumer blocks
-    #: its own stream, never the pump
+    #: per-handle stream bound (tokens): a consumer that stalls past
+    #: this many unread tokens loses the OLDEST ones (drop-oldest), so
+    #: the pump never blocks — size it to the longest generation whose
+    #: full transcript must survive an unread buffer (``result()`` only
+    #: returns what the buffer retained)
     stream_buffer: int = 4096
     #: interactive TTFT target (ms) — exported with the metrics so the
     #: bench/SLO gate reads the bound it asserts against
@@ -107,14 +110,18 @@ class ServingHandle:
         self.pinned_replica: Optional[int] = None
         self.delivered = 0                # tokens pushed to the stream
         self.consumed = 0                 # tokens read off request
+        self.dropped = 0                  # tokens evicted unread (full
+                                          # buffer, stalled consumer)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.admitted_at: Optional[float] = None
         self.error: Optional[BaseException] = None
         self.replays = 0                  # replica-death re-executions
         self._frontend = frontend
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max(
-            int(stream_buffer), max_new_tokens + 1))
+        # a REAL bound: when a stalled consumer lets it fill, _push
+        # drops the oldest undelivered token — the pump never blocks
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(stream_buffer)))
 
     # -- consumer surface --------------------------------------------------
 
@@ -141,23 +148,29 @@ class ServingHandle:
             return None
         return (self.first_token_at - self.submitted_at) * 1e3
 
-    def _push(self, tok: int) -> None:
-        try:
-            self._queue.put_nowait(tok)
-        except queue.Full:
-            # bounded stream, slow consumer: drop-oldest keeps the pump
-            # real-time; the consumer still sees completion
+    def _put_drop_oldest(self, item: Any) -> None:
+        """Bounded stream, slow consumer: evict the oldest unread token
+        so the pump never blocks (``dropped`` makes the loss visible —
+        completion still lands even on a full buffer)."""
+        while True:
             try:
-                self._queue.get_nowait()
-            except queue.Empty:  # consumer drained it concurrently
-                pass
-            self._queue.put_nowait(tok)
+                self._queue.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                    self.dropped += 1
+                except queue.Empty:  # consumer drained it concurrently
+                    pass
+
+    def _push(self, tok: int) -> None:
+        self._put_drop_oldest(tok)
 
     def _finish(self, status: str,
                 error: Optional[BaseException] = None) -> None:
         self.status = status
         self.error = error
-        self._queue.put(_DONE)
+        self._put_drop_oldest(_DONE)
 
 
 class ServingFrontend:
@@ -252,6 +265,16 @@ class ServingFrontend:
             # field-naming validation at the front door (the scheduler's
             # checks — empty prompt, max_new_tokens<=0, pool-impossible)
             healthy[0].scheduler.validate(list(prompt), max_new_tokens)
+            if max_new_tokens >= self.params.stream_buffer:
+                # the bounded buffer cannot hold the full generation: a
+                # consumer that only reads after completion (the
+                # submit -> run_until_idle -> result() pattern) will see
+                # a truncated transcript (handle.dropped counts it)
+                warn_once(
+                    "serving/stream-buffer",
+                    f"max_new_tokens {max_new_tokens} >= stream_buffer "
+                    f"{self.params.stream_buffer}: an unread stream "
+                    f"drops its oldest tokens")
             h = ServingHandle(self._uid, list(prompt), int(max_new_tokens),
                               klass, self.clock(), self,
                               self.params.stream_buffer)
@@ -580,11 +603,54 @@ class ServingFrontend:
 
     # -- introspection -----------------------------------------------------
 
+    #: bound on the snapshot lock wait — the flight recorder evaluates
+    #: this provider inside dump(), and the watchdog dumps BEFORE firing
+    #: trip listeners: exactly when a pump thread may be wedged in a
+    #: device call while still holding self._lock.  A blocking acquire
+    #: here would deadlock the watchdog thread — no bundle written,
+    #: replicas never marked dead.  Sized to outlast a ROUTINE long
+    #: device step (pump() holds the lock across engine.step), so a
+    #: healthy-system dump waits for the full snapshot and only a
+    #: genuine wedge degrades; on the watchdog-trip path the pump has
+    #: already been stuck for hang_timeout_s, so the extra wait is
+    #: noise.  (Class attribute: a test seam.)
+    _snapshot_lock_timeout_s: float = 5.0
+
     def snapshot(self) -> Dict[str, Any]:
-        with self._lock:
-            out = self.metrics.snapshot()
-            out["queues"] = {c: len(q) for c, q in self._queues.items()}
-            out["router"] = self.router.snapshot()
-            out["prefix_hit_rate"] = round(self._aggregate_hit_rate(), 4)
-            out["params"] = dataclasses.asdict(self.params)
+        if not self._lock.acquire(timeout=self._snapshot_lock_timeout_s):
+            # mirror the lockless _on_watchdog_trip design: emit a
+            # best-effort lock-free view instead of a bundle with no
+            # serving section at all
+            out = self._snapshot_best_effort()
+            out["degraded"] = ("frontend lock held beyond "
+                               f"{self._snapshot_lock_timeout_s}s (pump "
+                               "wedged or in a long device call) — "
+                               "lock-free best-effort reads")
             return out
+        try:
+            return self._snapshot_best_effort()
+        finally:
+            self._lock.release()
+
+    def _snapshot_best_effort(self) -> Dict[str, Any]:
+        """The one section list for BOTH snapshot branches (locked and
+        lock-timeout fallback), so they cannot drift.  In the fallback
+        the holder may be a LIVE pump in a long device call (not
+        wedged), still mutating underneath us — so every section is
+        guarded independently: a torn read (e.g. a metrics deque
+        resized mid-sort) costs that one section, never the whole
+        serving view.  Under the lock the guards never fire."""
+        out: Dict[str, Any] = {}
+        for build in (
+                self.metrics.snapshot,
+                lambda: {"queues": {c: len(q)
+                                    for c, q in self._queues.items()}},
+                lambda: {"router": self.router.snapshot()},
+                lambda: {"prefix_hit_rate":
+                         round(self._aggregate_hit_rate(), 4)},
+                lambda: {"params": dataclasses.asdict(self.params)}):
+            try:
+                out.update(build())
+            except Exception as e:
+                out.setdefault("section_errors", []).append(repr(e))
+        return out

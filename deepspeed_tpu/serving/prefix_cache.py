@@ -223,20 +223,12 @@ class PrefixCache:
 
     # -- matching ----------------------------------------------------------
 
-    def match(self, prompt: List[int],
-              count_cow: bool = False) -> List[int]:
-        """Longest indexed prefix of ``prompt`` in whole blocks →
-        the shared page ids, in sequence order.  Read-only: no refcount
-        movement (``acquire`` commits a match at admission).  With
-        ``count_cow``, a walk stopping *mid-block* counts one
-        copy-on-write event — some indexed chunk shares a proper prefix
-        with the diverging chunk, so an unpaged design would have shared
-        that page and forked it.  Only the committed reservation path
-        passes ``count_cow=True``: advisory callers (admission checks,
-        router affinity scoring) re-match the same queued prompt every
-        pump and would inflate the counter arbitrarily."""
-        if not self.enabled:
-            return []
+    def _walk(self, prompt: List[int]
+              ) -> Tuple[List[int], int, Optional[Tuple[int, ...]]]:
+        """Walk the trie along ``prompt``'s whole-block chunks →
+        (shared page ids in sequence order, node where the walk
+        stopped, the first unmatched chunk — ``None`` if every whole
+        block matched)."""
         bs = self.block_size
         blocks: List[int] = []
         parent = self._ROOT
@@ -244,12 +236,37 @@ class PrefixCache:
             chunk = tuple(prompt[i * bs:(i + 1) * bs])
             node_id = self._children.get((parent, chunk))
             if node_id is None:
-                if count_cow and self._diverges_mid_block(parent, chunk):
-                    self.cow_events += 1
-                break
+                return blocks, parent, chunk
             blocks.append(self._nodes[node_id]["block"])
             parent = node_id
-        return blocks
+        return blocks, parent, None
+
+    def match(self, prompt: List[int]) -> List[int]:
+        """Longest indexed prefix of ``prompt`` in whole blocks →
+        the shared page ids, in sequence order.  Strictly read-only: no
+        refcount movement (``acquire`` commits a match at admission) and
+        no counter movement (``count_mid_block_divergence`` records CoW
+        only when a reservation commits)."""
+        if not self.enabled:
+            return []
+        return self._walk(prompt)[0]
+
+    def count_mid_block_divergence(self, prompt: List[int]) -> bool:
+        """Count one copy-on-write event if ``prompt`` diverges from the
+        trie *mid-block* — some indexed chunk shares a proper prefix
+        with the diverging chunk, so an unpaged design would have shared
+        that page and forked it.  Called ONLY when a reservation
+        commits: advisory matches (admission checks, router affinity
+        scoring) AND capacity-deferred reservations re-walk the same
+        queued prompt every pump round — a page-blocked head at the
+        front of the waiting deque must not inflate the counter."""
+        if not self.enabled:
+            return False
+        _, parent, stopped = self._walk(prompt)
+        if stopped is not None and self._diverges_mid_block(parent, stopped):
+            self.cow_events += 1
+            return True
+        return False
 
     def _diverges_mid_block(self, parent: int, chunk: Tuple[int, ...]
                             ) -> bool:

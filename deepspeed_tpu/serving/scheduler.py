@@ -76,7 +76,7 @@ class ServingScheduler(RaggedScheduler):
 
     def _reserve(self, req: Request) -> bool:
         bs = self.cache.block_size
-        matched = self.prefix.match(req.prompt, count_cow=True)
+        matched = self.prefix.match(req.prompt)
         reused = self._reuse_cap(len(req.prompt), len(matched) * bs)
         shared = matched[:reused // bs]
         need = req.pages_needed(bs)
@@ -87,6 +87,11 @@ class ServingScheduler(RaggedScheduler):
         if fresh > (self.allocator.num_free
                     + self.allocator.num_cached - cached_shared):
             return False
+        # the reservation is committing — only now is the mid-block
+        # divergence a real CoW.  A page-blocked head retries _reserve
+        # every plan_step; counting before the capacity check inflated
+        # cow_events once per pump round.
+        self.prefix.count_mid_block_divergence(req.prompt)
         self.prefix.acquire(shared)
         req.blocks = shared + self.allocator.allocate(fresh)
         req.prefilled = reused

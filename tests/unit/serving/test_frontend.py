@@ -255,6 +255,91 @@ def test_snapshot_has_serving_sections():
     assert "params" in snap
 
 
+def test_snapshot_degrades_instead_of_deadlocking_when_lock_held():
+    """REVIEW regression: snapshot() is a flight-recorder context
+    provider, evaluated by the watchdog's dump() BEFORE trip listeners
+    fire — exactly when a wedged pump thread may still hold the
+    front-end lock.  It must time out into a best-effort lock-free
+    view, never block the watchdog (no bundle, replicas never drained)."""
+    import threading
+
+    fe, _ = make_frontend()
+    fe.submit([1] * 8, max_new_tokens=4)
+    fe.run_until_idle()
+    fe._snapshot_lock_timeout_s = 0.05
+    held, release = threading.Event(), threading.Event()
+
+    def wedged_pump():
+        with fe._lock:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=wedged_pump, daemon=True)
+    t.start()
+    assert held.wait(5.0)
+    try:
+        snap = fe.snapshot()
+    finally:
+        release.set()
+        t.join(5.0)
+    assert "lock held" in snap["degraded"]
+    # the best-effort view still carries the forensic sections
+    assert snap["classes"]["interactive"]["completed"] == 1
+    assert snap["router"]["replicas"][0]["healthy"]
+    # uncontended: full snapshot, no degraded marker
+    assert "degraded" not in fe.snapshot()
+
+
+def test_degraded_snapshot_survives_torn_section():
+    """The lock-timeout holder may be a LIVE pump (long device call,
+    not wedged) still mutating state: a section raising on a torn read
+    must cost that section only, not the whole serving view."""
+    import threading
+
+    fe, _ = make_frontend()
+    fe._snapshot_lock_timeout_s = 0.05
+    fe.metrics.snapshot = lambda: (_ for _ in ()).throw(
+        RuntimeError("deque mutated during iteration"))
+    held, release = threading.Event(), threading.Event()
+
+    def busy_pump():
+        with fe._lock:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=busy_pump, daemon=True)
+    t.start()
+    assert held.wait(5.0)
+    try:
+        snap = fe.snapshot()
+    finally:
+        release.set()
+        t.join(5.0)
+    assert "deque mutated" in snap["section_errors"][0]
+    # the other sections survived
+    assert set(snap["queues"]) == {"interactive", "batch", "background"}
+    assert snap["router"]["replicas"][0]["healthy"]
+    assert "params" in snap and "degraded" in snap
+
+
+def test_stream_buffer_really_bounds_unread_tokens():
+    """stream_buffer is a REAL bound: a consumer that never reads keeps
+    only the newest tokens (drop-oldest) plus completion, and the pump
+    never blocks on the stalled stream."""
+    params = ServingParams(stream_buffer=4)
+    fe, _ = make_frontend(params=params)
+    prompt = [5, 6, 7, 8]
+    h = fe.submit(prompt, max_new_tokens=12)
+    fe.run_until_idle()        # consumer never reads while pumping
+    assert h.status == "done"
+    assert h.delivered == 12   # every token was pushed...
+    want = [synthetic_token(prompt, i) for i in range(12)]
+    # ...but the buffer retained only the newest 3: 4 slots, one
+    # reclaimed by the completion sentinel — and the loss is VISIBLE
+    assert h.result() == want[-3:]
+    assert h.dropped == 9
+
+
 def test_serving_metrics_published_to_telemetry():
     from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
 

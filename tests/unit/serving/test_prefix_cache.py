@@ -120,15 +120,15 @@ def test_trie_mid_block_divergence_counts_cow():
     cache.insert(prompt, a.allocate(2))
     # same first block, second block diverges at its LAST token: the
     # divergence boundary falls mid-block -> recompute-as-CoW.  Counted
-    # only on the committed (count_cow) path — advisory matches from
-    # admission checks re-run every pump and must not inflate it.
+    # only by the committed-reservation hook — match() is advisory
+    # (admission checks re-run every pump) and never counts.
     diverged = prompt[:7] + [999]
     assert cache.match(diverged) == cache.match(prompt)[:1]
     assert cache.cow_events == 0        # advisory: not counted
-    assert cache.match(diverged, count_cow=True) == cache.match(prompt)[:1]
+    assert cache.count_mid_block_divergence(diverged)
     assert cache.cow_events == 1
     # a clean block-boundary divergence is NOT CoW
-    cache.match(prompt[:4] + [5, 5, 5, 5], count_cow=True)
+    assert not cache.count_mid_block_divergence(prompt[:4] + [5, 5, 5, 5])
     assert cache.cow_events == 1
 
 
@@ -283,6 +283,32 @@ def test_admit_now_and_can_admit_reserve():
     assert s.admit_now(r) is True
     assert r.state is RequestState.PREFILL
     assert r not in s.waiting
+
+
+def test_page_blocked_retry_does_not_inflate_cow_events():
+    """REVIEW regression: a page-blocked head at the front of the
+    waiting deque retries ``_reserve`` every plan_step; its mid-block
+    CoW divergence must count ONCE, when the reservation finally
+    commits — not once per pump round while it waits for pages."""
+    s = _sched(num_blocks=12, bs=4, slots=4, chunk=4, max_seq=32)
+    base = list(range(100, 108))                      # 2 full blocks
+    s.add_request(base + [1], max_new_tokens=3)       # 3 pages
+    _drive_prefill(s)          # base's blocks indexed in the trie
+    hog = s.add_request([2] * 16, max_new_tokens=8)   # 6 pages
+    s.plan_step()              # 9 of 11 pages active, 2 free
+    # shares base's first block, diverges MID-second-block; needs 3
+    # fresh pages with only 2 free -> page-blocked, retried every step
+    div = s.add_request(base[:7] + [999], max_new_tokens=8)
+    for _ in range(3):
+        s.plan_step()
+    assert div in s.waiting
+    assert s.prefix.cow_events == 0    # deferred: nothing committed
+    s.cancel(hog)              # pages come back
+    s.plan_step()              # reservation commits now
+    assert div not in s.waiting
+    assert s.prefix.cow_events == 1
+    s.plan_step()
+    assert s.prefix.cow_events == 1    # admitted: no recount
 
 
 def test_scheduler_validation_names_fields():

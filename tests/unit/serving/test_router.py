@@ -204,6 +204,47 @@ def test_watchdog_trip_does_not_need_frontend_lock():
     assert all(not r.healthy() for r in reps)
 
 
+def test_recorder_dump_completes_while_frontend_lock_wedged(tmp_path):
+    """REVIEW regression: HangWatchdog._trip dumps a bundle BEFORE
+    firing trip listeners, and dump() evaluates the front-end's
+    ``serving`` context provider with no timeout of its own.  With a
+    pump thread wedged holding the lock, the provider must degrade
+    (bounded wait) so the bundle still gets written and the trip
+    listeners behind it still drain the replicas."""
+    import json
+    import os
+    import threading
+
+    from deepspeed_tpu.telemetry import get_flight_recorder
+
+    rec = get_flight_recorder().configure(output_path=str(tmp_path))
+    fe, reps, _ = make_cluster(n=2)
+    fe._snapshot_lock_timeout_s = 0.05
+    acquired, release = threading.Event(), threading.Event()
+
+    def wedged_pump():
+        with fe._lock:             # stands in for a wedged pump thread
+            acquired.set()
+            release.wait(5)
+
+    holder = threading.Thread(target=wedged_pump, daemon=True)
+    holder.start()
+    assert acquired.wait(5)
+    try:
+        # replay the watchdog-trip order: dump first, listeners after
+        path = rec.dump("watchdog: test hang")
+        fe._on_watchdog_trip("test hang", path)
+    finally:
+        release.set()
+        holder.join(5)
+    with open(os.path.join(path, "bundle.json")) as fh:
+        manifest = json.load(fh)
+    serving = manifest["context"]["serving"]
+    assert "lock held" in serving["degraded"]
+    assert serving["router"]["replicas"]  # best-effort forensics present
+    assert all(not r.healthy() for r in reps)
+
+
 def test_dead_replica_snapshot_names_reason():
     fe, reps, _ = make_cluster(n=2)
     reps[1].mark_dead("operator drain")
