@@ -21,6 +21,17 @@ Extras in the same JSON line:
 - ``hbm_headroom_frac`` — 1 - peak/limit: how much HBM the headline
                           config leaves free (higher is better; the
                           autotuning search budget).
+- ``tuned_config_source`` — which best-known-config store entry the tuned
+                          run applied (``<store path>::<key>``; "none" on
+                          a store miss, "error: ..." when the tuned run
+                          died).  The headline itself NEVER changes config
+                          (cross-round comparability); the tuned run is a
+                          separate engine build from the store entry.
+- ``tuned_mfu``         — MFU of the tuned run; gated by ``telemetry perf
+                          check`` so a bad promotion or stale seed gates
+                          like a code regression.  ``tuned_vs_default_
+                          mfu_delta`` is the same number minus the
+                          headline ``mfu``.
 - ``environment_failure`` — present (true) ONLY on no-data error lines
                           (device probe failed): tells ``perf check``
                           to SKIP with the reason instead of gating.
@@ -159,6 +170,10 @@ def build_engine(cfg, batch, zero_stage=0, offload=False, bf16=True,
         # reports what the engine logged, so artifacts and telemetry can
         # never disagree); in-memory only — no file exporters in a bench
         "telemetry": {"enabled": True, "jsonl": False, "prometheus": False},
+        # bench engines pin their exact config: a promoted store entry
+        # must not silently shift the headline across rounds (the tuned
+        # variant applies its store entry's overrides explicitly)
+        "tuning": {"auto_apply": False},
     }
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config, mesh=mesh)
@@ -978,13 +993,20 @@ def _main() -> None:
         return
 
     if not on_tpu:  # CPU fallback so the bench always emits a line
+        from deepspeed_tpu.tuning import tuned_config_source
+
         cfg = LlamaConfig.tiny(num_layers=2)
         engine = build_engine(cfg, 4, bf16=False)
         tps = measure(engine, 4, 128, cfg.vocab_size, steps=3, segments=1)
         print(json.dumps({
             "metric": "llama_tiny_cpu_train_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec/chip",
-            "vs_baseline": 1.0, **_perf_extras(engine)}))
+            "vs_baseline": 1.0,
+            # bench engines never auto-apply (config pinned above), so
+            # this is "none" here — the artifact still always answers
+            # "was this run tuned, and from which store entry"
+            "tuned_config_source": tuned_config_source(),
+            **_perf_extras(engine)}))
         return
 
     _mark("selfcheck")
@@ -1014,6 +1036,68 @@ def _main() -> None:
     extras.update(_perf_extras(engine))
     del engine
     free_hbm()  # engine sits in a jit-closure reference cycle
+
+    _mark("tuned")
+    # -- tuned: the best-known-config run (tuning/ — ISSUE 9) --------------
+    # The headline above stays the round-1 config for cross-round
+    # comparability; THIS run is what the store says the same model should
+    # do on this chip — the seeded v5-lite entry (or whatever a search
+    # promoted since).  ``tuned_mfu`` is a gated perf metric, so a bad
+    # promotion or a stale seed shows up in `telemetry perf check`
+    # exactly like a code regression, never as a hand-asserted number.
+    try:
+        _budget_check()
+        import dataclasses
+
+        from deepspeed_tpu.models import LlamaModel
+        from deepspeed_tpu.parallel import MeshLayout
+        from deepspeed_tpu.tuning import BestConfigStore, resolve_store_path
+        from deepspeed_tpu.tuning.store import (current_device_kind,
+                                                mesh_signature,
+                                                model_fingerprint)
+        from deepspeed_tpu.utils import groups
+
+        fp = model_fingerprint(jax.eval_shape(
+            LlamaModel(cfg).init_params, jax.random.PRNGKey(0)))
+        tmesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+        store = BestConfigStore(resolve_store_path())
+        hit = store.lookup(fp, mesh_signature(tmesh), current_device_kind(),
+                           promoted_only=True)
+        if hit is None:
+            extras["tuned_config_source"] = "none"
+        else:
+            key, entry = hit
+            ov = entry.get("overrides", {})
+            known = {f.name for f in dataclasses.fields(cfg)}
+            tcfg = dataclasses.replace(
+                cfg, **{k: v for k, v in entry.get(
+                    "model_overrides", {}).items() if k in known})
+            tmb = int(ov.get("train_micro_batch_size_per_gpu", batch))
+            tgas = int(ov.get("gradient_accumulation_steps", 1))
+            tstage = int(ov.get("zero_optimization.stage", 0))
+            toff = str(ov.get("zero_optimization.offload_optimizer.device",
+                              "none")) == "cpu"
+            teng = build_engine(tcfg, tmb, zero_stage=tstage, offload=toff,
+                                gas=tgas)
+            # the engine steps on the GLOBAL batch (gas microbatches of
+            # tmb rows) — feeding only tmb rows would silently measure
+            # micro-batch tmb/gas, a config the store never claimed
+            tglobal = tmb * tgas
+            tflops = step_flops(teng, tglobal, seq, tcfg.vocab_size, tcfg)
+            teng.flops_per_step = tflops
+            ttps = measure(teng, tglobal, seq, tcfg.vocab_size, steps=10)
+            tmfu = (tflops * ttps / (tglobal * seq)) / peak
+            extras["tuned_config_source"] = f"{store.source_of(key)}::{key}"
+            extras["tuned_mfu"] = round(tmfu, 4)
+            extras["tuned_tokens_per_sec"] = round(ttps, 1)
+            extras["tuned_vs_default_mfu_delta"] = round(tmfu - mfu, 4)
+            if entry.get("stale_jax"):
+                extras["tuned_stale_jax"] = entry["stale_jax"]
+            del teng
+            free_hbm()
+    except Exception as e:  # the tuned run must never kill the headline line
+        free_hbm()
+        extras["tuned_config_source"] = "error: " + str(e)[:160]
 
     _mark("shape_tuned")
     # -- variant: max-fitting ZeRO-3 + remat, sized from live HBM ----------

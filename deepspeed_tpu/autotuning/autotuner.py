@@ -1,4 +1,4 @@
-"""Autotuner — search (zero stage × micro-batch) by timing compiled steps.
+"""Autotuner — the reference API shape, now a shim over ``tuning/``.
 
 Reference: ``deepspeed/autotuning/`` [K] — ``Autotuner`` +
 ``GridSearchTuner/RandomTuner/ModelBasedTuner`` launch short profiling jobs
@@ -6,19 +6,22 @@ over ``zero_optimization.stage`` / micro-batch / offload and pick the best
 throughput config (SURVEY §2.5).
 
 TPU-first: no subprocess launches — each candidate is one jit compile + a
-few timed steps IN PROCESS (XLA gives OOM errors synchronously, and
-compile+run of a candidate costs seconds, not a job launch).  The search
-space and the emitted best-config JSON keep the reference's shape.
+few timed steps IN PROCESS.  Since ISSUE 9 the measurement itself lives in
+the autotuning plane (``deepspeed_tpu/tuning/``): trials are DEVICE-FENCED
+per timed step (the loss-scalar fetch is the fence — ``time.time()``
+around unfenced dispatches measured host queueing on tunneled chips),
+scored from the engine's own StepRecords when telemetry is on, and pruned
+through the ledger-calibrated memory model.  This module keeps the
+reference entry points (``Autotuner``/``ModelBasedTuner``/``autotune``,
+the ``DS_AUTOTUNING_*`` env flows, the emitted best-config JSON shape) as
+thin shims over that plane.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
-import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
-
-import jax
 
 from ..utils.logging import log_dist, logger
 
@@ -83,6 +86,7 @@ class Autotuner:
         self.hbm_bytes = int(hbm_bytes)
         self.dp_size = max(int(dp_size), 1)
         self.records: List[Dict[str, Any]] = []
+        self._mm = None  # one shared memory model — calibrations persist
 
     def _apply(self, cfg: Dict[str, Any], dotted: str, value: Any) -> None:
         node = cfg
@@ -99,65 +103,92 @@ class Autotuner:
                 self._apply(cfg, k, v)
             yield dict(zip(keys, combo)), cfg
 
+    def _memory_model(self):
+        """The plane's calibrated memory model at legacy semantics:
+        margin 0, scale 1 until a trial calibrates it.  ONE instance per
+        tuner — every trial's calibration sharpens later prune calls."""
+        from ..tuning.memory_model import CalibratedMemoryModel
+
+        if self._mm is None:
+            self._mm = CalibratedMemoryModel(
+                params_count=self.model_params_count,
+                hbm_limit_bytes=self.hbm_bytes, dp_size=self.dp_size,
+                base_config=self.base_config, margin_frac=0.0)
+        return self._mm
+
     def _memory_prune(self, combo: Dict[str, Any]) -> bool:
         """True → skip without compiling (estimated state exceeds HBM)."""
         if not (self.model_params_count and self.hbm_bytes):
             return False
-        base_zero = self.base_config.get("zero_optimization", {})
-        stage = int(combo.get("zero_optimization.stage",
-                              base_zero.get("stage", 0)))
-        base_off = base_zero.get("offload_optimizer", {}).get("device",
-                                                              "none")
-        offload = str(combo.get(
-            "zero_optimization.offload_optimizer.device", base_off)) == "cpu"
-        est = zero_memory_estimate(self.model_params_count, stage,
-                                   self.dp_size, offload)
-        return est > self.hbm_bytes
+        return self._memory_model().prune_reason(combo) is not None
 
-    def _measure(self, cfg: Dict[str, Any]) -> Optional[float]:
-        try:
-            engine = self.engine_factory(cfg)
-            batch = self.batch_factory(cfg)
+    def _runner(self, base_config: Optional[Dict[str, Any]] = None):
+        from ..tuning.trial import EngineTrialRunner
 
-            def sync(metrics):
-                # scalar fetch = real fence (block_until_ready is a no-op
-                # on tunneled platforms)
-                return float(metrics["loss"])
+        return EngineTrialRunner(
+            self.engine_factory, self.batch_factory,
+            base_config if base_config is not None else self.base_config,
+            warmup_steps=self.warmup_steps,
+            memory_model=self._memory_model()
+            if self.model_params_count else None)
 
-            m = None
-            for _ in range(self.warmup_steps):
-                m = engine.train_step(batch)
-            if m is not None:  # warmup_steps=0 is legal
-                sync(m)
-            t0 = time.perf_counter()
-            for _ in range(self.timed_steps):
-                m = engine.train_step(batch)
-            sync(m)
-            dt = (time.perf_counter() - t0) / self.timed_steps
-            samples = int(engine.train_batch_size or 1)
-            return samples / dt
-        except Exception as e:
-            logger.warning(f"autotuning candidate failed: {e}")
+    def _measure(self, combo: Dict[str, Any]) -> Optional[float]:
+        """One candidate's samples/sec through the tuning plane's trial
+        runner: every timed step is DEVICE-FENCED (loss-scalar fetch),
+        and engines exposing the ``trial_run`` hook are scored from
+        their own StepRecords.  The COMBO (not a pre-merged config) is
+        what runs, so ledger calibration sees the candidate's real ZeRO
+        stage instead of the base config's."""
+        result = self._runner().run(combo, timed_steps=self.timed_steps)
+        if not result.feasible:
+            logger.warning(
+                f"autotuning candidate failed: {result.error}"
+                + (" (OOM)" if result.oom else ""))
             return None
+        rate = result.score(self.metric if self.metric in result.metrics
+                            else "samples_per_sec")
+        if rate is None:
+            rate = result.score("tokens_per_sec")
+        return rate
 
     def tune(self) -> Dict[str, Any]:
-        best, best_rate = None, -1.0
-        for combo, cfg in self._candidates():
-            if self._memory_prune(combo):
-                self.records.append({"combo": combo, "throughput": None,
-                                     "pruned": "memory_model"})
-                log_dist(f"autotuning {combo} -> PRUNED (memory model)")
+        """Grid search through the tuning plane (``tuning.SearchEngine``
+        + ``GridStrategy``), mapped back to the reference result shape
+        ``{"best_config", "best_combo", "throughput", "records"}``."""
+        from ..tuning.search import GridStrategy, SearchEngine
+        from ..tuning.space import CandidateSpace, Dimension
+
+        space = CandidateSpace()
+        for name, values in self.space.items():
+            space.register(Dimension(name, list(values)))
+        metric = (self.metric if self.metric != "throughput"
+                  else "samples_per_sec")
+        eng = SearchEngine(
+            self._runner(), space,
+            strategy=GridStrategy(timed_steps=self.timed_steps),
+            metric=metric,
+            memory_model=self._memory_model()
+            if (self.model_params_count and self.hbm_bytes) else None)
+        result = eng.search()
+        for rec in result.records:
+            combo = rec.get("candidate")
+            if combo is None:
                 continue
-            rate = self._measure(cfg)
-            rec = {"combo": combo, "throughput": rate}
-            self.records.append(rec)
-            log_dist(f"autotuning {combo} -> "
-                     f"{'FAIL' if rate is None else f'{rate:.1f} samples/s'}")
-            if rate is not None and rate > best_rate:
-                best, best_rate = (combo, cfg), rate
-        if best is None:
+            if rec.get("pruned"):
+                self.records.append({"combo": combo, "throughput": None,
+                                     "pruned": rec["pruned"]})
+            else:
+                rate = (rec.get("metrics") or {}).get(
+                    metric, (rec.get("metrics") or {}).get(
+                        "samples_per_sec"))
+                self.records.append({"combo": combo, "throughput": rate})
+        if result.best is None:
             raise RuntimeError("no autotuning candidate succeeded")
-        combo, cfg = best
+        combo = result.best.candidate
+        best_rate = result.best.score(metric) or 0.0
+        cfg = json.loads(json.dumps(self.base_config))
+        for k, v in combo.items():
+            self._apply(cfg, k, v)
         log_dist(f"autotuning best: {combo} at {best_rate:.1f} samples/s")
         return {"best_config": cfg, "best_combo": combo,
                 "throughput": best_rate, "records": self.records}
@@ -248,7 +279,7 @@ class ModelBasedTuner(Autotuner):
 
         def run(i: int) -> None:
             combo, cfg = all_cands[i]
-            rate = self._measure(cfg)
+            rate = self._measure(combo)
             self.records.append({"combo": combo, "throughput": rate})
             log_dist(f"autotuning(model) {combo} -> "
                      f"{'FAIL' if rate is None else f'{rate:.1f} samples/s'}")

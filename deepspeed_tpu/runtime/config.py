@@ -501,6 +501,37 @@ class AutotuningConfig(DeepSpeedConfigModel):
     mp_size: int = 1
 
 
+class TuningConfig(DeepSpeedConfigModel):
+    """``tuning`` config group — the telemetry-driven autotuning plane
+    (``deepspeed_tpu/tuning/``): offline search scored from telemetry,
+    the best-known-config store keyed by (model fingerprint, mesh,
+    device kind, jax version), and sentinel-gated promotion.  Distinct
+    from the legacy ``autotuning`` group (the launcher-driven reference
+    API shape, now a shim over this plane)."""
+
+    enabled: bool = True
+    #: consult the store at initialize() and apply the promoted entry's
+    #: overrides (user-pinned knobs always win)
+    auto_apply: bool = True
+    #: store file ("" = $DS_TUNING_STORE, else the per-user default;
+    #: the package-shipped seeded store is always the read-only
+    #: fallback)
+    store_path: str = ""
+    #: search defaults — ``tuning.SearchEngine.from_config(runner, space,
+    #: cfg.tuning)`` consumes strategy/warmup/timed/max_candidates/score
+    #: and pushes hbm_margin_frac onto the memory model
+    strategy: Literal["grid", "successive_halving"] = "successive_halving"
+    warmup_steps: int = 1
+    timed_steps: int = 3
+    #: cap on candidates entering the measurement phase (0 = all)
+    max_candidates: int = 0
+    #: score metric for trial ranking
+    score: str = "tokens_per_sec"
+    #: HBM fraction the calibrated memory model keeps clear of the
+    #: state estimate when pruning (activations/scratch headroom)
+    hbm_margin_frac: float = 0.05
+
+
 class DataEfficiencyConfig(DeepSpeedConfigModel):
     enabled: bool = False
     seed: int = 1234
@@ -600,6 +631,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    tuning: TuningConfig = Field(default_factory=TuningConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     compile: CompileConfig = Field(default_factory=CompileConfig)

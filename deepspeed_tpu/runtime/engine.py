@@ -1569,6 +1569,78 @@ class DeepSpeedEngine:
         return self._eval_loss_fn(self.state.params, batch)
 
     # ------------------------------------------------------------------
+    # autotuning trial hook (tuning/ — ISSUE 9)
+    # ------------------------------------------------------------------
+
+    def trial_run(self, batch, warmup_steps: int = 1,
+                  timed_steps: int = 3) -> Dict[str, Any]:
+        """Run ``warmup_steps`` + ``timed_steps`` optimizer steps with a
+        per-step device fence and return a telemetry-sourced summary for
+        the tuning plane: tokens/sec and step-time p50 from this
+        engine's OWN device-fenced StepRecords (falling back to the
+        fenced wall clock when telemetry is off), MFU when
+        ``flops_per_step`` is set, the window's compile cost from the
+        compile tracker (already charged to the goodput ``compile``
+        bucket by ``train_step``), and the memory ledger's per-step
+        HBM numbers.  The per-step loss fetch is the fence — on
+        tunneled platforms ``block_until_ready`` is a no-op, so this is
+        the only number that measures the DEVICE."""
+        warmup_steps = max(int(warmup_steps), 0)
+        timed_steps = max(int(timed_steps), 1)
+        trk = self.compile_tracker
+        ev0 = trk.events_total if trk is not None else 0
+        ms0 = trk.time_ms_total if trk is not None else 0.0
+        for _ in range(warmup_steps):
+            m = self.train_step(batch)
+            float(m["loss"])  # warmup fence: compiles stay out of timing
+        mark = (self.step_records[-1].step if self.step_records
+                else self.global_steps)
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            m = self.train_step(batch)
+            float(m["loss"])  # the per-step fence IS the measurement
+        wall_s = time.perf_counter() - t0
+        leaves = [l for l in jax.tree.leaves(batch)
+                  if getattr(l, "ndim", 0) >= 1]
+        rows = int(leaves[0].shape[0]) if leaves else 0
+        seq = (int(leaves[0].shape[1])
+               if leaves and leaves[0].ndim >= 2 else 1)
+        out: Dict[str, Any] = {"timed_steps": timed_steps,
+                               "wall_s": wall_s}
+        recs = [r for r in self.step_records
+                if r.step > mark and r.device_fenced]
+        if recs:
+            times = sorted(r.step_time_ms for r in recs)
+            tps = sorted(r.tokens_per_sec for r in recs)
+            out["source"] = "telemetry"
+            out["step_time_p50_ms"] = times[len(times) // 2]
+            out["tokens_per_sec"] = tps[len(tps) // 2]
+            sps = sorted(r.samples_per_sec for r in recs)
+            out["samples_per_sec"] = sps[len(sps) // 2]
+            mfus = sorted(r.mfu for r in recs if r.mfu)
+            if mfus:
+                out["mfu"] = mfus[len(mfus) // 2]
+            mem = recs[-1].extra or {}
+            for k in ("peak_hbm_bytes", "hbm_headroom_frac"):
+                if k in mem:
+                    out[k] = mem[k]
+        else:
+            dt = wall_s / timed_steps
+            out["source"] = "wall_clock"
+            out["step_time_p50_ms"] = dt * 1e3
+            out["samples_per_sec"] = rows / max(dt, 1e-9)
+            out["tokens_per_sec"] = rows * seq / max(dt, 1e-9)
+        if trk is not None:
+            out["compile_events"] = trk.events_total - ev0
+            out["compile_s"] = (trk.time_ms_total - ms0) / 1e3
+        if self.memory_ledger is not None and "peak_hbm_bytes" not in out:
+            sample = self.memory_ledger.step_sample()
+            for k in ("peak_hbm_bytes", "hbm_headroom_frac"):
+                if k in sample:
+                    out[k] = sample[k]
+        return out
+
+    # ------------------------------------------------------------------
     # DeepSpeed compat surface: forward / backward / step
     # ------------------------------------------------------------------
 

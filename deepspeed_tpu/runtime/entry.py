@@ -123,6 +123,27 @@ def initialize(args: Any = None,
         pp = int(mesh.shape.get("pipe", 1))
         world = int(mesh.devices.size)
 
+    # --- telemetry-driven autotuning (tuning/ — ISSUE 9) -----------------
+    # consult the best-known-config store BEFORE resolve_batch_sizes:
+    # resolution assigns the batch triple (pydantic marks assigned fields
+    # as set), so the pinned-knob check must see the USER's fields only.
+    # Promoted entries apply; pinned knobs always win; what happened is
+    # stamped into every debug bundle (context.tuning) and readable via
+    # tuning.autoapply for bench artifacts (tuned_config_source).
+    if cfg.tuning.enabled and cfg.tuning.auto_apply:
+        from ..tuning.autoapply import maybe_apply_tuned_config
+
+        maybe_apply_tuned_config(cfg, model=model,
+                                 model_parameters=model_parameters,
+                                 mesh=mesh)
+    else:
+        # skipping the consult must also clear a PREVIOUS initialize()'s
+        # hit — bundles/bench would otherwise report that engine's tuned
+        # config for this untuned one
+        from ..tuning.autoapply import reset_applied
+
+        reset_applied()
+
     cfg.resolve_batch_sizes(world_size=world, tp=tp, pp=pp, sp=sp)
     cfg.resolve_auto_precision()
 

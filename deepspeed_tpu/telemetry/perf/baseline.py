@@ -34,6 +34,11 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     # more HBM is a regression long before it is an OOM
     "peak_hbm_bytes": ("lower", 0.10),
     "hbm_headroom_frac": ("higher", 0.10),
+    # tuning plane (deepspeed_tpu/tuning): the best-known-config path —
+    # the MFU the headline model reaches UNDER the stored tuned config.
+    # Gated so a store regression (a bad promotion, a stale entry) shows
+    # up in the trajectory exactly like a code regression.
+    "tuned_mfu": ("higher", 0.10),
     # serving plane (deepspeed_tpu/serving): the multi-tenant SLO gate —
     # interactive tail latency, shared-prefix effectiveness, per-class
     # goodput.  TTFT tails are noisier than throughput medians, hence

@@ -1,0 +1,68 @@
+"""Telemetry-driven autotuning plane (ISSUE 9).
+
+The reference DeepSpeed ships a whole autotuning subsystem
+(``deepspeed/autotuning/`` — ``Autotuner`` + grid/random/model-based
+tuners that profile candidate configs and emit a best-config JSON,
+PAPER.md §2.5).  This package is its production rebuild on top of the
+observability stack PRs 5/7 put in place — trials are scored from
+*telemetry, not wall clock*, and a tuned config only becomes the stored
+default by passing the perf sentinel:
+
+* :mod:`.space` — the candidate space: a pluggable dimension registry
+  (micro-batch × grad-accumulation × remat × donation × sharding, with
+  offload / ZeRO-stage available as extra dimensions) and dotted-key
+  candidate application.
+* :mod:`.memory_model` — the ledger-calibrated memory model: the
+  analytic ``zero_memory_estimate`` cross-checked against the PR-7
+  memory ledger's *measured* per-pool bytes whenever a trial actually
+  runs; drift is the ``tuning/memory_model_drift_frac`` gauge, and the
+  calibrated estimate prunes infeasible candidates before they compile.
+* :mod:`.trial` — trial runners: build a candidate engine, run a few
+  steps in-process, score from device-fenced StepRecords / the compile
+  tracker / the memory ledger; OOMs become *infeasible* results with
+  their memory breakdown, never crashes.
+* :mod:`.search` — grid + successive-halving strategies over the
+  pruned candidate list.
+* :mod:`.store` — the versioned best-known-config store keyed by
+  (model fingerprint, mesh shape, device_kind, jax version), with
+  provenance (artifact hash, scores, search budget).
+* :mod:`.autoapply` — ``entry.initialize()`` consults the store and
+  applies the stored config unless the user pinned the knob; what was
+  applied lands in bench artifacts (``tuned_config_source``) and the
+  debug-bundle context.
+* :mod:`.promote` — sentinel-gated promotion: a candidate entry becomes
+  the stored default only by passing ``telemetry perf check`` against
+  the current baseline (exit-3 regression blocks it).
+* :mod:`.cli` — ``python -m deepspeed_tpu.tuning
+  {search,show,apply,promote,explain}``.
+"""
+
+from .memory_model import CalibratedMemoryModel
+from .search import (GridStrategy, SearchEngine, SearchResult,
+                     SuccessiveHalvingStrategy)
+from .space import (MODEL_KEY_PREFIX, CandidateSpace, Dimension,
+                    apply_overrides, default_space, split_overrides)
+from .store import (BestConfigStore, current_device_kind, jax_version_key,
+                    mesh_signature, model_fingerprint, package_store_path,
+                    resolve_store_path, store_key)
+from .trial import (EngineTrialRunner, SyntheticTrialRunner, TrialResult,
+                    TrialRunner)
+from .autoapply import (applied_info, maybe_apply_tuned_config,
+                        reset_applied, tuned_config_source)
+from .promote import promote_entry
+
+__all__ = [
+    "CandidateSpace", "Dimension", "default_space", "apply_overrides",
+    "split_overrides", "MODEL_KEY_PREFIX",
+    "CalibratedMemoryModel",
+    "TrialResult", "TrialRunner", "EngineTrialRunner",
+    "SyntheticTrialRunner",
+    "SearchEngine", "SearchResult", "GridStrategy",
+    "SuccessiveHalvingStrategy",
+    "BestConfigStore", "store_key", "model_fingerprint", "mesh_signature",
+    "current_device_kind", "jax_version_key", "resolve_store_path",
+    "package_store_path",
+    "maybe_apply_tuned_config", "applied_info", "tuned_config_source",
+    "reset_applied",
+    "promote_entry",
+]

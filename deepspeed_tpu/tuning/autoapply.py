@@ -1,0 +1,197 @@
+"""Auto-apply — ``initialize()`` consults the best-known-config store.
+
+Called from ``runtime/entry.py`` after the mesh is built and BEFORE
+``resolve_batch_sizes`` (assignment marks pydantic fields as set, so the
+pin check must run first).  The contract:
+
+* only ``promoted`` entries apply (a search candidate that never passed
+  the perf sentinel stays advisory);
+* a knob the user pinned explicitly in their ds_config (or through
+  ``DS_AUTOTUNING_CONFIG_OVERRIDE``) is NEVER overridden — pinned means
+  "present in the validated model's ``model_fields_set`` with a
+  non-``auto`` value";
+* any batch-family knob pinned ⇒ no batch-family override applies (a
+  half-applied batch triple would trip the batch invariant);
+* ``model.*`` overrides are reported but not applied — ``initialize``
+  never rebuilds the caller's model (bench/search harnesses apply them
+  at model construction);
+* what happened is stamped into every future debug bundle
+  (``context.tuning``) and readable via :func:`applied_info` /
+  :func:`tuned_config_source` (bench stamps the latter into the gated
+  artifact).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.logging import debug_once, log_dist, logger
+from .space import MODEL_KEY_PREFIX
+from .store import (BestConfigStore, current_device_kind, fingerprint_of,
+                    mesh_signature, resolve_store_path)
+
+_BATCH_KEYS = ("train_batch_size", "train_micro_batch_size_per_gpu",
+               "gradient_accumulation_steps")
+
+_lock = threading.Lock()
+_applied: Optional[Dict[str, Any]] = None
+
+
+def applied_info() -> Optional[Dict[str, Any]]:
+    """What the last ``initialize()`` consult did (None = no store hit)."""
+    with _lock:
+        return dict(_applied) if _applied is not None else None
+
+
+def tuned_config_source() -> str:
+    """The provenance string bench artifacts carry as
+    ``tuned_config_source`` ("none" when nothing matched)."""
+    info = applied_info()
+    if info is None:
+        return "none"
+    return f"{info['store']}::{info['key']}"
+
+
+def reset_applied() -> None:
+    with _lock:
+        global _applied
+        _applied = None
+
+
+def _set_applied(info: Dict[str, Any]) -> None:
+    with _lock:
+        global _applied
+        _applied = info
+    try:
+        from ..telemetry import get_flight_recorder
+
+        get_flight_recorder().register_context("tuning", applied_info)
+    except Exception as e:  # bundle context is best-effort
+        debug_once("tuning/recorder_context",
+                   f"tuning bundle context unavailable ({e!r})")
+
+
+def _is_pinned(cfg: Any, dotted: str) -> bool:
+    """Did the USER set this dotted key?  Walks pydantic submodels;
+    a field present in ``model_fields_set`` with a non-"auto",
+    non-None value is pinned.  Unknown paths count as pinned (never
+    guess into config we don't understand)."""
+    from ..runtime.config_utils import is_auto
+
+    node = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        nxt = getattr(node, p, None)
+        if nxt is None or not hasattr(nxt, "model_fields_set"):
+            extra = getattr(node, "model_extra", None) or {}
+            if p in extra:
+                return True  # free-form extra subtree the user wrote
+            return False  # subtree untouched by the user: not pinned
+        if p not in node.model_fields_set and p not in (
+                getattr(node, "model_extra", None) or {}):
+            # the whole subgroup is defaulted — nothing under it is pinned
+            return False
+        node = nxt
+    leaf = parts[-1]
+    if leaf in (getattr(node, "model_extra", None) or {}):
+        return True
+    if leaf not in getattr(node, "model_fields_set", ()):  # defaulted
+        return False
+    value = getattr(node, leaf, None)
+    return not (value is None or is_auto(value))
+
+
+def _apply_one(cfg: Any, dotted: str, value: Any) -> bool:
+    node = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        nxt = getattr(node, p, None)
+        if nxt is None or not hasattr(nxt, "model_fields_set"):
+            return False  # not a modeled config path — refuse to invent it
+        node = nxt
+    if not hasattr(node, parts[-1]):
+        return False
+    try:
+        setattr(node, parts[-1], value)  # validate_assignment re-checks type
+    except Exception as e:
+        logger.warning(f"tuning: stored override {dotted}={value!r} "
+                       f"rejected by config validation ({e}); skipped")
+        return False
+    return True
+
+
+def maybe_apply_tuned_config(cfg: Any, model: Any = None,
+                             model_parameters: Any = None,
+                             mesh: Any = None) -> Optional[Dict[str, Any]]:
+    """Consult the store and apply a promoted entry's overrides into the
+    validated ``DeepSpeedConfig`` in place.  Returns the applied-info
+    dict (also stored process-globally) or None on a miss.  Never
+    raises — a corrupt store must not kill ``initialize``."""
+    # a miss must not leave a PREVIOUS initialize()'s hit readable —
+    # debug bundles and tuned_config_source describe the LAST consult
+    reset_applied()
+    try:
+        fp = fingerprint_of(model=model, model_parameters=model_parameters)
+        if fp is None or mesh is None:
+            return None
+        store = BestConfigStore(resolve_store_path(
+            getattr(cfg.tuning, "store_path", "")))
+        hit = store.lookup(fp, mesh_signature(mesh), current_device_kind(),
+                           promoted_only=True)
+        if hit is None:
+            return None
+        key, entry = hit
+        overrides = dict(entry.get("overrides", {}))
+        model_overrides = dict(entry.get("model_overrides", {}))
+        # legacy entries may carry model.* inside overrides
+        for k in [k for k in overrides if k.startswith(MODEL_KEY_PREFIX)]:
+            model_overrides[k[len(MODEL_KEY_PREFIX):]] = overrides.pop(k)
+
+        batch_pinned = [k for k in _BATCH_KEYS if _is_pinned(cfg, k)]
+        applied: Dict[str, Any] = {}
+        skipped: Dict[str, str] = {}
+        for dotted, value in overrides.items():
+            if dotted.startswith("tuning."):
+                skipped[dotted] = "search-harness knob"
+                continue
+            if dotted in _BATCH_KEYS and batch_pinned:
+                skipped[dotted] = (f"batch family pinned by user "
+                                   f"({', '.join(batch_pinned)})")
+                continue
+            if _is_pinned(cfg, dotted):
+                skipped[dotted] = "pinned by user config"
+                continue
+            if _apply_one(cfg, dotted, value):
+                applied[dotted] = value
+            else:
+                skipped[dotted] = "not a modeled config path"
+        info = {
+            "store": store.source_of(key),
+            "key": key,
+            "status": entry.get("status"),
+            "applied": applied,
+            "skipped": skipped,
+            "model_overrides_unapplied": model_overrides,
+            "scores": entry.get("scores", {}),
+            "stale_jax": entry.get("stale_jax"),
+        }
+        _set_applied(info)
+        if applied:
+            log_dist("tuning: applied best-known config "
+                     f"{key} -> {applied}"
+                     + (f" (skipped pinned: {sorted(skipped)})"
+                        if skipped else ""))
+        else:
+            log_dist(f"tuning: best-known config {key} matched but every "
+                     f"override was pinned/unapplicable "
+                     f"({sorted(skipped) or 'empty entry'})")
+        if model_overrides:
+            log_dist(f"tuning: entry carries model overrides "
+                     f"{model_overrides} — initialize() cannot rebuild the "
+                     f"model; apply them at model construction")
+        return info
+    except Exception as e:
+        logger.warning(f"tuning: best-known-config consult failed ({e}); "
+                       f"continuing with the user config")
+        return None

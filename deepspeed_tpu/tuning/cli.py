@@ -1,0 +1,344 @@
+"""Operator CLI — ``python -m deepspeed_tpu.tuning <cmd>``.
+
+* ``search``  — run a search and write the winner as a ``candidate``
+  store entry.  ``--synthetic`` runs the built-in deterministic cost
+  model (CI smoke / demo — no device needed); real-model searches use
+  the Python API (``tuning.SearchEngine`` with an
+  ``EngineTrialRunner``) or the bench harness, which own model/mesh
+  construction.
+* ``show``    — list store entries (key, status, scores, provenance).
+* ``apply``   — merge an entry's overrides into a ds_config JSON and
+  print the result (what ``initialize()`` would do, made inspectable).
+* ``promote`` — the sentinel gate: candidate + run artifact + baseline
+  → promoted on a clean ``perf check``, exit 3 on regression.
+* ``explain`` — how the plane fits together, or one entry's provenance.
+
+Exit codes follow the telemetry CLI convention: 0 ok, 2 structural
+error, 3 gate verdict (regression blocked the promotion).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .memory_model import CalibratedMemoryModel
+from .promote import promote_entry
+from .search import GridStrategy, SearchEngine, SuccessiveHalvingStrategy
+from .space import CandidateSpace, Dimension, apply_overrides
+from .store import (BestConfigStore, jax_version_key, resolve_store_path,
+                    store_key)
+from .trial import SyntheticTrialRunner
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
+# ---------------------------------------------------------------------------
+# the synthetic landscape (CI smoke / demo)
+# ---------------------------------------------------------------------------
+
+#: the planted optimum the deterministic search must find
+SYNTHETIC_BEST = {"train_micro_batch_size_per_gpu": 8,
+                  "gradient_accumulation_steps": 1,
+                  "zero_optimization.stage": 3}
+
+
+def synthetic_space() -> CandidateSpace:
+    return (CandidateSpace()
+            .register(Dimension("train_micro_batch_size_per_gpu",
+                                [1, 2, 4, 8, 16]))
+            .register(Dimension("gradient_accumulation_steps", [1, 2]))
+            .register(Dimension("zero_optimization.stage", [0, 2, 3])))
+
+
+def synthetic_cost_model(cand: Dict[str, Any]) -> Dict[str, float]:
+    """Separable deterministic landscape, argmax at SYNTHETIC_BEST;
+    micro-batch 16 OOMs below stage 3 (the pruning path is exercised)."""
+    mb = int(cand["train_micro_batch_size_per_gpu"])
+    gas = int(cand["gradient_accumulation_steps"])
+    stage = int(cand["zero_optimization.stage"])
+    if mb >= 16 and stage < 3:
+        return {"oom": True}
+    mb_gain = {1: 0.4, 2: 0.7, 4: 0.9, 8: 1.0, 16: 0.95}[mb]
+    gas_gain = {1: 1.0, 2: 0.9}[gas]
+    stage_gain = {0: 0.8, 2: 0.9, 3: 1.0}[stage]
+    tps = 10000.0 * mb_gain * gas_gain * stage_gain
+    return {"tokens_per_sec": round(tps, 1),
+            "mfu": round(tps / 20000.0, 4),
+            "measured_state_bytes": float((16 >> min(stage, 3)) * 10**6)}
+
+
+def _synthetic_key(args: argparse.Namespace) -> str:
+    return store_key(args.fingerprint, args.mesh, args.device_kind,
+                     jax_version_key())
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_search(args: argparse.Namespace) -> int:
+    if not args.synthetic:
+        return _fail(
+            "only --synthetic searches run from the CLI (no model/mesh "
+            "context here); drive real searches through the Python API — "
+            "deepspeed_tpu.tuning.SearchEngine with an EngineTrialRunner "
+            "(see README 'Autotuning') — or the bench harness")
+    mm = CalibratedMemoryModel()  # disabled: the synthetic OOM path covers
+    runner = SyntheticTrialRunner(synthetic_cost_model, memory_model=mm)
+    # 0 = the strategy's own default (grid 3, halving rung-0 2)
+    kw = {"timed_steps": args.timed_steps} if args.timed_steps else {}
+    strategy = (SuccessiveHalvingStrategy(**kw)
+                if args.strategy == "successive_halving"
+                else GridStrategy(**kw))
+    eng = SearchEngine(runner, synthetic_space(), strategy=strategy,
+                       metric=args.metric,
+                       max_candidates=args.max_candidates)
+    result = eng.search()
+    if result.best is None:
+        return _fail("search produced no feasible candidate")
+    key = _synthetic_key(args)
+    store = BestConfigStore(resolve_store_path(args.store))
+    entry = result.to_store_entry()
+    entry["provenance"]["source"] = "cli --synthetic"
+    store.put(key, entry)
+    print(json.dumps({"best": result.best.candidate,
+                      "score": {args.metric:
+                                result.best.score(args.metric)},
+                      "trials_run": result.trials_run,
+                      "infeasible": result.infeasible,
+                      "store": store.path, "key": key,
+                      "status": "candidate"}, indent=2))
+    return 0
+
+
+def _fmt_entry(key: str, e: Dict[str, Any], verbose: bool) -> str:
+    scores = ", ".join(f"{k}={v:g}" for k, v in
+                       sorted(e.get("scores", {}).items()))
+    lines = [f"{key}", f"  status: {e.get('status', '?')}"
+             + (f"  scores: {scores}" if scores else "")]
+    if verbose:
+        lines.append("  overrides: "
+                     + json.dumps(e.get("overrides", {}), sort_keys=True))
+        if e.get("model_overrides"):
+            lines.append("  model_overrides: "
+                         + json.dumps(e["model_overrides"], sort_keys=True))
+        prov = e.get("provenance", {})
+        if prov:
+            lines.append("  provenance: "
+                         + json.dumps(prov, sort_keys=True))
+    return "\n".join(lines)
+
+
+def cmd_show(args: argparse.Namespace) -> int:
+    store = BestConfigStore(resolve_store_path(args.store))
+    entries = store.entries()
+    if args.key:
+        e = store.get(args.key)
+        if e is None:
+            return _fail(f"no store entry {args.key!r} in {store.path}"
+                         + (f" (fallback {store.fallback})"
+                            if store.fallback else ""))
+        if args.keys_only:
+            print(args.key)
+        else:
+            print(_fmt_entry(args.key, e, verbose=True))
+        return 0
+    if not entries:
+        print(f"store {store.path}: empty"
+              + (f" (fallback {store.fallback}: empty too)"
+                 if store.fallback else ""))
+        return 0
+    for key in sorted(entries):
+        if args.keys_only:
+            print(key)
+        else:
+            print(_fmt_entry(key, entries[key], verbose=args.verbose))
+    return 0
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    store = BestConfigStore(resolve_store_path(args.store))
+    entry = store.get(args.key)
+    if entry is None:
+        return _fail(f"no store entry {args.key!r} in {store.path}")
+    base: Dict[str, Any] = {}
+    if args.config:
+        try:
+            with open(args.config) as fh:
+                base = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            return _fail(f"cannot read config {args.config}: {e}")
+    try:
+        merged = apply_overrides(base, entry.get("overrides", {}))
+    except ValueError as e:
+        return _fail(str(e))
+    doc: Dict[str, Any] = dict(merged)
+    if entry.get("model_overrides"):
+        # surfaced, not merged: model knobs belong to model construction
+        doc["_tuning_model_overrides"] = entry["model_overrides"]
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_promote(args: argparse.Namespace) -> int:
+    from ..telemetry.perf.baseline import parse_tolerances
+
+    try:
+        tol = parse_tolerances(args.tol)
+    except ValueError as e:
+        return _fail(str(e))
+    store = BestConfigStore(resolve_store_path(args.store))
+    code, report = promote_entry(store, args.key, args.run, args.baseline,
+                                 tolerances=tol)
+    print(report)
+    return code
+
+
+EXPLAIN = """\
+The autotuning plane (deepspeed_tpu/tuning/) in one pass:
+
+  search   A candidate space (micro-batch x grad-accumulation x remat x
+           donation x sharding; offload/ZeRO-stage pluggable) is pruned
+           by a LEDGER-CALIBRATED memory model (analytic ZeRO estimate x
+           a scale learned from measured pool bytes; drift is the
+           tuning/memory_model_drift_frac gauge), then explored by grid
+           or successive-halving trials.  Each trial runs a few steps
+           in-process and is scored from TELEMETRY: device-fenced
+           StepRecords (tok/s, MFU, step-time p50), the compile tracker
+           (compile cost, charged to the goodput `compile` bucket), and
+           the memory ledger (peak HBM, headroom).  An OOM candidate is
+           recorded infeasible with its memory breakdown.
+
+  store    The winner lands in a versioned JSON store as a `candidate`,
+           keyed (model fingerprint | mesh shape | device kind | jax
+           version) with full provenance (strategy, budget, scores,
+           artifact hash).  Different mesh/device NEVER match; a jax-
+           version-only mismatch applies with a `stale_jax` note.
+
+  promote  `tuning promote` gates the candidate through `telemetry perf
+           check` against the current baseline: any regression beyond
+           tolerance exits 3 and the entry stays a candidate.  A clean
+           check flips it to `promoted`.
+
+  apply    `initialize()` consults the store (promoted entries only)
+           and applies the overrides UNLESS the user pinned the knob in
+           their ds_config; what was applied/skipped rides every debug
+           bundle (context.tuning) and the bench artifact
+           (`tuned_config_source`).
+"""
+
+
+def cmd_explain(args: argparse.Namespace) -> int:
+    if args.key:
+        store = BestConfigStore(resolve_store_path(args.store))
+        e = store.get(args.key)
+        if e is None:
+            return _fail(f"no store entry {args.key!r}")
+        print(_fmt_entry(args.key, e, verbose=True))
+        if e.get("stale_jax"):
+            print(f"  note: {e['stale_jax']}")
+        return 0
+    print(EXPLAIN)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.tuning",
+        description="telemetry-driven autotuning: search, best-known-"
+                    "config store, sentinel-gated promotion")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_store(sp):
+        sp.add_argument("--store", default="",
+                        help="store path (default: $DS_TUNING_STORE or "
+                             "~/.cache/deepspeed_tpu/best_known_configs"
+                             ".json; the package-shipped store is the "
+                             "read-only fallback)")
+
+    s = sub.add_parser("search", help="run a search, write the winner as "
+                                      "a candidate store entry")
+    add_store(s)
+    s.add_argument("--synthetic", action="store_true",
+                   help="deterministic built-in cost model (CI smoke)")
+    s.add_argument("--strategy", choices=["grid", "successive_halving"],
+                   default="grid")
+    s.add_argument("--metric", default="tokens_per_sec")
+    s.add_argument("--timed-steps", type=int, default=0,
+                   help="trial length (rung-0 length for "
+                        "successive_halving); 0 = strategy default")
+    s.add_argument("--max-candidates", type=int, default=0)
+    s.add_argument("--fingerprint", default="synthetic-demo",
+                   help="model-fingerprint key part for the entry")
+    s.add_argument("--mesh", default="devices=1",
+                   help="mesh-signature key part")
+    s.add_argument("--device-kind", default="synthetic",
+                   help="device-kind key part")
+    s.set_defaults(fn=cmd_search)
+
+    w = sub.add_parser("show", help="list store entries")
+    add_store(w)
+    w.add_argument("--key", default="", help="show one entry in full")
+    w.add_argument("--keys-only", action="store_true")
+    w.add_argument("-v", "--verbose", action="store_true")
+    w.set_defaults(fn=cmd_show)
+
+    a = sub.add_parser("apply", help="merge an entry's overrides into a "
+                                     "ds_config JSON, print the result")
+    add_store(a)
+    a.add_argument("--key", required=True)
+    a.add_argument("--config", default="",
+                   help="base ds_config JSON file ({} when omitted)")
+    a.set_defaults(fn=cmd_apply)
+
+    m = sub.add_parser("promote", help="perf-check gate a candidate; "
+                                       "exit 3 on regression")
+    add_store(m)
+    m.add_argument("--key", required=True)
+    m.add_argument("--run", required=True,
+                   help="the candidate's bench/run artifact JSON")
+    m.add_argument("--baseline", required=True,
+                   help="the current perf baseline file")
+    m.add_argument("--tol", action="append", default=[],
+                   metavar="metric=frac",
+                   help="tolerance override (repeatable)")
+    m.set_defaults(fn=cmd_promote)
+
+    e = sub.add_parser("explain", help="how the plane works, or one "
+                                       "entry's provenance")
+    add_store(e)
+    e.add_argument("--key", default="")
+    e.set_defaults(fn=cmd_explain)
+    return p
+
+
+def _logs_to_stderr() -> None:
+    """Every subcommand's stdout is one machine-readable document (the
+    suite smoke pipes it into json.load); the package logger defaults to
+    stdout, so trial-progress lines would corrupt it."""
+    import logging
+
+    from ..utils.logging import logger as ds_logger
+
+    for h in ds_logger.handlers:
+        if (isinstance(h, logging.StreamHandler)
+                and getattr(h, "stream", None) is sys.stdout):
+            h.setStream(sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _logs_to_stderr()
+    return int(args.fn(args))

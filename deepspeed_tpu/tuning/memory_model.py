@@ -1,0 +1,142 @@
+"""Ledger-calibrated memory model — prune before you compile.
+
+The analytic ``zero_memory_estimate`` (autotuning/autotuner.py — params
+2N + grads 2N + fp32 master/Adam 12N, sharded per ZeRO stage) is a fine
+*shape* for the state footprint but a silently wrong *scale* mis-prunes
+candidates: it ignores activation residency, allocator rounding, XLA
+scratch, and whatever else the real program holds.  This model keeps the
+analytic shape and learns the scale from the PR-7 memory ledger: every
+trial that actually runs reports its measured HBM state bytes, the
+estimate-vs-measured ratio becomes the calibration factor (EWMA over
+trials), and the drift is published as the
+``tuning/memory_model_drift_frac`` gauge so a mis-modeling is a visible
+number, not a mystery prune.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..autotuning.autotuner import zero_memory_estimate
+from ..utils.logging import debug_once
+
+
+class CalibratedMemoryModel:
+    """Analytic ZeRO state estimate × a measured calibration scale.
+
+    ``params_count``/``hbm_limit_bytes`` of 0 disable pruning entirely
+    (the analytic model has nothing to say); calibration still records
+    drift when measurements arrive."""
+
+    def __init__(self, params_count: int = 0, hbm_limit_bytes: int = 0,
+                 dp_size: int = 1, base_config: Optional[Dict[str, Any]] = None,
+                 margin_frac: float = 0.05, ewma: float = 0.5):
+        self.params_count = int(params_count)
+        self.hbm_limit_bytes = int(hbm_limit_bytes)
+        self.dp_size = max(int(dp_size), 1)
+        self.base_config = dict(base_config or {})
+        self.margin_frac = float(margin_frac)
+        self.ewma = float(ewma)
+        #: measured/estimated ratio learned from trials (1.0 = trust the
+        #: analytic model as-is)
+        self.scale = 1.0
+        #: signed drift of the last calibration: (estimate - measured)/measured
+        self.last_drift_frac: Optional[float] = None
+        self.calibrations = 0
+
+    # -- candidate knob extraction ----------------------------------------
+
+    def _stage_and_offload(self, candidate: Dict[str, Any]) -> tuple[int, bool]:
+        base_zero = self.base_config.get("zero_optimization", {}) or {}
+        stage = int(candidate.get("zero_optimization.stage",
+                                  base_zero.get("stage", 0)))
+        base_off = (base_zero.get("offload_optimizer", {}) or {}).get(
+            "device", "none")
+        offload = str(candidate.get(
+            "zero_optimization.offload_optimizer.device", base_off)) == "cpu"
+        return stage, offload
+
+    # -- estimate / prune / calibrate --------------------------------------
+
+    def estimate(self, candidate: Dict[str, Any]) -> int:
+        """Calibrated state-bytes estimate for a candidate (0 when the
+        model is disabled)."""
+        if not self.params_count:
+            return 0
+        stage, offload = self._stage_and_offload(candidate)
+        analytic = zero_memory_estimate(self.params_count, stage,
+                                        self.dp_size, offload)
+        return int(analytic * self.scale)
+
+    def prune_reason(self, candidate: Dict[str, Any]) -> Optional[str]:
+        """Non-None → skip this candidate without compiling it: the
+        calibrated state estimate alone exceeds the HBM budget (minus
+        the safety margin kept for activations/scratch)."""
+        if not (self.params_count and self.hbm_limit_bytes):
+            return None
+        est = self.estimate(candidate)
+        budget = int(self.hbm_limit_bytes * (1.0 - self.margin_frac))
+        if est > budget:
+            return (f"calibrated state estimate {est / 2**30:.2f} GiB "
+                    f"(scale {self.scale:.2f}) exceeds HBM budget "
+                    f"{budget / 2**30:.2f} GiB")
+        return None
+
+    def calibrate(self, candidate: Dict[str, Any],
+                  measured_state_bytes: int) -> Optional[float]:
+        """Feed a trial's MEASURED state bytes (the memory ledger's
+        hbm params+grads+optimizer pools) back into the model.  Returns
+        the drift fraction recorded, or None when there was nothing to
+        compare (model disabled / zero measurement)."""
+        if not self.params_count or measured_state_bytes <= 0:
+            return None
+        stage, offload = self._stage_and_offload(candidate)
+        analytic = zero_memory_estimate(self.params_count, stage,
+                                        self.dp_size, offload)
+        if analytic <= 0:
+            return None
+        ratio = measured_state_bytes / analytic
+        # EWMA toward the measured ratio: one weird trial (a partially
+        # registered ledger) must not swing every later prune decision
+        self.scale = (self.ewma * ratio + (1.0 - self.ewma) * self.scale
+                      if self.calibrations else ratio)
+        self.calibrations += 1
+        est = analytic * 1.0  # drift is of the UNcalibrated model — the
+        # gauge answers "how wrong is the analytic formula here", which
+        # stays meaningful after the scale has absorbed the error
+        drift = (est - measured_state_bytes) / measured_state_bytes
+        self.last_drift_frac = drift
+        self._publish_drift(drift)
+        return drift
+
+    def _publish_drift(self, drift: float) -> None:
+        try:
+            from ..telemetry import get_telemetry
+
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.registry.gauge(
+                    "tuning/memory_model_drift_frac",
+                    "analytic-vs-measured state-bytes drift of the "
+                    "autotuning memory model").set(round(drift, 4))
+        except Exception as e:  # gauge publishing must never fail a tune
+            debug_once("tuning/drift_gauge",
+                       f"memory-model drift gauge unavailable ({e!r})")
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"params_count": self.params_count,
+                "hbm_limit_bytes": self.hbm_limit_bytes,
+                "dp_size": self.dp_size, "scale": round(self.scale, 4),
+                "calibrations": self.calibrations,
+                "last_drift_frac": (None if self.last_drift_frac is None
+                                    else round(self.last_drift_frac, 4)),
+                "margin_frac": self.margin_frac}
+
+
+def hbm_limit_bytes() -> int:
+    """Device HBM capacity via the memory ledger's device stats (0 when
+    the platform reports none — CPU backends)."""
+    from ..telemetry.memory import get_memory_ledger
+
+    stats = get_memory_ledger().device_stats()
+    return int(stats.get("bytes_limit", 0) or 0)

@@ -1,0 +1,86 @@
+"""Sentinel-gated promotion — the perf check IS the gate.
+
+A search emits a ``candidate`` store entry; it becomes the stored
+default (``promoted``, the status ``initialize()`` applies) only by
+passing ``telemetry perf check`` against the current baseline: the
+candidate's bench/run artifact is compared metric-by-metric with the
+same tolerance machinery the CI sentinel uses, and any regression
+beyond tolerance BLOCKS the promotion with the sentinel's exit code 3.
+This closes the PR-5 loop: the same gate that stops a code regression
+stops a bad tune from becoming the default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..telemetry.perf import baseline as perfmod
+from ..utils.logging import logger
+from .store import BestConfigStore, artifact_sha1
+
+#: exit codes (the telemetry CLI convention)
+PROMOTE_OK = 0
+PROMOTE_ERROR = 2
+PROMOTE_BLOCKED = 3
+
+
+def promote_entry(store: BestConfigStore, key: str, run_path: str,
+                  baseline_path: str,
+                  tolerances: Optional[Dict[str, float]] = None
+                  ) -> Tuple[int, str]:
+    """Gate ``key``'s candidate entry on ``run_path`` (its measured
+    bench/run artifact) vs ``baseline_path``.  Returns (exit_code,
+    report): 0 = promoted (store updated), 3 = regression blocked it,
+    2 = structural error (missing entry/metrics/baseline)."""
+    entry = store.get(key)
+    if entry is None:
+        return PROMOTE_ERROR, f"no store entry {key!r}"
+    try:
+        run = perfmod.load_run(run_path)
+    except (OSError, ValueError) as e:
+        return PROMOTE_ERROR, f"cannot read run artifact: {e}"
+    metrics = perfmod.extract_perf(run)
+    if not metrics:
+        reason = perfmod.environment_failure_reason(run)
+        if reason:
+            return (PROMOTE_ERROR,
+                    f"run artifact carries no data (environment failure: "
+                    f"{reason}) — a no-data run cannot justify a promotion")
+        return PROMOTE_ERROR, (
+            f"{run_path}: no sentinel metrics "
+            f"({', '.join(perfmod.PERF_METRICS)}) — not a bench artifact?")
+    try:
+        base = perfmod.load_baseline(baseline_path)
+    except (OSError, ValueError) as e:
+        return PROMOTE_ERROR, (f"cannot read baseline {baseline_path} "
+                               f"({e}); run `telemetry perf baseline` first")
+    result = perfmod.check_regression(metrics, base, tolerances=tolerances)
+    report_lines: List[str] = [perfmod.format_check_report(result)]
+    if not result["compared"]:
+        return PROMOTE_ERROR, "\n".join(
+            report_lines + ["run and baseline share no metrics — "
+                            "cannot gate the promotion"])
+    if result["regressions"]:
+        report_lines.append(
+            f"PROMOTION BLOCKED: {len(result['regressions'])} metric(s) "
+            f"regressed beyond tolerance vs {baseline_path} — the tuned "
+            f"config does not beat the baseline it would replace")
+        return PROMOTE_BLOCKED, "\n".join(report_lines)
+    try:
+        sha = artifact_sha1(run_path)
+    except OSError as e:
+        logger.warning(f"tuning: artifact hash unavailable ({e})")
+        sha = None
+    summary = _one_line_summary(result)
+    store.mark_promoted(key, check_report=summary, artifact_sha1=sha)
+    report_lines.append(f"PROMOTED {key} (perf check clean: {summary})")
+    return PROMOTE_OK, "\n".join(report_lines)
+
+
+def _one_line_summary(result: Dict[str, Any]) -> str:
+    imp = [f"{r['metric']} {r['baseline']:g}->{r['current']:g}"
+           for r in result["improvements"]]
+    parts = [f"compared={len(result['compared'])}"]
+    if imp:
+        parts.append("improved " + "; ".join(imp))
+    return ", ".join(parts)

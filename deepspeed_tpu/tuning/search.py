@@ -1,0 +1,261 @@
+"""Search strategies + the search engine.
+
+Two strategies over the (memory-pruned) candidate list:
+
+* :class:`GridStrategy` — measure everything at full trial length
+  (the reference ``GridSearchTuner``).
+* :class:`SuccessiveHalvingStrategy` — measure everything briefly,
+  keep the top ``1/eta`` per rung, re-measure survivors with
+  ``eta×`` the steps: the measurement budget concentrates on the
+  frontier (the reference ``ModelBasedTuner``'s role, but driven by
+  measurements rather than a fitted curve — on TPU a short trial is a
+  real compile+run, so cheap low-fidelity rungs exist naturally).
+
+The engine pre-prunes candidates through the calibrated memory model
+(analytic estimate × ledger-learned scale) so hopeless configs never
+compile, and assembles a :class:`SearchResult` whose ``to_store_entry``
+is exactly what the best-known-config store persists.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import log_dist
+from .memory_model import CalibratedMemoryModel
+from .space import CandidateSpace
+from .trial import TrialResult, TrialRunner
+
+#: score metrics where SMALLER wins — ranking negates these (the perf
+#: sentinel's PERF_METRICS encodes the same directions)
+LOWER_IS_BETTER = {"step_time_p50_ms", "peak_hbm_bytes"}
+
+
+def ranked_score(result: TrialResult, metric: str) -> Optional[float]:
+    """The metric value oriented so that bigger is always better."""
+    s = result.score(metric)
+    if s is None:
+        return None
+    return -s if metric in LOWER_IS_BETTER else s
+
+
+@dataclass
+class SearchResult:
+    best: Optional[TrialResult]
+    metric: str
+    strategy: str
+    records: List[Dict[str, Any]] = field(default_factory=list)
+    trials_run: int = 0
+    candidates_total: int = 0
+    pruned_memory: int = 0
+    infeasible: int = 0
+    wall_s: float = 0.0
+    memory_model: Dict[str, Any] = field(default_factory=dict)
+
+    def to_store_entry(self) -> Dict[str, Any]:
+        """The store payload for the winning candidate (raises when the
+        search found nothing feasible)."""
+        if self.best is None:
+            raise RuntimeError("search produced no feasible candidate")
+        from .space import split_overrides
+
+        overrides, model_overrides = split_overrides(self.best.candidate)
+        return {
+            "overrides": overrides,
+            "model_overrides": model_overrides,
+            "scores": {k: round(float(v), 4)
+                       for k, v in self.best.metrics.items()},
+            "status": "candidate",
+            "provenance": {
+                "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                "strategy": self.strategy,
+                "score_metric": self.metric,
+                "search_budget": {"trials_run": self.trials_run,
+                                  "candidates_total": self.candidates_total,
+                                  "pruned_memory": self.pruned_memory,
+                                  "infeasible": self.infeasible,
+                                  "wall_s": round(self.wall_s, 2)},
+                "score_source": self.best.source,
+            },
+        }
+
+
+class GridStrategy:
+    name = "grid"
+
+    def __init__(self, timed_steps: int = 3):
+        self.timed_steps = max(int(timed_steps), 1)
+
+    def run(self, runner: TrialRunner,
+            candidates: List[Dict[str, Any]], metric: str
+            ) -> List[TrialResult]:
+        results = []
+        for cand in candidates:
+            r = runner.run(cand, timed_steps=self.timed_steps)
+            score = r.score(metric)
+            log_dist(f"tuning[grid] {cand} -> "
+                     + ("INFEASIBLE" if not r.feasible
+                        else f"{metric}={score:.2f}" if score is not None
+                        else "no score"))
+            results.append(r)
+        return results
+
+
+class SuccessiveHalvingStrategy:
+    """Rung 0 measures every candidate at ``timed_steps``; each rung
+    keeps the top ``ceil(n/eta)`` by score and multiplies the steps by
+    ``eta``, until one survivor (or an infeasible wipe-out) remains.
+    Every measurement lands in the result list — later rungs simply
+    append a fresh (longer) result for the surviving candidates."""
+
+    name = "successive_halving"
+
+    def __init__(self, timed_steps: int = 2, eta: int = 2,
+                 max_rungs: int = 4):
+        self.timed_steps = max(int(timed_steps), 1)
+        self.eta = max(int(eta), 2)
+        self.max_rungs = max(int(max_rungs), 1)
+
+    def run(self, runner: TrialRunner,
+            candidates: List[Dict[str, Any]], metric: str
+            ) -> List[TrialResult]:
+        results: List[TrialResult] = []
+        alive = list(candidates)
+        steps = self.timed_steps
+        for rung in range(self.max_rungs):
+            scored: List[tuple[float, TrialResult]] = []
+            for cand in alive:
+                r = runner.run(cand, timed_steps=steps)
+                results.append(r)
+                score = r.score(metric)
+                log_dist(f"tuning[halving r{rung} steps={steps}] {cand} -> "
+                         + ("INFEASIBLE" if not r.feasible
+                            else f"{metric}={score:.2f}"
+                            if score is not None else "no score"))
+                oriented = ranked_score(r, metric)
+                if r.feasible and oriented is not None:
+                    scored.append((oriented, r))
+            if len(scored) <= 1:
+                break
+            keep = max(1, math.ceil(len(scored) / self.eta))
+            scored.sort(key=lambda t: -t[0])
+            alive = [r.candidate for _, r in scored[:keep]]
+            steps *= self.eta
+            if keep == 1:
+                # confirmation rung: the winner's deciding score must not
+                # stay a short-trial fluke — one longer re-measurement
+                # supersedes its rung score in the engine's best-selection
+                r = runner.run(alive[0], timed_steps=steps)
+                results.append(r)
+                score = r.score(metric)
+                log_dist(f"tuning[halving confirm steps={steps}] "
+                         f"{alive[0]} -> "
+                         + ("INFEASIBLE" if not r.feasible
+                            else f"{metric}={score:.2f}"
+                            if score is not None else "no score"))
+                break
+        return results
+
+
+class SearchEngine:
+    """Memory-prune → strategy → best, with a full record trail."""
+
+    def __init__(self, runner: TrialRunner, space: CandidateSpace,
+                 strategy: Any = None, metric: str = "tokens_per_sec",
+                 memory_model: Optional[CalibratedMemoryModel] = None,
+                 max_candidates: int = 0):
+        self.runner = runner
+        self.space = space
+        self.strategy = strategy if strategy is not None else GridStrategy()
+        self.metric = metric
+        self.memory_model = memory_model
+        self.max_candidates = int(max_candidates)
+
+    @classmethod
+    def from_config(cls, runner: TrialRunner, space: CandidateSpace,
+                    tuning: Any,
+                    memory_model: Optional[CalibratedMemoryModel] = None
+                    ) -> "SearchEngine":
+        """Build a SearchEngine from the ``tuning.*`` config group (the
+        validated ``TuningConfig`` model or a plain dict): ``strategy``,
+        ``timed_steps``, ``max_candidates``, ``score``;
+        ``hbm_margin_frac`` lands on the memory model and
+        ``warmup_steps`` on the runner when they carry those knobs."""
+        get = (tuning.get if isinstance(tuning, dict)
+               else lambda k, d=None: getattr(tuning, k, d))
+        timed = max(int(get("timed_steps", 3) or 3), 1)
+        name = str(get("strategy", "successive_halving"))
+        strategy = (GridStrategy(timed_steps=timed) if name == "grid"
+                    else SuccessiveHalvingStrategy(timed_steps=timed))
+        if memory_model is not None and get("hbm_margin_frac") is not None:
+            memory_model.margin_frac = float(get("hbm_margin_frac"))
+        if hasattr(runner, "warmup_steps") and get("warmup_steps") is not None:
+            runner.warmup_steps = max(int(get("warmup_steps")), 0)
+        return cls(runner, space, strategy=strategy,
+                   metric=str(get("score", "tokens_per_sec")),
+                   memory_model=memory_model,
+                   max_candidates=int(get("max_candidates", 0) or 0))
+
+    def search(self) -> SearchResult:
+        t0 = time.perf_counter()
+        result = SearchResult(best=None, metric=self.metric,
+                              strategy=getattr(self.strategy, "name",
+                                               type(self.strategy).__name__))
+        survivors: List[Dict[str, Any]] = []
+        for cand in self.space.candidates():
+            result.candidates_total += 1
+            reason = (self.memory_model.prune_reason(cand)
+                      if self.memory_model is not None else None)
+            if reason is not None:
+                result.pruned_memory += 1
+                result.records.append({"candidate": dict(cand),
+                                       "pruned": "memory_model",
+                                       "reason": reason})
+                log_dist(f"tuning {cand} -> PRUNED ({reason})")
+                continue
+            survivors.append(cand)
+        if self.max_candidates and len(survivors) > self.max_candidates:
+            dropped = len(survivors) - self.max_candidates
+            survivors = survivors[:self.max_candidates]
+            result.records.append({"budget_truncated": dropped})
+            log_dist(f"tuning: candidate budget keeps "
+                     f"{self.max_candidates}, drops {dropped}")
+
+        trials = self.strategy.run(self.runner, survivors, self.metric)
+        result.trials_run = len(trials)
+        # a candidate may be measured at several fidelities (halving
+        # rungs); rank on each candidate's HIGHEST-fidelity result only,
+        # or a noisy short rung-0 score of an eliminated candidate could
+        # beat the survivor's longer re-measurement
+        final: Dict[str, TrialResult] = {}
+        for r in trials:
+            result.records.append(r.to_record())
+            if not r.feasible:
+                result.infeasible += 1
+                continue
+            ckey = json.dumps(r.candidate, sort_keys=True, default=str)
+            prev = final.get(ckey)
+            if prev is None or r.timed_steps >= prev.timed_steps:
+                final[ckey] = r
+        best: Optional[TrialResult] = None
+        best_oriented = -float("inf")
+        for r in final.values():
+            oriented = ranked_score(r, self.metric)
+            if oriented is not None and oriented > best_oriented:
+                best, best_oriented = r, oriented
+        result.best = best
+        result.wall_s = time.perf_counter() - t0
+        if self.memory_model is not None:
+            result.memory_model = self.memory_model.snapshot()
+        if best is not None:
+            log_dist(f"tuning best: {best.candidate} at "
+                     f"{self.metric}={best.score(self.metric):.2f} "
+                     f"({result.trials_run} trials, "
+                     f"{result.pruned_memory} memory-pruned, "
+                     f"{result.infeasible} infeasible)")
+        return result

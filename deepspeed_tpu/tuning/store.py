@@ -1,0 +1,347 @@
+"""Best-known-config store — versioned JSON keyed by what actually
+determines performance.
+
+A tuned config is only valid for the exact situation it was tuned in:
+the *model* (fingerprinted over its parameter tree — leaf paths,
+shapes, dtypes), the *mesh shape* it trains on, the *device kind* the
+chips report, and (loosely) the *jax version* that compiled it.  Keys
+are the pipe-joined normalization of those four parts; a lookup with a
+different mesh or device kind MUST miss (a v5e-tuned micro-batch on a
+v4 is a lie), while a jax-version-only mismatch falls back with a
+``stale_jax`` note — config knobs don't change meaning across jax
+minors, but the provenance should say the scores predate this compiler.
+
+Entries carry full provenance (who searched, with what budget, scoring
+which metric, from which bench artifact) and a ``status``:
+``candidate`` entries come out of a search; only ``promoted`` entries —
+the ones that passed the perf sentinel (:mod:`.promote`) — are applied
+by ``initialize()``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import debug_once, logger
+
+STORE_VERSION = 1
+
+#: the checked-in, package-shipped store (seeded best-known configs —
+#: e.g. the TPU v5 lite headline entry derived from the
+#: ``zero3_remat_shape_tuned`` bench variant)
+PACKAGE_STORE_BASENAME = "best_known_configs.json"
+
+#: env override for the operator/user store location
+STORE_ENV = "DS_TUNING_STORE"
+
+
+# ---------------------------------------------------------------------------
+# key parts
+# ---------------------------------------------------------------------------
+
+
+def model_fingerprint(tree_or_shapes: Any) -> str:
+    """Stable fingerprint of a parameter tree: sha1 over the sorted
+    (path, shape, dtype) triples of its leaves.  Works on concrete
+    arrays and on ``jax.eval_shape`` results alike (both carry
+    ``.shape``/``.dtype``)."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree_or_shapes)[0]
+    triples = []
+    for path, leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        triples.append((jax.tree_util.keystr(path), list(shape), dtype))
+    triples.sort()
+    digest = hashlib.sha1(
+        json.dumps(triples, separators=(",", ":")).encode()).hexdigest()
+    return digest[:12]
+
+
+def fingerprint_of(model: Any = None, model_parameters: Any = None
+                   ) -> Optional[str]:
+    """Fingerprint from whatever the caller has: a concrete param tree,
+    or a model exposing ``init_params`` (traced abstractly — no arrays
+    are materialized).  None when neither is usable."""
+    import jax
+
+    if model_parameters is not None:
+        try:
+            return model_fingerprint(model_parameters)
+        except Exception as e:
+            debug_once("tuning/fingerprint_params",
+                       f"param-tree fingerprint failed ({e!r})")
+    if model is not None and callable(getattr(model, "init_params", None)):
+        try:
+            shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+            return model_fingerprint(shapes)
+        except Exception as e:
+            debug_once("tuning/fingerprint_model",
+                       f"init_params shape trace failed ({e!r})")
+    return None
+
+
+def mesh_signature(mesh: Any) -> str:
+    """``devices=<n>[,axis=k...]`` over the >1-sized axes — stable under
+    axis reordering and all-ones meshes."""
+    try:
+        shape = dict(mesh.shape)
+    except Exception:
+        return "devices=?"
+    total = 1
+    for n in shape.values():
+        total *= int(n)
+    parts = [f"devices={total}"]
+    parts += [f"{a}={int(n)}" for a, n in sorted(shape.items())
+              if int(n) > 1]
+    return ",".join(parts)
+
+
+def current_device_kind() -> str:
+    import jax
+
+    try:
+        devs = jax.local_devices()
+        return str(devs[0].device_kind) if devs else "unknown"
+    except Exception as e:
+        debug_once("tuning/device_kind",
+                   f"device_kind unavailable ({e!r})")
+        return "unknown"
+
+
+def jax_version_key() -> str:
+    import jax
+
+    return "jax" + ".".join(jax.__version__.split(".")[:2])
+
+
+def store_key(fingerprint: str, mesh_sig: str, device_kind: str,
+              jax_version: Optional[str] = None) -> str:
+    return "|".join([fingerprint, mesh_sig, device_kind,
+                     jax_version or jax_version_key()])
+
+
+def split_key(key: str) -> Tuple[str, str, str, str]:
+    parts = key.split("|")
+    if len(parts) != 4:
+        raise ValueError(f"malformed store key {key!r} "
+                         f"(want fingerprint|mesh|device_kind|jaxver)")
+    return tuple(parts)  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# store paths
+# ---------------------------------------------------------------------------
+
+
+def package_store_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        PACKAGE_STORE_BASENAME)
+
+
+def resolve_store_path(configured: str = "") -> str:
+    """Operator store precedence: explicit config path > DS_TUNING_STORE
+    env > the per-user default."""
+    if configured:
+        return os.path.expanduser(configured)
+    env = os.environ.get(STORE_ENV)
+    if env:
+        return os.path.expanduser(env)
+    return os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu",
+                        PACKAGE_STORE_BASENAME)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+class BestConfigStore:
+    """One JSON document: ``{"version": 1, "entries": {key: entry}}``.
+
+    ``fallback`` (default: the package-shipped store) is consulted
+    read-only when a key misses the primary file — a fresh machine gets
+    the checked-in seeds without copying anything."""
+
+    def __init__(self, path: str, fallback: Optional[str] = "__package__"):
+        self.path = os.path.expanduser(path)
+        if fallback == "__package__":
+            fallback = package_store_path()
+        self.fallback = (None if not fallback
+                         or os.path.abspath(fallback)
+                         == os.path.abspath(self.path)
+                         else fallback)
+        self._doc = self._load(self.path)
+        # the fallback is read-only for our lifetime — parse it once, not
+        # on every get()/entries() (lookup() alone would hit disk twice)
+        self._fallback_doc = (self._load(self.fallback)
+                              if self.fallback else {"entries": {}})
+
+    @staticmethod
+    def _load(path: str) -> Dict[str, Any]:
+        if not os.path.exists(path):
+            return {"version": STORE_VERSION, "entries": {}}
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning(f"tuning store {path}: unreadable ({e}); "
+                           f"treating as empty")
+            return {"version": STORE_VERSION, "entries": {}}
+        if not isinstance(doc, dict) or "entries" not in doc:
+            logger.warning(f"tuning store {path}: not a store document; "
+                           f"treating as empty")
+            return {"version": STORE_VERSION, "entries": {}}
+        if int(doc.get("version", 0)) > STORE_VERSION:
+            logger.warning(
+                f"tuning store {path}: version {doc.get('version')} is "
+                f"newer than this runtime understands ({STORE_VERSION}); "
+                f"reading best-effort")
+        return doc
+
+    def save(self) -> None:
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        # never downgrade a document written by a newer runtime — its
+        # entries may carry semantics this version doesn't know about
+        self._doc["version"] = max(
+            int(self._doc.get("version", 0) or 0), STORE_VERSION)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._doc, fh, indent=2, sort_keys=True)
+        os.replace(tmp, self.path)  # atomic: readers never see a torn file
+
+    # -- access ------------------------------------------------------------
+
+    def entries(self, include_fallback: bool = True
+                ) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        if include_fallback and self.fallback:
+            out.update(self._fallback_doc.get("entries", {}))
+        out.update(self._doc.get("entries", {}))
+        return out
+
+    def has_local(self, key: str) -> bool:
+        """True when the key lives in THIS store file (not the read-only
+        fallback)."""
+        return key in self._doc.get("entries", {})
+
+    def source_of(self, key: str) -> str:
+        """The file a key resolves from — the provenance path stamped
+        into ``tuned_config_source`` and the bench artifact."""
+        if self.has_local(key) or not self.fallback:
+            return self.path
+        return self.fallback
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        entry = self._doc.get("entries", {}).get(key)
+        if entry is None and self.fallback:
+            entry = self._fallback_doc.get("entries", {}).get(key)
+        return entry
+
+    def lookup(self, fingerprint: str, mesh_sig: str, device_kind: str,
+               jax_version: Optional[str] = None,
+               promoted_only: bool = False
+               ) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Exact key first; a jax-version-only mismatch falls back to
+        the newest matching entry with ``stale_jax`` set in the
+        returned entry copy.  Mesh / device_kind NEVER fall back."""
+        jv = jax_version or jax_version_key()
+        want = store_key(fingerprint, mesh_sig, device_kind, jv)
+        entry = self.get(want)
+        if entry is not None and (not promoted_only
+                                  or entry.get("status") == "promoted"):
+            return want, dict(entry)
+        if promoted_only and self.fallback:
+            # a local CANDIDATE must not shadow the fallback's promoted
+            # entry for the same key — a fresh search would otherwise
+            # turn off the shipped known-good config until promotion
+            fb = self._fallback_doc.get("entries", {}).get(want)
+            if fb is not None and fb.get("status") == "promoted":
+                return want, dict(fb)
+        # scan local then fallback SEPARATELY: in the merged view a local
+        # candidate would hide the fallback's promoted entry at the same
+        # key — a qualifying local entry still wins (local listed first)
+        sources = [self._doc.get("entries", {})]
+        if self.fallback:
+            sources.append(self._fallback_doc.get("entries", {}))
+        candidates: List[Tuple[str, Dict[str, Any]]] = []
+        taken = set()
+        for src in sources:
+            for key, e in src.items():
+                if key in taken:
+                    continue
+                try:
+                    fp, mesh, kind, ejv = split_key(key)
+                except ValueError:
+                    continue
+                if (fp, mesh, kind) != (fingerprint, mesh_sig, device_kind):
+                    continue
+                if ejv == jv:
+                    continue  # exact-jax case handled above
+                if promoted_only and e.get("status") != "promoted":
+                    continue
+                taken.add(key)
+                candidates.append((key, e))
+        if not candidates:
+            return None
+        key, e = max(candidates, key=lambda ke: str(
+            ke[1].get("provenance", {}).get("created_utc", "")))
+        out = dict(e)
+        out["stale_jax"] = (f"entry tuned under {split_key(key)[3]}, "
+                            f"running {jv}")
+        return key, out
+
+    # -- mutation ----------------------------------------------------------
+
+    def put(self, key: str, entry: Dict[str, Any],
+            save: bool = True) -> Dict[str, Any]:
+        split_key(key)  # validate shape early
+        entry = dict(entry)
+        entry.setdefault("status", "candidate")
+        entry.setdefault("provenance", {})
+        entry["provenance"].setdefault(
+            "created_utc", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        fp, mesh, kind, jv = split_key(key)
+        entry["key_parts"] = {"model_fingerprint": fp, "mesh": mesh,
+                              "device_kind": kind, "jax_version": jv}
+        self._doc.setdefault("entries", {})[key] = entry
+        if save:
+            self.save()
+        return entry
+
+    def mark_promoted(self, key: str, check_report: Optional[str] = None,
+                      artifact_sha1: Optional[str] = None,
+                      save: bool = True) -> Dict[str, Any]:
+        entry = self._doc.get("entries", {}).get(key)
+        if entry is None:
+            # promoting a fallback (package) entry copies it into the
+            # writable store first
+            entry = self.get(key)
+            if entry is None:
+                raise KeyError(f"no store entry {key!r}")
+            entry = self.put(key, dict(entry), save=False)
+        entry["status"] = "promoted"
+        entry.setdefault("provenance", {})["promoted_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if check_report:
+            entry["provenance"]["perf_check"] = check_report
+        if artifact_sha1:
+            entry["provenance"]["artifact_sha1"] = artifact_sha1
+        if save:
+            self.save()
+        return entry
+
+
+def artifact_sha1(path: str) -> str:
+    """Provenance hash of a bench artifact file."""
+    h = hashlib.sha1()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(65536), b""):
+            h.update(chunk)
+    return h.hexdigest()[:16]

@@ -31,6 +31,7 @@ SHARDS=(
   "tests/unit/telemetry/test_memory_ledger.py tests/unit/telemetry/test_memory_oom.py tests/unit/telemetry/test_memory_health.py tests/unit/telemetry/test_memory_cli.py tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/resilience"
   "tests/unit/serving"
+  "tests/unit/tuning"
   "tests/unit/perf"
   "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
@@ -220,6 +221,48 @@ if [ $perf_ok -eq 1 ]; then
   echo "=== perf sentinel smoke passed"
 else
   echo "=== perf sentinel smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
+# Tuning CLI smoke (ISSUE 9): the deterministic synthetic search must
+# find the planted optimum, round-trip through show, and apply its
+# overrides onto a base ds_config (the whole search → store → apply
+# loop on CPU, no device work).
+echo "=== tuning CLI smoke: search / show / apply round-trip"
+smoke_dir=$(mktemp -d)
+tuning_ok=1
+tstore="$smoke_dir/store.json"
+python -m deepspeed_tpu.tuning search --synthetic --store "$tstore" \
+    >"$smoke_dir/search.json" || tuning_ok=0
+tkey=$(python -c '
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["best"]["train_micro_batch_size_per_gpu"] == 8, doc["best"]
+assert doc["best"]["zero_optimization.stage"] == 3, doc["best"]
+print(doc["key"])
+' "$smoke_dir/search.json") || tuning_ok=0
+python -m deepspeed_tpu.tuning show --store "$tstore" --key "$tkey" \
+    >/dev/null || tuning_ok=0
+echo '{"optimizer": {"type": "AdamW"}}' > "$smoke_dir/ds_config.json"
+python -m deepspeed_tpu.tuning apply --store "$tstore" --key "$tkey" \
+    --config "$smoke_dir/ds_config.json" | python -c '
+import json, sys
+
+merged = json.load(sys.stdin)
+assert merged["train_micro_batch_size_per_gpu"] == 8, merged
+assert merged["zero_optimization"]["stage"] == 3, merged
+assert merged["optimizer"]["type"] == "AdamW", merged
+' || tuning_ok=0
+# unknown key must be the structural-error exit, not a crash
+python -m deepspeed_tpu.tuning show --store "$tstore" --key "no|such|key|x" \
+    >/dev/null 2>&1
+[ $? -eq 2 ] || tuning_ok=0
+if [ $tuning_ok -eq 1 ]; then
+  echo "=== tuning CLI smoke passed"
+else
+  echo "=== tuning CLI smoke FAILED"
   fail=1
 fi
 rm -rf "$smoke_dir"

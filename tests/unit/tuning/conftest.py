@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuning_state(monkeypatch):
+    """Isolation: telemetry singletons scrubbed (the trial runners and
+    the drift gauge publish into them), the auto-apply process-global
+    cleared, and the operator store env unset so a developer's real
+    ``~/.cache`` store can never leak into a test."""
+    from deepspeed_tpu.telemetry import (get_compile_tracker,
+                                         get_flight_recorder, get_telemetry)
+    from deepspeed_tpu.tuning import reset_applied
+    from deepspeed_tpu.tuning.store import STORE_ENV
+
+    monkeypatch.delenv(STORE_ENV, raising=False)
+
+    def scrub():
+        get_telemetry().reset()
+        get_flight_recorder().reset()
+        trk = get_compile_tracker()
+        trk.reset()
+        trk.enabled = False
+        reset_applied()
+
+    scrub()
+    yield
+    scrub()
+
+
+@pytest.fixture()
+def tiny_model():
+    """A deterministic loss_fn + params pair every engine in this shard
+    shares (one model fingerprint across tests)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    params = {"w": jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    return loss_fn, params
+
+
+@pytest.fixture()
+def tiny_batch():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    return (jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32)),
+            jnp.zeros((4, 1), jnp.float32))
+
+
+@pytest.fixture()
+def make_engine(tiny_model, tmp_path):
+    """``make(config_overrides...)`` -> a real 1-device engine with
+    telemetry on (so trial scoring has StepRecords to read)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    loss_fn, params = tiny_model
+
+    def make(config=None):
+        mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "steps_per_print": 0,
+               "telemetry": {"enabled": True,
+                             "output_path": str(tmp_path / "tel"),
+                             "job_name": "tuning-test",
+                             "flight_recorder": {"install_handlers": False}}}
+        cfg.update(config or {})
+        engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
+                                    config=cfg, mesh=mesh)
+        return engine
+
+    return make

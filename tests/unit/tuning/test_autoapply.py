@@ -1,0 +1,159 @@
+"""``initialize()`` x the store: promoted entries apply, pinned knobs win.
+
+The acceptance loop's final leg: a fresh ``initialize()`` on the same
+(model, mesh, device) picks up what a search promoted — and NEVER
+overrides a knob the user wrote in their ds_config.
+"""
+
+import json
+
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.parallel import MeshLayout
+from deepspeed_tpu.tuning import applied_info, tuned_config_source
+from deepspeed_tpu.tuning.store import (BestConfigStore, STORE_ENV,
+                                        current_device_kind, fingerprint_of,
+                                        jax_version_key, store_key)
+from deepspeed_tpu.utils import groups
+
+
+@pytest.fixture()
+def seeded_env(tiny_model, tmp_path, monkeypatch):
+    """A store (via $DS_TUNING_STORE) holding a PROMOTED entry keyed to
+    exactly the tiny model on this host's 1-device mesh."""
+    _, params = tiny_model
+    key = store_key(fingerprint_of(model_parameters=params), "devices=1",
+                    current_device_kind(), jax_version_key())
+    path = str(tmp_path / "store.json")
+    st = BestConfigStore(path, fallback=None)
+    st.put(key, {"overrides": {"train_micro_batch_size_per_gpu": 8,
+                               "gradient_accumulation_steps": 1},
+                 "model_overrides": {"remat": True},
+                 "scores": {"tokens_per_sec": 999.0},
+                 "status": "promoted"})
+    monkeypatch.setenv(STORE_ENV, path)
+    return key, path
+
+
+def init_engine(tiny_model, config):
+    loss_fn, params = tiny_model
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    cfg = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 0}
+    cfg.update(config)
+    engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
+                                config=cfg, mesh=mesh)
+    return engine
+
+
+def test_fresh_initialize_picks_up_promoted_config(tiny_model, seeded_env):
+    key, path = seeded_env
+    engine = init_engine(tiny_model, {})  # no batch knob pinned
+    assert engine.config.train_micro_batch_size_per_gpu == 8
+    assert engine.config.train_batch_size == 8
+    info = applied_info()
+    assert info["key"] == key
+    assert info["applied"]["train_micro_batch_size_per_gpu"] == 8
+    # model overrides are REPORTED, never applied by initialize()
+    assert info["model_overrides_unapplied"] == {"remat": True}
+    assert tuned_config_source() == f"{path}::{key}"
+
+
+def test_user_pinned_knob_is_never_overridden(tiny_model, seeded_env):
+    engine = init_engine(
+        tiny_model, {"train_micro_batch_size_per_gpu": 2})
+    assert engine.config.train_micro_batch_size_per_gpu == 2
+    info = applied_info()
+    # the whole batch family is off-limits once ANY of it is pinned (a
+    # half-applied batch triple would trip the batch invariant)
+    assert "train_micro_batch_size_per_gpu" in info["skipped"]
+    assert "gradient_accumulation_steps" in info["skipped"]
+    assert info["applied"] == {}
+
+
+def test_candidate_entries_are_advisory_only(tiny_model, seeded_env,
+                                             tmp_path):
+    key, path = seeded_env
+    st = BestConfigStore(path, fallback=None)
+    entry = st.get(key)
+    entry["status"] = "candidate"
+    st.put(key, entry)
+    engine = init_engine(tiny_model, {})
+    assert engine.config.train_micro_batch_size_per_gpu == 1  # default
+    assert applied_info() is None
+    assert tuned_config_source() == "none"
+
+
+def test_auto_apply_off_skips_the_consult(tiny_model, seeded_env):
+    engine = init_engine(tiny_model, {"tuning": {"auto_apply": False}})
+    assert engine.config.train_micro_batch_size_per_gpu == 1
+    assert applied_info() is None
+
+
+def test_different_model_misses(tiny_model, seeded_env, monkeypatch):
+    import jax.numpy as jnp
+    import numpy as np
+
+    loss_fn, _ = tiny_model
+    other = {"w": jnp.asarray(np.zeros((16, 1), np.float32))}
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    engine, *_ = dst.initialize(
+        model=loss_fn, model_parameters=other,
+        config={"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0}, mesh=mesh)
+    assert engine.config.train_micro_batch_size_per_gpu == 1
+    assert applied_info() is None
+
+
+def test_applied_info_lands_in_debug_bundles(tiny_model, seeded_env,
+                                             tmp_path):
+    from deepspeed_tpu.telemetry import get_flight_recorder
+    from deepspeed_tpu.telemetry.flight_recorder import load_bundle
+
+    init_engine(tiny_model, {
+        "telemetry": {"enabled": True, "output_path": str(tmp_path / "t"),
+                      "flight_recorder": {"install_handlers": False}}})
+    bundle = get_flight_recorder().dump("tuning context smoke")
+    doc = load_bundle(bundle)
+    tun = doc["manifest"]["context"]["tuning"]
+    assert tun["applied"]["train_micro_batch_size_per_gpu"] == 8
+
+
+def test_corrupt_store_never_kills_initialize(tiny_model, tmp_path,
+                                              monkeypatch):
+    path = tmp_path / "broken.json"
+    path.write_text("{definitely not json")
+    monkeypatch.setenv(STORE_ENV, str(path))
+    engine = init_engine(tiny_model, {})  # must not raise
+    assert engine.config.train_micro_batch_size_per_gpu == 1
+
+
+def test_auto_apply_off_also_clears_previous_applied_info(tiny_model,
+                                                          seeded_env):
+    init_engine(tiny_model, {})  # hit: _applied set
+    assert applied_info() is not None
+    init_engine(tiny_model, {"tuning": {"auto_apply": False}})
+    # the consult was SKIPPED — the pinned engine must not inherit the
+    # previous engine's tuned-config provenance
+    assert applied_info() is None
+    assert tuned_config_source() == "none"
+
+
+def test_store_miss_clears_previous_applied_info(tiny_model, seeded_env):
+    import jax.numpy as jnp
+    import numpy as np
+
+    init_engine(tiny_model, {})  # hit: _applied set
+    assert applied_info() is not None
+    loss_fn, _ = tiny_model
+    other = {"w": jnp.asarray(np.zeros((16, 1), np.float32))}
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    dst.initialize(model=loss_fn, model_parameters=other,
+                   config={"optimizer": {"type": "Adam",
+                                         "params": {"lr": 1e-2}},
+                           "steps_per_print": 0}, mesh=mesh)
+    # the second engine missed the store — bundles/bench must not keep
+    # reporting the FIRST engine's tuned config
+    assert applied_info() is None
+    assert tuned_config_source() == "none"

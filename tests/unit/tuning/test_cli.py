@@ -1,0 +1,159 @@
+"""Operator CLI: search → show → apply → promote round-trip, exit codes."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.tuning.cli import SYNTHETIC_BEST, main
+from deepspeed_tpu.tuning.store import BestConfigStore
+
+
+@pytest.fixture()
+def store_path(tmp_path):
+    return str(tmp_path / "store.json")
+
+
+def run_cli(capsys, *argv):
+    rc = main(list(argv))
+    return rc, capsys.readouterr().out
+
+
+def test_synthetic_search_finds_planted_best_and_persists(capsys,
+                                                          store_path):
+    rc, out = run_cli(capsys, "search", "--synthetic",
+                      "--store", store_path)
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["best"] == SYNTHETIC_BEST
+    assert doc["status"] == "candidate"
+    entry = BestConfigStore(store_path, fallback=None).get(doc["key"])
+    assert entry["overrides"] == SYNTHETIC_BEST
+    assert entry["provenance"]["source"] == "cli --synthetic"
+
+
+def test_search_halving_agrees_with_grid(capsys, store_path):
+    rc, out = run_cli(capsys, "search", "--synthetic", "--store",
+                      store_path, "--strategy", "successive_halving")
+    assert rc == 0
+    assert json.loads(out)["best"] == SYNTHETIC_BEST
+
+
+def test_real_search_refused_without_model_context(capfd, store_path):
+    rc = main(["search", "--store", store_path])
+    assert rc == 2
+
+
+def test_show_and_explain_round_trip(capsys, store_path):
+    rc, out = run_cli(capsys, "search", "--synthetic", "--store",
+                      store_path)
+    key = json.loads(out)["key"]
+    rc, out = run_cli(capsys, "show", "--store", store_path)
+    assert rc == 0 and key in out
+    rc, out = run_cli(capsys, "show", "--store", store_path, "--key", key)
+    assert rc == 0 and "status: candidate" in out
+    rc, out = run_cli(capsys, "explain", "--store", store_path,
+                      "--key", key)
+    assert rc == 0 and "provenance" in out
+    rc, out = run_cli(capsys, "explain")
+    assert rc == 0 and "autotuning plane" in out
+
+
+def test_show_unknown_key_exit_2(capsys, store_path):
+    assert main(["show", "--store", store_path, "--key", "a|b|c|d"]) == 2
+
+
+def test_apply_merges_overrides_into_base_config(capsys, store_path,
+                                                 tmp_path):
+    rc, out = run_cli(capsys, "search", "--synthetic", "--store",
+                      store_path)
+    key = json.loads(out)["key"]
+    base = tmp_path / "ds_config.json"
+    base.write_text(json.dumps({"optimizer": {"type": "AdamW"},
+                                "zero_optimization": {"stage": 0}}))
+    rc, out = run_cli(capsys, "apply", "--store", store_path, "--key", key,
+                      "--config", str(base))
+    assert rc == 0
+    merged = json.loads(out)
+    assert merged["train_micro_batch_size_per_gpu"] == 8
+    assert merged["zero_optimization"]["stage"] == 3  # dotted key nested
+    assert merged["optimizer"]["type"] == "AdamW"  # base preserved
+
+
+def test_promote_blocked_then_clean(capsys, store_path, tmp_path):
+    from deepspeed_tpu.telemetry.perf import save_baseline
+
+    rc, out = run_cli(capsys, "search", "--synthetic", "--store",
+                      store_path)
+    key = json.loads(out)["key"]
+    base = str(tmp_path / "base.json")
+    save_baseline(base, {"metric": "llama_110m_train_tokens_per_sec",
+                         "value": 35000.0, "mfu": 0.42}, source="test")
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"metric": "llama_110m_train_tokens_per_sec",
+                               "value": 20000.0, "mfu": 0.2}))
+    rc, out = run_cli(capsys, "promote", "--store", store_path, "--key",
+                      key, "--run", str(bad), "--baseline", base)
+    assert rc == 3
+    assert BestConfigStore(store_path, fallback=None).get(key)[
+        "status"] == "candidate"
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"metric": "llama_110m_train_tokens_per_sec",
+                                "value": 36000.0, "mfu": 0.44}))
+    rc, out = run_cli(capsys, "promote", "--store", store_path, "--key",
+                      key, "--run", str(good), "--baseline", base)
+    assert rc == 0
+    assert BestConfigStore(store_path, fallback=None).get(key)[
+        "status"] == "promoted"
+
+
+def test_promote_bad_tolerance_spec_exit_2(capsys, store_path, tmp_path):
+    assert main(["promote", "--store", store_path, "--key", "a|b|c|d",
+                 "--run", "x", "--baseline", "y", "--tol", "nonsense"]) == 2
+
+
+def test_promoted_entry_applies_on_fresh_initialize(capsys, tmp_path,
+                                                    monkeypatch,
+                                                    tiny_model):
+    """The CI acceptance loop end-to-end on CPU: CLI search → clean CLI
+    promote → a fresh ``initialize()`` on a matching key picks the
+    config up."""
+    from deepspeed_tpu.telemetry.perf import save_baseline
+    from deepspeed_tpu.tuning import applied_info
+    from deepspeed_tpu.tuning.store import (STORE_ENV, current_device_kind,
+                                            fingerprint_of,
+                                            jax_version_key)
+
+    _, params = tiny_model
+    fp = fingerprint_of(model_parameters=params)
+    store_path = str(tmp_path / "store.json")
+    # search keyed to the REAL local (model, mesh, device, jax)
+    rc, out = run_cli(capsys, "search", "--synthetic", "--store",
+                      store_path, "--fingerprint", fp, "--mesh",
+                      "devices=1", "--device-kind", current_device_kind())
+    key = json.loads(out)["key"]
+    assert key.endswith(jax_version_key())
+    base = str(tmp_path / "base.json")
+    save_baseline(base, {"metric": "llama_110m_train_tokens_per_sec",
+                         "value": 9000.0}, source="test")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"metric": "llama_110m_train_tokens_per_sec",
+                                "value": 10000.0}))
+    rc, _ = run_cli(capsys, "promote", "--store", store_path, "--key", key,
+                    "--run", str(good), "--baseline", base)
+    assert rc == 0
+    monkeypatch.setenv(STORE_ENV, store_path)
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    loss_fn, params = tiny_model
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    engine, *_ = dst.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0}, mesh=mesh)
+    # the planted best (mb=8, gas=1, stage 3) is now the engine's config
+    assert engine.config.train_micro_batch_size_per_gpu == 8
+    assert engine.config.zero_optimization.stage == 3
+    assert applied_info()["key"] == key

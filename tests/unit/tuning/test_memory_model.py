@@ -1,0 +1,92 @@
+"""Calibrated memory model: analytic shape x measured scale, drift gauge."""
+
+from deepspeed_tpu.autotuning.autotuner import zero_memory_estimate
+from deepspeed_tpu.tuning import CalibratedMemoryModel
+
+N = 100_000_000  # params; analytic stage-0 state = 16 B/param = 1.6 GB
+
+
+def test_disabled_model_never_prunes():
+    mm = CalibratedMemoryModel()
+    assert mm.prune_reason({"zero_optimization.stage": 0}) is None
+    assert mm.estimate({"zero_optimization.stage": 0}) == 0
+
+
+def test_prune_tracks_stage_and_budget():
+    mm = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=1 << 30,
+                               dp_size=8, margin_frac=0.0)
+    # stage 0: full 1.6 GB replica > 1 GB budget
+    assert "exceeds HBM budget" in mm.prune_reason(
+        {"zero_optimization.stage": 0})
+    # stage 3 shards everything across dp=8 -> fits
+    assert mm.prune_reason({"zero_optimization.stage": 3}) is None
+
+
+def test_margin_frac_reserves_activation_headroom():
+    est = zero_memory_estimate(N, 0, 1, False)
+    tight = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=int(
+        est * 1.02), margin_frac=0.0)
+    assert tight.prune_reason({"zero_optimization.stage": 0}) is None
+    margined = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=int(
+        est * 1.02), margin_frac=0.10)
+    assert margined.prune_reason({"zero_optimization.stage": 0}) is not None
+
+
+def test_calibration_rescales_prunes_and_records_drift():
+    analytic = zero_memory_estimate(N, 0, 1, False)
+    # budget sized so the UNcalibrated estimate fits...
+    mm = CalibratedMemoryModel(params_count=N,
+                               hbm_limit_bytes=int(analytic * 1.2),
+                               margin_frac=0.0)
+    cand = {"zero_optimization.stage": 0}
+    assert mm.prune_reason(cand) is None
+    # ...but a trial measures 1.5x the analytic number (allocator
+    # rounding, scratch): the calibrated model must now prune
+    drift = mm.calibrate(cand, int(analytic * 1.5))
+    assert abs(mm.scale - 1.5) < 1e-6
+    assert mm.prune_reason(cand) is not None
+    # drift gauges the UNcalibrated analytic model: (est-measured)/measured
+    assert abs(drift - (analytic - analytic * 1.5) / (analytic * 1.5)) < 1e-6
+    assert mm.last_drift_frac == drift
+    assert mm.calibrations == 1
+
+
+def test_calibration_ewma_damps_single_outliers():
+    mm = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=1 << 40,
+                               ewma=0.5)
+    cand = {"zero_optimization.stage": 0}
+    analytic = zero_memory_estimate(N, 0, 1, False)
+    mm.calibrate(cand, int(analytic * 2.0))  # first: adopt outright
+    assert abs(mm.scale - 2.0) < 1e-6
+    mm.calibrate(cand, int(analytic * 1.0))  # second: EWMA halfway
+    assert abs(mm.scale - 1.5) < 1e-6
+
+
+def test_drift_published_as_telemetry_gauge():
+    from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+    tel = get_telemetry()
+    tel.configure(enabled=True)
+    mm = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=1 << 40)
+    analytic = zero_memory_estimate(N, 0, 1, False)
+    mm.calibrate({"zero_optimization.stage": 0}, int(analytic * 1.25))
+    parsed = parse_prometheus_text(tel.prometheus_text())
+    key = [k for k in parsed if "memory_model_drift_frac" in k]
+    assert key, f"drift gauge missing from {sorted(parsed)}"
+    assert abs(parsed[key[0]] - (-0.2)) < 1e-3  # (1 - 1.25)/1.25
+
+
+def test_zero_measurement_is_a_no_op():
+    mm = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=1 << 40)
+    assert mm.calibrate({"zero_optimization.stage": 0}, 0) is None
+    assert mm.calibrations == 0 and mm.scale == 1.0
+
+
+def test_snapshot_shape():
+    mm = CalibratedMemoryModel(params_count=N, hbm_limit_bytes=1 << 30,
+                               dp_size=4)
+    snap = mm.snapshot()
+    assert snap["params_count"] == N
+    assert snap["dp_size"] == 4
+    assert snap["scale"] == 1.0
+    assert snap["last_drift_frac"] is None

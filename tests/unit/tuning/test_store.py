@@ -1,0 +1,185 @@
+"""Best-known-config store: round-trip, key discipline, fallback."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.tuning.store import (BestConfigStore, package_store_path,
+                                        resolve_store_path, split_key,
+                                        store_key)
+
+ENTRY = {"overrides": {"train_micro_batch_size_per_gpu": 8,
+                       "zero_optimization.stage": 3},
+         "model_overrides": {"remat": True},
+         "scores": {"tokens_per_sec": 1234.5},
+         "status": "candidate"}
+
+
+def key(fp="fp1", mesh="devices=1", kind="cpu", jv="jax0.4"):
+    return store_key(fp, mesh, kind, jv)
+
+
+def make_store(tmp_path, fallback=None):
+    return BestConfigStore(str(tmp_path / "store.json"), fallback=fallback)
+
+
+def test_round_trip_survives_reload(tmp_path):
+    st = make_store(tmp_path)
+    st.put(key(), dict(ENTRY))
+    re = make_store(tmp_path)
+    got = re.get(key())
+    assert got["overrides"] == ENTRY["overrides"]
+    assert got["model_overrides"] == {"remat": True}
+    assert got["status"] == "candidate"
+    # put stamps provenance + the parsed key parts
+    assert got["provenance"]["created_utc"]
+    assert got["key_parts"]["mesh"] == "devices=1"
+    assert got["key_parts"]["device_kind"] == "cpu"
+
+
+def test_mesh_and_device_kind_never_fall_back(tmp_path):
+    st = make_store(tmp_path)
+    st.put(key(), dict(ENTRY))
+    assert st.lookup("fp1", "devices=4,data=4", "cpu") is None
+    assert st.lookup("fp1", "devices=1", "TPU v5 lite") is None
+    assert st.lookup("other-model", "devices=1", "cpu") is None
+
+
+def test_jax_version_only_mismatch_applies_with_stale_note(tmp_path):
+    st = make_store(tmp_path)
+    st.put(key(jv="jax0.3"), dict(ENTRY))
+    hit = st.lookup("fp1", "devices=1", "cpu", jax_version="jax9.9")
+    assert hit is not None
+    k, entry = hit
+    assert split_key(k)[3] == "jax0.3"
+    assert "tuned under jax0.3" in entry["stale_jax"]
+    assert "running jax9.9" in entry["stale_jax"]
+    # the stored entry itself is NOT annotated (the note is per-lookup)
+    assert "stale_jax" not in st.get(key(jv="jax0.3"))
+
+
+def test_promoted_only_filters_candidates(tmp_path):
+    st = make_store(tmp_path)
+    st.put(key(), dict(ENTRY))
+    assert st.lookup("fp1", "devices=1", "cpu", jax_version="jax0.4",
+                     promoted_only=True) is None
+    st.mark_promoted(key())
+    k, entry = st.lookup("fp1", "devices=1", "cpu", jax_version="jax0.4",
+                         promoted_only=True)
+    assert entry["status"] == "promoted"
+    assert entry["provenance"]["promoted_utc"]
+
+
+def test_fallback_is_read_only_and_promotion_copies(tmp_path):
+    pkg = tmp_path / "pkg.json"
+    pkg.write_text(json.dumps(
+        {"version": 1, "entries": {key(): dict(ENTRY)}}))
+    st = BestConfigStore(str(tmp_path / "user.json"), fallback=str(pkg))
+    assert st.get(key())["overrides"] == ENTRY["overrides"]
+    assert not st.has_local(key())
+    st.mark_promoted(key())
+    # the fallback file is untouched; the writable store owns the copy
+    assert json.loads(pkg.read_text())["entries"][key()]["status"] \
+        == "candidate"
+    assert st.has_local(key())
+    re = BestConfigStore(str(tmp_path / "user.json"), fallback=str(pkg))
+    assert re.get(key())["status"] == "promoted"
+
+
+def test_local_entry_shadows_fallback(tmp_path):
+    pkg = tmp_path / "pkg.json"
+    pkg.write_text(json.dumps({"version": 1, "entries": {
+        key(): {**ENTRY, "scores": {"tokens_per_sec": 1.0}}}}))
+    st = BestConfigStore(str(tmp_path / "user.json"), fallback=str(pkg))
+    st.put(key(), dict(ENTRY))
+    assert st.entries()[key()]["scores"]["tokens_per_sec"] == 1234.5
+
+
+def test_local_candidate_does_not_shadow_promoted_fallback(tmp_path):
+    # a fresh search writing a candidate for the seeded key must not
+    # turn off the shipped known-good config until it is promoted
+    pkg = tmp_path / "pkg.json"
+    pkg.write_text(json.dumps({"version": 1, "entries": {
+        key(): {**ENTRY, "status": "promoted"}}}))
+    st = BestConfigStore(str(tmp_path / "user.json"), fallback=str(pkg))
+    st.put(key(), dict(ENTRY))  # local candidate, same key
+    hit = st.lookup("fp1", "devices=1", "cpu", jax_version="jax0.4",
+                    promoted_only=True)
+    assert hit is not None
+    assert hit[1]["status"] == "promoted"
+    # without promoted_only the local candidate still wins (advisory view)
+    k, e = st.lookup("fp1", "devices=1", "cpu", jax_version="jax0.4")
+    assert e["status"] == "candidate"
+
+
+def test_stale_jax_scan_sees_promoted_fallback_behind_local_candidate(
+        tmp_path):
+    # operator searched on jax0.4 (local candidate), upgraded to jax0.5:
+    # the package's promoted jax0.4 entry must still apply (stale note)
+    pkg = tmp_path / "pkg.json"
+    pkg.write_text(json.dumps({"version": 1, "entries": {
+        key(jv="jax0.4"): {**ENTRY, "status": "promoted"}}}))
+    st = BestConfigStore(str(tmp_path / "user.json"), fallback=str(pkg))
+    st.put(key(jv="jax0.4"), dict(ENTRY))  # local candidate, same key
+    hit = st.lookup("fp1", "devices=1", "cpu", jax_version="jax0.5",
+                    promoted_only=True)
+    assert hit is not None
+    assert hit[1]["status"] == "promoted"
+    assert "tuned under jax0.4" in hit[1]["stale_jax"]
+
+
+def test_save_never_downgrades_a_newer_store_version(tmp_path):
+    p = tmp_path / "store.json"
+    p.write_text(json.dumps({"version": 99, "entries": {}}))
+    st = BestConfigStore(str(p), fallback=None)
+    st.put(key(), dict(ENTRY))
+    assert json.loads(p.read_text())["version"] == 99
+
+
+def test_corrupt_store_treated_as_empty_not_fatal(tmp_path):
+    p = tmp_path / "store.json"
+    p.write_text("{not json")
+    st = BestConfigStore(str(p), fallback=None)
+    assert st.entries() == {}
+    st.put(key(), dict(ENTRY))  # and it heals on the next save
+    assert BestConfigStore(str(p), fallback=None).get(key()) is not None
+
+
+def test_malformed_key_rejected_early(tmp_path):
+    st = make_store(tmp_path)
+    with pytest.raises(ValueError, match="malformed store key"):
+        st.put("no-pipes-here", dict(ENTRY))
+
+
+def test_missing_promotion_target_raises(tmp_path):
+    st = make_store(tmp_path)
+    with pytest.raises(KeyError):
+        st.mark_promoted(key())
+
+
+def test_package_seed_store_parses_and_is_promoted():
+    """The checked-in v5-lite seed must stay loadable: every entry keyed
+    correctly, promoted (initialize() only applies promoted), and
+    provenance-stamped as a seed."""
+    st = BestConfigStore(package_store_path(), fallback=None)
+    entries = st.entries()
+    assert entries, "package store lost its seeds"
+    for k, e in entries.items():
+        fp, mesh, kind, jv = split_key(k)
+        assert e["status"] == "promoted"
+        assert e["overrides"]
+        assert e["provenance"].get("seeded") or e["provenance"].get(
+            "strategy") == "seed"
+    seed_kinds = {split_key(k)[2] for k in entries}
+    assert "TPU v5 lite" in seed_kinds
+
+
+def test_resolve_store_path_precedence(tmp_path, monkeypatch):
+    from deepspeed_tpu.tuning.store import STORE_ENV
+
+    assert resolve_store_path("/x/y.json") == "/x/y.json"
+    monkeypatch.setenv(STORE_ENV, str(tmp_path / "env.json"))
+    assert resolve_store_path("") == str(tmp_path / "env.json")
+    assert resolve_store_path("/x/y.json") == "/x/y.json"  # config wins
+    monkeypatch.delenv(STORE_ENV)
+    assert resolve_store_path("").endswith("best_known_configs.json")

@@ -1,0 +1,252 @@
+"""Trial runners: telemetry-scored real engines, OOM containment,
+device-fenced fallbacks, and the engine's ``trial_run`` hook."""
+
+import pytest
+
+from deepspeed_tpu.tuning import EngineTrialRunner
+from deepspeed_tpu.tuning.space import apply_overrides
+
+
+def test_engine_trial_run_hook_scores_from_telemetry(make_engine,
+                                                     tiny_batch):
+    engine = make_engine()
+    out = engine.trial_run(tiny_batch, warmup_steps=1, timed_steps=3)
+    assert out["source"] == "telemetry"
+    assert out["tokens_per_sec"] > 0
+    assert out["samples_per_sec"] > 0
+    assert out["step_time_p50_ms"] > 0
+    assert out["timed_steps"] == 3
+    # the window's compile cost is visible (first step compiles)
+    assert out["compile_events"] >= 1
+    assert out["compile_s"] >= 0.0
+
+
+def test_engine_runner_builds_and_scores_candidates(tiny_model, tiny_batch,
+                                                    tmp_path):
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    loss_fn, params = tiny_model
+    built = []
+
+    def engine_factory(cfg_dict, model_overrides):
+        built.append((cfg_dict, model_overrides))
+        mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+        engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
+                                    config=cfg_dict, mesh=mesh)
+        return engine
+
+    base = {"train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 0,
+            "telemetry": {"enabled": True,
+                          "output_path": str(tmp_path / "t"),
+                          "flight_recorder": {"install_handlers": False}}}
+    runner = EngineTrialRunner(engine_factory, lambda cfg: tiny_batch, base,
+                               warmup_steps=1)
+    result = runner.run({"train_micro_batch_size_per_gpu": 4,
+                         "model.remat": False}, timed_steps=2)
+    assert result.feasible
+    assert result.source == "telemetry"
+    assert result.metrics["tokens_per_sec"] > 0
+    assert result.timed_steps == 2
+    cfg_dict, model_over = built[0]
+    assert cfg_dict["train_micro_batch_size_per_gpu"] == 4
+    assert model_over == {"remat": False}
+
+
+def test_oom_candidate_is_infeasible_with_breakdown_not_a_crash():
+    def exploding_factory(cfg_dict, model_overrides):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes")
+
+    runner = EngineTrialRunner(exploding_factory, lambda cfg: None, {})
+    result = runner.run({"train_micro_batch_size_per_gpu": 64})
+    assert not result.feasible
+    assert result.oom
+    assert "RESOURCE_EXHAUSTED" in result.error
+    assert isinstance(result.memory, dict)  # breakdown attached (may be {})
+    rec = result.to_record()
+    assert rec["oom"] and not rec["feasible"]
+
+
+def test_non_oom_failure_recorded_without_memory_blame():
+    def broken_factory(cfg_dict, model_overrides):
+        raise ValueError("bad candidate config")
+
+    runner = EngineTrialRunner(broken_factory, lambda cfg: None, {})
+    result = runner.run({"x": 1})
+    assert not result.feasible
+    assert not result.oom
+    assert "bad candidate config" in result.error
+
+
+def test_legacy_engine_falls_back_to_fenced_wall_clock():
+    class FakeEngine:
+        train_batch_size = 4
+        fences = 0
+
+        def train_step(self, batch):
+            return {"loss": _CountingScalar(self)}
+
+    class _CountingScalar:
+        def __init__(self, eng):
+            self.eng = eng
+
+        def __float__(self):
+            self.eng.fences += 1
+            return 0.5
+
+    eng = FakeEngine()
+    runner = EngineTrialRunner(lambda cfg: eng, lambda cfg: None, {},
+                               warmup_steps=1)
+    result = runner.run({}, timed_steps=3)
+    assert result.feasible
+    assert result.source == "wall_clock"
+    assert result.metrics["samples_per_sec"] > 0
+    # fenced per TIMED step (+1 after warmup): queue depth never hides
+    assert eng.fences == 4
+
+
+def test_one_arg_factory_rejects_model_overrides():
+    runner = EngineTrialRunner(lambda cfg: object(), lambda cfg: None, {})
+    result = runner.run({"model.remat": True})
+    assert not result.feasible
+    assert "model overrides" in result.error
+
+
+def test_optional_second_positional_factory_keeps_its_default():
+    # the legacy Autotuner API documents engine_factory(config) — a user
+    # factory with an optional second positional must NOT receive {}
+    seen = []
+
+    class E:
+        train_batch_size = 1
+
+        def train_step(self, batch):
+            return {"loss": 0.0}
+
+    def factory(cfg_dict, model_cls="default-sentinel"):
+        seen.append(model_cls)
+        return E()
+
+    runner = EngineTrialRunner(factory, lambda cfg: None, {},
+                               warmup_steps=0)
+    assert runner.run({"x": 1}).feasible
+    assert seen == ["default-sentinel"]  # not {}
+    # but a REQUIRED two-positional factory still gets the empty dict
+    def factory2(cfg_dict, model_overrides):
+        seen.append(model_overrides)
+        return E()
+
+    runner2 = EngineTrialRunner(factory2, lambda cfg: None, {},
+                                warmup_steps=0)
+    assert runner2.run({"x": 1}).feasible
+    assert seen[-1] == {}
+
+
+def test_teardown_runs_even_on_trial_failure():
+    torn = []
+
+    class FailingEngine:
+        def train_step(self, batch):
+            raise RuntimeError("mid-trial death")
+
+    runner = EngineTrialRunner(lambda cfg: FailingEngine(),
+                               lambda cfg: None, {}, warmup_steps=0,
+                               teardown=lambda e: torn.append(e))
+    result = runner.run({})
+    assert not result.feasible
+    assert len(torn) == 1
+
+
+def test_optional_unrelated_second_positional_never_gets_model_overrides():
+    # (cfg, model_cls=None) is NOT a model-overrides slot — misrouting
+    # the dict there produced confusing TypeErrors deep in the factory
+    def factory(cfg_dict, model_cls=None):
+        raise AssertionError("factory must not be called")
+
+    runner = EngineTrialRunner(factory, lambda cfg: None, {})
+    result = runner.run({"model.remat": True})
+    assert not result.feasible
+    assert "model overrides" in result.error  # the CLEAR error, early
+
+
+def test_wall_clock_fallback_emits_tokens_per_sec():
+    import jax.numpy as jnp
+
+    class E:
+        train_batch_size = 2
+
+        def train_step(self, batch):
+            return {"loss": 0.0}
+
+    runner = EngineTrialRunner(lambda cfg: E(), lambda cfg: jnp.ones((2, 8)),
+                               {}, warmup_steps=0)
+    result = runner.run({}, timed_steps=2)
+    assert result.feasible and result.source == "wall_clock"
+    # the DEFAULT score metric exists, so a search over wall-clock
+    # engines can rank (rows=2, seq=8 from the batch shape)
+    assert result.metrics["tokens_per_sec"] == pytest.approx(
+        8.0 * result.metrics["samples_per_sec"], rel=1e-6)
+
+
+def test_candidate_keyword_factory_sees_tuning_harness_knobs():
+    # tuning.* dims never enter the DS config; a factory that declares
+    # candidate= receives the full candidate to realize them
+    got = {}
+
+    class E:
+        train_batch_size = 1
+
+        def train_step(self, batch):
+            return {"loss": 0.0}
+
+    def factory(cfg_dict, model_overrides, candidate=None):
+        got.update(candidate)
+        return E()
+
+    runner = EngineTrialRunner(factory, lambda cfg: None, {},
+                               warmup_steps=0)
+    result = runner.run({"tuning.mesh_layout": "tp4",
+                         "zero_optimization.stage": 2})
+    assert result.feasible
+    assert got["tuning.mesh_layout"] == "tp4"
+    assert got["zero_optimization.stage"] == 2
+
+
+def test_tuning_prefixed_keys_stay_out_of_ds_config():
+    seen = {}
+
+    class E:
+        train_batch_size = 1
+
+        def train_step(self, batch):
+            return {"loss": 0.0}
+
+    def factory(cfg_dict, model_overrides):
+        seen.update(cfg_dict)
+        return E()
+
+    runner = EngineTrialRunner(factory, lambda cfg: None,
+                               {"zero_optimization": {"stage": 0}},
+                               warmup_steps=0)
+    result = runner.run({"tuning.donate_state": True,
+                         "zero_optimization.stage": 2})
+    assert result.feasible
+    assert seen["zero_optimization"]["stage"] == 2
+    assert "tuning" not in seen  # harness knob, not a DS-config key
+
+
+def test_apply_overrides_respects_nested_paths_and_rejects_scalars():
+    base = {"zero_optimization": {"stage": 0}}
+    out = apply_overrides(base, {"zero_optimization.stage": 3,
+                                 "bf16.enabled": True})
+    assert out["zero_optimization"]["stage"] == 3
+    assert out["bf16"]["enabled"] is True
+    assert base["zero_optimization"]["stage"] == 0  # deep-copied
+    with pytest.raises(ValueError, match="non-object value"):
+        apply_overrides({"a": 5}, {"a.b": 1})
+    with pytest.raises(ValueError, match="model config"):
+        apply_overrides({}, {"model.remat": True})
