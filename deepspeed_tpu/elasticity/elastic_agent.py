@@ -46,7 +46,8 @@ class WorkerSpec:
                  heartbeat_ttl: float = 5.0,
                  checkpoint_dir: Optional[str] = None,
                  restart_backoff_s: float = 1.0,
-                 restart_backoff_max_s: float = 30.0):
+                 restart_backoff_max_s: float = 30.0,
+                 scale_up_settle_s: float = 0.0):
         if (fn is None) == (cmd is None):
             raise ValueError("WorkerSpec needs exactly one of fn= or cmd=")
         self.fn = fn
@@ -64,6 +65,13 @@ class WorkerSpec:
         #: hammer the rendezvous store
         self.restart_backoff_s = float(restart_backoff_s)
         self.restart_backoff_max_s = float(restart_backoff_max_s)
+        #: settle window before re-rendezvousing on a JOIN-driven round
+        #: bump (every previous peer still heartbeating): a flapping
+        #: node that joins/leaves in a tight loop costs the gang at most
+        #: one reshape per window instead of thrashing the mesh.
+        #: Death-driven bumps (stale peers) stay prompt — capacity is
+        #: already lost, waiting only loses more work.
+        self.scale_up_settle_s = float(scale_up_settle_s)
 
 
 class _RestartSignal(Exception):
@@ -98,6 +106,9 @@ class DSElasticAgent:
         self._round = -1
         self._rank = 0
         self._peers: List[str] = []
+        #: world size of the last sealed round — a reseal at a different
+        #: size is a RESHAPE, counted and annotated (origin vs target)
+        self._world = 0
         #: injectable for tests (fake-clock backoff assertions)
         self._sleep: Callable[[float], None] = time.sleep
 
@@ -156,6 +167,38 @@ class DSElasticAgent:
             "elastic/agent_stale_peer_events", v=len(stale),
             help="stale peer heartbeats that triggered an agent restart")
 
+    def _note_reshape(self, round_id: int, world: int) -> None:
+        """A reseal at a DIFFERENT world size is a mesh reshape, not a
+        mere restart: count it (total + direction — the agent-level
+        mirror of the engine's reshard counters, so the two can be
+        cross-checked against an injected chaos schedule) and annotate
+        origin/target topology into the next debug bundle."""
+        prev = self._world
+        self._world = int(world)
+        if not prev or prev == world:
+            return
+        direction = "shrink" if world < prev else "grow"
+        from ..telemetry import get_flight_recorder, get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter(
+            "resilience/reshapes_total",
+            help="snapshots restored onto a DIFFERENT mesh shape "
+                 "(elastic reshard-on-restore)")
+        tel.inc_counter(
+            f"resilience/reshapes_{direction}_total",
+            help="reshard-on-restore restores, by direction (the "
+                 "{direction} breakdown of resilience/reshapes_total)")
+        get_flight_recorder().annotate("reshape", {
+            "direction": direction, "source": "rendezvous",
+            "round": int(round_id),
+            "origin": {"world_size": prev},
+            "target": {"world_size": int(world),
+                       "gang": list(self._peers)}})
+        log_dist(f"elastic agent[{self.node_id}]: mesh RESHAPE "
+                 f"({direction}): world {prev} -> {world} at round "
+                 f"{round_id}")
+
     # -- rendezvous --------------------------------------------------------
 
     def _rendezvous(self) -> None:
@@ -176,6 +219,15 @@ class DSElasticAgent:
             os.environ["COORDINATOR_ADDRESS"] = coord
             os.environ["NUM_PROCESSES"] = str(world)
             os.environ["PROCESS_ID"] = str(rank)
+            # scale-up joiner flag: the worker's resume path reads it to
+            # bootstrap mid-run state from a peer replica instead of
+            # starting at step 0 (cleared for ordinary members so a
+            # stale export never misleads a later attempt)
+            if getattr(self.rdzv, "joined_running", False):
+                os.environ["DS_ELASTIC_JOINED_RUNNING"] = "1"
+            else:
+                os.environ.pop("DS_ELASTIC_JOINED_RUNNING", None)
+            self._note_reshape(r, world)
             log_dist(f"elastic rendezvous: round={r} rank={rank}/{world} "
                      f"coordinator={coord}")
             # per-node heartbeat ages in every future debug bundle: a
@@ -239,6 +291,13 @@ class DSElasticAgent:
                 self._maybe_restart(
                     RuntimeError(f"worker exited with code {e.code}"))
             except Exception as e:  # worker failure → restart or give up
+                from ..resilience.faults import NodeLeaveRequested
+
+                if isinstance(e, NodeLeaveRequested):
+                    # scale-DOWN, not a crash: leave gracefully, bump so
+                    # the survivors reseal at the smaller world, and
+                    # EXIT the supervision loop — this host is done
+                    return self._leave_gang(str(e))
                 self._maybe_restart(e)
 
     def _run_fn(self) -> Any:
@@ -307,6 +366,10 @@ class DSElasticAgent:
         # the ring: the resilience tier-2 buddy lookup and the bundle
         # publisher both key their store slots on it
         env["DS_ELASTIC_NODE_ID"] = self.node_id
+        # lets the node_leave fault signal a GRACEFUL leave via the
+        # well-known exit code instead of an uncatchable raised
+        # exception (which would read as a budgeted crash)
+        env["DS_ELASTIC_SUBPROCESS"] = "1"
         if spec.checkpoint_dir:
             env["DS_ELASTIC_CHECKPOINT_DIR"] = spec.checkpoint_dir
         proc = subprocess.Popen(spec.cmd, env=env)
@@ -316,6 +379,15 @@ class DSElasticAgent:
                 if rc is not None:
                     if rc == 0:
                         return 0
+                    from ..resilience.faults import (NODE_LEAVE_EXIT_CODE,
+                                                     NodeLeaveRequested)
+
+                    if rc == NODE_LEAVE_EXIT_CODE:
+                        # scale-down, not a crash: run() maps this to
+                        # _leave_gang (graceful leave + bump + exit)
+                        raise NodeLeaveRequested(
+                            f"worker exited with the node-leave code "
+                            f"({rc})")
                     if self.rdzv is not None:
                         self.rdzv.bump_round(
                             f"worker on {self.node_id} exited rc={rc}")
@@ -348,11 +420,60 @@ class DSElasticAgent:
                     proc.kill()
                     proc.wait()
 
+    def _leave_gang(self, reason: str) -> Any:
+        """Graceful scale-down exit: mark left (peers must not mistake
+        our silence for a death), bump the round so the survivors reseal
+        at the smaller world NOW (instead of after a heartbeat-ttl
+        grace), and return the last result."""
+        if self.rdzv is not None:
+            try:
+                self.rdzv.leave()
+                self.rdzv.bump_round(
+                    f"node {self.node_id} leaving (scale-down): {reason}")
+            except Exception as e:
+                # the peers' ttl-based stale detection still reseals;
+                # leaving must not crash the leaver
+                debug_once("elastic/leave",
+                           f"graceful leave failed ({e!r}); peers will "
+                           f"notice via heartbeat ttl")
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "elastic/node_leaves_total",
+            help="nodes that left the gang gracefully (scale-down)")
+        log_dist(f"elastic agent[{self.node_id}]: left the gang "
+                 f"({reason}) after {self.restart_count} restart(s)")
+        return self.last_result
+
     def _maybe_restart(self, e: BaseException, announce: bool = True,
                        budgeted: bool = True) -> None:
         spec = self.spec
         self.restart_count += 1
         delay = spec.monitor_interval
+        if not budgeted and spec.scale_up_settle_s > 0:
+            # membership-churn restart: when every previous peer is
+            # still heartbeating AND none left gracefully, the bump was
+            # JOIN-driven — wait the settle window so a flapping node
+            # costs one reshape per window, not one per flap.  A
+            # capacity-LOSS bump (stale peers, or a graceful leaver —
+            # who never goes stale because stale_peers skips left
+            # nodes) keeps the prompt monitor_interval delay.
+            try:
+                stale = (self.rdzv.stale_peers(self._peers,
+                                               spec.heartbeat_ttl)
+                         if self.rdzv is not None else [])
+                stale = stale or (self.rdzv.left_peers(self._peers)
+                                  if self.rdzv is not None else [])
+            except (OSError, ConnectionError):
+                stale = []  # store hiccup — don't stall the re-form
+            if self.rdzv is not None and not stale:
+                delay = max(delay, spec.scale_up_settle_s)
+                from ..telemetry import get_telemetry
+
+                get_telemetry().inc_counter(
+                    "elastic/scale_up_settles_total",
+                    help="join-driven round bumps held for the "
+                         "scale-up settle window")
         if budgeted:
             self.failure_count += 1
             if self.failure_count > spec.max_restarts:
@@ -412,6 +533,11 @@ def cli_main(argv=None) -> int:
     parser.add_argument("--min_nodes", type=int, default=1)
     parser.add_argument("--max_nodes", type=int, default=64)
     parser.add_argument("--node_id", default=None)
+    parser.add_argument("--scale_up_settle", type=float, default=0.0,
+                        help="settle window (s) before re-rendezvousing "
+                             "on a JOIN-driven round bump — a flapping "
+                             "node costs one reshape per window instead "
+                             "of thrashing the mesh")
     parser.add_argument("--subprocess", action="store_true",
                         help="run the script as a supervised subprocess "
                              "(recommended with a rendezvous)")
@@ -437,7 +563,8 @@ def cli_main(argv=None) -> int:
             spec = WorkerSpec(
                 cmd=[sys.executable, args.user_script] + list(args.user_args),
                 max_restarts=args.max_restarts,
-                checkpoint_dir=args.checkpoint_dir)
+                checkpoint_dir=args.checkpoint_dir,
+                scale_up_settle_s=args.scale_up_settle)
             DSElasticAgent(spec).run()
             return 0
 

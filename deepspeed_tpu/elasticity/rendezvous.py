@@ -243,6 +243,12 @@ class ElasticRendezvous:
         # peer that sealed but hasn't beaten yet) and get the same grace as
         # a missing stamp instead of an instant death
         self._round_start: float = 0.0
+        #: latched by next_round when this node had to bump a SEALED
+        #: round to get in — i.e. it is joining a gang that was already
+        #: running (scale-up).  The agent exports it so the worker's
+        #: resume path knows to bootstrap from a peer replica instead of
+        #: starting at step 0.
+        self.joined_running: bool = False
 
     # round bookkeeping keys
     @staticmethod
@@ -286,6 +292,10 @@ class ElasticRendezvous:
     def _next_round_impl(self) -> Tuple[int, int, int, str]:
         deadline = time.monotonic() + self.timeout_s
         my_host = _my_host(self.c._addr)
+        # re-armed per join attempt: a node that ONCE joined mid-run is
+        # not forever a joiner — only this attempt's sealed-round bump
+        # latches it
+        self.joined_running = False
         while True:
             if time.monotonic() >= deadline:
                 raise TimeoutError(
@@ -299,6 +309,12 @@ class ElasticRendezvous:
                 # rejoin immediately — the running peers need a monitor
                 # tick to notice the bump, so our append lands well inside
                 # the new round's settle window
+                sealed = self.c.get(self._sealed_key(r)) or [[]]
+                if self.node_id not in list(sealed[0]):
+                    # joining a gang that was ALREADY running without us:
+                    # latch so the agent/worker resume path knows to
+                    # bootstrap mid-run state instead of step 0
+                    self.joined_running = True
                 self.bump_round(f"node {self.node_id} joining a sealed "
                                 f"round")
                 continue
@@ -465,6 +481,45 @@ class ElasticRendezvous:
                           help="smallest per-host HBM headroom fraction "
                                "(1 - peak/limit)")
         return stats
+
+    def left_peers(self, peer_ids: List[str]) -> List[str]:
+        """Peers that marked a GRACEFUL departure (``leave()``).  The
+        agent's settle-window classifier needs this: a leaver never goes
+        stale (``stale_peers`` skips left nodes by design), but its bump
+        is still a capacity LOSS — survivors must re-form promptly, not
+        wait out the scale-up settle window."""
+        return [pid for pid in peer_ids
+                if pid != self.node_id
+                and bool(self.c.get(f"rdzv/left/{pid}"))]
+
+    def sealed_ring(self, r: Optional[int] = None) -> List[str]:
+        """The FROZEN gang of round ``r`` (default: current round) —
+        empty when that round never sealed.  Sealed keys are never
+        deleted, so the ring history survives in the store for
+        :meth:`ring_diff` to walk."""
+        if r is None:
+            r = self.current_round()
+        sealed = self.c.get(self._sealed_key(int(r)))
+        return list(sealed[0]) if sealed else []
+
+    def ring_diff(self, lookback: int = 50) -> Dict[str, Any]:
+        """Diff the CURRENT sealed ring against the most recent
+        PREVIOUS sealed round (scanning back up to ``lookback`` rounds —
+        churn bumps rounds without sealing them, so r-1 is often empty).
+        Returns ``{round, prev_round, cur, prev, joined, left}`` — the
+        replacement-node adoption path reads ``left`` (dead peers whose
+        tier-2 replicas are orphaned) and ``joined`` (who adopts)."""
+        r = self.current_round()
+        cur = self.sealed_ring(r)
+        for p in range(r - 1, max(-1, r - 1 - int(lookback)), -1):
+            prev = self.sealed_ring(p)
+            if prev:
+                return {"round": r, "prev_round": p, "cur": cur,
+                        "prev": prev,
+                        "joined": [n for n in cur if n not in prev],
+                        "left": [n for n in prev if n not in cur]}
+        return {"round": r, "prev_round": None, "cur": cur, "prev": [],
+                "joined": list(cur), "left": []}
 
     def buddy(self) -> Optional[str]:
         """This node's snapshot buddy: the NEXT node id in the current

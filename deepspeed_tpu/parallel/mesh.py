@@ -103,6 +103,31 @@ class MeshLayout:
         return layout
 
 
+def mesh_topology(mesh: Mesh) -> Dict[str, object]:
+    """JSON-able description of a mesh's topology — stamped into every
+    snapshot manifest (resilience reshard-on-restore keys its
+    compatibility check on this) and into reshape annotations.
+
+    ``host_coverage`` records whether a single process can see the whole
+    state ("full": single-controller, device_get returns global arrays)
+    or only its own shards ("partial": multi-controller — a snapshot
+    taken there cannot serve a different shape without every origin
+    host's shards).
+    """
+    devs = np.asarray(mesh.devices).ravel()
+    kind = str(getattr(devs[0], "device_kind", "unknown")) if len(devs) \
+        else "unknown"
+    procs = int(jax.process_count())
+    return {
+        "axes": {str(a): int(s) for a, s in mesh.shape.items()},
+        "world_size": int(devs.size),
+        "device_kind": kind,
+        "num_processes": procs,
+        "process_index": int(jax.process_index()),
+        "host_coverage": "full" if procs == 1 else "partial",
+    }
+
+
 def build_mesh(layout: Optional[MeshLayout] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the global Mesh with the canonical axis order.

@@ -19,21 +19,28 @@ Operator CLI: ``python -m deepspeed_tpu.resilience {ls,verify}``.
 """
 
 from .faults import (Fault, FaultInjector, InjectedFault,
-                     corrupt_newest_snapshot, parse_fault, parse_faults)
+                     NodeLeaveRequested, corrupt_newest_snapshot,
+                     corrupt_tier0_snapshot, corrupt_tier2_replica,
+                     parse_fault, parse_faults)
 from .policy import (RecoveryPolicy, ResilienceGiveUp, ST_GAVE_UP,
                      ST_RECOVERING, ST_RUNNING)
-from .snapshot import (Snapshot, SnapshotManager, SnapshotUnsupportedError,
+from .snapshot import (MeshMismatchError, Snapshot, SnapshotManager,
+                       SnapshotUnsupportedError, adopt_orphaned_replica,
+                       bootstrap_from_peer_replica, check_reshardable,
                        check_snapshot_support, choose_resume_snapshot,
-                       fetch_buddy_snapshot, list_snapshots,
-                       replicate_snapshot, verify_snapshot)
+                       fetch_buddy_snapshot, format_topology,
+                       list_snapshots, replicate_snapshot, verify_snapshot)
 
 __all__ = [
     "Snapshot", "SnapshotManager", "SnapshotUnsupportedError",
+    "MeshMismatchError", "check_reshardable", "format_topology",
     "check_snapshot_support", "choose_resume_snapshot",
+    "adopt_orphaned_replica", "bootstrap_from_peer_replica",
     "list_snapshots", "verify_snapshot", "replicate_snapshot",
     "fetch_buddy_snapshot",
     "RecoveryPolicy", "ResilienceGiveUp",
     "ST_RUNNING", "ST_RECOVERING", "ST_GAVE_UP",
-    "Fault", "FaultInjector", "InjectedFault", "parse_fault",
-    "parse_faults", "corrupt_newest_snapshot",
+    "Fault", "FaultInjector", "InjectedFault", "NodeLeaveRequested",
+    "parse_fault", "parse_faults", "corrupt_newest_snapshot",
+    "corrupt_tier0_snapshot", "corrupt_tier2_replica",
 ]

@@ -3,12 +3,17 @@
 The 3am read side of the resilience plane:
 
 * ``ls <dir>``      — inventory the snapshot dir: tag, step, age,
-  bytes, and whether each snapshot passes the checksum gate.
+  bytes, ORIGIN MESH (world@device [axes] from the manifest's topology
+  stamp), and whether each snapshot passes the checksum gate.
 * ``verify <path>`` — full integrity check of one snapshot dir, or of
   every snapshot under a root dir.  Exit codes are scriptable: 0 when
   the NEWEST snapshot is valid, 3 when the newest is corrupt but an
   older valid one exists (a resume would silently lose extra steps —
-  worth an alert), 4 when nothing restorable remains.
+  worth an alert), 4 when nothing restorable remains.  With
+  ``--target-mesh AxB`` the reshardability pre-check answers "can I
+  resume this on that mesh?" OFFLINE — both topologies, the per-tier
+  verdict, and the recorded state leaves' layout at the target dp —
+  exit 3 when incompatible.
 
 Both commands are plain-directory reads — no store, no engine, no
 device needed beyond importing the package.
@@ -20,14 +25,64 @@ import argparse
 import os
 import sys
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
-from .snapshot import list_snapshots, verify_snapshot
+from .snapshot import (check_reshardable, format_topology, list_snapshots,
+                       read_snapshot_manifest, reshard_tier_report,
+                       verify_snapshot)
 
 
 def _fail(msg: str) -> int:
     print(f"error: {msg}", file=sys.stderr)
     return 2
+
+
+def parse_target_mesh(spec: str) -> Dict[str, Any]:
+    """``--target-mesh`` grammar → a target topology dict.  Accepts
+    ``N`` (pure-DP world of N), ``AxB`` (data=A, tensor=B), or five
+    ``x``-separated sizes in mesh axis order (pipe, expert, data, seq,
+    tensor).  Raises ValueError on anything else."""
+    from ..parallel.mesh import MESH_AXIS_ORDER
+
+    try:
+        dims = [int(d) for d in spec.lower().split("x")]
+    except ValueError:
+        raise ValueError(f"--target-mesh {spec!r}: expected N, AxB, or "
+                         f"five x-separated axis sizes")
+    if any(d < 1 for d in dims):
+        raise ValueError(f"--target-mesh {spec!r}: axis sizes must be >= 1")
+    if len(dims) == 1:
+        axes = {"pipe": 1, "expert": 1, "data": dims[0], "seq": 1,
+                "tensor": 1}
+    elif len(dims) == 2:
+        axes = {"pipe": 1, "expert": 1, "data": dims[0], "seq": 1,
+                "tensor": dims[1]}
+    elif len(dims) == len(MESH_AXIS_ORDER):
+        axes = {a: d for a, d in zip(MESH_AXIS_ORDER, dims)}
+    else:
+        raise ValueError(f"--target-mesh {spec!r}: give 1, 2, or "
+                         f"{len(MESH_AXIS_ORDER)} axis sizes")
+    world = 1
+    for d in axes.values():
+        world *= d
+    return {"axes": axes, "world_size": world, "host_coverage": "full",
+            "device_kind": "<target>"}
+
+
+def _mesh_column(path: str) -> str:
+    """Compact origin-mesh cell for ``ls``: ``world@kind axes`` — or
+    ``-`` for pre-reshard snapshots with no stamp."""
+    try:
+        meta = read_snapshot_manifest(path).get("meta") or {}
+    except Exception:
+        return "-"
+    topo = meta.get("mesh")
+    if not isinstance(topo, dict):
+        return "-"
+    axes = topo.get("axes") or {}
+    ax = "x".join(str(s) for s in axes.values()) or "?"
+    return (f"{topo.get('world_size', '?')}@"
+            f"{topo.get('device_kind', '?')} [{ax}]")
 
 
 def _dir_bytes(path: str) -> int:
@@ -53,23 +108,60 @@ def cmd_ls(args: argparse.Namespace) -> int:
         print(f"no committed snapshots under {args.dir}")
         return 0
     now = time.time()
-    print(f"{'TAG':<24} {'STEP':>8} {'AGE':>10} {'SIZE':>10}  STATUS")
+    print(f"{'TAG':<24} {'STEP':>8} {'AGE':>10} {'SIZE':>10} "
+          f"{'MESH':<20}  STATUS")
     for entry in snaps:
         ok, detail = verify_snapshot(entry["path"])
         age = now - float(entry.get("ts") or now)
         size = _dir_bytes(entry["path"])
         status = "valid" if ok else f"CORRUPT — {detail}"
         print(f"{entry['tag']:<24} {entry['step']:>8} "
-              f"{age:>9.0f}s {size / 2**20:>9.1f}M  {status}")
+              f"{age:>9.0f}s {size / 2**20:>9.1f}M "
+              f"{_mesh_column(entry['path']):<20}  {status}")
+    return 0
+
+
+def _check_target_mesh(path: str, target: Dict[str, Any]) -> int:
+    """The offline reshardability pre-check ("can I resume this on 3
+    hosts?" without starting an engine): exit 0 compatible, 3 not."""
+    meta = read_snapshot_manifest(path).get("meta") or {}
+    origin = meta.get("mesh")
+    ok, reason = check_reshardable(meta, target)
+    print(f"origin: {format_topology(origin)}")
+    print(f"target: {format_topology(target)}")
+    print(f"reshardable: {'YES' if ok else 'NO'} — {reason}")
+    if not ok:
+        for tier, verdict in reshard_tier_report(meta, target).items():
+            print(f"  {tier}: {verdict}")
+        return 3
+    shapes = meta.get("state_shapes")
+    if shapes:
+        from ..runtime.zero.sharder import reshard_layout_report
+
+        axes = target.get("axes") or {}
+        dp = int(axes.get("data", 1)) * int(axes.get("expert", 1))
+        rep = reshard_layout_report(shapes, dp)
+        print(f"layout at dp={dp}: {rep['sharded_count']} leaves "
+              f"DP-shard, {rep['replicated_count']} replicate")
+        for name in rep["replicated"][:8]:
+            print(f"  replicated: {name}")
     return 0
 
 
 def cmd_verify(args: argparse.Namespace) -> int:
     path = args.path
+    target = None
+    if getattr(args, "target_mesh", None):
+        try:
+            target = parse_target_mesh(args.target_mesh)
+        except ValueError as e:
+            return _fail(str(e))
     if _is_snapshot(path):
         ok, detail = verify_snapshot(path)
         print(f"{path}: {'valid' if ok else 'CORRUPT'} — {detail}")
-        return 0 if ok else 4
+        if not ok:
+            return 4
+        return _check_target_mesh(path, target) if target else 0
     if not os.path.isdir(path):
         return _fail(f"{path}: not a snapshot dir or snapshot root")
     snaps = list_snapshots(path)
@@ -82,6 +174,9 @@ def cmd_verify(args: argparse.Namespace) -> int:
     newest_ok = results[0][1]
     any_ok = any(ok for _e, ok, _d in results)
     if newest_ok:
+        if target:
+            # the pre-check answers for the snapshot a resume would pick
+            return _check_target_mesh(results[0][0]["path"], target)
         return 0
     if any_ok:
         print("WARNING: newest snapshot is corrupt; a resume would fall "
@@ -106,8 +201,14 @@ def build_parser() -> argparse.ArgumentParser:
     v = sub.add_parser("verify",
                        help="checksum-verify one snapshot or a whole "
                             "snapshot dir (exit 0 newest-valid / 3 "
-                            "fallback-only / 4 none)")
+                            "fallback-only-or-incompatible / 4 none)")
     v.add_argument("path")
+    v.add_argument("--target-mesh", default=None,
+                   help="pre-check reshardability onto a target mesh "
+                        "WITHOUT starting an engine: N (pure-DP world), "
+                        "AxB (data x tensor), or five x-separated axis "
+                        "sizes (pipe x expert x data x seq x tensor); "
+                        "exit 3 when the snapshot cannot serve it")
     v.set_defaults(fn=cmd_verify)
     return p
 

@@ -14,9 +14,14 @@ Spec grammar (comma-free ``kind@step[:key=value,...]``)::
     nan_loss@64                  # poison step 64's batch with NaN
     stall@32:seconds=90          # stall the step path (watchdog food)
     corrupt_snapshot@40          # flip bytes in the newest tier-1 snap
+    corrupt_snapshot@40:tier=0,buffers=all  # poison tier-0 host buffers
+    corrupt_snapshot@40:tier=2   # garble the tier-2 buddy replica
+    node_leave@200               # this host LEAVES the gang (scale-down)
+    node_join@200:delay_s=5      # a host joins (harness cb / round bump)
 
 Faults fire ONCE (per process) at the step they name; ``rank=`` guards
-restrict kill faults to one worker.  Every firing lands in telemetry
+restrict kill/leave/join faults to one worker.  Every firing lands in
+telemetry
 (``resilience/faults_injected_total``) and the flight recorder, so a
 chaos run's debug bundle says what was injected, where.
 """
@@ -29,12 +34,29 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.logging import logger
 
-KINDS = ("kill_rank", "kill", "nan_loss", "stall", "corrupt_snapshot")
+KINDS = ("kill_rank", "kill", "nan_loss", "stall", "corrupt_snapshot",
+         "node_leave", "node_join")
 
 
 class InjectedFault(RuntimeError):
     """A kill fault fired in ``raise`` mode — the supervisor (elastic
     agent) sees a worker failure exactly as it would a real crash."""
+
+
+#: exit code a SUBPROCESS worker uses to signal a graceful node leave —
+#: a typed exception cannot cross the process boundary, so the agent's
+#: _run_subprocess maps this code back to NodeLeaveRequested instead of
+#: a budgeted crash-restart (which would replay the run, re-fire the
+#: fault, and burn the whole restart budget on a deliberate scale-down)
+NODE_LEAVE_EXIT_CODE = 114
+
+
+class NodeLeaveRequested(Exception):
+    """A ``node_leave`` fault fired: this host is LEAVING the gang
+    permanently (scale-down chaos), not crashing.  The elastic agent
+    catches it, leaves the rendezvous gracefully, bumps the round so the
+    survivors reseal at the smaller world, and exits its supervision
+    loop instead of restarting."""
 
 
 class Fault:
@@ -102,6 +124,18 @@ class FaultInjector:
         self.recorder = recorder
         self._sleep = sleep
         self.injected = 0
+        #: ``node_join`` harness hook: cb(delay_s) launches the joining
+        #: node (a chaos-test thread, an operator script).  Without one
+        #: the fault falls back to bumping the rendezvous round after
+        #: ``delay_s`` — to the running gang a join ATTEMPT and a flap
+        #: look identical (a reseal), which is exactly what the settle
+        #: window chaos tests need.
+        self._node_join_cb: Optional[Any] = None
+
+    def on_node_join(self, cb: Any) -> None:
+        """Register the ``node_join`` callback: ``cb(delay_s)`` runs on
+        a daemon timer thread when the fault fires."""
+        self._node_join_cb = cb
 
     @classmethod
     def from_config(cls, rcfg: Any, recorder: Any = None
@@ -154,11 +188,12 @@ class FaultInjector:
         for fault in self.faults:
             if fault.fired or fault.step != step:
                 continue
-            if fault.kind == "kill_rank":
+            if fault.kind in ("kill_rank", "node_leave", "node_join"):
                 want = fault.params.get("rank")
                 if want is not None and int(want) != self.rank():
                     fault.fired = True  # this step is this fault's only shot
                     continue
+            if fault.kind == "kill_rank":
                 self._record(fault)
                 if fault.params.get("mode", "raise") == "exit":
                     # a real SIGKILL-ish death: no cleanup, exit code 113
@@ -167,22 +202,103 @@ class FaultInjector:
                 raise InjectedFault(
                     f"injected worker death at step {step} "
                     f"(rank {self.rank()})")
+            if fault.kind == "node_leave":
+                self._record(fault)
+                if os.environ.get("DS_ELASTIC_SUBPROCESS") == "1":
+                    # supervised subprocess: a raised exception would
+                    # surface as exit code 1 (a budgeted failure) — use
+                    # the well-known leave code the agent maps back
+                    os._exit(NODE_LEAVE_EXIT_CODE)
+                raise NodeLeaveRequested(
+                    f"injected node leave at step {step} "
+                    f"(rank {self.rank()})")
             if fault.kind == "stall":
                 self._record(fault)
                 self._sleep(float(fault.params.get("seconds", 60.0)))
             elif fault.kind == "nan_loss":
                 self._record(fault)
                 batch = _poison_batch(batch)
+            elif fault.kind == "node_join":
+                self._record(fault)
+                self._fire_node_join(
+                    float(fault.params.get("delay_s", 0.0)), engine)
             elif fault.kind == "corrupt_snapshot":
                 self._record(fault)
-                snap_dir = None
-                if engine is not None and getattr(engine, "snapshots",
-                                                  None) is not None:
-                    engine.snapshots.wait()  # corrupt a COMMITTED flush
-                    snap_dir = engine.snapshots.snapshot_dir
-                corrupt_newest_snapshot(
-                    fault.params.get("dir") or snap_dir or "")
+                self._fire_corrupt_snapshot(fault, engine)
         return batch
+
+    def _fire_node_join(self, delay_s: float, engine: Any) -> None:
+        """Launch the join after ``delay_s`` on a daemon timer: the
+        registered harness callback when present, else a rendezvous
+        round bump through the engine's attached store client (a join
+        attempt IS a reseal to the running gang)."""
+        import threading
+
+        cb = self._node_join_cb
+        rdzv = None
+        if cb is None:
+            snaps = getattr(engine, "snapshots", None) \
+                if engine is not None else None
+            rdzv = getattr(snaps, "_rdzv", None) if snaps else None
+            if rdzv is None:
+                logger.warning(
+                    "fault injection: node_join fired but no harness "
+                    "callback is registered (FaultInjector.on_node_join) "
+                    "and the engine has no rendezvous — fault had no "
+                    "effect")
+                return
+
+        def fire():
+            try:
+                if cb is not None:
+                    cb(delay_s)
+                else:
+                    rdzv.bump_round("injected node_join")
+            except Exception as e:
+                logger.warning(f"fault injection: node_join action "
+                               f"failed: {e!r}")
+
+        t = threading.Timer(max(delay_s, 0.0), fire)
+        t.daemon = True
+        t.start()
+
+    def _fire_corrupt_snapshot(self, fault: Fault, engine: Any) -> None:
+        """``corrupt_snapshot[:tier=0|1|2]`` — tier 1 (default) flips
+        bytes in the newest committed flush; tier 0 poisons the newest
+        in-memory buffer (the capture the next rollback restores first);
+        tier 2 garbles the buddy replica in the store.  Together the
+        three tiers prove the checksum/health-gated 0→1→2 fallback
+        chain end to end."""
+        tier = str(fault.params.get("tier", "1"))
+        snaps = getattr(engine, "snapshots", None) if engine is not None \
+            else None
+        if tier == "0":
+            if snaps is None:
+                logger.warning("fault injection: corrupt_snapshot tier=0 "
+                               "needs a live engine with snapshots — "
+                               "fault had no effect")
+                return
+            corrupt_tier0_snapshot(
+                snaps,
+                all_buffers=fault.params.get("buffers") == "all")
+            return
+        if tier == "2":
+            rdzv = getattr(snaps, "_rdzv", None) if snaps else None
+            if rdzv is None:
+                logger.warning("fault injection: corrupt_snapshot tier=2 "
+                               "needs an attached rendezvous (buddy "
+                               "tier) — fault had no effect")
+                return
+            if snaps is not None:
+                snaps.wait()  # corrupt a COMMITTED replication
+            corrupt_tier2_replica(rdzv.c,
+                                  fault.params.get("node") or rdzv.node_id)
+            return
+        snap_dir = None
+        if snaps is not None:
+            snaps.wait()  # corrupt a COMMITTED flush
+            snap_dir = snaps.snapshot_dir
+        corrupt_newest_snapshot(fault.params.get("dir") or snap_dir or "")
 
 
 def _poison_batch(batch: Any) -> Any:
@@ -201,6 +317,74 @@ def _poison_batch(batch: Any) -> Any:
     logger.warning("fault injection: nan_loss found no floating batch "
                    "leaf to poison — fault had no effect")
     return batch
+
+
+def corrupt_tier0_snapshot(snapshots: Any,
+                           all_buffers: bool = False) -> bool:
+    """Poison tier-0 host buffers IN PLACE (NaN every floating leaf —
+    params included, so the restored state is guaranteed
+    un-trainable); ``all_buffers`` poisons BOTH double-buffer slots so
+    a chaos run proves the full tier-0 -> tier-1 fallback.  Tier 0 has
+    no checksum — the policy's unproven-restore machinery is the gate:
+    a poisoned restore fails its first step, the buffer is discarded,
+    and the NEXT rollback digs deeper.  Returns True when a buffer was
+    poisoned."""
+    import numpy as _np
+
+    targets = snapshots.buffered() if all_buffers else \
+        [snapshots.latest()]
+    targets = [s for s in targets if s is not None]
+    if not targets:
+        logger.warning("fault injection: no tier-0 snapshot buffer to "
+                       "corrupt — fault had no effect")
+        return False
+    import jax
+
+    poisoned = 0
+
+    def poison(leaf):
+        nonlocal poisoned
+        arr = _np.asarray(leaf)
+        if _np.issubdtype(arr.dtype, _np.floating) and arr.size:
+            poisoned += 1
+            return _np.full_like(arr, _np.nan)  # device_get arrays can
+        return leaf                             # be read-only: rebuild
+
+    for snap in targets:
+        snap.state = jax.tree.map(poison, snap.state)
+    if poisoned:
+        logger.warning(
+            f"fault injection: poisoned {len(targets)} tier-0 buffer(s) "
+            f"(newest step {targets[0].global_steps}, {poisoned} leaves)")
+        return True
+    logger.warning("fault injection: tier-0 buffer has no floating leaf "
+                   "to poison — fault had no effect")
+    return False
+
+
+def corrupt_tier2_replica(client: Any, node_id: str) -> bool:
+    """Garble ``node_id``'s tier-2 replica in the rendezvous store: the
+    first payload chunk is replaced with same-length garbage base64, so
+    the fetch-side untar fails loudly and the resume path falls back
+    cleanly (tier-2 is the LAST tier — a corrupt replica means 'no
+    snapshot', never a crash).  Returns True when a replica existed."""
+    import base64
+
+    from .snapshot import RESIL_CHUNK_PREFIX, RESIL_META_KEY
+
+    meta = client.get(RESIL_META_KEY.format(node=node_id))
+    if not isinstance(meta, dict):
+        logger.warning(f"fault injection: node {node_id!r} has no tier-2 "
+                       f"replica in the store to corrupt")
+        return False
+    key = RESIL_CHUNK_PREFIX.format(node=node_id) + "/0"
+    chunk = client.get(key) or ""
+    garbage = base64.b64encode(os.urandom(max(len(chunk) // 2, 16))
+                               ).decode("ascii")
+    client.set(key, garbage)
+    logger.warning(f"fault injection: corrupted tier-2 replica of "
+                   f"{node_id!r} (chunk 0)")
+    return True
 
 
 def corrupt_newest_snapshot(snapshot_dir: str) -> Optional[str]:

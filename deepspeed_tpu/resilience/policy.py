@@ -251,21 +251,31 @@ class RecoveryPolicy:
     def _choose_disk_snapshot(self) -> Optional[str]:
         self.snapshots.wait()  # join any in-flight flush first
         rdzv = self.snapshots._rdzv
+        # rdzv unlocks the replacement-node fallbacks (adopt a dead
+        # peer's orphaned replica via the sealed-ring diff, bootstrap a
+        # scale-up joiner from a live peer) — the adopted snapshot lands
+        # in the local dir, so the policy treats it exactly as local
         return choose_resume_snapshot(
             self.snapshots.snapshot_dir,
             client=getattr(rdzv, "c", None),
-            node_id=getattr(rdzv, "node_id", None))
+            node_id=getattr(rdzv, "node_id", None),
+            rdzv=rdzv if hasattr(rdzv, "ring_diff") else None)
 
     # -- restart/resume path ------------------------------------------------
 
     def resume_if_restarted(self, force: bool = False) -> Optional[str]:
         """Entry-point hook for the elastic restart path: when this
         worker is a RESTART (``DS_ELASTIC_RESTART_COUNT`` > 0, exported
-        by the agent) — or ``force`` — load the policy-chosen newest
-        VALID snapshot from disk (buddy fallback included) and resume.
-        Returns the snapshot path used, or None (fresh start)."""
+        by the agent), a scale-up JOINER into a running gang
+        (``DS_ELASTIC_JOINED_RUNNING``, exported when the rendezvous had
+        to bump a sealed round to admit us) — or ``force`` — load the
+        policy-chosen newest VALID snapshot from disk (buddy/adoption/
+        bootstrap fallbacks included) and resume.  The load path
+        reshards a snapshot taken on a different mesh onto the current
+        one.  Returns the snapshot path used, or None (fresh start)."""
         restarts = int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0") or 0)
-        if not (force or restarts > 0):
+        joined = os.environ.get("DS_ELASTIC_JOINED_RUNNING", "") == "1"
+        if not (force or restarts > 0 or joined):
             return None
         path = self._choose_disk_snapshot()
         if path is None:

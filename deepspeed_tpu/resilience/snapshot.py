@@ -91,6 +91,98 @@ RESIL_META_KEY = "resil/pub/{node}"
 RESIL_CHUNK_PREFIX = "resil/chunk/{node}"
 
 
+# ---------------------------------------------------------------------------
+# mesh-elastic recovery: origin-topology stamping + reshard compatibility
+# ---------------------------------------------------------------------------
+
+def format_topology(topo: Optional[Dict[str, Any]]) -> str:
+    """One-line human form of a :func:`~..parallel.mesh.mesh_topology`
+    dict, used by :class:`MeshMismatchError` and the operator CLI."""
+    if not isinstance(topo, dict):
+        return "<unknown mesh>"
+    axes = topo.get("axes") or {}
+    ax = ",".join(f"{a}={s}" for a, s in axes.items()) or "shape unknown"
+    return (f"world={topo.get('world_size', '?')} mesh({ax}) "
+            f"device={topo.get('device_kind', '?')} "
+            f"processes={topo.get('num_processes', '?')} "
+            f"coverage={topo.get('host_coverage', '?')}")
+
+
+class MeshMismatchError(RuntimeError):
+    """A snapshot taken on mesh A cannot serve the engine's current mesh
+    B.  Carries both topologies and a per-tier reshardability verdict so
+    the 3am operator (and the ``verify --target-mesh`` pre-check) can
+    read exactly WHY instead of a device_put shape error deep in
+    restore."""
+
+    def __init__(self, origin: Optional[Dict[str, Any]],
+                 target: Optional[Dict[str, Any]], reason: str,
+                 tiers: Optional[Dict[str, str]] = None):
+        self.origin = origin
+        self.target = target
+        self.reason = reason
+        self.tiers = tiers or {}
+        tier_s = ("; tiers: " + ", ".join(
+            f"{t}: {v}" for t, v in self.tiers.items())) if self.tiers \
+            else ""
+        super().__init__(
+            f"snapshot mesh mismatch — origin {format_topology(origin)} "
+            f"cannot serve target {format_topology(target)}: "
+            f"{reason}{tier_s}")
+
+
+def check_reshardable(meta: Dict[str, Any],
+                      target: Dict[str, Any]) -> Tuple[bool, str]:
+    """Can a snapshot whose manifest ``meta`` names its origin mesh be
+    re-laid onto ``target``?  Returns ``(ok, reason)``.
+
+    The state tree a snapshot holds is the GLOBAL logical tree (ZeRO
+    shards via shardings, never by reshaping leaves), so resharding is a
+    ``device_put`` onto the target's shardings — UNLESS
+
+    * the origin capture only covered this host's shards
+      (multi-controller ``host_coverage == "partial"``), or
+    * part of the state is shaped BY the world size (the 1-bit
+      error-feedback residuals are ``[dp_world, ...]`` per leaf).
+    """
+    origin = meta.get("mesh") if isinstance(meta.get("mesh"), dict) \
+        else None
+    if origin is None:
+        return True, ("origin topology unknown (pre-reshard snapshot) — "
+                      "proceeding as a same-mesh restore")
+    same = (origin.get("axes") == target.get("axes")
+            and origin.get("world_size") == target.get("world_size"))
+    if same:
+        return True, "identical topology"
+    if origin.get("host_coverage") == "partial":
+        return False, (
+            f"origin snapshot covers only process "
+            f"{origin.get('process_index')}'s shards "
+            f"({origin.get('num_processes')} origin processes) — a "
+            f"different shape needs every origin host's shards")
+    baked = meta.get("world_baked_state") or []
+    if baked:
+        return False, (
+            "state leaves are shaped by the origin world size and cannot "
+            "be re-laid: " + "; ".join(baked))
+    return True, ("global state tree reshards via device_put onto the "
+                  "target mesh's shardings")
+
+
+def reshard_tier_report(meta: Dict[str, Any],
+                        target: Dict[str, Any]) -> Dict[str, str]:
+    """Per-tier verdict for :class:`MeshMismatchError` / the CLI: which
+    tiers could serve ``target``.  Tier 0/2 hold the same host tree as
+    tier 1, so reshardability is uniform — EXCEPT partial coverage,
+    where tier 1's per-host trees are exactly the shards that are
+    missing."""
+    ok, reason = check_reshardable(meta, target)
+    verdict = "reshardable" if ok else f"NOT reshardable ({reason})"
+    return {"tier0 (host memory)": verdict,
+            "tier1 (local disk)": verdict,
+            "tier2 (buddy replica)": verdict}
+
+
 class Snapshot:
     """One tier-0 capture: the host-side state tree + JSON-able meta."""
 
@@ -185,7 +277,51 @@ class SnapshotManager:
                 "numpy_global": pickle.dumps(np.random.get_state()).hex(),
             },
             "extras": extras,
+            **self._origin_meta(),
         }
+
+    def _origin_meta(self) -> Dict[str, Any]:
+        """Origin-topology stamp (mesh-elastic recovery): every snapshot
+        records the mesh it was taken on, the jax version, the resolved
+        global batch, the state leaf layout, and any world-size-baked
+        state — everything :func:`check_reshardable` and the offline
+        ``verify --target-mesh`` pre-check need."""
+        import jax
+
+        from ..parallel.mesh import mesh_topology
+
+        eng = self.engine
+        out: Dict[str, Any] = {"jax_version": str(jax.__version__)}
+        try:
+            out["mesh"] = (eng.mesh_topology()
+                           if hasattr(eng, "mesh_topology")
+                           else mesh_topology(eng.mesh))
+        except Exception as e:  # a stamp failure must not lose the snapshot
+            logger.warning(f"resilience: mesh topology stamp failed: {e!r}")
+            return out
+        tb = getattr(eng, "train_batch_size", None)
+        if tb:
+            out["train_batch_size"] = int(tb)
+        baked = []
+        comm_leaves = jax.tree.leaves(
+            getattr(eng.state, "comm_state", ()) or ())
+        if comm_leaves:
+            baked.append(
+                "comm_state: 1-bit error-feedback residuals shaped "
+                f"[dp_world={np.shape(comm_leaves[0])[0]}, ...] — baked "
+                "to the origin DP world")
+        out["world_baked_state"] = baked
+        # per-leaf (path, shape) inventory: lets the CLI answer "can I
+        # resume this on 3 hosts, and which leaves would still shard?"
+        # without loading a single byte of state
+        try:
+            paths = jax.tree_util.tree_flatten_with_path(eng.state)[0]
+            out["state_shapes"] = [
+                [jax.tree_util.keystr(kp), list(np.shape(leaf))]
+                for kp, leaf in paths]
+        except Exception as e:
+            logger.warning(f"resilience: state shape stamp failed: {e!r}")
+        return out
 
     def take(self, emergency: bool = False) -> Snapshot:
         """Capture tier 0 NOW (device→host copy of the full state) and,
@@ -422,16 +558,91 @@ class SnapshotManager:
 
     # -- restore -----------------------------------------------------------
 
+    def _reshard_guard(self, meta: Dict[str, Any],
+                       source: str) -> Optional[Dict[str, Any]]:
+        """Mesh-elastic restore gate: compare the snapshot's origin
+        topology against the engine's CURRENT mesh.  Same mesh → None
+        (the ordinary restore).  Different but reshardable → a reshape
+        info dict (origin/target/direction) the caller accounts after
+        the re-lay succeeds.  Not reshardable → a descriptive
+        :class:`MeshMismatchError` naming both topologies and the
+        per-tier verdict, instead of an opaque device_put error deep in
+        the load."""
+        from ..parallel.mesh import mesh_topology
+
+        eng = self.engine
+        target = (eng.mesh_topology() if hasattr(eng, "mesh_topology")
+                  else mesh_topology(eng.mesh))
+        origin = meta.get("mesh") if isinstance(meta.get("mesh"), dict) \
+            else None
+        if origin is None:
+            return None  # pre-reshard snapshot: same-mesh semantics
+        if (origin.get("axes") == target.get("axes")
+                and origin.get("world_size") == target.get("world_size")):
+            return None
+        ok, reason = check_reshardable(meta, target)
+        if not ok:
+            raise MeshMismatchError(origin, target, reason,
+                                    tiers=reshard_tier_report(meta, target))
+        o_w, t_w = int(origin["world_size"]), int(target["world_size"])
+        direction = "shrink" if t_w < o_w else "grow"
+        logger.warning(
+            f"resilience: resharding {source} snapshot taken on "
+            f"[{format_topology(origin)}] onto the current mesh "
+            f"[{format_topology(target)}] ({direction})")
+        return {"origin": origin, "target": target,
+                "direction": direction, "source": source,
+                "origin_train_batch_size": meta.get("train_batch_size")}
+
+    def _account_reshape(self, info: Dict[str, Any],
+                         reshard_ms: float) -> None:
+        """A cross-mesh restore COMPLETED: counters (total + the
+        direction breakdown — the registry has no labels, so
+        ``{direction}`` is a counter pair), latency gauge, and a
+        ``reshape`` annotation carrying both topologies into the next
+        debug bundle."""
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        # reshard_restores = the ENGINE actually re-laid state across
+        # meshes; reshapes_total (agent) = the gang resealed at a new
+        # world size.  Separate names so in-process deployments (agent +
+        # worker share one registry) never double-count one event.
+        tel.inc_counter("resilience/reshard_restores_total",
+                        help="snapshot restores that re-laid state onto "
+                             "a DIFFERENT mesh shape")
+        tel.inc_counter(
+            f"resilience/reshard_restores_{info['direction']}_total",
+            help="cross-mesh snapshot restores, by direction (the "
+                 "{direction} breakdown of "
+                 "resilience/reshard_restores_total)")
+        tel.set_gauge("resilience/reshard_last_ms", reshard_ms,
+                      help="state re-lay latency of the last cross-mesh "
+                           "restore")
+        if self.recorder is not None:
+            self.recorder.annotate("reshape", {
+                "direction": info["direction"], "source": info["source"],
+                "origin": info["origin"], "target": info["target"],
+                "reshard_ms": round(reshard_ms, 3),
+                "resumed_step": int(self.engine.global_steps)})
+
     def restore(self, snap: Snapshot) -> None:
         """Roll the ENGINE back to ``snap``: device_put the host tree
         onto the engine's current shardings, rewind the bookkeeping, and
-        run every registered restore hook."""
+        run every registered restore hook.  The host tree is the GLOBAL
+        logical state, so a snapshot taken on a different mesh re-lays
+        onto the current shardings in the same device_put — gated by
+        :meth:`_reshard_guard`."""
         import jax
 
         eng = self.engine
+        reshape = self._reshard_guard(snap.meta, "tier-0")
+        t0 = self._clock()
         shardings = eng._state_shardings(eng.state)
         eng.state = jax.device_put(snap.state, shardings)
         self._restore_meta(snap.meta)
+        if reshape is not None:
+            self._account_reshape(reshape, (self._clock() - t0) * 1e3)
         log_dist(f"resilience: restored training state to step "
                  f"{snap.global_steps}")
 
@@ -463,13 +674,17 @@ class SnapshotManager:
     def load_from_disk(self, path: str) -> Snapshot:
         """Checksum-gated tier-1 restore: verify the commit marker and
         the sidecar, load the state tree INTO the engine's sharded
-        layout, apply it, and return the reconstructed snapshot."""
+        layout (orbax reshard-on-load re-lays a snapshot taken on a
+        different mesh, gated by :meth:`_reshard_guard`), apply it, and
+        return the reconstructed snapshot."""
         import jax
 
         manifest = read_snapshot_manifest(path)  # raises when torn
+        reshape = self._reshard_guard(manifest.get("meta") or {}, "tier-1")
         state_path = os.path.join(path, "state")
         verify_sidecar_manifest(state_path, strict=True)
         eng = self.engine
+        t0 = self._clock()
 
         def abstract(x):
             return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
@@ -481,6 +696,8 @@ class SnapshotManager:
         # engine's mesh (orbax reshard-on-load)
         eng.state = TorchCheckpointEngine().load(state_path, target)
         self._restore_meta(manifest["meta"])
+        if reshape is not None:
+            self._account_reshape(reshape, (self._clock() - t0) * 1e3)
         snap = Snapshot(step=int(manifest["step"]),
                         global_steps=int(manifest["global_steps"]),
                         state=jax.tree.map(
@@ -550,19 +767,28 @@ def list_snapshots(snapshot_dir: str) -> List[Dict[str, Any]]:
 def choose_resume_snapshot(snapshot_dir: str,
                            client: Any = None,
                            node_id: Optional[str] = None,
-                           fetch_dir: Optional[str] = None
-                           ) -> Optional[str]:
+                           fetch_dir: Optional[str] = None,
+                           rdzv: Any = None) -> Optional[str]:
     """The policy's tier-fallback: newest LOCAL snapshot that passes the
     checksum gate; when none survives and a store client is given, pull
     the tier-2 buddy replica of ``node_id`` into ``fetch_dir`` (default:
-    the snapshot dir) and validate that.  Returns a verified snapshot
-    path or None."""
+    the snapshot dir) and validate that.  With ``rdzv`` (an
+    :class:`~..elasticity.rendezvous.ElasticRendezvous`), two further
+    fallbacks close the replacement-node gap: ADOPT a dead peer's
+    orphaned replica (sealed-ring diff names the dead; this node re-keys
+    the replica under its own id), then BOOTSTRAP from any live peer's
+    replica (a scale-up joiner has no history of its own).  Returns a
+    verified snapshot path or None."""
     for entry in list_snapshots(snapshot_dir):
         ok, detail = verify_snapshot(entry["path"])
         if ok:
             return entry["path"]
         logger.warning(f"resilience: skipping invalid snapshot "
                        f"{entry['path']}: {detail}")
+    if client is None and rdzv is not None:
+        client = rdzv.c
+    if node_id is None and rdzv is not None:
+        node_id = rdzv.node_id
     if client is not None and node_id:
         try:
             pulled = fetch_buddy_snapshot(client, node_id,
@@ -576,7 +802,115 @@ def choose_resume_snapshot(snapshot_dir: str,
             if ok:
                 return pulled
             logger.warning(f"resilience: buddy replica invalid: {detail}")
+    if rdzv is not None:
+        adopted = adopt_orphaned_replica(rdzv, fetch_dir or snapshot_dir)
+        if adopted:
+            return adopted
+        return bootstrap_from_peer_replica(rdzv,
+                                           fetch_dir or snapshot_dir)
     return None
+
+
+# ---------------------------------------------------------------------------
+# replacement-node adoption + scale-up bootstrap (ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+def adopt_orphaned_replica(rdzv: Any, out_dir: str) -> Optional[str]:
+    """Replacement-node adoption: a node with a FRESH node id that
+    sealed into the ring after a death walks the sealed-ring diff,
+    discovers which dead peer's tier-2 replica is orphaned, fetches it,
+    verifies the checksum gate, and RE-KEYS it under its own id (so its
+    future buddy — and its own future restarts — find the slot where
+    they expect it).  Deterministic assignment: the k-th joined node
+    (sorted) adopts the k-th dead peer (sorted, wrapping), so two
+    replacements never fight over one corpse.  Returns the local
+    adopted snapshot path, or None."""
+    try:
+        diff = rdzv.ring_diff()
+    except Exception as e:
+        logger.warning(f"resilience: sealed-ring diff failed: {e!r}")
+        return None
+    dead = sorted(diff.get("left") or [])
+    joined = sorted(diff.get("joined") or [])
+    me = rdzv.node_id
+    if not dead or me not in joined:
+        # a restarted SAME-id node owns its own slot (handled by the
+        # plain buddy fetch above); nothing orphaned to adopt
+        return None
+    k = joined.index(me) % len(dead)
+    candidates = dead[k:] + dead[:k]
+    for peer in candidates:
+        try:
+            pulled = fetch_buddy_snapshot(rdzv.c, peer, out_dir)
+        except Exception as e:
+            logger.warning(f"resilience: fetch of dead peer {peer!r}'s "
+                           f"replica failed: {e!r}")
+            continue
+        if not pulled:
+            continue  # that peer never replicated
+        ok, detail = verify_snapshot(pulled)
+        if not ok:
+            logger.warning(f"resilience: dead peer {peer!r}'s replica "
+                           f"invalid: {detail}")
+            continue
+        try:
+            replicate_snapshot(rdzv.c, me, pulled)  # re-key under OUR id
+        except Exception as e:
+            logger.warning(f"resilience: re-keying adopted replica under "
+                           f"{me!r} failed (adoption still valid): {e!r}")
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "resilience/replica_adoptions_total",
+            help="dead peers' tier-2 replicas adopted by replacement "
+                 "nodes (sealed-ring diff)")
+        log_dist(f"resilience: node {me} adopted dead peer {peer}'s "
+                 f"tier-2 replica -> {pulled}")
+        return pulled
+    return None
+
+
+def bootstrap_from_peer_replica(rdzv: Any, out_dir: str) -> Optional[str]:
+    """Scale-up bootstrap: a JOINING node with no local history and no
+    orphan to adopt pulls the newest live peer's replica as its starting
+    point — the reshard-on-restore path then lays it onto whatever mesh
+    the new world builds.  Returns the local path, or None."""
+    try:
+        gang = [n for n in rdzv.sealed_ring() if n != rdzv.node_id]
+    except Exception as e:
+        logger.warning(f"resilience: sealed-ring read failed: {e!r}")
+        return None
+    best: Optional[Tuple[float, str]] = None
+    for peer in gang:
+        meta = rdzv.c.get(RESIL_META_KEY.format(node=peer))
+        if isinstance(meta, dict):
+            ts = float(meta.get("ts") or 0.0)
+            if best is None or ts > best[0]:
+                best = (ts, peer)
+    if best is None:
+        return None
+    try:
+        pulled = fetch_buddy_snapshot(rdzv.c, best[1], out_dir)
+    except Exception as e:
+        logger.warning(f"resilience: bootstrap fetch from {best[1]!r} "
+                       f"failed: {e!r}")
+        return None
+    if not pulled:
+        return None
+    ok, detail = verify_snapshot(pulled)
+    if not ok:
+        logger.warning(f"resilience: bootstrap replica from {best[1]!r} "
+                       f"invalid: {detail}")
+        return None
+    from ..telemetry import get_telemetry
+
+    get_telemetry().inc_counter(
+        "resilience/replica_bootstraps_total",
+        help="joining nodes bootstrapped from a live peer's tier-2 "
+             "replica (scale-up)")
+    log_dist(f"resilience: joining node {rdzv.node_id} bootstrapped from "
+             f"peer {best[1]}'s replica -> {pulled}")
+    return pulled
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,10 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.sharding = batch_sharding(self.mesh, sp_shard_sequence)
         self._epoch = 0
+        #: batches of the NEXT epoch to skip before yielding (set by
+        #: resume_from_samples after a cross-mesh resume; cleared once
+        #: consumed)
+        self._resume_skip_batches = 0
         self._local_rows_cache: dict = {}
 
     def __len__(self):
@@ -134,9 +138,43 @@ class DeepSpeedDataLoader:
             rng.shuffle(idx)
         return idx
 
+    def resume_from_samples(self, consumed: int) -> None:
+        """Re-point the cursor at an absolute SAMPLE position — the
+        mesh-elastic resume path: a snapshot taken at global batch A
+        resumed under global batch B converts its progress to samples
+        (steps × A) and hands it here, so no data window is ever
+        double-consumed.  Position lands on the next batch-B boundary
+        AT-OR-PAST ``consumed``, rounding up — skipping a few unseen
+        samples (including a drop_last remainder the ORIGIN batch size
+        would have dropped anyway) beats refeeding seen ones.  Epochs
+        are dataset-length-denominated on purpose: the origin run's
+        per-epoch drop_last remainder depends on a batch size this
+        loader cannot know, and rounding that ambiguity UP keeps the
+        no-refeed contract."""
+        consumed = max(int(consumed), 0)
+        n = len(self.dataset)
+        if n <= 0 or self.batch_size <= 0:
+            self._epoch, self._resume_skip_batches = 0, 0
+            return
+        self._epoch = consumed // n
+        within = consumed - self._epoch * n
+        skip = -(-within // self.batch_size)  # ceil
+        per_epoch_batches = n // self.batch_size if self.drop_last \
+            else -(-n // self.batch_size)
+        if skip >= per_epoch_batches:
+            # the offset lands past what THIS batch size can yield from
+            # the epoch (a cross-batch-size remainder): advance to the
+            # next epoch head instead of iterating an empty epoch
+            self._epoch += 1
+            skip = 0
+        self._resume_skip_batches = skip
+
     def __iter__(self) -> Iterator[Any]:
         order = self._order()
         self._epoch += 1
+        skip, self._resume_skip_batches = self._resume_skip_batches, 0
+        if skip:
+            order = order[skip * self.batch_size:]
         pw = jax.process_count()
         for start in range(0, len(order), self.batch_size):
             sel = order[start:start + self.batch_size]

@@ -685,6 +685,15 @@ class DeepSpeedEngine:
 
         return jax.tree.map(of, state)
 
+    def mesh_topology(self) -> Dict[str, Any]:
+        """This engine's mesh topology — stamped into every snapshot
+        manifest and compared by the reshard-on-restore guard (a
+        snapshot taken on a different mesh re-lays onto THIS one, or
+        fails with a MeshMismatchError naming both)."""
+        from ..parallel.mesh import mesh_topology
+
+        return mesh_topology(self.mesh)
+
     # ------------------------------------------------------------------
     # the compiled train step
     # ------------------------------------------------------------------

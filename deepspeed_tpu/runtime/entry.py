@@ -266,11 +266,41 @@ def initialize(args: Any = None,
         if dataloader is not None:
             dl = dataloader  # bind the (possibly curriculum-wrapped) loader
             inner = getattr(dl, "loader", dl)
+            # sample-progress anchor: steps*tb alone under-counts any
+            # run whose global batch already changed once (an earlier
+            # reshape), so progress ACCUMULATES from the last restored
+            # position instead of being re-derived from the current tb
+            base = {"samples": 0, "steps": 0}
+
+            def _capture_cursor(eng=engine, inner=inner, base=base):
+                # position in SAMPLES, not steps: a snapshot resumed on
+                # a different world (different global batch) converts
+                # back without double-consuming any window
+                tb = int(eng.train_batch_size or 0)
+                consumed = base["samples"] \
+                    + (int(eng.global_steps) - base["steps"]) * tb
+                return {"epoch": int(getattr(inner, "_epoch", 0)),
+                        "consumed_samples": consumed,
+                        "train_batch_size": tb}
+
+            def _restore_cursor(p, eng=engine, inner=inner, base=base):
+                inner._epoch = int(p.get("epoch", 0))
+                origin_tb = int(p.get("train_batch_size", 0) or 0)
+                consumed = int(p.get("consumed_samples", -1))
+                if consumed < 0:
+                    return
+                # every step from here on consumes THIS engine's tb
+                base["samples"], base["steps"] = \
+                    consumed, int(eng.global_steps)
+                if (origin_tb
+                        and origin_tb != int(eng.train_batch_size or 0)
+                        and hasattr(inner, "resume_from_samples")):
+                    # mesh reshape changed the global batch: re-point
+                    # the cursor at the absolute sample position
+                    inner.resume_from_samples(consumed)
+
             engine.snapshots.register_meta(
-                "data_sampler",
-                lambda: {"epoch": int(getattr(inner, "_epoch", 0))},
-                restore=lambda p: setattr(inner, "_epoch",
-                                          int(p.get("epoch", 0))))
+                "data_sampler", _capture_cursor, restore=_restore_cursor)
         if cfg.resilience.buddy_tier and os.environ.get("DS_RDZV_ENDPOINT"):
             # tier 2 from the WORKER process: the sealed ring + buddy
             # slot live in the store, so a plain client suffices even

@@ -29,7 +29,7 @@ express it by sharding over only the ``data`` axis while replicating over
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -39,6 +39,50 @@ from ...parallel.mesh import DP_AXES
 from .config import DeepSpeedZeroConfig
 
 # pytree-of-PartitionSpec utilities work leaf-wise via tree_map.
+
+
+def dp_shardable_dim(shape: Tuple[int, ...], dp_size: int,
+                     taken: Optional[Sequence[Optional[Any]]] = None
+                     ) -> Optional[int]:
+    """THE placement rule, factored out: the largest free dim of
+    ``shape`` divisible by ``dp_size`` (ties → earliest), or None when
+    nothing shards (the leaf replicates over DP).  ``taken`` marks dims
+    a base spec already occupies.  Shared by the live sharding-spec
+    computation below and the OFFLINE reshard pre-check
+    (``resilience verify --target-mesh`` asks "how would this manifest's
+    recorded leaves lay out at dp=N?" without building an engine)."""
+    if dp_size <= 1 or not shape:
+        return None
+    entries = list(taken) if taken is not None else [None] * len(shape)
+    entries += [None] * (len(shape) - len(entries))
+    candidates = [(dim, i) for i, dim in enumerate(shape)
+                  if entries[i] is None and dim % dp_size == 0]
+    if not candidates:
+        return None
+    _, best = max(candidates, key=lambda t: (t[0], -t[1]))
+    return best
+
+
+def reshard_layout_report(state_shapes: Sequence[Sequence[Any]],
+                          dp_size: int) -> Dict[str, Any]:
+    """Offline layout preview for a snapshot manifest's recorded
+    ``state_shapes`` (``[path, shape]`` pairs) at a TARGET dp world:
+    which leaves would DP-shard under the placement rule and which
+    would fall back to replication (correct either way — replication is
+    the rule's documented fallback, so this is capacity guidance, not a
+    compatibility gate)."""
+    sharded: List[str] = []
+    replicated: List[str] = []
+    for entry in state_shapes or []:
+        name, shape = str(entry[0]), tuple(int(d) for d in entry[1])
+        if dp_shardable_dim(shape, dp_size) is not None:
+            sharded.append(name)
+        else:
+            replicated.append(name)
+    return {"dp_size": int(dp_size), "sharded": sharded,
+            "replicated": replicated,
+            "sharded_count": len(sharded),
+            "replicated_count": len(replicated)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,11 +164,9 @@ class ZeroShardingPolicy:
             return base_spec
         if int(np.prod(shape)) <= self.persistence_threshold:
             return base_spec  # persisted small param — stay replicated over DP
-        candidates = [(dim, i) for i, dim in enumerate(shape)
-                      if entries[i] is None and dim % free_size == 0]
-        if not candidates:
+        best = dp_shardable_dim(shape, free_size, taken=entries)
+        if best is None:
             return base_spec
-        _, best = max(candidates, key=lambda t: (t[0], -t[1]))
         entries[best] = free_axes
         return PartitionSpec(*entries)
 

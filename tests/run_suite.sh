@@ -30,6 +30,7 @@ SHARDS=(
   "tests/unit/telemetry --ignore=tests/unit/telemetry/test_memory_ledger.py --ignore=tests/unit/telemetry/test_memory_oom.py --ignore=tests/unit/telemetry/test_memory_health.py --ignore=tests/unit/telemetry/test_memory_cli.py --ignore=tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/telemetry/test_memory_ledger.py tests/unit/telemetry/test_memory_oom.py tests/unit/telemetry/test_memory_health.py tests/unit/telemetry/test_memory_cli.py tests/unit/telemetry/test_memory_watchdog.py"
   "tests/unit/resilience"
+  "tests/unit/elasticity"
   "tests/unit/serving"
   "tests/unit/tuning"
   "tests/unit/perf"
@@ -126,9 +127,14 @@ else
   echo "=== fault smoke FAILED"
   fail=1
 fi
-# the snapshot CLI must read the smoke run's artifacts cleanly
+# the snapshot CLI must read the smoke run's artifacts cleanly — and
+# the offline reshard pre-check (ISSUE 10) must answer "can I resume
+# this on 3 hosts?" without starting an engine (exit 0: the smoke run's
+# full-coverage 1-device snapshot reshards onto any world)
 if python -m deepspeed_tpu.resilience ls "$smoke_dir/snaps" >/dev/null \
-   && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" >/dev/null; then
+   && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" >/dev/null \
+   && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" \
+        --target-mesh 3 >/dev/null; then
   echo "=== resilience CLI smoke passed"
 else
   echo "=== resilience CLI smoke FAILED"

@@ -1,0 +1,101 @@
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_diagnostics():
+    """Same isolation as the resilience shard: scrub the process-global
+    diagnostic singletons before and after every test."""
+    from deepspeed_tpu.telemetry import (attach_collective_ledger,
+                                         get_collective_ledger,
+                                         get_compile_tracker,
+                                         get_flight_recorder,
+                                         get_goodput_ledger, get_telemetry,
+                                         get_watchdog, set_watchdog)
+    from deepspeed_tpu.telemetry.aggregator import set_publisher
+
+    def scrub():
+        get_telemetry().reset()
+        get_flight_recorder().reset()
+        set_watchdog(None)
+        led = get_collective_ledger()
+        led.reset()
+        led.enabled = False
+        attach_collective_ledger(None)
+        set_publisher(None)
+        trk = get_compile_tracker()
+        trk.reset()
+        trk.enabled = False
+        gp = get_goodput_ledger()
+        gp.reset()
+        gp.enabled = False
+
+    scrub()
+    yield
+    wd = get_watchdog()
+    if wd is not None:
+        wd.stop()
+    scrub()
+
+
+#: the FIXED global batch every mesh shape consumes: loss sequences are
+#: comparable across dp=1/2/4 because the same 8 rows feed every shape
+#: (micro batch = GLOBAL_ROWS // dp).
+GLOBAL_ROWS = 8
+
+
+@pytest.fixture()
+def tiny_engine_factory(tmp_path):
+    """Deterministic engines over a dp-sized slice of the 8 virtual CPU
+    devices: ``make(name, dp=1, **overrides)`` returns
+    ``(engine, batches)``.  Same seed + same GLOBAL batch everywhere, so
+    engines on DIFFERENT mesh shapes fed the same batch sequence produce
+    identical losses — the property the reshard acceptance tests
+    assert."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.utils import groups
+
+    def make(name, dp=1, n_batches=10, resilience=None, telemetry=None,
+             steps_per_print=0):
+        assert GLOBAL_ROWS % dp == 0, "dp must divide the global batch"
+        # a dp-sized slice of the 8 virtual CPU devices (build_mesh only
+        # auto-slices for world 1)
+        mesh = build_mesh(MeshLayout.infer(dp, dp=dp),
+                          devices=jax.devices()[:dp])
+        groups.initialize_mesh(mesh=mesh)
+        rng = np.random.default_rng(7)
+        params = {"w": jnp.asarray(
+            rng.normal(size=(8, 1)).astype(np.float32))}
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        res = {"enabled": True, "snapshot_interval": 2,
+               "snapshot_dir": str(tmp_path / name / "snaps"),
+               "flush_engine": "sync",
+               "backoff_base_s": 0.0, "backoff_max_s": 0.0}
+        res.update(resilience or {})
+        tel = {"enabled": True, "output_path": str(tmp_path / name),
+               "job_name": "job",
+               "flight_recorder": {"install_handlers": False}}
+        tel.update(telemetry or {})
+        cfg = {"train_micro_batch_size_per_gpu": GLOBAL_ROWS // dp,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "steps_per_print": steps_per_print,
+               "telemetry": tel, "resilience": res}
+        engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
+                                    config=cfg, mesh=mesh)
+        brng = np.random.default_rng(13)
+        batches = [(jnp.asarray(
+            brng.normal(size=(GLOBAL_ROWS, 8)).astype(np.float32)),
+                    jnp.zeros((GLOBAL_ROWS, 1), jnp.float32))
+                   for _ in range(n_batches)]
+        return engine, batches
+
+    return make

@@ -1,0 +1,155 @@
+"""Replacement-node adoption (ROADMAP item 5): a joining node with a
+FRESH node id walks the sealed-ring diff, adopts a dead peer's orphaned
+tier-2 replica, re-keys it under its own id, and resumes — without any
+surviving host's local snapshot being available."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.elasticity.rendezvous import (ElasticRendezvous,
+                                                 RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.resilience import (adopt_orphaned_replica,
+                                      bootstrap_from_peer_replica,
+                                      choose_resume_snapshot,
+                                      fetch_buddy_snapshot,
+                                      replicate_snapshot, verify_snapshot)
+from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+
+@pytest.fixture()
+def store():
+    srv = RendezvousServer()
+    try:
+        yield RendezvousClient(srv.endpoint), srv
+    finally:
+        srv.shutdown()
+
+
+def _seal(client, round_id, gang):
+    client.append(f"rdzv/round/{round_id}/sealed", list(gang))
+
+
+def test_ring_diff_walks_back_to_last_sealed_round(store):
+    c, _srv = store
+    _seal(c, 0, ["a", "b", "c"])
+    # rounds 1..3 bumped by churn but never sealed; round 4 sealed
+    c.set("rdzv/round", 4)
+    _seal(c, 4, ["a", "c", "new-1"])
+    rdzv = ElasticRendezvous(c, "new-1")
+    diff = rdzv.ring_diff()
+    assert diff["prev_round"] == 0 and diff["round"] == 4
+    assert diff["left"] == ["b"] and diff["joined"] == ["new-1"]
+    assert rdzv.sealed_ring(0) == ["a", "b", "c"]
+    assert rdzv.sealed_ring(3) == []
+
+
+def test_replacement_node_adopts_dead_peers_replica(
+        tiny_engine_factory, store, tmp_path):
+    """ISSUE 10 acceptance (adoption half): host-b dies; its tier-2
+    replica is the ONLY surviving copy (no local snapshot anywhere);
+    replacement node new-1 (fresh id) discovers it via the sealed-ring
+    diff, adopts + re-keys it, and a fresh engine resumes from it."""
+    c, _srv = store
+    # host-b trained to step 4 and replicated its snapshot under ITS id
+    engine_b, batches = tiny_engine_factory("host-b")
+    for b in batches[:4]:
+        engine_b.train_step(b)
+    engine_b.snapshots.wait()
+    snap = choose_resume_snapshot(engine_b.snapshots.snapshot_dir)
+    replicate_snapshot(c, "host-b", snap)
+
+    _seal(c, 0, ["host-a", "host-b"])
+    c.set("rdzv/round", 1)
+    _seal(c, 1, ["host-a", "new-1"])  # b died, new-1 replaced it
+
+    rdzv_new = ElasticRendezvous(c, "new-1")
+    empty_dir = str(tmp_path / "new-1-snaps")
+    chosen = choose_resume_snapshot(empty_dir, rdzv=rdzv_new)
+    assert chosen is not None
+    ok, detail = verify_snapshot(chosen)
+    assert ok, detail
+
+    # re-keyed under the ADOPTER's id: new-1's own slot now serves it
+    rekeyed = fetch_buddy_snapshot(c, "new-1", str(tmp_path / "rekeyed"))
+    assert rekeyed is not None and verify_snapshot(rekeyed)[0]
+
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_replica_adoptions_total"] == 1.0
+
+    # and the recovery policy treats the adopted snapshot as local: a
+    # fresh engine with rdzv attached resumes at step 4 from it
+    engine_new, _ = tiny_engine_factory(
+        "new-1", resilience={"snapshot_dir": empty_dir})
+    engine_new.snapshots.attach_rendezvous(rdzv_new)
+    path = engine_new.resilience.resume_if_restarted(force=True)
+    assert path is not None and engine_new.global_steps == 4
+
+
+def test_restarted_same_id_node_does_not_adopt(store, tmp_path):
+    """A SAME-id restart owns its own slot — it is not a joiner and
+    must never steal a dead peer's replica meant for a replacement."""
+    c, _srv = store
+    _seal(c, 0, ["a", "b", "c"])
+    c.set("rdzv/round", 1)
+    _seal(c, 1, ["a", "c"])  # b died; a and c are incumbents
+    rdzv_a = ElasticRendezvous(c, "a")
+    assert adopt_orphaned_replica(rdzv_a, str(tmp_path / "a")) is None
+
+
+def test_adoption_assignment_is_deterministic(
+        tiny_engine_factory, store, tmp_path):
+    """Two replacements, two corpses: the k-th joined node (sorted)
+    adopts the k-th dead peer (sorted) — no two replacements fight over
+    one replica."""
+    c, _srv = store
+    # two dead peers replicated snapshots at DIFFERENT steps, so the
+    # adopted path names which corpse each replacement got
+    engine, batches = tiny_engine_factory("src")
+    for b in batches[:2]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    snap2 = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    replicate_snapshot(c, "dead-a", snap2)  # snap-00000002
+    for b in batches[2:4]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    snap4 = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    replicate_snapshot(c, "dead-b", snap4)  # snap-00000004
+
+    _seal(c, 0, ["dead-a", "dead-b", "z-incumbent"])
+    c.set("rdzv/round", 1)
+    _seal(c, 1, ["new-1", "new-2", "z-incumbent"])
+
+    got1 = adopt_orphaned_replica(ElasticRendezvous(c, "new-1"),
+                                  str(tmp_path / "n1"))
+    got2 = adopt_orphaned_replica(ElasticRendezvous(c, "new-2"),
+                                  str(tmp_path / "n2"))
+    assert got1 and got2
+    assert os.path.basename(got1) == "snap-00000002"  # dead-a's
+    assert os.path.basename(got2) == "snap-00000004"  # dead-b's
+
+
+def test_scale_up_bootstrap_pulls_newest_live_peer(
+        tiny_engine_factory, store, tmp_path):
+    """A JOINING node (nobody died) bootstraps from the newest live
+    peer's replica instead of starting at step 0."""
+    c, _srv = store
+    engine, batches = tiny_engine_factory("host-a")
+    for b in batches[:4]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    snap = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    replicate_snapshot(c, "host-a", snap)
+
+    _seal(c, 0, ["host-a"])
+    c.set("rdzv/round", 1)
+    _seal(c, 1, ["host-a", "joiner"])  # scale-up: nobody left
+
+    rdzv_j = ElasticRendezvous(c, "joiner")
+    assert adopt_orphaned_replica(rdzv_j, str(tmp_path / "j1")) is None
+    pulled = bootstrap_from_peer_replica(rdzv_j, str(tmp_path / "j2"))
+    assert pulled is not None and verify_snapshot(pulled)[0]
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_replica_bootstraps_total"] == 1.0

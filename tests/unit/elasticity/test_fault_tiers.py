@@ -1,0 +1,158 @@
+"""corrupt_snapshot grows tier=0|1|2 so chaos runs prove the
+tier-0 -> 1 -> 2 fallback chain end to end; node_leave/node_join specs
+parse; the no-callback node_join bumps the round (a flap, which is what
+the settle window absorbs)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.rendezvous import (ElasticRendezvous,
+                                                 RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.resilience import (choose_resume_snapshot,
+                                      corrupt_tier2_replica, parse_fault,
+                                      replicate_snapshot)
+from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+
+def test_parse_new_fault_kinds():
+    f = parse_fault("node_leave@3")
+    assert f.kind == "node_leave" and f.step == 3
+    f = parse_fault("node_join@4:delay_s=0.5")
+    assert f.kind == "node_join" and f.params["delay_s"] == "0.5"
+    f = parse_fault("corrupt_snapshot@6:tier=2")
+    assert f.params["tier"] == "2"
+    with pytest.raises(ValueError, match="unknown kind"):
+        parse_fault("node_vanish@3")
+
+
+def test_corrupt_tier0_falls_back_through_chain_to_tier1(
+        tiny_engine_factory):
+    """Satellite: BOTH tier-0 buffers poisoned at step 3, NaN at
+    step 4 — rollback 1 restores the poisoned newest buffer, the
+    unproven-restore gate burns it, rollback 2 restores the (also
+    poisoned) older buffer, rollback 3 reaches checksum-clean TIER-1
+    disk state; the run finishes with losses matching a clean run —
+    the 0 -> 0' -> 1 chain end to end."""
+    TOTAL = 8
+    clean_engine, batches = tiny_engine_factory("clean")
+    clean = {}
+    while clean_engine.global_steps < TOTAL:
+        m = clean_engine.train_step(batches[clean_engine.global_steps])
+        clean[clean_engine.global_steps] = float(m["loss"])
+
+    engine, batches = tiny_engine_factory(
+        "tier0", resilience={
+            "faults": ["corrupt_snapshot@3:tier=0,buffers=all",
+                       "nan_loss@4"]})
+    losses = {}
+    while engine.global_steps < TOTAL:
+        m = engine.train_step(batches[engine.global_steps])
+        if not m.get("rolled_back"):
+            losses[engine.global_steps] = float(m["loss"])
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_rollbacks_total"] == 3.0
+    assert parsed["resilience_faults_injected_total"] == 2.0
+    for s in range(5, TOTAL + 1):
+        assert losses[s] == pytest.approx(clean[s], rel=1e-5), \
+            f"step {s} diverged after the tier-0->tier-1 fallback"
+
+
+def test_corrupt_tier2_replica_falls_back_cleanly(tiny_engine_factory,
+                                                  tmp_path):
+    """Satellite (the missing test): a corrupted tier-2 replica is
+    caught at fetch time and the resume path falls back CLEANLY (None /
+    older tier), never a crash."""
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        engine, batches = tiny_engine_factory("t2src")
+        for b in batches[:4]:
+            engine.train_step(b)
+        engine.snapshots.wait()
+        snap = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+        replicate_snapshot(c, "host-x", snap)
+        # sanity: the replica serves a resume before corruption
+        ok_dir = str(tmp_path / "ok")
+        assert choose_resume_snapshot(ok_dir, client=c,
+                                      node_id="host-x") is not None
+
+        assert corrupt_tier2_replica(c, "host-x") is True
+        chosen = choose_resume_snapshot(str(tmp_path / "empty"),
+                                        client=c, node_id="host-x")
+        assert chosen is None  # clean fallback, no exception
+        # a node with a VALID local tier-1 is unaffected by the corrupt
+        # replica (tier 1 ranks above tier 2)
+        local = choose_resume_snapshot(engine.snapshots.snapshot_dir,
+                                       client=c, node_id="host-x")
+        assert local is not None and "t2src" in local
+    finally:
+        srv.shutdown()
+
+
+def test_corrupt_tier2_fault_spec_via_engine(tiny_engine_factory):
+    """The fault grammar drives tier-2 corruption through a live engine
+    with an attached rendezvous."""
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        rdzv = ElasticRendezvous(c, "host-y")
+        c.append("rdzv/round/0/sealed", ["host-y", "host-z"])
+        # fault at step 5 (an OFF-interval step): the step-4 replica is
+        # in the store and no later flush re-replicates over the damage
+        engine, batches = tiny_engine_factory(
+            "t2fault", resilience={"buddy_tier": True,
+                                   "faults": ["corrupt_snapshot@5:tier=2"]})
+        engine.snapshots.attach_rendezvous(rdzv)
+        for b in batches[:5]:
+            engine.train_step(b)
+        engine.snapshots.wait()
+        # the replica was pushed on flush, then the fault garbled it
+        assert c.get("resil/pub/host-y") is not None
+        from deepspeed_tpu.resilience.snapshot import fetch_buddy_snapshot
+
+        with pytest.raises(Exception):
+            fetch_buddy_snapshot(c, "host-y", str(engine.snapshots
+                                                  .snapshot_dir) + "-pull")
+    finally:
+        srv.shutdown()
+
+
+def test_node_join_without_callback_bumps_round(tiny_engine_factory):
+    """No harness callback: node_join manifests to the running gang as
+    a round bump (a join attempt IS a reseal) after delay_s."""
+    import time
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        rdzv = ElasticRendezvous(c, "host-j")
+        engine, batches = tiny_engine_factory(
+            "join", resilience={"faults": ["node_join@2:delay_s=0"]})
+        engine.snapshots.attach_rendezvous(rdzv)
+        assert int(c.get("rdzv/round") or 0) == 0
+        for b in batches[:2]:
+            engine.train_step(b)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if int(c.get("rdzv/round") or 0) == 1:
+                break
+            time.sleep(0.02)
+        assert int(c.get("rdzv/round") or 0) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_node_join_callback_fires():
+    from deepspeed_tpu.resilience.faults import Fault, FaultInjector
+
+    import time
+
+    inj = FaultInjector([Fault("node_join", 2, {"delay_s": "0"})])
+    fired = []
+    inj.on_node_join(lambda d: fired.append(d))
+    inj.apply(2, batch=None)
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fired == [0.0]

@@ -1,0 +1,146 @@
+"""DSElasticAgent reshape orchestration units: scale-up settle window,
+flap backoff, reshape counters, graceful node_leave handling."""
+
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    WorkerSpec,
+                                                    _RestartSignal)
+from deepspeed_tpu.resilience.faults import NodeLeaveRequested
+from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+
+class FakeRdzv:
+    """Just enough rendezvous for the agent's restart/leave paths."""
+
+    def __init__(self, stale=(), left_set=()):
+        self.node_id = "fake"
+        self.stale = list(stale)
+        self.left_set = list(left_set)
+        self.left = False
+        self.bumps = []
+        self.joined_running = False
+
+    def stale_peers(self, peers, ttl):
+        return list(self.stale)
+
+    def left_peers(self, peers):
+        return list(self.left_set)
+
+    def leave(self):
+        self.left = True
+
+    def bump_round(self, reason=""):
+        self.bumps.append(reason)
+        return len(self.bumps)
+
+
+def _agent(rdzv=None, **spec_kw):
+    kw = dict(fn=lambda rc, ck: "ok", max_restarts=3,
+              monitor_interval=0.01, restart_backoff_s=0.05,
+              restart_backoff_max_s=0.1)
+    kw.update(spec_kw)
+    agent = DSElasticAgent(WorkerSpec(**kw))
+    agent.rdzv = rdzv
+    sleeps = []
+    agent._sleep = sleeps.append
+    return agent, sleeps
+
+
+def test_join_driven_bump_honors_settle_window():
+    """Every previous peer still heartbeating => the bump was a JOIN:
+    the agent waits the settle window before re-rendezvousing."""
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    agent, sleeps = _agent(FakeRdzv(stale=()), scale_up_settle_s=5.0)
+    agent._peers = ["a", "b"]
+    agent._maybe_restart(_RestartSignal("join bump"), announce=False,
+                         budgeted=False)
+    assert sleeps == [5.0]
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["elastic_scale_up_settles_total"] == 1.0
+    assert agent.failure_count == 0  # membership churn never budgeted
+
+
+def test_death_driven_bump_stays_prompt():
+    """A stale peer means capacity is ALREADY lost — re-form at
+    monitor_interval, not the settle window."""
+    agent, sleeps = _agent(FakeRdzv(stale=["b"]), scale_up_settle_s=5.0)
+    agent._peers = ["a", "b"]
+    agent._maybe_restart(_RestartSignal("peer died"), announce=False,
+                         budgeted=False)
+    assert sleeps == [0.01]
+
+
+def test_graceful_leaver_bump_stays_prompt():
+    """A LEFT peer never goes stale (stale_peers skips it by design)
+    but its bump is still a capacity loss — no settle window."""
+    agent, sleeps = _agent(FakeRdzv(stale=(), left_set=["b"]),
+                           scale_up_settle_s=5.0)
+    agent._peers = ["a", "b"]
+    agent._maybe_restart(_RestartSignal("peer left"), announce=False,
+                         budgeted=False)
+    assert sleeps == [0.01]
+
+
+def test_settle_window_off_by_default():
+    agent, sleeps = _agent(FakeRdzv(stale=()))
+    agent._maybe_restart(_RestartSignal("join"), announce=False,
+                         budgeted=False)
+    assert sleeps == [0.01]
+
+
+def test_flapping_schedule_counters_agree():
+    """Satellite: repeated node_leave/node_join flapping — restarts and
+    reshape counters match the injected schedule exactly, the settle
+    window bounds every join-driven re-form, and the failure budget is
+    untouched (no reshape thrash into give-up)."""
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    agent, sleeps = _agent(FakeRdzv(stale=()), scale_up_settle_s=2.0)
+    agent._peers = ["a", "b", "c", "d"]
+    # injected schedule: the flapping node joins/leaves 2x — worlds seen
+    # by this survivor: 4 -> 5 -> 4 -> 5 -> 4
+    worlds = [4, 5, 4, 5, 4]
+    for i, w in enumerate(worlds):
+        agent._note_reshape(round_id=i, world=w)
+        if i:
+            agent._maybe_restart(_RestartSignal(f"flap {i}"),
+                                 announce=False, budgeted=False)
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_reshapes_total"] == 4.0  # 4 world changes
+    assert parsed["resilience_reshapes_grow_total"] == 2.0
+    assert parsed["resilience_reshapes_shrink_total"] == 2.0
+    assert parsed["elastic_worker_restarts_total"] == 4.0
+    assert parsed["elastic_scale_up_settles_total"] == 4.0
+    assert sleeps == [2.0] * 4  # every re-form held for the window
+    assert agent.failure_count == 0
+
+
+def test_same_world_reseal_is_not_a_reshape():
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    agent, _ = _agent(None)
+    agent._note_reshape(0, 4)
+    agent._note_reshape(1, 4)
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert "resilience_reshapes_total" not in parsed
+
+
+def test_node_leave_exits_agent_gracefully():
+    """A NodeLeaveRequested from the worker ends the supervision loop:
+    graceful leave + round bump for the survivors, no failure counted,
+    no restart attempted."""
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    rdzv = FakeRdzv()
+    calls = {"n": 0}
+
+    def worker(rc, ck):
+        calls["n"] += 1
+        # attach the fake AFTER rendezvous would have run (the fake has
+        # no next_round; only the leave path is under test here)
+        agent.rdzv = rdzv
+        raise NodeLeaveRequested("injected node leave at step 3")
+
+    agent, _ = _agent(None, fn=worker)
+    agent.run()  # returns instead of restarting
+    assert calls["n"] == 1
+    assert rdzv.left and len(rdzv.bumps) == 1
+    assert agent.failure_count == 0
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["elastic_node_leaves_total"] == 1.0

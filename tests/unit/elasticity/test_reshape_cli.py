@@ -1,0 +1,86 @@
+"""Operator CLI satellites: ``resilience ls`` shows origin mesh/world,
+``verify --target-mesh`` answers reshardability offline (exit 3 on
+incompatible)."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.resilience import choose_resume_snapshot
+from deepspeed_tpu.resilience.cli import main, parse_target_mesh
+from deepspeed_tpu.resilience.snapshot import SNAPSHOT_MANIFEST
+
+
+@pytest.fixture()
+def snapped_engine(tiny_engine_factory):
+    engine, batches = tiny_engine_factory("cliview", dp=2)
+    for b in batches[:2]:
+        engine.train_step(b)
+    engine.snapshots.wait()
+    return engine
+
+
+def test_parse_target_mesh_grammar():
+    assert parse_target_mesh("3")["world_size"] == 3
+    t = parse_target_mesh("2x4")
+    assert t["axes"]["data"] == 2 and t["axes"]["tensor"] == 4
+    assert t["world_size"] == 8
+    full = parse_target_mesh("1x1x4x1x2")
+    assert full["axes"]["pipe"] == 1 and full["world_size"] == 8
+    with pytest.raises(ValueError):
+        parse_target_mesh("3x")
+    with pytest.raises(ValueError):
+        parse_target_mesh("0")
+    with pytest.raises(ValueError):
+        parse_target_mesh("2x2x2")  # 3 dims is not a shape we define
+
+
+def test_ls_prints_origin_mesh(snapped_engine, capsys):
+    rc = main(["ls", snapped_engine.snapshots.snapshot_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "MESH" in out and "2@cpu [1x1x2x1x1]" in out
+
+
+def test_verify_target_mesh_compatible_exits_0(snapped_engine, capsys):
+    rc = main(["verify", snapped_engine.snapshots.snapshot_dir,
+               "--target-mesh", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "reshardable: YES" in out
+    assert "origin: world=2" in out and "target: world=3" in out
+    assert "layout at dp=3" in out
+
+
+def test_verify_target_mesh_incompatible_exits_3(snapped_engine, capsys):
+    """'Can I resume this on 3 hosts?' — NO when the capture was
+    partial-coverage: exit 3, both topologies and tier verdicts
+    printed."""
+    path = choose_resume_snapshot(snapped_engine.snapshots.snapshot_dir)
+    mp = os.path.join(path, SNAPSHOT_MANIFEST)
+    with open(mp) as fh:
+        manifest = json.load(fh)
+    manifest["meta"]["mesh"]["host_coverage"] = "partial"
+    manifest["meta"]["mesh"]["num_processes"] = 2
+    with open(mp, "w") as fh:
+        json.dump(manifest, fh)
+    rc = main(["verify", snapped_engine.snapshots.snapshot_dir,
+               "--target-mesh", "3"])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "reshardable: NO" in out
+    assert "tier0" in out and "tier2" in out
+
+
+def test_verify_same_mesh_target_exits_0(snapped_engine, capsys):
+    rc = main(["verify", snapped_engine.snapshots.snapshot_dir,
+               "--target-mesh", "1x1x2x1x1"])
+    assert rc == 0
+    assert "identical topology" in capsys.readouterr().out
+
+
+def test_verify_bad_target_mesh_is_a_usage_error(snapped_engine):
+    rc = main(["verify", snapped_engine.snapshots.snapshot_dir,
+               "--target-mesh", "banana"])
+    assert rc == 2

@@ -1,0 +1,345 @@
+"""Snapshot reshard-on-restore: origin-topology stamping, the
+MeshMismatchError guard, and the real thing — a snapshot taken on a
+dp=2 mesh restored into a dp=4 engine (and back), with the post-resume
+loss sequence matching an uninterrupted run on the target shape."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience import (MeshMismatchError,
+                                      check_reshardable,
+                                      choose_resume_snapshot,
+                                      format_topology)
+from deepspeed_tpu.resilience.snapshot import (SNAPSHOT_MANIFEST,
+                                               read_snapshot_manifest)
+from deepspeed_tpu.telemetry import get_telemetry, parse_prometheus_text
+
+
+def _run(engine, batches, upto):
+    out = []
+    while engine.global_steps < upto:
+        m = engine.train_step(batches[engine.global_steps])
+        out.append((engine.global_steps, float(m["loss"])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# origin-topology stamping (satellite: the standalone guard lands first)
+# ---------------------------------------------------------------------------
+
+def test_manifest_records_origin_mesh_and_jax_version(tiny_engine_factory):
+    import jax
+
+    engine, batches = tiny_engine_factory("stamp", dp=2)
+    _run(engine, batches, 2)
+    engine.snapshots.wait()
+    path = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    meta = read_snapshot_manifest(path)["meta"]
+    topo = meta["mesh"]
+    assert topo["world_size"] == 2
+    assert topo["axes"]["data"] == 2
+    assert topo["host_coverage"] == "full"
+    assert topo["device_kind"]
+    assert meta["jax_version"] == str(jax.__version__)
+    assert meta["train_batch_size"] == 8
+    assert meta["world_baked_state"] == []
+    # per-leaf shape inventory powers the offline --target-mesh check
+    names = [n for n, _shape in meta["state_shapes"]]
+    assert any("params" in n for n in names)
+
+
+def test_check_reshardable_matrix():
+    full = {"axes": {"data": 4}, "world_size": 4, "host_coverage": "full"}
+    t3 = {"axes": {"data": 3}, "world_size": 3}
+    # identical topology: trivially ok
+    ok, why = check_reshardable({"mesh": dict(full)},
+                                {"axes": {"data": 4}, "world_size": 4})
+    assert ok and "identical" in why
+    # full coverage, no baked state: reshardable
+    ok, _ = check_reshardable({"mesh": dict(full),
+                               "world_baked_state": []}, t3)
+    assert ok
+    # partial coverage: refused, naming the origin processes
+    partial = dict(full, host_coverage="partial", num_processes=4,
+                   process_index=1)
+    ok, why = check_reshardable({"mesh": partial}, t3)
+    assert not ok and "shards" in why
+    # world-baked state (1-bit residuals): refused, naming the leaves
+    ok, why = check_reshardable(
+        {"mesh": dict(full),
+         "world_baked_state": ["comm_state: residuals [dp_world=4,...]"]},
+        t3)
+    assert not ok and "comm_state" in why
+    # unknown origin (pre-reshard snapshot): proceeds as same-mesh
+    ok, why = check_reshardable({}, t3)
+    assert ok and "unknown" in why
+
+
+# ---------------------------------------------------------------------------
+# the real reshard: dp=2 snapshot -> dp=4 engine (grow) and dp=2 (shrink)
+# ---------------------------------------------------------------------------
+
+def test_tier1_restore_reshards_grow_and_matches_clean_run(
+        tiny_engine_factory):
+    """ISSUE 10 acceptance (engine half): a snapshot taken at step 4 on
+    a 2-device mesh restores into a 4-device engine; the resumed loss
+    sequence MATCHES an uninterrupted run on the 4-device shape, the
+    reshard is counted (direction=grow) and the debug bundle carries a
+    ``reshape`` annotation with both topologies."""
+    TOTAL = 6
+    engine_a, batches = tiny_engine_factory("grow-src", dp=2)
+    _run(engine_a, batches, 4)
+    engine_a.snapshots.wait()
+    path = choose_resume_snapshot(engine_a.snapshots.snapshot_dir)
+    assert path is not None
+
+    # the uninterrupted reference ON THE TARGET SHAPE (same global batch)
+    ref_engine, ref_batches = tiny_engine_factory("grow-ref", dp=4)
+    ref = dict(_run(ref_engine, ref_batches, TOTAL))
+
+    engine_b, batches_b = tiny_engine_factory("grow-dst", dp=4)
+    snap = engine_b.snapshots.load_from_disk(path)
+    assert snap.global_steps == 4 and engine_b.global_steps == 4
+    # restored params live on the TARGET mesh
+    w = engine_b.state.params["w"]
+    assert {d.id for d in w.sharding.device_set} \
+        == {d.id for d in np.asarray(engine_b.mesh.devices).ravel()}
+    resumed = _run(engine_b, batches_b, TOTAL)
+    for s, l in resumed:
+        assert l == pytest.approx(ref[s], rel=1e-5), \
+            f"step {s} diverged after cross-mesh resume"
+
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_reshard_restores_total"] == 1.0
+    assert parsed["resilience_reshard_restores_grow_total"] == 1.0
+    assert parsed["resilience_reshard_last_ms"] >= 0.0
+
+    from deepspeed_tpu.telemetry import get_flight_recorder, load_bundle
+
+    m = load_bundle(get_flight_recorder().dump("post-reshard"))["manifest"]
+    reshapes = [a for a in m["annotations"] if a["kind"] == "reshape"]
+    assert reshapes, "bundle missing the reshape annotation"
+    ann = reshapes[-1]
+    assert ann["direction"] == "grow" and ann["source"] == "tier-1"
+    assert ann["origin"]["world_size"] == 2
+    assert ann["target"]["world_size"] == 4
+
+
+def test_tier0_restore_reshards_shrink(tiny_engine_factory):
+    """A tier-0 host capture from a dp=4 engine restores into a dp=2
+    engine (shrink) through SnapshotManager.restore."""
+    engine_a, batches = tiny_engine_factory("shrink-src", dp=4)
+    _run(engine_a, batches, 2)
+    snap = engine_a.snapshots.latest()
+    assert snap is not None and snap.meta["mesh"]["world_size"] == 4
+
+    engine_b, batches_b = tiny_engine_factory("shrink-dst", dp=2)
+    engine_b.snapshots.restore(snap)
+    assert engine_b.global_steps == 2
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert parsed["resilience_reshard_restores_shrink_total"] == 1.0
+    # and the resumed engine still steps
+    m = engine_b.train_step(batches_b[2])
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# the guard: a genuinely un-reshardable snapshot fails DESCRIPTIVELY
+# ---------------------------------------------------------------------------
+
+def _rewrite_manifest(path, mutate):
+    mp = os.path.join(path, SNAPSHOT_MANIFEST)
+    with open(mp) as fh:
+        manifest = json.load(fh)
+    mutate(manifest["meta"])
+    with open(mp, "w") as fh:
+        json.dump(manifest, fh)
+
+
+def test_partial_coverage_load_raises_mesh_mismatch(tiny_engine_factory):
+    """Satellite: a shape-mismatched un-reshardable load fails with a
+    MeshMismatchError naming BOTH topologies and the per-tier verdict —
+    not an opaque device_put error deep in restore."""
+    engine_a, batches = tiny_engine_factory("partial-src", dp=2)
+    _run(engine_a, batches, 2)
+    engine_a.snapshots.wait()
+    path = choose_resume_snapshot(engine_a.snapshots.snapshot_dir)
+
+    def mutate(meta):
+        meta["mesh"]["host_coverage"] = "partial"
+        meta["mesh"]["num_processes"] = 2
+        meta["mesh"]["process_index"] = 0
+
+    _rewrite_manifest(path, mutate)
+    engine_b, _ = tiny_engine_factory("partial-dst", dp=4)
+    with pytest.raises(MeshMismatchError) as ei:
+        engine_b.snapshots.load_from_disk(path)
+    msg = str(ei.value)
+    assert "world=2" in msg and "world=4" in msg  # both topologies named
+    assert "tier" in msg  # per-tier verdict
+    assert ei.value.origin["world_size"] == 2
+    assert ei.value.target["world_size"] == 4
+
+
+def test_same_mesh_partial_coverage_still_restores(tiny_engine_factory):
+    """Identical topology short-circuits the guard: a multi-controller
+    snapshot restores fine on the SAME shape."""
+    engine, batches = tiny_engine_factory("same-partial", dp=2)
+    _run(engine, batches, 2)
+    engine.snapshots.wait()
+    path = choose_resume_snapshot(engine.snapshots.snapshot_dir)
+    _rewrite_manifest(
+        path, lambda meta: meta["mesh"].update(host_coverage="partial"))
+    _run(engine, batches, 4)
+    engine.snapshots.load_from_disk(path)
+    assert engine.global_steps == 2  # rolled back, no error
+
+
+def test_world_baked_state_refuses_reshard(tiny_engine_factory):
+    engine_a, batches = tiny_engine_factory("baked-src", dp=2)
+    _run(engine_a, batches, 2)
+    engine_a.snapshots.wait()
+    path = choose_resume_snapshot(engine_a.snapshots.snapshot_dir)
+    _rewrite_manifest(path, lambda meta: meta.update(
+        world_baked_state=["comm_state: 1-bit residuals [dp_world=2,...]"]))
+    engine_b, _ = tiny_engine_factory("baked-dst", dp=4)
+    with pytest.raises(MeshMismatchError, match="comm_state"):
+        engine_b.snapshots.load_from_disk(path)
+
+
+def test_format_topology_handles_unknown():
+    assert format_topology(None) == "<unknown mesh>"
+    assert "world=4" in format_topology({"world_size": 4, "axes": {}})
+
+
+# ---------------------------------------------------------------------------
+# data-sampler cursor rescale (no window double-consumed)
+# ---------------------------------------------------------------------------
+
+def test_dataloader_resume_from_samples():
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    import jax
+
+    mesh = build_mesh(MeshLayout.infer(1, dp=1),
+                      devices=jax.devices()[:1])
+    data = [{"x": np.full((4,), i, np.float32)} for i in range(32)]
+    dl = DeepSpeedDataLoader(data, batch_size=8, mesh=mesh, shuffle=False)
+    # consumed 20 samples under the ORIGIN batch: the next batch-8
+    # window starts at-or-past sample 20 -> batch 3 (samples 24..31)
+    dl.resume_from_samples(20)
+    assert dl._epoch == 0 and dl._resume_skip_batches == 3
+    first = next(iter(dl))
+    assert float(np.asarray(first["x"])[0, 0]) == 24.0
+    # a full epoch + 1 batch consumed -> epoch 1, skip 1
+    dl.resume_from_samples(40)
+    assert dl._epoch == 1 and dl._resume_skip_batches == 1
+    first = next(iter(dl))
+    assert float(np.asarray(first["x"])[0, 0]) == 8.0
+    # exact boundary: nothing skipped
+    dl.resume_from_samples(32)
+    assert dl._epoch == 1 and dl._resume_skip_batches == 0
+
+
+def test_resume_from_samples_cross_size_remainder_overflow():
+    """A consumed count from a DIFFERENT origin batch size can land
+    past what the new size yields from an epoch (drop_last remainder
+    mismatch): the cursor must advance to the next epoch head, never
+    iterate an empty epoch."""
+    import jax
+
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    mesh = build_mesh(MeshLayout.infer(1, dp=1), devices=jax.devices()[:1])
+    data = [{"x": np.full((4,), i, np.float32)} for i in range(100)]
+    dl = DeepSpeedDataLoader(data, batch_size=25, mesh=mesh,
+                             shuffle=False)
+    # origin bs=30 (90 usable/epoch) ran 6 steps = 180 samples
+    dl.resume_from_samples(180)
+    assert dl._epoch == 2 and dl._resume_skip_batches == 0
+    assert len(list(dl)) == 4  # a full epoch, not an empty one
+
+
+def test_cursor_rescaled_on_cross_mesh_resume(tiny_engine_factory,
+                                              tmp_path):
+    """The registered data_sampler hook converts step progress to
+    SAMPLES and re-points a different-batch loader at the same absolute
+    position."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.utils import groups
+
+    import jax
+
+    def build(name, dp):
+        mesh = build_mesh(MeshLayout.infer(dp, dp=dp),
+                          devices=jax.devices()[:dp])
+        groups.initialize_mesh(mesh=mesh)
+        params = {"w": jnp.zeros((4, 1), jnp.float32)}
+
+        def loss_fn(p, batch):
+            return jnp.mean((batch["x"] @ p["w"]) ** 2)
+
+        data = [{"x": np.full((4,), i, np.float32)} for i in range(64)]
+        # micro batch FIXED at 4: the global batch scales with the world
+        # (tb = 4*dp), which is exactly what a reshape does
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "resilience": {"enabled": True, "snapshot_interval": 1,
+                              "snapshot_dir": str(tmp_path / "curs"),
+                              "flush_engine": "sync"},
+               "telemetry": {"enabled": True,
+                             "output_path": str(tmp_path / name),
+                             "job_name": "job",
+                             "flight_recorder":
+                                 {"install_handlers": False}}}
+        return dst.initialize(model=loss_fn, model_parameters=params,
+                              training_data=data, config=cfg, mesh=mesh)
+
+    engine_a, _, dl_a, _ = build("cursor-a", dp=2)
+    assert int(engine_a.train_batch_size) == 8
+    it = iter(dl_a)
+    for _ in range(2):
+        engine_a.train_step(next(it))
+    engine_a.snapshots.wait()
+    path = choose_resume_snapshot(engine_a.snapshots.snapshot_dir)
+    assert path is not None
+
+    engine_b, _, dl_b, _ = build("cursor-b", dp=4)
+    assert int(engine_b.train_batch_size) == 16  # re-resolved for world 4
+    engine_b.snapshots.load_from_disk(path)
+    # 2 steps x tb=8 = 16 origin samples consumed; the new tb is 16, so
+    # the rescaled cursor starts the next window exactly at position 16
+    # of the (seed-deterministic, shared) epoch-0 shuffle order — none
+    # of the 16 consumed samples is refed
+    assert engine_b.global_steps == 2
+    order = np.arange(64)
+    np.random.default_rng(dl_b.seed + 0).shuffle(order)
+    first = next(iter(dl_b))
+    got = set(np.asarray(first["x"])[:, 0].astype(int).tolist())
+    assert got == set(order[16:32].tolist())
+    assert not (got & set(order[:16].tolist()))  # no double-consumption
+
+    # SECOND reshape: progress must ACCUMULATE (16 origin samples + 1
+    # step at tb=16 = 32), not be re-derived as steps*current_tb (3*8
+    # = 24 would refeed 8 consumed samples)
+    dl_b.resume_from_samples(16)  # align the loader with the restore
+    engine_b.train_step(next(iter(dl_b)))
+    engine_b.snapshots.wait()
+    path2 = choose_resume_snapshot(engine_b.snapshots.snapshot_dir)
+    engine_c, _, dl_c, _ = build("cursor-c", dp=2)
+    engine_c.snapshots.load_from_disk(path2)
+    assert engine_c.global_steps == 3
+    first_c = next(iter(dl_c))
+    got_c = set(np.asarray(first_c["x"])[:, 0].astype(int).tolist())
+    assert got_c == set(order[32:40].tolist())  # tb=8 window at 32
