@@ -1,3 +1,9 @@
 from .elasticity import compute_elastic_config, get_compatible_gpus
+from .rendezvous import (ElasticRendezvous, RendezvousClient,
+                         RendezvousServer, StoreUnavailableError,
+                         control_plane_status, partition_all)
 
-__all__ = ["compute_elastic_config", "get_compatible_gpus"]
+__all__ = ["compute_elastic_config", "get_compatible_gpus",
+           "ElasticRendezvous", "RendezvousClient", "RendezvousServer",
+           "StoreUnavailableError", "control_plane_status",
+           "partition_all"]

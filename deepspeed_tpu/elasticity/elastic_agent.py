@@ -111,6 +111,25 @@ class DSElasticAgent:
         self._world = 0
         #: injectable for tests (fake-clock backoff assertions)
         self._sleep: Callable[[float], None] = time.sleep
+        # prefetch the resilience fault vocabulary OFF the supervision
+        # path: the failure branches import it to map NODE_LEAVE_EXIT_
+        # CODE, and a cold import there (orbax + friends, ~2.5s) would
+        # gate the crash->round-bump latency every peer's teardown
+        # clock depends on
+        import threading
+
+        threading.Thread(
+            target=self._prefetch_fault_vocabulary, daemon=True,
+            name="ds-agent-import-prefetch").start()
+
+    @staticmethod
+    def _prefetch_fault_vocabulary() -> None:
+        try:
+            from ..resilience.faults import NODE_LEAVE_EXIT_CODE  # noqa: F401
+        except Exception as e:
+            # the failure branches re-import and surface any real error
+            debug_once("elastic/prefetch",
+                       f"resilience prefetch failed ({e!r})")
 
     def _hb_payload(self):
         """The local watchdog's liveness summary (step index, step-time
@@ -335,6 +354,17 @@ class DSElasticAgent:
                         self.rdzv.bump_round(f"stale peers {stale}")
                         round_moved.set()
                         return
+                except ConnectionError as e:
+                    # control plane degraded (the store is down or this
+                    # node is partitioned): heartbeats are journaled so
+                    # they buffer and replay on reconnect — keep beating;
+                    # the client counts the outage
+                    # (elasticity/store_reconnects_total + degraded
+                    # seconds) when it heals
+                    debug_once("elastic/heartbeat_degraded",
+                               f"store unreachable in the beat thread "
+                               f"({e!r}); heartbeats buffered, resuming "
+                               f"on reconnect")
                 except Exception as e:
                     # store hiccup — keep the attempt running
                     debug_once("elastic/heartbeat_beat",

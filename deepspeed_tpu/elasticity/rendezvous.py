@@ -21,6 +21,20 @@ cross-host story:
   tear down their local workers and re-rendezvous.  Membership may differ
   in the new round; resume-at-a-different-world is the checkpoint
   reshard-on-load the runtime already provides.
+
+Store failover (ISSUE 11 tentpole): the store itself must be killable.
+Every server boot stamps a fresh ``srv/gen`` generation id; each client
+keeps a bounded local **write-journal** of its own durable entries
+(round counter, sealed rings, heartbeat slots, replica-index metadata)
+and, on reconnecting to a server with a DIFFERENT generation, replays
+the journal — so a kill -9'd-and-restarted store rebuilds its state
+from the survivors, no shared disk required.  When the retry budget is
+exhausted the client enters **degraded mode** instead of crashing its
+caller's loop: journaled writes buffer (bounded, replayed on
+reconnect), :class:`StoreUnavailableError` is raised for reads, the
+outage is counted (``elasticity/store_reconnects_total``, degraded
+seconds), and :func:`control_plane_status` feeds the
+``control_plane_degraded`` health rule.
 """
 
 from __future__ import annotations
@@ -31,9 +45,10 @@ import socket
 import socketserver
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..utils.logging import log_dist, logger
+from ..utils.logging import debug_once, log_dist, logger, warn_once
 
 
 # ---------------------------------------------------------------------------
@@ -48,6 +63,24 @@ class _StoreState:
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
+        # track live connections so shutdown() severs them like a real
+        # process death would — in-process chaos tests must not keep
+        # talking to a zombie handler thread after the "kill".  The
+        # finally-deregistration keeps the set bounded by LIVE
+        # connections (clients reconnect on every transient error; a
+        # long store lifetime must not accumulate dead sockets).
+        conns = getattr(self.server, "_conns", None)
+        if conns is not None:
+            with self.server._conns_lock:  # type: ignore[attr-defined]
+                conns.add(self.connection)
+        try:
+            self._serve()
+        finally:
+            if conns is not None:
+                with self.server._conns_lock:  # type: ignore[attr-defined]
+                    conns.discard(self.connection)
+
+    def _serve(self):
         state: _StoreState = self.server.state  # type: ignore[attr-defined]
         for raw in self.rfile:
             try:
@@ -67,6 +100,22 @@ class _Handler(socketserver.StreamRequestHandler):
                     state.data[req["k"]] = v
                     state.cond.notify_all()
                     out = {"ok": True, "v": v}
+                elif op == "max":
+                    # monotonic set: journal replay after a store restart
+                    # must never REGRESS a counter another survivor (or a
+                    # post-restart bump) already advanced
+                    v = max(int(state.data.get(req["k"], 0)),
+                            int(req["v"]))
+                    state.data[req["k"]] = v
+                    state.cond.notify_all()
+                    out = {"ok": True, "v": v}
+                elif op == "keys":
+                    # prefix scan (operator/chaos tooling: "prove no
+                    # snapshot bytes live in the store")
+                    pref = str(req.get("prefix", ""))
+                    out = {"ok": True,
+                           "v": sorted(k for k in state.data
+                                       if k.startswith(pref))}
                 elif op == "append":
                     lst = list(state.data.get(req["k"], []))
                     if req["v"] not in lst:
@@ -101,14 +150,30 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.flush()
 
 
+class _StoreTCPServer(socketserver.ThreadingTCPServer):
+    # reuse_address: a kill -9'd store must be restartable at the SAME
+    # endpoint immediately (clients dial a configured host:port), not
+    # after the kernel's TIME_WAIT expires
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class RendezvousServer:
-    """Threaded TCP store; start on ONE host (usually alongside agent 0)."""
+    """Threaded TCP store; start on ONE host (usually alongside agent 0).
+
+    Every boot stamps a fresh ``srv/gen`` generation id into the store —
+    reconnecting clients compare it against the generation they first
+    saw and replay their write-journals when it changed (the store was
+    restarted with empty state)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._srv = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
-        self._srv.daemon_threads = True
+        self._srv = _StoreTCPServer((host, port), _Handler,
+                                    bind_and_activate=True)
         self._srv.state = _StoreState()  # type: ignore[attr-defined]
+        self._srv._conns = set()  # type: ignore[attr-defined]
+        self._srv._conns_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._srv.state.data["srv/gen"] = \
+            f"{os.getpid()}-{time.time_ns()}"  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
@@ -122,6 +187,65 @@ class RendezvousServer:
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # sever live client connections (a real store death severs them;
+        # without this an in-process "kill" leaves zombie handler
+        # threads answering from the dead store's state)
+        with self._srv._conns_lock:  # type: ignore[attr-defined]
+            conns = list(self._srv._conns)  # type: ignore[attr-defined]
+            self._srv._conns.clear()  # type: ignore[attr-defined]
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class StoreUnavailableError(ConnectionError):
+    """The store did not answer within the retry budget — the control
+    plane is DEGRADED.  Subclasses :class:`ConnectionError` so every
+    existing ``except ConnectionError`` keeps working; loops that can
+    buffer (heartbeats, replica-index publication) catch it, mark
+    themselves degraded, and resume on reconnect instead of crashing
+    the training step."""
+
+
+#: process-wide client registry: the control-plane health rule and the
+#: ``partition_node`` chaos fault act on EVERY live client at once
+_registry_lock = threading.Lock()
+_all_clients: "weakref.WeakSet" = weakref.WeakSet()
+_degraded_clients: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def control_plane_status() -> Dict[str, Any]:
+    """Process-wide control-plane health: ``{degraded, degraded_for_s,
+    clients}`` — degraded when ANY live :class:`RendezvousClient` has
+    exhausted its retry budget and not yet reconnected.  Consumed by
+    the ``control_plane_degraded`` health rule (``telemetry/health.py``)
+    so a store outage surfaces as a structured health event instead of
+    a crashed daemon thread."""
+    with _registry_lock:
+        degs = [c for c in _degraded_clients]
+    if not degs:
+        return {"degraded": False, "degraded_for_s": 0.0, "clients": 0}
+    since = min(c._degraded_since for c in degs)
+    return {"degraded": True,
+            "degraded_for_s": max(time.monotonic() - since, 0.0),
+            "clients": len(degs)}
+
+
+def partition_all(seconds: float) -> int:
+    """Chaos: drop THIS process's store connectivity for ``seconds`` —
+    every live client blackholes its calls (``partition_node`` fault).
+    Returns the number of clients partitioned."""
+    with _registry_lock:
+        clients = list(_all_clients)
+    for c in clients:
+        c.partition(seconds)
+    return len(clients)
 
 
 class RendezvousClient:
@@ -131,8 +255,19 @@ class RendezvousClient:
     errors (ECONNRESET on a store restart, EINTR, a half-closed socket):
     a debug-bundle collector sweeping N hosts must not die because one
     request hit a reset — exactly the moment sweeps happen is the moment
-    networks are unhappy.  ``retries`` bounds the extra attempts;
-    the final failure propagates."""
+    networks are unhappy.  ``retries`` bounds the extra attempts; the
+    final failure raises :class:`StoreUnavailableError` and flips the
+    client DEGRADED until a later call succeeds.
+
+    **Write-journal**: callers mark durable writes (``set(...,
+    journal=True)`` / :meth:`journal_note`); the journal is bounded and
+    replayed whenever a reconnect lands on a server with a different
+    ``srv/gen`` generation — a restarted empty store re-seeds itself
+    from its surviving clients."""
+
+    #: journal entries kept at most (each key journals once; overflow
+    #: drops the NEW entry with a warning — never silently)
+    JOURNAL_CAP = 512
 
     def __init__(self, endpoint: str, timeout: float = 60.0,
                  retries: int = 3, backoff_s: float = 0.05):
@@ -143,16 +278,107 @@ class RendezvousClient:
         self.backoff_s = float(backoff_s)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        #: {(op, key): value} — this client's durable entries, replayed
+        #: on a generation change (guarded by _jlock; _lock -> _jlock
+        #: is the only nesting order)
+        self._journal: Dict[Tuple[str, str], Any] = {}
+        self._jlock = threading.Lock()
+        self._gen: Optional[str] = None
+        self._ever_connected = False
+        #: degraded-mode bookkeeping (see control_plane_status)
+        self.degraded = False
+        self._degraded_since = 0.0
+        self.degraded_seconds_total = 0.0
+        self.reconnects = 0
+        self.journal_replays = 0
+        self._partition_until = 0.0
+        #: set on every outage: the next successful connection must
+        #: flush the journal even when the server generation is
+        #: UNCHANGED — a same-store partition/flap buffers one-shot
+        #: journaled writes (endpoint publication, leave flags) that
+        #: nothing else would ever re-send
+        self._replay_pending = False
+        with _registry_lock:
+            _all_clients.add(self)
+
+    # -- transport ---------------------------------------------------------
+
+    def _raw(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One request/reply on the CURRENT connection (no retry, no
+        lock — callers hold ``_lock``)."""
+        self._file.write((json.dumps(req) + "\n").encode())
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("store closed connection")
+        return json.loads(line)
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection(self._addr, timeout=self._timeout)
             self._file = s.makefile("rwb")
             self._sock = s
+            try:
+                self._sync_generation()
+            except BaseException:
+                self.close()
+                raise
         return self._sock
+
+    def _sync_generation(self) -> None:
+        """Fresh-connection handshake: read the server's boot generation
+        and replay the write-journal when it CHANGED (the server
+        restarted with empty state and this client's durable entries are
+        part of rebuilding it) OR when an outage may have buffered
+        journaled writes (same store, dropped route: one-shot entries
+        like the replica-server endpoint or a leave flag would otherwise
+        never land)."""
+        gen = (self._raw({"op": "get", "k": "srv/gen"}) or {}).get("v")
+        restarted = (self._gen is not None and gen is not None
+                     and gen != self._gen)
+        if restarted or self._replay_pending:
+            n = self._replay_journal()
+            self.journal_replays += 1
+            self._replay_pending = False
+            why = (f"restarted (generation {self._gen} -> {gen})"
+                   if restarted else "reachable again after an outage")
+            log_dist(f"rendezvous store {why}: re-published {n} "
+                     f"journaled entries")
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "elasticity/store_state_replays_total",
+                help="write-journal replays after an observed store "
+                     "restart or outage")
+        if gen is not None:
+            self._gen = gen
+        self._ever_connected = True
+
+    def _replay_journal(self) -> int:
+        with self._jlock:
+            entries = list(self._journal.items())
+        for (op, k), v in entries:
+            if op == "hb":
+                self._raw({"op": "hb", "k": k})
+            elif op == "max":
+                self._raw({"op": "max", "k": k, "v": v})
+            elif op == "append":
+                self._raw({"op": "append", "k": k, "v": v})
+            else:
+                self._raw({"op": "set", "k": k, "v": v})
+        return len(entries)
 
     def _call(self, **req) -> Dict[str, Any]:
         with self._lock:
+            if self._partition_until:
+                if time.monotonic() < self._partition_until:
+                    self.close()
+                    err = ConnectionError(
+                        "store connectivity partitioned (chaos)")
+                    self._mark_degraded(err)
+                    raise StoreUnavailableError(
+                        f"store call dropped: {err}") from err
+                self._partition_until = 0.0
             last: Optional[BaseException] = None
             for attempt in range(self.retries + 1):
                 if attempt:
@@ -163,22 +389,31 @@ class RendezvousClient:
                                    2.0))
                 try:
                     self._connect()
-                    self._file.write((json.dumps(req) + "\n").encode())
-                    self._file.flush()
-                    line = self._file.readline()
-                    if not line:
-                        raise ConnectionError("store closed connection")
-                    return json.loads(line)
+                    out = self._raw(req)
+                    self._mark_healthy()
+                    return out
                 except (OSError, ConnectionError, ValueError) as e:
                     # ValueError: a line truncated by a mid-reply close
                     # parses as bad JSON — same transient as the reset
                     last = e
                     self.close()
-            raise ConnectionError(
+            self._mark_degraded(last)
+            raise StoreUnavailableError(
                 f"store call failed after {self.retries + 1} attempts: "
                 f"{last!r}") from last
 
     def close(self) -> None:
+        # close the makefile() wrapper too: it holds its own reference
+        # to the underlying fd, so closing only the socket object would
+        # leave the connection half-open — the server's handler thread
+        # would never see EOF and its connection entry would linger
+        f = getattr(self, "_file", None)
+        if f is not None:
+            try:
+                f.close()
+            except (OSError, ValueError):
+                pass
+            self._file = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -186,8 +421,98 @@ class RendezvousClient:
                 pass
             self._sock = None
 
-    def set(self, k: str, v: Any) -> None:
-        self._call(op="set", k=k, v=v)
+    # -- degraded-mode bookkeeping ----------------------------------------
+
+    def _mark_degraded(self, err: Optional[BaseException]) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self._replay_pending = True  # flush the journal on reconnect
+        self._degraded_since = time.monotonic()
+        with _registry_lock:
+            _degraded_clients.add(self)
+        logger.warning(f"rendezvous store unreachable ({err!r}) — "
+                       f"control plane DEGRADED: journaled writes "
+                       f"buffer and replay on reconnect")
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "elasticity/store_outages_total",
+            help="times a store client exhausted its retry budget and "
+                 "entered degraded mode")
+
+    def _mark_healthy(self) -> None:
+        if not self.degraded:
+            return
+        dur = max(time.monotonic() - self._degraded_since, 0.0)
+        self.degraded_seconds_total += dur
+        self.degraded = False
+        self.reconnects += 1
+        with _registry_lock:
+            _degraded_clients.discard(self)
+        log_dist(f"rendezvous store reachable again after {dur:.1f}s "
+                 f"degraded")
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter(
+            "elasticity/store_reconnects_total",
+            help="store clients that recovered from degraded mode "
+                 "(heartbeats resume, buffered writes replay)")
+        tel.inc_counter(
+            "elasticity/store_degraded_seconds_total", v=dur,
+            help="cumulative wall-clock seconds store clients spent in "
+                 "degraded mode")
+
+    def partition(self, seconds: float) -> None:
+        """Chaos: blackhole every call for ``seconds`` (client-side
+        partition — the practical stand-in for dropping this node's
+        store route)."""
+        with self._lock:
+            self._partition_until = time.monotonic() + float(seconds)
+            self.close()
+
+    # -- write-journal ------------------------------------------------------
+
+    def journal_note(self, op: str, k: str, v: Any = None) -> None:
+        """Record a durable entry WITHOUT writing it now (the caller
+        already wrote it, or learned it from a read): replayed verbatim
+        after a store restart.  ``op`` is one of ``set|max|append|hb``."""
+        with self._jlock:
+            if ((op, k) not in self._journal
+                    and len(self._journal) >= self.JOURNAL_CAP):
+                warn_once("rendezvous/journal_cap",
+                          f"store write-journal full ({self.JOURNAL_CAP} "
+                          f"entries) — dropping new entry {op}:{k}; a "
+                          f"store restart would not replay it")
+                return
+            self._journal[(op, k)] = v
+
+    def journal_forget(self, op: str, k: str) -> None:
+        with self._jlock:
+            self._journal.pop((op, k), None)
+
+    def journal_size(self) -> int:
+        with self._jlock:
+            return len(self._journal)
+
+    # -- ops ----------------------------------------------------------------
+
+    def set(self, k: str, v: Any, journal: bool = False) -> None:
+        """Write ``k``.  With ``journal=True`` the entry is durable: it
+        replays after a store restart, and a degraded-mode failure
+        BUFFERS (the journal is the buffer) instead of raising — the
+        write lands on reconnect."""
+        if journal:
+            self.journal_note("set", k, v)
+        try:
+            self._call(op="set", k=k, v=v)
+        except StoreUnavailableError:
+            if not journal:
+                raise
+            debug_once("rendezvous/buffered_set",
+                       f"store down — journaled write {k!r} buffered "
+                       f"for replay on reconnect")
 
     def get(self, k: str) -> Any:
         return self._call(op="get", k=k)["v"]
@@ -195,14 +520,31 @@ class RendezvousClient:
     def add(self, k: str, d: int = 1) -> int:
         return int(self._call(op="add", k=k, d=d)["v"])
 
+    def max(self, k: str, v: int, journal: bool = False) -> int:
+        if journal:
+            self.journal_note("max", k, int(v))
+        return int(self._call(op="max", k=k, v=int(v))["v"])
+
     def append(self, k: str, v: Any) -> List[Any]:
         return list(self._call(op="append", k=k, v=v)["v"])
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return list(self._call(op="keys", prefix=prefix)["v"])
 
     def wait_ge(self, k: str, v: int, timeout: float = 30.0) -> bool:
         return bool(self._call(op="wait_ge", k=k, v=v, t=timeout)["ok"])
 
-    def hb(self, k: str) -> None:
-        self._call(op="hb", k=k)
+    def hb(self, k: str, journal: bool = False) -> None:
+        if journal:
+            self.journal_note("hb", k)
+        try:
+            self._call(op="hb", k=k)
+        except StoreUnavailableError:
+            if not journal:
+                raise
+            debug_once("rendezvous/buffered_hb",
+                       f"store down — heartbeat {k!r} buffered for "
+                       f"replay on reconnect")
 
     def now(self) -> float:
         return float(self._call(op="now")["v"])
@@ -260,10 +602,18 @@ class ElasticRendezvous:
         return f"rdzv/round/{r}/sealed"
 
     def current_round(self) -> int:
-        return int(self.c.get("rdzv/round") or 0)
+        r = int(self.c.get("rdzv/round") or 0)
+        if r:
+            # journal the highest round this node has OBSERVED: after a
+            # store restart the replayed `max` keeps the counter from
+            # regressing past what any survivor saw (a regressed counter
+            # would read as "round moved" and tear every worker down)
+            self.c.journal_note("max", "rdzv/round", r)
+        return r
 
     def bump_round(self, reason: str = "") -> int:
         r = self.c.add("rdzv/round", 1)
+        self.c.journal_note("max", "rdzv/round", r)
         log_dist(f"rendezvous round bumped to {r} ({reason})")
         from ..telemetry import get_telemetry
 
@@ -361,6 +711,16 @@ class ElasticRendezvous:
                 continue
             rank = frozen.index(self.node_id)
             world = len(frozen)
+            # store-failover journal: this node vouches for the round it
+            # sealed into — a restarted store gets the counter AND the
+            # frozen ring back from any survivor (append replay is
+            # idempotent: every member re-appends the SAME frozen list).
+            # Sealed-ring history older than the adoption lookback is
+            # pruned so the journal stays bounded.
+            self.c.journal_note("max", "rdzv/round", r)
+            self.c.journal_note("append", self._sealed_key(r), frozen)
+            for p in range(max(0, r - 64), r - 8):
+                self.c.journal_forget("append", self._sealed_key(p))
             # Each round publishes a FRESH coordinator endpoint through the
             # store: rank 0 binds an ephemeral port on its own host (the
             # only host that can know what's free there) so a hung
@@ -389,7 +749,8 @@ class ElasticRendezvous:
                     self.bump_round(f"round {r}: rank 0 never published "
                                     f"a coordinator")
                 continue  # re-form without rank 0's corpse
-            self.c.set(f"rdzv/left/{self.node_id}", False)  # (re)joined
+            self.c.set(f"rdzv/left/{self.node_id}", False,
+                       journal=True)  # (re)joined
             self._hb_missing.clear()
             self._round_start = self.c.now()
             self.heartbeat()
@@ -399,13 +760,18 @@ class ElasticRendezvous:
 
     def heartbeat(self, payload: Optional[Dict[str, Any]] = None) -> None:
         # stamped by the STORE's clock (op=hb), not this host's — see
-        # stale_peers: all staleness math happens on one clock
-        self.c.hb(f"rdzv/hb/{self.node_id}")
+        # stale_peers: all staleness math happens on one clock.
+        # Both writes are JOURNALED: with the store down they buffer
+        # (the beat resumes on reconnect instead of dying in the daemon
+        # thread), and after a store restart the replay re-stamps this
+        # node's liveness before any peer can mistake it for dead.
+        self.c.hb(f"rdzv/hb/{self.node_id}", journal=True)
         if payload:
             # liveness summary riding the heartbeat (the watchdog's step
             # index / step-time EWMA): rank 0 folds every peer's payload
             # into straggler-skew gauges (publish_straggler_stats)
-            self.c.set(f"rdzv/hbinfo/{self.node_id}", payload)
+            self.c.set(f"rdzv/hbinfo/{self.node_id}", payload,
+                       journal=True)
 
     def peer_heartbeat_ages(self, peer_ids: List[str]
                             ) -> Dict[str, Dict[str, Any]]:
@@ -540,7 +906,7 @@ class ElasticRendezvous:
         """Graceful departure: a finished node stops heartbeating but must
         not be mistaken for a death — peers skip left nodes in
         :meth:`stale_peers` and keep their own attempts running."""
-        self.c.set(f"rdzv/left/{self.node_id}", True)
+        self.c.set(f"rdzv/left/{self.node_id}", True, journal=True)
 
     def stale_peers(self, peer_ids: List[str], ttl_s: float) -> List[str]:
         # one clock for everything: heartbeats are store-stamped (op=hb)

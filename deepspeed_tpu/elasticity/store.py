@@ -1,0 +1,63 @@
+"""Standalone rendezvous store process.
+
+``python -m deepspeed_tpu.elasticity.store --host H --port P`` runs the
+:class:`~.rendezvous.RendezvousServer` as its OWN process — the shape
+production deployments and the process-level chaos harness need: a
+store you can ``kill -9`` and restart at the same endpoint, watching
+the surviving clients re-seed its state from their write-journals
+(`rendezvous.py` docstring, ISSUE 11 tentpole).
+
+The ``restart_store`` fault (``resilience/faults.py``) spawns this
+module detached when no harness callback is registered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from .rendezvous import RendezvousServer
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.elasticity.store",
+        description="run a rendezvous store as a standalone process "
+                    "(kill -9-able; surviving clients re-seed a restart "
+                    "from their write-journals)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--endpoint", default=None,
+                   help="host:port shorthand (overrides --host/--port)")
+    p.add_argument("--pid_file", default=None,
+                   help="write this process's pid here (chaos harnesses "
+                        "kill -9 it)")
+    args = p.parse_args(argv)
+    host, port = args.host, args.port
+    if args.endpoint:
+        h, _, pt = args.endpoint.rpartition(":")
+        host, port = h or host, int(pt)
+    srv = RendezvousServer(host, port)
+    if args.pid_file:
+        with open(args.pid_file, "w") as fh:
+            fh.write(str(os.getpid()))
+    # one parseable readiness line, flushed — harnesses wait on it
+    print(f"DS_RDZV_ENDPOINT={srv.endpoint}", flush=True)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,29 +1,37 @@
-"""Self-healing resilience plane (ISSUE 4).
+"""Self-healing resilience plane (ISSUE 4 + ISSUE 11).
 
 Three pillars that turn the observability stack's DETECTIONS (watchdog
 trips, NaN'd losses, dead peers) into a bounded amount of lost work:
 
 * :mod:`.snapshot` — tiered async snapshots of the full training state
   (tier 0 host memory, tier 1 checksummed disk flush through the
-  checkpoint engine, tier 2 buddy-host replication over the rendezvous
-  store).
+  checkpoint engine, tier 2 **peer-to-peer** replication: each node's
+  :mod:`.replica_server` serves its flushed dirs and pushes a copy to
+  its ring buddy; the rendezvous store carries index/placement metadata
+  only, so store loss never invalidates the tier).
 * :mod:`.policy` — the automatic recovery state machine: rollback on
   NaN/loss-scale collapse with the offending data window skipped,
   emergency-save on watchdog trip, resume-from-newest-valid-snapshot on
   elastic restart, capped backoff + give-up budget.
 * :mod:`.faults` — deterministic, config/env-driven fault injection
-  (kill a rank, stall a step, NaN the loss, corrupt a snapshot) so the
-  whole loop is provable in CI.
+  (kill a rank, stall a step, NaN the loss, corrupt a snapshot tier,
+  kill/restart the rendezvous store, partition a node, SIGSTOP-hang a
+  worker) so the whole loop — control plane included — is provable in
+  CI.
 
-Operator CLI: ``python -m deepspeed_tpu.resilience {ls,verify}``.
+Operator CLI: ``python -m deepspeed_tpu.resilience
+{ls,verify,replicas,fetch,faults}``.
 """
 
-from .faults import (Fault, FaultInjector, InjectedFault,
+from .faults import (FAULT_DOCS, Fault, FaultInjector, InjectedFault,
                      NodeLeaveRequested, corrupt_newest_snapshot,
                      corrupt_tier0_snapshot, corrupt_tier2_replica,
                      parse_fault, parse_faults)
 from .policy import (RecoveryPolicy, ResilienceGiveUp, ST_GAVE_UP,
                      ST_RECOVERING, ST_RUNNING)
+from .replica_server import (ReplicaServer, fetch_replica,
+                             get_local_server, push_replica,
+                             set_local_server)
 from .snapshot import (MeshMismatchError, Snapshot, SnapshotManager,
                        SnapshotUnsupportedError, adopt_orphaned_replica,
                        bootstrap_from_peer_replica, check_reshardable,
@@ -38,9 +46,12 @@ __all__ = [
     "adopt_orphaned_replica", "bootstrap_from_peer_replica",
     "list_snapshots", "verify_snapshot", "replicate_snapshot",
     "fetch_buddy_snapshot",
+    "ReplicaServer", "get_local_server", "set_local_server",
+    "fetch_replica", "push_replica",
     "RecoveryPolicy", "ResilienceGiveUp",
     "ST_RUNNING", "ST_RECOVERING", "ST_GAVE_UP",
     "Fault", "FaultInjector", "InjectedFault", "NodeLeaveRequested",
-    "parse_fault", "parse_faults", "corrupt_newest_snapshot",
-    "corrupt_tier0_snapshot", "corrupt_tier2_replica",
+    "FAULT_DOCS", "parse_fault", "parse_faults",
+    "corrupt_newest_snapshot", "corrupt_tier0_snapshot",
+    "corrupt_tier2_replica",
 ]

@@ -14,9 +14,19 @@ The 3am read side of the resilience plane:
   resume this on that mesh?" OFFLINE — both topologies, the per-tier
   verdict, and the recorded state leaves' layout at the target dp —
   exit 3 when incompatible.
+* ``replicas <dir>`` — inventory the peer-to-peer tier-2 replicas HELD
+  under a replica-store root (own serving registrations live in the
+  running process; this reads the on-disk ``recv/<owner>/<tag>`` trees
+  plus any snapshot dirs), checksum-verifying each.  Exit 3 when any
+  held replica is corrupt, 4 when none exists.
+* ``fetch --endpoint H:P --owner NODE out_dir`` — pull a replica
+  straight from a peer's replica server, **no store required**: the
+  proof that tier 2 remains restorable with the store down.
+* ``faults`` — the chaos catalogue: every fault kind the injection
+  harness speaks (``kind@step[:k=v,...]``) with its parameters.
 
-Both commands are plain-directory reads — no store, no engine, no
-device needed beyond importing the package.
+``ls``/``verify``/``replicas`` are plain-directory reads — no store, no
+engine, no device needed beyond importing the package.
 """
 
 from __future__ import annotations
@@ -186,6 +196,93 @@ def cmd_verify(args: argparse.Namespace) -> int:
     return 4
 
 
+def _held_replicas(root: str) -> List[Dict[str, Any]]:
+    """Every snapshot dir under ``root`` (any depth — covers the
+    ``recv/<owner>/<tag>`` trees a holder keeps and plain snapshot
+    roots), with the owner inferred from the path."""
+    from .snapshot import SNAPSHOT_MANIFEST
+
+    out: List[Dict[str, Any]] = []
+    for dirpath, dirs, files in os.walk(root):
+        if SNAPSHOT_MANIFEST not in files:
+            continue
+        dirs[:] = []  # a snapshot dir never nests another
+        rel = os.path.relpath(dirpath, root)
+        parts = rel.split(os.sep)
+        owner = parts[-2] if len(parts) >= 2 else "<local>"
+        out.append({"path": dirpath, "owner": owner,
+                    "tag": os.path.basename(dirpath)})
+    out.sort(key=lambda e: (e["owner"], e["tag"]))
+    return out
+
+
+def cmd_replicas(args: argparse.Namespace) -> int:
+    if not os.path.isdir(args.dir):
+        return _fail(f"{args.dir}: not a directory")
+    held = _held_replicas(args.dir)
+    if not held:
+        print(f"no held replicas under {args.dir}")
+        return 4
+    bad = 0
+    print(f"{'OWNER':<16} {'TAG':<24} {'SIZE':>10} "
+          f"{'MESH':<20}  STATUS")
+    for entry in held:
+        ok, detail = verify_snapshot(entry["path"])
+        bad += 0 if ok else 1
+        size = _dir_bytes(entry["path"])
+        status = "valid" if ok else f"CORRUPT — {detail}"
+        print(f"{entry['owner']:<16} {entry['tag']:<24} "
+              f"{size / 2**20:>9.1f}M "
+              f"{_mesh_column(entry['path']):<20}  {status}")
+    return 3 if bad else 0
+
+
+def cmd_fetch(args: argparse.Namespace) -> int:
+    """Peer-to-peer restore with NO store: dial the holder's replica
+    server directly (`--endpoint` from the index metadata, a journal,
+    or the operator's notes), pull, checksum-verify, report."""
+    from .replica_server import _rpc, fetch_replica
+
+    tag = args.tag
+    if tag is None:
+        try:
+            idx = _rpc(args.endpoint, [{"op": "index"}])[0].get("v") or []
+        except (OSError, ConnectionError) as e:
+            return _fail(f"replica server {args.endpoint} unreachable: "
+                         f"{e!r}")
+        mine = sorted(e["tag"] for e in idx if e.get("owner") == args.owner)
+        if not mine:
+            print(f"{args.endpoint} holds no replica of {args.owner!r} "
+                  f"(serves: "
+                  f"{sorted(set(e.get('owner') for e in idx))})")
+            return 4
+        tag = mine[-1]  # newest by tag ordering (snap-<step>)
+    from ..runtime.checkpoint_engine import CheckpointCorruptionError
+
+    try:
+        path = fetch_replica(args.endpoint, args.owner, tag, args.out_dir)
+    except CheckpointCorruptionError as e:
+        # the transport sha gate rejected the holder's copy — the exact
+        # condition this command exists to diagnose: report, exit 4
+        print(f"{args.endpoint} {args.owner}/{tag}: CORRUPT — {e}")
+        return 4
+    except (OSError, ConnectionError) as e:
+        return _fail(f"fetch from {args.endpoint} failed: {e!r}")
+    ok, detail = verify_snapshot(path)
+    print(f"{path}: {'valid' if ok else 'CORRUPT'} — {detail}")
+    return 0 if ok else 4
+
+
+def cmd_faults(args: argparse.Namespace) -> int:
+    from .faults import FAULT_DOCS
+
+    print("fault spec grammar: kind@step[:key=value,...]  "
+          "(config resilience.faults or DS_FAULTS, ';'-separated)")
+    for kind, doc in FAULT_DOCS.items():
+        print(f"  {kind:<18} {doc}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.resilience",
@@ -210,6 +307,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "sizes (pipe x expert x data x seq x tensor); "
                         "exit 3 when the snapshot cannot serve it")
     v.set_defaults(fn=cmd_verify)
+
+    r = sub.add_parser("replicas",
+                       help="inventory + checksum-verify the tier-2 "
+                            "replicas held under a replica-store root "
+                            "(exit 3 any corrupt / 4 none)")
+    r.add_argument("dir")
+    r.set_defaults(fn=cmd_replicas)
+
+    f = sub.add_parser("fetch",
+                       help="pull a replica straight from a peer's "
+                            "replica server — no rendezvous store "
+                            "needed (tier-2 stays restorable with the "
+                            "store down)")
+    f.add_argument("--endpoint", required=True,
+                   help="host:port of the HOLDER's replica server")
+    f.add_argument("--owner", required=True,
+                   help="node id whose snapshot to pull")
+    f.add_argument("--tag", default=None,
+                   help="snapshot tag (default: the newest the holder "
+                        "serves for that owner)")
+    f.add_argument("out_dir")
+    f.set_defaults(fn=cmd_fetch)
+
+    fl = sub.add_parser("faults",
+                        help="list every chaos fault kind the "
+                             "injection harness speaks (incl. "
+                             "kill_store / restart_store / "
+                             "partition_node / sigstop_hang)")
+    fl.set_defaults(fn=cmd_faults)
     return p
 
 

@@ -18,10 +18,14 @@ Spec grammar (comma-free ``kind@step[:key=value,...]``)::
     corrupt_snapshot@40:tier=2   # garble the tier-2 buddy replica
     node_leave@200               # this host LEAVES the gang (scale-down)
     node_join@200:delay_s=5      # a host joins (harness cb / round bump)
+    kill_store@80                # SIGKILL the rendezvous store process
+    restart_store@90:delay_s=2   # respawn the store at its endpoint
+    partition_node@100:seconds=5 # drop THIS node's store connectivity
+    sigstop_hang@120:seconds=10  # SIGSTOP this worker (a real OS hang)
 
 Faults fire ONCE (per process) at the step they name; ``rank=`` guards
-restrict kill/leave/join faults to one worker.  Every firing lands in
-telemetry
+restrict kill/leave/join/store/partition/hang faults to one worker.
+Every firing lands in telemetry
 (``resilience/faults_injected_total``) and the flight recorder, so a
 chaos run's debug bundle says what was injected, where.
 """
@@ -29,13 +33,49 @@ chaos run's debug bundle says what was injected, where.
 from __future__ import annotations
 
 import os
+import signal
+import subprocess
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
 from ..utils.logging import logger
 
-KINDS = ("kill_rank", "kill", "nan_loss", "stall", "corrupt_snapshot",
-         "node_leave", "node_join")
+#: kind -> one-line operator doc (the `resilience faults` CLI prints
+#: this catalogue; KINDS derives from it so the two can't drift)
+FAULT_DOCS = {
+    "kill_rank": "worker death (raise InjectedFault, or os._exit(113) "
+                 "with mode=exit); params: rank=, mode=raise|exit",
+    "kill": "alias of kill_rank",
+    "nan_loss": "poison the step's batch with NaN (drives the rollback "
+                "loop)",
+    "stall": "stall the step path (watchdog food); params: seconds=",
+    "corrupt_snapshot": "defeat a snapshot tier's integrity gate; "
+                        "params: tier=0|1|2, buffers=all (tier 0), "
+                        "dir= (tier 1), node= (tier 2)",
+    "node_leave": "this host leaves the gang gracefully (scale-down); "
+                  "params: rank=",
+    "node_join": "a host joins after delay_s (harness callback, else a "
+                 "round bump — a join attempt IS a reseal); params: "
+                 "delay_s=, rank=",
+    "kill_store": "SIGKILL the rendezvous store process (pid= param, "
+                  "DS_STORE_PID env, or the on_store_kill harness "
+                  "callback); training must continue DEGRADED",
+    "restart_store": "respawn the store at its endpoint after delay_s "
+                     "(on_store_restart callback, else spawn `python -m "
+                     "deepspeed_tpu.elasticity.store` detached); "
+                     "params: delay_s=, endpoint=",
+    "partition_node": "drop THIS node's store connectivity for "
+                      "seconds= (client-side blackhole: every live "
+                      "RendezvousClient in the process); params: "
+                      "seconds=, rank=",
+    "sigstop_hang": "SIGSTOP this worker process for seconds= (a "
+                    "helper re-CONTs it) — a genuine OS-level hang the "
+                    "gang's heartbeat-ttl machinery must catch; "
+                    "params: seconds=, rank=",
+}
+
+KINDS = tuple(FAULT_DOCS)
 
 
 class InjectedFault(RuntimeError):
@@ -131,11 +171,26 @@ class FaultInjector:
         #: look identical (a reseal), which is exactly what the settle
         #: window chaos tests need.
         self._node_join_cb: Optional[Any] = None
+        #: ``kill_store``/``restart_store`` harness hooks — without
+        #: them the faults act directly (SIGKILL the pid from params/
+        #: DS_STORE_PID; spawn the standalone store module)
+        self._store_kill_cb: Optional[Any] = None
+        self._store_restart_cb: Optional[Any] = None
 
     def on_node_join(self, cb: Any) -> None:
         """Register the ``node_join`` callback: ``cb(delay_s)`` runs on
         a daemon timer thread when the fault fires."""
         self._node_join_cb = cb
+
+    def on_store_kill(self, cb: Any) -> None:
+        """Register the ``kill_store`` callback: ``cb()`` kills the
+        store (in-process harnesses shut their server object down)."""
+        self._store_kill_cb = cb
+
+    def on_store_restart(self, cb: Any) -> None:
+        """Register the ``restart_store`` callback: ``cb()`` brings the
+        store back at its endpoint."""
+        self._store_restart_cb = cb
 
     @classmethod
     def from_config(cls, rcfg: Any, recorder: Any = None
@@ -188,7 +243,9 @@ class FaultInjector:
         for fault in self.faults:
             if fault.fired or fault.step != step:
                 continue
-            if fault.kind in ("kill_rank", "node_leave", "node_join"):
+            if fault.kind in ("kill_rank", "node_leave", "node_join",
+                              "kill_store", "restart_store",
+                              "partition_node", "sigstop_hang"):
                 want = fault.params.get("rank")
                 if want is not None and int(want) != self.rank():
                     fault.fired = True  # this step is this fault's only shot
@@ -215,6 +272,20 @@ class FaultInjector:
             if fault.kind == "stall":
                 self._record(fault)
                 self._sleep(float(fault.params.get("seconds", 60.0)))
+            elif fault.kind == "kill_store":
+                self._record(fault)
+                self._fire_kill_store(fault)
+            elif fault.kind == "restart_store":
+                self._record(fault)
+                self._fire_restart_store(fault)
+            elif fault.kind == "partition_node":
+                self._record(fault)
+                self._fire_partition(
+                    float(fault.params.get("seconds", 10.0)))
+            elif fault.kind == "sigstop_hang":
+                self._record(fault)
+                self._fire_sigstop(
+                    float(fault.params.get("seconds", 5.0)))
             elif fault.kind == "nan_loss":
                 self._record(fault)
                 batch = _poison_batch(batch)
@@ -261,6 +332,108 @@ class FaultInjector:
         t = threading.Timer(max(delay_s, 0.0), fire)
         t.daemon = True
         t.start()
+
+    # -- process-level chaos (ISSUE 11 tentpole c) --------------------------
+
+    def _fire_kill_store(self, fault: Fault) -> None:
+        """``kill_store``: SIGKILL the rendezvous store process — the
+        exact failure the store-failover tentpole exists for.  The gang
+        must keep training (degraded mode) and re-seed a restarted
+        store from its write-journals."""
+        if self._store_kill_cb is not None:
+            try:
+                self._store_kill_cb()
+            except Exception as e:
+                logger.warning(f"fault injection: kill_store callback "
+                               f"failed: {e!r}")
+            return
+        pid_s = fault.params.get("pid") or os.environ.get("DS_STORE_PID")
+        if not pid_s:
+            logger.warning("fault injection: kill_store needs a pid= "
+                           "param, DS_STORE_PID, or an on_store_kill "
+                           "callback — fault had no effect")
+            return
+        try:
+            os.kill(int(pid_s), signal.SIGKILL)
+            logger.warning(f"fault injection: SIGKILLed rendezvous "
+                           f"store pid {pid_s}")
+        except (OSError, ValueError) as e:
+            logger.warning(f"fault injection: kill_store pid {pid_s!r} "
+                           f"failed: {e!r}")
+
+    def _fire_restart_store(self, fault: Fault) -> None:
+        """``restart_store``: bring the store back at its endpoint
+        after ``delay_s`` — the other half of the kill_store drill
+        (journal replay re-seeds it from the survivors)."""
+        import threading
+
+        delay_s = float(fault.params.get("delay_s", 0.0))
+        cb = self._store_restart_cb
+        endpoint = (fault.params.get("endpoint")
+                    or os.environ.get("DS_RDZV_ENDPOINT"))
+
+        def fire():
+            try:
+                if cb is not None:
+                    cb()
+                    return
+                if not endpoint:
+                    logger.warning(
+                        "fault injection: restart_store has no endpoint "
+                        "(param/DS_RDZV_ENDPOINT) and no callback — "
+                        "fault had no effect")
+                    return
+                # detached so the store outlives this worker; its own
+                # readiness line goes to the worker's log
+                subprocess.Popen(
+                    [sys.executable, "-m",
+                     "deepspeed_tpu.elasticity.store",
+                     "--endpoint", str(endpoint)],
+                    start_new_session=True)
+                logger.warning(f"fault injection: respawned rendezvous "
+                               f"store at {endpoint}")
+            except Exception as e:
+                logger.warning(f"fault injection: restart_store failed: "
+                               f"{e!r}")
+
+        t = threading.Timer(max(delay_s, 0.0), fire)
+        t.daemon = True
+        t.start()
+
+    def _fire_partition(self, seconds: float) -> None:
+        """``partition_node``: blackhole every live store client in
+        THIS process for ``seconds`` — the node trains on, blind; its
+        peers see its heartbeat go stale."""
+        from ..elasticity.rendezvous import partition_all
+
+        n = partition_all(seconds)
+        if n:
+            logger.warning(f"fault injection: partitioned {n} store "
+                           f"client(s) for {seconds}s")
+        else:
+            logger.warning("fault injection: partition_node found no "
+                           "live store client — fault had no effect")
+
+    def _fire_sigstop(self, seconds: float) -> None:
+        """``sigstop_hang``: a GENUINE OS-level hang — SIGSTOP this
+        process (heartbeat threads included), with a detached helper
+        re-CONTing it after ``seconds``.  Unlike ``stall`` (one thread
+        sleeps), this freezes everything: exactly what a peer's
+        heartbeat-ttl machinery must catch."""
+        pid = os.getpid()
+        try:
+            subprocess.Popen(
+                ["/bin/sh", "-c",
+                 f"sleep {max(seconds, 0.1)}; kill -CONT {pid}"],
+                start_new_session=True)
+        except OSError as e:
+            logger.warning(f"fault injection: sigstop_hang helper spawn "
+                           f"failed ({e!r}) — NOT stopping (nobody "
+                           f"would resume us)")
+            return
+        logger.warning(f"fault injection: SIGSTOPping pid {pid} for "
+                       f"{seconds}s")
+        os.kill(pid, signal.SIGSTOP)
 
     def _fire_corrupt_snapshot(self, fault: Fault, engine: Any) -> None:
         """``corrupt_snapshot[:tier=0|1|2]`` — tier 1 (default) flips
@@ -363,11 +536,17 @@ def corrupt_tier0_snapshot(snapshots: Any,
 
 
 def corrupt_tier2_replica(client: Any, node_id: str) -> bool:
-    """Garble ``node_id``'s tier-2 replica in the rendezvous store: the
-    first payload chunk is replaced with same-length garbage base64, so
-    the fetch-side untar fails loudly and the resume path falls back
-    cleanly (tier-2 is the LAST tier — a corrupt replica means 'no
-    snapshot', never a crash).  Returns True when a replica existed."""
+    """Garble ``node_id``'s tier-2 replica so every fetch fails the
+    checksum gate and the resume path falls back cleanly (tier-2 is the
+    LAST tier — a corrupt replica means 'no snapshot', never a crash).
+
+    P2P layout: the store holds only index metadata, so the chaos
+    poisons the published transport sha256 (every holder then fails the
+    gate — the same observable failure as rotten bytes on every holder)
+    AND, where a holder's copy is reachable on this filesystem (buddy
+    ``recv/`` trees in single-box chaos runs), flips real bytes in it.
+    Legacy store-chunk publications get their first chunk garbled as
+    before.  Returns True when a replica existed."""
     import base64
 
     from .snapshot import RESIL_CHUNK_PREFIX, RESIL_META_KEY
@@ -377,6 +556,25 @@ def corrupt_tier2_replica(client: Any, node_id: str) -> bool:
         logger.warning(f"fault injection: node {node_id!r} has no tier-2 "
                        f"replica in the store to corrupt")
         return False
+    if "holders" in meta:
+        poisoned = dict(meta)
+        poisoned["sha256"] = "0" * 64
+        try:
+            client.set(RESIL_META_KEY.format(node=node_id), poisoned,
+                       journal=True)
+        except TypeError:
+            client.set(RESIL_META_KEY.format(node=node_id), poisoned)
+        # rot the buddy's physical copy too when it is reachable here
+        # (never the owner's own dir — that would ALSO corrupt tier 1)
+        for holder in meta.get("holders") or []:
+            path = str(holder.get("path") or "")
+            if holder.get("node") == meta.get("owner") or not path:
+                continue
+            if os.sep + "recv" + os.sep in path and os.path.isdir(path):
+                corrupt_newest_snapshot(os.path.dirname(path))
+        logger.warning(f"fault injection: corrupted tier-2 replica of "
+                       f"{node_id!r} (transport checksum poisoned)")
+        return True
     key = RESIL_CHUNK_PREFIX.format(node=node_id) + "/0"
     chunk = client.get(key) or ""
     garbage = base64.b64encode(os.urandom(max(len(chunk) // 2, 16))

@@ -1,0 +1,481 @@
+"""Peer-to-peer tier-2 replica transport (ISSUE 11 tentpole b).
+
+Every node runs ONE lightweight :class:`ReplicaServer` — the same
+JSON-line TCP protocol the rendezvous store speaks — that serves this
+node's flushed snapshot dirs (and the replica copies peers pushed to
+it) directly to the gang.  The rendezvous store carries only
+**index/placement metadata** (``resil/pub/<node>``: tag, bytes, sha256,
+holder endpoints — see ``snapshot.py``), never snapshot bytes, so
+killing the store no longer destroys the tier: the bytes live on the
+owner AND its buddy, and anyone who knows a holder endpoint can
+restore with the store down (``python -m deepspeed_tpu.resilience
+fetch``).
+
+Protocol (one JSON object per line, ``op``-dispatched):
+
+* ``index``                         — list ``{owner, tag}`` served here
+* ``meta  {owner, tag}``            — prepare the tar, return
+  ``{n, bytes, sha256, chunk_bytes}``
+* ``chunk {owner, tag, i}``         — the i-th base64 chunk
+* ``put_begin/put_chunk/put_commit``— buddy upload (owner → holder);
+  commit verifies the transport sha256 BEFORE extracting — a torn or
+  tampered upload never lands on disk
+
+Fetches are checksum-gated twice: the transport sha256 over the tar
+(rejects a corrupt/garbled holder) and the per-file sidecar manifest
+the snapshot already carries (``verify_snapshot`` at the caller).
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import hashlib
+import io
+import json
+import os
+import socket
+import socketserver
+import tarfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime.checkpoint_engine import CheckpointCorruptionError
+from ..utils.logging import log_dist, logger
+
+DEFAULT_CHUNK_BYTES = 256 * 1024
+DEFAULT_MAX_BYTES = 256 * 1024 * 1024
+#: prepared tars kept in memory (LRU) — rebuilt from the served dir on
+#: a miss, so eviction costs time, never correctness
+TAR_CACHE = 4
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class _ReplicaTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _ReplicaHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        owner: "ReplicaServer" = self.server.replica  # type: ignore
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+            except ValueError:
+                break
+            try:
+                out = owner.handle_request(req)
+            except Exception as e:  # a bad request must not kill the
+                out = {"ok": False, "err": repr(e)}  # serving thread
+            self.wfile.write((json.dumps(out) + "\n").encode())
+            self.wfile.flush()
+
+
+class ReplicaServer:
+    """Serve snapshot dirs to peers; accept buddy uploads.
+
+    One per process (:func:`get_local_server`).  All shared state —
+    the served-dir registry, the tar LRU, in-flight uploads — is
+    guarded by one lock; tar preparation happens under it too, which
+    makes concurrent fetches of the same dir trivially safe (the
+    second fetch waits for the first build instead of duplicating it).
+    """
+
+    def __init__(self, base_dir: str, host: str = "", port: int = 0,
+                 advertise_host: Optional[str] = None,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self.chunk_bytes = max(1, int(chunk_bytes))
+        self.max_bytes = int(max_bytes)
+        #: (owner, tag) -> served dir path
+        self._served: Dict[Tuple[str, str], str] = {}
+        #: (owner, tag) -> size cap the ORIGINAL tar was built under: a
+        #: rebuild (cache eviction, server restart) must apply the same
+        #: cap or it could drop a different file set and produce a sha
+        #: the published index no longer matches
+        self._caps: Dict[Tuple[str, str], int] = {}
+        #: (owner, tag) -> (b64, sha256, raw_bytes, dropped) LRU
+        self._tars: "collections.OrderedDict[Tuple[str, str], tuple]" = \
+            collections.OrderedDict()
+        #: (owner, tag) -> in-flight upload staging
+        self._uploads: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        #: (owner, tag) -> Event for a tar build IN PROGRESS: builds run
+        #: OUTSIDE the registry lock (a multi-hundred-MB gzip must not
+        #: stall uploads/probes), concurrent fetchers of the same dir
+        #: wait on the event instead of duplicating the build
+        self._building: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+        self._srv = _ReplicaTCPServer((host or "", port), _ReplicaHandler)
+        self._srv.replica = self  # type: ignore[attr-defined]
+        self.port = int(self._srv.server_address[1])
+        #: the address PEERS dial — DS_ELASTIC_HOST (the operator knows
+        #: the routable interface) or loopback for single-box gangs
+        self.host = (advertise_host or os.environ.get("DS_ELASTIC_HOST")
+                     or "127.0.0.1")
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True,
+                                        name="ds-replica-server")
+        self._thread.start()
+        # a RESTARTED holder re-serves the replicas it already holds on
+        # disk (recv/<owner>/<tag>): a worker teardown/restart must not
+        # orphan the copies the tier's durability depends on
+        recv = os.path.join(base_dir, "recv")
+        if os.path.isdir(recv):
+            for owner in sorted(os.listdir(recv)):
+                odir = os.path.join(recv, owner)
+                if not os.path.isdir(odir):
+                    continue
+                for tag in sorted(os.listdir(odir)):
+                    tdir = os.path.join(odir, tag)
+                    if os.path.isdir(tdir):
+                        self._served[(owner, tag)] = tdir
+                        self._caps[(owner, tag)] = 2 ** 62  # held copy
+        log_dist(f"tier-2 replica server at {self.endpoint} "
+                 f"({len(self._served)} held replica(s) re-served; "
+                 f"store carries metadata only)")
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- registry -----------------------------------------------------------
+
+    def serve(self, owner: str, tag: str, path: str,
+              tar: Optional[Tuple[bytes, str]] = None,
+              max_bytes: Optional[int] = None) -> None:
+        """Register ``path`` as ``owner``'s snapshot ``tag``; with
+        ``tar`` (data, sha256) the prepared tar is cached so the first
+        peer fetch pays no rebuild.  ``max_bytes`` records the size cap
+        the original tar honored, so a rebuild drops the same (or no)
+        files and reproduces the published sha."""
+        with self._lock:
+            self._served[(owner, tag)] = path
+            if max_bytes is not None:
+                self._caps[(owner, tag)] = int(max_bytes)
+            if tar is not None:
+                data, sha = tar
+                self._cache_tar(owner, tag,
+                                (base64.b64encode(data).decode("ascii"),
+                                 sha, len(data), []))
+
+    def served(self) -> List[Dict[str, str]]:
+        with self._lock:
+            return [{"owner": o, "tag": t, "path": p}
+                    for (o, t), p in sorted(self._served.items())]
+
+    def _cache_tar(self, owner: str, tag: str, entry: tuple) -> None:
+        # caller holds the lock
+        self._tars[(owner, tag)] = entry
+        self._tars.move_to_end((owner, tag))
+        while len(self._tars) > TAR_CACHE:
+            self._tars.popitem(last=False)
+
+    def _tar_for(self, owner: str, tag: str) -> tuple:
+        """(b64, sha256, raw_bytes, dropped) for a served dir — cached,
+        else rebuilt OUTSIDE the registry lock.  Concurrent fetchers of
+        the same dir wait for the one in-flight build; other protocol
+        ops (buddy uploads, index probes) are never stalled behind a
+        gzip."""
+        key = (owner, tag)
+        while True:
+            with self._lock:
+                cached = self._tars.get(key)
+                if cached is not None:
+                    self._tars.move_to_end(key)
+                    return cached
+                building = self._building.get(key)
+                if building is None:
+                    building = threading.Event()
+                    self._building[key] = building
+                    path = self._served.get(key)
+                    break  # this thread builds
+            building.wait(timeout=300.0)
+            # re-check the cache (or find the build failed and retry it)
+        try:
+            if path is None or not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"replica {owner}/{tag} is not served here")
+            from ..telemetry.aggregator import _tar_dir
+            from .snapshot import SNAPSHOT_MANIFEST
+
+            with self._lock:
+                cap = self._caps.get(key, self.max_bytes)
+            data, dropped = _tar_dir(path, cap,
+                                     priority_file=SNAPSHOT_MANIFEST,
+                                     recursive=True)
+            entry = (base64.b64encode(data).decode("ascii"),
+                     _sha256(data), len(data), dropped)
+            with self._lock:
+                self._cache_tar(owner, tag, entry)
+            return entry
+        finally:
+            with self._lock:
+                self._building.pop(key, None)
+            building.set()
+
+    def _prune_held(self, owner: str, keep: int = 3) -> None:
+        """Holder-side retention: an owner replicating every snapshot
+        interval would otherwise grow this node's disk without bound —
+        keep the newest ``keep`` held copies per owner (tag order is
+        step order: ``snap-<zero-padded step>``)."""
+        import shutil
+
+        with self._lock:
+            held = sorted(t for (o, t), p in self._served.items()
+                          if o == owner
+                          and p.startswith(os.path.join(self.base_dir,
+                                                        "recv")))
+            drop = held[:-keep] if keep > 0 else []
+            paths = []
+            for tag in drop:
+                paths.append(self._served.pop((owner, tag)))
+                self._tars.pop((owner, tag), None)
+                self._caps.pop((owner, tag), None)
+        for p in paths:
+            shutil.rmtree(p, ignore_errors=True)
+
+    # -- protocol -----------------------------------------------------------
+
+    def handle_request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "index":
+            return {"ok": True, "v": self.served()}
+        if op == "meta":
+            b64, sha, nbytes, dropped = self._tar_for(str(req["owner"]),
+                                                      str(req["tag"]))
+            n = max(1, -(-len(b64) // self.chunk_bytes)) if b64 else 0
+            return {"ok": True, "n": n, "bytes": nbytes, "sha256": sha,
+                    "chunk_bytes": self.chunk_bytes, "dropped": dropped}
+        if op == "chunk":
+            b64, _sha, _nb, _dr = self._tar_for(str(req["owner"]),
+                                                str(req["tag"]))
+            i = int(req["i"])
+            step = self.chunk_bytes
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "resilience/replica_chunks_served_total",
+                help="tier-2 replica chunks served to peers")
+            return {"ok": True, "v": b64[i * step:(i + 1) * step]}
+        if op == "put_begin":
+            key = (str(req["owner"]), str(req["tag"]))
+            if int(req.get("bytes", 0)) > self.max_bytes:
+                return {"ok": False,
+                        "err": f"replica exceeds max_bytes "
+                               f"({self.max_bytes})"}
+            with self._lock:
+                # expire ABANDONED staging first: an owner killed
+                # mid-push (the exact crash window this tier exists
+                # for) must not leak its staged chunks in this holder
+                # forever — tags are unique per step, so torn pushes
+                # would otherwise accumulate without bound
+                now = time.time()
+                for stale in [k for k, u in self._uploads.items()
+                              if now - u["ts"] > 900.0]:
+                    self._uploads.pop(stale, None)
+                self._uploads[key] = {"n": int(req["n"]),
+                                      "sha256": str(req["sha256"]),
+                                      "chunks": {}, "ts": now}
+            return {"ok": True}
+        if op == "put_chunk":
+            key = (str(req["owner"]), str(req["tag"]))
+            with self._lock:
+                up = self._uploads.get(key)
+                if up is None:
+                    return {"ok": False, "err": "no upload in progress"}
+                up["chunks"][int(req["i"])] = str(req["v"])
+            return {"ok": True}
+        if op == "put_commit":
+            return self._commit_upload(str(req["owner"]), str(req["tag"]))
+        if op == "ping":
+            return {"ok": True, "v": "replica"}
+        return {"ok": False, "err": f"bad op {op!r}"}
+
+    def _commit_upload(self, owner: str, tag: str) -> Dict[str, Any]:
+        with self._lock:
+            up = self._uploads.pop((owner, tag), None)
+        if up is None:
+            return {"ok": False, "err": "no upload in progress"}
+        b64 = "".join(up["chunks"].get(i, "") for i in range(up["n"]))
+        data = base64.b64decode(b64)
+        if _sha256(data) != up["sha256"]:
+            # the checksum gate at the UPLOAD boundary: a torn or
+            # tampered push never lands on the holder's disk
+            return {"ok": False,
+                    "err": f"upload checksum mismatch for {owner}/{tag}"}
+        dest_root = os.path.join(self.base_dir, "recv", owner)
+        os.makedirs(dest_root, exist_ok=True)
+        from ..telemetry.aggregator import _safe_extract
+
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+            _safe_extract(tar, dest_root)
+        path = os.path.join(dest_root, tag)
+        # a held copy already passed the OWNER's size cap — a rebuild
+        # must never drop anything or its sha diverges from the index
+        self.serve(owner, tag, path, tar=(data, up["sha256"]),
+                   max_bytes=2 ** 62)
+        self._prune_held(owner, keep=3)
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "resilience/replica_holds_total",
+            help="peer replica copies accepted and held by this node")
+        log_dist(f"holding tier-2 replica {owner}/{tag} ({len(data)} "
+                 f"tar bytes) at {path}")
+        return {"ok": True, "path": path}
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+def _rpc(endpoint: str, requests: List[Dict[str, Any]],
+         timeout: float = 60.0) -> List[Dict[str, Any]]:
+    """Send ``requests`` over ONE connection; returns the replies.  No
+    retries — a dead holder is a normal condition the caller falls
+    through on (``ConnectionError``/``OSError`` propagate)."""
+    host, _, port = endpoint.rpartition(":")
+    out: List[Dict[str, Any]] = []
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as s:
+        f = s.makefile("rwb")
+        for req in requests:
+            f.write((json.dumps(req) + "\n").encode())
+            f.flush()
+            line = f.readline()
+            if not line:
+                raise ConnectionError(
+                    f"replica server {endpoint} closed the connection")
+            out.append(json.loads(line))
+    return out
+
+
+def fetch_replica(endpoint: str, owner: str, tag: str, out_dir: str,
+                  expect_sha: Optional[str] = None,
+                  timeout: float = 60.0) -> str:
+    """Pull ``owner``'s snapshot ``tag`` from the holder at
+    ``endpoint`` into ``out_dir``.  Raises
+    :class:`CheckpointCorruptionError` when the transport sha256 (the
+    holder's, and ``expect_sha`` from the store index when given)
+    doesn't match the bytes — a corrupt replica is rejected, never
+    extracted.  Dead holder → ``ConnectionError``/``OSError`` for the
+    caller's fallthrough."""
+    meta = _rpc(endpoint, [{"op": "meta", "owner": owner, "tag": tag}],
+                timeout=timeout)[0]
+    if not meta.get("ok"):
+        raise ConnectionError(f"replica server {endpoint} cannot serve "
+                              f"{owner}/{tag}: {meta.get('err')}")
+    reqs = [{"op": "chunk", "owner": owner, "tag": tag, "i": i}
+            for i in range(int(meta["n"]))]
+    replies = _rpc(endpoint, reqs, timeout=timeout) if reqs else []
+    bad = [r for r in replies if not r.get("ok")]
+    if bad:
+        # a refused chunk (tag pruned between meta and chunk calls,
+        # registry churn) is UNAVAILABILITY — it must read as a dead
+        # holder the caller falls through on, never as corruption
+        raise ConnectionError(
+            f"replica server {endpoint} stopped serving {owner}/{tag} "
+            f"mid-fetch: {bad[0].get('err')}")
+    b64 = "".join(str(r.get("v") or "") for r in replies)
+    data = base64.b64decode(b64)
+    got = _sha256(data)
+    want = expect_sha or meta.get("sha256")
+    if want and got != want:
+        raise CheckpointCorruptionError(
+            f"tier-2 replica {owner}/{tag} from {endpoint} failed the "
+            f"transport checksum gate (sha256 {got[:12]}… != expected "
+            f"{str(want)[:12]}…) — replica rejected")
+    os.makedirs(out_dir, exist_ok=True)
+    from ..telemetry.aggregator import _safe_extract
+
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        _safe_extract(tar, out_dir)
+    from ..telemetry import get_telemetry
+
+    get_telemetry().inc_counter(
+        "resilience/replica_fetches_total",
+        help="tier-2 replicas fetched peer-to-peer")
+    return os.path.join(out_dir, tag)
+
+
+def push_replica(endpoint: str, owner: str, tag: str, data: bytes,
+                 sha256: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 timeout: float = 60.0) -> str:
+    """Upload a prepared tar to the holder at ``endpoint`` (owner →
+    buddy).  Returns the holder-side path; raises on refusal or
+    checksum mismatch."""
+    b64 = base64.b64encode(data).decode("ascii")
+    step = max(1, int(chunk_bytes))
+    chunks = [b64[i:i + step] for i in range(0, len(b64), step)] or [""]
+    reqs: List[Dict[str, Any]] = [
+        {"op": "put_begin", "owner": owner, "tag": tag,
+         "n": len(chunks), "bytes": len(data), "sha256": sha256}]
+    reqs += [{"op": "put_chunk", "owner": owner, "tag": tag, "i": i,
+              "v": ch} for i, ch in enumerate(chunks)]
+    reqs.append({"op": "put_commit", "owner": owner, "tag": tag})
+    replies = _rpc(endpoint, reqs, timeout=timeout)
+    for r in replies:
+        if not r.get("ok"):
+            raise RuntimeError(f"replica push of {owner}/{tag} to "
+                               f"{endpoint} refused: {r.get('err')}")
+    from ..telemetry import get_telemetry
+
+    get_telemetry().inc_counter(
+        "resilience/replica_pushes_total",
+        help="tier-2 replicas pushed to a buddy holder peer-to-peer")
+    return str(replies[-1].get("path"))
+
+
+# ---------------------------------------------------------------------------
+# process-local singleton
+# ---------------------------------------------------------------------------
+
+_local: Optional[ReplicaServer] = None
+_local_lock = threading.Lock()
+
+
+def get_local_server(create: bool = False,
+                     base_dir: Optional[str] = None,
+                     chunk_bytes: Optional[int] = None,
+                     max_bytes: Optional[int] = None
+                     ) -> Optional[ReplicaServer]:
+    """This process's replica server (one per process — every engine /
+    snapshot manager in the process serves through it).  ``create=True``
+    starts it on first use; ``base_dir``/``chunk_bytes``/``max_bytes``
+    (the configured ``resilience.buddy_*`` knobs) only seed the first
+    creation — later callers share whatever the first one picked."""
+    global _local
+    with _local_lock:
+        if _local is None and create:
+            import tempfile
+
+            root = base_dir or tempfile.mkdtemp(prefix="ds-replica-store-")
+            _local = ReplicaServer(
+                root,
+                chunk_bytes=chunk_bytes or DEFAULT_CHUNK_BYTES,
+                max_bytes=max_bytes or DEFAULT_MAX_BYTES)
+        return _local
+
+
+def set_local_server(server: Optional[ReplicaServer]) -> None:
+    """Install/replace the process-local server (tests; a replaced
+    server is shut down)."""
+    global _local
+    with _local_lock:
+        prev, _local = _local, server
+    if prev is not None and prev is not server:
+        try:
+            prev.shutdown()
+        except OSError as e:
+            logger.warning(f"replica server shutdown failed: {e!r}")

@@ -18,13 +18,16 @@ host loss".  Three tiers, each a strictly cheaper/closer copy:
   path never blocks on storage).  Every flush commits a
   ``snapshot.json`` marker ONLY after the checksummed sidecar manifest
   is durable — restores are checksum-gated, torn flushes are invisible.
-* **tier 2 — off-host replica**: the flushed snapshot dir shipped into
-  the rendezvous store (chunked transport shared with debug bundles,
-  ``telemetry/aggregator.py``) under this node's slot, so a dead host's
-  state survives the host and its replacement — or the NEXT node in the
-  sealed ring (the "buddy", the expected adopter) — can pull it.  The
-  replica lives on the store host; surviving store loss via true
-  peer-to-peer placement is a ROADMAP follow-up.
+* **tier 2 — off-host replica, peer-to-peer**: the flushed snapshot dir
+  is served by this node's :class:`~.replica_server.ReplicaServer` and
+  PUSHED to the NEXT node in the sealed ring (the "buddy", the expected
+  adopter), which holds a physical copy on its own disk and serves it
+  too.  The rendezvous store carries only **index/placement metadata**
+  (``resil/pub/<node>``: tag, bytes, sha256, holder endpoints) — never
+  snapshot bytes — and that metadata is write-journaled, so a killed
+  store neither destroys the tier nor forgets where the replicas live:
+  adoption and scale-up bootstrap fetch from a holder peer through the
+  same transport checksum gate.
 
 The manager is engine-owned (``engine.snapshots``) and driven from
 ``train_step`` (:meth:`maybe_snapshot`); the recovery policy
@@ -86,9 +89,14 @@ def check_snapshot_support(engine: Any) -> None:
             "ordinary checkpoints (save_checkpoint covers offload "
             "state), or disable offload_optimizer to get tiered "
             "snapshots.  (ROADMAP item 5 tracks native support.)")
-#: tier-2 store key prefixes (mirrors the debug/-bundle transport)
+#: tier-2 store keys: INDEX/placement metadata only (the bytes live on
+#: peers — see replica_server.py).  The chunk prefix remains only for
+#: reading replicas published by pre-P2P builds.
 RESIL_META_KEY = "resil/pub/{node}"
 RESIL_CHUNK_PREFIX = "resil/chunk/{node}"
+#: each node's replica-server endpoint (journaled, so a restarted store
+#: re-learns the placement map from survivors)
+RESIL_SRV_KEY = "resil/srv/{node}"
 
 
 # ---------------------------------------------------------------------------
@@ -251,9 +259,29 @@ class SnapshotManager:
         self._meta_hooks[name] = (capture, restore)
 
     def attach_rendezvous(self, rdzv: Any) -> None:
-        """Enable tier 2 against this elastic rendezvous (its client is
-        the transport, its sealed ring names the buddy)."""
+        """Enable tier 2 against this elastic rendezvous: its sealed
+        ring names the buddy, its client carries the INDEX metadata.
+        With the buddy tier on, this also starts (or joins) the
+        process-local replica server and publishes its endpoint — a
+        journaled write, so a restarted store re-learns the placement
+        map from the survivors."""
         self._rdzv = rdzv
+        if not self.cfg.buddy_tier or rdzv is None:
+            return
+        try:
+            from .replica_server import get_local_server
+
+            server = get_local_server(
+                create=True,
+                base_dir=os.path.join(self.snapshot_dir, "replica_store"),
+                chunk_bytes=self.cfg.buddy_chunk_bytes,
+                max_bytes=self.cfg.buddy_max_bytes)
+            rdzv.c.set(RESIL_SRV_KEY.format(node=rdzv.node_id),
+                       server.endpoint, journal=True)
+        except Exception as e:
+            # tier 2 degrades to owner-only serving; tiers 0/1 are whole
+            logger.warning(f"resilience: replica server start/publish "
+                           f"failed: {e!r}")
 
     # -- capture (tier 0) --------------------------------------------------
 
@@ -533,7 +561,7 @@ class SnapshotManager:
             if buddy is None:
                 return  # no surviving peer could ever adopt the replica
             meta = replicate_snapshot(self._rdzv.c, self._rdzv.node_id,
-                                      path,
+                                      path, rdzv=self._rdzv,
                                       chunk_bytes=self.cfg.buddy_chunk_bytes,
                                       max_bytes=self.cfg.buddy_max_bytes)
             if meta.get("dropped"):
@@ -815,7 +843,9 @@ def choose_resume_snapshot(snapshot_dir: str,
 # replacement-node adoption + scale-up bootstrap (ROADMAP item 5)
 # ---------------------------------------------------------------------------
 
-def adopt_orphaned_replica(rdzv: Any, out_dir: str) -> Optional[str]:
+def adopt_orphaned_replica(rdzv: Any, out_dir: str,
+                           retries: int = 6,
+                           retry_delay_s: float = 2.0) -> Optional[str]:
     """Replacement-node adoption: a node with a FRESH node id that
     sealed into the ring after a death walks the sealed-ring diff,
     discovers which dead peer's tier-2 replica is orphaned, fetches it,
@@ -823,8 +853,11 @@ def adopt_orphaned_replica(rdzv: Any, out_dir: str) -> Optional[str]:
     future buddy — and its own future restarts — find the slot where
     they expect it).  Deterministic assignment: the k-th joined node
     (sorted) adopts the k-th dead peer (sorted, wrapping), so two
-    replacements never fight over one corpse.  Returns the local
-    adopted snapshot path, or None."""
+    replacements never fight over one corpse.  Fetches retry briefly
+    (``retries`` rounds, ``retry_delay_s`` apart): adoption runs while
+    the gang is RE-FORMING, so a surviving holder may itself be
+    mid-restart with its replica server not yet re-bound.  Returns the
+    local adopted snapshot path, or None."""
     try:
         diff = rdzv.ring_diff()
     except Exception as e:
@@ -839,35 +872,50 @@ def adopt_orphaned_replica(rdzv: Any, out_dir: str) -> Optional[str]:
         return None
     k = joined.index(me) % len(dead)
     candidates = dead[k:] + dead[:k]
-    for peer in candidates:
-        try:
-            pulled = fetch_buddy_snapshot(rdzv.c, peer, out_dir)
-        except Exception as e:
-            logger.warning(f"resilience: fetch of dead peer {peer!r}'s "
-                           f"replica failed: {e!r}")
-            continue
-        if not pulled:
-            continue  # that peer never replicated
-        ok, detail = verify_snapshot(pulled)
-        if not ok:
-            logger.warning(f"resilience: dead peer {peer!r}'s replica "
-                           f"invalid: {detail}")
-            continue
-        try:
-            replicate_snapshot(rdzv.c, me, pulled)  # re-key under OUR id
-        except Exception as e:
-            logger.warning(f"resilience: re-keying adopted replica under "
-                           f"{me!r} failed (adoption still valid): {e!r}")
-        from ..telemetry import get_telemetry
+    pulled = None
+    peer = None
+    for attempt in range(max(1, int(retries))):
+        if attempt:
+            time.sleep(retry_delay_s)
+            logger.warning(f"resilience: adoption retry "
+                           f"{attempt + 1}/{retries} (holders may be "
+                           f"re-binding mid-reform)")
+        for cand in candidates:
+            try:
+                got = fetch_buddy_snapshot(rdzv.c, cand, out_dir)
+            except Exception as e:
+                logger.warning(f"resilience: fetch of dead peer "
+                               f"{cand!r}'s replica failed: {e!r}")
+                continue
+            if not got:
+                continue  # that peer never replicated
+            ok, detail = verify_snapshot(got)
+            if not ok:
+                logger.warning(f"resilience: dead peer {cand!r}'s "
+                               f"replica invalid: {detail}")
+                continue
+            pulled, peer = got, cand
+            break
+        if pulled:
+            break
+    if not pulled:
+        return None
+    try:
+        # re-key under OUR id: serve the adopted dir from our own
+        # replica server (+ push to our buddy) and re-point the index
+        replicate_snapshot(rdzv.c, me, pulled, rdzv=rdzv)
+    except Exception as e:
+        logger.warning(f"resilience: re-keying adopted replica under "
+                       f"{me!r} failed (adoption still valid): {e!r}")
+    from ..telemetry import get_telemetry
 
-        get_telemetry().inc_counter(
-            "resilience/replica_adoptions_total",
-            help="dead peers' tier-2 replicas adopted by replacement "
-                 "nodes (sealed-ring diff)")
-        log_dist(f"resilience: node {me} adopted dead peer {peer}'s "
-                 f"tier-2 replica -> {pulled}")
-        return pulled
-    return None
+    get_telemetry().inc_counter(
+        "resilience/replica_adoptions_total",
+        help="dead peers' tier-2 replicas adopted by replacement "
+             "nodes (sealed-ring diff)")
+    log_dist(f"resilience: node {me} adopted dead peer {peer}'s "
+             f"tier-2 replica -> {pulled}")
+    return pulled
 
 
 def bootstrap_from_peer_replica(rdzv: Any, out_dir: str) -> Optional[str]:
@@ -889,12 +937,20 @@ def bootstrap_from_peer_replica(rdzv: Any, out_dir: str) -> Optional[str]:
                 best = (ts, peer)
     if best is None:
         return None
-    try:
-        pulled = fetch_buddy_snapshot(rdzv.c, best[1], out_dir)
-    except Exception as e:
-        logger.warning(f"resilience: bootstrap fetch from {best[1]!r} "
-                       f"failed: {e!r}")
-        return None
+    pulled = None
+    for attempt in range(3):
+        if attempt:
+            # the gang is re-forming: the peer's replica server may be
+            # re-binding — brief bounded retry, same as adoption
+            time.sleep(2.0)
+        try:
+            pulled = fetch_buddy_snapshot(rdzv.c, best[1], out_dir)
+        except Exception as e:
+            logger.warning(f"resilience: bootstrap fetch from "
+                           f"{best[1]!r} failed: {e!r}")
+            pulled = None
+        if pulled:
+            break
     if not pulled:
         return None
     ok, detail = verify_snapshot(pulled)
@@ -914,30 +970,148 @@ def bootstrap_from_peer_replica(rdzv: Any, out_dir: str) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
-# tier-2 transport (chunked store, shared with debug bundles)
+# tier-2 transport (peer-to-peer replica servers; the store carries
+# index/placement metadata only)
 # ---------------------------------------------------------------------------
 
 def replicate_snapshot(client: Any, node_id: str, snap_dir: str,
                        chunk_bytes: int = 256 * 1024,
-                       max_bytes: int = 256 * 1024 * 1024) -> Dict[str, Any]:
-    """Push one committed snapshot dir to this node's store slot (its
-    buddy — any surviving host — can pull it)."""
-    from ..telemetry.aggregator import push_dir_chunked
+                       max_bytes: int = 256 * 1024 * 1024,
+                       rdzv: Any = None,
+                       buddy: Optional[str] = None) -> Dict[str, Any]:
+    """Make one committed snapshot dir fetchable by the gang:
 
-    return push_dir_chunked(
-        client, RESIL_META_KEY.format(node=node_id),
-        RESIL_CHUNK_PREFIX.format(node=node_id), snap_dir,
-        chunk_bytes=chunk_bytes, max_bytes=max_bytes,
-        priority_file=SNAPSHOT_MANIFEST, recursive=True)
+    1. serve it from this process's replica server (started on demand);
+    2. PUSH a physical copy to the buddy's replica server when one is
+       reachable (``rdzv``/``buddy`` name it; its endpoint comes from
+       the store's ``resil/srv/<buddy>`` slot) — the copy that survives
+       this host's death;
+    3. publish the INDEX metadata (tag, bytes, sha256, holder
+       endpoints) under ``resil/pub/<node_id>`` — a journaled write, so
+       it buffers through a store outage and re-seeds a restarted
+       store.  **No snapshot bytes ever enter the store.**
+    """
+    import hashlib as _hashlib
+
+    from ..telemetry.aggregator import _tar_dir
+    from .replica_server import get_local_server, push_replica
+
+    tag = os.path.basename(snap_dir.rstrip(os.sep))
+    data, dropped = _tar_dir(snap_dir, max_bytes,
+                             priority_file=SNAPSHOT_MANIFEST,
+                             recursive=True)
+    sha = _hashlib.sha256(data).hexdigest()
+    server = get_local_server(
+        create=True, base_dir=os.path.join(os.path.dirname(
+            snap_dir.rstrip(os.sep)), "replica_store"),
+        chunk_bytes=chunk_bytes, max_bytes=max_bytes)
+    server.serve(node_id, tag, snap_dir, tar=(data, sha),
+                 max_bytes=max_bytes)
+    holders: List[Dict[str, Any]] = [
+        {"node": node_id, "endpoint": server.endpoint, "path": snap_dir}]
+    if buddy is None and rdzv is not None:
+        try:
+            buddy = rdzv.buddy()
+        except Exception as e:
+            logger.warning(f"resilience: buddy lookup failed: {e!r}")
+            buddy = None
+    if buddy and buddy != node_id:
+        buddy_ep = None
+        try:
+            buddy_ep = client.get(RESIL_SRV_KEY.format(node=buddy))
+        except (OSError, ConnectionError) as e:
+            logger.warning(f"resilience: buddy endpoint lookup failed "
+                           f"(store degraded?): {e!r}")
+        if buddy_ep:
+            try:
+                held = push_replica(str(buddy_ep), node_id, tag, data,
+                                    sha, chunk_bytes=chunk_bytes)
+                holders.append({"node": buddy, "endpoint": str(buddy_ep),
+                                "path": held})
+            except Exception as e:
+                # owner-only serving still covers restarts; only a
+                # simultaneous owner+store loss needs the buddy copy
+                logger.warning(f"resilience: replica push to buddy "
+                               f"{buddy!r} ({buddy_ep}) failed: {e!r}")
+        else:
+            logger.warning(f"resilience: buddy {buddy!r} has no replica "
+                           f"server endpoint published — replica held "
+                           f"by owner only")
+    meta = {"bundle": tag, "owner": node_id, "bytes": len(data),
+            "sha256": sha, "dropped": dropped, "ts": time.time(),
+            "holders": holders}
+    try:
+        client.set(RESIL_META_KEY.format(node=node_id), meta,
+                   journal=True)
+    except TypeError:
+        # a minimal client without the journal kwarg (tests/fakes)
+        client.set(RESIL_META_KEY.format(node=node_id), meta)
+    return meta
 
 
 def fetch_buddy_snapshot(client: Any, node_id: str,
                          out_dir: str) -> Optional[str]:
-    """Pull ``node_id``'s replicated snapshot out of the store into
-    ``out_dir``; returns the extracted snapshot path, or None when that
-    node never replicated."""
-    from ..telemetry.aggregator import fetch_dir_chunked
+    """Pull ``node_id``'s replica using the store's INDEX metadata:
+    try each holder endpoint in order (owner first, then the buddy) and
+    fall through past dead peers; every fetch passes the transport
+    sha256 gate.  Returns the extracted snapshot path, None when that
+    node never replicated, and raises when holders exist but none could
+    serve a VALID copy (all dead, or all corrupt — the caller's tier
+    fallback treats that as 'no tier-2')."""
+    meta = client.get(RESIL_META_KEY.format(node=node_id))
+    if not isinstance(meta, dict):
+        return None
+    if "holders" not in meta:
+        # pre-P2P publication: bytes chunked into the store
+        from ..telemetry.aggregator import fetch_dir_chunked
 
-    return fetch_dir_chunked(
-        client, RESIL_META_KEY.format(node=node_id),
-        RESIL_CHUNK_PREFIX.format(node=node_id), out_dir)
+        return fetch_dir_chunked(
+            client, RESIL_META_KEY.format(node=node_id),
+            RESIL_CHUNK_PREFIX.format(node=node_id), out_dir)
+    from .replica_server import fetch_replica
+
+    owner = str(meta.get("owner") or node_id)
+    tag = str(meta["bundle"])
+    errors: List[str] = []
+    for holder in meta.get("holders") or []:
+        # a holder NODE is stable; its endpoint is not (worker restarts
+        # re-bind).  Prefer the holder's CURRENTLY-published server
+        # endpoint, falling back to the one recorded at placement time.
+        endpoints = []
+        hnode = holder.get("node")
+        if hnode:
+            try:
+                live = client.get(RESIL_SRV_KEY.format(node=hnode))
+            except (OSError, ConnectionError):
+                live = None  # store degraded — recorded endpoint only
+            if live:
+                endpoints.append(str(live))
+        recorded = str(holder.get("endpoint") or "")
+        if recorded and recorded not in endpoints:
+            endpoints.append(recorded)
+        dead_here = None
+        for ep in endpoints:
+            try:
+                return fetch_replica(ep, owner, tag, out_dir,
+                                     expect_sha=meta.get("sha256"))
+            except (OSError, ConnectionError) as e:
+                dead_here = e
+            except CheckpointCorruptionError as e:
+                dead_here = None
+                errors.append(f"{hnode}@{ep}: {e}")
+                break  # corrupt copy: this holder is done, move on
+        if dead_here is not None:
+            # dead/unreachable holder: fall through to the next
+            # placement candidate
+            errors.append(f"{hnode}@{endpoints}: {dead_here!r}")
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "resilience/replica_fetch_fallthroughs_total",
+                help="replica holders skipped because they were "
+                     "unreachable (fetch fell through to the next "
+                     "placement candidate)")
+    raise CheckpointCorruptionError(
+        f"tier-2 replica of {node_id!r} ({tag}) could not be fetched "
+        f"from any holder: " + "; ".join(errors or ["no holder had an "
+                                                    "endpoint"]))

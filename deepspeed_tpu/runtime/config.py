@@ -209,6 +209,9 @@ class TelemetryHealthConfig(DeepSpeedConfigModel):
     #: recompile events within `window` steps that raise a
     #: recompile_storm health event; <= 0 disables the rule
     recompile_storm_threshold: int = 3
+    #: raise a control_plane_degraded event when a rendezvous-store
+    #: client exhausts its retry budget (one per outage streak)
+    control_plane: bool = True
 
 
 class FlightRecorderConfig(DeepSpeedConfigModel):

@@ -386,6 +386,7 @@ class DeepSpeedEngine:
                 throughput_frac=h_cfg.throughput_frac,
                 compile_dominated_frac=h_cfg.compile_dominated_frac,
                 recompile_storm_threshold=h_cfg.recompile_storm_threshold,
+                control_plane=h_cfg.control_plane,
                 memory_pressure_frac=tcfg.memory.pressure_frac,
                 memory_pressure_steps=tcfg.memory.pressure_steps,
                 host_leak_window=tcfg.memory.leak_window,

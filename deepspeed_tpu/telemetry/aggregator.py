@@ -64,6 +64,18 @@ def _partial_key(node_id: str) -> str:
 # publish side (every host)
 # ---------------------------------------------------------------------------
 
+def _normalize_tarinfo(ti: tarfile.TarInfo) -> tarfile.TarInfo:
+    """Strip everything non-content from a tar member: the archive of a
+    directory must be a pure function of its FILE CONTENTS, so a holder
+    that re-tars an extracted tier-2 replica reproduces the exact bytes
+    the owner's published sha256 was computed over."""
+    ti.mtime = 0
+    ti.uid = ti.gid = 0
+    ti.uname = ti.gname = ""
+    ti.mode = 0o755 if ti.isdir() else 0o644
+    return ti
+
+
 def _tar_dir(src_dir: str, max_bytes: int, priority_file: str = "",
              recursive: bool = False) -> tuple:
     """tar.gz ``src_dir`` into memory, smallest files first under the
@@ -71,7 +83,17 @@ def _tar_dir(src_dir: str, max_bytes: int, priority_file: str = "",
     included; the biggest side file is what gets dropped.  Returns
     ``(data, dropped_names)``.  The generic half of the store transport
     — the resilience plane ships snapshot trees (``recursive=True``)
-    through the same path debug bundles use."""
+    through the same path debug bundles use.
+
+    The archive is DETERMINISTIC (gzip mtime zeroed, members fully
+    ordered, stat metadata normalized): the P2P replica transport
+    checksum-gates on the tar's sha256, and a holder serving a replica
+    it re-extracted must be able to rebuild byte-identical data.
+    (Caveat: determinism assumes one zlib build across the gang — a
+    mismatched holder fails the gate loudly and the fetch falls
+    through, never restores silently-wrong bytes.)"""
+    import gzip
+
     name = os.path.basename(src_dir.rstrip(os.sep))
     if recursive:
         entries = []
@@ -83,20 +105,24 @@ def _tar_dir(src_dir: str, max_bytes: int, priority_file: str = "",
         entries = [f for f in os.listdir(src_dir)
                    if os.path.isfile(os.path.join(src_dir, f))]
     entries.sort(key=lambda f: (f != priority_file,
-                                os.path.getsize(os.path.join(src_dir, f))))
+                                os.path.getsize(os.path.join(src_dir, f)),
+                                f))
     dropped: List[str] = []
     buf = io.BytesIO()
     budget = int(max_bytes)
-    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
-        for f in entries:
-            p = os.path.join(src_dir, f)
-            size = os.path.getsize(p)
-            # raw-size budget (compression only helps); priority always in
-            if f != priority_file and size > budget:
-                dropped.append(f)
-                continue
-            tar.add(p, arcname=f"{name}/{f}")
-            budget -= size
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+        with tarfile.open(fileobj=gz, mode="w") as tar:
+            for f in entries:
+                p = os.path.join(src_dir, f)
+                size = os.path.getsize(p)
+                # raw-size budget (compression only helps); priority
+                # always in
+                if f != priority_file and size > budget:
+                    dropped.append(f)
+                    continue
+                tar.add(p, arcname=f"{name}/{f}",
+                        filter=_normalize_tarinfo)
+                budget -= size
     return buf.getvalue(), dropped
 
 
@@ -301,7 +327,13 @@ class BundlePublisher:
     def tick(self, client: Any) -> Optional[str]:
         """One service beat: answer a pending collect request with a
         FRESH dump, else push a not-yet-published local bundle (watchdog
-        trip / crash hook).  Returns the published path, if any."""
+        trip / crash hook).  Returns the published path, if any.
+
+        Store-down beats DEGRADE instead of raising: nothing is marked
+        served/published on a failed beat (the request and the pending
+        bundle are the bounded buffer — both retry on the next healthy
+        tick), and the skipped beat is counted so the outage is visible
+        in the registry."""
         with self._tick_lock:
             try:
                 # FIRST and unconditionally: the cheap partial push must
@@ -312,23 +344,40 @@ class BundlePublisher:
                 debug_once("aggregator/partial_push",
                            f"partial-ledger push failed ({e!r}); "
                            f"retrying next tick")
-            req = int(client.get(_REQ_KEY) or 0)
-            rec = self.recorder()
-            if req > self._last_req_served:
-                # dump BEFORE marking served: a failed dump (ENOSPC mid-
-                # incident) leaves the request pending so the next tick
-                # really does retry; a failed PUBLISH after a good dump
-                # self-heals via the last_bundle_path branch below
-                bundle = rec.dump(f"operator collect request #{req}")
-                self._last_req_served = req
-                self._publish(client, bundle, req)
-                return bundle
-            last = getattr(rec, "last_bundle_path", None)
-            if last and last != self._last_published \
-                    and os.path.isdir(last):
-                self._publish(client, last, self._last_req_served)
-                return last
-            return None
+            try:
+                req = int(client.get(_REQ_KEY) or 0)
+                rec = self.recorder()
+                if req > self._last_req_served:
+                    # dump BEFORE marking served: a failed dump (ENOSPC
+                    # mid-incident) leaves the request pending so the
+                    # next tick really does retry; a failed PUBLISH after
+                    # a good dump self-heals via the last_bundle_path
+                    # branch below
+                    bundle = rec.dump(f"operator collect request #{req}")
+                    self._last_req_served = req
+                    self._publish(client, bundle, req)
+                    return bundle
+                last = getattr(rec, "last_bundle_path", None)
+                if last and last != self._last_published \
+                        and os.path.isdir(last):
+                    self._publish(client, last, self._last_req_served)
+                    return last
+                return None
+            except ConnectionError as e:
+                # control plane degraded (StoreUnavailableError is a
+                # ConnectionError; a failed DUMP — ENOSPC etc. — still
+                # propagates): publications stay pending, re-tried once
+                # the store answers again
+                from . import get_telemetry
+
+                get_telemetry().inc_counter(
+                    "aggregator/degraded_ticks_total",
+                    help="publisher beats skipped because the rendezvous "
+                         "store was unreachable (publications buffered)")
+                debug_once("aggregator/degraded_tick",
+                           f"publisher tick degraded — store unreachable "
+                           f"({e!r}); buffered for the next healthy beat")
+                return None
 
     # -- worker-side daemon (subprocess deployments) -----------------------
 

@@ -18,6 +18,10 @@ per-step record stream into structured :class:`HealthEvent`\\ s:
 * ``host_memory_leak``       — monotonic host-RSS / live-array-count
   growth vs the rolling median (a leak in host staging, snapshot
   buffers, or un-freed jax arrays; quiet on flat or sawtooth usage)
+* ``control_plane_degraded`` — a rendezvous-store client exhausted its
+  retry budget (store killed / partitioned): heartbeats and replica
+  publications are buffering, training continues blind — one event per
+  outage streak, cleared on reconnect
 
 Compile-dominated steps (``extra["compile_ms"]`` at or above
 ``compile_dominated_frac`` of the step time — the CompileTracker's
@@ -87,6 +91,7 @@ class HealthMonitor:
                  memory_pressure_steps: int = 8,
                  host_leak_window: int = 16,
                  host_leak_frac: float = 0.05,
+                 control_plane: bool = True,
                  registry: Optional[Any] = None,
                  recorder: Optional[Any] = None):
         self.min_points = max(2, int(min_points))
@@ -111,6 +116,10 @@ class HealthMonitor:
         #: ``host_leak_frac``; window < 2 disables the rule
         self.host_leak_window = int(host_leak_window)
         self.host_leak_frac = float(host_leak_frac)
+        #: alert when a rendezvous-store client is in degraded mode
+        #: (one event per outage streak, re-armed on reconnect)
+        self.control_plane = bool(control_plane)
+        self._cp_alerted = False
         self.registry = registry
         self.recorder = recorder
         w = max(int(window), self.min_points)
@@ -389,6 +398,33 @@ class HealthMonitor:
                     xs[-1], _median(xs) * (1.0 + self.host_leak_frac)))
                 self._live.clear()
 
+    def _check_control_plane(self, rec: StepRecord,
+                             out: List[HealthEvent]) -> None:
+        """One ``control_plane_degraded`` event per store-outage streak:
+        a degraded rendezvous client means heartbeats / tier-2 replica
+        publications are BUFFERING (they replay on reconnect) and the
+        gang is blind to this node — training itself continues, which is
+        exactly why an operator needs the structured alert."""
+        if not self.control_plane:
+            return
+        from ..elasticity.rendezvous import control_plane_status
+
+        st = control_plane_status()
+        if not st["degraded"]:
+            self._cp_alerted = False
+            return
+        if self._cp_alerted:
+            return
+        self._cp_alerted = True
+        out.append(HealthEvent(
+            "control_plane_degraded", SEV_WARNING, rec.step,
+            f"step {rec.step}: rendezvous store unreachable for "
+            f"{st['degraded_for_s']:.1f}s ({st['clients']} client(s) "
+            f"degraded) — heartbeats and replica-index writes are "
+            f"buffered and replay on reconnect; training continues but "
+            f"the gang cannot see this node",
+            st["degraded_for_s"], 0.0))
+
     # -- the feed ----------------------------------------------------------
 
     def observe(self, rec: StepRecord) -> List[HealthEvent]:
@@ -401,6 +437,7 @@ class HealthMonitor:
         self._check_recompile_storm(rec, out)
         self._check_memory_pressure(rec, out)
         self._check_host_leak(rec, out)
+        self._check_control_plane(rec, out)
         for ev in out:
             self._publish(ev)
         return out

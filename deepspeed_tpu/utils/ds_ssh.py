@@ -2,6 +2,11 @@
 
 Reference: ``bin/ds_ssh`` [K]: parallel-ssh a shell command across the
 hostfile (ops convenience for pod management).
+
+One hung host must not block the whole fan-out (ISSUE 11 satellite):
+each host gets a per-host ``--timeout``; a host that blows it is
+killed, reported with ``rc=timeout``, and listed explicitly in the
+summary line — the command still returns nonzero so scripts notice.
 """
 
 from __future__ import annotations
@@ -9,14 +14,23 @@ from __future__ import annotations
 import argparse
 import subprocess
 import sys
+import time
 from typing import List
 
 from ..launcher.runner import DLTS_HOSTFILE, parse_hostfile
+
+#: rc reported for a host that exceeded the per-host timeout (the
+#: shell convention for "timed out", distinct from any ssh rc)
+TIMEOUT_RC = 124
 
 
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(prog="ds_ssh")
     parser.add_argument("--hostfile", "-f", default=DLTS_HOSTFILE)
+    parser.add_argument("--timeout", "-t", type=float, default=120.0,
+                        help="per-host timeout in seconds; a host that "
+                             "exceeds it is killed and reported as "
+                             "timed out (<= 0 waits forever)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if not args.command:
@@ -27,11 +41,32 @@ def main(argv: List[str] = None) -> int:
                                  stderr=subprocess.STDOUT)
              for h in hosts}
     rc = 0
+    timed_out: List[str] = []
+    # ONE shared deadline from spawn: the processes all run in
+    # parallel, so a pod of uniformly-hung hosts must cost ~one
+    # timeout total, not hosts x timeout sequentially
+    deadline = time.monotonic() + args.timeout if args.timeout > 0 \
+        else None
     for h, p in procs.items():
-        out, _ = p.communicate()
-        print(f"----- {h} (rc={p.returncode})")
-        sys.stdout.write(out.decode(errors="replace"))
-        rc = rc or p.returncode
+        try:
+            remaining = None
+            if deadline is not None:
+                remaining = max(deadline - time.monotonic(), 0.1)
+            out, _ = p.communicate(timeout=remaining)
+            host_rc = p.returncode
+        except subprocess.TimeoutExpired:
+            # kill + reap: a wedged ssh must not leak, and the next
+            # host's communicate() must not inherit the stall
+            p.kill()
+            out, _ = p.communicate()
+            host_rc = TIMEOUT_RC
+            timed_out.append(h)
+        print(f"----- {h} (rc={'timeout' if h in timed_out else host_rc})")
+        sys.stdout.write((out or b"").decode(errors="replace"))
+        rc = rc or host_rc
+    if timed_out:
+        print(f"----- TIMED OUT after {args.timeout:.0f}s: "
+              f"{', '.join(timed_out)}")
     return rc
 
 

@@ -36,7 +36,8 @@ SHARDS=(
   "tests/unit/perf"
   "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
-  "tests/unit/multiprocess"
+  "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py"
+  "tests/unit/multiprocess/test_chaos_control_plane.py -m chaos"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
 )
 
@@ -134,7 +135,9 @@ fi
 if python -m deepspeed_tpu.resilience ls "$smoke_dir/snaps" >/dev/null \
    && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" >/dev/null \
    && python -m deepspeed_tpu.resilience verify "$smoke_dir/snaps" \
-        --target-mesh 3 >/dev/null; then
+        --target-mesh 3 >/dev/null \
+   && python -m deepspeed_tpu.resilience faults \
+        | grep -q "sigstop_hang"; then
   echo "=== resilience CLI smoke passed"
 else
   echo "=== resilience CLI smoke FAILED"

@@ -29,6 +29,11 @@ def _fresh_diagnostics():
         gp = get_goodput_ledger()
         gp.reset()
         gp.enabled = False
+        # the P2P tier-2 replica server is process-global too: shut it
+        # down so served-dir registrations never leak across tests
+        from deepspeed_tpu.resilience.replica_server import set_local_server
+
+        set_local_server(None)
 
     scrub()
     yield

@@ -1,0 +1,106 @@
+"""ds_ssh per-host timeout (ISSUE 11 satellite): one hung host must
+not block the whole fan-out — it is killed, reported as ``rc=timeout``,
+listed explicitly, and the overall rc goes nonzero."""
+
+import subprocess
+
+import pytest
+
+from deepspeed_tpu.utils import ds_ssh
+
+
+@pytest.fixture()
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("fast1 slots=1\nhung1 slots=1\nfast2 slots=1\n")
+    return str(p)
+
+
+class _FakeProc:
+    def __init__(self, host, hang):
+        self.host = host
+        self.hang = hang
+        self.returncode = None
+        self.killed = False
+
+    def communicate(self, timeout=None):
+        if self.hang and not self.killed:
+            if timeout is not None:
+                raise subprocess.TimeoutExpired(cmd=["ssh", self.host],
+                                                timeout=timeout)
+            raise AssertionError("would hang forever without a timeout")
+        self.returncode = 0 if not self.hang else -9
+        return (f"out-{self.host}\n".encode(), b"")
+
+    def kill(self):
+        self.killed = True
+
+
+def _patch_popen(monkeypatch):
+    spawned = {}
+
+    def fake_popen(cmd, **kw):
+        host = cmd[1]
+        proc = _FakeProc(host, hang=host.startswith("hung"))
+        spawned[host] = proc
+        return proc
+
+    monkeypatch.setattr(ds_ssh.subprocess, "Popen", fake_popen)
+    return spawned
+
+
+def test_hung_host_is_killed_reported_and_nonzero(hostfile, monkeypatch,
+                                                  capsys):
+    spawned = _patch_popen(monkeypatch)
+    rc = ds_ssh.main(["--hostfile", hostfile, "--timeout", "0.1",
+                      "echo", "hi"])
+    assert rc == ds_ssh.TIMEOUT_RC
+    assert spawned["hung1"].killed  # killed, not leaked
+    out = capsys.readouterr().out
+    assert "fast1 (rc=0)" in out and "fast2 (rc=0)" in out
+    assert "hung1 (rc=timeout)" in out
+    assert "TIMED OUT" in out and "hung1" in out.split("TIMED OUT")[1]
+    # the fast hosts' output still made it through
+    assert "out-fast1" in out and "out-fast2" in out
+
+
+def test_all_healthy_hosts_exit_zero(tmp_path, monkeypatch, capsys):
+    p = tmp_path / "hf"
+    p.write_text("fastA slots=1\nfastB slots=1\n")
+    _patch_popen(monkeypatch)
+    rc = ds_ssh.main(["--hostfile", str(p), "--timeout", "5", "uptime"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "TIMED OUT" not in out
+
+
+def test_timeout_deadline_is_shared_across_hosts(tmp_path, monkeypatch):
+    """Review fix: the per-host timeout is one SHARED deadline from
+    spawn — N uniformly hung hosts cost ~one timeout total, not N."""
+    p = tmp_path / "hf"
+    p.write_text("hungA slots=1\nhungB slots=1\nhungC slots=1\n")
+    seen = []
+
+    class _Hung:
+        def __init__(self, host):
+            self.host = host
+            self.returncode = None
+
+        def communicate(self, timeout=None):
+            seen.append(timeout)
+            raise subprocess.TimeoutExpired(cmd=["ssh", self.host],
+                                            timeout=timeout)
+
+        def kill(self):
+            self.returncode = -9
+            # once killed, the reap returns immediately
+            self.communicate = lambda timeout=None: (b"", b"")
+
+    monkeypatch.setattr(ds_ssh.subprocess, "Popen",
+                        lambda cmd, **kw: _Hung(cmd[1]))
+    rc = ds_ssh.main(["--hostfile", str(p), "--timeout", "10", "echo"])
+    assert rc == ds_ssh.TIMEOUT_RC
+    # each later host got only the REMAINING budget (monotonically
+    # non-increasing), never a fresh full timeout
+    assert len(seen) == 3 and seen[0] <= 10.0
+    assert seen[1] <= seen[0] and seen[2] <= seen[1]

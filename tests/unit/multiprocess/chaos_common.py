@@ -1,0 +1,66 @@
+"""Shared tiny problem for the control-plane chaos tier (ISSUE 11).
+
+Every gang member AND the single-process oracle build the SAME engine
+and consume the SAME per-step batch stream, so any process's loss at
+step ``s`` equals the oracle's — snapshots capture the full state and
+batches are a pure function of the step index, which is what makes the
+"post-resume loss sequence matches an uninterrupted run" acceptance
+assertable across real kill -9 chaos."""
+
+import os
+
+import numpy as np
+
+HIDDEN = 16
+ROWS = 8
+
+
+def batch_for_step(step):
+    """The batch consumed BY the step after ``step`` — deterministic in
+    the step index alone, so resumes never need data-cursor replay."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(500 + int(step))
+    x = rng.normal(size=(ROWS, HIDDEN)).astype(np.float32)
+    return (jnp.asarray(x), jnp.zeros((ROWS, 1), jnp.float32))
+
+
+def build_engine(node_dir, resilience=True):
+    """One deterministic 1-device engine.  With ``resilience`` on:
+    per-step snapshots, sync flush, buddy tier (P2P replica server +
+    store index) — the full ISSUE 11 surface.  The oracle runs with it
+    off."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dst
+
+    rng = np.random.default_rng(11)
+    params = {"w": jnp.asarray(
+        rng.normal(size=(HIDDEN, 1)).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": ROWS,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "output_path": node_dir,
+                      "job_name": "chaos",
+                      "watchdog": {"enabled": False},
+                      "flight_recorder": {"install_handlers": False}},
+    }
+    if resilience:
+        cfg["resilience"] = {
+            "enabled": True, "snapshot_interval": 1,
+            "snapshot_dir": os.path.join(node_dir, "snaps"),
+            "flush_engine": "sync", "buddy_tier": True,
+            "keep_snapshots": 3,
+            "backoff_base_s": 0.0, "backoff_max_s": 0.0,
+        }
+    engine, _, _, _ = dst.initialize(model=loss_fn,
+                                     model_parameters=params,
+                                     config=cfg,
+                                     dist_init_required=False)
+    return engine

@@ -1,0 +1,50 @@
+"""Multiprocess-shard plumbing (ISSUE 11 satellite): the chaos tests
+get a dedicated ``chaos`` marker (always implies ``slow`` so tier-1
+stays fast) and a per-test hard timeout — a wedged real-process gang
+must fail the TEST with a named timeout, not hang the whole suite
+until the shard's outer ``timeout(1)`` kills it silently."""
+
+import signal
+
+import pytest
+
+#: default hard timeout for chaos-marked tests lacking an explicit
+#: @pytest.mark.timeout(N)
+CHAOS_DEFAULT_TIMEOUT_S = 420
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if item.get_closest_marker("chaos"):
+            item.add_marker(pytest.mark.slow)
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    """SIGALRM-based per-test deadline honoring ``@pytest.mark.
+    timeout(seconds)`` (chaos tests default to
+    CHAOS_DEFAULT_TIMEOUT_S).  In-process and dependency-free — the
+    image ships no pytest-timeout."""
+    marker = request.node.get_closest_marker("timeout")
+    if marker is not None and marker.args:
+        seconds = int(marker.args[0])
+    elif request.node.get_closest_marker("chaos"):
+        seconds = CHAOS_DEFAULT_TIMEOUT_S
+    else:
+        seconds = 0
+    if seconds <= 0:
+        yield
+        return
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"per-test timeout: {request.node.nodeid} exceeded "
+            f"{seconds}s (chaos gang wedged?)")
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

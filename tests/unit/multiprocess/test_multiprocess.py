@@ -305,7 +305,33 @@ def test_elastic_failure_resume_at_new_world_size(tmp_path):
             _time.sleep(1.0)
         assert (tmp_path / "ckpt").exists(), \
             "pre-kill attempt never saved\n" + _logs()
-        _time.sleep(5.0)  # let the collective save commit
+
+        # wait until the collective save has COMMITTED before killing:
+        # a fixed sleep races the writer under load — the kill then
+        # tears the checkpoint and the survivor "resumes" from scratch.
+        # Quiesce = no file in the tree changed for a full 3 s.
+        def _tree_stamp():
+            out = []
+            for root, _dirs, files in os.walk(tmp_path / "ckpt"):
+                for f in files:
+                    p = os.path.join(root, f)
+                    try:
+                        st = os.stat(p)
+                        out.append((p, st.st_mtime_ns, st.st_size))
+                    except OSError:
+                        pass  # mid-rename
+            return sorted(out)
+
+        deadline = _time.time() + 120
+        stamp = _tree_stamp()
+        quiet_since = _time.time()
+        while _time.time() < deadline:
+            _time.sleep(0.5)
+            cur = _tree_stamp()
+            if cur != stamp:
+                stamp, quiet_since = cur, _time.time()
+            elif _time.time() - quiet_since >= 3.0:
+                break
         a1.send_signal(signal.SIGKILL)  # node loss — no goodbye
         a1.wait(timeout=15)
         (tmp_path / "kill_done").touch()  # flip workers to report phase

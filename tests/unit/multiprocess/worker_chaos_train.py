@@ -1,0 +1,60 @@
+"""Control-plane chaos worker: real training under the DSElasticAgent
+with per-step snapshots and the P2P buddy tier on.
+
+The worker just trains — every bit of choreography (store kill -9,
+restart, node kill, replacement join) happens around it.  Each step
+appends one JSON line to ``T_OUT/<node>.losses.jsonl``; the test reads
+those files to prove training CONTINUED through the store outage and
+that post-resume losses match the uninterrupted oracle.  Faults (the
+``kill_store``/``restart_store``/``partition_node``/``sigstop_hang``
+kinds) arrive via each node's ``DS_FAULTS`` env — the real-process
+fault harness, not a thread simulation.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["T_REPO"])
+sys.path.insert(0, os.path.dirname(__file__))
+
+from chaos_common import batch_for_step, build_engine  # noqa: E402
+
+
+def main() -> int:
+    node = os.environ["DS_ELASTIC_NODE_ID"]
+    out = os.environ["T_OUT"]
+    restart = int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0"))
+    node_dir = os.path.join(out, node)
+    engine = build_engine(node_dir)
+    resumed = int(engine.global_steps)
+    losses_path = os.path.join(out, f"{node}.losses.jsonl")
+    stop_marker = os.path.join(out, "stop")
+    step_sleep = float(os.environ.get("T_STEP_SLEEP", "0.3"))
+    while engine.global_steps < 500:
+        if os.path.exists(stop_marker):
+            break
+        metrics = engine.train_step(
+            batch_for_step(engine.global_steps))
+        with open(losses_path, "a") as fh:
+            fh.write(json.dumps({
+                "node": node, "restart": restart,
+                "step": int(engine.global_steps),
+                "loss": float(metrics["loss"])}) + "\n")
+        time.sleep(step_sleep)
+    with open(os.path.join(out, f"{node}.final.json"), "w") as fh:
+        json.dump({"node": node, "restart": restart,
+                   "resumed_step": resumed,
+                   "final_step": int(engine.global_steps)}, fh)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

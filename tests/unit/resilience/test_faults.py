@@ -78,3 +78,127 @@ def test_corrupt_snapshot_fault_defeats_checksum(tiny_engine_factory):
     assert not ok2 and "checksum" in detail or "sha256" in detail
     chosen = choose_resume_snapshot(engine.snapshots.snapshot_dir)
     assert chosen == by_step[3]  # newest valid wins, corrupt one skipped
+
+
+# ---------------------------------------------------------------------------
+# process-level chaos kinds (ISSUE 11 tentpole c)
+# ---------------------------------------------------------------------------
+
+def test_parse_process_level_chaos_kinds():
+    f = parse_fault("kill_store@80")
+    assert f.kind == "kill_store" and f.step == 80
+    f = parse_fault("restart_store@90:delay_s=2")
+    assert f.params["delay_s"] == "2"
+    f = parse_fault("partition_node@100:seconds=5,rank=1")
+    assert f.kind == "partition_node" and f.params["rank"] == "1"
+    assert parse_fault("sigstop_hang@120:seconds=10").step == 120
+
+
+def test_fault_docs_cover_every_kind():
+    """The CLI catalogue and the parser can't drift: KINDS derives from
+    FAULT_DOCS, and every documented kind parses."""
+    from deepspeed_tpu.resilience import FAULT_DOCS
+
+    for kind in FAULT_DOCS:
+        assert parse_fault(f"{kind}@1").kind in FAULT_DOCS
+
+
+def test_kill_store_fires_callback_and_pid(monkeypatch):
+    from deepspeed_tpu.resilience.faults import Fault
+
+    fired = []
+    inj = FaultInjector([Fault("kill_store", 2, {})], rank=0)
+    inj.on_store_kill(lambda: fired.append("cb"))
+    inj.apply(2, None)
+    assert fired == ["cb"] and inj.injected == 1
+
+    # pid path: SIGKILL goes to the pid named by the spec
+    import signal as signal_mod
+
+    kills = []
+    monkeypatch.setattr("os.kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    inj2 = FaultInjector([Fault("kill_store", 3, {"pid": "4242"})], rank=0)
+    inj2.apply(3, None)
+    assert kills == [(4242, signal_mod.SIGKILL)]
+
+
+def test_restart_store_spawns_standalone_store_module(monkeypatch):
+    import time as time_mod
+
+    from deepspeed_tpu.resilience import faults as faults_mod
+    from deepspeed_tpu.resilience.faults import Fault
+
+    spawned = []
+
+    class _P:
+        pass
+
+    monkeypatch.setattr(faults_mod.subprocess, "Popen",
+                        lambda cmd, **kw: spawned.append((cmd, kw)) or _P())
+    inj = FaultInjector([Fault("restart_store", 2,
+                               {"endpoint": "127.0.0.1:29400",
+                                "delay_s": "0"})], rank=0)
+    inj.apply(2, None)
+    deadline = time_mod.monotonic() + 5.0
+    while not spawned and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.01)
+    assert spawned, "restart_store never spawned the store module"
+    cmd, kw = spawned[0]
+    assert "deepspeed_tpu.elasticity.store" in cmd
+    assert cmd[-1] == "127.0.0.1:29400" and kw["start_new_session"]
+
+
+def test_partition_node_blackholes_live_clients():
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer,
+                                                     StoreUnavailableError)
+    from deepspeed_tpu.resilience.faults import Fault
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint, retries=0, backoff_s=0.001)
+        c.set("k", 1)
+        inj = FaultInjector([Fault("partition_node", 2,
+                                   {"seconds": "0.2"})], rank=0)
+        inj.apply(2, None)
+        with pytest.raises(StoreUnavailableError):
+            c.get("k")
+        import time as time_mod
+
+        time_mod.sleep(0.25)
+        assert c.get("k") == 1  # partition healed
+    finally:
+        srv.shutdown()
+
+
+def test_sigstop_hang_stops_self_with_resume_helper(monkeypatch):
+    """sigstop_hang must spawn the CONT helper BEFORE stopping itself
+    (stopping first would hang forever) — asserted with both actions
+    faked."""
+    from deepspeed_tpu.resilience import faults as faults_mod
+    from deepspeed_tpu.resilience.faults import Fault
+
+    order = []
+    monkeypatch.setattr(
+        faults_mod.subprocess, "Popen",
+        lambda cmd, **kw: order.append(("helper", cmd)) or object())
+    monkeypatch.setattr(faults_mod.os, "kill",
+                        lambda pid, sig: order.append(("kill", pid, sig)))
+    inj = FaultInjector([Fault("sigstop_hang", 2, {"seconds": "3"})],
+                        rank=0)
+    inj.apply(2, None)
+    assert [o[0] for o in order] == ["helper", "kill"]
+    import signal as signal_mod
+
+    assert order[1][2] == signal_mod.SIGSTOP
+    assert "kill -CONT" in order[0][1][-1]
+
+
+def test_rank_guard_applies_to_chaos_kinds():
+    from deepspeed_tpu.resilience.faults import Fault
+
+    inj = FaultInjector([Fault("partition_node", 2, {"rank": "1"})],
+                        rank=0)
+    inj.apply(2, None)  # other rank: no fire, slot burned
+    assert inj.injected == 0 and inj.faults[0].fired
