@@ -42,6 +42,13 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: "flash" → the Pallas online-softmax kernel, non-causal, with the
+    #: padding mask riding in as segment ids (kernels.flash_attention
+    #: config knob / model.attn_impl tuning dimension); "xla" → the
+    #: einsum+softmax left to XLA's fuser (at S=512 flash tiling is
+    #: roughly break-even — the knob exists so the tuning plane can
+    #: measure, not assume)
+    attn_impl: str = "xla"
 
     @property
     def hd(self) -> int:
@@ -194,11 +201,23 @@ class BertModel:
         q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-        scale = 1.0 / np.sqrt(c.hd)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
-        s = jnp.where(pad_mask[:, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        if c.attn_impl == "flash":
+            # padding rides as segment ids: real tokens are segment 1,
+            # pads segment 0, so cross-segment pairs mask out in-kernel.
+            # (A pad QUERY then attends only pads where the dense path
+            # lets it see real keys — those rows are -100-masked in the
+            # loss, and the parity test compares real rows only.)
+            from ..ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(q, kk, vv, causal=False,
+                                   segment_ids=pad_mask.astype(jnp.int32))
+        else:
+            scale = 1.0 / np.sqrt(c.hd)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                           kk).astype(jnp.float32) * scale
+            s = jnp.where(pad_mask[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
         out = jnp.einsum("bshd,hdH->bsH", attn, lp["attn"]["wo"].astype(dt)) \
             + lp["attn"]["bo"].astype(dt)
         x = _layer_norm(x + out, lp["attn_ln_w"].astype(dt),

@@ -71,6 +71,11 @@ class LlamaConfig:
     #: "flash" → Pallas online-softmax kernel (TPU; falls back to XLA off-TPU),
     #: "xla" → einsum+softmax left to the XLA fuser
     attn_impl: str = "xla"
+    #: flash kernel block sizes; 0 = the seq-length-aware table
+    #: (ops/pallas/lattice.auto_flash_blocks) — surfaced so the tuning
+    #: plane's kernels.flash_block_* dimensions reach the kernel
+    flash_block_q: int = 0
+    flash_block_k: int = 0
 
     @property
     def hd(self) -> int:
@@ -397,7 +402,9 @@ class LlamaModel:
 
                 # window rides into the kernel: k-blocks wholly outside the
                 # window are skipped, so windowed work is O(S·W), not O(S²)
-                return flash_attention(q, kk, vv, True, window=W)
+                return flash_attention(q, kk, vv, True,
+                                       block_q=c.flash_block_q,
+                                       block_k=c.flash_block_k, window=W)
             from ..ops.masks import local_attention_mask
 
             pos = jnp.arange(S)
@@ -471,7 +478,9 @@ class LlamaModel:
         if c.attn_impl == "flash":
             from ..ops.pallas.flash_attention import flash_attention
 
-            attn = flash_attention(q, kk, vv, True, window=W)
+            attn = flash_attention(q, kk, vv, True,
+                                   block_q=c.flash_block_q,
+                                   block_k=c.flash_block_k, window=W)
         else:
             from ..ops.masks import local_attention_mask
 

@@ -1,12 +1,17 @@
 """Pallas TPU kernels (reference csrc CUDA kernel roles, SURVEY §2.2):
 flash attention (csrc/transformer fused attention), decode attention w/ KV
 cache (csrc/transformer/inference), int8 quantizer (csrc/quantization for
-ZeRO++ compressed collectives)."""
+ZeRO++ compressed collectives), one-pass fused Adam (csrc/adam fused
+optimizer), and the shared block skip lattice every attention kernel
+plans against."""
 
 from .block_sparse_attention import block_sparse_attention
 from .decode_attention import decode_attention
 from .flash_attention import flash_attention
+from .fused_optimizer import (FusedAdamConfig, apply_fused_adam,
+                              fused_adam_tree, tree_sqsum)
 from .quantizer import dequantize_int8, quantize_int8
 
 __all__ = ["flash_attention", "decode_attention", "quantize_int8",
-           "dequantize_int8", "block_sparse_attention"]
+           "dequantize_int8", "block_sparse_attention", "FusedAdamConfig",
+           "apply_fused_adam", "fused_adam_tree", "tree_sqsum"]

@@ -55,6 +55,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import lattice
+
 
 # ---------------------------------------------------------------------------
 # host-side planning
@@ -78,10 +80,9 @@ def _plan(layout: np.ndarray, S: int, block_q: int, block_k: int,
     H, nb, _ = layout.shape
     nq, nk = S // block_q, S // block_k
     qc, kc = block_q // cb, block_k // cb
-    lay = layout.astype(np.int8)
-    if causal:
-        # cells strictly above the diagonal contribute nothing
-        lay = np.stack([np.tril(l) for l in lay])
+    # the shared skip lattice (ops/pallas/lattice.py): cells the causal
+    # triangle kills are dropped by the SAME rule flash uses
+    lay = lattice.apply_lattice(layout.astype(np.int8), causal, cb=cb)
     lists = [[[] for _ in range(nq)] for _ in range(H)]
     for h in range(H):
         coarse = lay[h].reshape(nq, qc, nk, kc).any(axis=(1, 3))
@@ -131,9 +132,7 @@ def _plan_flat(layout: np.ndarray, S: int, block_q: int, block_k: int,
     H, nb, _ = layout.shape
     nq, nk = S // block_q, S // block_k
     qc, kc = block_q // cb, block_k // cb
-    lay = layout.astype(np.int8)
-    if causal:
-        lay = np.stack([np.tril(l) for l in lay])
+    lay = lattice.apply_lattice(layout.astype(np.int8), causal, cb=cb)
     pairs = []
     for h in range(H):
         coarse = lay[h].reshape(nq, qc, nk, kc).any(axis=(1, 3))
@@ -174,13 +173,11 @@ def _keep_tile(cell, kj, qi, *, block_q: int, block_k: int, cb: int,
     qc, kc = block_q // cb, block_k // cb
     if qc == 1 and kc == 1:
         # kernel block == cell: a planned tile is live by construction,
-        # so the mask is just causality — no kron expansion matmuls
-        if not causal:
-            return jnp.ones((block_q, block_k), jnp.bool_)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_off = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        return q_pos >= kj * block_k + k_off
+        # so the mask is just causality — the SHARED lattice tile mask
+        # (the rule flash uses), no kron expansion matmuls
+        keep = lattice.tile_keep(qi, kj, block_q, block_k, causal)
+        return keep if keep is not None else jnp.ones(
+            (block_q, block_k), jnp.bool_)
     # 0/1 expansion matmuls: keep = R @ cell @ K (an in-kernel kron;
     # Mosaic rejects the naive broadcast+reshape-merge lowering)
     ri = jax.lax.broadcasted_iota(jnp.int32, (block_q, qc), 0) // cb
@@ -195,11 +192,9 @@ def _keep_tile(cell, kj, qi, *, block_q: int, block_k: int, cb: int,
         K, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     keep = keep_f > 0.5
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_off = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        keep = keep & (q_pos >= kj * block_k + k_off)
+    causal_keep = lattice.tile_keep(qi, kj, block_q, block_k, causal)
+    if causal_keep is not None:
+        keep = keep & causal_keep
     return keep
 
 
@@ -425,10 +420,58 @@ def _norm_layout(layout: np.ndarray, h: int) -> np.ndarray:
 
 
 #: PER-PLANE element bound (S·d of K, same for V) for the resident
-#: kernel; K+V together then occupy up to 2x this.  2M elems/plane =
-#: 8 MiB/plane in bf16 — comfortably inside a v5e core's ~64 MiB VMEM
-#: alongside q/acc scratch, with headroom for fp32 inputs (2x bytes)
-_RESIDENT_VMEM_ELEMS = 2 * 1024 * 1024
+#: kernel — ONE bound shared with flash (ops/pallas/lattice.py) so the
+#: two kernel families cannot disagree about what "fits VMEM"
+_RESIDENT_VMEM_ELEMS = lattice.RESIDENT_VMEM_ELEMS
+
+#: measured kernel-overhead factor vs the dense fused-matmul path
+#: (v5e, bf16, d=64, BigBird-style layouts): the tile loop wins when
+#: ``1/(overhead · live) > 1``, and the fixed per-tile cost inflates the
+#: factor at short S — which is exactly how BENCH_r04 lost at 4k
+#: (``block_sparse_speedup_s4096 = 0.96``: near-dense coarsened layout
+#: plus a 1.7x overhead floor).  (S_max, factor) pairs, first match.
+_KERNEL_OVERHEAD_BY_S: Tuple[Tuple[int, float], ...] = (
+    (2048, 2.2), (4096, 1.7), (8192, 1.4), (1 << 62, 1.3))
+
+
+def _kernel_overhead(S: int) -> float:
+    for cap, ov in _KERNEL_OVERHEAD_BY_S:
+        if S <= cap:
+            return ov
+    return _KERNEL_OVERHEAD_BY_S[-1][1]
+
+
+def dense_live_threshold(S: int) -> float:
+    """Live fraction above which the dense masked path is expected to
+    beat the tile kernel at this seq length — the CROSSOVER the
+    auto-dispatch enforces, so the kernel never loses to its own
+    fallback (a sub-1.0 ``block_sparse_speedup_*`` bench entry is a
+    dispatch bug, not a tuning note)."""
+    return min(1.0 / _kernel_overhead(S), 0.95)
+
+
+def choose_impl(S: int, d: int, live_frac: float,
+                interpret: bool = False) -> str:
+    """The ONE forward dispatch contract: "dense" (the flash-class XLA
+    fallback), "resident" (VMEM-resident tile kernel), or "gather"
+    (splash-style streamed kernel).  Interpret mode always exercises a
+    kernel; beyond ``_DENSE_DISPATCH_MAX_S`` the dense path's O(S²)
+    logits stop being materializable regardless of live fraction."""
+    if interpret:
+        return ("resident" if S * d <= _RESIDENT_VMEM_ELEMS else "gather")
+    if S <= _DENSE_DISPATCH_MAX_S and live_frac > dense_live_threshold(S):
+        return "dense"
+    if S * d <= _RESIDENT_VMEM_ELEMS:
+        return "resident"
+    return "gather"
+
+
+def _bs_auto_block(S: int, cb: int) -> int:
+    """Default kernel block for this seq length: cell-matched 128 at
+    short/medium S (no live-coverage inflation, causality-only tile
+    masks — measured 2.8x the dense vjp at S=4096); 256 at S≥8k where
+    per-tile DMA latency starts to dominate the gather walk."""
+    return max(cb, 128 if S <= 4096 else 256)
 
 
 def _select_fwd(q, interpret):
@@ -1002,9 +1045,7 @@ def _sparse_bwd_pallas(q, k, v, o, lse, do, layout, cb, causal,
     # blocks the flat walks never visit (fully-dead rows/columns — e.g.
     # strictly-above-diagonal under causal) hold uninitialized memory:
     # zero them from one vectorized coarse-liveness reduction
-    lay_b = layout.astype(bool)
-    if causal:
-        lay_b = np.stack([np.tril(l) for l in lay_b])
+    lay_b = lattice.apply_lattice(layout.astype(bool), causal, cb=cb)
     coarse = lay_b.reshape(H, nq, block_q // cb, nk,
                            block_k // cb).any(axis=(2, 4))  # [H, nq, nk]
     hl = np.arange(h) % H
@@ -1044,8 +1085,11 @@ def _bs_bwd(layout_key, causal, block_q, block_k, cb, interpret, res, do):
     # beyond _DENSE_DISPATCH_MAX_S the dense vjp's O(S^2) logits stop
     # being materializable, so the sparse form runs regardless of live
     # fraction (a 0.6-live S=32k layout must not OOM in backward when the
-    # forward deliberately routed it to the kernel)
-    if live_frac <= 0.5 or S > _DENSE_DISPATCH_MAX_S:
+    # forward deliberately routed it to the kernel).  The live threshold
+    # is the SAME crossover the forward dispatch uses (choose_impl) so
+    # the two sites cannot drift.
+    if (live_frac <= dense_live_threshold(S)
+            or S > _DENSE_DISPATCH_MAX_S):
         if not interpret:
             return _sparse_bwd_pallas(q, k, v, o, lse, do, layout, cb,
                                       causal, block_q, block_k,
@@ -1078,7 +1122,7 @@ _bs_attention.defvjp(_bs_vjp_fwd, _bs_bwd)
 
 def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            sparsity_config: Any, causal: bool = False,
-                           block_q: int = 256, block_k: int = 256,
+                           block_q: int = 0, block_k: int = 0,
                            interpret: bool | None = None) -> jnp.ndarray:
     """[B, S, h, d] attention executing ONLY the k-blocks the config's
     layout marks live (per head when the layout is per-head).  Numerics
@@ -1089,10 +1133,14 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     128-blocks match the cell granularity, so coarsening inflates no
     live coverage, the per-tile mask is causality alone, and the flat
     backward runs 2.8x the dense vjp (256-blocks: 0.9x — coarsened live
-    0.26→0.51 erases the win) while the forward is within 3%.  So when
-    the config's cell fits, the kernel block snaps DOWN to the cell
-    size (floor 128); explicit smaller ``block_q/block_k`` still
-    apply."""
+    0.26→0.51 erases the win) while the forward is within 3%.
+    ``block_q``/``block_k`` 0 → :func:`_bs_auto_block` (seq-length
+    aware: 128 to 4k, 256 beyond); explicit sizes still apply.
+
+    Dispatch is :func:`choose_impl`'s crossover contract: above the
+    per-seq-length live-fraction threshold the DENSE masked path is the
+    faster correct implementation, and auto-dispatch takes it — the
+    kernel never loses to its own fallback."""
     B, S, h, d = q.shape
     cb = sparsity_config.block
     layout = _norm_layout(sparsity_config.make_layout(S), h)
@@ -1100,8 +1148,9 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         if jax.default_backend() != "tpu":
             return _dense_reference(q, k, v, layout, cb, causal)
         interpret = False
-    block_q = min(block_q, max(cb, 128))
-    block_k = min(block_k, max(cb, 128))
+    auto = _bs_auto_block(S, cb)
+    block_q = min(block_q, auto) if block_q else auto
+    block_k = min(block_k, auto) if block_k else auto
 
     def fits(b):
         return b >= cb and b % cb == 0 and S % b == 0 and b % 8 == 0
@@ -1117,15 +1166,13 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # granularity (a 256-token block is live if ANY of its 16-token cells
     # is) — when most kernel blocks are live, the dense masked path's big
     # fused matmuls beat the tile loop (measured: cb=16 BigBird at S=4096
-    # coarsens to 0.92 live and dense wins 2x).  Auto-dispatch exists to
-    # pick the fastest correct impl, so route those to dense — but NOT
-    # in interpret mode (that flag means "exercise the kernel", and the
-    # kernel tests' tiny grids coarsen dense), and NOT at long S, where
-    # the dense path's O(S^2) logits/mask stop being materializable.
+    # coarsens to 0.92 live and dense wins 2x).  choose_impl owns the
+    # crossover (per-seq-length live threshold — the r04 0.96@4k fix);
+    # interpret mode always exercises a kernel (tests' tiny grids
+    # coarsen dense), and past _DENSE_DISPATCH_MAX_S dense cannot run.
     _, counts, _ = _plan(layout, S, block_q, block_k, cb, causal)
-    if (not interpret and S <= _DENSE_DISPATCH_MAX_S
-            and _live_fraction(counts, S, block_q, block_k,
-                               causal) > 0.6):
+    live = _live_fraction(counts, S, block_q, block_k, causal)
+    if choose_impl(S, d, live, bool(interpret)) == "dense":
         return _dense_reference(q, k, v, layout, cb, causal)
     key = (layout.tobytes(), layout.shape, layout.dtype.str)
     _LAYOUTS[key] = layout

@@ -5,13 +5,30 @@ Role parity: the reference's fused attention kernels
 q-loop × online-softmax k-loop kernel that never materializes the
 ``[S, S]`` score matrix in HBM.
 
-Forward is the Pallas kernel and also emits the per-row log-sum-exp so
-the backward never has to re-derive softmax normalization.  Backward is a
-flash-style chunked recompute: a ``lax.scan`` over k-blocks that holds at
-most ``[B, h, S, block_k]`` of scores at a time (O(S·block) transient, not
-O(S²)), using the standard ``delta = Σ_d do·o`` trick for the softmax
-jacobian.  ``interpret=True`` (CPU testing) and the jnp reference path
-keep numerics checkable everywhere.
+The kernel family (dispatched by :func:`_flash_call` / :func:`_flash_bwd`):
+
+* **resident** (fwd + dq/dkv backward): K/V (and in the dkv pass
+  q/do/lse/Δ) ride VMEM whole; the k-loop walks the contiguous
+  ``lattice.kv_block_bounds`` range, so causal work is the true
+  triangle and windowed work is O(S·window).  Fastest while a head's
+  planes fit the VMEM budget (``lattice.resident_fits``).
+* **streamed** (fwd + dq/dkv backward): beyond VMEM residency the grid
+  grows a live-step dimension and a scalar-prefetched ``index_map``
+  DMAs ONLY each step's live block (``lattice.plan_q_live`` /
+  ``plan_k_live`` — the same gather machinery as the block-sparse
+  kernels, here walking the causal/window lattice).  VMEM holds one
+  block; S is unbounded.
+
+Block sizes are seq-length-aware (``lattice.auto_flash_blocks``) unless
+the caller (or the tuning plane's ``kernels.flash_block_*`` dimensions)
+pins them.  ``segment_ids`` masks cross-segment pairs (packed sequences
+/ BERT padding) on the resident kernels and every reference path.
+
+Forward also emits the per-row log-sum-exp so the backward never has to
+re-derive softmax normalization; backward uses the standard
+``delta = Σ_d do·o`` trick for the softmax jacobian.  ``interpret=True``
+(CPU testing) and the jnp reference path keep numerics checkable
+everywhere.
 """
 
 from __future__ import annotations
@@ -22,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import lattice
+
 
 def _mask(S, T, causal, window=None):
     from ..masks import local_attention_mask
@@ -30,30 +49,69 @@ def _mask(S, T, causal, window=None):
                                 causal=causal, window=window)
 
 
-def _reference_attention(q, k, v, causal: bool, window=None):
+def _full_mask(S, T, causal, window, segment_ids):
+    """[B or 1, 1, S, T] bool combined mask (positions ∩ segments)."""
+    m = _mask(S, T, causal, window)[None, None]
+    if segment_ids is not None:
+        seg = (segment_ids[:, None, :, None]
+               == segment_ids[:, None, None, :])
+        m = m & seg
+    return m
+
+
+def _reference_attention(q, k, v, causal: bool, window=None,
+                         segment_ids=None):
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal or window is not None:
-        s = jnp.where(_mask(s.shape[-2], s.shape[-1], causal, window),
-                      s, -1e30)
+    if causal or window is not None or segment_ids is not None:
+        s = jnp.where(_full_mask(s.shape[-2], s.shape[-1], causal, window,
+                                 segment_ids), s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _reference_fwd_with_lse(q, k, v, causal: bool, window=None):
+def _reference_fwd_with_lse(q, k, v, causal: bool, window=None,
+                            segment_ids=None):
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal or window is not None:
-        s = jnp.where(_mask(s.shape[-2], s.shape[-1], causal, window),
-                      s, -1e30)
+    if causal or window is not None or segment_ids is not None:
+        s = jnp.where(_full_mask(s.shape[-2], s.shape[-1], causal, window,
+                                 segment_ids), s, -1e30)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, h, S]
     p = jnp.exp(s - lse[..., None]).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-               block_k: int, seq_len: int, causal: bool, scale: float,
-               window=None):
+# kept as the module-local name older callers/tests import; the logic
+# lives in lattice.fit_block so forward/backward eligibility share it
+_flash_fit_probe = lattice.fit_block
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _resolve_blocks(block_q, block_k, S, d, backward=False):
+    """0/None → the seq-length table; explicit values are honored (then
+    shrunk to legal divisors).  The backward CAPS explicit sizes at the
+    table's choice — its resident passes hold extra O(S·d) planes, and a
+    512-block at S≥8k pushes scoped VMEM past the limit."""
+    abq, abk = lattice.auto_flash_blocks(S, d, backward=backward)
+    block_q = min(block_q, abq) if (block_q and backward) else (block_q
+                                                               or abq)
+    block_k = min(block_k, abk) if (block_k and backward) else (block_k
+                                                               or abk)
+    return lattice.fit_block(block_q, S), lattice.fit_block(block_k, S)
+
+
+# ---------------------------------------------------------------------------
+# resident kernels
+# ---------------------------------------------------------------------------
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, seg_ref, o_ref, lse_ref, *,
+               block_q: int, block_k: int, seq_len: int, causal: bool,
+               scale: float, window=None, has_seg: bool = False):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -63,8 +121,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_seg = (seg_ref[0, pl.ds(qi * block_q, block_q)] if has_seg else None)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -72,17 +129,18 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal or window is not None:
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = q_pos >= k_pos if causal else jnp.bool_(True)
-            if window is not None:
-                reach = (q_pos - k_pos < window if causal
-                         else jnp.abs(q_pos - k_pos) < window)
-                keep = keep & reach
+        k_seg = (seg_ref[0, pl.ds(ki * block_k, block_k)] if has_seg
+                 else None)
+        keep = lattice.tile_keep(qi, ki, block_q, block_k, causal, window,
+                                 q_seg, k_seg)
+        if keep is not None:
             s = jnp.where(keep, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
+        if has_seg:
+            # a row fully masked in this tile must not accumulate the
+            # exp(-1e30 − (-1e30)) = 1 garbage a pure -inf carry avoids
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
@@ -90,74 +148,155 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
-        nk_eff = (qi * block_q + block_q + block_k - 1) // block_k
-        nk_eff = jnp.minimum(nk_eff, nk)
-    else:
-        nk_eff = nk
-    if window is not None:
-        # sliding window: blocks entirely BEFORE the earliest reachable
-        # position are skipped too — this is where flash beats the dense
-        # mask for windowed (Mistral) configs: work per q block is
-        # O(window), not O(S)
-        k0 = jnp.maximum(qi * block_q - (window - 1), 0) // block_k
-    else:
-        k0 = 0
+    k0, nk_eff = lattice.kv_block_bounds(qi, block_q, block_k, nk, causal,
+                                         window)
     m, l, acc = jax.lax.fori_loop(k0, nk_eff, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, None]
+    l2 = l[:, None]
+    o_ref[0] = jnp.where(l2 > 0, acc / jnp.where(l2 > 0, l2, 1.0),
+                         0.0).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(l2 > 0, m[:, None] + jnp.log(
+        jnp.where(l2 > 0, l2, 1.0)), 1e30)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 512, block_k: int = 512,
-                    window=None):
-    """[B, S, h, d] attention; Pallas on TPU, jnp reference elsewhere.
-    ``window`` = sliding-window reach (ops/masks semantics); the kernel
-    skips k-blocks wholly outside the window.
-
-    Default 512-blocks: measured 1.9x faster than 128-blocks on v5e at
-    B=8/S=2048/d=64 (bigger MXU tiles, fewer grid steps; the [bq, bk]
-    fp32 score tile is 1 MiB — comfortably inside VMEM)."""
-    return _flash_fwd(q, k, v, causal, block_q, block_k, window)[0]
+# ---------------------------------------------------------------------------
+# streamed forward (long S): gather each live k-block via the lattice plan
+# ---------------------------------------------------------------------------
 
 
-def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+def _fa_stream_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref,
+                      lse_ref, m_ref, l_ref, acc_ref, *, block_q: int,
+                      block_k: int, causal: bool, scale: float, window,
+                      max_live: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    s = pl.program_id(2)
+    count = cnt_ref[qi]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s < count)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # [bq, d]
+        kblk = k_ref[0].astype(jnp.float32)           # [bk, d]
+        vblk = v_ref[0].astype(jnp.float32)
+        kj = idx_ref[qi, s]
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        keep = lattice.tile_keep(qi, kj, block_q, block_k, causal, window)
+        if keep is not None:
+            sc = jnp.where(keep, sc, -1e30)
+        m, l = m_ref[:, 0], l_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+        acc_ref[...] = acc_new
+
+    @pl.when(s == max_live - 1)
+    def _finalize():
+        l2 = l_ref[...]
+        o_ref[0] = jnp.where(l2 > 0, acc_ref[...] / jnp.where(
+            l2 > 0, l2, 1.0), 0.0).astype(o_ref.dtype)
+        m1 = m_ref[...]
+        lse_ref[0] = jnp.where(l2 > 0, m1 + jnp.log(
+            jnp.where(l2 > 0, l2, 1.0)), 1e30)
 
 
-def _flash_fit_probe(b: int, S: int) -> int:
-    """The block size _flash_call's ``fit`` would settle on (shared logic
-    so the backward's kernel-eligibility check can't drift)."""
-    b = min(b, S)
-    while b >= 64 and (S % b or b % 8):
-        b //= 2
-    return b
+def _flash_fwd_stream(qr, kr, vr, causal, block_q, block_k, window,
+                      interpret):
+    """[B*h, S, d] streamed forward over the lattice plan."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, d = qr.shape
+    nq = S // block_q
+    idx, counts = lattice.plan_q_live(S, block_q, block_k, causal, window)
+    L = idx.shape[1]
+    kern = functools.partial(_fa_stream_kernel, block_q=block_q,
+                             block_k=block_k, causal=causal,
+                             scale=1.0 / np.sqrt(d), window=window,
+                             max_live=L)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BH, nq, L),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, s, idx, cnt: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, idx, cnt: (bh, idx[qi, s], 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, idx, cnt: (bh, idx[qi, s], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, s, idx, cnt: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, s, idx, cnt: (bh, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((BH, S, d), qr.dtype),
+                   jax.ShapeDtypeStruct((BH, S, 1), jnp.float32)],
+        interpret=bool(interpret),
+    )(jnp.asarray(idx), jnp.asarray(counts), qr, kr, vr)
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                with_lse: bool = False, window=None):
+                with_lse: bool = False, window=None, segment_ids=None,
+                force_stream: bool = False):
     from jax.experimental import pallas as pl
 
     B, S, h, d = q.shape
-    # shrink blocks to divisors of S that keep the (8, 128) sublane tiling
-    # legal: S=1920 with 512-defaults runs the kernel at 128/128 instead
-    # of the O(S^2) dense path; a non-8-aligned S (e.g. 321) can never
-    # satisfy both constraints and drops to the dense reference
-    block_q = _flash_fit_probe(block_q, S)
-    block_k = _flash_fit_probe(block_k, S)
+    block_q, block_k = _resolve_blocks(block_q, block_k, S, d)
     if block_q < 64 or block_k < 64:  # degenerate shapes → dense reference
-        out, lse = _reference_fwd_with_lse(q, k, v, causal, window)
+        out, lse = _reference_fwd_with_lse(q, k, v, causal, window,
+                                           segment_ids)
         return (out, lse) if with_lse else out
     # [B, S, h, d] -> [B*h, S, d]
     qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
     kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
     vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
 
+    stream = force_stream or not lattice.resident_fits(S, d)
+    if stream and segment_ids is None:
+        out, lse = _flash_fwd_stream(qr, kr, vr, causal, block_q, block_k,
+                                     window, interpret)
+        out = out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+        lse = lse.reshape(B, h, S)
+        return (out, lse) if with_lse else out
+    # segments ride the resident kernel only (the streamed plan is a
+    # pure position lattice); beyond residency they fall back dense —
+    # packed long-sequence streaming is a later round
+    has_seg = segment_ids is not None
+    if stream and has_seg:
+        out, lse = _reference_fwd_with_lse(q, k, v, causal, window,
+                                           segment_ids)
+        return (out, lse) if with_lse else out
+    seg = (segment_ids.astype(jnp.int32) if has_seg
+           else jnp.zeros((B, 1), jnp.int32))
+    heads = h
+
     kernel = functools.partial(
         _fa_kernel, block_q=block_q, block_k=block_k, seq_len=S,
-        causal=causal, scale=1.0 / np.sqrt(d), window=window)
+        causal=causal, scale=1.0 / np.sqrt(d), window=window,
+        has_seg=has_seg)
+    seg_block = (1, S) if has_seg else (1, 1)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * h, S // block_q),
@@ -165,6 +304,8 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec(seg_block,
+                         lambda bh, qi: (bh // heads, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -177,29 +318,24 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((B * h, S, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(qr, kr, vr, seg)
     out = out.reshape(B, h, S, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(B, h, S)  # drops the singleton
     return (out, lse) if with_lse else out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, window=None):
-    if _use_pallas():
-        out, lse = _flash_call(q, k, v, causal, block_q, block_k,
-                               interpret=False, with_lse=True,
-                               window=window)
-    else:
-        out, lse = _reference_fwd_with_lse(q, k, v, causal, window)
-    return out, (q, k, v, out, lse)
+# ---------------------------------------------------------------------------
+# resident backward kernels
+# ---------------------------------------------------------------------------
 
 
 def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
-                      dq_ref, *, block_q: int, block_k: int, seq_len: int,
-                      causal: bool, scale: float, window):
+                      seg_ref, dq_ref, *, block_q: int, block_k: int,
+                      seq_len: int, causal: bool, scale: float, window,
+                      has_seg: bool = False):
     """Pallas dq pass: grid (bh, q-block); K/V ride VMEM-resident (as in
-    the forward) and the k-loop SKIPS blocks above the causal diagonal /
-    outside the window — scores never touch HBM, and causal work is the
-    true triangle, both of which the jnp chunked backward paid for."""
+    the forward) and the k-loop walks the lattice's contiguous live range
+    — scores never touch HBM, and causal work is the true triangle."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -208,22 +344,20 @@ def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]                             # [bq]
     delta = delta_ref[0, :, 0]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    q_seg = (seg_ref[0, pl.ds(qi * block_q, block_q)] if has_seg else None)
 
     def body(ki, acc):
         kblk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         vblk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        keep = jnp.ones((block_q, block_k), jnp.bool_)
-        if causal:
-            keep = q_pos >= k_pos
-        if window is not None:
-            keep = keep & (q_pos - k_pos < window) & (k_pos - q_pos < window)
-        p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+        k_seg = (seg_ref[0, pl.ds(ki * block_k, block_k)] if has_seg
+                 else None)
+        keep = lattice.tile_keep(qi, ki, block_q, block_k, causal, window,
+                                 q_seg, k_seg)
+        p = jnp.exp(s - lse[:, None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -231,31 +365,19 @@ def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             ds, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    if causal:
-        nk_eff = (qi * block_q + block_q + block_k - 1) // block_k
-        nk_eff = jnp.minimum(nk_eff, nk)
-    else:
-        nk_eff = nk
-    k0 = 0
-    if window is not None:
-        k0 = jnp.maximum(qi * block_q - (window - 1), 0) // block_k
-        if not causal:
-            # window reaches forward too: clip k-blocks past the last
-            # position any row of this q-block can see
-            nk_eff = jnp.minimum(
-                nk_eff,
-                (qi * block_q + block_q - 1 + window + block_k - 1)
-                // block_k)
+    k0, nk_eff = lattice.kv_block_bounds(qi, block_q, block_k, nk, causal,
+                                         window)
     acc = jax.lax.fori_loop(
         k0, nk_eff, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
     dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, block_q: int, block_k: int,
-                       seq_len: int, causal: bool, scale: float, window):
+                       seg_ref, dk_ref, dv_ref, *, block_q: int,
+                       block_k: int, seq_len: int, causal: bool,
+                       scale: float, window, has_seg: bool = False):
     """Pallas dk/dv pass: grid (bh, k-block); Q/do/lse/Δ VMEM-resident,
-    q-loop starts at the diagonal under causality.  dv += pᵀ·do,
+    q-loop walks the transposed lattice range.  dv += pᵀ·do,
     dk += dsᵀ·q·scale, accumulated in registers/VMEM — no segment-sum or
     HBM score chunks."""
     from jax.experimental import pallas as pl
@@ -264,8 +386,7 @@ def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
     nq = seq_len // block_q
     kblk = k_ref[0].astype(jnp.float32)                # [bk, d]
     vblk = v_ref[0].astype(jnp.float32)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    k_seg = (seg_ref[0, pl.ds(ki * block_k, block_k)] if has_seg else None)
 
     def body(qi, carry):
         dk_acc, dv_acc = carry
@@ -275,14 +396,13 @@ def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        keep = jnp.ones((block_q, block_k), jnp.bool_)
-        if causal:
-            keep = q_pos >= k_pos
-        if window is not None:
-            keep = keep & (q_pos - k_pos < window) & (k_pos - q_pos < window)
-        p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+        q_seg = (seg_ref[0, pl.ds(qi * block_q, block_q)] if has_seg
+                 else None)
+        keep = lattice.tile_keep(qi, ki, block_q, block_k, causal, window,
+                                 q_seg, k_seg)
+        p = jnp.exp(s - lse[:, None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -294,17 +414,8 @@ def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * scale
         return dk_acc, dv_acc
 
-    q0 = (ki * block_k) // block_q if causal else 0
-    nq_eff = nq
-    if window is not None:
-        # rows beyond the window's backward reach see nothing of this
-        # k-block: clip both ends so windowed work is O(S·window), the
-        # mirror of the dq pass (and the forward's k0 skip)
-        nq_eff = jnp.minimum(
-            nq, (ki * block_k + block_k - 1 + window + block_q - 1)
-            // block_q)
-        if not causal:
-            q0 = jnp.maximum(ki * block_k - (window - 1), 0) // block_q
+    q0, nq_eff = lattice.q_block_bounds(ki, block_q, block_k, nq, causal,
+                                        window)
     d = kblk.shape[-1]
     dk_acc, dv_acc = jax.lax.fori_loop(
         q0, nq_eff, body, (jnp.zeros((block_k, d), jnp.float32),
@@ -314,24 +425,16 @@ def _fa_bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
-                      window, interpret: bool = False):
-    """Kernel backward: dq + dk/dv passes with VMEM-resident scores.
-
-    Replaces the jnp chunked scan, which materialized [B, h, S, block]
-    fp32 score chunks in HBM (bandwidth-bound: ~4 such tensors per chunk)
-    and computed the full S×block products even above the causal diagonal
-    — measured 4x faster at B=8/S=2048/h=12/d=64 on v5e, taking the
-    110M-headline attention from 7.5%% to ~30%% component efficiency."""
+                      window, interpret: bool = False, segment_ids=None):
+    """Resident kernel backward: dq + dk/dv passes with VMEM-resident
+    scores — measured 4x the jnp chunked scan at B=8/S=2048/h=12/d=64 on
+    v5e (took the 110M-headline attention from 7.5%% to ~30%% component
+    efficiency)."""
     from jax.experimental import pallas as pl
 
     B, S, h, d = q.shape
-    # long S: the dkv pass holds q/do/lse/Δ VMEM-resident (O(S·d)), so
-    # 512-blocks push scoped VMEM past the 16M limit at S>=8192 — cap
-    # the backward blocks there (measured: no headline impact at S=2048)
-    if S * d > 4096 * 64:
-        block_q, block_k = min(block_q, 256), min(block_k, 256)
-    block_q = _flash_fit_probe(block_q, S)
-    block_k = _flash_fit_probe(block_k, S)
+    block_q, block_k = _resolve_blocks(block_q, block_k, S, d,
+                                       backward=True)
     qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
     kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
     vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
@@ -341,11 +444,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
                     axis=-1)                            # [B, S, h]
     delta_r = delta.transpose(0, 2, 1).reshape(B * h, S, 1)
     scale = 1.0 / np.sqrt(d)
+    has_seg = segment_ids is not None
+    seg = (segment_ids.astype(jnp.int32) if has_seg
+           else jnp.zeros((B, 1), jnp.int32))
+    seg_block = (1, S) if has_seg else (1, 1)
+    heads = h
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, seq_len=S, causal=causal,
-                          scale=scale, window=window),
+                          scale=scale, window=window, has_seg=has_seg),
         grid=(B * h, S // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -354,16 +462,17 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
             pl.BlockSpec((1, S, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec(seg_block, lambda bh, qi: (bh // heads, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
         interpret=interpret,
-    )(qr, dor, kr, vr, lse_r, delta_r)
+    )(qr, dor, kr, vr, lse_r, delta_r, seg)
 
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, seq_len=S, causal=causal,
-                          scale=scale, window=window),
+                          scale=scale, window=window, has_seg=has_seg),
         grid=(B * h, S // block_k),
         in_specs=[
             pl.BlockSpec((1, S, d), lambda bh, ki: (bh, 0, 0)),
@@ -372,6 +481,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec(seg_block, lambda bh, ki: (bh // heads, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -380,28 +490,259 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_k,
         out_shape=[jax.ShapeDtypeStruct((B * h, S, d), k.dtype),
                    jax.ShapeDtypeStruct((B * h, S, d), v.dtype)],
         interpret=interpret,
-    )(qr, dor, kr, vr, lse_r, delta_r)
+    )(qr, dor, kr, vr, lse_r, delta_r, seg)
 
     back = lambda a: a.reshape(B, h, S, d).transpose(0, 2, 1, 3)
     return back(dq), back(dk), back(dv)
 
 
-def _flash_bwd(causal, block_q, block_k, window, res, do):
-    """Backward dispatch: the Pallas kernel pair on TPU (VMEM-resident
-    scores, causal-triangle work); the jnp chunked scan elsewhere.
+# ---------------------------------------------------------------------------
+# streamed backward kernels (long S)
+# ---------------------------------------------------------------------------
+
+
+def _fa_bwd_dq_stream_kernel(idx_ref, cnt_ref, q_ref, do_ref, k_ref,
+                             v_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+                             *, block_q: int, block_k: int, causal: bool,
+                             scale: float, window):
+    """Streamed dq: grid (bh, q-block, live-s); each step's K/V block is
+    gathered by the prefetched lattice plan.  dq accumulates in VMEM
+    scratch; the constant-over-s output index map flushes it at the
+    q-row boundary (the block-sparse flat-walk write trick)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    s = pl.program_id(2)
+    count = cnt_ref[qi]
+
+    @pl.when(s == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s < count)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        kj = idx_ref[qi, s]
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        keep = lattice.tile_keep(qi, kj, block_q, block_k, causal, window)
+        p = jnp.exp(sc - lse[:, None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_stream_kernel(idx_ref, cnt_ref, q_ref, do_ref, k_ref,
+                              v_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                              kacc_ref, vacc_ref, *, block_q: int,
+                              block_k: int, causal: bool, scale: float,
+                              window):
+    """Streamed dk/dv: grid (bh, k-block, live-s) over the transposed
+    plan; q/do/lse/Δ blocks gathered per step, dk/dv accumulate in
+    scratch and flush at the k-column boundary."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    s = pl.program_id(2)
+    count = cnt_ref[ki]
+
+    @pl.when(s == 0)
+    def _init():
+        kacc_ref[...] = jnp.zeros_like(kacc_ref)
+        vacc_ref[...] = jnp.zeros_like(vacc_ref)
+
+    @pl.when(s < count)
+    def _step():
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        qi = idx_ref[ki, s]
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        keep = lattice.tile_keep(qi, ki, block_q, block_k, causal, window)
+        p = jnp.exp(sc - lse[:, None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        vacc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        kacc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dk_ref[0] = kacc_ref[...].astype(dk_ref.dtype)
+    dv_ref[0] = vacc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_stream(q, k, v, out, lse, do, causal, block_q, block_k,
+                      window, interpret: bool = False):
+    """Streamed kernel backward — VMEM holds one tile's operands, HBM
+    traffic follows the lattice's live count, S unbounded by residency."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, h, d = q.shape
+    block_q, block_k = _resolve_blocks(block_q, block_k, S, d,
+                                       backward=True)
+    nq, nk = S // block_q, S // block_k
+    qr = q.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    dor = do.transpose(0, 2, 1, 3).reshape(B * h, S, d)
+    lse_r = lse.reshape(B * h, S, 1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta_r = delta.transpose(0, 2, 1).reshape(B * h, S, 1)
+    scale = 1.0 / np.sqrt(d)
+
+    idx, counts = lattice.plan_q_live(S, block_q, block_k, causal, window)
+    L = idx.shape[1]
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * h, nq, L),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, s, ix, ct: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, s, ix, ct: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, ix, ct: (bh, ix[qi, s], 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, s, ix, ct: (bh, ix[qi, s], 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, s, ix, ct: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, s, ix, ct: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, s, ix, ct: (bh, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_stream_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          window=window),
+        grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct((B * h, S, d), q.dtype),
+        interpret=bool(interpret),
+    )(jnp.asarray(idx), jnp.asarray(counts), qr, dor, kr, vr, lse_r,
+      delta_r)
+
+    idx_k, counts_k = lattice.plan_k_live(S, block_q, block_k, causal,
+                                          window)
+    Lk = idx_k.shape[1]
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * h, nk, Lk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, ki, s, ix, ct: (bh, ix[ki, s], 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, ki, s, ix, ct: (bh, ix[ki, s], 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ki, s, ix, ct: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ki, s, ix, ct: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, ki, s, ix, ct: (bh, ix[ki, s], 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, ki, s, ix, ct: (bh, ix[ki, s], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ki, s, ix, ct: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ki, s, ix, ct: (bh, ki, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_stream_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          window=window),
+        grid_spec=dkv_spec,
+        out_shape=[jax.ShapeDtypeStruct((B * h, S, d), k.dtype),
+                   jax.ShapeDtypeStruct((B * h, S, d), v.dtype)],
+        interpret=bool(interpret),
+    )(jnp.asarray(idx_k), jnp.asarray(counts_k), qr, dor, kr, vr, lse_r,
+      delta_r)
+
+    back = lambda a: a.reshape(B, h, S, d).transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring + public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, seg, causal, block_q, block_k, window):
+    return _flash_inner_fwd(q, k, v, seg, causal, block_q, block_k,
+                            window)[0]
+
+
+def _flash_inner_fwd(q, k, v, seg, causal, block_q, block_k, window):
+    segment_ids = seg if seg is not None and seg.ndim == 2 \
+        and seg.shape[1] == q.shape[1] else None
+    if _use_pallas():
+        out, lse = _flash_call(q, k, v, causal, block_q, block_k,
+                               interpret=False, with_lse=True,
+                               window=window, segment_ids=segment_ids)
+    else:
+        out, lse = _reference_fwd_with_lse(q, k, v, causal, window,
+                                           segment_ids)
+    return out, (q, k, v, seg, out, lse)
+
+
+def _flash_inner_bwd(causal, block_q, block_k, window, res, do):
+    """Backward dispatch: resident Pallas kernels while the planes fit
+    VMEM, streamed kernels beyond, jnp chunked scan off-TPU.
 
     Uses the saved per-row log-sum-exp (no softmax re-normalization pass)
     and ``delta_i = Σ_d do_i·o_i`` so the softmax jacobian term needs no
-    cross-block reduction.
-    """
-    q, k, v, out, lse = res
+    cross-block reduction."""
+    q, k, v, seg, out, lse = res
+    segment_ids = seg if seg is not None and seg.ndim == 2 \
+        and seg.shape[1] == q.shape[1] else None
     B, S, h, d = q.shape
-    if _use_pallas() and S % 64 == 0 and min(
-            _flash_fit_probe(block_q, S), _flash_fit_probe(block_k, S)) >= 64:
-        return _flash_bwd_pallas(q, k, v, out, lse, do, causal, block_q,
-                                 block_k, window)
+    bq, bk = _resolve_blocks(block_q, block_k, S, d, backward=True)
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    kernel_ok = _use_pallas() and S % 64 == 0 and min(bq, bk) >= 64
+    # segments ride the resident kernels only (mirrors the forward)
+    if kernel_ok and segment_ids is not None \
+            and not lattice.resident_fits(S, d):
+        kernel_ok = False
+    if kernel_ok:
+        if lattice.resident_fits(S, d):
+            dq, dk, dv = _flash_bwd_pallas(
+                q, k, v, out, lse, do, causal, block_q, block_k, window,
+                segment_ids=segment_ids)
+        else:
+            dq, dk, dv = _flash_bwd_stream(
+                q, k, v, out, lse, do, causal, block_q, block_k, window)
+        return dq, dk, dv, dseg
     scale = 1.0 / np.sqrt(d)
-    blk = min(block_k, S)
+    blk = min(bk if bk >= 1 else S, S)
     while blk > 1 and S % blk:  # shrink to a divisor (matches _flash_call)
         blk //= 2
     if blk < 64:
@@ -421,12 +762,19 @@ def _flash_bwd(causal, block_q, block_k, window, res, do):
         ki, kblk, vblk = chunk
         kb32 = kblk.astype(jnp.float32)
         s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb32) * scale
-        if causal or window is not None:
+        if causal or window is not None or segment_ids is not None:
             from ..masks import local_attention_mask
 
             k_pos = ki * blk + jnp.arange(blk)
-            s = jnp.where(local_attention_mask(q_pos, k_pos, causal, window),
-                          s, -1e30)
+            m = local_attention_mask(q_pos, k_pos, causal, window)[None,
+                                                                   None]
+            if segment_ids is not None:
+                seg_m = (segment_ids[:, None, :, None]
+                         == jax.lax.dynamic_slice_in_dim(
+                             segment_ids, ki * blk, blk,
+                             axis=1)[:, None, None, :])
+                m = m & seg_m
+            s = jnp.where(m, s, -1e30)
         p = jnp.exp(s - lse[..., None])  # [B, h, S, blk]
         dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
         dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vblk.astype(jnp.float32))
@@ -440,15 +788,37 @@ def _flash_bwd(causal, block_q, block_k, window, res, do):
         body, dq0, (jnp.arange(nk), k_chunks, v_chunks))
     dk = dk_chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, h, d)
     dv = dv_chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, h, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dseg)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_inner_fwd, _flash_inner_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 0, block_k: int = 0,
+                    window=None, segment_ids=None):
+    """[B, S, h, d] attention; Pallas on TPU, jnp reference elsewhere.
+
+    ``block_q``/``block_k`` 0 → the seq-length-aware table
+    (:func:`lattice.auto_flash_blocks`; forward and backward resolve
+    independently).  ``window`` = sliding-window reach (ops/masks
+    semantics); k-blocks wholly outside the lattice are skipped.
+    ``segment_ids [B, S]`` masks cross-segment pairs (packed sequences,
+    padding) on the resident kernels and all reference paths."""
+    B, S = q.shape[0], q.shape[1]
+    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
+           else jnp.zeros((B, 1), jnp.int32))
+    return _flash(q, k, v, seg, causal, int(block_q or 0),
+                  int(block_k or 0), window)
 
 
 def flash_attention_interpret(q, k, v, causal: bool = True,
                               block_q: int = 64, block_k: int = 64,
-                              window=None):
-    """Interpreter-mode kernel run (CPU numerics testing)."""
+                              window=None, segment_ids=None,
+                              stream: bool = False):
+    """Interpreter-mode kernel run (CPU numerics testing); ``stream=True``
+    forces the long-S gather kernels regardless of residency."""
     return _flash_call(q, k, v, causal, block_q, block_k, interpret=True,
-                       window=window)
+                       window=window, segment_ids=segment_ids,
+                       force_stream=stream)

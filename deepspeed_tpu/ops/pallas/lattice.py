@@ -1,0 +1,288 @@
+"""Block skip lattice + block-size tables — shared by every attention kernel.
+
+The causal triangle and the sliding window are BLOCK-structured masks:
+at kernel-block granularity they define a boolean ``[nq, nk]`` lattice of
+live tiles.  Before this module, :mod:`flash_attention` derived its
+causal k-loop bounds inline and :mod:`block_sparse_attention` tril'd its
+layout inline — two skip implementations that could (and did) drift.
+Now there is ONE lattice:
+
+* :func:`live_lattice` — the host-side ``[nq, nk]`` live-tile grid for
+  (causal, window); block-sparse intersects its ``SparsityConfig``
+  layout with it (:func:`apply_lattice`), flash walks it directly.
+* :func:`kv_block_bounds` / :func:`q_block_bounds` — the traced
+  contiguous [lo, hi) loop bounds the RESIDENT kernels use (causal and
+  window lattices are banded, so a contiguous range is exact).
+* :func:`plan_q_live` / :func:`plan_k_live` — padded live-index plans
+  (row-major / column-major) that drive the STREAMED kernels' scalar-
+  prefetched gather ``index_map``s, the same machinery as the
+  block-sparse gather forward.
+* :func:`tile_keep` — the in-kernel ``[bq, bk]`` token mask for one
+  tile (causal edge + window band + segment equality), shared by the
+  flash forward, both flash backwards, and the block-sparse tile update
+  so masking cannot drift between passes.
+
+Block-size selection (:func:`auto_flash_blocks`) is seq-length-aware:
+the 512-everywhere default that made flash merely break even at 8k
+(BENCH_r04) loses VMEM headroom to the resident K/V planes as S grows —
+the table steps tiles down where the measured crossover sits.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: PER-PLANE element bound (S·d of K, same for V) for VMEM-resident
+#: kernels; K+V together occupy up to 2x this.  2M elems/plane = 8 MiB
+#: bf16 — inside a v5e core's VMEM alongside q/acc scratch (one bound
+#: for flash AND block-sparse so their dispatch cannot disagree about
+#: what "fits").
+RESIDENT_VMEM_ELEMS = 2 * 1024 * 1024
+
+
+def resident_fits(S: int, d: int) -> bool:
+    """Whether a head's K/V planes fit the resident-kernel VMEM budget."""
+    return S * d <= RESIDENT_VMEM_ELEMS
+
+
+# ---------------------------------------------------------------------------
+# block-size tables
+# ---------------------------------------------------------------------------
+
+#: (min_S·d_elems_exclusive → (block_q, block_k)) forward table,
+#: measured on v5e at d=64/bf16: 512-tiles win on MXU occupancy up to 8k
+#: (·64); past that the fp32 q/score/acc tiles compete with the resident
+#: K/V planes — whose footprint is S·d, which is why the key is ELEMENTS
+#: not raw S (a d=128 model hits the pressure point at half the S) —
+#: and the scheduler stops double-buffering; smaller q tiles restore the
+#: pipeline.  ``auto_flash_blocks`` walks this largest-bound-first.
+_FWD_BLOCKS: Tuple[Tuple[int, Tuple[int, int]], ...] = (
+    (16384 * 64, (256, 256)),   # S·d > 1M elems
+    (8192 * 64, (256, 512)),    # 512k < S·d <= 1M
+    (0, (512, 512)),            # S·d <= 512k
+)
+
+#: backward table: the dkv pass holds q/do/lse/Δ resident (O(S·d)) on
+#: top of what the forward holds, so tiles cap earlier — the PR-5-era
+#: guard was exactly ``S·d > 4096·64 → cap 256``, preserved here as the
+#: 262k boundary.
+_BWD_BLOCKS: Tuple[Tuple[int, Tuple[int, int]], ...] = (
+    (8192 * 64, (128, 256)),    # S·d > 512k
+    (4096 * 64, (256, 256)),    # 262k < S·d <= 512k
+    (0, (512, 512)),            # S·d <= 262k
+)
+
+
+def fit_block(b: int, S: int) -> int:
+    """Largest block <= ``b`` that divides S and keeps the (8, 128)
+    sublane tiling legal (shared by forward/backward eligibility so the
+    two dispatch sites cannot drift)."""
+    b = min(b, S)
+    while b >= 64 and (S % b or b % 8):
+        b //= 2
+    return b
+
+
+def auto_flash_blocks(S: int, d: int, backward: bool = False
+                      ) -> Tuple[int, int]:
+    """VMEM-pressure-aware (block_q, block_k) for the flash kernels,
+    keyed on S·d (the resident planes' footprint); callers pass explicit
+    sizes (or the tuning plane's ``kernels.flash_block_*`` overrides) to
+    bypass the table."""
+    elems = S * max(d, 1)
+    table = _BWD_BLOCKS if backward else _FWD_BLOCKS
+    for min_elems, (bq, bk) in table:
+        if elems > min_elems:  # the (0, ...) row matches any valid S·d
+            return fit_block(bq, S), fit_block(bk, S)
+    raise AssertionError(f"block table has no row for S·d = {elems}")
+
+
+# ---------------------------------------------------------------------------
+# the lattice itself
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def live_lattice(S: int, block_q: int, block_k: int, causal: bool,
+                 window: Optional[int] = None) -> np.ndarray:
+    """Host-side ``[nq, nk]`` bool — True where a (q-block, k-block) tile
+    holds ANY unmasked (causal ∩ window) token pair.  This is the single
+    source of truth for "which tiles exist": flash plans walk it,
+    block-sparse intersects its layout with it."""
+    nq, nk = S // block_q, S // block_k
+    qi = np.arange(nq)
+    kj = np.arange(nk)
+    q_lo = qi[:, None] * block_q                   # first q pos of row
+    q_hi = q_lo + block_q - 1                      # last q pos of row
+    k_lo = kj[None, :] * block_k
+    k_hi = k_lo + block_k - 1
+    # a tile is live iff SOME (q, k) pair in it is unmasked; the q−k
+    # values a tile can realize form the interval [q_lo−k_hi, q_hi−k_lo]
+    live = np.ones((nq, nk), bool)
+    if causal:
+        live &= k_lo <= q_hi                       # ∃ pair with q−k ≥ 0
+    if window is not None:
+        live &= (q_lo - k_hi) < window             # ∃ pair with q−k < w
+        if not causal:
+            live &= (k_lo - q_hi) < window         # ∃ pair with k−q < w
+    return live
+
+
+def apply_lattice(layout: np.ndarray, causal: bool,
+                  window: Optional[int] = None,
+                  cb: int = 1) -> np.ndarray:
+    """Intersect a ``[H, nb, nb]`` sparsity-cell layout with the causal/
+    window lattice at CELL granularity — the block-sparse planner's skip
+    source (replaces its inline tril).  ``window`` is TOKENS (the unit
+    every other lattice function uses); ``cb`` is the cell size in
+    tokens, so the cell lattice is computed over the token grid with
+    cells as blocks (cb=1 keeps cells == tokens)."""
+    lay = np.asarray(layout)
+    H, nb, _ = lay.shape
+    if not causal and window is None:
+        return lay
+    cb = max(int(cb), 1)
+    lat = live_lattice(nb * cb, cb, cb, causal, window)
+    return lay * lat[None].astype(lay.dtype)
+
+
+def kv_block_bounds(qi, block_q: int, block_k: int, nk: int, causal: bool,
+                    window: Optional[int] = None):
+    """Traced [k0, nk_eff) k-block loop bounds for one q-block — the
+    contiguous-range form of the lattice row (causal/window rows are
+    banded so the range is exact).  Shared by the resident flash forward
+    and its dq backward."""
+    if causal:
+        nk_eff = (qi * block_q + block_q + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk_eff, nk)
+    else:
+        nk_eff = nk
+    k0 = 0
+    if window is not None:
+        k0 = jnp.maximum(qi * block_q - (window - 1), 0) // block_k
+        if not causal:
+            nk_eff = jnp.minimum(
+                nk_eff,
+                (qi * block_q + block_q - 1 + window + block_k - 1)
+                // block_k)
+    return k0, nk_eff
+
+
+def q_block_bounds(ki, block_q: int, block_k: int, nq: int, causal: bool,
+                   window: Optional[int] = None):
+    """Traced [q0, nq_eff) q-block bounds for one k-block (the dkv pass's
+    transposed walk of the same lattice)."""
+    q0 = (ki * block_k) // block_q if causal else 0
+    nq_eff = nq
+    if window is not None:
+        nq_eff = jnp.minimum(
+            nq, (ki * block_k + block_k - 1 + window + block_q - 1)
+            // block_q)
+        if not causal:
+            q0 = jnp.maximum(ki * block_k - (window - 1), 0) // block_q
+    return q0, nq_eff
+
+
+# ---------------------------------------------------------------------------
+# streamed-kernel plans (padded live-index lists over the lattice)
+# ---------------------------------------------------------------------------
+
+_PLAN_CACHE: OrderedDict = OrderedDict()
+_PLAN_CACHE_MAX = 32
+
+
+def _cached(key, build):
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        _PLAN_CACHE.move_to_end(key)
+        return hit
+    out = build()
+    _PLAN_CACHE[key] = out
+    while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+        _PLAN_CACHE.popitem(last=False)
+    return out
+
+
+def plan_q_live(S: int, block_q: int, block_k: int, causal: bool,
+                window: Optional[int] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Row-major plan: per q-block, the list of live k-block ids —
+    ``(idx [nq, L] int32, counts [nq] int32)`` with dead slots padded by
+    the last live id (consecutive identical indices elide the re-DMA,
+    the block-sparse gather trick).  Drives the streamed forward and the
+    streamed dq backward."""
+    def build():
+        lat = live_lattice(S, block_q, block_k, causal, window)
+        nq = lat.shape[0]
+        lists = [np.nonzero(lat[qi])[0] for qi in range(nq)]
+        L = max((len(l) for l in lists), default=1)
+        L = max(L, 1)
+        idx = np.zeros((nq, L), np.int32)
+        counts = np.zeros((nq,), np.int32)
+        for qi, live in enumerate(lists):
+            counts[qi] = len(live)
+            if len(live):
+                idx[qi, :len(live)] = live
+                idx[qi, len(live):] = live[-1]
+        return idx, counts
+    return _cached((S, block_q, block_k, causal, window, "q"), build)
+
+
+def plan_k_live(S: int, block_q: int, block_k: int, causal: bool,
+                window: Optional[int] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Column-major plan: per k-block, the live q-block ids — the
+    streamed dk/dv backward's transposed walk."""
+    def build():
+        lat = live_lattice(S, block_q, block_k, causal, window)
+        nk = lat.shape[1]
+        lists = [np.nonzero(lat[:, kj])[0] for kj in range(nk)]
+        L = max((len(l) for l in lists), default=1)
+        L = max(L, 1)
+        idx = np.zeros((nk, L), np.int32)
+        counts = np.zeros((nk,), np.int32)
+        for kj, live in enumerate(lists):
+            counts[kj] = len(live)
+            if len(live):
+                idx[kj, :len(live)] = live
+                idx[kj, len(live):] = live[-1]
+        return idx, counts
+    return _cached((S, block_q, block_k, causal, window, "k"), build)
+
+
+# ---------------------------------------------------------------------------
+# the in-kernel tile mask
+# ---------------------------------------------------------------------------
+
+
+def tile_keep(qi, kj, block_q: int, block_k: int, causal: bool,
+              window: Optional[int] = None, q_seg=None, k_seg=None):
+    """``[bq, bk]`` bool keep mask for tile (qi, kj): causal edge ∩
+    window band ∩ segment equality.  ``q_seg [bq]`` / ``k_seg [bk]`` are
+    this tile's segment-id slices (packed sequences / padding); None
+    skips the segment term.  Returns None when nothing masks (the caller
+    skips the where())."""
+    need_pos = causal or window is not None
+    keep = None
+    if need_pos:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            keep = q_pos >= k_pos
+        if window is not None:
+            reach = ((q_pos - k_pos < window) if causal
+                     else (q_pos - k_pos < window)
+                     & (k_pos - q_pos < window))
+            keep = reach if keep is None else keep & reach
+    if q_seg is not None and k_seg is not None:
+        seg = q_seg[:, None] == k_seg[None, :]
+        keep = seg if keep is None else keep & seg
+    return keep

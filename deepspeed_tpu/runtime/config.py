@@ -565,6 +565,37 @@ class CompileConfig(DeepSpeedConfigModel):
     offload_opt_states: bool = False
 
 
+class KernelsConfig(DeepSpeedConfigModel):
+    """``kernels`` config group — the Pallas kernel plane
+    (``deepspeed_tpu/ops/pallas/``): which custom kernels serve the step
+    hot path, and their tuning knobs.  Every knob here is a tuning-plane
+    dimension (``tuning/space.py``) so the PR-9 search picks winners per
+    (model, mesh, device_kind); the defaults are the conservative
+    XLA-reference paths."""
+
+    #: route model attention (llama/bert builders honor this) through the
+    #: Pallas flash kernel family instead of the XLA einsum+softmax
+    flash_attention: bool = False
+    #: flash kernel block sizes; 0 = the seq-length-aware table
+    #: (``ops/pallas/lattice.auto_flash_blocks``)
+    flash_block_q: int = 0
+    flash_block_k: int = 0
+    #: one-pass fused Adam over ZeRO shards (``ops/pallas/
+    #: fused_optimizer.py``): moments + grad-norm + unscale/clip in two
+    #: HBM passes instead of the optax chain's 3–4 sweeps.  Requires a
+    #: config-built adam/adamw-family optimizer; silently kept off for
+    #: offload/1-bit/1F1B paths (logged).
+    fused_adam: bool = False
+    #: ZeRO-3 collective–compute overlap: explicit chunked-ppermute ring
+    #: all-gather/reduce-scatter (``comm/overlap.py``) instead of the
+    #: monolithic GSPMD collectives that serialize against the matmuls
+    #: they feed
+    overlap_collectives: bool = False
+    #: ring payload granularity (chunks per shard); more chunks = finer
+    #: pipelining but more per-hop latency — a tuning dimension
+    overlap_chunks: int = 4
+
+
 # ---------------------------------------------------------------------------
 # top-level
 # ---------------------------------------------------------------------------
@@ -638,6 +669,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     compile: CompileConfig = Field(default_factory=CompileConfig)
+    kernels: KernelsConfig = Field(default_factory=KernelsConfig)
     compression_training: Dict[str, Any] = Field(default_factory=dict)
     curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
 

@@ -293,6 +293,45 @@ class DeepSpeedEngine:
         clip = config.gradient_clipping
         self.gradient_clipping = 0.0 if isinstance(clip, str) else float(clip)
 
+        # --- Pallas kernel plane (kernels.* config group) ----------------
+        kcfg = config.kernels
+        self.overlap_zero3 = bool(kcfg.overlap_collectives)
+        self.overlap_chunks = max(int(kcfg.overlap_chunks), 1)
+        self.fused_adam_enabled = False
+        self._fused_adam_cfg = None
+        if kcfg.fused_adam:
+            fused_ok = (optimizer is None
+                        and opt_name in ("adam", "fusedadam", "adamw",
+                                         "deepspeedcpuadam")
+                        and not (self.offload_enabled
+                                 or self._infinity_requested
+                                 or self.onebit_enabled or self._pp_1f1b))
+            if not fused_ok:
+                log_dist("kernels.fused_adam requested but the active "
+                         "optimizer/path is not a config-built adam "
+                         "family (or offload/1-bit/1F1B owns the update) "
+                         "— keeping the optax chain")
+            else:
+                from ..ops.pallas.fused_optimizer import FusedAdamConfig
+
+                op = config.optimizer.params if config.optimizer else None
+                betas = getattr(op, "betas", [0.9, 0.999])
+                if isinstance(betas, str):  # "auto"
+                    betas = [0.9, 0.999]
+                eps_v = getattr(op, "eps", 1e-8)
+                wd_v = getattr(op, "weight_decay", 0.0)
+                self._fused_adam_cfg = FusedAdamConfig(
+                    b1=float(betas[0]), b2=float(betas[1]),
+                    eps=1e-8 if isinstance(eps_v, str) else float(eps_v),
+                    weight_decay=(0.0 if isinstance(wd_v, str)
+                                  else float(wd_v)),
+                    # build_optimizer maps adamw/cpu-adam to optax.adamw
+                    # (decoupled decay); plain adam takes additive L2
+                    decoupled_wd=opt_name in ("adamw", "deepspeedcpuadam"))
+                self.fused_adam_enabled = True
+                log_dist("kernels.fused_adam: one-pass fused Adam update "
+                         f"active ({self._fused_adam_cfg})")
+
         # --- loss scaler (fp16 only; bf16/fp32 need none) ----------------
         # Scale cap 2^15: the loss cotangent enters the f16 subgraph as the
         # scale itself, and f16 max is 65504 — a 2^16 seed is inf before the
@@ -611,6 +650,44 @@ class DeepSpeedEngine:
             self.memory_ledger.register(
                 "grads", "engine/step_grads", grad_bytes, transient=True,
                 tag="fp32 grad accumulators (transient, inside-step)")
+            # kernel scratch attribution (ISSUE 12): the Pallas planes
+            # that live OUTSIDE the params/grads/optimizer pools get
+            # named entries under collective_scratch so peak_hbm gating
+            # and OOM forensics can point at them
+            mc = getattr(self.module, "config", None)
+            if getattr(mc, "attn_impl", "") == "flash":
+                # keyed on the MODEL's route (the signal that decides
+                # whether the kernel actually runs), not the
+                # kernels.flash_attention config knob — the knob only
+                # steers builders that construct the model
+                heads = int(getattr(mc, "num_heads", 0) or 0)
+                max_s = int(getattr(mc, "max_seq_len", 0) or 0)
+                layers = int(getattr(mc, "num_layers", 1) or 1)
+                rows = int(self.micro_batch_size or 0)
+                if heads and max_s and rows:
+                    # fwd lse + bwd delta, fp32 per (row, head, pos); one
+                    # layer's planes live at a time under remat
+                    self.memory_ledger.register(
+                        "collective_scratch", "engine/flash_softmax_stats",
+                        2 * rows * heads * max_s * 4 * (1 if getattr(
+                            mc, "remat", True) else layers),
+                        transient=True,
+                        tag="flash attention lse/delta softmax stats")
+            if self.overlap_zero3 and self.policy.stage >= 3:
+                from ..comm.overlap import staging_bytes
+
+                dp_world = int(np.prod([self.mesh.shape[a]
+                                        for a in DP_AXES]))
+                ring_bytes = sum(
+                    staging_bytes(np.shape(p),
+                                  getattr(p, "dtype", jnp.float32),
+                                  self.overlap_chunks) // max(dp_world, 1)
+                    for p in jax.tree.leaves(params))
+                self.memory_ledger.register(
+                    "collective_scratch", "engine/overlap_ring_staging",
+                    ring_bytes, transient=True,
+                    tag=f"ZeRO-3 overlap ring payloads "
+                        f"(chunks={self.overlap_chunks})")
 
         if self.offload_enabled:
             # optimizer states live on the HOST (ZeRO-Offload): fp32 master +
@@ -858,11 +935,79 @@ class DeepSpeedEngine:
         grads["layers"] = g_trunk
         return grads, loss
 
-    def _grad_core(self, onebit: Optional[bool] = None):
+    def _stage3_manual_infos(self, compute_params, label: str):
+        """Per-leaf manual-sharding projections for the explicit stage-3
+        shard_map branches (qgZ int8 comm, ring-overlap comm): how each
+        param/grad leaf's DP axes project into the manual region.  One
+        home so the two branches cannot drift."""
+        policy = self.policy
+        dp_set = set(DP_AXES)
+        if tuple(policy.shard_axes) != tuple(DP_AXES):
+            raise NotImplementedError(
+                f"{label} + MiCS sub-group sharding not supported (the "
+                f"manual reduce must cover every DP axis)")
+
+        def _manual_proj(spec, shape):
+            entries = list(spec) + [None] * (len(shape) - len(spec))
+            man_entries, dims = [], []
+            for i, e in enumerate(entries):
+                axes = (e if isinstance(e, tuple)
+                        else ((e,) if e is not None else ()))
+                man = tuple(a for a in axes if a in dp_set)
+                auto = tuple(a for a in axes if a not in dp_set)
+                if man and auto:
+                    raise NotImplementedError(
+                        f"{label}: leaf mixes DP and model axes on one dim")
+                man_entries.append(man if man else None)
+                if man:
+                    dims.append(i)
+            if len(dims) > 1:
+                raise NotImplementedError(f"{label}: multi-dim DP sharding")
+            dim = dims[0] if dims else None
+            return (PartitionSpec(*man_entries), dim,
+                    man_entries[dim] if dim is not None else None)
+
+        def _leaf_info(p, b):
+            if b is not None:
+                for e in tuple(b):
+                    axes = (e if isinstance(e, tuple)
+                            else ((e,) if e else ()))
+                    if any(a in dp_set for a in axes):
+                        raise NotImplementedError(
+                            f"{label} does not support model params "
+                            f"sharded over DP axes (expert-stacked MoE "
+                            f"weights)")
+            shape = np.shape(p)
+            pin, pdim, paxes = _manual_proj(policy.param_spec(p, b), shape)
+            gout, gdim, gaxes = _manual_proj(policy.grad_spec(p, b), shape)
+            return {"pin": pin, "pdim": pdim, "paxes": paxes,
+                    "gout": gout, "gdim": gdim, "gaxes": gaxes}
+
+        if self.base_specs is None:
+            info = jax.tree.map(lambda p: _leaf_info(p, None),
+                                compute_params)
+        else:
+            info = jax.tree.map(_leaf_info, compute_params,
+                                self.base_specs)
+        pin_tree = jax.tree.map(lambda p, i: i["pin"], compute_params,
+                                info)
+        gout_tree = jax.tree.map(lambda p, i: i["gout"], compute_params,
+                                 info)
+        return info, pin_tree, gout_tree
+
+    def _grad_core(self, onebit: Optional[bool] = None,
+                   fused_prep: bool = False):
         """Shared microbatch-scan gradient computation: accumulation, loss
         (un)scaling, ZeRO grad constraints, overflow screen, clipping.  Used
         by BOTH the fused on-device step and the offload grad-only step so
-        the two paths cannot drift."""
+        the two paths cannot drift.
+
+        ``fused_prep=True`` (the kernels.fused_adam path): the separate
+        unscale/clip HBM sweeps are SKIPPED — grads return still
+        loss-scaled, the global grad-norm comes from ONE Pallas read
+        (``tree_sqsum``), and everything the chain applied per element
+        (unscale × clip × overflow-zero) folds into the single ``mult``
+        scalar the fused update kernel consumes."""
         gas = self.gradient_accumulation_steps
         fp16 = self.fp16_enabled
         dtype = self.compute_dtype
@@ -963,63 +1108,8 @@ class DeepSpeedEngine:
                                        quantized_reduce_scatter)
 
                 P = PartitionSpec
-                dp_set = set(DP_AXES)
-                if tuple(policy.shard_axes) != tuple(DP_AXES):
-                    raise NotImplementedError(
-                        "qgZ at stage>=3 + MiCS sub-group sharding not "
-                        "supported (the manual reduce must cover every DP "
-                        "axis)")
-
-                def _manual_proj(spec, shape):
-                    entries = list(spec) + [None] * (len(shape) - len(spec))
-                    man_entries, dims = [], []
-                    for i, e in enumerate(entries):
-                        axes = (e if isinstance(e, tuple)
-                                else ((e,) if e is not None else ()))
-                        man = tuple(a for a in axes if a in dp_set)
-                        auto = tuple(a for a in axes if a not in dp_set)
-                        if man and auto:
-                            raise NotImplementedError(
-                                "qgZ stage>=3: leaf mixes DP and model axes "
-                                "on one dim")
-                        man_entries.append(man if man else None)
-                        if man:
-                            dims.append(i)
-                    if len(dims) > 1:
-                        raise NotImplementedError(
-                            "qgZ stage>=3: multi-dim DP sharding")
-                    dim = dims[0] if dims else None
-                    return (PartitionSpec(*man_entries), dim,
-                            man_entries[dim] if dim is not None else None)
-
-                def _leaf_info(p, b):
-                    if b is not None:
-                        for e in tuple(b):
-                            axes = (e if isinstance(e, tuple)
-                                    else ((e,) if e else ()))
-                            if any(a in dp_set for a in axes):
-                                raise NotImplementedError(
-                                    "qgZ at stage>=3 does not support model "
-                                    "params sharded over DP axes (expert-"
-                                    "stacked MoE weights)")
-                    shape = np.shape(p)
-                    pin, pdim, paxes = _manual_proj(policy.param_spec(p, b),
-                                                    shape)
-                    gout, gdim, gaxes = _manual_proj(policy.grad_spec(p, b),
-                                                     shape)
-                    return {"pin": pin, "pdim": pdim, "paxes": paxes,
-                            "gout": gout, "gdim": gdim, "gaxes": gaxes}
-
-                if self.base_specs is None:
-                    info = jax.tree.map(lambda p: _leaf_info(p, None),
-                                        compute_params)
-                else:
-                    info = jax.tree.map(_leaf_info, compute_params,
-                                        self.base_specs)
-                pin_tree = jax.tree.map(lambda p, i: i["pin"],
-                                        compute_params, info)
-                gout_tree = jax.tree.map(lambda p, i: i["gout"],
-                                         compute_params, info)
+                info, pin_tree, gout_tree = self._stage3_manual_infos(
+                    compute_params, "qgZ stage>=3")
 
                 def local3(params_shards, micro_local):
                     def gather(p, i):
@@ -1042,6 +1132,61 @@ class DeepSpeedEngine:
 
                 mean_loss, grads = _shard_map(
                     local3, mesh=mesh,
+                    in_specs=(pin_tree, P(None, DP_AXES)),
+                    out_specs=(P(), gout_tree),
+                    axis_names=set(DP_AXES), check_vma=False)(
+                        compute_params, micro)
+                new_comm = state.comm_state
+            elif (self.overlap_zero3 and policy.stage >= 3
+                  and not (onebit or qgz or self.qwz_enabled)):
+                # collective–compute overlap for stage 3 (kernels.
+                # overlap_collectives): the same explicit shard_map shape
+                # as the qgZ branch, but the param gather and grad reduce
+                # are CHUNKED ppermute rings (comm/overlap.py) instead of
+                # monolithic collectives — chunk i's compute runs while
+                # chunk i+1 is in flight, where GSPMD's single all-gather
+                # serializes against the first matmul it feeds.  Every
+                # ring hop goes through the comm verbs, so the
+                # CollectiveLedger census sees the ring.
+                from ..comm import overlap as ovl
+
+                P = PartitionSpec
+                info, pin_tree, gout_tree = self._stage3_manual_infos(
+                    compute_params, "overlap stage>=3")
+                ring_chunks = self.overlap_chunks
+                dp_world = int(np.prod([mesh.shape[a] for a in DP_AXES]))
+
+                def _fit_chunks(dim_size: int) -> int:
+                    c = min(ring_chunks, max(dim_size, 1))
+                    while c > 1 and dim_size % c:
+                        c -= 1
+                    return c
+
+                def local3o(params_shards, micro_local):
+                    def gather(p, i):
+                        if i["pdim"] is None:
+                            return p
+                        return ovl.ring_all_gather(
+                            p, i["paxes"], axis=i["pdim"],
+                            chunks=_fit_chunks(p.shape[i["pdim"]]))
+                    params_full = jax.tree.map(gather, params_shards, info)
+                    loss_sum, grads = microbatch_scan(params_full,
+                                                      micro_local, scale)
+
+                    def reduce(g, i):
+                        if i["gdim"] is None:
+                            return dist.pmean(g, DP_AXES)
+                        shard = g.shape[i["gdim"]] // dp_world
+                        out = ovl.ring_reduce_scatter(
+                            g, i["gaxes"], axis=i["gdim"],
+                            chunks=_fit_chunks(shard))
+                        return out / dp_world  # mean (matches pmean/qgZ)
+                    grads = jax.tree.map(reduce, grads, info)
+                    mean_loss = dist.pmean(loss_sum, DP_AXES)
+                    return mean_loss, grads
+
+                mean_loss, grads = _shard_map(
+                    local3o, mesh=mesh,
                     in_specs=(pin_tree, P(None, DP_AXES)),
                     out_specs=(P(), gout_tree),
                     axis_names=set(DP_AXES), check_vma=False)(
@@ -1085,6 +1230,34 @@ class DeepSpeedEngine:
                 mean_loss = loss_sum
                 new_comm = state.comm_state
 
+            if fused_prep:
+                # kernels.fused_adam: NO per-element unscale/clip sweeps.
+                # One Pallas read of the (still-scaled) grads yields the
+                # norm; overflow falls out of its finiteness (any non-
+                # finite grad poisons the sum); unscale × clip × zero
+                # collapse into the `mult` scalar the update kernel folds
+                # into its single pass.
+                from ..ops.pallas.fused_optimizer import tree_sqsum
+
+                if fp16:
+                    mean_loss = mean_loss / scale
+                grads = policy.apply_grad_constraints(grads,
+                                                      self.base_specs)
+                raw_norm = jnp.sqrt(tree_sqsum(grads))  # scaled-grad norm
+                overflow = ((~jnp.isfinite(raw_norm)) if fp16
+                            else jnp.bool_(False))
+                safe = jnp.where(jnp.isfinite(raw_norm), raw_norm, 0.0)
+                grad_norm = safe / scale if fp16 else safe
+                if clip > 0:
+                    factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                else:
+                    factor = jnp.float32(1.0)
+                mult = jnp.where(overflow, 0.0, factor)
+                if fp16:
+                    mult = mult / scale
+                return (grads, mean_loss, overflow, grad_norm, mult,
+                        new_comm)
+
             if fp16:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 mean_loss = mean_loss / scale  # undo scaling; /gas already in
@@ -1103,7 +1276,69 @@ class DeepSpeedEngine:
 
         return compute
 
+    def _build_fused_train_step(self, onebit: Optional[bool] = None):
+        """kernels.fused_adam step: the optax chain's update (moments →
+        bias correction → direction → apply, each its own HBM sweep plus
+        the separate unscale/clip sweeps in the core) is replaced by TWO
+        Pallas passes over the ZeRO shard — the grad-norm read inside
+        the fused-prep core and the one-pass update here."""
+        from ..ops.pallas.fused_optimizer import apply_fused_adam
+
+        fp16 = self.fp16_enabled
+        schedule = self._schedule
+        scaler = self.loss_scaler
+        fused_cfg = self._fused_adam_cfg
+        core = self._grad_core(onebit, fused_prep=True)
+
+        def step_fn(state: TrainState, batch):
+            (grads, mean_loss, overflow, grad_norm, mult,
+             new_comm) = core(state, batch)
+            lr = jnp.asarray(schedule(state.step), jnp.float32)
+            new_params, new_opt_state = apply_fused_adam(
+                state.opt_state, state.params, grads, lr, mult, fused_cfg)
+
+            if fp16:
+                keep = lambda new, old: jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n), new, old)
+                new_params = keep(new_params, state.params)
+                new_opt_state = keep(new_opt_state, state.opt_state)
+                new_scale = scaler.update(state.loss_scale, overflow)
+            else:
+                new_scale = state.loss_scale
+
+            new_state = TrainState(
+                params=new_params, opt_state=new_opt_state,
+                step=state.step + jnp.where(overflow, 0, 1),
+                loss_scale=new_scale,
+                skipped_steps=state.skipped_steps + jnp.where(overflow, 1,
+                                                              0),
+                comm_state=new_comm)
+            metrics = {
+                "loss": mean_loss,
+                "grad_norm": grad_norm,
+                "lr": lr,
+                "loss_scale": state.loss_scale.scale,
+                "overflow": overflow,
+            }
+            return new_state, metrics
+
+        state_shardings = self._state_shardings(self.state)
+        batch_sharding = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
+        onebit_now = self.onebit_enabled if onebit is None else bool(onebit)
+        return self._jit(
+            step_fn, "engine/train_step_fused",
+            static_context={
+                "gas": self.gradient_accumulation_steps,
+                "onebit": onebit_now,
+                "ltd_keep": getattr(self.module, "ltd_keep", None),
+            },
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,))
+
     def _build_train_step(self, onebit: Optional[bool] = None):
+        if self.fused_adam_enabled:
+            return self._build_fused_train_step(onebit)
         fp16 = self.fp16_enabled
         schedule = self._schedule
         scaler = self.loss_scaler

@@ -47,6 +47,19 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     "prefix_hit_rate": ("higher", 0.10),
     "tok_s_interactive": ("higher", 0.15),
     "tok_s_background": ("higher", 0.25),
+    # kernel plane (ops/pallas — ISSUE 12): no kernel ships without a
+    # number.  Speedups are ratios vs the XLA reference ladder rung the
+    # dispatch would otherwise take; the fused-adam figure is effective
+    # HBM GB/s over the 7-floats/param logical traffic (same accounting
+    # as optax_adam_hbm_gbps so the two compare); hiding_frac is the
+    # share of collective time the ring decomposition buries under
+    # compute.  A drop beyond tolerance exits 3 like any other metric.
+    "flash_speedup_s2048": ("higher", 0.10),
+    "flash_speedup_s8192": ("higher", 0.10),
+    "flash_speedup_s32768": ("higher", 0.10),
+    "block_sparse_speedup_s4096": ("higher", 0.10),
+    "fused_adam_hbm_gbps": ("higher", 0.15),
+    "overlap_hiding_frac": ("higher", 0.15),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —

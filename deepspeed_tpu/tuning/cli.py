@@ -42,10 +42,16 @@ def _fail(msg: str) -> int:
 # the synthetic landscape (CI smoke / demo)
 # ---------------------------------------------------------------------------
 
-#: the planted optimum the deterministic search must find
+#: the planted optimum the deterministic search must find — includes the
+#: kernel plane (ISSUE 12: every kernel is a searchable dimension, so
+#: the smoke landscape exercises kernel on/off × block granularity ×
+#: overlap chunk count end-to-end through search → store → apply)
 SYNTHETIC_BEST = {"train_micro_batch_size_per_gpu": 8,
                   "gradient_accumulation_steps": 1,
-                  "zero_optimization.stage": 3}
+                  "zero_optimization.stage": 3,
+                  "model.attn_impl": "flash",
+                  "kernels.fused_adam": True,
+                  "kernels.overlap_chunks": 4}
 
 
 def synthetic_space() -> CandidateSpace:
@@ -53,7 +59,10 @@ def synthetic_space() -> CandidateSpace:
             .register(Dimension("train_micro_batch_size_per_gpu",
                                 [1, 2, 4, 8, 16]))
             .register(Dimension("gradient_accumulation_steps", [1, 2]))
-            .register(Dimension("zero_optimization.stage", [0, 2, 3])))
+            .register(Dimension("zero_optimization.stage", [0, 2, 3]))
+            .register(Dimension("model.attn_impl", ["xla", "flash"]))
+            .register(Dimension("kernels.fused_adam", [False, True]))
+            .register(Dimension("kernels.overlap_chunks", [2, 4, 8])))
 
 
 def synthetic_cost_model(cand: Dict[str, Any]) -> Dict[str, float]:
@@ -67,7 +76,13 @@ def synthetic_cost_model(cand: Dict[str, Any]) -> Dict[str, float]:
     mb_gain = {1: 0.4, 2: 0.7, 4: 0.9, 8: 1.0, 16: 0.95}[mb]
     gas_gain = {1: 1.0, 2: 0.9}[gas]
     stage_gain = {0: 0.8, 2: 0.9, 3: 1.0}[stage]
-    tps = 10000.0 * mb_gain * gas_gain * stage_gain
+    attn_gain = {"xla": 0.85, "flash": 1.0}[cand.get("model.attn_impl",
+                                                     "xla")]
+    fused_gain = 1.0 if cand.get("kernels.fused_adam", False) else 0.97
+    chunk_gain = {2: 0.92, 4: 1.0, 8: 0.96}[
+        int(cand.get("kernels.overlap_chunks", 4))]
+    tps = (10000.0 * mb_gain * gas_gain * stage_gain * attn_gain
+           * fused_gain * chunk_gain)
     return {"tokens_per_sec": round(tps, 1),
             "mfu": round(tps / 20000.0, 4),
             "measured_state_bytes": float((16 >> min(stage, 3)) * 10**6)}

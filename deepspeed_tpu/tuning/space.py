@@ -128,10 +128,15 @@ def apply_overrides(base_config: Dict[str, Any],
 def default_space(max_micro_batch: int = 16,
                   include_offload: bool = False,
                   include_zero_stage: bool = True,
-                  mesh_layouts: Optional[Sequence[str]] = None
+                  mesh_layouts: Optional[Sequence[str]] = None,
+                  include_kernels: bool = True
                   ) -> CandidateSpace:
     """The stock search space: micro-batch × grad-accumulation × remat ×
-    donation (× ZeRO stage, × offload, × mesh layout when asked).
+    donation (× ZeRO stage, × offload, × mesh layout when asked) × the
+    Pallas kernel plane (attention impl × flash block sizes × fused
+    optimizer × collective overlap — every kernel is a searchable
+    dimension, so the store picks winners per (model, mesh,
+    device_kind) instead of a global default guessing).
 
     ``mesh_layouts`` entries are opaque layout names the trial harness
     interprets (an engine rebuild on a different mesh); omitted on
@@ -168,4 +173,37 @@ def default_space(max_micro_batch: int = 16,
             "tuning.mesh_layout", list(mesh_layouts),
             description="mesh/sharding layout name the trial harness "
                         "realizes (dp/tp/sp split)"))
+    if include_kernels:
+        flash_on = lambda v, cand: (
+            v == 0 or cand.get("model.attn_impl") == "flash")
+        space.register(Dimension(
+            "model.attn_impl", ["xla", "flash"],
+            description="attention kernel: XLA einsum+softmax vs the "
+                        "Pallas flash family (ops/pallas/"
+                        "flash_attention.py dispatch ladder)"))
+        space.register(Dimension(
+            "model.flash_block_q", [0, 256, 512],
+            description="flash q-block (0 = seq-length auto table)",
+            feasible=flash_on))
+        space.register(Dimension(
+            "model.flash_block_k", [0, 256, 512],
+            description="flash k-block (0 = seq-length auto table)",
+            feasible=flash_on))
+        space.register(Dimension(
+            "kernels.fused_adam", [False, True],
+            description="one-pass fused Pallas Adam over ZeRO shards vs "
+                        "the optax chain (ops/pallas/fused_optimizer.py)"))
+        space.register(Dimension(
+            "kernels.overlap_collectives", [False, True],
+            description="ZeRO-3 chunked-ring collective overlap "
+                        "(comm/overlap.py) vs monolithic GSPMD "
+                        "collectives",
+            feasible=lambda v, cand: (not v) or cand.get(
+                "zero_optimization.stage", 3) >= 3))
+        space.register(Dimension(
+            "kernels.overlap_chunks", [2, 4, 8],
+            description="ring payloads per shard (finer pipelining vs "
+                        "per-hop latency)",
+            feasible=lambda v, cand: cand.get(
+                "kernels.overlap_collectives", False) or v == 4))
     return space

@@ -35,7 +35,7 @@ SHARDS=(
   "tests/unit/tuning"
   "tests/unit/perf"
   "tests/unit/profiling"
-  "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py"
+  "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py tests/unit/test_overlap.py"
   "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py"
   "tests/unit/multiprocess/test_chaos_control_plane.py -m chaos"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"

@@ -1,0 +1,317 @@
+"""Flash-kernel numerics parity shard (ISSUE 12).
+
+Every dispatch rung of the reworked flash attention — resident kernel,
+streamed (lattice-gather) kernel, both backward pairs, segments, windows
+— against ``_reference_attention`` in interpret mode on CPU, with
+tolerance tiers per dtype.  Plus the shared skip lattice against a
+brute-force token-mask coarsening, and the block-size tables' contracts.
+"""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+fa = importlib.import_module("deepspeed_tpu.ops.pallas.flash_attention")
+lattice = importlib.import_module("deepspeed_tpu.ops.pallas.lattice")
+
+pytestmark = pytest.mark.slow  # jit-heavy; smoke tier runs -m "not slow"
+
+#: (rtol, atol) per input dtype — bf16 inputs accumulate in fp32 inside
+#: every kernel, so the budget covers the input rounding, not the math
+TOL = {jnp.float32: (2e-5, 2e-5), jnp.bfloat16: (2e-2, 2e-2)}
+
+
+def qkv(B=2, S=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, S, h, d) * 0.5).astype(dtype)
+    return mk(), mk(), mk()
+
+
+def segs(B, S):
+    """Two packed segments per row, uneven split."""
+    cut = S // 3
+    return jnp.asarray(
+        np.concatenate([np.zeros((B, cut)), np.ones((B, S - cut))],
+                       axis=1), jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# lattice
+# ---------------------------------------------------------------------------
+
+
+def brute_lattice(S, bq, bk, causal, window):
+    q = np.arange(S)[:, None]
+    k = np.arange(S)[None, :]
+    keep = np.ones((S, S), bool)
+    if causal:
+        keep &= q >= k
+    if window is not None:
+        keep &= (q - k < window) if causal else (np.abs(q - k) < window)
+    return keep.reshape(S // bq, bq, S // bk, bk).any(axis=(1, 3))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("window", [None, 1, 63, 100])
+def test_live_lattice_matches_token_mask_coarsening(causal, window):
+    for S, bq, bk in ((256, 64, 64), (256, 64, 32), (512, 128, 64)):
+        got = lattice.live_lattice(S, bq, bk, causal, window)
+        want = brute_lattice(S, bq, bk, causal, window)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_plans_walk_exactly_the_lattice():
+    S, bq, bk = 512, 64, 64
+    lat = lattice.live_lattice(S, bq, bk, True, 100)
+    idx, counts = lattice.plan_q_live(S, bq, bk, True, 100)
+    for qi in range(S // bq):
+        live = set(np.nonzero(lat[qi])[0])
+        assert set(idx[qi, :counts[qi]].tolist()) == live
+    idx_k, counts_k = lattice.plan_k_live(S, bq, bk, True, 100)
+    for kj in range(S // bk):
+        live = set(np.nonzero(lat[:, kj])[0])
+        assert set(idx_k[kj, :counts_k[kj]].tolist()) == live
+
+
+def test_block_bounds_cover_the_lattice_rows():
+    """The contiguous [k0, nk_eff) resident-kernel bounds must cover
+    every live tile of the banded lattices (and nothing is live outside
+    them) — the resident and streamed kernels must agree on skips."""
+    S, bq, bk = 512, 64, 64
+    for causal, window in ((True, None), (True, 100), (False, 100)):
+        lat = lattice.live_lattice(S, bq, bk, causal, window)
+        for qi in range(S // bq):
+            k0, nk_eff = jax.tree.map(
+                int, lattice.kv_block_bounds(qi, bq, bk, S // bk, causal,
+                                             window))
+            live = np.nonzero(lat[qi])[0]
+            if len(live):
+                assert k0 <= live.min() and live.max() < nk_eff
+            assert not lat[qi, :k0].any()
+            assert not lat[qi, nk_eff:].any()
+
+
+def test_auto_blocks_step_down_with_seq_length():
+    assert lattice.auto_flash_blocks(2048, 64) == (512, 512)
+    assert lattice.auto_flash_blocks(32768, 64) == (256, 256)
+    bq_s, _ = lattice.auto_flash_blocks(2048, 64)
+    bq_l, _ = lattice.auto_flash_blocks(32768, 64)
+    assert bq_l <= bq_s
+    # backward caps earlier than forward at matched S
+    fb, _ = lattice.auto_flash_blocks(8192, 64)
+    bb, _ = lattice.auto_flash_blocks(8192, 64, backward=True)
+    assert bb <= fb
+
+
+def test_auto_blocks_key_on_elements_not_raw_seq_length():
+    """The VMEM pressure point is S·d (the resident planes), so a
+    d=128 model must cap at HALF the S a d=64 model does — the PR-5-era
+    ``S·d > 4096·64 → 256`` backward guard, preserved (review finding:
+    a seq-only table silently dropped it)."""
+    # d=64 at 4096: under the 262k boundary → 512-tiles
+    assert lattice.auto_flash_blocks(4096, 64, backward=True) == (512, 512)
+    # d=128 at 4096: 512k elems → capped, like d=64 at 8192
+    assert lattice.auto_flash_blocks(4096, 128, backward=True) == \
+        lattice.auto_flash_blocks(8192, 64, backward=True)
+    bq, bk = lattice.auto_flash_blocks(4096, 128, backward=True)
+    assert max(bq, bk) <= 256
+    # forward steps down for wide heads too
+    assert lattice.auto_flash_blocks(16384, 128)[0] <= 256
+
+
+def test_apply_lattice_window_is_token_denominated():
+    """apply_lattice takes TOKEN windows like every other lattice fn;
+    the cell size converts — a cb=16 layout with a 32-token window keeps
+    a ~2-cell band, not a 32-cell one (review finding)."""
+    nb, cb = 8, 16
+    layout = np.ones((1, nb, nb), np.int8)
+    out = lattice.apply_lattice(layout, causal=True, window=32, cb=cb)
+    # cell (i, j) live iff ∃ tokens q∈cell i, k∈cell j with 0<=q-k<32:
+    # exactly the token lattice at block=cb
+    want = lattice.live_lattice(nb * cb, cb, cb, True, 32)[None]
+    np.testing.assert_array_equal(out.astype(bool), want)
+    # row 7 reaches at most back to cell 4 (112-16·cb boundary), far
+    # from the full 8-cell band a cell-unit window would keep
+    assert out[0, 7, :5].sum() <= 2
+
+
+def test_explicit_backward_blocks_capped_at_table():
+    # a 512 explicit block at long S would blow scoped VMEM in the
+    # resident dkv pass — the resolver caps it at the table's choice
+    bq, bk = fa._resolve_blocks(512, 512, 16384, 64, backward=True)
+    abq, abk = lattice.auto_flash_blocks(16384, 64, backward=True)
+    assert bq <= abq and bk <= abk
+
+
+# ---------------------------------------------------------------------------
+# forward parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal,window", [(True, None), (False, None),
+                                           (True, 100), (False, 100)])
+def test_resident_fwd_matches_reference(dtype, causal, window):
+    q, k, v = qkv(dtype=dtype)
+    got = fa.flash_attention_interpret(q, k, v, causal, 64, 64,
+                                       window=window)
+    ref = fa._reference_attention(q, k, v, causal, window)
+    rtol, atol = TOL[dtype]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("causal,window", [(True, None), (False, None),
+                                           (True, 100)])
+def test_streamed_fwd_matches_reference(causal, window):
+    """The long-S gather kernel (force-streamed at test size) — the path
+    S > RESIDENT_VMEM_ELEMS/d takes in production."""
+    q, k, v = qkv()
+    got = fa.flash_attention_interpret(q, k, v, causal, 64, 64,
+                                       window=window, stream=True)
+    ref = fa._reference_attention(q, k, v, causal, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_fwd_matches_reference(causal):
+    q, k, v = qkv()
+    seg = segs(q.shape[0], q.shape[1])
+    got = fa.flash_attention_interpret(q, k, v, causal, 64, 64,
+                                       segment_ids=seg)
+    ref = fa._reference_attention(q, k, v, causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# backward parity
+# ---------------------------------------------------------------------------
+
+
+def _ref_vjp(q, k, v, do, causal, window=None, seg=None):
+    def f(q_, k_, v_):
+        out, _ = fa._reference_fwd_with_lse(q_, k_, v_, causal, window,
+                                            seg)
+        return out
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(do)
+
+
+@pytest.mark.parametrize("causal,window", [(True, None), (False, None),
+                                           (True, 100), (False, 100)])
+def test_resident_bwd_matches_reference(causal, window):
+    q, k, v = qkv()
+    do = jnp.asarray(np.random.RandomState(7).randn(*q.shape), jnp.float32)
+    out, lse = fa._reference_fwd_with_lse(q, k, v, causal, window)
+    got = fa._flash_bwd_pallas(q, k, v, out, lse, do, causal, 64, 64,
+                               window, interpret=True)
+    want = _ref_vjp(q, k, v, do, causal, window)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal,window", [(True, None), (False, 100)])
+def test_streamed_bwd_matches_reference(causal, window):
+    q, k, v = qkv()
+    do = jnp.asarray(np.random.RandomState(7).randn(*q.shape), jnp.float32)
+    out, lse = fa._reference_fwd_with_lse(q, k, v, causal, window)
+    got = fa._flash_bwd_stream(q, k, v, out, lse, do, causal, 64, 64,
+                               window, interpret=True)
+    want = _ref_vjp(q, k, v, do, causal, window)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_segment_bwd_matches_reference():
+    q, k, v = qkv()
+    seg = segs(q.shape[0], q.shape[1])
+    do = jnp.asarray(np.random.RandomState(7).randn(*q.shape), jnp.float32)
+    out, lse = fa._reference_fwd_with_lse(q, k, v, True, None, seg)
+    got = fa._flash_bwd_pallas(q, k, v, out, lse, do, True, 64, 64, None,
+                               interpret=True, segment_ids=seg)
+    want = _ref_vjp(q, k, v, do, True, None, seg)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_public_vjp_with_segments_on_cpu_path():
+    """The custom_vjp plumbing: segment ids ride as a traced arg whose
+    cotangent is float0 — grad through the public entry must work and
+    match the reference (CPU reference route)."""
+    q, k, v = qkv(B=1, S=96, h=2, d=32)
+    seg = segs(1, 96)
+
+    g = jax.grad(lambda q_: jnp.sum(
+        fa.flash_attention(q_, k, v, True, segment_ids=seg) ** 2))(q)
+    g_ref = jax.grad(lambda q_: jnp.sum(
+        fa._reference_attention(q_, k, v, True,
+                                segment_ids=seg) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# model routing (BERT padding-as-segments)
+# ---------------------------------------------------------------------------
+
+
+def test_bert_flash_matches_xla_on_real_tokens():
+    """BertConfig(attn_impl='flash') routes encoder attention through the
+    flash family with the padding mask as segment ids; real-token rows
+    must match the XLA path (pad-query rows differ by design and are
+    -100-masked in the loss)."""
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    rng = np.random.RandomState(0)
+    B, S = 2, 64
+    ids = jnp.asarray(rng.randint(0, 512, size=(B, S)))
+    mask = np.ones((B, S), bool)
+    mask[:, S - 10:] = False  # padded tail
+    mask_j = jnp.asarray(mask)
+
+    cfg_x = BertConfig.tiny(dtype=jnp.float32)
+    model_x = BertModel(cfg_x)
+    params = model_x.init_params(jax.random.PRNGKey(0))
+    logits_x = model_x.forward(params, ids, attention_mask=mask_j)
+
+    import dataclasses
+
+    model_f = BertModel(dataclasses.replace(cfg_x, attn_impl="flash"))
+    logits_f = model_f.forward(params, ids, attention_mask=mask_j)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_f)[mask], np.asarray(logits_x)[mask],
+        rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# paged decode (interpret) — consolidating the kernel-parity shard
+# ---------------------------------------------------------------------------
+
+
+def test_paged_decode_kernel_matches_reference_interpret():
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_reference)
+
+    rng = np.random.RandomState(3)
+    B, h, d, bs, nblocks = 3, 2, 64, 16, 12
+    q = jnp.asarray(rng.randn(B, h, d), jnp.float32)
+    k_pool = jnp.asarray(rng.randn(nblocks, bs, h, d), jnp.float32)
+    v_pool = jnp.asarray(rng.randn(nblocks, bs, h, d), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(nblocks)[:B * 3].reshape(B, 3), jnp.int32)
+    lengths = jnp.asarray([41, 16, 33], jnp.int32)
+    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                                 interpret=True)
+    ref = paged_decode_reference(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -25,8 +25,9 @@ def test_grid_finds_planted_best():
     runner, result = run_search(GridStrategy())
     assert result.best is not None
     assert result.best.candidate == SYNTHETIC_BEST
-    # grid measures every feasible candidate exactly once
-    assert result.trials_run == len(runner.calls) == 30
+    # grid measures every feasible candidate exactly once (5·2·3 batch
+    # combos × 2·2·3 kernel-plane combos since the ISSUE-12 dims landed)
+    assert result.trials_run == len(runner.calls) == 360
 
 
 def test_successive_halving_finds_planted_best():
@@ -41,10 +42,10 @@ def test_successive_halving_finds_planted_best():
 
 def test_oom_candidates_recorded_infeasible_not_crashed():
     _, result = run_search(GridStrategy())
-    # mb=16 below stage 3 OOMs: 2 gas values x 2 stages = 4 candidates
-    assert result.infeasible == 4
+    # mb=16 below stage 3 OOMs: 2 gas x 2 stages x 12 kernel combos
+    assert result.infeasible == 48
     oom_recs = [r for r in result.records if r.get("oom")]
-    assert len(oom_recs) == 4
+    assert len(oom_recs) == 48
     for r in oom_recs:
         assert r["candidate"]["train_micro_batch_size_per_gpu"] == 16
         assert r["candidate"]["zero_optimization.stage"] < 3
@@ -85,15 +86,46 @@ def test_max_candidates_budget_truncation_is_visible():
 def test_store_entry_carries_provenance():
     _, result = run_search(GridStrategy())
     entry = result.to_store_entry()
-    assert entry["overrides"] == SYNTHETIC_BEST
-    assert entry["model_overrides"] == {}
+    # model.* dims split into model_overrides (initialize() cannot
+    # rebuild the caller's model); dotted config dims stay in overrides
+    assert entry["overrides"] == {
+        k: v for k, v in SYNTHETIC_BEST.items()
+        if not k.startswith("model.")}
+    assert entry["model_overrides"] == {"attn_impl": "flash"}
     assert entry["status"] == "candidate"
     assert entry["scores"]["tokens_per_sec"] == 10000.0
     prov = entry["provenance"]
     assert prov["strategy"] == "grid"
     assert prov["score_metric"] == "tokens_per_sec"
-    assert prov["search_budget"]["trials_run"] == 30
-    assert prov["search_budget"]["infeasible"] == 4
+    assert prov["search_budget"]["trials_run"] == 360
+    assert prov["search_budget"]["infeasible"] == 48
+
+
+def test_default_space_carries_the_kernel_plane_dimensions():
+    """ISSUE 12 acceptance: every kernel is a searchable dimension —
+    attention impl, flash block sizes, fused optimizer, overlap chunks
+    — with feasibility gating (blocks pinned to auto unless flash is
+    on; chunk counts pinned unless overlap is on)."""
+    from deepspeed_tpu.tuning import default_space
+
+    names = default_space().names()
+    for dim in ("model.attn_impl", "model.flash_block_q",
+                "model.flash_block_k", "kernels.fused_adam",
+                "kernels.overlap_collectives", "kernels.overlap_chunks"):
+        assert dim in names, dim
+    combos = list(default_space(max_micro_batch=1).candidates())
+    for c in combos:
+        if c["model.attn_impl"] != "flash":
+            assert c["model.flash_block_q"] == 0
+            assert c["model.flash_block_k"] == 0
+        if not c["kernels.overlap_collectives"]:
+            assert c["kernels.overlap_chunks"] == 4
+    # both kernel on-states survive enumeration
+    assert any(c["model.attn_impl"] == "flash"
+               and c["model.flash_block_q"] == 512 for c in combos)
+    assert any(c["kernels.fused_adam"] for c in combos)
+    assert any(c["kernels.overlap_collectives"]
+               and c["kernels.overlap_chunks"] == 8 for c in combos)
 
 
 def test_model_override_dimension_splits_to_model_side():

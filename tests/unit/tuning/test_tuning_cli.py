@@ -27,7 +27,11 @@ def test_synthetic_search_finds_planted_best_and_persists(capsys,
     assert doc["best"] == SYNTHETIC_BEST
     assert doc["status"] == "candidate"
     entry = BestConfigStore(store_path, fallback=None).get(doc["key"])
-    assert entry["overrides"] == SYNTHETIC_BEST
+    # model.attn_impl splits into model_overrides on store persist
+    assert entry["overrides"] == {
+        k: v for k, v in SYNTHETIC_BEST.items()
+        if not k.startswith("model.")}
+    assert entry["model_overrides"] == {"attn_impl": "flash"}
     assert entry["provenance"]["source"] == "cli --synthetic"
 
 
