@@ -139,15 +139,24 @@ class DSElasticAgent:
         collective ledger's ``coll_seq``/``coll_hash`` ride along
         whenever the ledger is on — with or without a watchdog — so
         rank 0 can flag a collective desync live."""
-        from ..telemetry import get_collective_ledger, get_watchdog
+        from ..telemetry import (cap_heartbeat_payload,
+                                 get_collective_ledger, get_watchdog)
+        from ..telemetry.watchdog import DEFAULT_HEARTBEAT_MAX_BYTES
 
         wd = get_watchdog()
-        payload = wd.heartbeat_payload() if wd is not None else None
+        if wd is not None:
+            # the watchdog assembles AND caps its own payload with its
+            # configured bound — never re-add fields its cap dropped
+            # (that would ship past the operator's limit and bump the
+            # drop counter every single beat)
+            return wd.heartbeat_payload()
         led = get_collective_ledger()
-        if led.enabled and (payload is None or "coll_seq" not in payload):
-            payload = dict(payload or {})
-            payload.update(led.heartbeat_summary())
-        return payload
+        if not led.enabled:
+            return None
+        # ledger-only path (no watchdog installed): same schema version
+        # + the documented default bound
+        return cap_heartbeat_payload(dict(led.heartbeat_summary()),
+                                     DEFAULT_HEARTBEAT_MAX_BYTES)
 
     def _heartbeat_tick(self) -> None:
         """One liveness beat: heartbeat (+watchdog/ledger payload); the
@@ -166,6 +175,19 @@ class DSElasticAgent:
                 debug_once("elastic/publisher_tick",
                            f"bundle publisher tick failed ({e!r}); "
                            f"retrying next heartbeat")
+        else:
+            # subprocess mode: the WORKER owns the publisher (and its
+            # tick runs the clock sync + metrics push); the agent still
+            # keeps its own store-clock estimate fresh so agent-side
+            # spans land aligned in merged traces
+            try:
+                from ..telemetry import maybe_sync_clock
+
+                maybe_sync_clock(self.rdzv.c, node_id=self.node_id)
+            except Exception as e:
+                debug_once("elastic/clock_sync",
+                           f"agent clock sync failed ({e!r}); retrying "
+                           f"next heartbeat")
         if self._rank == 0 and len(self._peers) > 1:
             try:
                 self.rdzv.publish_straggler_stats(self._peers)
@@ -174,6 +196,19 @@ class DSElasticAgent:
                 # store hiccup; the next tick retries
                 debug_once("elastic/straggler_stats",
                            f"straggler/desync publication failed ({e!r}); "
+                           f"retrying next heartbeat")
+            try:
+                # the live cross-process rollup (ISSUE 13): ingest every
+                # peer's published registry snapshot + step batch, feed
+                # the cluster gauges, keep the merged exports fresh
+                from ..telemetry import get_telemetry, rollup_tick
+
+                rollup_tick(self.rdzv.c, self._peers,
+                            out_dir=get_telemetry().output_path)
+            except Exception as e:
+                # store hiccup / peers not publishing yet; next tick
+                debug_once("elastic/rollup_tick",
+                           f"metrics rollup tick failed ({e!r}); "
                            f"retrying next heartbeat")
 
     def _record_stale_peers(self, stale: List[str]) -> None:

@@ -186,6 +186,12 @@ class TelemetryWatchdogConfig(DeepSpeedConfigModel):
     #: annotation instead of a 180 s+ hang (BENCH_r05)
     device_probe: bool = True
     device_probe_timeout_s: float = 20.0
+    #: byte cap on the heartbeat payload (JSON size).  The payload is
+    #: versioned (``v``) and fields drop in a deterministic order
+    #: (``telemetry.watchdog.HEARTBEAT_DROP_ORDER``) when over the cap,
+    #: counted by ``elastic/heartbeat_fields_dropped_total``; <= 0
+    #: disables the cap
+    heartbeat_max_bytes: int = 1024
 
 
 class TelemetryHealthConfig(DeepSpeedConfigModel):
@@ -261,6 +267,18 @@ class TelemetryAggregationConfig(DeepSpeedConfigModel):
     #: trace-sourced census (profiling.collective_trace.feed_exec_census)
     #: is the cross-rank-comparable execution-order source
     ledger_exec_feed: bool = False
+    #: cross-process metrics rollup (telemetry/rollup.py): every worker
+    #: ships its registry snapshot + step-record batch on the publisher
+    #: tick; rank 0 merges them into one per-node-labeled view
+    metrics_rollup: bool = True
+    #: publish cadence (seconds) for the snapshot/step batch; the
+    #: heartbeat tick is the transport, this bounds its payload rate
+    metrics_push_every_s: float = 2.0
+    #: compact StepRecord streaming to the rollup: bounded ring, batched
+    #: on the publisher tick, degraded-mode buffered (flushes exactly
+    #: once after a store restart — the rollup dedups by sequence)
+    step_stream: bool = True
+    step_stream_len: int = 256
 
 
 class TelemetryMemoryConfig(DeepSpeedConfigModel):

@@ -391,7 +391,9 @@ class DeepSpeedEngine:
                 # trips WITHOUT writing bundles (the operator said no)
                 recorder=self.flight_recorder,
                 device_probe=wd_cfg.device_probe,
-                device_probe_timeout_s=wd_cfg.device_probe_timeout_s)
+                device_probe_timeout_s=wd_cfg.device_probe_timeout_s,
+                heartbeat_max_bytes=getattr(wd_cfg, "heartbeat_max_bytes",
+                                            1024))
             # process-global handle: the elastic agent folds the
             # watchdog's heartbeat_payload into rendezvous heartbeats
             set_watchdog(self.watchdog)

@@ -192,6 +192,21 @@ def initialize(args: Any = None,
                 tail=cfg.telemetry.aggregation.ledger_tail,
                 exec_feed=cfg.telemetry.aggregation.ledger_exec_feed,
                 recorder=recorder)
+        # cross-process telemetry plane (telemetry/rollup.py): compact
+        # StepRecords buffer in a bounded ring and ship to rank 0's
+        # rollup on the publisher tick (with the registry snapshot)
+        from ..telemetry import configure_step_stream
+
+        configure_step_stream(
+            enabled=(cfg.telemetry.aggregation.metrics_rollup
+                     and cfg.telemetry.aggregation.step_stream),
+            maxlen=cfg.telemetry.aggregation.step_stream_len)
+    else:
+        # a previous initialize() may have enabled the stream — this
+        # engine's config says no aggregation, so stop buffering
+        from ..telemetry import configure_step_stream
+
+        configure_step_stream(enabled=False)
 
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time

@@ -45,11 +45,15 @@ from .memory import (HBMExhaustedError, MemoryLedger,
 from .perf import (CompileTracker, GoodputLedger, configure_compile_tracker,
                    configure_goodput_ledger, get_compile_tracker,
                    get_goodput_ledger, tracked_jit)
+from .clocksync import ClockSync, get_clock_sync, maybe_sync_clock
+from .rollup import (MetricsRollup, StepStream, collect_rollup,
+                     configure_step_stream, get_rollup, get_step_stream,
+                     push_node_telemetry, render_top, rollup_tick)
 from .step_record import (StepRecord, collect_memory_stats,
                           publish_step_record)
 from .tracer import NOOP_SPAN, SpanTracer, device_fence
-from .watchdog import (HangWatchdog, WatchdogTimeout, get_watchdog,
-                       set_watchdog)
+from .watchdog import (HEARTBEAT_SCHEMA_V, HangWatchdog, WatchdogTimeout,
+                       cap_heartbeat_payload, get_watchdog, set_watchdog)
 
 __all__ = [
     "Telemetry", "StepRecord", "MetricsRegistry", "SpanTracer",
@@ -70,6 +74,11 @@ __all__ = [
     "get_goodput_ledger",
     "MemoryLedger", "configure_memory_ledger", "get_memory_ledger",
     "HBMExhaustedError", "is_oom_error", "probe_device_liveness",
+    "MetricsRollup", "StepStream", "collect_rollup",
+    "configure_step_stream", "get_rollup", "get_step_stream",
+    "push_node_telemetry", "render_top", "rollup_tick",
+    "ClockSync", "get_clock_sync", "maybe_sync_clock",
+    "HEARTBEAT_SCHEMA_V", "cap_heartbeat_payload",
 ]
 
 
@@ -157,6 +166,12 @@ class Telemetry:
         if not self.enabled:
             return
         publish_step_record(self.registry, rec)
+        # cross-process streaming (telemetry/rollup.py): a compact copy
+        # rides the bounded ring until the next publisher beat ships it
+        # to rank 0's rollup (no-op unless aggregation enabled it)
+        from .rollup import get_step_stream
+
+        get_step_stream().push(rec)
 
     # -- export ------------------------------------------------------------
 

@@ -49,6 +49,11 @@ from .collective_ledger import (find_first_divergence,
 from .flight_recorder import BUNDLE_MANIFEST
 
 CLUSTER_MANIFEST = "cluster_manifest.json"
+#: the clock-aligned merged trace `telemetry collect` assembles from
+#: every host bundle's trace.json (ISSUE 13): one Chrome-trace document
+#: with a lane (pid) per process, span timestamps shifted onto the
+#: shared store clock via each tracer's clock_sync metadata
+CLUSTER_TRACE = "cluster_trace.json"
 _REQ_KEY = "debug/req"
 
 
@@ -221,7 +226,8 @@ class BundlePublisher:
     def __init__(self, node_id: str, recorder: Any = None,
                  chunk_bytes: int = 256 * 1024,
                  max_bundle_bytes: int = 32 * 1024 * 1024,
-                 shared_fs_path: str = ""):
+                 shared_fs_path: str = "",
+                 telemetry_push_every_s: float = 2.0):
         self.node_id = node_id
         #: None = resolve the process-global recorder at tick time (the
         #: ledger reaches bundles through its flight-recorder context
@@ -237,6 +243,11 @@ class BundlePublisher:
         self._last_published: Optional[str] = None
         #: watchdog trips already answered with a PARTIAL push
         self._trips_pushed = 0
+        #: cross-process rollup publish cadence (telemetry/rollup.py):
+        #: the tick ships the registry snapshot + step-stream batch at
+        #: most this often (<= 0 disables the push entirely)
+        self.telemetry_push_every_s = float(telemetry_push_every_s)
+        self._last_telemetry_push = 0.0
         # the agent's heartbeat loop and the worker-side daemon (subprocess
         # mode) may drive the same publisher — one beat at a time
         self._tick_lock = threading.Lock()
@@ -300,6 +311,26 @@ class BundlePublisher:
             payload["stacks"] = f"unavailable: {e!r}"
         return payload
 
+    def _maybe_push_telemetry(self, client: Any) -> None:
+        """Cadence-gated cross-process telemetry publish (the tentpole
+        transport): estimate/refresh the store-clock offset, then ship
+        the registry snapshot and the step stream's unacked batch.
+        Raises the client's ConnectionError family on an outage so the
+        caller's degraded path counts and retries it."""
+        if self.telemetry_push_every_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_telemetry_push < self.telemetry_push_every_s:
+            return
+        from .clocksync import maybe_sync_clock
+        from .rollup import push_node_telemetry
+
+        maybe_sync_clock(client, node_id=self.node_id)
+        push_node_telemetry(client, self.node_id)
+        # stamp only after SUCCESS: a degraded beat retries immediately
+        # on the next healthy tick instead of waiting out the cadence
+        self._last_telemetry_push = now
+
     def _maybe_push_partial(self, client: Any) -> None:
         """ROADMAP follow-up (ISSUE 4 satellite): when the watchdog
         trips, event-push a best-effort PARTIAL ledger (tail + stacks)
@@ -345,6 +376,15 @@ class BundlePublisher:
                            f"partial-ledger push failed ({e!r}); "
                            f"retrying next tick")
             try:
+                # cross-process telemetry (ISSUE 13): clock sync (cheap
+                # no-op unless the store generation moved) + the metrics
+                # snapshot / step-record batch at the configured cadence.
+                # A store-down failure lands in the ConnectionError
+                # branch below: the beat degrades, the step batch stays
+                # buffered in its bounded ring, and the next healthy
+                # beat flushes it exactly once (the rollup dedups by
+                # sequence).
+                self._maybe_push_telemetry(client)
                 req = int(client.get(_REQ_KEY) or 0)
                 rec = self.recorder()
                 if req > self._last_req_served:
@@ -544,6 +584,19 @@ def collect_cluster_archive(client: Any, peer_ids: Optional[List[str]] = None,
                            heartbeat_ages=_heartbeat_view(client, peer_ids),
                            missing=missing, req_id=req_id,
                            partials=partials)
+    try:
+        build_cluster_trace(archive)
+    except Exception as e:  # the archive is still useful without it
+        logger.warning(f"aggregator: cluster trace assembly failed: {e!r}")
+    try:
+        # the live rollup view at collect time: merged per-node-labeled
+        # metrics straight from the store, next to the bundles
+        from .rollup import collect_rollup
+
+        collect_rollup(client, peer_ids).save(archive)
+    except (OSError, ConnectionError, ValueError) as e:
+        logger.warning(f"aggregator: rollup snapshot at collect failed: "
+                       f"{e!r}")
     logger.error(f"aggregator: cluster archive written to {archive} "
                  f"({len(got)}/{len(peer_ids)} hosts"
                  + (f", missing {missing}" if missing else "") + ")")
@@ -579,6 +632,10 @@ def collect_cluster_archive_fs(shared_fs_path: str,
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         shutil.copytree(src, dst)
     build_cluster_manifest(archive)
+    try:
+        build_cluster_trace(archive)
+    except Exception as e:
+        logger.warning(f"aggregator: cluster trace assembly failed: {e!r}")
     return archive
 
 
@@ -704,6 +761,105 @@ def build_cluster_manifest(archive: str,
 
 
 # ---------------------------------------------------------------------------
+# clock-aligned merged trace (ISSUE 13 tentpole)
+# ---------------------------------------------------------------------------
+
+def _newest_bundle_trace(node_dir: str) -> Optional[str]:
+    for bundle in sorted(os.listdir(node_dir), reverse=True):
+        p = os.path.join(node_dir, bundle, "trace.json")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def build_cluster_trace(archive: str, persist: bool = True
+                        ) -> Optional[Dict[str, Any]]:
+    """Merge every host bundle's ``trace.json`` into ONE Chrome-trace
+    document with clock-aligned per-process lanes.
+
+    Each tracer exports ``metadata.clock_sync.trace_to_store_offset_us``
+    (``telemetry/clocksync.py``): adding it to a span's ``ts`` lands the
+    span on the shared store clock.  Hosts are remapped onto distinct
+    ``pid`` lanes (with ``process_name`` metadata events so Perfetto
+    labels them by node id), aligned timestamps are re-based to the
+    earliest aligned span across the gang, and hosts WITHOUT a clock
+    sync are still included — flagged ``aligned: false`` and left on
+    their private timebase (re-based to zero) rather than dropped.  The
+    result is what makes a store outage or a straggler legible as
+    aligned slices across processes."""
+    hosts_dir = os.path.join(archive, "hosts")
+    if not os.path.isdir(hosts_dir):
+        return None
+    lanes: Dict[str, Dict[str, Any]] = {}
+    for node in sorted(os.listdir(hosts_dir)):
+        node_dir = os.path.join(hosts_dir, node)
+        if not os.path.isdir(node_dir):
+            continue
+        tp = _newest_bundle_trace(node_dir)
+        if tp is None:
+            continue
+        try:
+            with open(tp) as fh:
+                trace = json.load(fh)
+        except (OSError, ValueError) as e:
+            logger.warning(f"aggregator: unreadable trace for {node} "
+                           f"({e!r}); lane skipped")
+            continue
+        meta = trace.get("metadata") or {}
+        sync = meta.get("clock_sync") or {}
+        off_us = sync.get("trace_to_store_offset_us")
+        events = [e for e in (trace.get("traceEvents") or [])
+                  if isinstance(e.get("ts"), (int, float))]
+        lanes[node] = {
+            "events": events,
+            "aligned": isinstance(off_us, (int, float)),
+            "offset_us": float(off_us) if isinstance(
+                off_us, (int, float)) else 0.0,
+            "clock_sync": sync or None,
+        }
+    if not lanes:
+        return None
+    aligned_starts = [ev["ts"] + lane["offset_us"]
+                      for lane in lanes.values() if lane["aligned"]
+                      for ev in lane["events"]]
+    base_us = min(aligned_starts) if aligned_starts else 0.0
+    out_events: List[Dict[str, Any]] = []
+    hosts_meta: Dict[str, Any] = {}
+    for pid, node in enumerate(sorted(lanes)):
+        lane = lanes[node]
+        out_events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "args": {"name": node + (
+                               "" if lane["aligned"] else " (unaligned)")}})
+        lane_min = min((ev["ts"] for ev in lane["events"]), default=0.0)
+        for ev in lane["events"]:
+            ev = dict(ev)
+            if lane["aligned"]:
+                ev["ts"] = round(ev["ts"] + lane["offset_us"] - base_us, 1)
+            else:
+                # no clock sync: keep internal order, re-based to zero
+                ev["ts"] = round(ev["ts"] - lane_min, 1)
+            ev["pid"] = pid
+            out_events.append(ev)
+        hosts_meta[node] = {
+            "pid": pid, "aligned": lane["aligned"],
+            "events": len(lane["events"]),
+            "clock_sync": lane["clock_sync"],
+        }
+    doc = {"traceEvents": out_events,
+           "displayTimeUnit": "ms",
+           "metadata": {"source": "deepspeed_tpu.telemetry.aggregator",
+                        "store_clock_base_us": base_us,
+                        "hosts": hosts_meta}}
+    if persist:
+        path = os.path.join(archive, CLUSTER_TRACE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    return doc
+
+
+# ---------------------------------------------------------------------------
 # live desync check (rank 0's heartbeat loop)
 # ---------------------------------------------------------------------------
 
@@ -783,6 +939,9 @@ def publisher_from_config(tcfg: Any, node_id: Optional[str] = None
         recorder=recorder,
         chunk_bytes=agg.chunk_bytes,
         max_bundle_bytes=agg.max_bundle_bytes,
-        shared_fs_path=agg.shared_fs_path)
+        shared_fs_path=agg.shared_fs_path,
+        telemetry_push_every_s=(
+            float(getattr(agg, "metrics_push_every_s", 2.0))
+            if getattr(agg, "metrics_rollup", True) else 0.0))
     set_publisher(pub)
     return pub

@@ -23,9 +23,14 @@ The read side of the observability plane, for humans at 3am:
 * ``mem``      — the memory plane (``telemetry/memory``): ``mem show``
   one bundle's pool breakdown, ``mem top`` its largest live arrays,
   ``mem diff`` two bundles with a leak verdict (exit 3).
+* ``top``      — the LIVE cluster view (``telemetry/rollup.py``):
+  per-node step / step-time EWMA / goodput / hbm / heartbeat age /
+  store-outage counters rendered straight from the rendezvous store's
+  rollup publications — no bundle collection, no engine.  ``--once``
+  prints one frame and exits 0 (scriptable); default refreshes.
 
-Every command works on plain directories — no store, no JAX device
-needed beyond what importing the package costs.
+Every command except ``collect``/``top`` works on plain directories —
+no store, no JAX device needed beyond what importing the package costs.
 """
 
 from __future__ import annotations
@@ -36,9 +41,9 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-from .aggregator import (CLUSTER_MANIFEST, build_cluster_manifest,
-                         collect_cluster_archive, collect_cluster_archive_fs,
-                         load_host_manifests)
+from .aggregator import (CLUSTER_MANIFEST, CLUSTER_TRACE,
+                         build_cluster_manifest, collect_cluster_archive,
+                         collect_cluster_archive_fs, load_host_manifests)
 from .collective_ledger import (find_first_divergence,
                                 format_divergence_report)
 from .flight_recorder import BUNDLE_MANIFEST, BUNDLE_TRACE
@@ -185,6 +190,18 @@ def _print_archive_summary(archive: str, last_n: int) -> int:
     print(f"  created: {cm.get('created_utc')}  "
           f"hosts: {len(cm.get('hosts') or {})}  "
           f"missing: {cm.get('missing_hosts') or 'none'}")
+    ct_path = os.path.join(archive, CLUSTER_TRACE)
+    if os.path.exists(ct_path):
+        try:
+            with open(ct_path) as fh:
+                hosts_meta = (json.load(fh).get("metadata")
+                              or {}).get("hosts") or {}
+            aligned = sum(1 for h in hosts_meta.values()
+                          if h.get("aligned"))
+            print(f"  merged trace: {CLUSTER_TRACE} "
+                  f"({len(hosts_meta)} lanes, {aligned} clock-aligned)")
+        except (OSError, ValueError):
+            print(f"  merged trace: {CLUSTER_TRACE} (unreadable)")
     partials = cm.get("partials") or {}
     for node in cm.get("missing_hosts") or []:
         p = partials.get(node)
@@ -345,6 +362,63 @@ def cmd_collect(args: argparse.Namespace) -> int:
 
 
 # ---------------------------------------------------------------------------
+# top — the live cluster view (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _render_top_frame(client: Any, peers: Optional[List[str]],
+                      endpoint: str, silent_after_s: float = 30.0) -> str:
+    from .aggregator import _heartbeat_view, sealed_members
+    from .rollup import collect_rollup, render_top
+
+    peer_ids = peers or sealed_members(client)
+    if not peer_ids:
+        # no sealed round yet: fall back to whoever has published
+        # telemetry (a gang mid-formation is still worth watching)
+        peer_ids = sorted(k.rsplit("/", 1)[1]
+                          for k in client.keys("telemetry/metrics/"))
+    if not peer_ids:
+        raise ValueError("no peers: store has no sealed round and no "
+                         "telemetry publications (pass --peers)")
+    rollup = collect_rollup(client, peer_ids)
+    hb = _heartbeat_view(client, peer_ids)
+    store_info = {"endpoint": endpoint,
+                  "generation": client.get("srv/gen"),
+                  "round": client.get("rdzv/round")}
+    return render_top(rollup, hb_view=hb, store_info=store_info,
+                      silent_after_s=silent_after_s)
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    if not args.endpoint:
+        return _fail("top needs --endpoint host:port "
+                     "(or $DS_RDZV_ENDPOINT)")
+    import time as _time
+
+    from ..elasticity.rendezvous import RendezvousClient
+
+    client = RendezvousClient(args.endpoint, retries=1, backoff_s=0.05)
+    peers = [p for p in (args.peers or "").split(",") if p] or None
+    frames = 0
+    try:
+        while True:
+            try:
+                frame = _render_top_frame(client, peers, args.endpoint,
+                                          silent_after_s=args.silent_after)
+            except (ValueError, ConnectionError, OSError) as e:
+                return _fail(f"top: {e}")
+            if frames:
+                print()  # frame separator (no TTY games — pipe-friendly)
+            print(f"--- {_time.strftime('%H:%M:%S')}")
+            print(frame, flush=True)
+            frames += 1
+            if args.once or (args.frames and frames >= args.frames):
+                return 0
+            _time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+# ---------------------------------------------------------------------------
 # perf — the regression sentinel
 # ---------------------------------------------------------------------------
 
@@ -358,6 +432,17 @@ def cmd_perf(args: argparse.Namespace) -> int:
     metrics = perfmod.extract_perf(run)
 
     if args.perf_cmd == "show":
+        # satellite (ISSUE 13): an environment-failure artifact (r05's
+        # dead tunnel — value 0.0 + error, or the explicit marker) is a
+        # SKIPPED round and must say so — `check` already understood
+        # the marker, but `show` used to render 0.0 as if measured
+        reason = perfmod.environment_failure_reason(run)
+        if reason:
+            print(f"run: {args.run}")
+            print(f"  SKIPPED round — environment failure: {reason}")
+            print("  (no metrics were measured; values in this artifact "
+                  "are placeholders, not results)")
+            return 0
         if not metrics:
             return _fail(f"{args.run}: no sentinel metrics "
                          f"({', '.join(perfmod.PERF_METRICS)})")
@@ -456,6 +541,25 @@ def build_parser() -> argparse.ArgumentParser:
                                       "(exit 3 when desync found)")
     y.add_argument("archive")
     y.set_defaults(fn=cmd_desync)
+
+    t = sub.add_parser("top", help="live cluster view from the store's "
+                                   "metrics rollup (no bundles)")
+    t.add_argument("--endpoint", default=os.environ.get("DS_RDZV_ENDPOINT"),
+                   help="rendezvous store host:port "
+                        "(default: $DS_RDZV_ENDPOINT)")
+    t.add_argument("--peers", default="",
+                   help="comma-separated node ids (default: the store's "
+                        "current sealed round, else every publishing node)")
+    t.add_argument("--once", action="store_true",
+                   help="print one frame and exit 0")
+    t.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    t.add_argument("--frames", type=int, default=0,
+                   help="stop after N frames (0 = until interrupted)")
+    t.add_argument("--silent-after", type=float, default=30.0,
+                   help="heartbeat age (s) past which a node renders "
+                        "SILENT")
+    t.set_defaults(fn=cmd_top)
 
     from .perf.baseline import DEFAULT_BASELINE
 

@@ -1,0 +1,171 @@
+"""Store-clock synchronization — one timeline for N processes.
+
+Every cross-process artifact in this plane (heartbeat staleness, the
+metrics rollup, merged traces) needs ONE clock, and the rendezvous
+store's monotonic clock is already that clock for heartbeats (the
+server stamps ``op=hb`` itself).  Traces can't be server-stamped — a
+span's start/end happen on the worker — so each client ESTIMATES its
+offset to the store clock the classic NTP way: send ``now``, halve the
+round trip, take the best (minimum-RTT) of a few probes::
+
+    t0 = perf_counter()          # local send
+    s  = client.now()            # store monotonic
+    t1 = perf_counter()          # local receive
+    offset = s - (t0 + t1) / 2   # store_time ~= perf_counter() + offset
+
+The estimate is re-taken **per reconnect generation**: a store restart
+(``srv/gen`` change) resets the store's monotonic epoch, and a healed
+partition may have let the estimate go stale — both invalidate the old
+offset, so :func:`maybe_sync_clock` keys the cached estimate on
+``(srv_gen, reconnects)`` and refreshes exactly when either moves.
+
+On every successful estimate the process-global span tracer is stamped
+(:meth:`SpanTracer.set_clock_sync`), so the Chrome-trace export — and
+therefore every debug bundle's ``trace.json`` — carries the mapping
+from its private ``perf_counter`` timebase to the shared store clock.
+``telemetry collect`` uses exactly that mapping to merge N hosts'
+traces into one clock-aligned ``cluster_trace.json``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import debug_once
+
+#: probes per estimate — the minimum-RTT sample wins (queueing delay
+#: only ever ADDS to a round trip, so the fastest probe is the truest)
+DEFAULT_PROBES = 5
+
+
+class ClockSync:
+    """Cached store-clock offset for this process (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.offset_s: Optional[float] = None
+        self.rtt_s: Optional[float] = None
+        #: the (srv/gen, client reconnect count) the estimate was taken
+        #: under — either moving invalidates it
+        self._key: Optional[tuple] = None
+        self.estimates = 0
+
+    @property
+    def synced(self) -> bool:
+        return self.offset_s is not None
+
+    def status(self) -> Dict[str, Any]:
+        """JSON-able summary (bundle context, rollup meta)."""
+        with self._lock:
+            return {"synced": self.offset_s is not None,
+                    "offset_s": self.offset_s, "rtt_s": self.rtt_s,
+                    "estimates": self.estimates,
+                    "generation": (self._key[0] if self._key else None)}
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._key = None
+
+    def reset(self) -> None:
+        """Test isolation: forget the estimate entirely."""
+        with self._lock:
+            self.offset_s = None
+            self.rtt_s = None
+            self._key = None
+            self.estimates = 0
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _client_key(client: Any) -> tuple:
+        return (getattr(client, "_gen", None),
+                int(getattr(client, "reconnects", 0)))
+
+    def estimate(self, client: Any, probes: int = DEFAULT_PROBES
+                 ) -> Dict[str, float]:
+        """Take a fresh estimate against ``client`` (raises the client's
+        ConnectionError family when the store is down — callers on
+        heartbeat paths guard, same as any other store call).  The
+        validity key is snapshotted BEFORE probing and re-checked after:
+        a store restart mid-estimate would otherwise blend two server
+        epochs into one offset and cache it under the post-restart key,
+        leaving wrong-epoch trace lanes marked aligned forever — a moved
+        key discards the probes and re-takes once, then raises so the
+        next tick starts clean."""
+        for _attempt in range(2):
+            key = self._client_key(client)
+            best_off, best_rtt = None, None
+            for _ in range(max(1, int(probes))):
+                t0 = time.perf_counter()
+                store_now = float(client.now())
+                t1 = time.perf_counter()
+                rtt = t1 - t0
+                if best_rtt is None or rtt < best_rtt:
+                    best_rtt = rtt
+                    best_off = store_now - (t0 + t1) / 2.0
+            if self._client_key(client) != key:
+                continue  # generation/reconnect moved mid-probe: re-take
+            with self._lock:
+                self.offset_s = best_off
+                self.rtt_s = best_rtt
+                self._key = key
+                self.estimates += 1
+            return {"offset_s": best_off, "rtt_s": best_rtt}
+        raise ConnectionError(
+            "store generation kept moving during the clock estimate; "
+            "retrying on the next tick")
+
+    def needs_estimate(self, client: Any) -> bool:
+        key = self._client_key(client)
+        with self._lock:
+            return self._key is None or self._key != key
+
+
+_sync = ClockSync()
+
+
+def get_clock_sync() -> ClockSync:
+    return _sync
+
+
+def maybe_sync_clock(client: Any, tracer: Any = None,
+                     node_id: Optional[str] = None) -> Optional[ClockSync]:
+    """(Re-)estimate the store-clock offset when needed — first call,
+    store restart (``srv/gen`` moved), or a reconnect after an outage —
+    and stamp the span tracer so trace exports carry the mapping.
+    Returns the sync when an estimate is HELD (fresh or cached), None
+    when the store could not be reached for a needed estimate."""
+    sync = _sync
+    if not sync.needs_estimate(client):
+        return sync
+    try:
+        est = sync.estimate(client)
+    except (OSError, ConnectionError, ValueError) as e:
+        # store down mid-estimate: keep whatever estimate we had (a
+        # stale offset beats none for an already-exported trace), retry
+        # on the next tick
+        debug_once("clocksync/estimate",
+                   f"store clock estimate failed ({e!r}); retrying on "
+                   f"the next healthy tick")
+        return sync if sync.synced else None
+    if tracer is None:
+        from . import get_telemetry
+
+        tracer = get_telemetry().tracer
+    try:
+        tracer.set_clock_sync(
+            offset_s=est["offset_s"], rtt_s=est["rtt_s"],
+            generation=getattr(client, "_gen", None), node_id=node_id)
+    except Exception as e:  # a tracer without the hook (test double)
+        debug_once("clocksync/tracer_stamp",
+                   f"tracer clock stamp failed ({e!r})")
+    from . import get_telemetry
+
+    tel = get_telemetry()
+    tel.inc_counter("telemetry/clock_syncs_total",
+                    help="store-clock offset estimates taken")
+    tel.set_gauge("telemetry/clock_offset_s", float(est["offset_s"] or 0.0),
+                  help="estimated local->store clock offset (seconds)")
+    return sync

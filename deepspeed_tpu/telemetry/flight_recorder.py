@@ -214,6 +214,13 @@ class FlightRecorder:
             "files": [BUNDLE_TRACE, BUNDLE_ENV, BUNDLE_STACKS],
         }
         try:
+            # store-clock mapping (telemetry/clocksync.py): the manifest
+            # twin of the trace metadata, so archive tooling can reason
+            # about alignment without parsing trace.json
+            manifest["clock_sync"] = hub.tracer.clock_sync()
+        except Exception as e:
+            manifest["clock_sync"] = {"error": repr(e)}
+        try:
             manifest["metrics_prom"] = hub.registry.prometheus_text()
         except Exception as e:
             manifest["metrics_prom"] = f"unavailable: {e!r}"

@@ -252,6 +252,31 @@ class MetricsRegistry:
         with self._lock:
             self._metrics = {}
 
+    # -- cross-process snapshot (telemetry/rollup.py) ----------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able value snapshot of every metric — the unit the
+        cross-process rollup ships over the store.  Counters/gauges
+        carry their value; histograms carry RAW per-bucket counts (not
+        cumulative) plus sum/count, so N snapshots merge by plain
+        elementwise addition.  Help text rides along so the merged
+        Prometheus export can render it without sharing a registry."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {},
+                               "histograms": {}}
+        for name, m in self.metrics().items():
+            if isinstance(m, Counter):
+                out["counters"][name] = {"value": m.value, "help": m.help}
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = {"value": m.value, "help": m.help}
+            elif isinstance(m, Histogram):
+                with m._lock:
+                    counts = list(m._counts)
+                    hsum, hcount = m._sum, m._count
+                out["histograms"][name] = {
+                    "buckets": list(m.buckets), "counts": counts,
+                    "sum": hsum, "count": hcount, "help": m.help}
+        return out
+
     # -- JSONL -------------------------------------------------------------
 
     def attach_event_log(self, path: str) -> None:

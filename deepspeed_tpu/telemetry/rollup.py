@@ -1,0 +1,720 @@
+"""Cross-process metrics rollup — N registries, ONE merged view.
+
+Every observability layer through PR 12 assumed one process and one
+shared :class:`~.metrics.MetricsRegistry`; PR 11's chaos gang runs N
+real Python processes whose registries can only meet through the
+rendezvous store.  This module is that meeting point (ISSUE 13
+tentpole):
+
+* **publish side** (every worker): :func:`push_node_telemetry` ships
+  the local registry's :meth:`~.metrics.MetricsRegistry.snapshot` plus
+  a batch of compact :class:`StepStream` records to the store under
+  ``telemetry/{metrics,steps}/<node>`` — on the existing heartbeat
+  transport, at a configurable cadence, degraded-mode tolerant (a
+  store outage leaves records in the bounded ring; the next healthy
+  push flushes them exactly once — the consumer dedups by sequence).
+* **rollup side** (rank 0 / the operator): :class:`MetricsRollup`
+  ingests every node's documents and renders ONE merged Prometheus
+  export where **every sample carries a node label** (collision between
+  node-local and rolled-up series is impossible by construction: the
+  rollup never emits an unlabeled sample, and gang aggregates use the
+  reserved ``node="_cluster"`` label value — a real node that dares to
+  call itself ``_cluster`` is remapped).  Counters and histograms also
+  get summed ``_cluster`` aggregates; gauges stay per-node (summing a
+  gauge is a lie).
+* **live view**: ``python -m deepspeed_tpu.telemetry top`` renders the
+  rollup straight from the store — per-node step / step-time EWMA /
+  goodput / hbm / heartbeat age / store health — without collecting a
+  single bundle.
+
+Store keys (all JSON values through ``RendezvousClient``)::
+
+    telemetry/metrics/<node>   {v, node, seq, stream, clock, snapshot}
+    telemetry/steps/<node>     {v, node, stream, records: [{seq, ...}]}
+
+Neither key is write-journaled: snapshots are absolute state (a replay
+of a stale one after a store restart would only regress the view until
+the next cadence tick) and step batches are deduped by ``(stream,
+seq)`` on ingest, so the at-least-once transport still counts each
+record exactly once.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import debug_once, logger
+from .metrics import escape_help, format_labels, prom_name
+
+#: rollup document schema version
+ROLLUP_SCHEMA_V = 1
+
+#: reserved node-label value for gang-wide aggregate samples; a real
+#: node id equal to it is remapped (collision-free by construction)
+CLUSTER_NODE_LABEL = "_cluster"
+
+
+def _metrics_key(node_id: str) -> str:
+    return f"telemetry/metrics/{node_id}"
+
+
+def _steps_key(node_id: str) -> str:
+    return f"telemetry/steps/{node_id}"
+
+
+def node_label_value(node_id: str) -> str:
+    """The label value a node's samples carry — never the reserved
+    aggregate value."""
+    nid = str(node_id)
+    return nid + ":node" if nid == CLUSTER_NODE_LABEL else nid
+
+
+# ---------------------------------------------------------------------------
+# step streaming (publish side)
+# ---------------------------------------------------------------------------
+
+#: compact per-step fields shipped to the rollup — the operator-facing
+#: subset, NOT the full StepRecord (bundles carry that)
+STEP_STREAM_FIELDS = ("step", "loss", "step_time_ms", "tokens_per_sec")
+
+
+class StepStream:
+    """Bounded ring of compact step records awaiting shipment.
+
+    ``push`` assigns a monotonically increasing sequence number;
+    ``unacked`` returns everything not yet confirmed shipped; ``ack``
+    advances the shipped watermark.  A store outage simply leaves the
+    ring growing (bounded — the oldest unshipped records fall off and
+    are counted) until the next healthy push flushes it; the consumer
+    dedups by ``(stream, seq)`` so a retried batch never double-counts.
+    """
+
+    def __init__(self, maxlen: int = 256, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self.maxlen = int(maxlen)
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.maxlen)
+        self._seq = 0
+        self._acked = 0
+        self.dropped = 0
+        #: distinguishes this process's sequence space from a restarted
+        #: predecessor's under the same node id (the consumer resets its
+        #: watermark when the stream id changes)
+        self.stream_id = f"{os.getpid()}-{time.time_ns()}"
+        self._lock = threading.Lock()
+
+    def configure(self, enabled: Optional[bool] = None,
+                  maxlen: Optional[int] = None) -> "StepStream":
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if maxlen is not None and int(maxlen) != self.maxlen:
+                self.maxlen = int(maxlen)
+                self._ring = collections.deque(self._ring,
+                                               maxlen=self.maxlen)
+        return self
+
+    def push(self, rec: Any) -> None:
+        """Append one StepRecord (object or dict) as a compact record."""
+        if not self.enabled:
+            return
+        d = rec.to_dict() if hasattr(rec, "to_dict") else dict(rec)
+        compact = {k: d.get(k) for k in STEP_STREAM_FIELDS}
+        with self._lock:
+            self._seq += 1
+            compact["seq"] = self._seq
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1  # oldest unshipped record falls off
+            self._ring.append(compact)
+
+    def unacked(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r for r in self._ring if r["seq"] > self._acked]
+
+    def ack(self, through_seq: int) -> None:
+        with self._lock:
+            self._acked = max(self._acked, int(through_seq))
+            while self._ring and self._ring[0]["seq"] <= self._acked:
+                self._ring.popleft()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._acked = 0
+            self.dropped = 0
+
+
+_step_stream = StepStream()
+
+
+def get_step_stream() -> StepStream:
+    return _step_stream
+
+
+def configure_step_stream(enabled: bool = True,
+                          maxlen: Optional[int] = None) -> StepStream:
+    """``maxlen=None`` leaves the ring size untouched — a disable call
+    must not silently shrink a sized ring and drop buffered unshipped
+    records."""
+    return _step_stream.configure(enabled=enabled, maxlen=maxlen)
+
+
+# ---------------------------------------------------------------------------
+# publish side
+# ---------------------------------------------------------------------------
+
+_push_lock = threading.Lock()
+_push_seq = 0
+
+
+def push_node_telemetry(client: Any, node_id: str) -> Optional[Dict[str, Any]]:
+    """One publish beat: ship this process's registry snapshot (plus
+    clock-sync status) and the step stream's unacked batch.  Returns the
+    metrics doc shipped, or None when the hub is disabled (nothing to
+    roll up).  Raises the client's ConnectionError family on a store
+    outage — callers (the publisher tick) degrade and retry; the step
+    batch stays unacked so the next healthy beat flushes it."""
+    global _push_seq
+    from . import get_telemetry
+    from .clocksync import get_clock_sync
+
+    tel = get_telemetry()
+    if not tel.enabled:
+        return None
+    with _push_lock:
+        _push_seq += 1
+        seq = _push_seq
+    doc = {"v": ROLLUP_SCHEMA_V, "node": str(node_id), "seq": seq,
+           "stream": _step_stream.stream_id,
+           "clock": get_clock_sync().status(),
+           "snapshot": tel.registry.snapshot()}
+    stream = _step_stream
+    pending = stream.unacked() if stream.enabled else []
+    # metrics first: even if the step set fails mid-outage, the fresher
+    # snapshot is already worth having
+    client.set(_metrics_key(node_id), doc)
+    if pending:
+        client.set(_steps_key(node_id),
+                   {"v": ROLLUP_SCHEMA_V, "node": str(node_id),
+                    "stream": stream.stream_id, "records": pending})
+        # ack only after the set SUCCEEDED: an outage mid-push leaves
+        # the batch buffered for the next healthy beat (exactly-once is
+        # the consumer's seq dedup, at-least-once is this retry)
+        stream.ack(pending[-1]["seq"])
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# rollup (consume side)
+# ---------------------------------------------------------------------------
+
+class MetricsRollup:
+    """Rank 0's (or the operator's) live merged view of the gang."""
+
+    def __init__(self, node_label: str = "node"):
+        self.node_label = str(node_label)
+        #: node -> {"doc": metrics doc, "ingest_mono": local monotonic}
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        #: node -> step-stream consumer state
+        self._steps: Dict[str, Dict[str, Any]] = {}
+        #: rollup_tick loads persisted step watermarks at most once
+        self._watermarks_loaded = False
+        #: rollup_tick cadence stamp (monotonic; 0 = never ticked)
+        self._last_tick_mono = 0.0
+        self._lock = threading.Lock()
+
+    # -- ingest --------------------------------------------------------
+
+    def ingest_metrics(self, node_id: str, doc: Dict[str, Any]) -> bool:
+        """Adopt a node's published snapshot (absolute state — newest
+        wins).  Returns True when the doc advanced the view."""
+        if not isinstance(doc, dict) or "snapshot" not in doc:
+            return False
+        nid = str(node_id)
+        with self._lock:
+            prev = self._nodes.get(nid)
+            if (prev is not None
+                    and prev["doc"].get("stream") == doc.get("stream")
+                    and int(prev["doc"].get("seq", 0))
+                    >= int(doc.get("seq", 0))):
+                return False  # stale or already-seen publication
+            self._nodes[nid] = {"doc": doc,
+                                "ingest_mono": time.monotonic()}
+        return True
+
+    def ingest_steps(self, node_id: str, doc: Dict[str, Any]
+                     ) -> List[Dict[str, Any]]:
+        """Fold a node's step batch in; returns only the NEW records
+        (seq above the per-stream watermark) — a re-pushed batch after
+        a store restart contributes nothing twice."""
+        if not isinstance(doc, dict):
+            return []
+        nid = str(node_id)
+        stream = doc.get("stream")
+        records = [r for r in (doc.get("records") or [])
+                   if isinstance(r, dict) and "seq" in r]
+        with self._lock:
+            st = self._steps.setdefault(
+                nid, {"stream": stream, "last_seq": 0, "ewma_ms": 0.0,
+                      "count": 0, "last": None})
+            if st["stream"] != stream:
+                # the node restarted (new process, new sequence space)
+                st.update({"stream": stream, "last_seq": 0})
+            fresh = [r for r in records
+                     if int(r["seq"]) > int(st["last_seq"])]
+            for r in sorted(fresh, key=lambda r: int(r["seq"])):
+                st["last_seq"] = int(r["seq"])
+                st["count"] += 1
+                st["last"] = r
+                ms = r.get("step_time_ms")
+                if isinstance(ms, (int, float)) and ms == ms:
+                    st["ewma_ms"] = (float(ms) if st["ewma_ms"] == 0.0
+                                     else 0.9 * st["ewma_ms"]
+                                     + 0.1 * float(ms))
+        return [dict(r, node=nid) for r in fresh]
+
+    # -- read side -----------------------------------------------------
+
+    def node_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def node_doc(self, node_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._nodes.get(str(node_id))
+            return entry["doc"] if entry else None
+
+    def _gauge_value(self, snap: Dict[str, Any], name: str
+                     ) -> Optional[float]:
+        g = (snap.get("gauges") or {}).get(name)
+        return None if g is None else float(g.get("value", 0.0))
+
+    def _counter_value(self, snap: Dict[str, Any], name: str
+                       ) -> Optional[float]:
+        c = (snap.get("counters") or {}).get(name)
+        return None if c is None else float(c.get("value", 0.0))
+
+    def rows(self, hb_view: Optional[Dict[str, Dict[str, Any]]] = None
+             ) -> List[Dict[str, Any]]:
+        """Per-node operator rows for ``telemetry top`` — everything the
+        3am question needs, none of it from bundles."""
+        hb_view = hb_view or {}
+        out = []
+        with self._lock:
+            nodes = {n: dict(e) for n, e in self._nodes.items()}
+            steps = {n: dict(s) for n, s in self._steps.items()}
+        for nid in sorted(set(nodes) | set(hb_view)):
+            entry = nodes.get(nid)
+            doc = entry["doc"] if entry else {}
+            snap = doc.get("snapshot") or {}
+            st = steps.get(nid) or {}
+            hb = hb_view.get(nid) or {}
+            last = st.get("last") or {}
+            step = last.get("step")
+            if step is None:
+                step = self._gauge_value(snap, "train/step")
+            ewma = st.get("ewma_ms") or self._gauge_value(
+                snap, "train/step_time_ms_last")
+            row = {
+                "node": nid,
+                "v": doc.get("v"),
+                "published": entry is not None,
+                "step": step,
+                "step_time_ewma_ms": ewma,
+                "loss": last.get("loss"),
+                "goodput": self._gauge_value(snap, "goodput/fraction"),
+                "hbm_frac": self._gauge_value(snap, "memory/hbm_frac"),
+                "steps_streamed": st.get("count", 0),
+                "store_outages": self._counter_value(
+                    snap, "elasticity/store_outages_total"),
+                "store_degraded_s": self._counter_value(
+                    snap, "elasticity/store_degraded_seconds_total"),
+                "hb_age_s": hb.get("age_s"),
+                "left": bool(hb.get("left")),
+                "clock_offset_s": (doc.get("clock") or {}).get("offset_s"),
+            }
+            out.append(row)
+        return out
+
+    # -- merged Prometheus export --------------------------------------
+
+    def prometheus_text(self) -> str:
+        """ONE exposition document for the whole gang.  Construction
+        rules (the no-collision guarantee): every sample the rollup
+        emits carries the ``node`` label — node-local series are always
+        ``name{...,node="<id>"}``, gang aggregates are always
+        ``name{...,node="_cluster"}``, and a node id equal to the
+        reserved value is remapped by :func:`node_label_value` — so no
+        two distinct sources can ever render the same sample key."""
+        with self._lock:
+            docs = {n: e["doc"] for n, e in self._nodes.items()}
+        counters: Dict[str, Dict[str, Any]] = {}
+        gauges: Dict[str, Dict[str, Any]] = {}
+        hists: Dict[str, Dict[str, Any]] = {}
+        for nid in sorted(docs):
+            snap = docs[nid].get("snapshot") or {}
+            for name, m in (snap.get("counters") or {}).items():
+                counters.setdefault(name, {"help": m.get("help", ""),
+                                           "by_node": {}})
+                counters[name]["by_node"][nid] = float(m.get("value", 0.0))
+            for name, m in (snap.get("gauges") or {}).items():
+                gauges.setdefault(name, {"help": m.get("help", ""),
+                                         "by_node": {}})
+                gauges[name]["by_node"][nid] = float(m.get("value", 0.0))
+            for name, m in (snap.get("histograms") or {}).items():
+                hists.setdefault(name, {"help": m.get("help", ""),
+                                        "by_node": {}})
+                hists[name]["by_node"][nid] = m
+
+        lines: List[str] = []
+
+        def label(nid: str, extra: Optional[Dict[str, Any]] = None) -> str:
+            labels = dict(extra or {})
+            labels[self.node_label] = node_label_value(nid)
+            return format_labels(labels)
+
+        def agg_label(extra: Optional[Dict[str, Any]] = None) -> str:
+            labels = dict(extra or {})
+            labels[self.node_label] = CLUSTER_NODE_LABEL
+            return format_labels(labels)
+
+        for name in sorted(counters):
+            e = counters[name]
+            base = prom_name(name)
+            if e["help"]:
+                lines.append(f"# HELP {base} {escape_help(e['help'])}")
+            lines.append(f"# TYPE {base} counter")
+            for nid in sorted(e["by_node"]):
+                lines.append(f"{base}{label(nid)} {e['by_node'][nid]:g}")
+            lines.append(f"{base}{agg_label()} "
+                         f"{sum(e['by_node'].values()):g}")
+        for name in sorted(gauges):
+            e = gauges[name]
+            base = prom_name(name)
+            if e["help"]:
+                lines.append(f"# HELP {base} {escape_help(e['help'])}")
+            lines.append(f"# TYPE {base} gauge")
+            for nid in sorted(e["by_node"]):
+                lines.append(f"{base}{label(nid)} {e['by_node'][nid]:g}")
+        for name in sorted(hists):
+            e = hists[name]
+            base = prom_name(name)
+            if e["help"]:
+                lines.append(f"# HELP {base} {escape_help(e['help'])}")
+            lines.append(f"# TYPE {base} histogram")
+            agg_counts: Optional[List[float]] = None
+            agg_buckets: Optional[List[float]] = None
+            agg_sum, agg_count, agg_ok = 0.0, 0, True
+            for nid in sorted(e["by_node"]):
+                h = e["by_node"][nid]
+                buckets = list(h.get("buckets") or [])
+                raw = list(h.get("counts") or [])
+                cum = 0
+                for ub, c in zip(buckets, raw):
+                    cum += c
+                    lines.append(
+                        f"{base}_bucket{label(nid, {'le': repr(float(ub))})}"
+                        f" {cum}")
+                cum += raw[-1] if len(raw) > len(buckets) else 0
+                lines.append(f"{base}_bucket{label(nid, {'le': '+Inf'})}"
+                             f" {cum}")
+                lines.append(f"{base}_sum{label(nid)} "
+                             f"{float(h.get('sum', 0.0)):g}")
+                lines.append(f"{base}_count{label(nid)} "
+                             f"{int(h.get('count', 0))}")
+                if agg_buckets is None:
+                    agg_buckets, agg_counts = buckets, list(raw)
+                elif agg_buckets == buckets and agg_counts is not None \
+                        and len(raw) == len(agg_counts):
+                    agg_counts = [a + b for a, b in zip(agg_counts, raw)]
+                else:
+                    agg_ok = False  # mismatched bucket bounds don't sum
+                agg_sum += float(h.get("sum", 0.0))
+                agg_count += int(h.get("count", 0))
+            if agg_ok and agg_buckets is not None and agg_counts:
+                cum = 0
+                for ub, c in zip(agg_buckets, agg_counts):
+                    cum += c
+                    lines.append(
+                        f"{base}_bucket"
+                        f"{agg_label({'le': repr(float(ub))})} {cum}")
+                cum += (agg_counts[-1]
+                        if len(agg_counts) > len(agg_buckets) else 0)
+                lines.append(f"{base}_bucket{agg_label({'le': '+Inf'})}"
+                             f" {cum}")
+                lines.append(f"{base}_sum{agg_label()} {agg_sum:g}")
+                lines.append(f"{base}_count{agg_label()} {agg_count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            docs = {n: e["doc"] for n, e in self._nodes.items()}
+            steps = {n: dict(s) for n, s in self._steps.items()}
+        return {"v": ROLLUP_SCHEMA_V, "nodes": sorted(docs),
+                "docs": docs, "steps": steps}
+
+    def save(self, out_dir: str) -> Dict[str, str]:
+        """Atomic merged exports under ``out_dir``:
+        ``cluster_metrics.prom`` (the labeled exposition) and
+        ``cluster_metrics.json`` (the raw per-node documents)."""
+        os.makedirs(out_dir, exist_ok=True)
+        out = {}
+        for name, text in (
+                ("cluster_metrics.prom", self.prometheus_text()),
+                ("cluster_metrics.json",
+                 json.dumps(self.to_json(), default=str, indent=2))):
+            path = os.path.join(out_dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(text)
+            os.replace(tmp, path)
+            out[name] = path
+        return out
+
+    # -- step-watermark persistence ------------------------------------
+
+    def load_step_watermarks(self, path: str) -> bool:
+        """Adopt persisted per-(node, stream) sequence watermarks.  The
+        dedup watermark otherwise lives only in process memory, so a
+        restarted rank-0 agent would re-ingest each peer's last
+        published batch and append duplicates to the append-only
+        ``cluster_steps.jsonl`` — loading the saved watermarks first
+        keeps the flush-exactly-once contract across agent restarts."""
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return False
+        with self._lock:
+            for nid, st in (doc.get("streams") or {}).items():
+                if nid not in self._steps and isinstance(st, dict):
+                    self._steps[nid] = {
+                        "stream": st.get("stream"),
+                        "last_seq": int(st.get("last_seq", 0)),
+                        "ewma_ms": 0.0, "count": 0, "last": None}
+        return True
+
+    def save_step_watermarks(self, path: str) -> None:
+        with self._lock:
+            doc = {"streams": {
+                n: {"stream": s.get("stream"),
+                    "last_seq": int(s.get("last_seq", 0))}
+                for n, s in self._steps.items()}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+
+    # -- gauges (rank 0's registry) ------------------------------------
+
+    def publish_gauges(self) -> Dict[str, float]:
+        """Feed the existing cluster gauges from the rollup — the same
+        names ``publish_straggler_stats`` fills from heartbeat payloads,
+        now sourced from real per-process registries/streams (the two
+        agree when both run; the rollup wins on detail)."""
+        from . import get_telemetry
+
+        tel = get_telemetry()
+        stats: Dict[str, float] = {}
+        with self._lock:
+            docs = {n: e["doc"] for n, e in self._nodes.items()}
+            steps = {n: dict(s) for n, s in self._steps.items()}
+        snaps = {n: d.get("snapshot") or {} for n, d in docs.items()}
+        node_steps = []
+        for nid in snaps:
+            st = steps.get(nid) or {}
+            last = st.get("last") or {}
+            s = last.get("step")
+            if s is None:
+                s = self._gauge_value(snaps[nid], "train/step")
+            if s is not None:
+                node_steps.append(float(s))
+        if len(node_steps) >= 2:
+            stats["step_skew"] = max(node_steps) - min(node_steps)
+            tel.set_gauge("elastic/straggler_step_skew",
+                          stats["step_skew"],
+                          help="max-min per-host step index across the gang")
+        ewmas = [float(steps[n]["ewma_ms"]) for n in steps
+                 if steps[n].get("ewma_ms")]
+        if len(ewmas) >= 2:
+            med = sorted(ewmas)[len(ewmas) // 2]
+            stats["ewma_ratio"] = max(ewmas) / max(med, 1e-9)
+            tel.set_gauge(
+                "elastic/straggler_ewma_ratio", stats["ewma_ratio"],
+                help="slowest host step-time EWMA over the median host's")
+        gps = [v for v in (self._gauge_value(s, "goodput/fraction")
+                           for s in snaps.values()) if v is not None]
+        if gps:
+            stats["goodput_min"] = min(gps)
+            stats["goodput_mean"] = sum(gps) / len(gps)
+            tel.set_gauge("elastic/cluster_goodput_min",
+                          stats["goodput_min"],
+                          help="worst per-host rolling goodput fraction")
+            tel.set_gauge("elastic/cluster_goodput_mean",
+                          stats["goodput_mean"],
+                          help="mean per-host rolling goodput fraction")
+        hbms = [v for v in (self._gauge_value(s, "memory/hbm_frac")
+                            for s in snaps.values()) if v is not None]
+        if hbms:
+            stats["hbm_max"] = max(hbms)
+            tel.set_gauge("elastic/cluster_hbm_max", stats["hbm_max"],
+                          help="fullest per-host HBM used fraction")
+        tel.set_gauge("rollup/nodes", float(len(snaps)),
+                      help="nodes with a live metrics publication in "
+                           "the rollup")
+        return stats
+
+
+_rollup = MetricsRollup()
+
+
+def get_rollup() -> MetricsRollup:
+    """Rank 0's process-global rollup (the agent's heartbeat tick feeds
+    it; ``telemetry top`` builds its own transient one instead)."""
+    return _rollup
+
+
+def reset_rollup() -> None:
+    global _rollup
+    _rollup = MetricsRollup()
+
+
+# ---------------------------------------------------------------------------
+# ticks (rank 0 / operator)
+# ---------------------------------------------------------------------------
+
+def ingest_from_store(rollup: MetricsRollup, client: Any,
+                      peer_ids: List[str]
+                      ) -> Tuple[bool, List[Dict[str, Any]]]:
+    """Pull every peer's published telemetry documents into ``rollup``;
+    returns ``(changed, fresh_steps)`` — whether any node's snapshot
+    advanced, and the NEW step records across all nodes (node-stamped).
+    Raises the client's ConnectionError family when the store is down —
+    callers on heartbeat paths guard."""
+    changed = False
+    fresh: List[Dict[str, Any]] = []
+    for pid in peer_ids:
+        doc = client.get(_metrics_key(pid))
+        if isinstance(doc, dict):
+            changed = rollup.ingest_metrics(pid, doc) or changed
+        sdoc = client.get(_steps_key(pid))
+        if isinstance(sdoc, dict):
+            fresh.extend(rollup.ingest_steps(pid, sdoc))
+    return changed, fresh
+
+
+def collect_rollup(client: Any, peer_ids: List[str]) -> MetricsRollup:
+    """A transient rollup built straight from the store (``telemetry
+    top``, the chaos acceptance) — no agent, no bundles."""
+    rollup = MetricsRollup()
+    ingest_from_store(rollup, client, peer_ids)
+    return rollup
+
+
+STEP_WATERMARKS_FILE = "cluster_steps.state.json"
+
+
+def rollup_tick(client: Any, peer_ids: List[str],
+                out_dir: Optional[str] = None,
+                every_s: float = 2.0) -> Optional[MetricsRollup]:
+    """Rank 0's heartbeat-loop beat: ingest every peer's publications
+    into the process-global rollup, publish the cluster gauges, and
+    (when ``out_dir`` is set) keep the merged exports fresh —
+    ``cluster_metrics.prom``/``.json`` plus an append-only
+    ``cluster_steps.jsonl`` of every streamed step record, node-stamped
+    (the merged JSONL export).  Store-down beats return None (counted
+    by the caller's degraded path).
+
+    ``every_s`` cadence-gates the ingest: the heartbeat loop calls this
+    every monitor tick (default 0.1 s), but peers only re-publish every
+    ``metrics_push_every_s`` — re-reading 2 store keys per peer at
+    10 Hz would just load the single-threaded store for nothing."""
+    rollup = _rollup
+    now = time.monotonic()
+    if every_s > 0 and now - rollup._last_tick_mono < every_s:
+        return rollup
+    if out_dir and not rollup._watermarks_loaded:
+        # a restarted rank 0 must not re-append the batches still
+        # sitting in the store — adopt the persisted seq watermarks
+        rollup.load_step_watermarks(
+            os.path.join(out_dir, STEP_WATERMARKS_FILE))
+        rollup._watermarks_loaded = True
+    changed, fresh = ingest_from_store(rollup, client, peer_ids)
+    # stamp only after a SUCCESSFUL ingest (a raised store error skips
+    # this), so a degraded beat retries on the next healthy tick
+    rollup._last_tick_mono = now
+    rollup.publish_gauges()
+    if out_dir and (changed or fresh):
+        # write only when the view MOVED: the heartbeat loop calls this
+        # every tick, the publish side only every metrics_push_every_s
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            if fresh:
+                with open(os.path.join(out_dir, "cluster_steps.jsonl"),
+                          "a") as fh:
+                    for r in fresh:
+                        fh.write(json.dumps(r, default=str) + "\n")
+                rollup.save_step_watermarks(
+                    os.path.join(out_dir, STEP_WATERMARKS_FILE))
+            rollup.save(out_dir)
+        except OSError as e:
+            logger.warning(f"rollup: merged export write failed: {e!r}")
+    return rollup
+
+
+# ---------------------------------------------------------------------------
+# `telemetry top` rendering
+# ---------------------------------------------------------------------------
+
+def _fmt(v: Any, pattern: str = "{:g}", none: str = "-") -> str:
+    if v is None:
+        return none
+    try:
+        return pattern.format(v)
+    except (ValueError, TypeError):
+        return str(v)
+
+
+def render_top(rollup: MetricsRollup,
+               hb_view: Optional[Dict[str, Dict[str, Any]]] = None,
+               store_info: Optional[Dict[str, Any]] = None,
+               silent_after_s: float = 30.0) -> str:
+    """The live cluster view as a fixed-width table."""
+    rows = rollup.rows(hb_view)
+    header = (f"{'NODE':<14} {'STEP':>8} {'STEP_MS':>9} {'GOODPUT':>8} "
+              f"{'HBM%':>6} {'LOSS':>10} {'HB_AGE':>7} {'OUTAGES':>8} "
+              f"{'STATE':<10}")
+    lines = []
+    if store_info:
+        lines.append(
+            f"store: {store_info.get('endpoint', '?')}  "
+            f"gen {store_info.get('generation', '?')}  "
+            f"round {store_info.get('round', '?')}  "
+            f"nodes {len(rows)}")
+    lines.append(header)
+    for r in rows:
+        age = r.get("hb_age_s")
+        if r.get("left"):
+            state = "LEFT"
+        elif (not r.get("published") and age is None) \
+                or (age is not None and age > silent_after_s):
+            state = "SILENT"
+        else:
+            state = "LIVE"
+        hbm = r.get("hbm_frac")
+        lines.append(
+            f"{r['node']:<14} {_fmt(r.get('step'), '{:.0f}'):>8} "
+            f"{_fmt(r.get('step_time_ewma_ms'), '{:.1f}'):>9} "
+            f"{_fmt(r.get('goodput'), '{:.3f}'):>8} "
+            f"{_fmt(None if hbm is None else hbm * 100.0, '{:.1f}'):>6} "
+            f"{_fmt(r.get('loss'), '{:.5g}'):>10} "
+            f"{_fmt(age, '{:.1f}'):>7} "
+            f"{_fmt(r.get('store_outages'), '{:.0f}'):>8} "
+            f"{state:<10}")
+    return "\n".join(lines)

@@ -63,6 +63,11 @@ class SpanTracer:
         #: one stable origin so span timestamps are comparable across
         #: threads (perf_counter has an arbitrary epoch per process)
         self._t0 = time.perf_counter()
+        #: store-clock mapping (telemetry/clocksync.py): set when this
+        #: process estimated its offset to the rendezvous store clock —
+        #: exported in the trace metadata so N hosts' traces merge onto
+        #: ONE timeline (``telemetry collect`` -> cluster_trace.json)
+        self._clock_sync: Optional[Dict[str, Any]] = None
 
     @property
     def max_events(self) -> int:
@@ -122,6 +127,31 @@ class SpanTracer:
 
     # ------------------------------------------------------------------
 
+    def set_clock_sync(self, offset_s: float, rtt_s: Optional[float] = None,
+                       generation: Any = None,
+                       node_id: Optional[str] = None) -> None:
+        """Record this process's estimated offset to the store clock
+        (``store_time ~= perf_counter() + offset_s``).  Span ``ts``
+        values stay in the tracer's private timebase; the metadata
+        carries ``trace_to_store_offset_us`` so any consumer can shift
+        ``ev.ts + trace_to_store_offset_us`` onto the shared store
+        timeline — that arithmetic is what clock-aligns the per-process
+        lanes in ``cluster_trace.json``."""
+        with self._lock:
+            self._clock_sync = {
+                "offset_s": float(offset_s),
+                "rtt_s": None if rtt_s is None else float(rtt_s),
+                "generation": generation,
+                "node_id": node_id,
+                # ts (us since _t0) + this = us on the STORE clock
+                "trace_to_store_offset_us": round(
+                    (self._t0 + float(offset_s)) * 1e6, 1),
+            }
+
+    def clock_sync(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._clock_sync) if self._clock_sync else None
+
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
@@ -140,10 +170,14 @@ class SpanTracer:
         Perfetto load; the ``X`` event shape matches what
         ``profiling/collective_trace.parse_trace`` consumes from the XLA
         profiler, so host spans and device lanes merge into one view."""
+        meta: Dict[str, Any] = {"source": "deepspeed_tpu.telemetry",
+                                "dropped_events": self._dropped}
+        sync = self.clock_sync()
+        if sync is not None:
+            meta["clock_sync"] = sync
         return {"traceEvents": self.events(),
                 "displayTimeUnit": "ms",
-                "metadata": {"source": "deepspeed_tpu.telemetry",
-                             "dropped_events": self._dropped}}
+                "metadata": meta}
 
     def save_chrome_trace(self, path: str) -> str:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

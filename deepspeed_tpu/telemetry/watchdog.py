@@ -37,6 +37,83 @@ from ..utils.logging import debug_once, logger
 
 ACTIONS = ("log", "raise", "exit")
 
+#: heartbeat-payload schema version (satellite, ISSUE 13).  The payload
+#: accreted step/EWMA/goodput/coll_seq/hbm fields across PRs 2-7 with no
+#: version and no size bound; consumers (rank 0's straggler publisher,
+#: the rollup, `telemetry top`) now key behavior on ``v`` instead of
+#: sniffing fields, and producers cap the byte size below.
+HEARTBEAT_SCHEMA_V = 1
+
+#: default byte cap for heartbeat payloads — the watchdog ctor default
+#: AND the cap producers without a watchdog config (the agent's
+#: ledger-only path) apply, so the bound is defined exactly once
+DEFAULT_HEARTBEAT_MAX_BYTES = 1024
+
+#: deterministic field-drop order under the byte cap: least
+#: operator-critical first.  ``v`` and ``step`` are never dropped (the
+#: version is what makes the drop legible downstream; the step index is
+#: the minimum liveness signal every consumer needs).  Fields NOT in
+#: this order (a future producer's additions) drop before everything
+#: listed, in sorted-name order — deterministic by construction.
+HEARTBEAT_DROP_ORDER = (
+    "goodput_total",    # the rolling figure is the live one
+    "hbm_headroom",
+    "hbm_frac",
+    "goodput",
+    "progress_age_s",   # derivable from the store-stamped hb age
+    "coll_hash",        # desync detection degrades to seq-skew only
+    "coll_seq",
+    "step_time_ewma_ms",
+)
+
+
+def cap_heartbeat_payload(payload: Dict[str, Any],
+                          max_bytes: int) -> Dict[str, Any]:
+    """Bound a heartbeat payload's JSON size by dropping fields in
+    :data:`HEARTBEAT_DROP_ORDER` (unknown fields first).  Dropped
+    fields are counted (``elastic/heartbeat_fields_dropped_total``) and
+    the payload records how many went missing (``dropped``) so the
+    consumer can tell 'field absent' from 'field capped'."""
+    import json as _json
+
+    if max_bytes <= 0:
+        return payload
+    payload = dict(payload)
+    payload.setdefault("v", HEARTBEAT_SCHEMA_V)
+
+    def size() -> int:
+        return len(_json.dumps(payload, default=str))
+
+    if size() <= max_bytes:
+        return payload
+    protected = ("v", "step", "dropped")
+    known = [f for f in HEARTBEAT_DROP_ORDER if f in payload]
+    unknown = sorted(f for f in payload
+                     if f not in HEARTBEAT_DROP_ORDER
+                     and f not in protected)
+    dropped = 0
+    for field in unknown + known:
+        if size() <= max_bytes:
+            break
+        payload.pop(field, None)
+        dropped += 1
+        payload["dropped"] = dropped
+    if dropped:
+        try:
+            from . import get_telemetry
+
+            get_telemetry().inc_counter(
+                "elastic/heartbeat_fields_dropped_total", v=dropped,
+                help="heartbeat payload fields dropped by the byte cap")
+        except Exception as e:  # counter publish is best-effort
+            debug_once("watchdog/hb_cap_counter",
+                       f"heartbeat-cap counter publish failed ({e!r})")
+        debug_once("watchdog/hb_cap",
+                   f"heartbeat payload over {max_bytes}B — dropped "
+                   f"{dropped} field(s) (deterministic order; see "
+                   f"HEARTBEAT_DROP_ORDER)")
+    return payload
+
 
 class WatchdogTimeout(RuntimeError):
     """No train-step progress within ``hang_timeout_s``."""
@@ -55,7 +132,8 @@ class HangWatchdog:
                  clock: Callable[[], float] = time.monotonic,
                  recorder: Any = GLOBAL_RECORDER,
                  device_probe: bool = True,
-                 device_probe_timeout_s: float = 20.0):
+                 device_probe_timeout_s: float = 20.0,
+                 heartbeat_max_bytes: int = DEFAULT_HEARTBEAT_MAX_BYTES):
         if action not in ACTIONS:
             raise ValueError(f"watchdog action {action!r} not in {ACTIONS}")
         self.hang_timeout_s = float(hang_timeout_s)
@@ -72,6 +150,10 @@ class HangWatchdog:
         #: annotate the bundle with ``device_unresponsive``
         self.device_probe = bool(device_probe)
         self.device_probe_timeout_s = float(device_probe_timeout_s)
+        #: byte cap on heartbeat_payload (<= 0 disables): the payload
+        #: rides every rendezvous heartbeat — an unbounded dict would
+        #: let one noisy producer bloat every store beat in the gang
+        self.heartbeat_max_bytes = int(heartbeat_max_bytes)
         #: test seam: injectable probe body (a hanging fake backend)
         self.device_probe_fn: Optional[Callable[[], Any]] = None
         self._clock = clock
@@ -125,7 +207,8 @@ class HangWatchdog:
         collective ledger is on, its ``coll_seq``/``coll_hash`` ride
         along so rank 0 can detect collective desync live."""
         with self._lock:
-            payload = {"step": self._last_step,
+            payload = {"v": HEARTBEAT_SCHEMA_V,
+                       "step": self._last_step,
                        "step_time_ewma_ms": round(self._ewma_ms, 3),
                        "progress_age_s": round(
                            self._clock() - self._last_progress, 3)}
@@ -150,7 +233,7 @@ class HangWatchdog:
             # elastic/cluster_hbm_{max,headroom_min} and the cluster
             # manifest shows per-host memory
             payload.update(mem.heartbeat_summary())
-        return payload
+        return cap_heartbeat_payload(payload, self.heartbeat_max_bytes)
 
     # -- the check ---------------------------------------------------------
 

@@ -80,6 +80,53 @@ else
 fi
 rm -rf "$smoke_dir"
 
+# Live-cluster-view smoke (ISSUE 13): three in-process "hosts" publish
+# their registry snapshots through a rendezvous store; `telemetry top
+# --once` (the real module CLI, in a subprocess) must exit 0 and render
+# every live node from the rollup — no bundles collected.
+echo "=== CLI smoke: telemetry top --once"
+if python - <<'PYEOF'
+import subprocess
+import sys
+
+from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.telemetry import (StepRecord, configure_step_stream,
+                                     get_telemetry, push_node_telemetry)
+
+srv = RendezvousServer()
+try:
+    c = RendezvousClient(srv.endpoint)
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    configure_step_stream(enabled=True)
+    for node, step in (("host-a", 4), ("host-b", 6), ("host-c", 5)):
+        tel.record_step(StepRecord(
+            step=step, step_time_ms=12.0, device_fenced=True,
+            samples_per_sec=1.0, tokens_per_sec=100.0, loss=0.5,
+            grad_norm=0.0, lr=0.1, loss_scale=1.0, overflow=False,
+            skipped_steps=0, comm_bytes=0, comm_ops=0))
+        push_node_telemetry(c, node)
+        c.hb(f"rdzv/hb/{node}")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.telemetry", "top", "--once",
+         "--endpoint", srv.endpoint, "--peers", "host-a,host-b,host-c"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for node in ("host-a", "host-b", "host-c"):
+        assert node in out.stdout, out.stdout
+    assert "LIVE" in out.stdout, out.stdout
+finally:
+    srv.shutdown()
+print("top --once rendered all 3 hosts")
+PYEOF
+then
+  echo "=== top smoke passed"
+else
+  echo "=== top smoke FAILED"
+  fail=1
+fi
+
 # Fault-injection smoke (ISSUE 4): an env-var fault must drive the WHOLE
 # recovery loop — NaN injected, rollback taken, recovery counter moves.
 echo "=== fault-injection smoke: env-driven NaN -> rollback"

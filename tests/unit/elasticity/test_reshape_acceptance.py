@@ -101,6 +101,20 @@ class Gang:
                            and time.monotonic() < deadline):
                         time.sleep(0.02)
                     raise _RestartSignal("peer set changed at the gate")
+                if (restart_count == 0 and self.faults_for.get(node)
+                        and engine.global_steps == CHAOS_AT):
+                    # the chaos step must not fire while a peer is still
+                    # short of its pre-chaos snapshot (step CHAOS_AT-1):
+                    # under full-suite load a slow survivor would be
+                    # torn down before snap-2 exists and replay from
+                    # step 0, which is a scheduling artifact — not the
+                    # resume behavior these tests assert
+                    deadline = time.monotonic() + 120.0
+                    while time.monotonic() < deadline and not all(
+                            any(s >= CHAOS_AT - 1 for _rc, s, _l
+                                in self.losses.get(p, []))
+                            for p in self.agents if p != node):
+                        time.sleep(0.02)
                 m = engine.train_step(batches[engine.global_steps])
                 self.losses.setdefault(node, []).append(
                     (restart_count, engine.global_steps,

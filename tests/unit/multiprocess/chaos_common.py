@@ -49,7 +49,13 @@ def build_engine(node_dir, resilience=True):
         "telemetry": {"enabled": True, "output_path": node_dir,
                       "job_name": "chaos",
                       "watchdog": {"enabled": False},
-                      "flight_recorder": {"install_handlers": False}},
+                      "flight_recorder": {"install_handlers": False},
+                      # cross-process telemetry plane (ISSUE 13): each
+                      # worker ships its registry snapshot + step batch
+                      # through the store; a fast cadence so the 3-node
+                      # acceptance sees the merged view promptly
+                      "aggregation": {"enabled": True,
+                                      "metrics_push_every_s": 0.5}},
     }
     if resilience:
         cfg["resilience"] = {

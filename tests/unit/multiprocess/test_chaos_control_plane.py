@@ -27,7 +27,7 @@ import numpy as np
 import pytest
 
 pytestmark = [pytest.mark.slow, pytest.mark.chaos,
-              pytest.mark.timeout(420)]
+              pytest.mark.timeout(600)]
 
 _HERE = pathlib.Path(__file__).resolve().parent
 _REPO = str(_HERE.parents[2])
@@ -287,6 +287,63 @@ def test_store_death_restart_and_p2p_adoption(tmp_path):
             isinstance(client.get(f"resil/pub/{n}"), dict) for n in gang),
             timeout=60, what="replica index re-seeded from journals")
 
+        # --- phase 2b: ISSUE 13 — the merged view knows what happened -----
+        # every node publishes its registry through the store; each
+        # one's degraded window (store-outage counters) appears in the
+        # merged export under ITS OWN node label, next to its live step
+        # counter — no shared registry, no bundle collection
+        from deepspeed_tpu.telemetry.metrics import parse_prometheus_text
+        from deepspeed_tpu.telemetry.rollup import collect_rollup
+
+        def _merged():
+            return parse_prometheus_text(
+                collect_rollup(client, list(gang)).prometheus_text())
+
+        def _outage_windows_visible():
+            parsed = _merged()
+            return all(
+                parsed.get(f'train_steps_total{{node="{n}"}}', 0) > 0
+                and parsed.get(
+                    f'elasticity_store_outages_total{{node="{n}"}}', 0)
+                >= 1 for n in gang)
+
+        wait_for(_outage_windows_visible, timeout=90,
+                 what="rollup shows every node's step counter AND its "
+                      "store-outage degraded window")
+        merged = _merged()
+        for n in gang:
+            assert merged.get(
+                f'elasticity_store_degraded_seconds_total{{node="{n}"}}',
+                0) > 0, (n, merged)
+        # gang aggregate under the reserved label sums the per-node lanes
+        assert merged['train_steps_total{node="_cluster"}'] == sum(
+            merged[f'train_steps_total{{node="{n}"}}'] for n in gang)
+
+        # the live operator view renders every node, bundle-free, exit 0
+        top = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.telemetry", "top",
+             "--once", "--endpoint", endpoint],
+            env={**os.environ, "PYTHONPATH":
+                 _REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            capture_output=True, text=True, timeout=120)
+        assert top.returncode == 0, top.stdout + top.stderr
+        for n in gang:
+            assert n in top.stdout, top.stdout
+
+        # first collect: every lane clock-aligned (also gives n2 — about
+        # to be killed — a published bundle the later merged trace uses)
+        from deepspeed_tpu.telemetry.aggregator import (
+            collect_cluster_archive)
+
+        archive1 = collect_cluster_archive(
+            client, list(gang), out_dir=str(tmp_path / "arch1"),
+            timeout_s=120)
+        with open(os.path.join(archive1, "cluster_trace.json")) as fh:
+            ct1 = json.load(fh)
+        hosts1 = ct1["metadata"]["hosts"]
+        assert set(hosts1) == set(gang), hosts1
+        assert all(h["aligned"] for h in hosts1.values()), hosts1
+
         # --- phase 3: kill a worker node; the replacement adopts ----------
         wait_for(lambda: len(
             (client.get("resil/pub/n2") or {}).get("holders", [])) >= 2,
@@ -307,6 +364,59 @@ def test_store_death_restart_and_p2p_adoption(tmp_path):
         # the adopted replica was re-keyed under n3's id
         wait_for(lambda: isinstance(client.get("resil/pub/n3"), dict),
                  timeout=60, what="adopted replica re-keyed under n3")
+
+        # --- phase 3b: ISSUE 13 — the kill is legible in the merged view --
+        # the killed worker's heartbeat goes stale while its last
+        # publications persist: `top` renders it SILENT next to the
+        # LIVE survivors and the replacement
+        wait_for(lambda: client.now()
+                 - float(client.get("rdzv/hb/n2") or 0) > 5.0,
+                 timeout=60, what="n2's heartbeat went stale")
+        top2 = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.telemetry", "top",
+             "--once", "--endpoint", endpoint,
+             "--peers", "n0,n1,n2,n3", "--silent-after", "5"],
+            env={**os.environ, "PYTHONPATH":
+                 _REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            capture_output=True, text=True, timeout=120)
+        assert top2.returncode == 0, top2.stdout + top2.stderr
+        rows = {ln.split()[0]: ln for ln in top2.stdout.splitlines()
+                if ln.split() and ln.split()[0] in
+                ("n0", "n1", "n2", "n3")}
+        assert set(rows) == {"n0", "n1", "n2", "n3"}, top2.stdout
+        assert "SILENT" in rows["n2"], top2.stdout
+        assert "LIVE" in rows["n3"], top2.stdout
+
+        # second collect, while survivors + replacement are live: the
+        # merged trace holds FOUR clock-aligned lanes — n2's from its
+        # last (pre-kill) publication — and the lanes are mutually
+        # ordered on the store clock: every n3 span happened after n2's
+        # lane ended (n3 was spawned after the kill), which the raw
+        # per-process timestamps (every tracer starts near zero) could
+        # never show.  Tolerance: one heartbeat period.
+        # timeout bounds how long we wait for the DEAD n2's fresh dump
+        # (never coming — its last publication is the fallback)
+        archive2 = collect_cluster_archive(
+            client, ["n0", "n1", "n2", "n3"],
+            out_dir=str(tmp_path / "arch2"), timeout_s=30)
+        with open(os.path.join(archive2, "cluster_trace.json")) as fh:
+            ct2 = json.load(fh)
+        hosts2 = ct2["metadata"]["hosts"]
+        assert set(hosts2) == {"n0", "n1", "n2", "n3"}, hosts2
+        assert all(h["aligned"] for h in hosts2.values()), hosts2
+
+        def lane(node):
+            pid = hosts2[node]["pid"]
+            return [e for e in ct2["traceEvents"]
+                    if e.get("ph") == "X" and e.get("pid") == pid]
+
+        assert all(lane(n) for n in ("n0", "n1", "n2", "n3")), hosts2
+        hb_period_us = 2.0e6  # heartbeat/monitor cadence tolerance
+        n2_end = max(e["ts"] + e.get("dur", 0.0) for e in lane("n2"))
+        n3_start = min(e["ts"] for e in lane("n3"))
+        assert n3_start > n2_end - hb_period_us, (n3_start, n2_end)
+        assert n3_start > min(e["ts"] for e in lane("n2")), \
+            "alignment lost: n3's lane overlaps n2's private-clock origin"
 
         # --- phase 4: wind down; every loss matches the oracle ------------
         (tmp_path / "stop").touch()

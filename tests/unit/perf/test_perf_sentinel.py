@@ -123,3 +123,38 @@ def test_cli_show_prints_metrics(tmp_path, capsys):
     assert cli_main(["perf", "show", run]) == 0
     out = capsys.readouterr().out
     assert "tokens_per_sec: 35000" in out and "goodput: 0.95" in out
+
+
+def test_cli_show_marks_environment_failure_as_skipped(tmp_path, capsys):
+    """Satellite (ISSUE 13): an r05-style environment-failure artifact
+    (value 0.0 + error, no debug_bundle) must render as an explicitly
+    SKIPPED round in `perf show` — never as measured 0.0 values.  Only
+    `check` used to understand the marker."""
+    r05 = {"metric": "llama_110m_train_tokens_per_sec", "value": 0.0,
+           "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+           "error": "jax.devices() unresponsive after 180s "
+                    "(TPU tunnel down?)"}
+    run = _write(tmp_path / "r05.json", r05)
+    assert cli_main(["perf", "show", run]) == 0
+    out = capsys.readouterr().out
+    assert "SKIPPED round" in out
+    assert "TPU tunnel down" in out
+    assert "tokens_per_sec: 0" not in out
+
+    # the explicit marker shape (bench stamps environment_failure=True)
+    # takes the same path even when placeholder metric fields ride along
+    marked = {"metric": "llama_110m_train_tokens_per_sec", "value": 0.0,
+              "environment_failure": True, "mfu": 0.0,
+              "error": "device probe timed out"}
+    run2 = _write(tmp_path / "marked.json", marked)
+    assert cli_main(["perf", "show", run2]) == 0
+    out = capsys.readouterr().out
+    assert "SKIPPED round" in out and "device probe timed out" in out
+    assert "mfu: 0" not in out
+
+    # a CRASH artifact (debug_bundle present) stays a loud error — a
+    # code regression must never read as an environment skip
+    crash = {"metric": "llama_110m_train_tokens_per_sec", "value": 0.0,
+             "error": "OOM", "debug_bundle": "/tmp/bundle-x"}
+    run3 = _write(tmp_path / "crash.json", crash)
+    assert cli_main(["perf", "show", run3]) == 2

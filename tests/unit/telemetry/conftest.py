@@ -38,6 +38,15 @@ def _fresh_diagnostics():
         mem.reset()
         mem.enabled = False
         clear_device_unresponsive()
+        from deepspeed_tpu.telemetry import (get_clock_sync,
+                                             get_step_stream)
+        from deepspeed_tpu.telemetry.rollup import reset_rollup
+
+        get_clock_sync().reset()
+        stream = get_step_stream()
+        stream.reset()
+        stream.enabled = False
+        reset_rollup()
 
     scrub()
     yield

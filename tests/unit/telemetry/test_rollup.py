@@ -1,0 +1,604 @@
+"""Cross-process telemetry plane (ISSUE 13 tentpole): registry
+snapshot/merge with per-node labels, store-clock sync, compact step
+streaming with degraded-mode buffering that flushes exactly once, the
+clock-aligned merged cluster trace, and the `telemetry top` live view.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.telemetry import (FlightRecorder, StepRecord,
+                                     cap_heartbeat_payload,
+                                     collect_rollup, configure_step_stream,
+                                     get_clock_sync, get_step_stream,
+                                     get_telemetry, maybe_sync_clock,
+                                     parse_prometheus_text,
+                                     push_node_telemetry, render_top)
+from deepspeed_tpu.telemetry import aggregator as agg
+from deepspeed_tpu.telemetry.rollup import (CLUSTER_NODE_LABEL,
+                                            MetricsRollup, StepStream,
+                                            node_label_value, rollup_tick)
+from deepspeed_tpu.telemetry.watchdog import (HEARTBEAT_DROP_ORDER,
+                                              HEARTBEAT_SCHEMA_V,
+                                              HangWatchdog)
+
+
+def _steprec(step, loss, ms, tps):
+    return StepRecord(step=step, step_time_ms=ms, device_fenced=True,
+                      samples_per_sec=1.0, tokens_per_sec=tps, loss=loss,
+                      grad_norm=0.0, lr=0.1, loss_scale=1.0,
+                      overflow=False, skipped_steps=0, comm_bytes=0,
+                      comm_ops=0)
+
+
+def _snapshot_doc(node, seq=1, stream="s0", counters=None, gauges=None,
+                  hists=None):
+    return {"v": 1, "node": node, "seq": seq, "stream": stream,
+            "clock": {"synced": False},
+            "snapshot": {"counters": counters or {},
+                         "gauges": gauges or {},
+                         "histograms": hists or {}}}
+
+
+# ---------------------------------------------------------------------------
+# registry snapshot
+# ---------------------------------------------------------------------------
+
+def test_registry_snapshot_carries_values_and_raw_bucket_counts():
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    tel.inc_counter("train/steps_total", 7, help="steps")
+    tel.set_gauge("goodput/fraction", 0.875, help="goodput")
+    tel.observe("train/step_time_ms", 3.0, buckets=(1.0, 5.0))
+    tel.observe("train/step_time_ms", 100.0, buckets=(1.0, 5.0))
+    snap = tel.registry.snapshot()
+    assert snap["counters"]["train/steps_total"]["value"] == 7
+    assert snap["counters"]["train/steps_total"]["help"] == "steps"
+    assert snap["gauges"]["goodput/fraction"]["value"] == 0.875
+    h = snap["histograms"]["train/step_time_ms"]
+    assert h["buckets"] == [1.0, 5.0]
+    assert h["counts"] == [0, 1, 1]  # RAW per-bucket (incl. +Inf), not cum
+    assert h["count"] == 2 and h["sum"] == 103.0
+    json.dumps(snap)  # ships over the store as JSON
+
+
+# ---------------------------------------------------------------------------
+# merged Prometheus export (satellite: labels + round-trip parse)
+# ---------------------------------------------------------------------------
+
+def test_merged_prometheus_per_node_labels_round_trip():
+    rollup = MetricsRollup()
+    rollup.ingest_metrics("n0", _snapshot_doc(
+        "n0",
+        counters={"train/steps_total": {"value": 5, "help": "steps"}},
+        gauges={"goodput/fraction": {"value": 0.9, "help": ""}},
+        hists={"train/step_time_ms": {
+            "buckets": [1.0, 5.0], "counts": [1, 2, 1], "sum": 20.0,
+            "count": 4, "help": "ms"}}))
+    rollup.ingest_metrics("n1", _snapshot_doc(
+        "n1",
+        counters={"train/steps_total": {"value": 3, "help": "steps"}},
+        hists={"train/step_time_ms": {
+            "buckets": [1.0, 5.0], "counts": [0, 1, 0], "sum": 2.0,
+            "count": 1, "help": "ms"}}))
+    text = rollup.prometheus_text()
+    parsed = parse_prometheus_text(text)  # must round-trip cleanly
+    assert parsed['train_steps_total{node="n0"}'] == 5.0
+    assert parsed['train_steps_total{node="n1"}'] == 3.0
+    # gang aggregate under the reserved label, summed
+    assert parsed['train_steps_total{node="_cluster"}'] == 8.0
+    # gauges are per-node only (no meaningless gang sum)
+    assert parsed['goodput_fraction{node="n0"}'] == 0.9
+    assert 'goodput_fraction{node="_cluster"}' not in parsed
+    # histograms: cumulative per node AND summed aggregate
+    assert parsed['train_step_time_ms_bucket{le="5.0",node="n0"}'] == 3.0
+    assert parsed['train_step_time_ms_bucket{le="+Inf",node="n0"}'] == 4.0
+    assert parsed['train_step_time_ms_bucket{le="+Inf",node="_cluster"}'] \
+        == 5.0
+    assert parsed['train_step_time_ms_count{node="_cluster"}'] == 5.0
+    # every sample line carries a node label: NO bare sample can ever
+    # collide with a node-local series (the by-construction guarantee)
+    for ln in text.splitlines():
+        if ln and not ln.startswith("#"):
+            assert "node=" in ln, ln
+    # and no two lines share a sample key
+    keys = [ln.rsplit(" ", 1)[0] for ln in text.splitlines()
+            if ln and not ln.startswith("#")]
+    assert len(keys) == len(set(keys))
+
+
+def test_reserved_node_label_is_collision_free_by_construction():
+    assert node_label_value("n0") == "n0"
+    assert node_label_value(CLUSTER_NODE_LABEL) == "_cluster:node"
+    rollup = MetricsRollup()
+    rollup.ingest_metrics("_cluster", _snapshot_doc(
+        "_cluster",
+        counters={"train/steps_total": {"value": 2, "help": ""}}))
+    parsed = parse_prometheus_text(rollup.prometheus_text())
+    # the REAL node's series is remapped; the aggregate keeps the
+    # reserved value — distinct keys even for a hostile node id
+    assert parsed['train_steps_total{node="_cluster:node"}'] == 2.0
+    assert parsed['train_steps_total{node="_cluster"}'] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# step stream
+# ---------------------------------------------------------------------------
+
+def test_step_stream_ring_ack_and_bound():
+    s = StepStream(maxlen=3, enabled=True)
+    for i in range(1, 6):
+        s.push({"step": i, "loss": float(i), "step_time_ms": 1.0,
+                "tokens_per_sec": 10.0})
+    pending = s.unacked()
+    assert [r["seq"] for r in pending] == [3, 4, 5]  # bounded: 1-2 fell off
+    assert s.dropped == 2
+    s.ack(4)
+    assert [r["seq"] for r in s.unacked()] == [5]
+
+
+def test_rollup_step_ingest_dedups_by_seq_and_resets_on_new_stream():
+    rollup = MetricsRollup()
+    batch = {"v": 1, "node": "n0", "stream": "s0",
+             "records": [{"seq": 1, "step": 1, "loss": 0.5,
+                          "step_time_ms": 10.0},
+                         {"seq": 2, "step": 2, "loss": 0.4,
+                          "step_time_ms": 12.0}]}
+    assert len(rollup.ingest_steps("n0", batch)) == 2
+    # the SAME batch re-pushed (store restart replay) contributes nothing
+    assert rollup.ingest_steps("n0", batch) == []
+    # a restarted node (new stream id, fresh sequence space) starts over
+    batch2 = {"v": 1, "node": "n0", "stream": "s1",
+              "records": [{"seq": 1, "step": 3, "loss": 0.3,
+                           "step_time_ms": 11.0}]}
+    assert len(rollup.ingest_steps("n0", batch2)) == 1
+
+
+# ---------------------------------------------------------------------------
+# push/ingest over a live store + degraded-mode flush-exactly-once
+# ---------------------------------------------------------------------------
+
+def test_push_and_collect_rollup_over_store(tmp_path):
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        tel = get_telemetry()
+        tel.configure(enabled=True, jsonl=False, prometheus=False)
+        configure_step_stream(enabled=True, maxlen=16)
+        tel.inc_counter("train/steps_total", 4)
+        tel.record_step(_steprec(4, 0.25, 9.0, 111.0))
+        assert push_node_telemetry(c, "a") is not None
+        tel.inc_counter("train/steps_total", 2)
+        assert push_node_telemetry(c, "b") is not None
+        rollup = collect_rollup(c, ["a", "b"])
+        rows = {r["node"]: r for r in rollup.rows()}
+        assert set(rows) == {"a", "b"}
+        assert rows["a"]["step"] == 4 and rows["a"]["loss"] == 0.25
+        parsed = parse_prometheus_text(rollup.prometheus_text())
+        # 4 manual + 1 from record_step itself + 2 manual
+        assert parsed['train_steps_total{node="b"}'] == 7.0
+    finally:
+        srv.shutdown()
+
+
+def test_degraded_push_buffers_and_flushes_exactly_once(tmp_path):
+    """Satellite (ISSUE 13): a store outage mid-push counts
+    ``aggregator/degraded_ticks_total``, leaves the step batch in the
+    bounded ring, and the first healthy tick after a PR-11-style store
+    restart flushes it exactly once — journal replay cannot double it
+    (telemetry keys are never journaled; the rollup dedups by seq)."""
+    srv = RendezvousServer()
+    host, port = srv.host, srv.port
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    configure_step_stream(enabled=True, maxlen=16)
+    fr = FlightRecorder(max_records=8, output_path=str(tmp_path / "d"))
+    pub = agg.BundlePublisher("w0", recorder=fr,
+                              telemetry_push_every_s=0.001)
+    c = RendezvousClient(f"{host}:{port}", retries=1, backoff_s=0.01)
+    try:
+        tel.record_step(_steprec(1, 1.0, 5.0, 10.0))
+        pub.tick(c)
+        assert get_step_stream().unacked() == []  # shipped + acked
+
+        srv.shutdown()  # kill -9 stand-in
+        tel.record_step(_steprec(2, 0.9, 5.0, 10.0))
+        time.sleep(0.005)  # past the push cadence
+        pub.tick(c)  # degraded: buffered, counted, NOT acked
+        assert len(get_step_stream().unacked()) == 1
+        assert tel.registry.counter(
+            "aggregator/degraded_ticks_total").value >= 1
+
+        srv2 = RendezvousServer(host, port)  # restart at the SAME endpoint
+        try:
+            c.close()
+            time.sleep(0.005)
+            consumer = MetricsRollup()
+            op = RendezvousClient(srv2.endpoint)
+            deadline = time.monotonic() + 10
+            fresh = []
+            while time.monotonic() < deadline and not fresh:
+                pub.tick(c)
+                fresh = consumer.ingest_steps(
+                    "w0", op.get("telemetry/steps/w0") or {})
+                time.sleep(0.01)
+            # the buffered record flushed...
+            assert [r["step"] for r in fresh] == [2]
+            assert get_step_stream().unacked() == []
+            # ...and EXACTLY once: re-ingesting the store state again
+            # (what a journal replay would amount to) adds nothing
+            assert consumer.ingest_steps(
+                "w0", op.get("telemetry/steps/w0") or {}) == []
+        finally:
+            srv2.shutdown()
+    finally:
+        srv.shutdown()  # idempotent: already down mid-test by design
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat payload: version + byte cap (satellite)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_payload_carries_schema_version():
+    wd = HangWatchdog(hang_timeout_s=60.0, recorder=None,
+                      device_probe=False)
+    wd.notify_progress(3, 0.1)
+    payload = wd.heartbeat_payload()
+    assert payload["v"] == HEARTBEAT_SCHEMA_V
+    assert payload["step"] == 3
+    assert json.dumps(payload)  # store-shippable
+
+
+def test_heartbeat_cap_drops_in_deterministic_order_and_counts():
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    full = {"step": 9, "step_time_ewma_ms": 12.0, "progress_age_s": 0.1,
+            "coll_seq": 5, "coll_hash": "ab" * 40, "goodput": 0.9,
+            "goodput_total": 0.95, "hbm_frac": 0.5, "hbm_headroom": 0.4}
+    # generous cap: nothing dropped, version stamped
+    kept = cap_heartbeat_payload(dict(full), 4096)
+    assert kept["v"] == HEARTBEAT_SCHEMA_V and "dropped" not in kept
+    # tight cap: fields leave strictly in HEARTBEAT_DROP_ORDER; v and
+    # step are never dropped
+    capped = cap_heartbeat_payload(dict(full), 120)
+    assert capped["v"] == HEARTBEAT_SCHEMA_V and capped["step"] == 9
+    dropped = {f for f in full if f not in capped}
+    order = [f for f in HEARTBEAT_DROP_ORDER if f in full]
+    assert dropped == set(order[:len(dropped)])
+    assert capped["dropped"] == len(dropped) >= 1
+    assert len(json.dumps(capped)) <= 120
+    assert tel.registry.counter(
+        "elastic/heartbeat_fields_dropped_total").value == capped["dropped"]
+    # unknown (future) fields drop BEFORE the documented order
+    odd = cap_heartbeat_payload(
+        {"step": 1, "zz_new_field": "y" * 300, "coll_seq": 5}, 80)
+    assert "zz_new_field" not in odd and "coll_seq" in odd
+
+
+# ---------------------------------------------------------------------------
+# clock sync
+# ---------------------------------------------------------------------------
+
+class _SkewedClient:
+    """now() answers on a clock skewed +123.0s from perf_counter."""
+
+    def __init__(self, gen="g1", skew=123.0):
+        self._gen = gen
+        self.reconnects = 0
+        self.skew = skew
+        self.calls = 0
+
+    def now(self):
+        self.calls += 1
+        return time.perf_counter() + self.skew
+
+
+def test_clock_sync_estimates_offset_and_rekeys_on_generation():
+    sync = get_clock_sync()
+    client = _SkewedClient(skew=123.0)
+    assert maybe_sync_clock(client, node_id="n0") is sync
+    assert abs(sync.offset_s - 123.0) < 0.05
+    calls = client.calls
+    # cached: same generation + reconnect count -> no new probes
+    maybe_sync_clock(client)
+    assert client.calls == calls
+    # a store RESTART (new generation) invalidates the estimate
+    client._gen = "g2"
+    client.skew = 50.0
+    maybe_sync_clock(client)
+    assert client.calls > calls
+    assert abs(sync.offset_s - 50.0) < 0.05
+    # a reconnect after an outage re-estimates too
+    client.reconnects += 1
+    maybe_sync_clock(client)
+    assert abs(sync.offset_s - 50.0) < 0.05
+    assert sync.estimates == 3
+
+
+def test_clock_sync_discards_probes_when_the_store_epoch_moves():
+    """Review fix: a store restart mid-estimate must not blend two
+    server epochs into one cached offset.  A key that moved once is
+    re-probed; a key that keeps moving raises (next tick retries)."""
+    sync = get_clock_sync()
+
+    class _RestartingClient(_SkewedClient):
+        def __init__(self):
+            super().__init__(gen="g1", skew=111.0)
+            self.flipped = False
+
+        def now(self):
+            v = super().now()
+            if not self.flipped:
+                # the restart lands after the first probe: new
+                # generation, new epoch
+                self.flipped = True
+                self._gen = "g2"
+                self.skew = 222.0
+            return v
+
+    client = _RestartingClient()
+    est = sync.estimate(client)
+    # the first attempt's probes straddled the restart — discarded; the
+    # cached offset comes from a clean second pass on the NEW epoch
+    assert abs(est["offset_s"] - 222.0) < 0.05
+    assert not sync.needs_estimate(client)
+
+    class _ThrashingClient(_SkewedClient):
+        def now(self):
+            self.reconnects += 1  # every probe looks like a reconnect
+            return super().now()
+
+    sync.reset()
+    with pytest.raises(ConnectionError):
+        sync.estimate(_ThrashingClient())
+    assert not sync.synced  # nothing was cached under a moving key
+
+
+def test_clock_sync_stamps_tracer_and_bundle_manifest(tmp_path):
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    maybe_sync_clock(_SkewedClient(skew=10.0), tracer=tel.tracer,
+                     node_id="n0")
+    with tel.span("unit/work"):
+        pass
+    trace = tel.tracer.chrome_trace()
+    sync = trace["metadata"]["clock_sync"]
+    assert abs(sync["offset_s"] - 10.0) < 0.05
+    assert sync["node_id"] == "n0"
+    # ts + trace_to_store_offset_us lands the span on the store clock
+    ev = trace["traceEvents"][-1]
+    store_us = ev["ts"] + sync["trace_to_store_offset_us"]
+    now_store_us = (time.perf_counter() + 10.0) * 1e6
+    assert abs(store_us - now_store_us) < 5e6
+    fr = FlightRecorder(max_records=8, output_path=str(tmp_path / "d"))
+    bundle = fr.dump("clock sync test")
+    with open(os.path.join(bundle, "bundle.json")) as fh:
+        manifest = json.load(fh)
+    assert abs(manifest["clock_sync"]["offset_s"] - 10.0) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# clock-aligned merged cluster trace
+# ---------------------------------------------------------------------------
+
+def _fake_bundle_with_trace(archive, node, events, offset_us=None):
+    bdir = os.path.join(archive, "hosts", node, "bundle-20260101-000000-001")
+    os.makedirs(bdir, exist_ok=True)
+    meta = {"source": "test"}
+    if offset_us is not None:
+        meta["clock_sync"] = {"offset_s": offset_us / 1e6,
+                              "trace_to_store_offset_us": offset_us}
+    with open(os.path.join(bdir, "trace.json"), "w") as fh:
+        json.dump({"traceEvents": events, "metadata": meta}, fh)
+    with open(os.path.join(bdir, "bundle.json"), "w") as fh:
+        json.dump({"reason": "test", "steps": []}, fh)
+
+
+def test_cluster_trace_aligns_lanes_onto_the_store_clock(tmp_path):
+    archive = str(tmp_path / "arch")
+    # host a: tracer origin at store-time 1.0s; spans at +0ms, +100ms
+    _fake_bundle_with_trace(archive, "a", [
+        {"ph": "X", "name": "a0", "ts": 0.0, "dur": 10.0, "pid": 7,
+         "tid": 1},
+        {"ph": "X", "name": "a1", "ts": 100_000.0, "dur": 10.0, "pid": 7,
+         "tid": 1}], offset_us=1_000_000.0)
+    # host b started 4s later on its private clock: raw ts 0 but
+    # store-time 5.0s — alignment must order it AFTER both of a's spans
+    _fake_bundle_with_trace(archive, "b", [
+        {"ph": "X", "name": "b0", "ts": 0.0, "dur": 10.0, "pid": 9,
+         "tid": 1}], offset_us=5_000_000.0)
+    # host c has no clock sync: included, flagged unaligned
+    _fake_bundle_with_trace(archive, "c", [
+        {"ph": "X", "name": "c0", "ts": 77.0, "dur": 1.0, "pid": 3,
+         "tid": 1}])
+    doc = agg.build_cluster_trace(archive)
+    assert os.path.exists(os.path.join(archive, "cluster_trace.json"))
+    hosts = doc["metadata"]["hosts"]
+    assert hosts["a"]["aligned"] and hosts["b"]["aligned"]
+    assert not hosts["c"]["aligned"]
+    spans = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    # lanes are distinct pids with process_name metadata
+    assert spans["a0"]["pid"] != spans["b0"]["pid"]
+    names = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+             if e.get("ph") == "M"}
+    assert names["a"] == spans["a0"]["pid"]
+    assert "c (unaligned)" in names
+    # aligned, re-based to the earliest aligned span: a0 at 0, a1 at
+    # +100ms, b0 at +4s — mutual ORDER across processes, which the raw
+    # per-process timestamps (both start at 0) could never show
+    assert spans["a0"]["ts"] == 0.0
+    assert spans["a1"]["ts"] == pytest.approx(100_000.0)
+    assert spans["b0"]["ts"] == pytest.approx(4_000_000.0)
+    assert spans["b0"]["ts"] > spans["a1"]["ts"]
+    # the unaligned lane is re-based to zero, order preserved
+    assert spans["c0"]["ts"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# rollup tick + merged exports + top
+# ---------------------------------------------------------------------------
+
+def test_rollup_tick_publishes_gauges_and_writes_merged_exports(tmp_path):
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        tel = get_telemetry()
+        tel.configure(enabled=True, jsonl=False, prometheus=False)
+        configure_step_stream(enabled=True, maxlen=16)
+        tel.set_gauge("goodput/fraction", 0.8)
+        tel.record_step(_steprec(10, 0.5, 20.0, 50.0))
+        push_node_telemetry(c, "n0")
+        tel.set_gauge("goodput/fraction", 0.6)
+        tel.record_step(_steprec(14, 0.4, 30.0, 40.0))
+        push_node_telemetry(c, "n1")
+        out = str(tmp_path / "merged")
+        rollup = rollup_tick(c, ["n0", "n1"], out_dir=out)
+        assert rollup is not None
+        # cluster gauges fed from the rollup (rank 0's registry)
+        assert tel.registry.gauge("elastic/straggler_step_skew").value \
+            == 4.0
+        assert tel.registry.gauge("elastic/cluster_goodput_min").value \
+            == pytest.approx(0.6)
+        assert tel.registry.gauge("rollup/nodes").value == 2.0
+        # merged exports on disk
+        parsed = parse_prometheus_text(
+            open(os.path.join(out, "cluster_metrics.prom")).read())
+        assert 'goodput_fraction{node="n1"}' in parsed
+        steps = [json.loads(ln) for ln in
+                 open(os.path.join(out, "cluster_steps.jsonl"))]
+        assert {(s["node"], s["step"]) for s in steps} \
+            >= {("n0", 10), ("n1", 14)}
+        # a second tick ingests nothing new -> no duplicate step lines
+        # (every_s=0 bypasses the cadence gate so the ingest REALLY
+        # re-reads the store and the dedup is what's being tested)
+        rollup_tick(c, ["n0", "n1"], out_dir=out, every_s=0.0)
+        steps2 = [json.loads(ln) for ln in
+                  open(os.path.join(out, "cluster_steps.jsonl"))]
+        assert len(steps2) == len(steps)
+        # and the default cadence gate skips a back-to-back beat
+        # entirely (the heartbeat loop calls at ~10 Hz)
+        before = os.path.getmtime(os.path.join(out,
+                                               "cluster_metrics.prom"))
+        rollup_tick(c, ["n0", "n1"], out_dir=out)
+        assert os.path.getmtime(os.path.join(
+            out, "cluster_metrics.prom")) == before
+    finally:
+        srv.shutdown()
+
+
+def test_top_cli_once_renders_every_live_node(tmp_path, capsys):
+    from deepspeed_tpu.telemetry import cli
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        tel = get_telemetry()
+        tel.configure(enabled=True, jsonl=False, prometheus=False)
+        configure_step_stream(enabled=True, maxlen=16)
+        for node, step in (("h0", 5), ("h1", 7), ("h2", 6)):
+            tel.record_step(_steprec(step, 0.1, 10.0, 1.0))
+            push_node_telemetry(c, node)
+            c.hb(f"rdzv/hb/{node}")
+        assert cli.main(["top", "--once", "--endpoint", srv.endpoint,
+                         "--peers", "h0,h1,h2"]) == 0
+        out = capsys.readouterr().out
+        for node in ("h0", "h1", "h2"):
+            assert node in out
+        assert "LIVE" in out and "STEP_MS" in out
+        # an unreachable store is a clean scriptable failure, not a hang
+        srv.shutdown()
+        assert cli.main(["top", "--once", "--endpoint", srv.endpoint,
+                         "--peers", "h0"]) == 2
+    finally:
+        srv.shutdown()
+
+
+def test_agent_hb_payload_never_reinflates_a_capped_watchdog_payload():
+    """Review fix: the agent must trust the watchdog's configured cap —
+    a field the cap dropped (e.g. coll_seq under a tight bound) must
+    NOT be re-added by the agent's ledger merge, and the drop counter
+    must not be re-bumped every beat."""
+    from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                        WorkerSpec)
+    from deepspeed_tpu.telemetry import (configure_collective_ledger,
+                                         set_watchdog)
+
+    tel = get_telemetry()
+    tel.configure(enabled=True, jsonl=False, prometheus=False)
+    led = configure_collective_ledger(tail=8)
+    for _ in range(3):
+        led.record("psum", 1024)
+    wd = HangWatchdog(hang_timeout_s=60.0, recorder=None,
+                      device_probe=False, heartbeat_max_bytes=90)
+    wd.notify_progress(5, 0.1)
+    set_watchdog(wd)
+    agent = DSElasticAgent(WorkerSpec(fn=lambda *_: 0))
+    payload = agent._hb_payload()
+    # the tight cap dropped coll_hash — it STAYS dropped (the old merge
+    # re-added every ledger field past the operator's bound)
+    assert "coll_hash" not in payload
+    assert payload["step"] == 5
+    assert len(json.dumps(payload)) <= 90
+    # per beat: exactly ONE cap application (the watchdog's)
+    drops1 = tel.registry.counter(
+        "elastic/heartbeat_fields_dropped_total").value
+    agent._hb_payload()
+    drops2 = tel.registry.counter(
+        "elastic/heartbeat_fields_dropped_total").value
+    assert drops2 == 2 * drops1
+    # ledger-only path (no watchdog): capped with the default bound
+    set_watchdog(None)
+    payload2 = agent._hb_payload()
+    assert payload2["coll_seq"] == led.seq
+    assert payload2["v"] == HEARTBEAT_SCHEMA_V
+
+
+def test_rollup_tick_watermarks_survive_a_rank0_restart(tmp_path):
+    """Review fix: the seq-dedup watermark persists next to the merged
+    exports, so a restarted rank-0 agent (fresh process-global rollup)
+    re-ingesting the batch still sitting in the store appends NOTHING
+    new to cluster_steps.jsonl."""
+    from deepspeed_tpu.telemetry.rollup import (STEP_WATERMARKS_FILE,
+                                                reset_rollup)
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        tel = get_telemetry()
+        tel.configure(enabled=True, jsonl=False, prometheus=False)
+        configure_step_stream(enabled=True, maxlen=16)
+        tel.record_step(_steprec(3, 0.5, 10.0, 10.0))
+        push_node_telemetry(c, "n0")
+        out = str(tmp_path / "merged")
+        rollup_tick(c, ["n0"], out_dir=out)
+        lines = open(os.path.join(out, "cluster_steps.jsonl")).readlines()
+        assert len(lines) == 1
+        assert os.path.exists(os.path.join(out, STEP_WATERMARKS_FILE))
+        # "restart": a brand-new process-global rollup, same out_dir,
+        # same batch still published in the store
+        reset_rollup()
+        rollup_tick(c, ["n0"], out_dir=out)
+        lines2 = open(os.path.join(out, "cluster_steps.jsonl")).readlines()
+        assert lines2 == lines  # no duplicates
+    finally:
+        srv.shutdown()
+
+
+def test_render_top_marks_silent_and_left_nodes():
+    rollup = MetricsRollup()
+    rollup.ingest_metrics("alive", _snapshot_doc("alive"))
+    rollup.ingest_metrics("dead", _snapshot_doc("dead"))
+    hb = {"alive": {"age_s": 0.5, "left": False},
+          "dead": {"age_s": 99.0, "left": False},
+          "gone": {"age_s": None, "left": True}}
+    text = render_top(rollup, hb_view=hb, silent_after_s=30.0)
+    lines = {ln.split()[0]: ln for ln in text.splitlines()[1:]}
+    assert "LIVE" in lines["alive"]
+    assert "SILENT" in lines["dead"]
+    assert "LEFT" in lines["gone"]
