@@ -1296,6 +1296,54 @@ def _main() -> None:
             fe = None
         free_hbm()
 
+    _mark("serving_network")
+    # -- variant: NETWORK serving plane — a real HTTP/SSE front door over
+    # 2 replica worker PROCESSES (synthetic engines: this measures the
+    # serving STACK — sockets, SSE writes, router RPCs, process hops —
+    # not model math, so the numbers are stable across devices).
+    # Sustained mixed-class QPS with shared tenant headers; p99 TTFT,
+    # sustained QPS and the cross-tenant prefix hit rate land in the
+    # gated baseline (`telemetry perf check` fails on regression).
+    net_door = None
+    net_fleet = []
+    try:
+        _budget_check()
+        from deepspeed_tpu.launcher.serving_fleet import (
+            launch_worker_fleet, shutdown_fleet)
+        from deepspeed_tpu.serving import (FrontDoor, FrontDoorParams,
+                                           NetworkFrontend, NetworkParams,
+                                           ReplicaEndpoint)
+        from deepspeed_tpu.serving.cli import run_network_workload
+
+        net_fleet = launch_worker_fleet(2)
+        net_eps = [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+                   for w in net_fleet]
+        net_door = FrontDoor(NetworkFrontend(net_eps, net=NetworkParams()),
+                             params=FrontDoorParams())
+        net_door.start()
+        # warm the sockets + tenant headers outside the measured window
+        run_network_workload(net_door.host, net_door.port,
+                             duration_s=1.0, seed=7)
+        nsv = run_network_workload(net_door.host, net_door.port,
+                                   duration_s=4.0, seed=0)
+        extras["serving_net_p99_ttft_ms"] = nsv["serving_net_p99_ttft_ms"]
+        extras["serving_net_qps_sustained"] = \
+            nsv["serving_net_qps_sustained"]
+        extras["serving_net_prefix_hit_rate"] = \
+            nsv["serving_net_prefix_hit_rate"]
+        extras.setdefault("variants", {})["serving_network"] = nsv
+    except Exception as e:
+        extras.setdefault("variants", {})[
+            "serving_network_error"] = str(e)[:200]
+    finally:
+        if net_door is not None:
+            net_door.shutdown()
+        if net_fleet:
+            from deepspeed_tpu.launcher.serving_fleet import shutdown_fleet
+
+            shutdown_fleet(net_fleet)
+        free_hbm()
+
     _mark("block_sparse")
     # -- variant: block-sparse kernel speedup vs dense-masked (S=4096) ----
     try:

@@ -403,6 +403,53 @@ class ServingConfig(DeepSpeedConfigModel):
     stream_buffer: int = 4096
     #: interactive TTFT target (ms), exported with the serving metrics
     interactive_ttft_slo_ms: float = 500.0
+    #: under the HBM-headroom floor, preemption RELEASES the victim's
+    #: KV pages to the cached-free LRU tier (re-admission recomputes
+    #: via the prefix trie) instead of keeping them resident
+    preempt_release_pages: bool = True
+    #: the network serving plane (HTTP/SSE front door,
+    #: process-per-replica workers, disaggregated prefill/decode)
+    network: "ServingNetworkConfig" = Field(
+        default_factory=lambda: ServingNetworkConfig())
+
+
+class ServingNetworkConfig(DeepSpeedConfigModel):
+    """``serving.network`` config group — the network serving plane
+    (``deepspeed_tpu/serving/{frontdoor,worker,remote,kv_transfer}``):
+    an HTTP/SSE front door over the submit/stream/cancel API,
+    process-per-replica worker backends registered in the rendezvous
+    store, and disaggregated prefill/decode over the page-granular
+    checksum-gated KV transport."""
+
+    enabled: bool = False
+    #: front-door bind address (port 0 = ephemeral)
+    host: str = "127.0.0.1"
+    port: int = 0
+    #: replica worker PROCESSES to launch behind the door
+    workers: int = 2
+    #: of the fleet, dedicated prefill replicas (with ``disaggregate``)
+    prefill_workers: int = 1
+    #: run the prefill -> KV-page-stream -> decode pipeline
+    disaggregate: bool = False
+    #: per-class queued-token budget: past it the door answers 429 +
+    #: Retry-After (backpressure) instead of queueing
+    queue_token_budget: int = 32768
+    retry_after_s: float = 1.0
+    #: SSE idle heartbeat period (also dead-client detection cadence)
+    sse_heartbeat_s: float = 5.0
+    #: KV-page transfer chunk size (base64 chars per protocol line)
+    kv_chunk_bytes: int = 64 * 1024
+    #: network front-end pump idle sleep
+    poll_interval_s: float = 0.005
+    #: worker health-probe (ping) timeout
+    probe_timeout_s: float = 2.0
+    #: ping cadence (a fresh TCP connection per endpoint per probe;
+    #: transport failures mark endpoints dead instantly regardless)
+    probe_every_s: float = 1.0
+    rpc_timeout_s: float = 30.0
+    #: rendezvous store for worker registration/discovery (None: the
+    #: launcher wires endpoints directly)
+    store_endpoint: Optional[str] = None
 
 
 class ResilienceConfig(DeepSpeedConfigModel):

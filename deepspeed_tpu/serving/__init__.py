@@ -24,19 +24,28 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
+from .frontdoor import (CLASS_HEADER, FrontDoor, FrontDoorParams,
+                        door_params_from_config)
 from .frontend import (NoHealthyReplicaError, ServingFrontend,
                        ServingHandle, ServingParams)
 from .metrics import CLASSES, LatencyTracker, ServingMetrics
 from .prefix_cache import PrefixCache, RefcountedBlockAllocator
+from .remote import (NetworkFrontend, NetworkParams, ReplicaEndpoint,
+                     discover_endpoints, jsonline_rpc)
 from .router import Replica, ReplicaRouter
 from .scheduler import ServingScheduler
 from .synthetic import FakeClock, SyntheticEngine, synthetic_token
+from .worker import SRV_PREFIX, ServingWorker
 
 __all__ = [
-    "CLASSES", "FakeClock", "LatencyTracker", "NoHealthyReplicaError",
-    "PrefixCache", "RefcountedBlockAllocator", "Replica", "ReplicaRouter",
+    "CLASSES", "CLASS_HEADER", "FakeClock", "FrontDoor", "FrontDoorParams",
+    "LatencyTracker", "NetworkFrontend", "NetworkParams",
+    "NoHealthyReplicaError", "PrefixCache", "RefcountedBlockAllocator",
+    "Replica", "ReplicaEndpoint", "ReplicaRouter", "SRV_PREFIX",
     "ServingFrontend", "ServingHandle", "ServingMetrics", "ServingParams",
-    "ServingScheduler", "SyntheticEngine", "build_serving_frontend",
+    "ServingScheduler", "ServingWorker", "SyntheticEngine",
+    "build_serving_frontend", "discover_endpoints",
+    "door_params_from_config", "jsonline_rpc", "net_params_from_config",
     "params_from_config", "synthetic_token",
 ]
 
@@ -56,7 +65,21 @@ def params_from_config(scfg: Any) -> ServingParams:
         eos_token_id=getattr(scfg, "eos_token_id", None),
         stream_buffer=int(getattr(scfg, "stream_buffer", 4096)),
         interactive_ttft_slo_ms=float(
-            getattr(scfg, "interactive_ttft_slo_ms", 500.0)))
+            getattr(scfg, "interactive_ttft_slo_ms", 500.0)),
+        preempt_release_pages=bool(
+            getattr(scfg, "preempt_release_pages", True)))
+
+
+def net_params_from_config(ncfg: Any) -> NetworkParams:
+    """Map the ``serving.network.*`` config group onto
+    :class:`NetworkParams`."""
+    return NetworkParams(
+        rpc_timeout_s=float(getattr(ncfg, "rpc_timeout_s", 30.0)),
+        probe_timeout_s=float(getattr(ncfg, "probe_timeout_s", 2.0)),
+        probe_every_s=float(getattr(ncfg, "probe_every_s", 1.0)),
+        poll_interval_s=float(getattr(ncfg, "poll_interval_s", 0.005)),
+        kv_chunk_bytes=int(getattr(ncfg, "kv_chunk_bytes", 64 * 1024)),
+        disaggregate=bool(getattr(ncfg, "disaggregate", False)))
 
 
 def build_serving_frontend(model: Any, params: Any = None,

@@ -1,19 +1,24 @@
-"""Serving CLI — ``python -m deepspeed_tpu.serving bench [--dry-run]``.
+"""Serving CLI — bench / serve / worker.
 
-One deterministic multi-tenant workload, two execution modes:
+* ``python -m deepspeed_tpu.serving bench [--dry-run] [--network]`` —
+  the deterministic multi-tenant workload.  ``--dry-run`` drives
+  synthetic replicas on a fake clock (CI smoke); real mode compiles a
+  tiny model; ``--network`` spawns a real front door + 2 replica worker
+  PROCESSES and drives sustained mixed-class QPS over actual HTTP/SSE,
+  emitting the gated ``serving_net_*`` metrics.
+* ``python -m deepspeed_tpu.serving serve`` — run the HTTP/SSE front
+  door.  ``--dry-run`` boots synthetic in-process replicas, answers its
+  own health probe, and shuts down cleanly (the run_suite smoke);
+  ``--workers N`` launches a worker-process fleet behind it;
+  ``--store`` discovers externally-launched workers from the
+  rendezvous store.
+* ``python -m deepspeed_tpu.serving worker`` — run ONE replica worker
+  process (the launcher and chaos tests spawn these; ``kill -9`` one
+  and the front door's router drains it).
 
-* ``--dry-run`` — synthetic replicas on a fake clock: zero device work,
-  finishes in milliseconds, numbers deterministic.  This is the CI
-  smoke (run_suite.sh) and the quickest way to see the serving metrics
-  end to end.
-* real mode — a tiny real model through ``build_serving_frontend`` on
-  whatever backend JAX has (CPU works): the same workload against the
-  actual compiled engine.  ``bench.py``'s serving variant reuses
-  :func:`run_workload` against a production-sized model.
-
-The emitted JSON line carries the gated serving metrics
-(``serving_p99_ttft_ms``, ``prefix_hit_rate``, ``tok_s_interactive``)
-in the exact shape ``telemetry perf check`` reads.
+The emitted bench JSON lines carry the gated serving metrics
+(``serving_p99_ttft_ms``, ``prefix_hit_rate``, ``serving_net_*``) in
+the exact shape ``telemetry perf check`` reads.
 """
 
 from __future__ import annotations
@@ -136,7 +141,180 @@ def _real_frontend(replicas: int):
     return fe, time.monotonic
 
 
+def sse_events(resp) -> "Any":
+    """Parse a ``text/event-stream`` HTTP response into ``(event,
+    data_dict)`` pairs; comment heartbeats are skipped.  Yields until
+    the close-delimited body ends."""
+    event, data = None, []
+    while True:
+        line = resp.readline()
+        if not line:
+            return
+        line = line.decode().rstrip("\n").rstrip("\r")
+        if not line:
+            if event is not None:
+                yield event, json.loads("".join(data) or "{}")
+            event, data = None, []
+            continue
+        if line.startswith(":"):
+            continue  # heartbeat comment
+        if line.startswith("event:"):
+            event = line[len("event:"):].strip()
+        elif line.startswith("data:"):
+            data.append(line[len("data:"):].strip())
+
+
+def http_generate_stream(host: str, port: int, prompt: list,
+                         max_new_tokens: int, klass: str,
+                         timeout: float = 60.0) -> Dict[str, Any]:
+    """One streamed request through the front door; returns the tokens,
+    client-measured TTFT, and the server's ``done`` summary."""
+    import http.client
+    import time as _time
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        t0 = _time.monotonic()
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompt": prompt,
+                             "max_new_tokens": max_new_tokens,
+                             "stream": True}),
+            headers={"Content-Type": "application/json",
+                     "X-DS-Class": klass})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return {"status_code": resp.status,
+                    "error": resp.read().decode()[:200], "tokens": []}
+        tokens, ttft_ms, done = [], None, {}
+        for event, data in sse_events(resp):
+            if event == "token":
+                if ttft_ms is None:
+                    ttft_ms = (_time.monotonic() - t0) * 1e3
+                tokens.append(int(data["token"]))
+            elif event in ("done", "error"):
+                done = data
+                break
+        return {"status_code": 200, "tokens": tokens,
+                "ttft_ms": ttft_ms, "done": done}
+    finally:
+        conn.close()
+
+
+def run_network_workload(host: str, port: int, duration_s: float = 3.0,
+                         tenants: int = 4, concurrency: int = 6,
+                         header_len: int = 96, interactive_new: int = 12,
+                         background_new: int = 48,
+                         seed: int = 0) -> Dict[str, Any]:
+    """Sustained mixed-class QPS against a live front door: ``tenants``
+    shared prompt headers (cross-request prefix hits), ``concurrency``
+    client threads submitting back-to-back over real HTTP/SSE for
+    ``duration_s``.  Returns the gated ``serving_net_*`` metrics."""
+    import http.client
+    import threading
+    import time as _time
+
+    rng = np.random.RandomState(seed)
+    headers = [rng.randint(2, 29000, size=header_len).tolist()
+               for _ in range(tenants)]
+    results: list = []
+    errors: list = []
+    lock = threading.Lock()
+    stop = _time.monotonic() + duration_s
+
+    def client(idx: int) -> None:
+        r = np.random.RandomState(seed + 1000 + idx)
+        i = 0
+        while _time.monotonic() < stop:
+            klass = "interactive" if (i % 3) else "background"
+            new = interactive_new if klass == "interactive" \
+                else background_new
+            prompt = (headers[(idx + i) % tenants]
+                      + r.randint(2, 29000, size=4).tolist())
+            try:
+                out = http_generate_stream(host, port, prompt, new, klass)
+            except OSError as e:
+                with lock:
+                    errors.append(repr(e))
+                break
+            with lock:
+                if out["status_code"] == 200 and out["tokens"]:
+                    results.append((klass, out["ttft_ms"],
+                                    len(out["tokens"])))
+                elif out["status_code"] != 429:
+                    errors.append(str(out.get("error"))[:120])
+            i += 1
+
+    t0 = _time.monotonic()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60.0)
+    elapsed = max(_time.monotonic() - t0, 1e-9)
+
+    inter = sorted(ms for k, ms, _ in results
+                   if k == "interactive" and ms is not None)
+
+    def pct(p: float) -> float:
+        if not inter:
+            return 0.0
+        return inter[min(len(inter) - 1,
+                         int(round(p / 100.0 * (len(inter) - 1))))]
+
+    hit_rate = 0.0
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/v1/metrics")
+        m = json.loads(conn.getresponse().read())
+        hit_rate = float(m.get("prefix_hit_rate", 0.0))
+        conn.close()
+    except (OSError, ValueError):
+        pass
+    return {
+        "serving_net_p99_ttft_ms": round(pct(99), 3),
+        "serving_net_p50_ttft_ms": round(pct(50), 3),
+        "serving_net_qps_sustained": round(len(results) / elapsed, 2),
+        "serving_net_prefix_hit_rate": round(hit_rate, 4),
+        "requests_completed": len(results),
+        "tokens_streamed": sum(n for _, _, n in results),
+        "elapsed_s": round(elapsed, 3),
+        "errors": errors[:5],
+    }
+
+
+def _network_bench(args: argparse.Namespace) -> int:
+    """bench --network: a real front door + 2 worker processes."""
+    from ..launcher.serving_fleet import (launch_worker_fleet,
+                                          shutdown_fleet)
+    from . import (FrontDoor, FrontDoorParams, NetworkFrontend,
+                   NetworkParams, ReplicaEndpoint)
+
+    fleet = launch_worker_fleet(args.replicas)
+    door = None
+    try:
+        eps = [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+               for w in fleet]
+        fe = NetworkFrontend(eps, net=NetworkParams())
+        door = FrontDoor(fe, params=FrontDoorParams())
+        door.start()
+        out = run_network_workload(door.host, door.port,
+                                   duration_s=args.duration,
+                                   seed=args.seed)
+        out["replicas"] = len(fleet)
+        out["network"] = True
+        print(json.dumps(out))
+        return 0 if out["requests_completed"] > 0 else 3
+    finally:
+        if door is not None:
+            door.shutdown()
+        shutdown_fleet(fleet)
+
+
 def bench_command(args: argparse.Namespace) -> int:
+    if getattr(args, "network", False):
+        return _network_bench(args)
     if args.dry_run:
         fe, clock = _dry_run_frontend(args.replicas)
         header_len, inter_new, bg_new = 128, 16, 96
@@ -154,6 +332,180 @@ def bench_command(args: argparse.Namespace) -> int:
     return 0
 
 
+def _build_worker_engine(args: argparse.Namespace):
+    from ..inference.v2 import KVCacheConfig
+
+    cache = KVCacheConfig(num_blocks=args.blocks,
+                          block_size=args.block_size,
+                          max_seq_len=args.max_seq_len)
+    if args.engine == "synthetic":
+        from . import SyntheticEngine
+
+        return SyntheticEngine(cache, max_batch_slots=args.slots,
+                               prefill_chunk=args.block_size * 4,
+                               prefill_batch=2, decode_burst=4)
+    # tiny real model on whatever backend JAX has (CPU works)
+    import jax.numpy as jnp
+
+    from ..inference.v2 import build_engine_v2
+    from ..models import LlamaConfig, LlamaModel
+    from .scheduler import ServingScheduler
+
+    cfg = LlamaConfig.tiny(num_layers=2,
+                           max_seq_len=args.max_seq_len,
+                           dtype=jnp.float32)
+    return build_engine_v2(
+        LlamaModel(cfg), cache_config=cache,
+        max_batch_slots=args.slots,
+        prefill_chunk=args.block_size * 2, prefill_batch=2,
+        decode_burst=4, scheduler_factory=ServingScheduler)
+
+
+def worker_command(args: argparse.Namespace) -> int:
+    import signal
+    import threading
+
+    from ..telemetry import get_telemetry
+    from . import ServingWorker
+
+    # the worker ships its registry through the PR-13 rollup — the
+    # merged cluster view labels serving counters per replica process
+    get_telemetry().configure(enabled=True, jsonl=False,
+                              prometheus=False)
+    engine = _build_worker_engine(args)
+    w = ServingWorker(engine, args.id, role=args.role, port=args.port,
+                      store_endpoint=args.store,
+                      kv_chunk_bytes=args.kv_chunk_bytes,
+                      poll_drip=args.drip)
+    # one parseable readiness line, flushed — launchers wait on it
+    print(f"DS_SERVING_WORKER id={w.id} role={w.role} "
+          f"endpoint={w.endpoint}", flush=True)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    w.shutdown()
+    return 0
+
+
+def _load_network_config(spec: Optional[str]):
+    """``--ds-config``: a DeepSpeed config path or inline JSON whose
+    ``serving.network`` group seeds the serve defaults (explicit CLI
+    flags win)."""
+    if not spec:
+        return None
+    import os
+
+    from ..runtime.config import ServingNetworkConfig
+
+    if os.path.exists(spec):
+        with open(spec) as fh:
+            doc = json.load(fh)
+    else:
+        doc = json.loads(spec)
+    group = (doc.get("serving") or {}).get("network") or {}
+    return ServingNetworkConfig(**group)
+
+
+def serve_command(args: argparse.Namespace) -> int:
+    import http.client
+    import signal
+    import threading
+
+    from . import (FrontDoor, FrontDoorParams, NetworkFrontend,
+                   NetworkParams, ReplicaEndpoint, discover_endpoints,
+                   door_params_from_config, net_params_from_config)
+
+    ncfg = _load_network_config(args.ds_config)
+    door_params = (door_params_from_config(ncfg) if ncfg is not None
+                   else FrontDoorParams())
+    if args.queue_token_budget is not None:
+        door_params.queue_token_budget = args.queue_token_budget
+    if args.retry_after is not None:
+        door_params.retry_after_s = args.retry_after
+    net = net_params_from_config(ncfg) if ncfg is not None \
+        else NetworkParams()
+    if args.disaggregate:
+        net.disaggregate = True
+    if args.kv_chunk_bytes is not None:
+        net.kv_chunk_bytes = args.kv_chunk_bytes
+    host = args.host if args.host is not None else \
+        (ncfg.host if ncfg is not None else "127.0.0.1")
+    port = args.port if args.port is not None else \
+        (ncfg.port if ncfg is not None else 0)
+    store = args.store if args.store is not None else \
+        (ncfg.store_endpoint if ncfg is not None else None)
+    workers = args.workers if args.workers is not None else \
+        (ncfg.workers if ncfg is not None and ncfg.enabled else 0)
+    prefill_workers = args.prefill_workers \
+        if args.prefill_workers is not None \
+        else (ncfg.prefill_workers if ncfg is not None else 1)
+
+    fleet = []
+    if args.dry_run:
+        fe, _ = _dry_run_frontend(args.replicas)
+        # a fake-clock front-end never advances wall TTFT — fine for
+        # the boot/probe/shutdown smoke this mode exists for
+    elif workers > 0 or store:
+        from ..launcher.serving_fleet import launch_worker_fleet
+
+        eps = []
+        if workers > 0:
+            prefill = prefill_workers if net.disaggregate else 0
+            fleet = launch_worker_fleet(workers, prefill=prefill,
+                                        store=store,
+                                        engine=args.engine)
+            eps = [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+                   for w in fleet]
+        elif store:
+            from ..elasticity.rendezvous import RendezvousClient
+
+            eps = discover_endpoints(RendezvousClient(store))
+        if not eps:
+            print(json.dumps({"ok": False,
+                              "error": "no worker endpoints found"}))
+            return 2
+        fe = NetworkFrontend(eps, net=net)
+    else:
+        fe, _ = _real_frontend(args.replicas)
+    door = FrontDoor(fe, host=host, port=port, params=door_params)
+    door.start()
+    try:
+        if args.dry_run:
+            # boot -> probe -> clean shutdown, one parseable JSON line
+            conn = http.client.HTTPConnection(door.host, door.port,
+                                              timeout=10)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            health = json.loads(resp.read())
+            conn.close()
+            print(json.dumps({"ok": resp.status == 200,
+                              "endpoint": door.endpoint,
+                              "healthz": health}))
+            return 0 if resp.status == 200 else 3
+        print(f"DS_SERVING_FRONTDOOR endpoint={door.endpoint}",
+              flush=True)
+        stop = threading.Event()
+
+        def _term(signum, frame):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _term)
+        signal.signal(signal.SIGINT, _term)
+        stop.wait()
+        return 0
+    finally:
+        door.shutdown()
+        if fleet:
+            from ..launcher.serving_fleet import shutdown_fleet
+
+            shutdown_fleet(fleet)
+
+
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.serving",
@@ -162,13 +514,68 @@ def main(argv: Optional[list] = None) -> int:
     b = sub.add_parser("bench", help="mixed-class serving benchmark")
     b.add_argument("--dry-run", action="store_true",
                    help="synthetic replicas on a fake clock (no device)")
+    b.add_argument("--network", action="store_true",
+                   help="real front door + worker processes over HTTP")
     b.add_argument("--replicas", type=int, default=2)
     b.add_argument("--interactive", type=int, default=12)
     b.add_argument("--background", type=int, default=6)
+    b.add_argument("--duration", type=float, default=3.0,
+                   help="--network: sustained-load window (s)")
     b.add_argument("--seed", type=int, default=0)
+
+    s = sub.add_parser("serve", help="run the HTTP/SSE front door")
+    s.add_argument("--dry-run", action="store_true",
+                   help="boot synthetic replicas, answer a health "
+                        "probe, shut down cleanly (CI smoke)")
+    s.add_argument("--ds-config", default=None,
+                   help="DeepSpeed config (path or inline JSON) whose "
+                        "serving.network group seeds the defaults "
+                        "below; explicit flags win")
+    s.add_argument("--host", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument("--replicas", type=int, default=2,
+                   help="in-process replicas (no --workers/--store)")
+    s.add_argument("--workers", type=int, default=None,
+                   help="spawn this many replica worker PROCESSES")
+    s.add_argument("--prefill-workers", type=int, default=None,
+                   help="of the worker fleet, run this many as "
+                        "dedicated prefill replicas (--disaggregate)")
+    s.add_argument("--disaggregate", action="store_true",
+                   help="prefill/decode disaggregation over the "
+                        "KV-page transport")
+    s.add_argument("--engine", choices=("synthetic", "tiny-llama"),
+                   default="synthetic")
+    s.add_argument("--store", default=None,
+                   help="rendezvous store endpoint (worker discovery "
+                        "+ registration)")
+    s.add_argument("--queue-token-budget", type=int, default=None)
+    s.add_argument("--retry-after", type=float, default=None)
+    s.add_argument("--kv-chunk-bytes", type=int, default=None)
+
+    w = sub.add_parser("worker", help="run ONE replica worker process")
+    w.add_argument("--id", required=True)
+    w.add_argument("--role", choices=("mixed", "prefill", "decode"),
+                   default="mixed")
+    w.add_argument("--engine", choices=("synthetic", "tiny-llama"),
+                   default="synthetic")
+    w.add_argument("--port", type=int, default=0)
+    w.add_argument("--store", default=None)
+    w.add_argument("--slots", type=int, default=4)
+    w.add_argument("--blocks", type=int, default=256)
+    w.add_argument("--block-size", type=int, default=16)
+    w.add_argument("--max-seq-len", type=int, default=512)
+    w.add_argument("--kv-chunk-bytes", type=int, default=64 * 1024)
+    w.add_argument("--drip", type=int, default=0,
+                   help="flow control: tokens per poll reply (0 = all; "
+                        "chaos tests keep streams in flight with 1)")
+
     args = p.parse_args(argv)
     if args.cmd == "bench":
         return bench_command(args)
+    if args.cmd == "serve":
+        return serve_command(args)
+    if args.cmd == "worker":
+        return worker_command(args)
     return 2
 
 

@@ -1,0 +1,345 @@
+"""HTTP/SSE front door — the network edge of the serving plane
+(ISSUE 14 tentpole a).
+
+Stdlib-only (``http.server``/``socketserver``, the same dependency
+posture as the rendezvous store): one :class:`FrontDoor` wraps either
+the in-process :class:`~.frontend.ServingFrontend` or the
+process-per-replica :class:`~.remote.NetworkFrontend` — a request
+enters over a socket and (in network mode) exits over a socket.
+
+API:
+
+* ``GET /healthz`` — 200 with replica health when at least one replica
+  is live, 503 otherwise.  The CLI smoke and load balancers probe it.
+* ``GET /v1/metrics`` — the serving snapshot (per-class TTFT/TPOT,
+  queue depths, counters, prefix hit rate, disaggregated TTFT
+  attribution) as JSON.
+* ``POST /v1/generate`` — body ``{"prompt": [ints], "max_new_tokens":
+  N, "class": "interactive", "stream": true}``.  The admission class
+  may also ride the ``X-DS-Class`` header (the header wins — edge
+  proxies stamp it without touching the body).
+
+  - Validation failures map to **400** naming the offending field
+    (the scheduler's own messages), malformed/oversized bodies to
+    400/413, wrong methods/paths to 405/404.
+  - **Backpressure**: when the class queue is over its token budget
+    the door answers **429** with a ``Retry-After`` header instead of
+    queueing — the SLO stays honest under overload.
+  - ``"stream": true`` (default) answers ``text/event-stream``:
+    ``event: token`` per generated token, comment heartbeats while
+    idle (dead-socket detection between tokens), and a final ``event:
+    done`` carrying the TTFT (split prefill/transfer/decode when the
+    request ran disaggregated).  A client that disconnects mid-stream
+    CANCELS the request (``serving/cancelled_on_disconnect_total``) —
+    abandoned work never holds pages or decode slots.
+  - ``"stream": false`` blocks and answers one JSON document.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..utils.logging import log_dist, logger
+from .frontend import NoHealthyReplicaError
+from .metrics import CLASSES
+
+#: admission-class request header (overrides the body's "class")
+CLASS_HEADER = "X-DS-Class"
+
+
+@dataclasses.dataclass
+class FrontDoorParams:
+    """HTTP-layer knobs (``serving.network.*`` maps the overlap)."""
+
+    #: per-class queued-token budget: a submit that would push the
+    #: class queue past it is answered 429 + Retry-After
+    queue_token_budget: int = 32768
+    retry_after_s: float = 1.0
+    #: SSE idle heartbeat period (comment lines; also the cadence at
+    #: which a dead client socket is discovered between tokens)
+    sse_heartbeat_s: float = 5.0
+    max_body_bytes: int = 1 << 20
+    #: non-streaming requests block at most this long
+    result_timeout_s: float = 600.0
+
+
+def door_params_from_config(ncfg: Any) -> FrontDoorParams:
+    """Map the HTTP-layer knobs of the ``serving.network.*`` config
+    group onto :class:`FrontDoorParams`."""
+    return FrontDoorParams(
+        queue_token_budget=int(
+            getattr(ncfg, "queue_token_budget", 32768)),
+        retry_after_s=float(getattr(ncfg, "retry_after_s", 1.0)),
+        sse_heartbeat_s=float(getattr(ncfg, "sse_heartbeat_s", 5.0)))
+
+
+class _DoorHandler(BaseHTTPRequestHandler):
+    server_version = "ds-serving-frontdoor/1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, format: str, *args) -> None:
+        logger.debug("frontdoor: " + format % args)
+
+    def _door(self) -> "FrontDoor":
+        return self.server.door  # type: ignore[attr-defined]
+
+    def _send_json(self, code: int, doc: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        body = (json.dumps(doc) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- GET -----------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server convention)
+        door = self._door()
+        if self.path == "/healthz":
+            healthy = door.frontend.healthy_count()
+            doc = {"ok": healthy > 0, "healthy_replicas": healthy,
+                   "mode": door.mode}
+            self._send_json(200 if healthy > 0 else 503, doc)
+            return
+        if self.path == "/v1/metrics":
+            self._send_json(200, door.frontend.snapshot())
+            return
+        self._send_json(404, {"error": f"no such path {self.path!r}"})
+
+    # -- POST ----------------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/generate":
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+            return
+        door = self._door()
+        params = door.params
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            # the body length is unknowable, so it cannot be drained:
+            # close, or the unread bytes desync the next keep-alive
+            # request on this connection
+            self.close_connection = True
+            self._send_json(400, {"error": "bad Content-Length"},
+                            headers={"Connection": "close"})
+            return
+        if length <= 0:
+            # no usable Content-Length (absent, zero, or a chunked
+            # body we don't read): anything the client DID send would
+            # desync the next keep-alive request — close
+            self.close_connection = True
+            self._send_json(400, {"error": "empty request body "
+                                           "(Content-Length required)"},
+                            headers={"Connection": "close"})
+            return
+        if length > params.max_body_bytes:
+            # replying without reading the oversized body leaves it in
+            # the socket — close instead of parsing it as a "request"
+            self.close_connection = True
+            self._send_json(413, {
+                "error": f"body of {length} bytes exceeds "
+                         f"{params.max_body_bytes}"},
+                headers={"Connection": "close"})
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+        except ValueError as e:
+            self._send_json(400, {"error": f"malformed JSON body: {e}"})
+            return
+        if not isinstance(body, dict):
+            self._send_json(400, {"error": "body must be a JSON object"})
+            return
+        klass = (self.headers.get(CLASS_HEADER)
+                 or body.get("class") or "interactive")
+        if klass not in CLASSES:
+            self._send_json(400, {
+                "error": f"class: unknown latency class {klass!r} "
+                         f"(one of {', '.join(CLASSES)})"})
+            return
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            self._send_json(400, {
+                "error": "prompt: must be a non-empty token list"})
+            return
+        if not all(isinstance(t, int) and not isinstance(t, bool)
+                   for t in prompt):
+            self._send_json(400, {
+                "error": "prompt: every token must be an integer"})
+            return
+        max_new = body.get("max_new_tokens", 64)
+        try:
+            max_new = int(max_new)
+            door.frontend.validate(prompt, max_new)
+        except (TypeError, ValueError) as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        # backpressure BEFORE anything is queued: the class budget is
+        # in tokens, so one huge batch request cannot hide behind a
+        # small queue length
+        tokens = len(prompt) + max_new
+        queued = door.frontend.queued_tokens(klass)
+        if queued + tokens > params.queue_token_budget:
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "serving/backpressure_429_total",
+                help="requests shed with 429 (class token budget full)")
+            self._send_json(
+                429,
+                {"error": f"{klass} queue over its token budget "
+                          f"({queued}/{params.queue_token_budget} "
+                          f"queued); retry later",
+                 "queued_tokens": queued},
+                headers={"Retry-After":
+                         str(max(1, int(round(params.retry_after_s))))})
+            return
+        try:
+            handle = door.frontend.submit(prompt, max_new, klass)
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except NoHealthyReplicaError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        if bool(body.get("stream", True)):
+            self._stream_sse(handle)
+        else:
+            self._blocking_result(handle)
+
+    # -- response modes -------------------------------------------------------
+
+    def _summary(self, handle: Any) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "status": handle.status,
+            "tokens_delivered": handle.delivered,
+            "replays": handle.replays,
+            "ttft_ms": (round(handle.ttft_ms, 3)
+                        if handle.ttft_ms is not None else None)}
+        if handle.ttft_breakdown:
+            out["ttft_breakdown_ms"] = {
+                k.replace("_ms", ""): round(v, 3)
+                for k, v in handle.ttft_breakdown.items()}
+        return out
+
+    def _blocking_result(self, handle: Any) -> None:
+        try:
+            toks = handle.result(
+                timeout=self._door().params.result_timeout_s)
+        except Exception as e:
+            self._send_json(500, {"error": str(e),
+                                  "status": handle.status})
+            return
+        doc = {"tokens": toks}
+        doc.update(self._summary(handle))
+        self._send_json(200, doc)
+
+    def _stream_sse(self, handle: Any) -> None:
+        door = self._door()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # close-delimited body: no Content-Length for an unbounded
+        # stream, and the close tells the client the stream is over
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        i = 0
+        try:
+            while True:
+                kind, value = handle.next_event(
+                    timeout=door.params.sse_heartbeat_s)
+                if kind == "timeout":
+                    # comment heartbeat: keeps proxies open AND makes a
+                    # vanished client raise here instead of never
+                    self.wfile.write(b": hb\n\n")
+                    self.wfile.flush()
+                    continue
+                if kind == "token":
+                    payload = json.dumps({"i": i, "token": value})
+                    self.wfile.write(
+                        f"event: token\ndata: {payload}\n\n".encode())
+                    self.wfile.flush()
+                    i += 1
+                    continue
+                # done
+                err = value
+                if err is not None:
+                    payload = json.dumps({"error": str(err),
+                                          "status": handle.status})
+                    self.wfile.write(
+                        f"event: error\ndata: {payload}\n\n".encode())
+                else:
+                    payload = json.dumps(self._summary(handle))
+                    self.wfile.write(
+                        f"event: done\ndata: {payload}\n\n".encode())
+                self.wfile.flush()
+                return
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client went away mid-stream: cancel so abandoned
+            # work frees its decode slot and KV pages immediately
+            try:
+                door.frontend.cancel(handle)
+            finally:
+                from ..telemetry import get_telemetry
+
+                get_telemetry().inc_counter(
+                    "serving/cancelled_on_disconnect_total",
+                    help="streams cancelled because the client "
+                         "disconnected")
+
+
+class FrontDoor:
+    """The HTTP server around a serving front-end.  ``frontend`` is a
+    :class:`~.frontend.ServingFrontend` or
+    :class:`~.remote.NetworkFrontend`; the door starts the front-end's
+    pump thread with :meth:`start` and owns its shutdown."""
+
+    def __init__(self, frontend: Any, host: str = "127.0.0.1",
+                 port: int = 0,
+                 params: Optional[FrontDoorParams] = None,
+                 own_frontend: bool = True):
+        self.frontend = frontend
+        self.params = params or FrontDoorParams()
+        self.own_frontend = bool(own_frontend)
+        self.mode = ("network"
+                     if hasattr(frontend, "endpoints") else "local")
+        self._srv = ThreadingHTTPServer((host, int(port)), _DoorHandler)
+        self._srv.daemon_threads = True
+        self._srv.door = self  # type: ignore[attr-defined]
+        self.host = host or "127.0.0.1"
+        self.port = int(self._srv.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.frontend.start()
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True,
+                                        name="ds-serving-frontdoor")
+        self._thread.start()
+        log_dist(f"serving front door ({self.mode} mode) at "
+                 f"http://{self.endpoint}")
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self.own_frontend:
+            self.frontend.close()

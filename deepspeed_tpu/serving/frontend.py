@@ -90,6 +90,12 @@ class ServingParams:
     #: interactive TTFT target (ms) — exported with the metrics so the
     #: bench/SLO gate reads the bound it asserts against
     interactive_ttft_slo_ms: float = 500.0
+    #: under the HBM-headroom floor, preemption RELEASES the victim's
+    #: KV pages back to the cached-free LRU tier (trie-indexed prompt
+    #: pages stay revivable; re-admission recomputes the rest and the
+    #: stream splices past the delivered high-water mark) instead of
+    #: keeping them resident
+    preempt_release_pages: bool = True
 
 
 class ServingHandle:
@@ -117,6 +123,9 @@ class ServingHandle:
         self.admitted_at: Optional[float] = None
         self.error: Optional[BaseException] = None
         self.replays = 0                  # replica-death re-executions
+        #: disaggregated serving: {"prefill_ms", "transfer_ms",
+        #: "decode_ms"} TTFT attribution (None for colocated requests)
+        self.ttft_breakdown: Optional[Dict[str, float]] = None
         self._frontend = frontend
         # a REAL bound: when a stalled consumer lets it fill, _push
         # drops the oldest undelivered token — the pump never blocks
@@ -138,6 +147,34 @@ class ServingHandle:
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         return list(self.stream(timeout=timeout))
+
+    def drain(self) -> "tuple[List[int], bool]":
+        """Non-blocking: every currently-buffered token plus a
+        completion flag.  The replica-worker protocol's ``poll`` op
+        reads the stream this way (a socket peer cannot park in
+        :meth:`stream`)."""
+        toks: List[int] = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return toks, False
+            if item is _DONE:
+                return toks, True
+            toks.append(int(item))
+
+    def next_event(self, timeout: Optional[float] = None) -> "tuple":
+        """One stream event for push-style consumers (the SSE writer):
+        ``("token", t)`` / ``("done", error)`` / ``("timeout", None)``
+        when nothing arrived within ``timeout`` — the caller emits a
+        heartbeat and retries, detecting dead sockets between tokens."""
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return ("timeout", None)
+        if item is _DONE:
+            return ("done", self.error)
+        return ("token", int(item))
 
     def cancel(self) -> None:
         self._frontend.cancel(self)
@@ -288,6 +325,98 @@ class ServingFrontend:
                 help="requests submitted per latency class")
             return h
 
+    def validate(self, prompt: List[int], max_new_tokens: int) -> None:
+        """The scheduler's request validation, surfaced for the network
+        front door: raises ``ValueError`` naming the offending field —
+        the HTTP layer maps it to a 400 BEFORE anything is queued."""
+        with self._lock:
+            reps = self.router.replicas
+            (self.router.healthy() or reps)[0].scheduler.validate(
+                list(prompt), int(max_new_tokens))
+
+    def queued_tokens(self, klass: str) -> int:
+        """Admission-queue depth in TOKENS (prompt + generation budget)
+        for one latency class — the front door's backpressure signal
+        (429 + Retry-After when a class is over its token budget)."""
+        with self._lock:
+            return sum(len(h.prompt) + h.max_new_tokens
+                       for h in self._queues.get(klass, ()))
+
+    def healthy_count(self) -> int:
+        """Replicas not marked dead (cheap — no probe RPCs): the
+        ``/healthz`` answer."""
+        return sum(1 for r in self.router.replicas
+                   if r.dead_reason is None)
+
+    def match_tokens(self, prompt: List[int]) -> int:
+        """Best prefix-affinity score across replicas — the network
+        router's placement signal (the worker protocol's ``match``)."""
+        with self._lock:
+            best = 0
+            for r in self.router.replicas:
+                sched = r.scheduler
+                if hasattr(sched, "match_tokens"):
+                    best = max(best, sched.match_tokens(list(prompt)))
+            return best
+
+    # -- disaggregated adoption (decode side) ------------------------------
+
+    def adopt_begin(self, prompt: List[int], max_new_tokens: int,
+                    klass: str = "interactive") -> "tuple":
+        """Reserve pages + a slot for a request prefilled ELSEWHERE.
+        Returns ``(handle, need)`` — ``need`` is the list of prompt-page
+        indices the KV transfer must fill (trie-shared pages excluded)
+        — or ``(None, None)`` when capacity is unavailable."""
+        with self._lock:
+            healthy = self.router.healthy()
+            if not healthy:
+                raise NoHealthyReplicaError(
+                    "adopt rejected: no healthy replica")
+            rep = healthy[0]
+            got = rep.scheduler.adopt_reserve(list(prompt),
+                                              int(max_new_tokens))
+            if got is None:
+                return None, None
+            req, need = got
+            h = ServingHandle(self._uid, list(prompt), int(max_new_tokens),
+                              klass, self.clock(), self,
+                              self.params.stream_buffer)
+            self._uid += 1
+            h.request = req
+            h.status = "adopting"
+            h.replica_id = rep.id
+            h.pinned_replica = rep.id
+            return h, need
+
+    def adopt_commit(self, handle: ServingHandle, first_token: int,
+                     inject_fn=None) -> None:
+        """The transferred pages arrived (verified): write them into
+        the pool (``inject_fn`` runs under the front-end lock — the
+        pump must not step the engine mid-write) and seat the request
+        RUNNING.  Token delivery flows through the normal pump."""
+        with self._lock:
+            rep = self._replica_by_id(handle.pinned_replica)
+            if rep is None or not rep.healthy():
+                raise NoHealthyReplicaError(
+                    "adopt_commit: adopting replica died mid-transfer")
+            if inject_fn is not None:
+                inject_fn()
+            rep.scheduler.adopt_commit(handle.request, int(first_token),
+                                       self.params.eos_token_id)
+            handle.status = "running"
+            handle.admitted_at = self.clock()
+            rep.active.append(handle)
+
+    def adopt_abort(self, handle: ServingHandle,
+                    error: Optional[BaseException] = None) -> None:
+        """Transfer failed: release the reservation and fail the
+        handle (the caller re-routes at ITS layer with a fresh one)."""
+        with self._lock:
+            rep = self._replica_by_id(handle.pinned_replica)
+            if rep is not None and handle.request is not None:
+                rep.scheduler.adopt_abort(handle.request)
+            handle._finish("failed", error)
+
     def cancel(self, handle: ServingHandle) -> None:
         with self._lock:
             if handle.status == "queued":
@@ -308,6 +437,13 @@ class ServingFrontend:
                     rep.scheduler.cancel(handle.request)
                     if handle in rep.active:
                         rep.active.remove(handle)
+                self.metrics.inc("cancelled")
+                handle._finish("cancelled")
+            elif handle.status == "adopting":
+                # reserved for a KV transfer that no longer matters
+                rep = self._replica_by_id(handle.pinned_replica)
+                if rep is not None and handle.request is not None:
+                    rep.scheduler.adopt_abort(handle.request)
                 self.metrics.inc("cancelled")
                 handle._finish("cancelled")
 
@@ -545,17 +681,22 @@ class ServingFrontend:
         RUNNING background request; True when a preemption happened."""
         head = self._queues["interactive"][0]
         preempted = False
+        # under the HBM-headroom floor the victim's pages are RELEASED
+        # (cached-free tier), not retained — so preemption can help a
+        # page-blocked head too, and HBM actually shrinks
+        release = (self.params.preempt_release_pages
+                   and self._headroom_degraded())
         for rep in self.router.healthy():
             if rep.scheduler.can_admit(head.prompt, head.max_new_tokens):
                 return False  # admissible without preemption
         for rep in self.router.healthy():
-            if not rep.scheduler.can_admit(head.prompt,
-                                           head.max_new_tokens,
-                                           ignore_slots=True):
+            if not release and not rep.scheduler.can_admit(
+                    head.prompt, head.max_new_tokens, ignore_slots=True):
                 # the head is page-blocked here, not slot-blocked:
-                # preemption retains the victim's KV pages, so bumping
-                # it cannot free what the head needs — let the running
-                # work finish and release its pages instead
+                # retaining preemption keeps the victim's KV pages
+                # resident, so bumping it cannot free what the head
+                # needs — let the running work finish and release its
+                # pages instead
                 continue
             victims = [h for h in rep.active
                        if h.klass == "background" and h.request is not None
@@ -566,11 +707,22 @@ class ServingFrontend:
             # bump the request expected to hold its slot longest: decode
             # with the most remaining budget first, else a prefill
             victim = max(victims, key=lambda h: h.request.remaining_budget)
-            rep.scheduler.preempt(victim.request)
-            rep.active.remove(victim)
-            victim.status = "queued"
-            victim.preempted = True
-            self._queues["background"].insert(0, victim)
+            if release:
+                pages = rep.scheduler.preempt_release(victim.request)
+                rep.active.remove(victim)
+                # the request object is retired with its pages: the
+                # handle replays through a fresh admission, where the
+                # prefix trie revives what the cached tier still holds
+                # and delivery splices past the high-water mark
+                self._reset_for_replay(victim)
+                self._queues["background"].insert(0, victim)
+                self.metrics.inc("preempt_pages_released", pages)
+            else:
+                rep.scheduler.preempt(victim.request)
+                rep.active.remove(victim)
+                victim.status = "queued"
+                victim.preempted = True
+                self._queues["background"].insert(0, victim)
             self.metrics.inc("preemptions")
             preempted = True
             break

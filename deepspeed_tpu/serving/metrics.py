@@ -59,10 +59,17 @@ class ServingMetrics:
         self.tpot = {c: LatencyTracker(window) for c in CLASSES}
         self.tokens = {c: 0 for c in CLASSES}
         self.completed = {c: 0 for c in CLASSES}
+        #: disaggregated-mode TTFT attribution: where the first token's
+        #: latency went (prefill replica / KV-page transfer / decode
+        #: replica's first burst)
+        self.disagg = {k: LatencyTracker(window)
+                       for k in ("prefill_ms", "transfer_ms", "decode_ms")}
         self.counters: Dict[str, int] = {
             "submitted": 0, "cancelled": 0, "failed": 0,
-            "preemptions": 0, "requeued_replica_death": 0,
+            "preemptions": 0, "preempt_pages_released": 0,
+            "requeued_replica_death": 0,
             "admission_deferred_headroom": 0,
+            "disagg_requests": 0,
         }
 
     def inc(self, name: str, v: int = 1) -> None:
@@ -70,6 +77,29 @@ class ServingMetrics:
 
     def record_ttft(self, klass: str, ms: float) -> None:
         self.ttft[klass].observe(ms)
+
+    def record_disagg(self, breakdown: Dict[str, float],
+                      count: bool = True) -> None:
+        """One disaggregated request's TTFT attribution (ms per
+        stage); missing stages are skipped.  ``count=False`` records a
+        late-arriving stage (decode_ms lands with the first decoded
+        token) without double-counting the request."""
+        if count:
+            self.counters["disagg_requests"] += 1
+        for k, tracker in self.disagg.items():
+            v = breakdown.get(k)
+            if v is not None:
+                tracker.observe(float(v))
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel.enabled:
+            for k, tracker in self.disagg.items():
+                if tracker.count:
+                    tel.set_gauge(
+                        f"serving/disagg_ttft_{k.replace('_ms', '')}_p50_ms",
+                        tracker.percentile(50),
+                        help="disaggregated TTFT attribution p50 by stage")
 
     def record_completion(self, klass: str, n_tokens: int,
                           gen_time_s: float) -> None:
@@ -113,4 +143,8 @@ class ServingMetrics:
                           "tpot": self.tpot[c].summary(),
                           "tokens": self.tokens[c],
                           "completed": self.completed[c]}
-        return {"classes": classes, "counters": dict(self.counters)}
+        out = {"classes": classes, "counters": dict(self.counters)}
+        if self.counters.get("disagg_requests"):
+            out["disagg_ttft"] = {k: t.summary()
+                                  for k, t in self.disagg.items()}
+        return out

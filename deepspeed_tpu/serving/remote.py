@@ -1,0 +1,851 @@
+"""Network replica routing — live endpoints instead of in-process
+replicas (ISSUE 14 tentpole b).
+
+:class:`NetworkFrontend` is the PR-8 front-end's control loop rewired
+to real worker processes: the same latency-class queues, strict-
+priority admission, prefix-affinity placement and drain-and-requeue —
+but every replica is a :class:`ReplicaEndpoint` (a JSON-line socket to
+a :class:`~.worker.ServingWorker` process), health is a live ``ping``
+with a bounded timeout, and a replica *death* is a real dead socket
+(``kill -9`` included): in-flight handles re-queue onto survivors and
+delivery splices past the streamed high-water mark (exact under greedy
+decode — both the synthetic engine and temperature-0 real engines
+regenerate the identical sequence).
+
+Disaggregated mode: with prefill-role endpoints present, admission runs
+the prefill → KV-page-stream → decode pipeline instead of a plain
+submit — the first token is delivered the moment prefill returns (TTFT
+excludes the transfer), pages stream prefill→decode peer-to-peer, and
+the handle's ``ttft_breakdown`` attributes the tail
+(prefill/transfer/decode) for ``telemetry top`` and the SSE ``done``
+event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import log_dist, warn_once
+from .frontend import NoHealthyReplicaError, ServingHandle, ServingParams
+from .metrics import CLASSES, ServingMetrics
+from .worker import SRV_PREFIX
+
+
+def jsonline_rpc(endpoint: str, requests: List[Dict[str, Any]],
+                 timeout: float = 30.0) -> List[Dict[str, Any]]:
+    """Send ``requests`` over ONE connection to a JSON-line server
+    (worker or tier-2 replica protocol); returns the replies in order.
+    No retries — a dead peer raises ``ConnectionError``/``OSError`` for
+    the caller's drain/fallthrough logic."""
+    host, _, port = endpoint.rpartition(":")
+    out: List[Dict[str, Any]] = []
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        f = s.makefile("rwb")
+        try:
+            for req in requests:
+                f.write((json.dumps(req) + "\n").encode())
+                f.flush()
+                line = f.readline()
+                if not line:
+                    raise ConnectionError(
+                        f"worker {endpoint} closed the connection")
+                out.append(json.loads(line))
+        finally:
+            f.close()
+    return out
+
+
+@dataclasses.dataclass
+class NetworkParams:
+    """Network-plane knobs (the ``serving.network.*`` config group maps
+    onto this; tests construct it directly)."""
+
+    rpc_timeout_s: float = 30.0
+    #: health-probe timeout — a worker that cannot answer ``ping``
+    #: within this is dead for the round
+    probe_timeout_s: float = 2.0
+    #: ping cadence: probes cost a fresh TCP connection per endpoint,
+    #: and an idle pump loops ~200x/s — probe at most this often
+    #: (transport failures on submit/poll mark an endpoint dead
+    #: instantly regardless)
+    probe_every_s: float = 1.0
+    #: pump-thread idle sleep / run_until_idle backoff
+    poll_interval_s: float = 0.005
+    #: (the 429 token-budget backpressure knobs live in
+    #: FrontDoorParams — the HTTP layer owns shedding)
+    kv_chunk_bytes: int = 64 * 1024
+    #: run the prefill->transfer->decode pipeline when prefill-role
+    #: endpoints are present
+    disaggregate: bool = False
+
+
+class ReplicaEndpoint:
+    """One worker process behind the network router."""
+
+    def __init__(self, eid: str, endpoint: str, role: str = "mixed",
+                 probe_timeout_s: float = 2.0,
+                 rpc_timeout_s: float = 30.0):
+        self.id = str(eid)
+        self.endpoint = str(endpoint)
+        self.role = str(role)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self._dead_reason: Optional[str] = None
+        self._probe_round = 0
+        self._probe_seen = -1
+        self._probe_ok = True
+
+    def rpc(self, requests: List[Dict[str, Any]],
+            timeout: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One protocol exchange; a transport failure marks the
+        endpoint dead (sticky) and re-raises for the caller's drain."""
+        try:
+            return jsonline_rpc(self.endpoint, requests,
+                                timeout=timeout or self.rpc_timeout_s)
+        except (ConnectionError, OSError) as e:
+            self.mark_dead(f"rpc failed: {e!r}")
+            raise
+
+    def new_round(self, gen: int) -> None:
+        self._probe_round = gen
+
+    def healthy(self) -> bool:
+        if self._dead_reason is not None:
+            return False
+        if self._probe_seen != self._probe_round:
+            self._probe_seen = self._probe_round
+            try:
+                r = jsonline_rpc(self.endpoint, [{"op": "ping"}],
+                                 timeout=self.probe_timeout_s)[0]
+                self._probe_ok = bool(r.get("ok"))
+                if not self._probe_ok:
+                    self._dead_reason = f"ping refused: {r.get('err')}"
+            except (ConnectionError, OSError) as e:
+                self._probe_ok = False
+                self._dead_reason = f"ping failed: {e!r}"
+        return self._probe_ok
+
+    def mark_dead(self, reason: str) -> None:
+        self._dead_reason = str(reason)
+
+    @property
+    def dead_reason(self) -> Optional[str]:
+        return self._dead_reason
+
+    def stats(self) -> Dict[str, Any]:
+        return self.rpc([{"op": "stats"}])[0].get("v", {})
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"id": self.id, "endpoint": self.endpoint, "role": self.role,
+               "healthy": self._dead_reason is None}
+        if self._dead_reason:
+            out["dead_reason"] = self._dead_reason
+        return out
+
+
+def discover_endpoints(client: Any,
+                       probe_timeout_s: float = 2.0,
+                       rpc_timeout_s: float = 30.0
+                       ) -> List[ReplicaEndpoint]:
+    """Worker endpoints from the rendezvous store's ``serving/srv/*``
+    registrations (workers self-register at boot, like the tier-2
+    replica servers)."""
+    eps: List[ReplicaEndpoint] = []
+    for key in sorted(client.keys(SRV_PREFIX)):
+        v = client.get(key)
+        if not isinstance(v, dict) or "endpoint" not in v:
+            continue
+        eps.append(ReplicaEndpoint(
+            key[len(SRV_PREFIX):], v["endpoint"],
+            role=v.get("role", "mixed"), probe_timeout_s=probe_timeout_s,
+            rpc_timeout_s=rpc_timeout_s))
+    return eps
+
+
+class NetworkFrontend:
+    """submit/stream/cancel over a fleet of worker processes.  The
+    surface mirrors :class:`~.frontend.ServingFrontend` (the HTTP front
+    door drives either interchangeably)."""
+
+    def __init__(self, endpoints: List[ReplicaEndpoint],
+                 params: Optional[ServingParams] = None,
+                 net: Optional[NetworkParams] = None,
+                 clock=time.monotonic):
+        if not endpoints:
+            raise ValueError("network front-end needs at least one "
+                             "worker endpoint")
+        self.endpoints = list(endpoints)
+        self.params = params or ServingParams()
+        self.net = net or NetworkParams()
+        # the front-end owns its endpoints' transport knobs: every
+        # construction site (serve CLI, bench, discovery) builds bare
+        # ReplicaEndpoints, so the configured serving.network timeouts
+        # must land HERE or they are dead config
+        for e in self.endpoints:
+            e.probe_timeout_s = self.net.probe_timeout_s
+            e.rpc_timeout_s = self.net.rpc_timeout_s
+        self.clock = clock
+        self.metrics = ServingMetrics()
+        self._queues: Dict[str, List[ServingHandle]] = {
+            c: [] for c in CLASSES}
+        #: endpoint id -> in-flight handles placed there
+        self._active: Dict[str, List[ServingHandle]] = {}
+        self._uid = 0
+        self._round = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._drained: set = set()
+        #: (block_size, num_blocks, max_seq_len) learned from the first
+        #: reachable worker — local request validation without an RPC
+        #: per submit
+        self._geometry: Optional[Dict[str, int]] = None
+        #: (round, rate) memo — the hit rate costs one stats RPC per
+        #: endpoint, far too much for every pump round
+        self._hit_rate_memo = (-1, 0.0)
+        self._hit_rate_every = 50
+        #: probe generation + cadence stamp (see net.probe_every_s)
+        self._probe_gen = 0
+        self._last_probe_mono = 0.0
+
+    # -- fleet views ---------------------------------------------------------
+
+    def _serving_endpoints(self) -> List[ReplicaEndpoint]:
+        """Endpoints that accept whole requests (prefill-only ones
+        serve the disaggregation pipeline, never plain submits)."""
+        return [e for e in self.endpoints
+                if e.role != "prefill" and e.healthy()]
+
+    def _prefill_endpoints(self) -> List[ReplicaEndpoint]:
+        return [e for e in self.endpoints
+                if e.role == "prefill" and e.healthy()]
+
+    def healthy_count(self) -> int:
+        return sum(1 for e in self.endpoints if e.dead_reason is None)
+
+    def _geom(self) -> Optional[Dict[str, int]]:
+        if self._geometry is None:
+            for ep in self.endpoints:
+                if ep.dead_reason is not None:
+                    continue
+                try:
+                    s = ep.stats()
+                except (ConnectionError, OSError):
+                    continue
+                if "block_size" in s:
+                    self._geometry = {
+                        "block_size": int(s["block_size"]),
+                        "num_blocks": int(s["num_blocks"]),
+                        "max_seq_len": int(s["max_seq_len"])}
+                    break
+        return self._geometry
+
+    # -- request surface ------------------------------------------------------
+
+    def validate(self, prompt: List[int], max_new_tokens: int) -> None:
+        """Front-door validation without a worker round-trip: the
+        structural checks always, the pool-geometry checks once a
+        worker has told us its cache shape."""
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            raise ValueError("prompt: must be a non-empty token list")
+        if not all(isinstance(t, int) and not isinstance(t, bool)
+                   for t in prompt):
+            raise ValueError("prompt: every token must be an integer")
+        if int(max_new_tokens) <= 0:
+            raise ValueError(
+                f"max_new_tokens: must be >= 1, got {max_new_tokens}")
+        g = self._geom()
+        if g is not None:
+            total = len(prompt) + int(max_new_tokens)
+            if total > g["max_seq_len"]:
+                raise ValueError(
+                    f"request of {total} tokens exceeds max_seq_len "
+                    f"{g['max_seq_len']}")
+            need = -(-total // g["block_size"])
+            if need > g["num_blocks"] - 1:
+                raise ValueError(
+                    f"request needs {need} pages but the pool only has "
+                    f"{g['num_blocks'] - 1}")
+
+    def queued_tokens(self, klass: str) -> int:
+        with self._lock:
+            return sum(len(h.prompt) + h.max_new_tokens
+                       for h in self._queues.get(klass, ()))
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 64,
+               klass: str = "interactive") -> ServingHandle:
+        if klass not in CLASSES:
+            raise ValueError(f"klass: unknown latency class {klass!r} "
+                             f"(one of {', '.join(CLASSES)})")
+        self.validate(prompt, max_new_tokens)
+        with self._lock:
+            if not any(e.dead_reason is None for e in self.endpoints
+                       if e.role != "prefill"):
+                raise NoHealthyReplicaError(
+                    "submit rejected: no live serving worker "
+                    + "; ".join(f"{e.id}: {e.dead_reason}"
+                                for e in self.endpoints))
+            h = ServingHandle(self._uid, list(prompt), int(max_new_tokens),
+                              klass, self.clock(), self,
+                              self.params.stream_buffer)
+            self._uid += 1
+            self._queues[klass].append(h)
+            self.metrics.inc("submitted")
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                f"serving/{klass}_submitted",
+                help="requests submitted per latency class")
+            return h
+
+    def cancel(self, handle: ServingHandle) -> None:
+        with self._lock:
+            if handle.status == "queued":
+                try:
+                    self._queues[handle.klass].remove(handle)
+                except ValueError:
+                    pass
+                self.metrics.inc("cancelled")
+                handle._finish("cancelled")
+            elif handle.status == "admitting":
+                # mid-pipeline: the admitting pump finalizes the
+                # cancel (it may still have to tear down a remote
+                # seat) — consumers get their _DONE from there
+                handle._cancel_requested = True
+                return
+            elif handle.status == "running":
+                ep = self._endpoint_by_id(handle.replica_id)
+                if ep is not None:
+                    try:
+                        ep.rpc([{"op": "cancel",
+                                 "rid": getattr(handle, "rid", "")}])
+                    except (ConnectionError, OSError) as e:
+                        warn_once("serving/net-cancel",
+                                  f"remote cancel failed ({e!r})")
+                    lst = self._active.get(ep.id)
+                    if lst is not None and handle in lst:
+                        lst.remove(handle)
+                self.metrics.inc("cancelled")
+                handle._finish("cancelled")
+
+    # -- the pump -------------------------------------------------------------
+
+    def pump(self) -> int:
+        """One network serving round: probe, drain dead endpoints,
+        admit (colocated or disaggregated), poll token streams.
+        Returns tokens delivered — 0 means idle.
+
+        Lock discipline: the health probes, the token polls, and the
+        disaggregated admission pipeline (whose KV-page transfer can
+        take seconds) run OUTSIDE ``self._lock``, so one stalled peer
+        cannot block ``submit``/``cancel``/``queued_tokens`` (every
+        front-door request) behind the pump.  Plain-mode admission RPCs
+        still run under the lock: a worker ``submit`` is host-side
+        bookkeeping, answered in microseconds, and the first transport
+        failure marks the endpoint dead."""
+        with self._lock:
+            self._round += 1
+        self._maybe_probe()
+        claim = None
+        with self._lock:
+            self._drain_dead()
+            # healthy() below is memoized for this round — no I/O here
+            if not self._serving_endpoints():
+                if any(self._queues.values()):
+                    self._fail_pending_no_replica()
+                return 0
+            if self.net.disaggregate and self._prefill_endpoints():
+                claim = self._claim_head()
+            else:
+                self._admit_all()
+        if claim is not None:
+            self._admit_claimed(claim)
+        n = self._poll_all()
+        with self._lock:
+            self._drain_dead()  # a poll may have found a dead socket
+            last_round, rate = self._hit_rate_memo
+            if self._round - last_round >= self._hit_rate_every:
+                rate = self._aggregate_hit_rate()
+                self._hit_rate_memo = (self._round, rate)
+            self.metrics.publish(
+                {c: len(q) for c, q in self._queues.items()}, rate)
+        return n
+
+    def _maybe_probe(self) -> None:
+        """Cadence-gated fleet ping (a fresh TCP connection per
+        endpoint — see ``net.probe_every_s``); runs WITHOUT the main
+        lock.  Only the pump/run_until_idle driver calls this."""
+        now = time.monotonic()
+        if now - self._last_probe_mono < self.net.probe_every_s:
+            return
+        self._last_probe_mono = now
+        self._probe_gen += 1
+        for ep in self.endpoints:
+            ep.new_round(self._probe_gen)
+            ep.healthy()
+
+    def run_until_idle(self, max_rounds: int = 100_000) -> None:
+        for _ in range(max_rounds):
+            # probe (not just the sticky flag): pending work with the
+            # whole fleet dead must raise promptly, like the
+            # in-process front-end — consumers unblock first.  Between
+            # probe cadences, transport failures inside pump() mark
+            # endpoints dead and the next iteration raises.
+            self._maybe_probe()
+            with self._lock:
+                pending = (any(self._queues.values())
+                           or any(self._active.values()))
+                if not pending:
+                    return
+                if not any(e.role != "prefill"
+                           and e.dead_reason is None
+                           for e in self.endpoints):
+                    self._drain_dead()
+                    self._fail_pending_no_replica()
+                    raise NoHealthyReplicaError(
+                        "pending serving work but no live worker")
+            if self.pump() == 0:
+                time.sleep(self.net.poll_interval_s)
+        raise RuntimeError(f"run_until_idle: no quiescence in "
+                           f"{max_rounds} rounds")
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name="ds-serving-net-frontend")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=10.0)
+
+    def close(self) -> None:
+        self.stop()
+
+    def _serve_loop(self) -> None:
+        log_dist("network serving front-end loop started")
+        while not self._stop.is_set():
+            try:
+                n = self.pump()
+            except Exception as e:
+                warn_once("serving/net-pump", f"pump error ({e!r})")
+                n = 0
+            if n == 0:
+                self._stop.wait(self.net.poll_interval_s)
+
+    # -- internals (lock held) ------------------------------------------------
+
+    def _endpoint_by_id(self, eid: Optional[str]
+                        ) -> Optional[ReplicaEndpoint]:
+        for e in self.endpoints:
+            if e.id == eid:
+                return e
+        return None
+
+    def _outstanding(self, ep: ReplicaEndpoint) -> int:
+        with self._lock:  # reentrant: also called from locked paths
+            return sum(len(h.prompt) + h.max_new_tokens - h.consumed
+                       for h in self._active.get(ep.id, ()))
+
+    def _requeue(self, h: ServingHandle) -> None:
+        """Replica death / torn pipeline: replay the request from its
+        prompt elsewhere — delivery resumes past ``h.delivered``."""
+        self._reset_replay_cursor(h)
+        self._queues[h.klass].insert(0, h)
+
+    def _reset_replay_cursor(self, h: ServingHandle) -> None:
+        h.replays += 1
+        h.consumed = 0
+        h.status = "queued"
+        h.replica_id = None
+        # the dead pipeline's attribution must not leak into the
+        # replay (which may run colocated): a stale _transfer_done_at
+        # would stamp death-detection + replay time as "decode_ms"
+        h.ttft_breakdown = None
+        h._transfer_done_at = None
+
+    def _drain_dead(self) -> None:
+        for ep in self.endpoints:
+            if ep.dead_reason is None or ep.id in self._drained:
+                continue
+            self._drained.add(ep.id)
+            moved = 0
+            for h in self._active.pop(ep.id, []):
+                self._requeue(h)
+                moved += 1
+            if moved:
+                self.metrics.inc("requeued_replica_death", moved)
+            log_dist(f"serving: worker {ep.id} drained "
+                     f"({ep.dead_reason}); {moved} requests re-queued")
+
+    def _fail_pending_no_replica(self) -> None:
+        err = NoHealthyReplicaError(
+            "all serving workers dead: "
+            + "; ".join(f"{e.id}: {e.dead_reason}"
+                        for e in self.endpoints))
+        n = 0
+        for q in self._queues.values():
+            for h in q:
+                self.metrics.inc("failed")
+                h._finish("failed", err)
+                n += 1
+            q.clear()
+        log_dist(f"serving: failed {n} pending requests — "
+                 f"no live worker")
+
+    def _admit_all(self) -> None:
+        for klass in CLASSES:
+            q = self._queues[klass]
+            while q:
+                if not self._try_admit(q[0]):
+                    break
+                q.pop(0)
+            if q and any(self._active.values()):
+                # strict priority: a blocked class head blocks lower
+                # classes while ANY work is in flight (its completions
+                # free the capacity the head waits on)
+                break
+
+    def _try_admit(self, h: ServingHandle) -> bool:
+        h.rid = f"{h.uid}.{h.replays}"
+        return self._admit_plain(h)
+
+    def _claim_head(self) -> Optional[ServingHandle]:
+        """Disaggregated-mode admission: pop the highest-class head
+        under the lock, run its pipeline OUTSIDE it (lock held by the
+        caller)."""
+        for klass in CLASSES:
+            q = self._queues[klass]
+            if q:
+                h = q.pop(0)
+                h.status = "admitting"
+                return h
+        return None
+
+    def _admit_claimed(self, h: ServingHandle) -> None:
+        """Run the claimed head's admission with no lock held; seat /
+        terminal-fail / re-queue under short lock grabs at the end.  A
+        ``cancel`` issued mid-pipeline is finalized here."""
+        h.rid = f"{h.uid}.{h.replays}"
+        if self.net.disaggregate and self._prefill_endpoints():
+            ok = self._admit_disagg(h)
+        else:
+            ok = self._admit_plain(h)
+        with self._lock:
+            if getattr(h, "_cancel_requested", False) \
+                    and h.status in ("admitting", "queued", "running"):
+                ep = self._endpoint_by_id(h.replica_id)
+                if ep is not None:
+                    try:
+                        ep.rpc([{"op": "cancel", "rid": h.rid}])
+                    except (ConnectionError, OSError) as e:
+                        warn_once("serving/net-cancel",
+                                  f"remote cancel failed ({e!r})")
+                    lst = self._active.get(ep.id)
+                    if lst is not None and h in lst:
+                        lst.remove(h)
+                self.metrics.inc("cancelled")
+                h._finish("cancelled")
+                return
+            if not ok and h.status in ("admitting", "queued"):
+                # capacity / torn pipeline: back to the class front for
+                # the next round ("queued" = a torn pipeline already
+                # reset the replay cursor)
+                h.status = "queued"
+                self._queues[h.klass].insert(0, h)
+
+    def _admit_plain(self, h: ServingHandle) -> bool:
+        # cheap local budget screen FIRST: a saturated fleet (the
+        # normal overload state) must cost zero match RPCs per retry
+        candidates = [
+            ep for ep in self._serving_endpoints()
+            if (self._outstanding(ep) + len(h.prompt) + h.max_new_tokens
+                <= self.params.max_outstanding_tokens)]
+        # then prefix affinity (one match RPC per surviving candidate)
+        # -> least outstanding -> stable id: the PR-8 placement order
+        scored = []
+        for ep in candidates:
+            affinity = self._affinity_of(ep, h.prompt)
+            if affinity < self.params.affinity_min_tokens:
+                affinity = 0  # one hot block must not pin placement
+            scored.append((-affinity, self._outstanding(ep), ep.id, ep))
+        for ep in [t[-1] for t in sorted(scored, key=lambda t: t[:3])]:
+            try:
+                r = ep.rpc([{"op": "submit", "rid": h.rid,
+                             "prompt": h.prompt,
+                             "max_new_tokens": h.max_new_tokens,
+                             "klass": h.klass}])[0]
+            except (ConnectionError, OSError):
+                continue
+            if r.get("ok"):
+                self._seat(h, ep)
+                return True
+            if r.get("kind") == "validation":
+                self._fail_terminal(h, ValueError(str(r.get("err"))))
+                return True  # leaves the queue — terminally invalid
+        return False
+
+    def _seat(self, h: ServingHandle, ep: ReplicaEndpoint) -> None:
+        with self._lock:  # reentrant: also called from locked paths
+            h.status = "running"
+            h.replica_id = ep.id
+            h.admitted_at = self.clock()
+            self._active.setdefault(ep.id, []).append(h)
+
+    def _fail_terminal(self, h: ServingHandle, err: Exception) -> None:
+        with self._lock:
+            self.metrics.inc("failed")
+            h._finish("failed", err)
+
+    def _admit_disagg(self, h: ServingHandle) -> bool:
+        """prefill replica -> KV-page stream -> decode replica.  The
+        first token is delivered as soon as prefill returns; a torn
+        pipeline re-queues the handle and the replay splices."""
+        if h.max_new_tokens < 2:
+            # a one-token request IS its prefill — nothing to
+            # disaggregate; the prefill worker's +1-token parking
+            # budget (put(prompt, 2)) would also push a boundary-valid
+            # request (len+1 == max_seq_len) over the pool's limits
+            return self._admit_plain(h)
+        pres = sorted(
+            self._prefill_endpoints(),
+            key=lambda e: (-self._affinity_of(e, h.prompt), e.id))
+        decs = self._serving_endpoints()
+        if not pres or not decs:
+            # prefill fleet gone: colocated fallback keeps serving
+            return self._admit_plain(h)
+        pre = pres[0]
+        try:
+            r = pre.rpc([{"op": "prefill", "rid": h.rid,
+                          "prompt": h.prompt,
+                          "max_new_tokens": h.max_new_tokens}])[0]
+        except (ConnectionError, OSError):
+            return False
+        if not r.get("ok"):
+            if r.get("kind") == "validation":
+                self._fail_terminal(h, ValueError(str(r.get("err"))))
+                return True
+            return False
+        first = int(r["first_token"])
+        adopted = None
+        for dec in sorted(decs, key=lambda e: (self._outstanding(e),
+                                               e.id)):
+            try:
+                rb = dec.rpc([{"op": "adopt_begin", "rid": h.rid,
+                               "prompt": h.prompt,
+                               "max_new_tokens": h.max_new_tokens,
+                               "first_token": first,
+                               "klass": h.klass}])[0]
+            except (ConnectionError, OSError):
+                continue
+            if rb.get("ok"):
+                adopted = (dec, list(rb.get("need", [])))
+                break
+            if rb.get("kind") == "validation":
+                self._release_prefill(pre, h.rid)
+                self._fail_terminal(h, ValueError(str(rb.get("err"))))
+                return True
+        if adopted is None:
+            self._release_prefill(pre, h.rid)
+            return False
+        dec, need = adopted
+        # TTFT is prefill-bound: the first token goes out NOW, the
+        # page stream rides behind it
+        if h.consumed == 0:
+            h.consumed = 1
+            if h.delivered < 1:
+                if h.first_token_at is None:
+                    h.first_token_at = self.clock()
+                    with self._lock:
+                        self.metrics.record_ttft(h.klass, h.ttft_ms)
+                h.delivered = 1
+                h._push(first)
+        t1 = self.clock()
+        try:
+            if need:
+                kv = pre.rpc([{"op": "kv_push", "rid": h.rid,
+                               "to": dec.endpoint, "pages": need,
+                               "chunk_bytes": self.net.kv_chunk_bytes}],
+                             timeout=self.net.rpc_timeout_s)[0]
+                if not kv.get("ok"):
+                    raise RuntimeError(f"kv_push refused: {kv.get('err')}")
+            dc = dec.rpc([{"op": "adopt_commit", "rid": h.rid}])[0]
+            if not dc.get("ok"):
+                raise RuntimeError(
+                    f"adopt_commit refused: {dc.get('err')}")
+        except (ConnectionError, OSError, RuntimeError) as e:
+            warn_once("serving/disagg-torn",
+                      f"disaggregated pipeline torn ({e!r}); replaying")
+            try:
+                dec.rpc([{"op": "adopt_abort", "rid": h.rid}])
+            except (ConnectionError, OSError):
+                pass
+            self._release_prefill(pre, h.rid)
+            self._requeue_inline(h)
+            return False
+        self._release_prefill(pre, h.rid)
+        t2 = self.clock()
+        h.ttft_breakdown = {
+            "prefill_ms": float(r.get("prefill_ms", 0.0)),
+            "transfer_ms": round((t2 - t1) * 1e3, 3)}
+        h._transfer_done_at = t2
+        self._seat(h, dec)
+        with self._lock:
+            self.metrics.record_disagg(h.ttft_breakdown)
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "serving/disagg_requests_total",
+            help="requests served through disaggregated prefill/decode")
+        return True
+
+    def _requeue_inline(self, h: ServingHandle) -> None:
+        """A torn pipeline leaves the handle AT the queue head (it was
+        never popped) — only reset its replay cursor."""
+        self._reset_replay_cursor(h)
+
+    def _affinity_of(self, ep: ReplicaEndpoint, prompt: List[int]) -> int:
+        if len(prompt) < self.params.affinity_min_tokens:
+            return 0
+        try:
+            r = ep.rpc([{"op": "match", "prompt": prompt}])[0]
+            return int(r.get("v", 0) or 0)
+        except (ConnectionError, OSError):
+            return 0
+
+    def _release_prefill(self, pre: ReplicaEndpoint, rid: str) -> None:
+        try:
+            pre.rpc([{"op": "release", "rid": rid}])
+        except (ConnectionError, OSError) as e:
+            warn_once("serving/prefill-release",
+                      f"prefill release failed ({e!r})")
+
+    def _poll_all(self) -> int:
+        """Poll every endpoint's in-flight streams.  Snapshot under
+        the lock, RPC outside it (a wedged peer must not stall the
+        submit path), re-apply under it — a reply for a handle that
+        was cancelled/re-queued mid-RPC is stale and dropped (the rid
+        or cursor no longer matches)."""
+        with self._lock:
+            batches = []
+            for ep in self.endpoints:
+                handles = self._active.get(ep.id)
+                if handles:
+                    batches.append(
+                        (ep, [(h, h.rid, h.consumed) for h in handles]))
+        polled = []
+        for ep, items in batches:
+            reqs = [{"op": "poll", "rid": rid, "cursor": cur}
+                    for _, rid, cur in items]
+            try:
+                polled.append((ep, items, ep.rpc(reqs)))
+            except (ConnectionError, OSError):
+                continue  # dead: the trailing _drain_dead re-queues
+        n = 0
+        with self._lock:
+            for ep, items, replies in polled:
+                handles = self._active.get(ep.id, [])
+                for (h, rid, cursor), r in zip(items, replies):
+                    if (h not in handles or h.rid != rid
+                            or h.consumed != cursor):
+                        continue  # moved on while the RPC was in flight
+                    if not r.get("ok"):
+                        if r.get("kind") == "unknown_rid":
+                            # worker restarted underneath us: replay
+                            handles.remove(h)
+                            self._requeue(h)
+                        continue
+                    n += self._deliver_remote(h, r)
+                    if r.get("done"):
+                        handles.remove(h)
+                        self._finish_remote(h, r)
+        return n
+
+    def _deliver_remote(self, h: ServingHandle, r: Dict[str, Any]) -> int:
+        delivered = 0
+        for tok in r.get("tokens", ()):
+            h.consumed += 1
+            if h.consumed > h.delivered:
+                if h.first_token_at is None:
+                    h.first_token_at = self.clock()
+                    self.metrics.record_ttft(h.klass, h.ttft_ms)
+                bd = h.ttft_breakdown
+                if bd is not None and "decode_ms" not in bd:
+                    t0 = getattr(h, "_transfer_done_at", None)
+                    if t0 is not None:
+                        bd["decode_ms"] = round(
+                            (self.clock() - t0) * 1e3, 3)
+                        self.metrics.record_disagg(
+                            {"decode_ms": bd["decode_ms"]}, count=False)
+                h.delivered += 1
+                h._push(int(tok))
+                delivered += 1
+        return delivered
+
+    def _finish_remote(self, h: ServingHandle, r: Dict[str, Any]) -> None:
+        status = str(r.get("status", "done"))
+        if status == "done":
+            h.finished_at = self.clock()
+            gen_s = (h.finished_at - (h.first_token_at or h.finished_at))
+            self.metrics.record_completion(h.klass, h.delivered, gen_s)
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                f"serving/{h.klass}_tokens", v=h.delivered,
+                help="generated tokens delivered per latency class")
+            h._finish("done")
+        elif status == "cancelled":
+            self.metrics.inc("cancelled")
+            h._finish("cancelled")
+        else:
+            self.metrics.inc("failed")
+            h._finish("failed",
+                      RuntimeError(str(r.get("error", "remote failure"))))
+
+    # -- introspection --------------------------------------------------------
+
+    def _aggregate_hit_rate(self) -> float:
+        hits = looks = 0
+        for ep in self.endpoints:
+            if ep.dead_reason is not None:
+                continue
+            try:
+                p = ep.stats().get("prefix")
+            except (ConnectionError, OSError):
+                continue
+            if p:
+                hits += int(p.get("hit_tokens", 0))
+                looks += int(p.get("lookup_tokens", 0))
+        return hits / looks if looks else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self.metrics.snapshot())
+            out["queues"] = {c: len(q) for c, q in self._queues.items()}
+            out["endpoints"] = [e.snapshot() for e in self.endpoints]
+            out["active"] = {eid: len(hs)
+                             for eid, hs in self._active.items() if hs}
+            last_round, rate = self._hit_rate_memo
+        if last_round < 0:
+            # never pumped: pay the stats RPCs once; after that the
+            # pump's memo keeps /v1/metrics scrapes RPC-free (a wedged
+            # worker must not stall the metrics endpoint 30s/scrape)
+            rate = self._aggregate_hit_rate()
+            with self._lock:
+                self._hit_rate_memo = (0, rate)
+        out["prefix_hit_rate"] = round(rate, 4)
+        return out

@@ -29,7 +29,7 @@ the deltas are exactly the serving-plane primitives:
 from __future__ import annotations
 
 from collections import deque
-from typing import List
+from typing import List, Optional
 
 from ..inference.v2.kv_cache import KVCacheConfig
 from ..inference.v2.scheduler import (RaggedScheduler, Request,
@@ -74,18 +74,31 @@ class ServingScheduler(RaggedScheduler):
             cap -= bs
         return max(cap, 0)
 
-    def _reserve(self, req: Request) -> bool:
+    def _shared_plan(self, prompt: List[int], max_new_tokens: int
+                     ) -> tuple:
+        """The ONE trie-match + reuse-cap + capacity accounting, shared
+        by ``_reserve``, ``can_admit`` and ``adopt_reserve`` so their
+        admission arithmetic can never diverge.  Read-only.  Returns
+        ``(shared_blocks, fresh_needed, reused_tokens, available)`` —
+        ``available`` already excludes the cached pages this very
+        request would revive (fresh allocations may reclaim cached
+        pages, but not the ones being re-acquired)."""
         bs = self.cache.block_size
-        matched = self.prefix.match(req.prompt)
-        reused = self._reuse_cap(len(req.prompt), len(matched) * bs)
+        matched = self.prefix.match(prompt)
+        reused = self._reuse_cap(len(prompt), len(matched) * bs)
         shared = matched[:reused // bs]
-        need = req.pages_needed(bs)
+        need = -(-(len(prompt) + max_new_tokens) // bs)
         fresh = need - len(shared)
-        # capacity: fresh pages may reclaim cached pages, EXCEPT the
-        # cached pages this very request is about to revive
-        cached_shared = sum(1 for b in shared if self.allocator.is_cached(b))
-        if fresh > (self.allocator.num_free
-                    + self.allocator.num_cached - cached_shared):
+        cached_shared = sum(1 for b in shared
+                            if self.allocator.is_cached(b))
+        avail = (self.allocator.num_free
+                 + self.allocator.num_cached - cached_shared)
+        return shared, fresh, reused, avail
+
+    def _reserve(self, req: Request) -> bool:
+        shared, fresh, reused, avail = self._shared_plan(
+            req.prompt, req.max_new_tokens)
+        if fresh > avail:
             return False
         # the reservation is committing — only now is the mid-block
         # divergence a real CoW.  A page-blocked head retries _reserve
@@ -109,15 +122,7 @@ class ServingScheduler(RaggedScheduler):
         page-blocked (it cannot: preempted KV stays resident)."""
         if not ignore_slots and self._free_slot() < 0:
             return False
-        bs = self.cache.block_size
-        matched = self.prefix.match(prompt)
-        reused = self._reuse_cap(len(prompt), len(matched) * bs)
-        shared = matched[:reused // bs]
-        need = -(-(len(prompt) + max_new_tokens) // bs)
-        fresh = need - len(shared)
-        cached_shared = sum(1 for b in shared if self.allocator.is_cached(b))
-        avail = (self.allocator.num_free
-                 + self.allocator.num_cached - cached_shared)
+        _, fresh, _, avail = self._shared_plan(prompt, max_new_tokens)
         return fresh + max(reserve_pages, 0) <= avail
 
     def match_tokens(self, prompt: List[int]) -> int:
@@ -178,13 +183,11 @@ class ServingScheduler(RaggedScheduler):
 
     # -- preemptible decode slots ------------------------------------------
 
-    def preempt(self, req: Request) -> None:
-        """Bump a RUNNING or PREFILL request out of its slot.  Pages
-        stay referenced (all KV written so far is intact), generated
-        tokens and the prefill cursor stay accepted; the caller
-        re-queues the request and later calls :meth:`resume`, which
-        continues decode — or the chunk lattice — exactly where it
-        stopped."""
+    def unseat(self, req: Request) -> None:
+        """:meth:`preempt` minus the SLO counters — the disaggregation
+        plane's "hold the pages, free the slot" primitive: a prefill
+        replica parks a just-prefilled request here while its KV pages
+        stream out to a decode replica, then :meth:`cancel`\\ s it."""
         if req.state is RequestState.PREFILL:
             self.prefilling.remove(req)
         elif req.state is not RequestState.RUNNING:
@@ -194,12 +197,57 @@ class ServingScheduler(RaggedScheduler):
         self.slots[req.slot] = None
         req.slot = -1
         req.state = RequestState.WAITING
+
+    def preempt(self, req: Request) -> None:
+        """Bump a RUNNING or PREFILL request out of its slot.  Pages
+        stay referenced (all KV written so far is intact), generated
+        tokens and the prefill cursor stay accepted; the caller
+        re-queues the request and later calls :meth:`resume`, which
+        continues decode — or the chunk lattice — exactly where it
+        stopped."""
+        self.unseat(req)
         self.preemptions += 1
         from ..telemetry import get_telemetry
 
         get_telemetry().inc_counter(
             "serving/preemptions",
             help="decode slots preempted for a higher latency class")
+
+    def preempt_release(self, req: Request) -> int:
+        """HBM-pressure preemption (ROADMAP 3e): bump the request AND
+        release its KV pages back through the refcounts — trie-indexed
+        prompt pages land in the cached-free LRU tier (immediately
+        reclaimable, revivable), everything else returns to the free
+        list.  The request object is RETIRED (state DONE): the caller
+        re-queues its *handle* for a fresh admission, whose ``_reserve``
+        re-matches the prefix trie and recomputes only what the cached
+        tier no longer holds.  Returns the number of pages released."""
+        if req.state is RequestState.PREFILL:
+            self.prefilling.remove(req)
+        elif req.state is not RequestState.RUNNING:
+            raise ValueError(
+                f"can only preempt RUNNING/PREFILL requests, uid "
+                f"{req.uid} is {req.state.value}")
+        released = len(req.blocks)
+        self._release(req)
+        req.blocks = []
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+        req.state = RequestState.DONE
+        self.preemptions += 1
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter(
+            "serving/preemptions",
+            help="decode slots preempted for a higher latency class")
+        tel.inc_counter(
+            "serving/preempt_pages_released_total", v=released,
+            help="KV pages released by HBM-pressure preemptions "
+                 "(cached-free tier keeps trie-indexed prompt pages "
+                 "revivable)")
+        return released
 
     def resume(self, req: Request) -> bool:
         """Re-seat a preempted request in a free slot; decode (or the
@@ -220,6 +268,74 @@ class ServingScheduler(RaggedScheduler):
         else:
             req.state = RequestState.RUNNING
         return True
+
+    # -- disaggregated prefill/decode adoption -----------------------------
+
+    def prompt_pages(self, prompt_len: int) -> int:
+        """Pages holding prompt KV (positions ``0..prompt_len-1``) —
+        the page set a disaggregated transfer must cover.  The final
+        page may be partial: decode's first write lands in it too, so
+        it ships whole."""
+        return -(-prompt_len // self.cache.block_size)
+
+    def adopt_reserve(self, prompt: List[int], max_new_tokens: int
+                      ) -> Optional[tuple]:
+        """Decode-side phase 1 of KV-page adoption: reserve pages + a
+        decode slot for a request whose prefill ran ELSEWHERE.  The
+        prompt is matched against the local prefix trie first — shared
+        pages already hold the right KV and are NOT re-transferred,
+        which is what makes the paged prefix cache a cluster-wide tier.
+        Returns ``(request, need)`` where ``need`` lists the
+        prompt-page indices the transfer must fill, or ``None`` when no
+        slot/pages are available (the caller re-queues).  The request
+        parks WAITING in its slot (inert to the planner) until
+        :meth:`adopt_commit` seats it RUNNING."""
+        self.validate(prompt, max_new_tokens)
+        slot = self._free_slot()
+        if slot < 0:
+            return None
+        shared, fresh, reused, avail = self._shared_plan(prompt,
+                                                         max_new_tokens)
+        if fresh > avail:
+            return None
+        self.prefix.acquire(shared)
+        req = Request(uid=self._uid, prompt=list(prompt),
+                      max_new_tokens=int(max_new_tokens))
+        self._uid += 1
+        req.blocks = shared + self.allocator.allocate(fresh)
+        req.prefilled = len(prompt)
+        req.slot = slot
+        self.slots[slot] = req
+        self.prefix.record_lookup(len(prompt), reused)
+        need = list(range(len(shared), self.prompt_pages(len(prompt))))
+        return req, need
+
+    def adopt_commit(self, req: Request, first_token: int,
+                     eos_token_id: Optional[int] = None) -> None:
+        """Phase 2: the transferred pages are in the pool — seat the
+        request RUNNING with the prefill replica's sampled first token
+        and index its prompt pages into the local trie (the next
+        same-prefix adoption transfers nothing)."""
+        if req.state is not RequestState.WAITING or req.slot < 0:
+            raise ValueError(
+                f"adopt_commit expects a reserved adoption (WAITING in "
+                f"a slot), uid {req.uid} is {req.state.value}")
+        req.state = RequestState.RUNNING
+        req.generated.append(int(first_token))
+        self._maybe_finish(req, int(first_token), eos_token_id)
+        if req.state is not RequestState.DONE:
+            self.prefix.insert(req.prompt, req.blocks)
+
+    def adopt_abort(self, req: Request) -> None:
+        """Transfer failed: give the reservation back (pages through
+        refcounts, slot freed) — the caller re-routes the request."""
+        if req.blocks:
+            self._release(req)
+            req.blocks = []
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+        req.state = RequestState.DONE
 
     # -- introspection -----------------------------------------------------
 

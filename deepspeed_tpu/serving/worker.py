@@ -1,0 +1,574 @@
+"""Process-per-replica serving backend (ISSUE 14 tentpole b).
+
+One :class:`ServingWorker` wraps ONE replica engine (real
+``RaggedInferenceEngineV2`` or the host-only synthetic engine) in a
+JSON-line TCP server — the same protocol family as the rendezvous store
+and the tier-2 replica transport (``resilience/replica_server.py``),
+which this is modeled on.  The network front door
+(:mod:`.remote`/:mod:`.frontdoor`) drives a fleet of these as real
+worker processes: ``kill -9`` one and the router drains it, requeues
+its in-flight work onto survivors, and the client stream splices past
+the delivered high-water mark.
+
+Roles:
+
+* ``mixed``   — accepts ``submit``/``poll``/``cancel`` (the replica's
+  own :class:`~.frontend.ServingFrontend` pumps a single local replica)
+  AND KV-page adoption, so a plain fleet needs no role split.
+* ``prefill`` — runs ``prefill`` only: prompt in, first token out, KV
+  pages parked (``unseat`` — slot freed, pages referenced) until
+  ``kv_push`` streams them to a decode peer and ``release`` lets go.
+  Completed prefills index the local trie, so a hot shared header is
+  computed once per prefill replica, ever.
+* ``decode``  — ``adopt_begin``/``kv_page_*``/``adopt_commit`` seat a
+  remotely-prefilled request over the transferred pages (trie-shared
+  pages skip the wire entirely), then ``poll`` streams its decode.
+
+Protocol (one JSON object per line, ``op``-dispatched; every reply
+carries ``ok``):
+
+=================  =====================================================
+``ping``           liveness + identity (id, role)
+``stats``          load view: outstanding tokens, kv pages, prefix
+                   stats, cache geometry (the router's placement inputs)
+``match``          prefix-affinity score for a prompt
+``submit``         queue a request (validation errors -> ``kind:
+                   validation`` so the front door can map them to 4xx)
+``poll``           tokens past a cursor + terminal status
+``cancel``         abort (any phase — queued, running, prefill-parked,
+                   mid-adoption)
+``prefill``        run a prompt to its first token, park the KV
+``kv_push``        stream parked pages to a decode endpoint (P2P)
+``release``        drop a parked prefill's pages (cached-free tier
+                   keeps the trie-indexed ones revivable)
+``adopt_begin``    reserve pages+slot for a remote prefill (returns the
+                   page indices the transfer must fill)
+``kv_page_begin/chunk/commit``  chunked upload, sha256-gated PER PAGE
+``adopt_commit``   seat the adopted request RUNNING
+``adopt_abort``    give the reservation back
+=================  =====================================================
+
+Worker processes register in the rendezvous store like the tier-2
+replica servers do (``serving/srv/<id>`` — endpoint, role, pid; index
+metadata only), heartbeat ``rdzv/hb/<id>``, and ship their telemetry
+registry through the PR-13 rollup (``push_node_telemetry``) so the
+merged cluster view labels every serving counter per replica process.
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import log_dist, warn_once
+from .frontend import ServingFrontend, ServingParams
+from .kv_transfer import (DEFAULT_KV_CHUNK_BYTES, PageStager, inject_pages,
+                          page_payload, push_pages)
+from .router import Replica
+
+#: store key prefix for worker registration (endpoint/role/pid — the
+#: same "store carries metadata only" posture as ``resil/srv``)
+SRV_PREFIX = "serving/srv/"
+
+
+class _WorkerTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _WorkerHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        import json
+
+        owner: "ServingWorker" = self.server.worker  # type: ignore
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+            except ValueError:
+                break
+            try:
+                out = owner.handle_request(req)
+            except Exception as e:  # a bad request must not kill the
+                out = {"ok": False, "err": repr(e)}  # serving thread
+            self.wfile.write((json.dumps(out) + "\n").encode())
+            self.wfile.flush()
+
+
+class ServingWorker:
+    """One replica engine behind a JSON-line socket; see module doc."""
+
+    #: abandoned-reservation expiry: a front door that dies between
+    #: ``prefill``/``adopt_begin`` and ``release``/``adopt_commit``
+    #: (the exact crash window the chaos tooling exercises) must not
+    #: hold this worker's decode slots and KV pages forever — with 4
+    #: slots, 4 orphaned adoptions would brick the worker.  Same
+    #: failure class as the tier-2 replica server's staged-upload
+    #: expiry (PR 11).  (Class attribute: a test seam.)
+    _reservation_ttl_s: float = 600.0
+
+    def __init__(self, engine: Any, worker_id: str, role: str = "mixed",
+                 host: str = "", port: int = 0,
+                 advertise_host: Optional[str] = None,
+                 serving_params: Optional[ServingParams] = None,
+                 kv_chunk_bytes: int = DEFAULT_KV_CHUNK_BYTES,
+                 rpc_timeout_s: float = 30.0,
+                 store_endpoint: Optional[str] = None,
+                 telemetry_push_every_s: float = 1.0,
+                 poll_drip: int = 0):
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"role: unknown worker role {role!r} "
+                             f"(one of mixed, prefill, decode)")
+        self.engine = engine
+        self.id = str(worker_id)
+        self.role = role
+        self.params = serving_params or ServingParams()
+        self.kv_chunk_bytes = int(kv_chunk_bytes)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        #: flow control: a poll returns at most this many new tokens
+        #: (0 = unbounded).  Chaos tests set it to keep long streams
+        #: genuinely in flight while they kill -9 the worker.
+        self.poll_drip = int(poll_drip)
+        #: rid -> {"handle", "buffer", "done"} (submit + adopted)
+        self._handles: Dict[str, Dict[str, Any]] = {}
+        #: rid -> {"req", "prompt", "prefill_ms"} (parked prefills)
+        self._prefills: Dict[str, Dict[str, Any]] = {}
+        #: rid -> {"handle", "need", "stager", "first_token"}
+        self._adopts: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        #: serializes the prefill role's direct engine drive (put ->
+        #: step* -> unseat must be atomic: a second prefill stepping
+        #: the engine could decode an un-parked request past its
+        #: budget and release the pages mid-extract)
+        self._engine_lock = threading.Lock()
+        self.frontend: Optional[ServingFrontend] = None
+        if role in ("mixed", "decode"):
+            self.frontend = ServingFrontend([Replica(engine, 0)],
+                                            params=self.params)
+            self.frontend.start()
+        self._srv = _WorkerTCPServer((host or "", int(port)),
+                                     _WorkerHandler)
+        self._srv.worker = self  # type: ignore[attr-defined]
+        self.port = int(self._srv.server_address[1])
+        self.host = (advertise_host or os.environ.get("DS_ELASTIC_HOST")
+                     or "127.0.0.1")
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True,
+                                        name=f"ds-serving-worker-{self.id}")
+        self._thread.start()
+        self._store = None
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if store_endpoint:
+            self._register(store_endpoint, telemetry_push_every_s)
+        log_dist(f"serving worker {self.id} ({role}) at {self.endpoint}")
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- store registration + telemetry push --------------------------------
+
+    def _register(self, store_endpoint: str, push_every_s: float) -> None:
+        from ..elasticity.rendezvous import RendezvousClient
+
+        self._store = RendezvousClient(store_endpoint)
+        self._store.set(SRV_PREFIX + self.id,
+                        {"endpoint": self.endpoint, "role": self.role,
+                         "pid": os.getpid()}, journal=True)
+        self._store.hb(f"rdzv/hb/{self.id}")
+
+        def _beat():
+            while not self._hb_stop.wait(push_every_s):
+                try:
+                    self._store.hb(f"rdzv/hb/{self.id}")
+                    from ..telemetry import push_node_telemetry
+
+                    push_node_telemetry(self._store, self.id)
+                except Exception as e:  # store down: degraded, retry
+                    warn_once("serving/worker-hb",
+                              f"worker heartbeat degraded ({e!r})")
+
+        self._hb_thread = threading.Thread(
+            target=_beat, daemon=True,
+            name=f"ds-serving-worker-hb-{self.id}")
+        self._hb_thread.start()
+
+    def shutdown(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        if self.frontend is not None:
+            self.frontend.close()
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception as e:
+                warn_once("serving/worker-store-close",
+                          f"store close failed ({e!r})")
+
+    # -- protocol ------------------------------------------------------------
+
+    def handle_request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "v": "serving-replica", "id": self.id,
+                    "role": self.role}
+        if op == "stats":
+            return {"ok": True, "v": self.stats()}
+        if op == "match":
+            return {"ok": True, "v": self._match(list(req["prompt"]))}
+        if op == "submit":
+            return self._op_submit(req)
+        if op == "poll":
+            return self._op_poll(req)
+        if op == "cancel":
+            return self._op_cancel(req)
+        if op == "prefill":
+            return self._op_prefill(req)
+        if op == "kv_push":
+            return self._op_kv_push(req)
+        if op == "release":
+            return self._op_release(req)
+        if op == "adopt_begin":
+            return self._op_adopt_begin(req)
+        if op in ("kv_page_begin", "kv_page_chunk", "kv_page_commit"):
+            return self._op_kv_page(op, req)
+        if op == "adopt_commit":
+            return self._op_adopt_commit(req)
+        if op == "adopt_abort":
+            return self._op_adopt_abort(req)
+        return {"ok": False, "err": f"bad op {op!r}"}
+
+    def stats(self) -> Dict[str, Any]:
+        sched = self.engine.scheduler
+        cc = self.engine.cache_config
+        out: Dict[str, Any] = {
+            "id": self.id, "role": self.role,
+            "block_size": int(cc.block_size),
+            "num_blocks": int(cc.num_blocks),
+            "max_seq_len": int(cc.max_seq_len),
+            "kv_pages_free": int(sched.allocator.num_free),
+        }
+        alloc = sched.allocator
+        if hasattr(alloc, "num_cached"):
+            out["kv_pages_cached"] = int(alloc.num_cached)
+        if hasattr(sched, "prefix"):
+            out["prefix"] = sched.prefix.stats()
+            out["preemptions"] = int(sched.preemptions)
+        if self.frontend is not None:
+            reps = self.frontend.router.replicas
+            out["outstanding_tokens"] = sum(r.outstanding_tokens()
+                                            for r in reps)
+            out["active"] = sum(len(r.active) for r in reps)
+        else:
+            with self._lock:
+                out["outstanding_tokens"] = sum(
+                    len(p["prompt"]) for p in self._prefills.values())
+                out["active"] = len(self._prefills)
+        return out
+
+    def _match(self, prompt: List[int]) -> int:
+        if self.frontend is not None:
+            return self.frontend.match_tokens(prompt)
+        with self._engine_lock:
+            sched = self.engine.scheduler
+            if hasattr(sched, "match_tokens"):
+                return int(sched.match_tokens(prompt))
+            return 0
+
+    # -- submit / poll / cancel ---------------------------------------------
+
+    def _op_submit(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if self.frontend is None:
+            return {"ok": False, "kind": "role",
+                    "err": f"worker {self.id} is prefill-only"}
+        rid = str(req["rid"])
+        try:
+            h = self.frontend.submit(list(req["prompt"]),
+                                     int(req.get("max_new_tokens", 64)),
+                                     str(req.get("klass", "interactive")))
+        except ValueError as e:
+            return {"ok": False, "kind": "validation", "err": str(e)}
+        with self._lock:
+            self._handles[rid] = {"handle": h, "buffer": [], "done": False}
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "serving/worker_requests_total",
+            help="requests accepted by this replica worker process")
+        return {"ok": True}
+
+    def _op_poll(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        cursor = max(0, int(req.get("cursor", 0)))
+        with self._lock:
+            ent = self._handles.get(rid)
+            if ent is None:
+                return {"ok": False, "kind": "unknown_rid",
+                        "err": f"no request {rid} on worker {self.id}"}
+            toks, done = ent["handle"].drain()
+            ent["buffer"].extend(toks)
+            if done:
+                ent["done"] = True
+            h = ent["handle"]
+            status = h.status if ent["done"] else \
+                ("running" if h.status in ("running", "adopting", "done")
+                 else h.status)
+            new = ent["buffer"][cursor:]
+            if self.poll_drip > 0:
+                new = new[:self.poll_drip]
+            fully_delivered = cursor + len(new) >= len(ent["buffer"])
+            out = {"ok": True, "tokens": new, "status": status,
+                   "done": ent["done"] and fully_delivered}
+            if out["done"]:
+                if h.error is not None:
+                    out["error"] = str(h.error)
+                # the terminal reply is the entry's last use — evict,
+                # or a long-lived worker leaks one handle + token
+                # buffer per request served.  (If this reply is lost
+                # on the wire, the router re-queues and replays — the
+                # splice keeps that correct, just not free.)
+                self._handles.pop(rid, None)
+            return out
+
+    def _op_cancel(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        with self._lock:
+            ent = self._handles.pop(rid, None)
+            pre = self._prefills.pop(rid, None)
+            self._adopts.pop(rid, None)
+        if ent is not None and self.frontend is not None:
+            self.frontend.cancel(ent["handle"])
+        if pre is not None:
+            with self._engine_lock:
+                self.engine.scheduler.cancel(pre["req"])
+        return {"ok": True}
+
+    # -- prefill side (disaggregation) ----------------------------------------
+
+    def _expire_reservations(self) -> None:
+        """Give back slots+pages whose front door vanished mid-pipeline
+        (see ``_reservation_ttl_s``).  Run at the reservation-pressure
+        points (``prefill``/``adopt_begin``), like the replica server's
+        staged-upload sweep."""
+        now = time.time()
+        with self._lock:
+            stale_pre = [rid for rid, e in self._prefills.items()
+                         if now - e["ts"] > self._reservation_ttl_s]
+            stale_ad = [rid for rid, e in self._adopts.items()
+                        if now - e["ts"] > self._reservation_ttl_s]
+            pres = [self._prefills.pop(rid) for rid in stale_pre]
+            ads = [self._adopts.pop(rid) for rid in stale_ad]
+            for rid in stale_ad:
+                self._handles.pop(rid, None)
+        for ent in pres:
+            with self._engine_lock:
+                self.engine.scheduler.cancel(ent["req"])
+        for ad in ads:
+            self.frontend.adopt_abort(ad["handle"])
+        if stale_pre or stale_ad:
+            warn_once("serving/worker-expire",
+                      f"worker {self.id}: expired "
+                      f"{len(stale_pre)} parked prefill(s) and "
+                      f"{len(stale_ad)} orphaned adoption(s) past "
+                      f"{self._reservation_ttl_s:.0f}s")
+
+    def _op_prefill(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if self.frontend is not None:
+            # a mixed/decode worker's pump thread owns the engine; a
+            # concurrent direct drive here would corrupt the planner
+            return {"ok": False, "kind": "role",
+                    "err": f"worker {self.id} ({self.role}) does not "
+                           f"run disaggregated prefills"}
+        self._expire_reservations()
+        rid = str(req["rid"])
+        prompt = list(req["prompt"])
+        t0 = time.perf_counter()
+        with self._engine_lock:
+            try:
+                # budget 2: covers every prompt page + the first
+                # sampled token; the decode side holds the REAL budget
+                r = self.engine.put(prompt, 2)
+            except ValueError as e:
+                return {"ok": False, "kind": "validation", "err": str(e)}
+            guard = 0
+            while not r.generated and r.state.value != "done":
+                self.engine.step(temperature=self.params.temperature,
+                                 eos_token_id=None)
+                guard += 1
+                if guard > 100_000:
+                    self.engine.scheduler.cancel(r)
+                    return {"ok": False,
+                            "err": "prefill made no progress"}
+            first = int(r.generated[0])
+            # park: slot freed, pages stay referenced for kv_push
+            self.engine.scheduler.unseat(r)
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._prefills[rid] = {"req": r, "prompt": prompt,
+                                   "prefill_ms": ms, "ts": time.time()}
+        n_pages = self.engine.scheduler.prompt_pages(len(prompt))
+        from ..telemetry import get_telemetry
+
+        get_telemetry().inc_counter(
+            "serving/worker_prefills_total",
+            help="disaggregated prefills run by this worker")
+        return {"ok": True, "first_token": first, "n_pages": n_pages,
+                "prefill_ms": round(ms, 3)}
+
+    def _op_kv_push(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        to = str(req["to"])
+        pages = [int(p) for p in req.get("pages", [])]
+        with self._lock:
+            ent = self._prefills.get(rid)
+        if ent is None:
+            return {"ok": False, "kind": "unknown_rid",
+                    "err": f"no parked prefill {rid}"}
+        t0 = time.perf_counter()
+        with self._engine_lock:
+            payloads = {i: page_payload(self.engine, ent["prompt"],
+                                        ent["req"].blocks, i)
+                        for i in pages}
+        from .remote import jsonline_rpc
+
+        chunk = int(req.get("chunk_bytes", self.kv_chunk_bytes))
+        out = push_pages(
+            lambda reqs: jsonline_rpc(to, reqs,
+                                      timeout=self.rpc_timeout_s),
+            rid, payloads, chunk_bytes=chunk)
+        out["transfer_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        out["ok"] = True
+        return out
+
+    def _op_release(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        with self._lock:
+            ent = self._prefills.pop(rid, None)
+        if ent is None:
+            return {"ok": False, "kind": "unknown_rid",
+                    "err": f"no parked prefill {rid}"}
+        with self._engine_lock:
+            # releases through refcounts: trie-indexed prompt pages
+            # land in the cached-free tier -> the next prefill of the
+            # same header revives them instead of recomputing
+            self.engine.scheduler.cancel(ent["req"])
+        return {"ok": True}
+
+    # -- decode side (adoption) ----------------------------------------------
+
+    def _op_adopt_begin(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if self.frontend is None:
+            return {"ok": False, "kind": "role",
+                    "err": f"worker {self.id} is prefill-only"}
+        self._expire_reservations()
+        rid = str(req["rid"])
+        try:
+            h, need = self.frontend.adopt_begin(
+                list(req["prompt"]), int(req["max_new_tokens"]),
+                str(req.get("klass", "interactive")))
+        except ValueError as e:
+            return {"ok": False, "kind": "validation", "err": str(e)}
+        if h is None:
+            return {"ok": False, "kind": "capacity",
+                    "err": "no free slot/pages for adoption"}
+        with self._lock:
+            self._adopts[rid] = {"handle": h, "need": list(need),
+                                 "stager": PageStager(),
+                                 "first_token": int(req["first_token"]),
+                                 "ts": time.time()}
+            self._handles[rid] = {"handle": h, "buffer": [], "done": False}
+        return {"ok": True, "need": list(need)}
+
+    def _op_kv_page(self, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        page = int(req["page"])
+        with self._lock:
+            ad = self._adopts.get(rid)
+            if ad is None:
+                return {"ok": False, "kind": "unknown_rid",
+                        "err": f"no adoption in progress for {rid}"}
+            stager: PageStager = ad["stager"]
+            try:
+                if op == "kv_page_begin":
+                    stager.begin(page, req)
+                elif op == "kv_page_chunk":
+                    stager.chunk(page, int(req["i"]), str(req["v"]))
+                else:
+                    nbytes = stager.commit(page)
+                    from ..telemetry import get_telemetry
+
+                    tel = get_telemetry()
+                    tel.inc_counter(
+                        "serving/kv_transfer_received_total",
+                        help="KV pages received and checksum-verified")
+                    tel.inc_counter(
+                        "serving/kv_transfer_received_bytes_total",
+                        v=nbytes,
+                        help="raw KV bytes received over the transfer")
+            except ValueError as e:
+                if op == "kv_page_commit":
+                    from ..telemetry import get_telemetry
+
+                    get_telemetry().inc_counter(
+                        "serving/kv_transfer_rejects_total",
+                        help="KV pages rejected at the checksum gate")
+                return {"ok": False, "kind": "checksum"
+                        if op == "kv_page_commit" else "protocol",
+                        "err": str(e)}
+        return {"ok": True}
+
+    def _op_adopt_commit(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        with self._lock:
+            # pop = an atomic claim (a concurrent duplicate commit for
+            # the same rid must see unknown_rid, never double-commit)
+            ad = self._adopts.pop(rid, None)
+            if ad is None:
+                return {"ok": False, "kind": "unknown_rid",
+                        "err": f"no adoption in progress for {rid}"}
+            missing = [p for p in ad["need"]
+                       if p not in ad["stager"].ready]
+            if missing:
+                self._adopts[rid] = ad  # un-claim: sender may retry
+                return {"ok": False, "kind": "incomplete",
+                        "err": f"pages {missing} not received/verified"}
+        h = ad["handle"]
+        skipped = (self.engine.scheduler.prompt_pages(len(h.prompt))
+                   - len(ad["need"]))
+        try:
+            self.frontend.adopt_commit(
+                h, ad["first_token"],
+                inject_fn=lambda: inject_pages(self.engine,
+                                               h.request.blocks,
+                                               ad["stager"].ready))
+        except Exception as e:
+            # a failed commit (bad payload dtype/shape, dead replica)
+            # must give the slot+pages back — the claim above already
+            # removed the entry, so the expiry sweep could never see it
+            with self._lock:
+                self._handles.pop(rid, None)
+            self.frontend.adopt_abort(h, error=e)
+            return {"ok": False, "kind": "commit", "err": repr(e)}
+        if skipped > 0:
+            from ..telemetry import get_telemetry
+
+            get_telemetry().inc_counter(
+                "serving/kv_transfer_skipped_pages_total", v=skipped,
+                help="prompt pages served from the local prefix trie "
+                     "instead of the wire (cluster-wide KV tier)")
+        return {"ok": True, "skipped_pages": skipped}
+
+    def _op_adopt_abort(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = str(req["rid"])
+        with self._lock:
+            ad = self._adopts.pop(rid, None)
+            self._handles.pop(rid, None)
+        if ad is not None:
+            self.frontend.adopt_abort(ad["handle"])
+        return {"ok": True}

@@ -36,8 +36,9 @@ SHARDS=(
   "tests/unit/perf"
   "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py tests/unit/test_overlap.py"
-  "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py"
+  "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py --ignore=tests/unit/multiprocess/test_serving_network.py"
   "tests/unit/multiprocess/test_chaos_control_plane.py -m chaos"
+  "tests/unit/multiprocess/test_serving_network.py -m chaos"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
 )
 
@@ -248,6 +249,25 @@ assert line["requests_completed"] == line["requests_submitted"] == 6, line
   echo "=== serving CLI smoke passed"
 else
   echo "=== serving CLI smoke FAILED"
+  fail=1
+fi
+
+# Front-door CLI smoke (ISSUE 14): `serve --dry-run` must boot the
+# HTTP/SSE front door over synthetic replicas, answer its own health
+# probe, and shut down cleanly — one parseable JSON line, exit 0.
+echo "=== front-door CLI smoke: serve --dry-run"
+frontdoor_line=$(JAX_PLATFORMS=cpu python -m deepspeed_tpu.serving serve \
+    --dry-run 2>/dev/null | tail -1)
+if echo "$frontdoor_line" | python -c '
+import json, sys
+
+line = json.loads(sys.stdin.read())
+assert line["ok"] is True, line
+assert line["healthz"]["healthy_replicas"] >= 1, line
+'; then
+  echo "=== front-door smoke passed"
+else
+  echo "=== front-door smoke FAILED"
   fail=1
 fi
 

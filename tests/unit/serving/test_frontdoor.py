@@ -1,0 +1,270 @@
+"""HTTP/SSE front door (ISSUE 14): validation 4xx, 429 backpressure,
+SSE streaming, cancel-on-disconnect, health probe, CLI smoke."""
+
+import http.client
+import json
+import socket
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (FrontDoor, FrontDoorParams, Replica,
+                                   ServingFrontend, ServingParams,
+                                   SyntheticEngine, synthetic_token)
+from deepspeed_tpu.serving.cli import (http_generate_stream, main,
+                                       sse_events)
+
+
+def make_door(door_params=None, start_pump=True, replicas=1,
+              num_blocks=128):
+    cc = KVCacheConfig(num_blocks=num_blocks, block_size=16,
+                       max_seq_len=512)
+    fe = ServingFrontend(
+        [Replica(SyntheticEngine(cc), i) for i in range(replicas)],
+        params=ServingParams())
+    door = FrontDoor(fe, params=door_params or FrontDoorParams())
+    door.start()
+    if not start_pump:
+        fe.stop()  # handles queue but never run (backpressure tests)
+    return door, fe
+
+
+def post(door, body, headers=None, raw_body=None):
+    c = http.client.HTTPConnection(door.host, door.port, timeout=30)
+    try:
+        c.request("POST", "/v1/generate",
+                  body=raw_body if raw_body is not None
+                  else json.dumps(body),
+                  headers={"Content-Type": "application/json",
+                           **(headers or {})})
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), r.read().decode()
+    finally:
+        c.close()
+
+
+def test_healthz_live_and_dead():
+    door, fe = make_door()
+    try:
+        c = http.client.HTTPConnection(door.host, door.port, timeout=10)
+        c.request("GET", "/healthz")
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        assert r.status == 200 and doc["ok"] \
+            and doc["healthy_replicas"] == 1
+        for rep in fe.router.replicas:
+            rep.mark_dead("test kill")
+        c.request("GET", "/healthz")
+        r = c.getresponse()
+        assert r.status == 503 and not json.loads(r.read())["ok"]
+        c.close()
+    finally:
+        door.shutdown()
+
+
+def test_generate_blocking_json_matches_engine():
+    door, _ = make_door()
+    try:
+        status, _, body = post(door, {"prompt": [3, 4, 5],
+                                      "max_new_tokens": 5,
+                                      "stream": False})
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["tokens"] == [synthetic_token([3, 4, 5], i)
+                                 for i in range(5)]
+        assert doc["status"] == "done" and doc["ttft_ms"] is not None
+    finally:
+        door.shutdown()
+
+
+def test_generate_sse_stream_and_done_event():
+    door, _ = make_door()
+    try:
+        out = http_generate_stream(door.host, door.port, [7, 8, 9], 6,
+                                   "interactive")
+        assert out["status_code"] == 200
+        assert out["tokens"] == [synthetic_token([7, 8, 9], i)
+                                 for i in range(6)]
+        assert out["ttft_ms"] is not None
+        assert out["done"]["status"] == "done"
+        assert out["done"]["tokens_delivered"] == 6
+    finally:
+        door.shutdown()
+
+
+def test_class_header_wins_over_body():
+    door, fe = make_door()
+    try:
+        status, _, _ = post(door, {"prompt": [1] * 8,
+                                   "max_new_tokens": 3,
+                                   "class": "interactive",
+                                   "stream": False},
+                            headers={"X-DS-Class": "batch"})
+        assert status == 200
+        assert fe.metrics.snapshot()["classes"]["batch"]["completed"] == 1
+    finally:
+        door.shutdown()
+
+
+@pytest.mark.parametrize("body,needle", [
+    ({"prompt": [], "max_new_tokens": 4}, "prompt"),
+    ({"prompt": "not-a-list", "max_new_tokens": 4}, "prompt"),
+    ({"prompt": [1, "x", 3], "max_new_tokens": 4}, "integer"),
+    ({"prompt": [1, 2, 3], "max_new_tokens": 0}, "max_new_tokens"),
+    ({"prompt": [1, 2, 3], "max_new_tokens": 4,
+      "class": "warp-speed"}, "latency class"),
+    ({"prompt": [1] * 500, "max_new_tokens": 400}, "max_seq_len"),
+])
+def test_validation_maps_to_400(body, needle):
+    door, _ = make_door()
+    try:
+        status, _, text = post(door, body)
+        assert status == 400, text
+        assert needle in json.loads(text)["error"]
+    finally:
+        door.shutdown()
+
+
+def test_malformed_json_and_bad_paths():
+    door, _ = make_door()
+    try:
+        status, _, text = post(door, None, raw_body="{nope")
+        assert status == 400 and "JSON" in json.loads(text)["error"]
+        c = http.client.HTTPConnection(door.host, door.port, timeout=10)
+        c.request("GET", "/v1/nothing-here")
+        r = c.getresponse()
+        assert r.status == 404
+        r.read()  # drain before reusing the keep-alive connection
+        c.request("POST", "/v1/nothing-here", body="{}")
+        r = c.getresponse()
+        assert r.status == 404
+        r.read()
+        c.close()
+    finally:
+        door.shutdown()
+
+
+def test_backpressure_429_with_retry_after():
+    door, fe = make_door(door_params=FrontDoorParams(
+        queue_token_budget=40, retry_after_s=2.0), start_pump=False)
+    try:
+        # 16 tokens sit queued (pump stopped) — fits the 40 budget
+        fe.submit([1] * 8, max_new_tokens=8, klass="batch")
+        # the queued 16 tokens + this 32 exceed 40 -> shed with 429
+        status, headers, text = post(
+            door, {"prompt": [1] * 16, "max_new_tokens": 16,
+                   "class": "batch"})
+        assert status == 429, text
+        assert headers.get("Retry-After") == "2"
+        assert "token budget" in json.loads(text)["error"]
+        assert fe.queued_tokens("batch") == 16
+    finally:
+        door.shutdown()
+
+
+def test_backpressure_single_oversized_request():
+    door, _ = make_door(door_params=FrontDoorParams(
+        queue_token_budget=10, retry_after_s=1.0))
+    try:
+        status, headers, _ = post(door, {"prompt": [1] * 8,
+                                         "max_new_tokens": 8})
+        assert status == 429
+        assert headers.get("Retry-After") == "1"
+    finally:
+        door.shutdown()
+
+
+def test_cancel_on_disconnect_frees_the_request():
+    door, fe = make_door(door_params=FrontDoorParams(
+        sse_heartbeat_s=0.1), start_pump=False)
+    try:
+        # open a raw streaming request, then vanish mid-stream
+        s = socket.create_connection((door.host, door.port), timeout=10)
+        body = json.dumps({"prompt": [5] * 8, "max_new_tokens": 32})
+        s.sendall((f"POST /v1/generate HTTP/1.1\r\n"
+                   f"Host: {door.host}\r\n"
+                   f"Content-Type: application/json\r\n"
+                   f"Content-Length: {len(body)}\r\n\r\n"
+                   f"{body}").encode())
+        # wait until the request is queued (pump stopped: it stays),
+        # then slam the socket shut
+        deadline = time.monotonic() + 10
+        while not fe._queues["interactive"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fe._queues["interactive"], "request never queued"
+        s.close()
+        # the next heartbeat write hits the dead socket -> cancel
+        deadline = time.monotonic() + 10
+        while fe.metrics.counters["cancelled"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fe.metrics.counters["cancelled"] == 1
+        assert not fe._queues["interactive"]
+    finally:
+        door.shutdown()
+
+
+def test_oversized_body_413_closes_the_connection():
+    """A 413 cannot leave the unread body in the socket: the reply
+    carries Connection: close (a reused keep-alive connection would
+    otherwise parse the leftover bytes as the next request)."""
+    door, _ = make_door(door_params=FrontDoorParams(max_body_bytes=64))
+    try:
+        c = http.client.HTTPConnection(door.host, door.port, timeout=10)
+        c.request("POST", "/v1/generate",
+                  body=json.dumps({"prompt": [1] * 200,
+                                   "max_new_tokens": 4}))
+        r = c.getresponse()
+        assert r.status == 413
+        assert r.getheader("Connection") == "close"
+        r.read()
+        c.close()
+        # a fresh connection still serves normally
+        status, _, _ = post(door, {"prompt": [1, 2], "max_new_tokens": 2,
+                                   "stream": False})
+        assert status == 200
+    finally:
+        door.shutdown()
+
+
+def test_metrics_endpoint_serves_snapshot():
+    door, _ = make_door()
+    try:
+        post(door, {"prompt": [2] * 8, "max_new_tokens": 4,
+                    "stream": False})
+        c = http.client.HTTPConnection(door.host, door.port, timeout=10)
+        c.request("GET", "/v1/metrics")
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        c.close()
+        assert r.status == 200
+        assert doc["counters"]["submitted"] == 1
+        assert doc["classes"]["interactive"]["completed"] == 1
+        assert "prefix_hit_rate" in doc
+    finally:
+        door.shutdown()
+
+
+def test_sse_parser_skips_heartbeats():
+    class FakeResp:
+        def __init__(self, lines):
+            self._lines = [ln.encode() for ln in lines]
+
+        def readline(self):
+            return self._lines.pop(0) if self._lines else b""
+
+    events = list(sse_events(FakeResp([
+        ": hb\n", "event: token\n", 'data: {"i": 0, "token": 7}\n',
+        "\n", "event: done\n", 'data: {"status": "done"}\n', "\n"])))
+    assert events == [("token", {"i": 0, "token": 7}),
+                      ("done", {"status": "done"})]
+
+
+def test_serve_dry_run_cli_smoke(capsys):
+    rc = main(["serve", "--dry-run"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(out)
+    assert rc == 0
+    assert doc["ok"] and doc["healthz"]["healthy_replicas"] == 2

@@ -1,0 +1,309 @@
+"""Disaggregated prefill/decode over the KV-page transport (ISSUE 14):
+checksum gates, trie-skipped transfers, synthetic + real-engine parity."""
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (NetworkFrontend, NetworkParams,
+                                   ReplicaEndpoint, ServingWorker,
+                                   SyntheticEngine, jsonline_rpc,
+                                   synthetic_token)
+from deepspeed_tpu.serving.kv_transfer import (PageStager, page_payload,
+                                               push_pages)
+
+CC = KVCacheConfig(num_blocks=128, block_size=16, max_seq_len=512)
+
+
+def make_pair(cc=CC, **net_kw):
+    wp = ServingWorker(SyntheticEngine(cc), "p0", role="prefill")
+    wd = ServingWorker(SyntheticEngine(cc), "d0", role="decode")
+    eps = [ReplicaEndpoint(wp.id, wp.endpoint, role="prefill"),
+           ReplicaEndpoint(wd.id, wd.endpoint, role="decode")]
+    fe = NetworkFrontend(eps, net=NetworkParams(disaggregate=True,
+                                                **net_kw))
+    return wp, wd, fe
+
+
+# -- stager / payload units -------------------------------------------------
+
+def test_page_stager_chunked_round_trip():
+    eng = SyntheticEngine(CC)
+    prompt = list(range(200, 240))
+    p = page_payload(eng, prompt, [], 1)
+    stager = PageStager()
+    import base64
+
+    b64 = base64.b64encode(p["raw"]).decode()
+    chunks = [b64[i:i + 7] for i in range(0, len(b64), 7)]
+    stager.begin(1, {"n": len(chunks), "sha256": p["sha256"],
+                     "dtype": p["dtype"], "shape": p["shape"],
+                     "synthetic": True})
+    for i, ch in enumerate(chunks):
+        stager.chunk(1, i, ch)
+    assert stager.commit(1) == len(p["raw"])
+    assert stager.ready[1]["raw"] == p["raw"]
+
+
+def test_page_stager_rejects_corrupt_page_then_accepts_retry():
+    eng = SyntheticEngine(CC)
+    p = page_payload(eng, list(range(40)), [], 0)
+    stager = PageStager()
+    import base64
+
+    b64 = base64.b64encode(p["raw"]).decode()
+    stager.begin(0, {"n": 1, "sha256": p["sha256"], "synthetic": True})
+    stager.chunk(0, 0, b64[:-4] + "AAAA")  # tampered tail
+    with pytest.raises(ValueError, match="checksum gate"):
+        stager.commit(0)
+    assert 0 not in stager.ready  # never staged
+    stager.begin(0, {"n": 1, "sha256": p["sha256"], "synthetic": True})
+    stager.chunk(0, 0, b64)
+    stager.commit(0)
+    assert stager.ready[0]["raw"] == p["raw"]
+
+
+def test_corrupt_page_refused_over_the_wire():
+    wp, wd, fe = make_pair()
+    try:
+        prompt = list(range(300, 340))
+        rb = jsonline_rpc(wd.endpoint, [
+            {"op": "adopt_begin", "rid": "t1", "prompt": prompt,
+             "max_new_tokens": 8, "first_token": 11}])[0]
+        assert rb["ok"] and rb["need"]
+        page = rb["need"][0]
+        r = jsonline_rpc(wd.endpoint, [
+            {"op": "kv_page_begin", "rid": "t1", "page": page, "n": 1,
+             "sha256": "0" * 64, "synthetic": True},
+            {"op": "kv_page_chunk", "rid": "t1", "page": page, "i": 0,
+             "v": "Z0Z0"},
+            {"op": "kv_page_commit", "rid": "t1", "page": page}])
+        assert not r[2]["ok"] and r[2]["kind"] == "checksum"
+        # an incomplete transfer cannot seat the request
+        rc = jsonline_rpc(wd.endpoint,
+                          [{"op": "adopt_commit", "rid": "t1"}])[0]
+        assert not rc["ok"] and rc["kind"] == "incomplete"
+        jsonline_rpc(wd.endpoint, [{"op": "adopt_abort", "rid": "t1"}])
+    finally:
+        wp.shutdown()
+        wd.shutdown()
+        fe.close()
+
+
+# -- disaggregated end-to-end (synthetic) -----------------------------------
+
+def test_disagg_matches_colocated_and_attributes_ttft():
+    wp, wd, fe = make_pair(kv_chunk_bytes=64)  # force multi-chunk pages
+    try:
+        prompt = list(range(100, 148))
+        h = fe.submit(prompt, max_new_tokens=8)
+        fe.run_until_idle()
+        # bit-identical to the colocated single-replica engine
+        colocated = [synthetic_token(prompt, i) for i in range(8)]
+        assert h.result(timeout=5) == colocated
+        assert h.replica_id == "d0"
+        bd = h.ttft_breakdown
+        assert bd is not None and "prefill_ms" in bd \
+            and "transfer_ms" in bd and "decode_ms" in bd
+        snap = fe.snapshot()
+        assert snap["counters"]["disagg_requests"] == 1
+        assert "disagg_ttft" in snap
+    finally:
+        wp.shutdown()
+        wd.shutdown()
+        fe.close()
+
+
+def test_cluster_wide_kv_tier_skips_warm_pages():
+    """Same header, second request: the decode worker's trie already
+    holds the transferred pages — fewer pages cross the wire, and the
+    prefill worker's cached tier skips the recompute."""
+    wp, wd, fe = make_pair()
+    try:
+        header = list(range(500, 548))  # 3 full pages
+        h1 = fe.submit(header + [1, 2], max_new_tokens=4)
+        fe.run_until_idle()
+        assert h1.status == "done"
+        # adopt_commit indexed the transferred prompt pages locally
+        assert jsonline_rpc(wd.endpoint, [
+            {"op": "stats"}])[0]["v"]["prefix"]["inserts"] > 0
+        # ask the decode worker directly what a same-header adoption
+        # would still need over the wire
+        rb = jsonline_rpc(wd.endpoint, [
+            {"op": "adopt_begin", "rid": "probe",
+             "prompt": header + [9, 9], "max_new_tokens": 4,
+             "first_token": 5}])[0]
+        assert rb["ok"]
+        # 52-token prompt = 4 pages; 3 full header pages are shared ->
+        # only the final partial page still needs the transfer
+        assert rb["need"] == [3]
+        jsonline_rpc(wd.endpoint, [{"op": "adopt_abort",
+                                    "rid": "probe"}])
+        # prefill side: the released prompt pages live in the cached
+        # tier, so a same-header prefill revives instead of recomputing
+        h2 = fe.submit(header + [7, 8], max_new_tokens=4)
+        fe.run_until_idle()
+        assert h2.result(timeout=5) == [
+            synthetic_token(header + [7, 8], i) for i in range(4)]
+        pstats = wp.stats()["prefix"]
+        assert pstats["revivals"] > 0 and pstats["hit_tokens"] > 0
+    finally:
+        wp.shutdown()
+        wd.shutdown()
+        fe.close()
+
+
+def test_prefill_fleet_death_falls_back_to_colocated():
+    wp, wd, fe = make_pair()
+    try:
+        wp.shutdown()  # the whole prefill fleet dies
+        prompt = [3] * 20
+        h = fe.submit(prompt, max_new_tokens=5)
+        fe.run_until_idle()
+        # decode-role workers still run whole requests: serving survives
+        assert h.result(timeout=5) == [synthetic_token(prompt, i)
+                                       for i in range(5)]
+        assert h.ttft_breakdown is None  # colocated fallback path
+    finally:
+        wd.shutdown()
+        fe.close()
+
+
+def test_push_pages_helper_against_live_worker():
+    wp, wd, fe = make_pair()
+    try:
+        prompt = list(range(700, 740))
+        rb = jsonline_rpc(wd.endpoint, [
+            {"op": "adopt_begin", "rid": "pp", "prompt": prompt,
+             "max_new_tokens": 6,
+             "first_token": synthetic_token(prompt, 0)}])[0]
+        eng = SyntheticEngine(CC)
+        payloads = {i: page_payload(eng, prompt, [], i)
+                    for i in rb["need"]}
+        out = push_pages(
+            lambda reqs: jsonline_rpc(wd.endpoint, reqs),
+            "pp", payloads, chunk_bytes=16)
+        assert out["pages"] == len(rb["need"]) and out["bytes"] > 0
+        rc = jsonline_rpc(wd.endpoint,
+                          [{"op": "adopt_commit", "rid": "pp"}])[0]
+        assert rc["ok"]
+        # the adopted request decodes to the engine-deterministic tail
+        toks, deadline = [], 200
+        while deadline:
+            r = jsonline_rpc(wd.endpoint, [{"op": "poll", "rid": "pp",
+                                            "cursor": 0}])[0]
+            toks = r["tokens"]
+            if r.get("done"):
+                break
+            deadline -= 1
+        assert toks == [synthetic_token(prompt, i) for i in range(6)]
+    finally:
+        wp.shutdown()
+        wd.shutdown()
+        fe.close()
+
+
+def test_failed_adopt_commit_releases_the_reservation():
+    """A commit that blows up (payload passes the sha gate but carries
+    a lying shape) must give the slot+pages back — otherwise a few bad
+    senders brick the worker's decode slots forever."""
+    import jax
+
+    from deepspeed_tpu.inference.v2 import build_engine_v2
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.serving.scheduler import ServingScheduler
+    import jax.numpy as jnp
+    import base64
+    import hashlib
+
+    cfg = LlamaConfig.tiny(num_layers=1, max_seq_len=128,
+                           dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    eng = build_engine_v2(model, model.init_params(jax.random.PRNGKey(0)),
+                          cache_config=KVCacheConfig(
+                              num_blocks=32, block_size=16,
+                              max_seq_len=128),
+                          max_batch_slots=2, prefill_chunk=16,
+                          prefill_batch=1, decode_burst=2,
+                          scheduler_factory=ServingScheduler)
+    wd = ServingWorker(eng, "bad-commit", role="decode")
+    try:
+        prompt = list(range(1, 33))
+        # slots=2: prove repeated failed commits never exhaust them
+        for attempt in range(4):
+            rid = f"bad{attempt}"
+            rb = jsonline_rpc(wd.endpoint, [
+                {"op": "adopt_begin", "rid": rid, "prompt": prompt,
+                 "max_new_tokens": 4, "first_token": 7}])[0]
+            assert rb["ok"], rb
+            raw = b"\x00" * 64  # sha-consistent but shape-inconsistent
+            sha = hashlib.sha256(raw).hexdigest()
+            reqs = []
+            for page in rb["need"]:
+                reqs += [
+                    {"op": "kv_page_begin", "rid": rid, "page": page,
+                     "n": 1, "sha256": sha, "nbytes": len(raw),
+                     "dtype": "float32", "shape": [9, 9, 9],
+                     "synthetic": False},
+                    {"op": "kv_page_chunk", "rid": rid, "page": page,
+                     "i": 0,
+                     "v": base64.b64encode(raw).decode()},
+                    {"op": "kv_page_commit", "rid": rid, "page": page}]
+            reqs.append({"op": "adopt_commit", "rid": rid})
+            replies = jsonline_rpc(wd.endpoint, reqs)
+            assert not replies[-1]["ok"]
+            assert replies[-1]["kind"] == "commit"
+        # the slots all came back: a clean adoption still seats
+        rb = jsonline_rpc(wd.endpoint, [
+            {"op": "adopt_begin", "rid": "clean", "prompt": prompt,
+             "max_new_tokens": 4, "first_token": 7}])[0]
+        assert rb["ok"], rb
+    finally:
+        wd.shutdown()
+
+
+# -- real-engine bitwise parity (slow) --------------------------------------
+
+@pytest.mark.slow
+def test_real_engine_disagg_bitwise_identical_to_colocated():
+    """The acceptance bar: prefill on one REAL engine, KV pages over
+    the wire, decode on ANOTHER real engine — outputs bitwise-identical
+    to the colocated single-replica engine (greedy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import build_engine_v2
+    from deepspeed_tpu.models import LlamaConfig, LlamaModel
+    from deepspeed_tpu.serving.scheduler import ServingScheduler
+
+    cfg = LlamaConfig.tiny(num_layers=2, max_seq_len=256,
+                           dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    cc = KVCacheConfig(num_blocks=64, block_size=16, max_seq_len=256)
+
+    def build():
+        return build_engine_v2(model, params, cache_config=cc,
+                               max_batch_slots=4, prefill_chunk=32,
+                               prefill_batch=2, decode_burst=4,
+                               scheduler_factory=ServingScheduler)
+
+    prompt = list(range(1, 41))  # 40 tokens: 2 full pages + 1 partial
+    colocated = build().generate([prompt], max_new_tokens=8,
+                                 temperature=0.0)[0]
+    assert len(colocated) == 8
+
+    wp = ServingWorker(build(), "rp", role="prefill")
+    wd = ServingWorker(build(), "rd", role="decode")
+    fe = NetworkFrontend(
+        [ReplicaEndpoint(wp.id, wp.endpoint, role="prefill"),
+         ReplicaEndpoint(wd.id, wd.endpoint, role="decode")],
+        net=NetworkParams(disaggregate=True))
+    try:
+        h = fe.submit(prompt, max_new_tokens=8)
+        fe.run_until_idle()
+        assert h.result(timeout=30) == colocated
+        assert h.ttft_breakdown is not None
+    finally:
+        wp.shutdown()
+        wd.shutdown()
+        fe.close()

@@ -1,0 +1,112 @@
+"""Page-releasing preemption under HBM pressure (ISSUE 14 satellite,
+ROADMAP 3e): preempted requests release KV pages to the cached-free
+LRU tier; re-admission recomputes via the prefix trie and the stream
+splices exactly."""
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (Replica, ServingFrontend,
+                                   ServingParams, SyntheticEngine,
+                                   synthetic_token)
+
+
+def make_frontend(num_blocks=12, slots=2, params=None):
+    cc = KVCacheConfig(num_blocks=num_blocks, block_size=16,
+                       max_seq_len=512)
+    eng = SyntheticEngine(cc, max_batch_slots=slots, prefill_chunk=16,
+                          prefill_batch=1, decode_burst=1)
+    fe = ServingFrontend([Replica(eng, 0)], params=params
+                         or ServingParams())
+    return fe, eng
+
+
+def test_pressure_preemption_releases_pages_and_replays_via_trie(
+        monkeypatch):
+    # pool: 11 allocatable pages.  Background: 33-token prompt (3
+    # pages, 2 full -> trie-indexable) + 96 new = 9 pages total.
+    fe, eng = make_frontend(num_blocks=12, slots=2)
+    sched = fe.router.replicas[0].scheduler
+    degraded = {"on": False}
+    monkeypatch.setattr(fe, "_headroom_degraded",
+                        lambda: degraded["on"])
+    bg_prompt = list(range(2000, 2033))
+    bg = fe.submit(bg_prompt, max_new_tokens=96, klass="background")
+    for _ in range(8):
+        fe.pump()
+    assert bg.status == "running" and bg.delivered > 0
+    streamed_before = bg.delivered
+    # HBM pressure hits; an interactive request arrives that the pool
+    # cannot hold alongside the background resident (page-blocked)
+    degraded["on"] = True
+    inter = fe.submit(list(range(100, 120)), max_new_tokens=30)
+    fe.pump()
+    # retaining preemption could never help a page-blocked head; the
+    # release path frees real pages
+    assert fe.metrics.counters["preemptions"] == 1
+    assert fe.metrics.counters["preempt_pages_released"] > 0
+    assert bg.status == "queued" and bg.request is None  # retired
+    # the background PROMPT pages (trie-indexed at prefill completion)
+    # are in the cached-free tier, revivable; generation pages freed
+    assert sched.allocator.num_cached == 2  # 2 full prompt pages
+    # (run_until_idle would spin: the deferred background stays queued
+    # for as long as the pressure lasts — pump the interactive through)
+    for _ in range(200):
+        fe.pump()
+        if inter.status == "done":
+            break
+    assert inter.status == "done"
+    # pressure clears -> the background replays through a FRESH
+    # admission whose _reserve re-matches the trie
+    degraded["on"] = False
+    fe.run_until_idle()
+    assert bg.status == "done" and bg.replays == 1
+    assert sched.prefix.revivals > 0  # recompute skipped cached pages
+    # splice-exact: the full transcript, no duplicate and no gap past
+    # the pre-preemption high-water mark
+    assert streamed_before > 0
+    assert bg.result(timeout=5) == [synthetic_token(bg_prompt, i)
+                                    for i in range(96)]
+
+
+def test_preemption_keeps_pages_when_not_degraded(monkeypatch):
+    """Without HBM pressure the classic slot preemption still holds:
+    pages stay resident, the victim resumes in place (no replay)."""
+    fe, _ = make_frontend(num_blocks=64, slots=1)
+    monkeypatch.setattr(fe, "_headroom_degraded", lambda: False)
+    bg = fe.submit([1] * 20, max_new_tokens=64, klass="background")
+    for _ in range(6):
+        fe.pump()
+    assert bg.status == "running"
+    inter = fe.submit([2] * 20, max_new_tokens=4)
+    fe.run_until_idle()
+    assert inter.status == "done" and bg.status == "done"
+    assert fe.metrics.counters["preemptions"] == 1
+    assert fe.metrics.counters["preempt_pages_released"] == 0
+    assert bg.replays == 0  # resumed from retained KV, not replayed
+
+
+def test_release_preemption_disabled_by_param(monkeypatch):
+    """preempt_release_pages=False: pressure preemption falls back to
+    the retaining kind (slot-blocked only)."""
+    degraded = {"on": False}
+    fe, _ = make_frontend(
+        num_blocks=64, slots=1,
+        params=ServingParams(preempt_release_pages=False))
+    monkeypatch.setattr(fe, "_headroom_degraded",
+                        lambda: degraded["on"])
+    bg = fe.submit([1] * 20, max_new_tokens=64, klass="background")
+    for _ in range(6):
+        fe.pump()
+    assert bg.status == "running"
+    degraded["on"] = True
+    inter = fe.submit([2] * 20, max_new_tokens=4)
+    for _ in range(200):
+        fe.pump()
+        if inter.status == "done":
+            break
+    assert inter.status == "done"
+    assert fe.metrics.counters["preempt_pages_released"] == 0
+    # degraded admission still deferred the background resume until
+    # the pressure cleared
+    degraded["on"] = False
+    fe.run_until_idle()
+    assert bg.status == "done" and bg.replays == 0

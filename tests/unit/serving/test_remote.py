@@ -1,0 +1,204 @@
+"""Network front-end over live worker endpoints (ISSUE 14): routing,
+prefix affinity, drain-and-requeue splice, store discovery."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (NetworkFrontend, NetworkParams,
+                                   ReplicaEndpoint, ServingWorker,
+                                   SyntheticEngine, discover_endpoints,
+                                   jsonline_rpc, synthetic_token)
+
+
+def make_worker(wid, role="mixed", **engine_kw):
+    cc = engine_kw.pop("cache", None) or KVCacheConfig(
+        num_blocks=128, block_size=16, max_seq_len=512)
+    return ServingWorker(SyntheticEngine(cc, **engine_kw), wid, role=role)
+
+
+@pytest.fixture
+def pair():
+    # ids chosen so the least-outstanding tiebreak (stable id order)
+    # routes the first request to "a" deterministically
+    wa, wb = make_worker("a"), make_worker("b")
+    yield wa, wb
+    wa.shutdown()
+    wb.shutdown()
+
+
+def endpoints_of(*workers):
+    return [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+            for w in workers]
+
+
+def test_plain_submit_streams_engine_tokens(pair):
+    fe = NetworkFrontend(endpoints_of(*pair), net=NetworkParams())
+    prompt = [5, 6, 7, 8]
+    h = fe.submit(prompt, max_new_tokens=6)
+    fe.run_until_idle()
+    assert h.result(timeout=5) == [synthetic_token(prompt, i)
+                                   for i in range(6)]
+    assert h.status == "done" and h.replica_id in ("a", "b")
+    snap = fe.snapshot()
+    assert snap["counters"]["submitted"] == 1
+    assert snap["classes"]["interactive"]["completed"] == 1
+
+
+def test_local_validation_uses_worker_geometry(pair):
+    fe = NetworkFrontend(endpoints_of(*pair), net=NetworkParams())
+    with pytest.raises(ValueError, match="non-empty"):
+        fe.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        fe.submit([1, 2], max_new_tokens=0)
+    # geometry learned over the wire: 512-token max_seq_len enforced
+    with pytest.raises(ValueError, match="max_seq_len"):
+        fe.submit([1] * 500, max_new_tokens=100)
+    with pytest.raises(ValueError, match="latency class"):
+        fe.submit([1, 2], max_new_tokens=4, klass="hyper")
+
+
+def test_prefix_affinity_prefers_the_warm_worker(pair):
+    fe = NetworkFrontend(endpoints_of(*pair), net=NetworkParams())
+    header = list(range(1000, 1048))  # 48 tokens = 3 full pages
+    h1 = fe.submit(header + [1, 2], max_new_tokens=4)
+    fe.run_until_idle()
+    first = h1.replica_id
+    # the warm worker's trie now indexes the header: affinity must
+    # override least-outstanding/id ordering for the same header
+    for tail in ([3, 4], [5, 6], [7, 8]):
+        h = fe.submit(header + tail, max_new_tokens=4)
+        fe.run_until_idle()
+        assert h.replica_id == first
+    hits = [w for w in pair if w.id == first][0].stats()["prefix"]
+    assert hits["hit_tokens"] > 0
+
+
+def test_drain_and_requeue_splices_exactly(pair):
+    wa, wb = pair
+    # freeze a's local pump: admitted work there never generates
+    wa.frontend.stop()
+    fe = NetworkFrontend(endpoints_of(wa, wb), net=NetworkParams())
+    prompt = [9, 9, 9, 9]
+    h = fe.submit(prompt, max_new_tokens=12)
+    fe.pump()  # admits to "a" (id order) — which is frozen
+    assert h.replica_id == "a"
+    got_before = h.drain()[0]
+    assert got_before == []  # nothing generated on the frozen worker
+    wa.shutdown()  # the socket dies — a real connection loss
+    fe.run_until_idle()
+    # replayed on "b" from the prompt; delivery past the high-water
+    # mark only — no duplicated or dropped tokens
+    assert h.replays == 1 and h.replica_id == "b"
+    assert h.result(timeout=5) == [synthetic_token(prompt, i)
+                                   for i in range(12)]
+    assert fe.metrics.counters["requeued_replica_death"] == 1
+
+
+def test_mid_stream_death_no_dup_no_drop(pair):
+    """Kill after SOME tokens streamed: the replay must continue at
+    the delivered high-water mark exactly."""
+    wa, wb = pair
+    fe = NetworkFrontend(endpoints_of(wa, wb), net=NetworkParams())
+    prompt = [4, 4, 4]
+    h = fe.submit(prompt, max_new_tokens=40)
+    # pump until at least one token delivered (worker "a" serves it)
+    deadline = time.monotonic() + 10
+    while h.delivered == 0 and time.monotonic() < deadline:
+        fe.pump()
+    assert h.delivered > 0
+    victim = [w for w in pair if w.id == h.replica_id][0]
+    survivor = [w for w in pair if w.id != h.replica_id][0]
+    victim.shutdown()
+    fe.run_until_idle()
+    assert h.result(timeout=5) == [synthetic_token(prompt, i)
+                                   for i in range(40)]
+    assert h.replica_id in (victim.id, survivor.id)
+    if h.replays:  # the victim died before finishing: spliced replay
+        assert h.replica_id == survivor.id
+
+
+def test_all_workers_dead_fails_pending(pair):
+    wa, wb = pair
+    fe = NetworkFrontend(endpoints_of(wa, wb), net=NetworkParams())
+    wa.frontend.stop()
+    wb.frontend.stop()
+    h = fe.submit([1, 2, 3], max_new_tokens=4)
+    wa.shutdown()
+    wb.shutdown()
+    with pytest.raises(Exception, match="no live worker"):
+        fe.run_until_idle()
+    assert h.status == "failed"
+
+
+def test_worker_protocol_edges(pair):
+    wa, _ = pair
+    # unknown rid polls are named, not crashes
+    r = jsonline_rpc(wa.endpoint, [{"op": "poll", "rid": "nope"}])[0]
+    assert not r["ok"] and r["kind"] == "unknown_rid"
+    # validation errors carry their kind for the 4xx mapping
+    r = jsonline_rpc(wa.endpoint, [
+        {"op": "submit", "rid": "x", "prompt": [],
+         "max_new_tokens": 4}])[0]
+    assert not r["ok"] and r["kind"] == "validation"
+    r = jsonline_rpc(wa.endpoint, [{"op": "wat"}])[0]
+    assert not r["ok"] and "bad op" in r["err"]
+    # stats carries the placement inputs
+    s = jsonline_rpc(wa.endpoint, [{"op": "stats"}])[0]["v"]
+    assert s["block_size"] == 16 and s["max_seq_len"] == 512
+    assert "outstanding_tokens" in s
+
+
+def test_queued_tokens_backpressure_signal(pair):
+    wa, wb = pair
+    fe = NetworkFrontend(endpoints_of(wa, wb), net=NetworkParams())
+    fe.submit([1] * 8, max_new_tokens=8, klass="batch")
+    fe.submit([1] * 4, max_new_tokens=4, klass="batch")
+    assert fe.queued_tokens("batch") == 24
+    assert fe.queued_tokens("interactive") == 0
+
+
+def test_store_discovery_and_rollup_labels(tmp_path):
+    """Workers register endpoints in the store (like resil/srv) and
+    ship their telemetry registry through the PR-13 rollup so the
+    merged view labels serving counters per replica process."""
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer)
+    from deepspeed_tpu.telemetry import get_telemetry
+
+    srv = RendezvousServer()
+    w = None
+    try:
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        cc = KVCacheConfig(num_blocks=64, block_size=16, max_seq_len=256)
+        w = ServingWorker(SyntheticEngine(cc), "serving-r7",
+                          store_endpoint=srv.endpoint,
+                          telemetry_push_every_s=0.1)
+        client = RendezvousClient(srv.endpoint)
+        eps = discover_endpoints(client)
+        assert [e.id for e in eps] == ["serving-r7"]
+        assert eps[0].role == "mixed" and eps[0].endpoint == w.endpoint
+        # drive one request so the worker registry has serving counters
+        fe = NetworkFrontend(eps, net=NetworkParams())
+        fe.submit([2] * 4, max_new_tokens=3)
+        fe.run_until_idle()
+        # the heartbeat thread pushes registry snapshots -> rollup
+        from deepspeed_tpu.telemetry import collect_rollup
+
+        deadline = time.monotonic() + 10
+        text = ""
+        while time.monotonic() < deadline:
+            rollup = collect_rollup(client, ["serving-r7"])
+            text = rollup.prometheus_text()
+            if 'node="serving-r7"' in text \
+                    and "serving_worker_requests_total" in text:
+                break
+            time.sleep(0.1)
+        assert 'node="serving-r7"' in text
+        assert "serving_worker_requests_total" in text
+    finally:
+        if w is not None:
+            w.shutdown()
+        srv.shutdown()

@@ -1,7 +1,9 @@
 """The ``serving.*`` config group parses and maps onto ServingParams."""
 
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
-from deepspeed_tpu.serving import ServingParams, params_from_config
+from deepspeed_tpu.serving import (NetworkParams, ServingParams,
+                                   net_params_from_config,
+                                   params_from_config)
 
 
 def test_serving_config_defaults():
@@ -36,3 +38,72 @@ def test_serving_config_round_trip_to_params():
     assert p.temperature == 0.7
     assert p.eos_token_id == 2
     assert p.interactive_ttft_slo_ms == 250.0
+
+
+def test_serving_network_config_defaults():
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1}, world_size=1)
+    net = cfg.serving.network
+    assert net.enabled is False and net.workers == 2
+    assert net.disaggregate is False
+    assert cfg.serving.preempt_release_pages is True
+
+
+def test_serving_network_config_round_trip_to_params():
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1,
+         "serving": {"preempt_release_pages": False,
+                     "network": {"enabled": True, "workers": 4,
+                                 "prefill_workers": 2,
+                                 "disaggregate": True,
+                                 "queue_token_budget": 9999,
+                                 "retry_after_s": 3.0,
+                                 "kv_chunk_bytes": 4096,
+                                 "probe_timeout_s": 0.5}}},
+        world_size=1)
+    assert cfg.serving.network.enabled
+    assert cfg.serving.network.prefill_workers == 2
+    p = params_from_config(cfg.serving)
+    assert p.preempt_release_pages is False
+    n = net_params_from_config(cfg.serving.network)
+    assert isinstance(n, NetworkParams)
+    assert n.disaggregate is True
+    assert n.kv_chunk_bytes == 4096
+    assert n.probe_timeout_s == 0.5
+    # the 429 backpressure knobs are the HTTP layer's (FrontDoorParams)
+    from deepspeed_tpu.serving import door_params_from_config
+
+    dp = door_params_from_config(cfg.serving.network)
+    assert dp.queue_token_budget == 9999
+    assert dp.retry_after_s == 3.0
+    # a NetworkFrontend applies the configured transport timeouts to
+    # its endpoints (they would be dead config otherwise)
+    from deepspeed_tpu.serving import NetworkFrontend, ReplicaEndpoint
+
+    ep = ReplicaEndpoint("x", "127.0.0.1:1")
+    fe = NetworkFrontend([ep], net=n)
+    assert ep.probe_timeout_s == 0.5
+    assert ep.rpc_timeout_s == n.rpc_timeout_s
+    fe.close()
+
+
+def test_door_params_and_cli_config_seeding():
+    """serve --ds-config: the serving.network group actually reaches
+    the front door / network params (finding: the group must never be
+    dead config)."""
+    from deepspeed_tpu.serving import door_params_from_config
+    from deepspeed_tpu.serving.cli import _load_network_config
+
+    ncfg = _load_network_config(
+        '{"serving": {"network": {"enabled": true,'
+        ' "queue_token_budget": 777, "retry_after_s": 4.0,'
+        ' "sse_heartbeat_s": 0.25, "disaggregate": true,'
+        ' "workers": 3}}}')
+    assert ncfg.enabled and ncfg.workers == 3
+    dp = door_params_from_config(ncfg)
+    assert dp.queue_token_budget == 777
+    assert dp.retry_after_s == 4.0
+    assert dp.sse_heartbeat_s == 0.25
+    n = net_params_from_config(ncfg)
+    assert n.disaggregate is True
+    assert _load_network_config(None) is None
