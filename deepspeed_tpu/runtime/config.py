@@ -364,6 +364,31 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=TelemetryMemoryConfig)
 
 
+class ServingTracingConfig(DeepSpeedConfigModel):
+    """``serving.tracing`` config group — distributed request tracing
+    (``deepspeed_tpu/serving/tracing.py``): per-request lifecycle
+    records (queue wait, admission, preempt/replay, prefill/transfer/
+    decode phases, token timings) in a bounded ring, head-based sampled
+    with always-on capture of anomalous requests, shipped cross-process
+    over the telemetry rollup and assembled by ``python -m
+    deepspeed_tpu.serving trace <id>``."""
+
+    enabled: bool = True
+    #: head-based sample rate (deterministic on the trace id, so every
+    #: process that touches a request reaches the same verdict);
+    #: anomalous requests (replayed / preempted / failed / slow TTFT)
+    #: are ALWAYS recorded, even at 0.0
+    sample_rate: float = 1.0
+    #: committed records retained (the ring is also the window each
+    #: rollup publication ships — the store holds the recent history)
+    ring: int = 256
+    #: TTFT above this (ms) force-samples the request as anomalous
+    #: (0 disables the threshold)
+    anomaly_ttft_ms: float = 2000.0
+    #: per-record cap on token timestamps kept for gap percentiles
+    token_timings: int = 512
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """``serving`` config group — the production serving plane
     (``deepspeed_tpu/serving/``): paged prefix-sharing KV cache over the
@@ -411,6 +436,10 @@ class ServingConfig(DeepSpeedConfigModel):
     #: process-per-replica workers, disaggregated prefill/decode)
     network: "ServingNetworkConfig" = Field(
         default_factory=lambda: ServingNetworkConfig())
+    #: distributed request tracing (per-request lifecycle records,
+    #: cross-process timeline assembly)
+    tracing: ServingTracingConfig = Field(
+        default_factory=ServingTracingConfig)
 
 
 class ServingNetworkConfig(DeepSpeedConfigModel):
@@ -450,6 +479,13 @@ class ServingNetworkConfig(DeepSpeedConfigModel):
     #: rendezvous store for worker registration/discovery (None: the
     #: launcher wires endpoints directly)
     store_endpoint: Optional[str] = None
+    #: front-door structured access log: one JSONL line per request
+    #: (ts, method, path, status, class, trace id, duration, tokens,
+    #: close reason); "" disables
+    access_log: str = ""
+    #: rotate the live access log past this size (one ``.1``
+    #: predecessor kept)
+    access_log_max_bytes: int = 8 << 20
 
 
 class ResilienceConfig(DeepSpeedConfigModel):

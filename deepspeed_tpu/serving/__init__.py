@@ -28,7 +28,14 @@ from .frontdoor import (CLASS_HEADER, FrontDoor, FrontDoorParams,
                         door_params_from_config)
 from .frontend import (NoHealthyReplicaError, ServingFrontend,
                        ServingHandle, ServingParams)
-from .metrics import CLASSES, LatencyTracker, ServingMetrics
+from .metrics import (CLASSES, LatencyTracker, RequestLog, RequestRecord,
+                      ServingMetrics, head_sampled)
+from .tracing import (REQUESTS_PREFIX, TRACE_HEADER, AccessLog,
+                      assemble_timeline, configure_request_log,
+                      configure_tracing_from_config, fetch_request_docs,
+                      find_trace, get_request_log, mint_trace_id,
+                      render_timeline, sanitize_trace_id,
+                      timeline_chrome_trace)
 from .prefix_cache import PrefixCache, RefcountedBlockAllocator
 from .remote import (NetworkFrontend, NetworkParams, ReplicaEndpoint,
                      discover_endpoints, jsonline_rpc)
@@ -38,15 +45,20 @@ from .synthetic import FakeClock, SyntheticEngine, synthetic_token
 from .worker import SRV_PREFIX, ServingWorker
 
 __all__ = [
-    "CLASSES", "CLASS_HEADER", "FakeClock", "FrontDoor", "FrontDoorParams",
-    "LatencyTracker", "NetworkFrontend", "NetworkParams",
-    "NoHealthyReplicaError", "PrefixCache", "RefcountedBlockAllocator",
-    "Replica", "ReplicaEndpoint", "ReplicaRouter", "SRV_PREFIX",
-    "ServingFrontend", "ServingHandle", "ServingMetrics", "ServingParams",
-    "ServingScheduler", "ServingWorker", "SyntheticEngine",
-    "build_serving_frontend", "discover_endpoints",
-    "door_params_from_config", "jsonline_rpc", "net_params_from_config",
-    "params_from_config", "synthetic_token",
+    "AccessLog", "CLASSES", "CLASS_HEADER", "FakeClock", "FrontDoor",
+    "FrontDoorParams", "LatencyTracker", "NetworkFrontend",
+    "NetworkParams", "NoHealthyReplicaError", "PrefixCache",
+    "REQUESTS_PREFIX", "RefcountedBlockAllocator", "Replica",
+    "ReplicaEndpoint", "ReplicaRouter", "RequestLog", "RequestRecord",
+    "SRV_PREFIX", "ServingFrontend", "ServingHandle", "ServingMetrics",
+    "ServingParams", "ServingScheduler", "ServingWorker",
+    "SyntheticEngine", "TRACE_HEADER", "assemble_timeline",
+    "build_serving_frontend", "configure_request_log",
+    "configure_tracing_from_config", "discover_endpoints",
+    "door_params_from_config", "fetch_request_docs", "find_trace",
+    "get_request_log", "head_sampled", "jsonline_rpc", "mint_trace_id",
+    "net_params_from_config", "params_from_config", "render_timeline",
+    "sanitize_trace_id", "synthetic_token", "timeline_chrome_trace",
 ]
 
 

@@ -1,4 +1,4 @@
-"""Serving CLI — bench / serve / worker.
+"""Serving CLI — bench / serve / worker / trace.
 
 * ``python -m deepspeed_tpu.serving bench [--dry-run] [--network]`` —
   the deterministic multi-tenant workload.  ``--dry-run`` drives
@@ -15,6 +15,13 @@
 * ``python -m deepspeed_tpu.serving worker`` — run ONE replica worker
   process (the launcher and chaos tests spawn these; ``kill -9`` one
   and the front door's router drains it).
+* ``python -m deepspeed_tpu.serving trace <request-id>`` — assemble ONE
+  request's cross-process timeline from every node's request-record
+  publication in the rendezvous store (ISSUE 15): front door, router,
+  prefill/decode workers, each a clock-aligned lane showing queue wait,
+  admission, preempt/replay, transfer batches, and token timing.  Exit
+  0 with the timeline, 3 when the id is unknown; ``--out`` writes the
+  lanes as a Chrome-trace JSON for Perfetto.
 
 The emitted bench JSON lines carry the gated serving metrics
 (``serving_p99_ttft_ms``, ``prefix_hit_rate``, ``serving_net_*``) in
@@ -166,12 +173,17 @@ def sse_events(resp) -> "Any":
 
 def http_generate_stream(host: str, port: int, prompt: list,
                          max_new_tokens: int, klass: str,
-                         timeout: float = 60.0) -> Dict[str, Any]:
+                         timeout: float = 60.0,
+                         trace: Optional[str] = None) -> Dict[str, Any]:
     """One streamed request through the front door; returns the tokens,
-    client-measured TTFT, and the server's ``done`` summary."""
+    client-measured TTFT, and the server's ``done`` summary.  ``trace``
+    rides the ``X-DS-Trace`` header (ISSUE 15)."""
     import http.client
     import time as _time
 
+    headers = {"Content-Type": "application/json", "X-DS-Class": klass}
+    if trace:
+        headers["X-DS-Trace"] = str(trace)
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         t0 = _time.monotonic()
@@ -180,8 +192,7 @@ def http_generate_stream(host: str, port: int, prompt: list,
             body=json.dumps({"prompt": prompt,
                              "max_new_tokens": max_new_tokens,
                              "stream": True}),
-            headers={"Content-Type": "application/json",
-                     "X-DS-Class": klass})
+            headers=headers)
         resp = conn.getresponse()
         if resp.status != 200:
             return {"status_code": resp.status,
@@ -343,7 +354,8 @@ def _build_worker_engine(args: argparse.Namespace):
 
         return SyntheticEngine(cache, max_batch_slots=args.slots,
                                prefill_chunk=args.block_size * 4,
-                               prefill_batch=2, decode_burst=4)
+                               prefill_batch=2, decode_burst=4,
+                               step_delay_s=args.step_delay_ms / 1e3)
     # tiny real model on whatever backend JAX has (CPU works)
     import jax.numpy as jnp
 
@@ -372,11 +384,20 @@ def worker_command(args: argparse.Namespace) -> int:
     # merged cluster view labels serving counters per replica process
     get_telemetry().configure(enabled=True, jsonl=False,
                               prometheus=False)
+    if args.trace_sample_rate is not None \
+            or args.trace_ring is not None \
+            or args.trace_anomaly_ttft_ms is not None:
+        from .tracing import configure_request_log
+
+        configure_request_log(sample_rate=args.trace_sample_rate,
+                              maxlen=args.trace_ring,
+                              anomaly_ttft_ms=args.trace_anomaly_ttft_ms)
     engine = _build_worker_engine(args)
     w = ServingWorker(engine, args.id, role=args.role, port=args.port,
                       store_endpoint=args.store,
                       kv_chunk_bytes=args.kv_chunk_bytes,
-                      poll_drip=args.drip)
+                      poll_drip=args.drip,
+                      telemetry_push_every_s=args.push_every)
     # one parseable readiness line, flushed — launchers wait on it
     print(f"DS_SERVING_WORKER id={w.id} role={w.role} "
           f"endpoint={w.endpoint}", flush=True)
@@ -395,18 +416,24 @@ def worker_command(args: argparse.Namespace) -> int:
 def _load_network_config(spec: Optional[str]):
     """``--ds-config``: a DeepSpeed config path or inline JSON whose
     ``serving.network`` group seeds the serve defaults (explicit CLI
-    flags win)."""
+    flags win).  The ``serving.tracing`` group, when present, is
+    applied to the process request log as a side input."""
     if not spec:
         return None
     import os
 
-    from ..runtime.config import ServingNetworkConfig
+    from ..runtime.config import ServingNetworkConfig, ServingTracingConfig
 
     if os.path.exists(spec):
         with open(spec) as fh:
             doc = json.load(fh)
     else:
         doc = json.loads(spec)
+    tgroup = (doc.get("serving") or {}).get("tracing")
+    if isinstance(tgroup, dict):
+        from .tracing import configure_tracing_from_config
+
+        configure_tracing_from_config(ServingTracingConfig(**tgroup))
     group = (doc.get("serving") or {}).get("network") or {}
     return ServingNetworkConfig(**group)
 
@@ -427,6 +454,8 @@ def serve_command(args: argparse.Namespace) -> int:
         door_params.queue_token_budget = args.queue_token_budget
     if args.retry_after is not None:
         door_params.retry_after_s = args.retry_after
+    if args.access_log is not None:
+        door_params.access_log = args.access_log
     net = net_params_from_config(ncfg) if ncfg is not None \
         else NetworkParams()
     if args.disaggregate:
@@ -456,9 +485,20 @@ def serve_command(args: argparse.Namespace) -> int:
         eps = []
         if workers > 0:
             prefill = prefill_workers if net.disaggregate else 0
+            # the serving.tracing config applied to THIS process must
+            # reach the workers it spawns, or their trace lanes run
+            # with default sampling/retention silently
+            from .tracing import get_request_log
+
+            rlog = get_request_log()
+            trace_args = [
+                "--trace-sample-rate", str(rlog.sample_rate),
+                "--trace-ring", str(rlog.maxlen),
+                "--trace-anomaly-ttft-ms", str(rlog.anomaly_ttft_ms)]
             fleet = launch_worker_fleet(workers, prefill=prefill,
                                         store=store,
-                                        engine=args.engine)
+                                        engine=args.engine,
+                                        extra_args=trace_args)
             eps = [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
                    for w in fleet]
         elif store:
@@ -472,7 +512,15 @@ def serve_command(args: argparse.Namespace) -> int:
         fe = NetworkFrontend(eps, net=net)
     else:
         fe, _ = _real_frontend(args.replicas)
-    door = FrontDoor(fe, host=host, port=port, params=door_params)
+    if store:
+        # the door is a trace lane too: publish its registry + request
+        # records over the rollup so `serving trace` sees the edge
+        from ..telemetry import get_telemetry
+
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+    door = FrontDoor(fe, host=host, port=port, params=door_params,
+                     store_endpoint=store)
     door.start()
     try:
         if args.dry_run:
@@ -504,6 +552,65 @@ def serve_command(args: argparse.Namespace) -> int:
             from ..launcher.serving_fleet import shutdown_fleet
 
             shutdown_fleet(fleet)
+
+
+def trace_command(args: argparse.Namespace) -> int:
+    """Assemble one request's cross-process timeline (ISSUE 15)."""
+    import os
+    import sys as _sys
+
+    from ..elasticity.rendezvous import RendezvousClient
+    from .tracing import (assemble_timeline, distinct_trace_ids,
+                          fetch_request_docs, find_trace,
+                          render_timeline, timeline_chrome_trace)
+
+    if not args.endpoint:
+        print("error: trace needs --endpoint host:port "
+              "(or $DS_RDZV_ENDPOINT)", file=_sys.stderr)
+        return 2
+    client = RendezvousClient(args.endpoint, retries=1, backoff_s=0.05)
+    try:
+        docs = fetch_request_docs(client)
+    except (ConnectionError, OSError) as e:
+        print(f"error: store unreachable at {args.endpoint}: {e}",
+              file=_sys.stderr)
+        return 2
+    finally:
+        try:
+            client.close()
+        except (OSError, ConnectionError):
+            pass  # read-only CLI teardown; nothing to leak
+    matches = find_trace(docs, args.trace_id)
+    if not matches:
+        nodes = ", ".join(sorted(docs)) or "none publishing"
+        print(f"no records for trace {args.trace_id!r} "
+              f"(nodes consulted: {nodes}) — the request was not "
+              f"sampled, fell off the retention window, or the id is "
+              f"wrong", file=_sys.stderr)
+        return 3
+    ids = distinct_trace_ids(matches)
+    if len(ids) > 1:
+        # a short prefix resolving to several requests must never
+        # merge them into one fabricated timeline
+        print(f"prefix {args.trace_id!r} is ambiguous — "
+              f"{len(ids)} distinct trace ids match: "
+              + ", ".join(ids[:8])
+              + (" …" if len(ids) > 8 else ""), file=_sys.stderr)
+        return 2
+    resolved = ids[0]
+    tl = assemble_timeline(matches)
+    if args.json:
+        print(json.dumps(tl, default=str, indent=2))
+    else:
+        print(render_timeline(tl))
+    if args.out:
+        doc = timeline_chrome_trace(docs, trace_id=resolved)
+        out = os.path.abspath(args.out)
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as fh:
+            json.dump(doc, fh)
+        print(f"chrome trace written: {out}", file=_sys.stderr)
+    return 0
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -551,6 +658,9 @@ def main(argv: Optional[list] = None) -> int:
     s.add_argument("--queue-token-budget", type=int, default=None)
     s.add_argument("--retry-after", type=float, default=None)
     s.add_argument("--kv-chunk-bytes", type=int, default=None)
+    s.add_argument("--access-log", default=None,
+                   help="structured JSONL access log path "
+                        "(one line per request, size-cap rotated)")
 
     w = sub.add_parser("worker", help="run ONE replica worker process")
     w.add_argument("--id", required=True)
@@ -568,6 +678,36 @@ def main(argv: Optional[list] = None) -> int:
     w.add_argument("--drip", type=int, default=0,
                    help="flow control: tokens per poll reply (0 = all; "
                         "chaos tests keep streams in flight with 1)")
+    w.add_argument("--trace-sample-rate", type=float, default=None,
+                   help="request-trace head sample rate (anomalies are "
+                        "always recorded)")
+    w.add_argument("--trace-ring", type=int, default=None,
+                   help="request-trace retention window (records)")
+    w.add_argument("--trace-anomaly-ttft-ms", type=float, default=None,
+                   help="TTFT (ms) past which a request is force-"
+                        "sampled as anomalous")
+    w.add_argument("--push-every", type=float, default=1.0,
+                   help="telemetry/request-record publish cadence (s)")
+    w.add_argument("--step-delay-ms", type=float, default=0.0,
+                   help="synthetic engine: wall-clock sleep per step "
+                        "(paces decode for chaos tests)")
+
+    import os as _os
+
+    t = sub.add_parser("trace", help="assemble one request's cross-"
+                                     "process timeline (exit 3 when "
+                                     "the id is unknown)")
+    t.add_argument("trace_id", help="the X-DS-Trace id (a unique "
+                                    "prefix >= 6 chars works)")
+    t.add_argument("--endpoint",
+                   default=_os.environ.get("DS_RDZV_ENDPOINT"),
+                   help="rendezvous store host:port "
+                        "(default: $DS_RDZV_ENDPOINT)")
+    t.add_argument("--json", action="store_true",
+                   help="emit the assembled timeline as JSON")
+    t.add_argument("--out", default=None,
+                   help="also write the request lanes as a Chrome-"
+                        "trace JSON (open in Perfetto)")
 
     args = p.parse_args(argv)
     if args.cmd == "bench":
@@ -576,6 +716,8 @@ def main(argv: Optional[list] = None) -> int:
         return serve_command(args)
     if args.cmd == "worker":
         return worker_command(args)
+    if args.cmd == "trace":
+        return trace_command(args)
     return 2
 
 

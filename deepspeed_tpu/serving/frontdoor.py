@@ -43,9 +43,11 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from ..utils.logging import log_dist, logger
+from ..utils.logging import log_dist, logger, warn_once
 from .frontend import NoHealthyReplicaError
 from .metrics import CLASSES
+from .tracing import (TRACE_HEADER, AccessLog, mint_trace_id,
+                      sanitize_trace_id)
 
 #: admission-class request header (overrides the body's "class")
 CLASS_HEADER = "X-DS-Class"
@@ -65,6 +67,12 @@ class FrontDoorParams:
     max_body_bytes: int = 1 << 20
     #: non-streaming requests block at most this long
     result_timeout_s: float = 600.0
+    #: structured access log (ISSUE 15): one JSONL line per request —
+    #: ts, method, path, status, class, trace id, duration_ms, tokens
+    #: streamed, close reason (incl. cancel-on-disconnect).  "" = off.
+    access_log: str = ""
+    #: size cap before the live file rotates to ``<path>.1``
+    access_log_max_bytes: int = 8 << 20
 
 
 def door_params_from_config(ncfg: Any) -> FrontDoorParams:
@@ -74,7 +82,10 @@ def door_params_from_config(ncfg: Any) -> FrontDoorParams:
         queue_token_budget=int(
             getattr(ncfg, "queue_token_budget", 32768)),
         retry_after_s=float(getattr(ncfg, "retry_after_s", 1.0)),
-        sse_heartbeat_s=float(getattr(ncfg, "sse_heartbeat_s", 5.0)))
+        sse_heartbeat_s=float(getattr(ncfg, "sse_heartbeat_s", 5.0)),
+        access_log=str(getattr(ncfg, "access_log", "") or ""),
+        access_log_max_bytes=int(
+            getattr(ncfg, "access_log_max_bytes", 8 << 20)))
 
 
 class _DoorHandler(BaseHTTPRequestHandler):
@@ -95,31 +106,68 @@ class _DoorHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        trace = getattr(self, "_trace_id", None)
+        if trace is not None:
+            # the trace id is echoed on EVERY reply — a 429 or a 400 is
+            # exactly when the client wants something to correlate with
+            self.send_header(TRACE_HEADER, trace)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
+    def _log_access(self, status: int, klass: Optional[str] = None,
+                    tokens: int = 0, close: str = "done",
+                    t0: Optional[float] = None) -> None:
+        log = self._door().access_log
+        if log is None:
+            return
+        import time as _time
+
+        log.write(method=self.command, path=self.path, status=int(status),
+                  klass=klass, trace=getattr(self, "_trace_id", None),
+                  duration_ms=(round((_time.perf_counter() - t0) * 1e3, 3)
+                               if t0 is not None else None),
+                  tokens=int(tokens), close=str(close),
+                  peer=(self.client_address[0]
+                        if self.client_address else None))
+
     # -- GET -----------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802 (http.server convention)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._trace_id = None
         door = self._door()
         if self.path == "/healthz":
             healthy = door.frontend.healthy_count()
             doc = {"ok": healthy > 0, "healthy_replicas": healthy,
                    "mode": door.mode}
-            self._send_json(200 if healthy > 0 else 503, doc)
+            code = 200 if healthy > 0 else 503
+            self._send_json(code, doc)
+            self._log_access(code, t0=t0)
             return
         if self.path == "/v1/metrics":
             self._send_json(200, door.frontend.snapshot())
+            self._log_access(200, t0=t0)
             return
         self._send_json(404, {"error": f"no such path {self.path!r}"})
+        self._log_access(404, close="bad_path", t0=t0)
 
     # -- POST ----------------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
+        import time as _time
+
+        t0 = _time.perf_counter()
+        # accept the edge's trace id, else mint one: every request is
+        # traceable, and the id is echoed on every reply either way
+        self._trace_id = (sanitize_trace_id(self.headers.get(TRACE_HEADER))
+                          or mint_trace_id())
         if self.path != "/v1/generate":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
+            self._log_access(404, close="bad_path", t0=t0)
             return
         door = self._door()
         params = door.params
@@ -132,6 +180,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send_json(400, {"error": "bad Content-Length"},
                             headers={"Connection": "close"})
+            self._log_access(400, close="validation", t0=t0)
             return
         if length <= 0:
             # no usable Content-Length (absent, zero, or a chunked
@@ -141,6 +190,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "empty request body "
                                            "(Content-Length required)"},
                             headers={"Connection": "close"})
+            self._log_access(400, close="validation", t0=t0)
             return
         if length > params.max_body_bytes:
             # replying without reading the oversized body leaves it in
@@ -150,14 +200,17 @@ class _DoorHandler(BaseHTTPRequestHandler):
                 "error": f"body of {length} bytes exceeds "
                          f"{params.max_body_bytes}"},
                 headers={"Connection": "close"})
+            self._log_access(413, close="validation", t0=t0)
             return
         try:
             body = json.loads(self.rfile.read(length))
         except ValueError as e:
             self._send_json(400, {"error": f"malformed JSON body: {e}"})
+            self._log_access(400, close="validation", t0=t0)
             return
         if not isinstance(body, dict):
             self._send_json(400, {"error": "body must be a JSON object"})
+            self._log_access(400, close="validation", t0=t0)
             return
         klass = (self.headers.get(CLASS_HEADER)
                  or body.get("class") or "interactive")
@@ -165,16 +218,19 @@ class _DoorHandler(BaseHTTPRequestHandler):
             self._send_json(400, {
                 "error": f"class: unknown latency class {klass!r} "
                          f"(one of {', '.join(CLASSES)})"})
+            self._log_access(400, close="validation", t0=t0)
             return
         prompt = body.get("prompt")
         if not isinstance(prompt, list) or not prompt:
             self._send_json(400, {
                 "error": "prompt: must be a non-empty token list"})
+            self._log_access(400, klass=klass, close="validation", t0=t0)
             return
         if not all(isinstance(t, int) and not isinstance(t, bool)
                    for t in prompt):
             self._send_json(400, {
                 "error": "prompt: every token must be an integer"})
+            self._log_access(400, klass=klass, close="validation", t0=t0)
             return
         max_new = body.get("max_new_tokens", 64)
         try:
@@ -182,6 +238,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
             door.frontend.validate(prompt, max_new)
         except (TypeError, ValueError) as e:
             self._send_json(400, {"error": str(e)})
+            self._log_access(400, klass=klass, close="validation", t0=t0)
             return
         # backpressure BEFORE anything is queued: the class budget is
         # in tokens, so one huge batch request cannot hide behind a
@@ -202,19 +259,23 @@ class _DoorHandler(BaseHTTPRequestHandler):
                  "queued_tokens": queued},
                 headers={"Retry-After":
                          str(max(1, int(round(params.retry_after_s))))})
+            self._log_access(429, klass=klass, close="shed", t0=t0)
             return
         try:
-            handle = door.frontend.submit(prompt, max_new, klass)
+            handle = door.frontend.submit(prompt, max_new, klass,
+                                          trace_id=self._trace_id)
         except ValueError as e:
             self._send_json(400, {"error": str(e)})
+            self._log_access(400, klass=klass, close="validation", t0=t0)
             return
         except NoHealthyReplicaError as e:
             self._send_json(503, {"error": str(e)})
+            self._log_access(503, klass=klass, close="no_replica", t0=t0)
             return
         if bool(body.get("stream", True)):
-            self._stream_sse(handle)
+            self._stream_sse(handle, t0)
         else:
-            self._blocking_result(handle)
+            self._blocking_result(handle, t0)
 
     # -- response modes -------------------------------------------------------
 
@@ -223,6 +284,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
             "status": handle.status,
             "tokens_delivered": handle.delivered,
             "replays": handle.replays,
+            "trace_id": handle.trace_id,
             "ttft_ms": (round(handle.ttft_ms, 3)
                         if handle.ttft_ms is not None else None)}
         if handle.ttft_breakdown:
@@ -231,23 +293,31 @@ class _DoorHandler(BaseHTTPRequestHandler):
                 for k, v in handle.ttft_breakdown.items()}
         return out
 
-    def _blocking_result(self, handle: Any) -> None:
+    def _blocking_result(self, handle: Any, t0: float) -> None:
         try:
             toks = handle.result(
                 timeout=self._door().params.result_timeout_s)
         except Exception as e:
             self._send_json(500, {"error": str(e),
                                   "status": handle.status})
+            self._log_access(500, klass=handle.klass,
+                             tokens=handle.delivered, close="error",
+                             t0=t0)
             return
         doc = {"tokens": toks}
         doc.update(self._summary(handle))
         self._send_json(200, doc)
+        self._log_access(200, klass=handle.klass, tokens=len(toks),
+                         close="done", t0=t0)
 
-    def _stream_sse(self, handle: Any) -> None:
+    def _stream_sse(self, handle: Any, t0: float) -> None:
         door = self._door()
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
+        trace = getattr(self, "_trace_id", None)
+        if trace is not None:
+            self.send_header(TRACE_HEADER, trace)
         # close-delimited body: no Content-Length for an unbounded
         # stream, and the close tells the client the stream is over
         self.send_header("Connection", "close")
@@ -275,14 +345,20 @@ class _DoorHandler(BaseHTTPRequestHandler):
                 err = value
                 if err is not None:
                     payload = json.dumps({"error": str(err),
-                                          "status": handle.status})
+                                          "status": handle.status,
+                                          "trace_id": handle.trace_id})
                     self.wfile.write(
                         f"event: error\ndata: {payload}\n\n".encode())
                 else:
+                    # the done event carries the trace id (_summary):
+                    # the SSE client's end of the correlation contract
                     payload = json.dumps(self._summary(handle))
                     self.wfile.write(
                         f"event: done\ndata: {payload}\n\n".encode())
                 self.wfile.flush()
+                self._log_access(200, klass=handle.klass, tokens=i,
+                                 close=("error" if err is not None
+                                        else "done"), t0=t0)
                 return
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the client went away mid-stream: cancel so abandoned
@@ -296,6 +372,8 @@ class _DoorHandler(BaseHTTPRequestHandler):
                     "serving/cancelled_on_disconnect_total",
                     help="streams cancelled because the client "
                          "disconnected")
+                self._log_access(200, klass=handle.klass, tokens=i,
+                                 close="client_disconnect", t0=t0)
 
 
 class FrontDoor:
@@ -307,12 +385,29 @@ class FrontDoor:
     def __init__(self, frontend: Any, host: str = "127.0.0.1",
                  port: int = 0,
                  params: Optional[FrontDoorParams] = None,
-                 own_frontend: bool = True):
+                 own_frontend: bool = True,
+                 store_endpoint: Optional[str] = None,
+                 node_id: str = "frontdoor",
+                 telemetry_push_every_s: float = 1.0):
         self.frontend = frontend
         self.params = params or FrontDoorParams()
         self.own_frontend = bool(own_frontend)
         self.mode = ("network"
                      if hasattr(frontend, "endpoints") else "local")
+        self.access_log: Optional[AccessLog] = None
+        if self.params.access_log:
+            self.access_log = AccessLog(
+                self.params.access_log,
+                max_bytes=self.params.access_log_max_bytes)
+        #: with a store endpoint, the door publishes its telemetry —
+        #: registry snapshot AND its request-record stream — on the
+        #: PR-13 rollup transport, clock-synced: the front-door lane of
+        #: every `serving trace` timeline comes from here
+        self.store_endpoint = store_endpoint
+        self.node_id = str(node_id)
+        self.telemetry_push_every_s = float(telemetry_push_every_s)
+        self._push_stop = threading.Event()
+        self._push_thread: Optional[threading.Thread] = None
         self._srv = ThreadingHTTPServer((host, int(port)), _DoorHandler)
         self._srv.daemon_threads = True
         self._srv.door = self  # type: ignore[attr-defined]
@@ -332,10 +427,46 @@ class FrontDoor:
                                         daemon=True,
                                         name="ds-serving-frontdoor")
         self._thread.start()
+        if self.store_endpoint and self._push_thread is None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, daemon=True,
+                name="ds-serving-frontdoor-publish")
+            self._push_thread.start()
         log_dist(f"serving front door ({self.mode} mode) at "
                  f"http://{self.endpoint}")
 
+    def _push_loop(self) -> None:
+        """The door's publisher beat (mirrors the worker's): clock sync
+        + registry/request-record push, degraded-mode tolerant."""
+        from ..elasticity.rendezvous import RendezvousClient
+        from ..telemetry import maybe_sync_clock, push_node_telemetry
+
+        client = None
+        try:
+            client = RendezvousClient(self.store_endpoint)
+            while not self._push_stop.wait(self.telemetry_push_every_s):
+                try:
+                    maybe_sync_clock(client, node_id=self.node_id)
+                    push_node_telemetry(client, self.node_id)
+                except Exception as e:  # store down: degraded, retry
+                    warn_once("serving/frontdoor-push",
+                              f"front-door telemetry push degraded "
+                              f"({e!r})")
+        except Exception as e:
+            warn_once("serving/frontdoor-push-boot",
+                      f"front-door publisher not started ({e!r})")
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception as e:
+                    logger.debug(f"frontdoor publisher close: {e!r}")
+
     def shutdown(self) -> None:
+        self._push_stop.set()
+        if self._push_thread is not None:
+            self._push_thread.join(timeout=5.0)
+            self._push_thread = None
         self._srv.shutdown()
         self._srv.server_close()
         if self._thread is not None:

@@ -126,6 +126,10 @@ class ServingHandle:
         #: disaggregated serving: {"prefill_ms", "transfer_ms",
         #: "decode_ms"} TTFT attribution (None for colocated requests)
         self.ttft_breakdown: Optional[Dict[str, float]] = None
+        #: distributed tracing (ISSUE 15): the propagated trace id and
+        #: this process's lifecycle record for the request
+        self.trace_id: Optional[str] = None
+        self.record: Any = None
         self._frontend = frontend
         # a REAL bound: when a stalled consumer lets it fill, _push
         # drops the oldest undelivered token — the pump never blocks
@@ -207,6 +211,15 @@ class ServingHandle:
                 error: Optional[BaseException] = None) -> None:
         self.status = status
         self.error = error
+        if self.record is not None:
+            # the ONE terminal point both front-ends and the worker's
+            # local pump share: close + commit the lifecycle record
+            # (the ring decides sampled-or-anomalous)
+            from .tracing import get_request_log
+
+            self.record.finish(status, ttft_ms=self.ttft_ms, error=error,
+                               breakdown=self.ttft_breakdown)
+            get_request_log().commit(self.record)
         self._put_drop_oldest(_DONE)
 
 
@@ -288,7 +301,14 @@ class ServingFrontend:
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt: List[int], max_new_tokens: int = 64,
-               klass: str = "interactive") -> ServingHandle:
+               klass: str = "interactive",
+               trace_id: Optional[str] = None,
+               sampled: Optional[bool] = None) -> ServingHandle:
+        """``trace_id``/``sampled`` propagate the distributed trace
+        context (ISSUE 15): the front door passes the minted/accepted
+        id through; absent, one is minted here so every request is
+        traceable.  ``sampled`` overrides the head-based decision (an
+        upstream hop that knows the request is anomalous forces it)."""
         if klass not in CLASSES:
             raise ValueError(f"klass: unknown latency class {klass!r} "
                              f"(one of {', '.join(CLASSES)})")
@@ -316,6 +336,13 @@ class ServingFrontend:
                               klass, self.clock(), self,
                               self.params.stream_buffer)
             self._uid += 1
+            from .tracing import get_request_log, mint_trace_id
+
+            h.trace_id = trace_id or mint_trace_id()
+            h.record = get_request_log().start(
+                h.trace_id, h.uid, klass, len(prompt),
+                int(max_new_tokens), sampled=sampled)
+            h.record.event("submitted")
             self._queues[klass].append(h)
             self.metrics.inc("submitted")
             from ..telemetry import get_telemetry
@@ -362,7 +389,9 @@ class ServingFrontend:
     # -- disaggregated adoption (decode side) ------------------------------
 
     def adopt_begin(self, prompt: List[int], max_new_tokens: int,
-                    klass: str = "interactive") -> "tuple":
+                    klass: str = "interactive",
+                    trace_id: Optional[str] = None,
+                    sampled: Optional[bool] = None) -> "tuple":
         """Reserve pages + a slot for a request prefilled ELSEWHERE.
         Returns ``(handle, need)`` — ``need`` is the list of prompt-page
         indices the KV transfer must fill (trie-shared pages excluded)
@@ -382,6 +411,14 @@ class ServingFrontend:
                               klass, self.clock(), self,
                               self.params.stream_buffer)
             self._uid += 1
+            from .tracing import get_request_log, mint_trace_id
+
+            h.trace_id = trace_id or mint_trace_id()
+            h.record = get_request_log().start(
+                h.trace_id, h.uid, klass, len(prompt),
+                int(max_new_tokens), sampled=sampled)
+            h.record.event("adopt_reserve", replica=rep.id,
+                           need_pages=len(need))
             h.request = req
             h.status = "adopting"
             h.replica_id = rep.id
@@ -405,6 +442,9 @@ class ServingFrontend:
                                        self.params.eos_token_id)
             handle.status = "running"
             handle.admitted_at = self.clock()
+            if handle.record is not None:
+                handle.record.event("admitted", replica=rep.id,
+                                    adopted=True)
             rep.active.append(handle)
 
     def adopt_abort(self, handle: ServingHandle,
@@ -554,6 +594,9 @@ class ServingFrontend:
         """The dead engine's scheduler state is unreachable; the handle
         restarts from its prompt on a healthy replica, delivery resumes
         past the already-streamed high-water mark."""
+        if h.record is not None:
+            h.record.event("replayed", from_replica=h.pinned_replica,
+                           delivered=h.delivered)
         h.request = None
         h.replica_id = None
         h.pinned_replica = None
@@ -651,9 +694,13 @@ class ServingFrontend:
             if rep is None or not rep.healthy():
                 return False
             if not rep.scheduler.resume(h.request):
+                if h.record is not None:
+                    h.record.note_blocked_admission()
                 return False
             h.status = "running"
             h.replica_id = rep.id
+            if h.record is not None:
+                h.record.event("resumed", replica=rep.id)
             rep.active.append(h)
             return True
         for rep in self.router.route_candidates(h.prompt):
@@ -672,8 +719,12 @@ class ServingFrontend:
             h.replica_id = rep.id
             h.pinned_replica = rep.id
             h.admitted_at = self.clock()
+            if h.record is not None:
+                h.record.event("admitted", replica=rep.id)
             rep.active.append(h)
             return True
+        if h.record is not None:
+            h.record.note_blocked_admission()
         return False
 
     def _preempt_for_interactive(self) -> bool:
@@ -707,6 +758,9 @@ class ServingFrontend:
             # bump the request expected to hold its slot longest: decode
             # with the most remaining budget first, else a prefill
             victim = max(victims, key=lambda h: h.request.remaining_budget)
+            if victim.record is not None:
+                victim.record.event("preempted", replica=rep.id,
+                                    release=release)
             if release:
                 pages = rep.scheduler.preempt_release(victim.request)
                 rep.active.remove(victim)
@@ -737,8 +791,14 @@ class ServingFrontend:
                 if h.consumed > h.delivered:
                     if h.first_token_at is None:
                         h.first_token_at = self.clock()
-                        self.metrics.record_ttft(h.klass, h.ttft_ms)
+                        self.metrics.record_ttft(h.klass, h.ttft_ms,
+                                                 ref=h.trace_id)
+                        if h.record is not None:
+                            h.record.event("first_token",
+                                           replica=rep.id)
                     h.delivered += 1
+                    if h.record is not None:
+                        h.record.token()
                     h._push(int(tok))
             if req.state.value == "done" and h.status == "running":
                 rep.active.remove(h)

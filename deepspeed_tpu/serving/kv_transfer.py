@@ -110,13 +110,17 @@ def inject_pages(engine: Any, blocks: List[int],
 
 def push_pages(rpc_fn, rid: str, payloads: Dict[int, Dict[str, Any]],
                chunk_bytes: int = DEFAULT_KV_CHUNK_BYTES,
-               timeout: Optional[float] = None) -> Dict[str, int]:
+               timeout: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Dict[str, int]:
     """Stream page payloads to a decode worker through ``rpc_fn`` (one
     ``rpc(requests) -> replies`` callable bound to the target
     endpoint).  Each page rides its own begin/chunk*/commit triplet so
     the receiver's sha256 gate is PER PAGE — one corrupt page names
-    itself instead of poisoning the whole transfer.  Raises
-    ``RuntimeError`` on refusal (checksum mismatch, unknown rid)."""
+    itself instead of poisoning the whole transfer.  ``trace_id``
+    stamps each page's ``begin`` message so a packet capture or a
+    receiver-side log attributes the transfer to its request (ISSUE
+    15 context propagation).  Raises ``RuntimeError`` on refusal
+    (checksum mismatch, unknown rid)."""
     step = max(1, int(chunk_bytes))
     reqs: List[Dict[str, Any]] = []
     total = 0
@@ -124,11 +128,14 @@ def push_pages(rpc_fn, rid: str, payloads: Dict[int, Dict[str, Any]],
         b64 = base64.b64encode(p["raw"]).decode("ascii")
         chunks = [b64[i:i + step] for i in range(0, len(b64), step)] \
             or [""]
-        reqs.append({"op": "kv_page_begin", "rid": rid, "page": page_index,
-                     "n": len(chunks), "sha256": p["sha256"],
-                     "nbytes": len(p["raw"]), "dtype": p["dtype"],
-                     "shape": p["shape"],
-                     "synthetic": bool(p.get("synthetic"))})
+        begin = {"op": "kv_page_begin", "rid": rid, "page": page_index,
+                 "n": len(chunks), "sha256": p["sha256"],
+                 "nbytes": len(p["raw"]), "dtype": p["dtype"],
+                 "shape": p["shape"],
+                 "synthetic": bool(p.get("synthetic"))}
+        if trace_id:
+            begin["trace"] = str(trace_id)
+        reqs.append(begin)
         reqs += [{"op": "kv_page_chunk", "rid": rid, "page": page_index,
                   "i": i, "v": ch} for i, ch in enumerate(chunks)]
         reqs.append({"op": "kv_page_commit", "rid": rid,

@@ -279,7 +279,9 @@ class NetworkFrontend:
                        for h in self._queues.get(klass, ()))
 
     def submit(self, prompt: List[int], max_new_tokens: int = 64,
-               klass: str = "interactive") -> ServingHandle:
+               klass: str = "interactive",
+               trace_id: Optional[str] = None,
+               sampled: Optional[bool] = None) -> ServingHandle:
         if klass not in CLASSES:
             raise ValueError(f"klass: unknown latency class {klass!r} "
                              f"(one of {', '.join(CLASSES)})")
@@ -295,6 +297,13 @@ class NetworkFrontend:
                               klass, self.clock(), self,
                               self.params.stream_buffer)
             self._uid += 1
+            from .tracing import get_request_log, mint_trace_id
+
+            h.trace_id = trace_id or mint_trace_id()
+            h.record = get_request_log().start(
+                h.trace_id, h.uid, klass, len(prompt),
+                int(max_new_tokens), sampled=sampled)
+            h.record.event("submitted")
             self._queues[klass].append(h)
             self.metrics.inc("submitted")
             from ..telemetry import get_telemetry
@@ -468,6 +477,9 @@ class NetworkFrontend:
         self._queues[h.klass].insert(0, h)
 
     def _reset_replay_cursor(self, h: ServingHandle) -> None:
+        if h.record is not None:
+            h.record.event("replayed", from_replica=h.replica_id,
+                           delivered=h.delivered)
         h.replays += 1
         h.consumed = 0
         h.status = "queued"
@@ -485,6 +497,9 @@ class NetworkFrontend:
             self._drained.add(ep.id)
             moved = 0
             for h in self._active.pop(ep.id, []):
+                if h.record is not None:
+                    h.record.event("replica_drained", replica=ep.id,
+                                   reason=str(ep.dead_reason)[:120])
                 self._requeue(h)
                 moved += 1
             if moved:
@@ -568,6 +583,16 @@ class NetworkFrontend:
                 h.status = "queued"
                 self._queues[h.klass].insert(0, h)
 
+    def _trace_fields(self, h: ServingHandle) -> Dict[str, Any]:
+        """The trace context an RPC carries: the id plus the effective
+        sampling verdict (head-based, forced once anomalous)."""
+        if h.trace_id is None:
+            return {}
+        out: Dict[str, Any] = {"trace": h.trace_id}
+        if h.record is not None:
+            out["sampled"] = h.record.propagate_sampled()
+        return out
+
     def _admit_plain(self, h: ServingHandle) -> bool:
         # cheap local budget screen FIRST: a saturated fleet (the
         # normal overload state) must cost zero match RPCs per retry
@@ -585,10 +610,11 @@ class NetworkFrontend:
             scored.append((-affinity, self._outstanding(ep), ep.id, ep))
         for ep in [t[-1] for t in sorted(scored, key=lambda t: t[:3])]:
             try:
-                r = ep.rpc([{"op": "submit", "rid": h.rid,
-                             "prompt": h.prompt,
-                             "max_new_tokens": h.max_new_tokens,
-                             "klass": h.klass}])[0]
+                r = ep.rpc([dict({"op": "submit", "rid": h.rid,
+                                  "prompt": h.prompt,
+                                  "max_new_tokens": h.max_new_tokens,
+                                  "klass": h.klass},
+                                 **self._trace_fields(h))])[0]
             except (ConnectionError, OSError):
                 continue
             if r.get("ok"):
@@ -597,6 +623,8 @@ class NetworkFrontend:
             if r.get("kind") == "validation":
                 self._fail_terminal(h, ValueError(str(r.get("err"))))
                 return True  # leaves the queue — terminally invalid
+        if h.record is not None:
+            h.record.note_blocked_admission()
         return False
 
     def _seat(self, h: ServingHandle, ep: ReplicaEndpoint) -> None:
@@ -604,6 +632,8 @@ class NetworkFrontend:
             h.status = "running"
             h.replica_id = ep.id
             h.admitted_at = self.clock()
+            if h.record is not None:
+                h.record.event("admitted", replica=ep.id)
             self._active.setdefault(ep.id, []).append(h)
 
     def _fail_terminal(self, h: ServingHandle, err: Exception) -> None:
@@ -629,10 +659,15 @@ class NetworkFrontend:
             # prefill fleet gone: colocated fallback keeps serving
             return self._admit_plain(h)
         pre = pres[0]
+        import time as _time
+
+        p0 = _time.perf_counter()
         try:
-            r = pre.rpc([{"op": "prefill", "rid": h.rid,
-                          "prompt": h.prompt,
-                          "max_new_tokens": h.max_new_tokens}])[0]
+            r = pre.rpc([dict({"op": "prefill", "rid": h.rid,
+                               "prompt": h.prompt,
+                               "max_new_tokens": h.max_new_tokens,
+                               "klass": h.klass},
+                              **self._trace_fields(h))])[0]
         except (ConnectionError, OSError):
             return False
         if not r.get("ok"):
@@ -640,16 +675,22 @@ class NetworkFrontend:
                 self._fail_terminal(h, ValueError(str(r.get("err"))))
                 return True
             return False
+        if h.record is not None:
+            # the phase as THIS lane saw it (RPC-inclusive); the
+            # prefill worker's own lane carries the engine-side number
+            h.record.phase("prefill_rpc", start_ts=p0, replica=pre.id,
+                           prefill_ms=r.get("prefill_ms"))
         first = int(r["first_token"])
         adopted = None
         for dec in sorted(decs, key=lambda e: (self._outstanding(e),
                                                e.id)):
             try:
-                rb = dec.rpc([{"op": "adopt_begin", "rid": h.rid,
-                               "prompt": h.prompt,
-                               "max_new_tokens": h.max_new_tokens,
-                               "first_token": first,
-                               "klass": h.klass}])[0]
+                rb = dec.rpc([dict({"op": "adopt_begin", "rid": h.rid,
+                                    "prompt": h.prompt,
+                                    "max_new_tokens": h.max_new_tokens,
+                                    "first_token": first,
+                                    "klass": h.klass},
+                                   **self._trace_fields(h))])[0]
             except (ConnectionError, OSError):
                 continue
             if rb.get("ok"):
@@ -671,15 +712,23 @@ class NetworkFrontend:
                 if h.first_token_at is None:
                     h.first_token_at = self.clock()
                     with self._lock:
-                        self.metrics.record_ttft(h.klass, h.ttft_ms)
+                        self.metrics.record_ttft(h.klass, h.ttft_ms,
+                                                 ref=h.trace_id)
                 h.delivered = 1
+                if h.record is not None:
+                    h.record.event("first_token", replica=pre.id,
+                                   disagg=True)
+                    h.record.token()
                 h._push(first)
         t1 = self.clock()
+        x0 = _time.perf_counter()
         try:
             if need:
-                kv = pre.rpc([{"op": "kv_push", "rid": h.rid,
-                               "to": dec.endpoint, "pages": need,
-                               "chunk_bytes": self.net.kv_chunk_bytes}],
+                kv = pre.rpc([dict({"op": "kv_push", "rid": h.rid,
+                                    "to": dec.endpoint, "pages": need,
+                                    "chunk_bytes":
+                                        self.net.kv_chunk_bytes},
+                                   **self._trace_fields(h))],
                              timeout=self.net.rpc_timeout_s)[0]
                 if not kv.get("ok"):
                     raise RuntimeError(f"kv_push refused: {kv.get('err')}")
@@ -703,6 +752,10 @@ class NetworkFrontend:
             "prefill_ms": float(r.get("prefill_ms", 0.0)),
             "transfer_ms": round((t2 - t1) * 1e3, 3)}
         h._transfer_done_at = t2
+        if h.record is not None:
+            h.record.phase("transfer", start_ts=x0,
+                           pages=len(need), from_replica=pre.id,
+                           to_replica=dec.id)
         self._seat(h, dec)
         with self._lock:
             self.metrics.record_disagg(h.ttft_breakdown)
@@ -782,7 +835,11 @@ class NetworkFrontend:
             if h.consumed > h.delivered:
                 if h.first_token_at is None:
                     h.first_token_at = self.clock()
-                    self.metrics.record_ttft(h.klass, h.ttft_ms)
+                    self.metrics.record_ttft(h.klass, h.ttft_ms,
+                                             ref=h.trace_id)
+                    if h.record is not None:
+                        h.record.event("first_token",
+                                       replica=h.replica_id)
                 bd = h.ttft_breakdown
                 if bd is not None and "decode_ms" not in bd:
                     t0 = getattr(h, "_transfer_done_at", None)
@@ -791,7 +848,14 @@ class NetworkFrontend:
                             (self.clock() - t0) * 1e3, 3)
                         self.metrics.record_disagg(
                             {"decode_ms": bd["decode_ms"]}, count=False)
+                        if h.record is not None:
+                            h.record.phase(
+                                "decode_first_burst",
+                                dur_ms=bd["decode_ms"],
+                                replica=h.replica_id)
                 h.delivered += 1
+                if h.record is not None:
+                    h.record.token()
                 h._push(int(tok))
                 delivered += 1
         return delivered

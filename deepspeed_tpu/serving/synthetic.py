@@ -60,7 +60,8 @@ class SyntheticEngine:
                  prefix_sharing: bool = True,
                  clock: Optional[FakeClock] = None,
                  prefill_cost_s: float = 0.004,
-                 decode_cost_s: float = 0.002):
+                 decode_cost_s: float = 0.002,
+                 step_delay_s: float = 0.0):
         self.cache_config = cache_config or KVCacheConfig(
             num_blocks=256, block_size=16, max_seq_len=1024)
         self.scheduler = ServingScheduler(
@@ -72,6 +73,9 @@ class SyntheticEngine:
         self._clock = clock
         self.prefill_cost_s = float(prefill_cost_s)
         self.decode_cost_s = float(decode_cost_s)
+        #: REAL wall-clock sleep per step: paces worker-process decode
+        #: so chaos tests can kill -9 a replica genuinely mid-stream
+        self.step_delay_s = float(step_delay_s)
         self.steps = 0
 
     # -- the engine surface the front-end drives ---------------------------
@@ -84,6 +88,10 @@ class SyntheticEngine:
         """One planner step, mirroring the real engine's control flow
         (burst 1 while prefill work interleaves, else decode_burst)."""
         del temperature  # synthetic tokens are class-less
+        if self.step_delay_s > 0:
+            import time
+
+            time.sleep(self.step_delay_s)
         chunks, decode = self.scheduler.plan_step()
         n = 0
         cost = 0.0

@@ -1,0 +1,428 @@
+"""Distributed request tracing — follow ONE request across the fleet
+(ISSUE 15 tentpole).
+
+A request entering the serving plane crosses real process and network
+boundaries (front door → NetworkFrontend → prefill worker → P2P KV
+transfer → decode worker); the aggregate trackers say *that* p99
+regressed, never *which request* or *where its time went*.  This module
+is the Dapper-style answer:
+
+* **Context propagation** — the front door mints (or accepts via the
+  ``X-DS-Trace`` header) a request trace id; it rides the worker
+  JSON-line protocol (``submit``/``prefill``/``adopt_begin``) and the
+  KV-transfer page messages, so every process touching the request tags
+  its :class:`~.metrics.RequestRecord` with it.  Sampling is head-based
+  and DETERMINISTIC on the id (:func:`~.metrics.head_sampled`), with an
+  explicit ``sampled`` flag riding the RPCs once a request turns
+  anomalous (a replay must be recorded on the worker it replays to,
+  even at ``sample_rate=0``).
+* **Cross-process shipment** — the process-global :class:`RequestLog`
+  registers as a rollup *aux stream* (``telemetry/requests/<node>``,
+  the PR-13 push path: store-down beats leave the batch buffered; the
+  publication always holds the last window plus open-record snapshots,
+  so a ``kill -9``'d worker's final push still shows its partial lane).
+* **Assembly** — :func:`assemble_timeline` merges every node's records
+  for one trace id into clock-aligned lanes (each publication carries
+  its node's clocksync status; ``perf_counter + offset_s`` is the store
+  clock), rendered as text (``python -m deepspeed_tpu.serving trace
+  <id>``) or as Chrome-trace request lanes (``--out``, and folded into
+  ``telemetry collect``'s ``cluster_trace.json``).
+
+Also here: the front door's structured :class:`AccessLog` (one JSONL
+line per request, size-capped rotation) — the flat index you grep for a
+trace id before assembling its timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import warn_once
+from .metrics import RequestLog
+
+#: the request trace-id header: accepted on ``POST /v1/generate``,
+#: echoed on every reply (including 4xx/429) and in the SSE ``done``
+#: event — an edge proxy can stamp it and correlate end to end
+TRACE_HEADER = "X-DS-Trace"
+
+#: store key prefix for per-node request-record publications (the
+#: rollup aux stream)
+REQUESTS_PREFIX = "telemetry/requests/"
+
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
+
+
+def mint_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def sanitize_trace_id(raw: Any) -> Optional[str]:
+    """A client-supplied trace id, or None when absent/unusable (the
+    caller mints one instead — a hostile header must not be able to
+    smuggle newlines into logs or store keys)."""
+    if not raw:
+        return None
+    s = str(raw).strip()
+    return s if _TRACE_ID_RE.match(s) else None
+
+
+# ---------------------------------------------------------------------------
+# the process-global request log (registered as a rollup aux stream)
+# ---------------------------------------------------------------------------
+
+_request_log = RequestLog()
+
+
+def get_request_log() -> RequestLog:
+    return _request_log
+
+
+def configure_request_log(**kw: Any) -> RequestLog:
+    return _request_log.configure(**kw)
+
+
+def configure_tracing_from_config(tcfg: Any) -> RequestLog:
+    """Map the ``serving.tracing.*`` config group onto the process
+    request log."""
+    return _request_log.configure(
+        enabled=bool(getattr(tcfg, "enabled", True)),
+        sample_rate=float(getattr(tcfg, "sample_rate", 1.0)),
+        maxlen=int(getattr(tcfg, "ring", 256)),
+        anomaly_ttft_ms=float(getattr(tcfg, "anomaly_ttft_ms", 2000.0)),
+        token_cap=int(getattr(tcfg, "token_timings", 512)))
+
+
+def _register_aux_stream() -> None:
+    from ..telemetry.rollup import register_aux_stream
+
+    register_aux_stream("requests", _request_log)
+
+
+# importing the serving plane wires its request stream into every
+# subsequent push_node_telemetry beat (worker heartbeats, the front
+# door's publisher, the elastic agent's tick) — no extra transport
+_register_aux_stream()
+
+
+# ---------------------------------------------------------------------------
+# front-door structured access log (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+class AccessLog:
+    """One JSONL line per front-door request, size-cap rotated.
+
+    Rotation keeps exactly one predecessor (``<path>.1`` — the same
+    newest-K posture as flight-recorder bundle retention): when the
+    live file would exceed ``max_bytes`` it is renamed aside and a
+    fresh one starts, so the log can never eat the disk under a
+    request flood."""
+
+    def __init__(self, path: str, max_bytes: int = 8 << 20):
+        self.path = str(path)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        try:
+            self._size = os.path.getsize(self.path)
+        except OSError:
+            self._size = 0
+
+    def write(self, **fields: Any) -> None:
+        fields.setdefault("ts", round(time.time(), 3))
+        line = json.dumps(fields, default=str) + "\n"
+        data = line.encode()
+        with self._lock:
+            try:
+                if self._size and self._size + len(data) > self.max_bytes:
+                    os.replace(self.path, self.path + ".1")
+                    self._size = 0
+                with open(self.path, "a") as fh:
+                    fh.write(line)
+                self._size += len(data)
+            except OSError as e:
+                warn_once("serving/access-log",
+                          f"access log write failed ({e!r}); "
+                          f"requests keep serving")
+
+
+# ---------------------------------------------------------------------------
+# fetch + assembly (the read side)
+# ---------------------------------------------------------------------------
+
+def fetch_request_docs(client: Any) -> Dict[str, Dict[str, Any]]:
+    """Every node's current request-record publication from the store:
+    ``{node_id: {stream, clock, records: [...]}}``."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for key in sorted(client.keys(REQUESTS_PREFIX)):
+        doc = client.get(key)
+        if isinstance(doc, dict) and isinstance(doc.get("records"), list):
+            out[key[len(REQUESTS_PREFIX):]] = doc
+    return out
+
+
+def find_trace(docs: Dict[str, Dict[str, Any]], trace_id: str
+               ) -> List[Dict[str, Any]]:
+    """Matches for one trace id across every node's publication:
+    ``[{node, aligned, offset_s, record}]``.  A prefix of the id
+    (>= 6 chars) matches too — operators paste truncated ids — but an
+    EXACT match always wins outright, and a prefix that resolves to
+    more than one distinct id returns all of them so the caller can
+    refuse to merge two requests into one timeline
+    (:func:`distinct_trace_ids`)."""
+    tid = str(trace_id)
+    exact: List[Dict[str, Any]] = []
+    prefix: List[Dict[str, Any]] = []
+    for node, doc in sorted(docs.items()):
+        clock = doc.get("clock") or {}
+        aligned = bool(clock.get("synced")) \
+            and isinstance(clock.get("offset_s"), (int, float))
+        for rec in doc.get("records") or []:
+            rid = str(rec.get("trace_id", ""))
+            m = {"node": node, "aligned": aligned,
+                 "offset_s": float(clock.get("offset_s") or 0.0),
+                 "record": rec}
+            if rid == tid:
+                exact.append(m)
+            elif len(tid) >= 6 and rid.startswith(tid):
+                prefix.append(m)
+    return exact if exact else prefix
+
+
+def distinct_trace_ids(matches: List[Dict[str, Any]]) -> List[str]:
+    return sorted({str(m["record"].get("trace_id", ""))
+                   for m in matches})
+
+
+def assemble_timeline(matches: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One clock-aligned timeline from every lane that touched the
+    request.  Aligned lanes land on the shared store clock
+    (``perf_ts + offset_s``) re-based to the earliest aligned instant;
+    unaligned lanes are included flagged, re-based to their own start
+    (internal order preserved) — same contract as the PR-13 merged
+    trace."""
+    if not matches:
+        return {"lanes": [], "trace_id": None}
+    tid = str(matches[0]["record"].get("trace_id"))
+    aligned_starts = [
+        float(m["record"].get("start_ts", 0.0)) + m["offset_s"]
+        for m in matches if m["aligned"]]
+    base_s = min(aligned_starts) if aligned_starts else 0.0
+    lanes: List[Dict[str, Any]] = []
+    for m in matches:
+        rec = m["record"]
+        off = m["offset_s"] if m["aligned"] else 0.0
+        lane_base = base_s if m["aligned"] \
+            else float(rec.get("start_ts", 0.0))
+
+        def rel_ms(ts: Any) -> Optional[float]:
+            if not isinstance(ts, (int, float)):
+                return None
+            return round((float(ts) + off - lane_base) * 1e3, 3)
+
+        items: List[Dict[str, Any]] = []
+        for ev in rec.get("events") or []:
+            t = rel_ms(ev.get("ts"))
+            if t is None:
+                continue
+            it = {"t_ms": t, "kind": "event", "name": ev.get("name")}
+            it.update({k: v for k, v in ev.items()
+                       if k not in ("ts", "name")})
+            items.append(it)
+        for ph in rec.get("phases") or []:
+            t = rel_ms(ph.get("ts"))
+            if t is None:
+                continue
+            it = {"t_ms": t, "kind": "phase", "name": ph.get("phase"),
+                  "dur_ms": ph.get("dur_ms")}
+            it.update({k: v for k, v in ph.items()
+                       if k not in ("ts", "phase", "dur_ms")})
+            items.append(it)
+        items.sort(key=lambda it: it["t_ms"])
+        start_ms = rel_ms(rec.get("start_ts"))
+        end_ms = rel_ms(rec.get("end_ts"))
+        lane = {
+            "node": m["node"], "aligned": m["aligned"],
+            "status": rec.get("status"),
+            "done": bool(rec.get("done", True)),
+            "klass": rec.get("klass"),
+            "start_ms": start_ms, "end_ms": end_ms,
+            "span_ms": (round(end_ms - start_ms, 3)
+                        if None not in (start_ms, end_ms) else None),
+            "tokens": rec.get("tokens"),
+            "replays": rec.get("replays"),
+            "preempts": rec.get("preempts"),
+            "items": items,
+            "record": rec,
+        }
+        lanes.append(lane)
+    lanes.sort(key=lambda ln: (not ln["aligned"], ln["start_ms"] or 0.0,
+                               ln["node"]))
+    spans = [ln["end_ms"] for ln in lanes
+             if ln["aligned"] and ln["end_ms"] is not None]
+    return {"trace_id": tid, "lanes": lanes,
+            "aligned_lanes": sum(1 for ln in lanes if ln["aligned"]),
+            "wall_ms": round(max(spans), 3) if spans else None}
+
+
+def render_timeline(tl: Dict[str, Any]) -> str:
+    """Operator text view: one lane per (node, record), events/phases
+    in clock-aligned order."""
+    lines = [f"trace {tl.get('trace_id')}: {len(tl['lanes'])} lane(s), "
+             f"{tl.get('aligned_lanes', 0)} clock-aligned"
+             + (f", wall {tl['wall_ms']:.1f} ms"
+                if tl.get("wall_ms") is not None else "")]
+    for ln in tl["lanes"]:
+        flags = []
+        if not ln["aligned"]:
+            flags.append("UNALIGNED")
+        if not ln["done"]:
+            flags.append("OPEN (partial — process died or in flight)")
+        anomaly = (ln["record"] or {}).get("anomaly")
+        if anomaly:
+            flags.append(f"anomaly={anomaly}")
+        head = (f"[{ln['node']}] {ln['klass']} status={ln['status']} "
+                f"tokens={ln['tokens']} replays={ln['replays']}")
+        if ln.get("span_ms") is not None:
+            head += f" span={ln['span_ms']:.1f}ms"
+        if flags:
+            head += "  " + " ".join(flags)
+        lines.append(head)
+        rec = ln["record"] or {}
+        if rec.get("queue_wait_ms") is not None:
+            lines.append(f"    queue_wait {rec['queue_wait_ms']:.1f} ms "
+                         f"(admission attempts "
+                         f"{rec.get('admission_attempts', 0)})")
+        for it in ln["items"]:
+            extra = {k: v for k, v in it.items()
+                     if k not in ("t_ms", "kind", "name", "dur_ms")}
+            tail = (" ".join(f"{k}={v}" for k, v in extra.items())
+                    if extra else "")
+            if it["kind"] == "phase":
+                lines.append(
+                    f"    +{it['t_ms']:>10.1f} ms  {it['name']:<20} "
+                    f"{float(it.get('dur_ms') or 0.0):>8.1f} ms  {tail}")
+            else:
+                lines.append(
+                    f"    +{it['t_ms']:>10.1f} ms  {it['name']:<20} "
+                    f"{'':>8}     {tail}")
+        gaps = rec.get("gap_p99_ms")
+        if gaps is not None:
+            lines.append(f"    token gaps: p50 {rec.get('gap_p50_ms')} ms "
+                         f"p99 {gaps} ms max {rec.get('gap_max_ms')} ms")
+    return "\n".join(lines)
+
+
+def request_trace_events(node: str, doc: Dict[str, Any], pid: int,
+                         base_us: Optional[float] = None
+                         ) -> "tuple[List[Dict[str, Any]], bool]":
+    """One node's request publication as Chrome-trace events on lane
+    ``pid`` — the shape ``cluster_trace.json`` and Perfetto load.
+    ``base_us`` is the shared store-clock origin in microseconds (the
+    PR-13 merged trace's ``store_clock_base_us``); aligned events are
+    re-based onto it.  Returns ``(events, aligned)``."""
+    clock = doc.get("clock") or {}
+    aligned = bool(clock.get("synced")) \
+        and isinstance(clock.get("offset_s"), (int, float))
+    off_us = float(clock.get("offset_s") or 0.0) * 1e6
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid,
+        "args": {"name": f"{node} requests"
+                 + ("" if aligned else " (unaligned)")}}]
+    recs = [r for r in (doc.get("records") or []) if isinstance(r, dict)]
+    lane_min = min((float(r.get("start_ts", 0.0)) for r in recs),
+                   default=0.0) * 1e6
+
+    def ts_us(ts: float) -> float:
+        t = float(ts) * 1e6
+        if aligned:
+            return round(t + off_us - (base_us or 0.0), 1)
+        return round(t - lane_min, 1)
+
+    for rec in recs:
+        tid8 = str(rec.get("trace_id", ""))[:8]
+        start = rec.get("start_ts")
+        end = rec.get("end_ts")
+        if isinstance(start, (int, float)):
+            dur = ((float(end) - float(start)) * 1e6
+                   if isinstance(end, (int, float)) else 0.0)
+            events.append({
+                "ph": "X", "cat": "request", "pid": pid, "tid": 0,
+                "name": f"request {tid8} ({rec.get('klass')})",
+                "ts": ts_us(start), "dur": round(max(dur, 1.0), 1),
+                "args": {"trace_id": rec.get("trace_id"),
+                         "status": rec.get("status"),
+                         "tokens": rec.get("tokens"),
+                         "replays": rec.get("replays"),
+                         "done": rec.get("done", True)}})
+        for ph in rec.get("phases") or []:
+            ts = ph.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            events.append({
+                "ph": "X", "cat": "request", "pid": pid, "tid": 1,
+                "name": f"{ph.get('phase')} [{tid8}]",
+                "ts": ts_us(ts),
+                "dur": round(max(float(ph.get("dur_ms") or 0.0)
+                                 * 1e3, 1.0), 1),
+                "args": {k: v for k, v in ph.items()
+                         if k not in ("ts", "phase")}})
+        for ev in rec.get("events") or []:
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            events.append({
+                "ph": "i", "cat": "request", "pid": pid, "tid": 1,
+                "s": "t", "name": f"{ev.get('name')} [{tid8}]",
+                "ts": ts_us(ts),
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("ts", "name")}})
+    return events, aligned
+
+
+def timeline_chrome_trace(docs: Dict[str, Dict[str, Any]],
+                          trace_id: Optional[str] = None
+                          ) -> Dict[str, Any]:
+    """A standalone Chrome-trace document of request lanes (one pid per
+    node), optionally filtered to one trace id — what ``serving trace
+    --out`` writes for Perfetto."""
+    filtered: Dict[str, Dict[str, Any]] = {}
+    for node, doc in docs.items():
+        recs = doc.get("records") or []
+        if trace_id is not None:
+            tid = str(trace_id)
+            recs = [r for r in recs
+                    if str(r.get("trace_id", "")) == tid
+                    or (len(tid) >= 6
+                        and str(r.get("trace_id", "")).startswith(tid))]
+        if recs:
+            filtered[node] = dict(doc, records=recs)
+    base_candidates = []
+    for doc in filtered.values():
+        clock = doc.get("clock") or {}
+        if clock.get("synced") and isinstance(clock.get("offset_s"),
+                                              (int, float)):
+            for r in doc["records"]:
+                if isinstance(r.get("start_ts"), (int, float)):
+                    base_candidates.append(
+                        (float(r["start_ts"])
+                         + float(clock["offset_s"])) * 1e6)
+    base_us = min(base_candidates) if base_candidates else 0.0
+    events: List[Dict[str, Any]] = []
+    hosts: Dict[str, Any] = {}
+    for pid, node in enumerate(sorted(filtered)):
+        evs, aligned = request_trace_events(node, filtered[node], pid,
+                                            base_us=base_us)
+        events.extend(evs)
+        hosts[node] = {"pid": pid, "aligned": aligned,
+                       "records": len(filtered[node]["records"])}
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"source": "deepspeed_tpu.serving.tracing",
+                         "trace_id": trace_id,
+                         "store_clock_base_us": base_us,
+                         "hosts": hosts}}

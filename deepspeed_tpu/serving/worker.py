@@ -137,6 +137,7 @@ class ServingWorker:
         self._prefills: Dict[str, Dict[str, Any]] = {}
         #: rid -> {"handle", "need", "stager", "first_token"}
         self._adopts: Dict[str, Dict[str, Any]] = {}
+        self._prefills_served = 0
         self._lock = threading.Lock()
         #: serializes the prefill role's direct engine drive (put ->
         #: step* -> unseat must be atomic: a second prefill stepping
@@ -179,22 +180,51 @@ class ServingWorker:
                         {"endpoint": self.endpoint, "role": self.role,
                          "pid": os.getpid()}, journal=True)
         self._store.hb(f"rdzv/hb/{self.id}")
-
-        def _beat():
-            while not self._hb_stop.wait(push_every_s):
-                try:
-                    self._store.hb(f"rdzv/hb/{self.id}")
-                    from ..telemetry import push_node_telemetry
-
-                    push_node_telemetry(self._store, self.id)
-                except Exception as e:  # store down: degraded, retry
-                    warn_once("serving/worker-hb",
-                              f"worker heartbeat degraded ({e!r})")
-
         self._hb_thread = threading.Thread(
-            target=_beat, daemon=True,
+            target=self._beat_loop, args=(push_every_s,), daemon=True,
             name=f"ds-serving-worker-hb-{self.id}")
         self._hb_thread.start()
+
+    def _beat_loop(self, push_every_s: float) -> None:
+        """The heartbeat/publish thread: store heartbeat, clock sync
+        (what clock-aligns this worker's request-trace lane), registry
+        + request-record push, and the live-load gauges ``telemetry top
+        --serving`` renders."""
+        last_tokens = 0
+        last_mono = time.monotonic()
+        while not self._hb_stop.wait(push_every_s):
+            try:
+                self._store.hb(f"rdzv/hb/{self.id}")
+                from ..telemetry import (get_telemetry, maybe_sync_clock,
+                                         push_node_telemetry)
+
+                maybe_sync_clock(self._store, node_id=self.id)
+                tel = get_telemetry()
+                if tel.enabled:
+                    st = self.stats()
+                    tel.set_gauge("serving/worker_active",
+                                  float(st.get("active", 0)),
+                                  help="requests active on this worker")
+                    tel.set_gauge("serving/worker_queued",
+                                  float(st.get("queued", 0)),
+                                  help="requests queued on this worker")
+                    tel.set_gauge(
+                        "serving/worker_outstanding_tokens",
+                        float(st.get("outstanding_tokens", 0)),
+                        help="admitted-but-unfinished token budget")
+                    toks = int(st.get("tokens_delivered", 0))
+                    now = time.monotonic()
+                    dt = max(now - last_mono, 1e-6)
+                    tel.set_gauge(
+                        "serving/worker_tok_s",
+                        max(0.0, (toks - last_tokens) / dt),
+                        help="tokens/s delivered over the last "
+                             "heartbeat interval")
+                    last_tokens, last_mono = toks, now
+                push_node_telemetry(self._store, self.id)
+            except Exception as e:  # store down: degraded, retry
+                warn_once("serving/worker-hb",
+                          f"worker heartbeat degraded ({e!r})")
 
     def shutdown(self) -> None:
         self._hb_stop.set()
@@ -261,15 +291,22 @@ class ServingWorker:
             out["prefix"] = sched.prefix.stats()
             out["preemptions"] = int(sched.preemptions)
         if self.frontend is not None:
-            reps = self.frontend.router.replicas
-            out["outstanding_tokens"] = sum(r.outstanding_tokens()
-                                            for r in reps)
-            out["active"] = sum(len(r.active) for r in reps)
+            with self.frontend._lock:
+                reps = self.frontend.router.replicas
+                out["outstanding_tokens"] = sum(r.outstanding_tokens()
+                                                for r in reps)
+                out["active"] = sum(len(r.active) for r in reps)
+                out["queued"] = sum(
+                    len(q) for q in self.frontend._queues.values())
+                out["tokens_delivered"] = sum(
+                    self.frontend.metrics.tokens.values())
         else:
             with self._lock:
                 out["outstanding_tokens"] = sum(
                     len(p["prompt"]) for p in self._prefills.values())
                 out["active"] = len(self._prefills)
+                out["queued"] = 0
+                out["tokens_delivered"] = self._prefills_served
         return out
 
     def _match(self, prompt: List[int]) -> int:
@@ -283,15 +320,28 @@ class ServingWorker:
 
     # -- submit / poll / cancel ---------------------------------------------
 
+    @staticmethod
+    def _trace_of(req: Dict[str, Any]) -> "tuple":
+        """The propagated trace context of one protocol request:
+        ``(trace_id, sampled)`` — ``sampled`` stays None (local
+        head-based decision) when the sender didn't carry a verdict."""
+        from .tracing import sanitize_trace_id
+
+        trace = sanitize_trace_id(req.get("trace"))
+        sampled = req.get("sampled")
+        return trace, (bool(sampled) if sampled is not None else None)
+
     def _op_submit(self, req: Dict[str, Any]) -> Dict[str, Any]:
         if self.frontend is None:
             return {"ok": False, "kind": "role",
                     "err": f"worker {self.id} is prefill-only"}
         rid = str(req["rid"])
+        trace, sampled = self._trace_of(req)
         try:
             h = self.frontend.submit(list(req["prompt"]),
                                      int(req.get("max_new_tokens", 64)),
-                                     str(req.get("klass", "interactive")))
+                                     str(req.get("klass", "interactive")),
+                                     trace_id=trace, sampled=sampled)
         except ValueError as e:
             return {"ok": False, "kind": "validation", "err": str(e)}
         with self._lock:
@@ -347,6 +397,12 @@ class ServingWorker:
         if pre is not None:
             with self._engine_lock:
                 self.engine.scheduler.cancel(pre["req"])
+            rec = pre.get("rec")
+            if rec is not None:
+                from .tracing import get_request_log
+
+                rec.finish("cancelled")
+                get_request_log().commit(rec)
         return {"ok": True}
 
     # -- prefill side (disaggregation) ----------------------------------------
@@ -369,6 +425,12 @@ class ServingWorker:
         for ent in pres:
             with self._engine_lock:
                 self.engine.scheduler.cancel(ent["req"])
+            rec = ent.get("rec")
+            if rec is not None:
+                from .tracing import get_request_log
+
+                rec.finish("expired")  # anomalous: always ringed
+                get_request_log().commit(rec)
         for ad in ads:
             self.frontend.adopt_abort(ad["handle"])
         if stale_pre or stale_ad:
@@ -388,6 +450,13 @@ class ServingWorker:
         self._expire_reservations()
         rid = str(req["rid"])
         prompt = list(req["prompt"])
+        trace, sampled = self._trace_of(req)
+        from .tracing import get_request_log, mint_trace_id
+
+        rec = get_request_log().start(
+            trace or mint_trace_id(), rid,
+            str(req.get("klass", "interactive")), len(prompt),
+            int(req.get("max_new_tokens", 0)), sampled=sampled)
         t0 = time.perf_counter()
         with self._engine_lock:
             try:
@@ -395,6 +464,8 @@ class ServingWorker:
                 # sampled token; the decode side holds the REAL budget
                 r = self.engine.put(prompt, 2)
             except ValueError as e:
+                rec.finish("failed", error=e)
+                get_request_log().commit(rec)
                 return {"ok": False, "kind": "validation", "err": str(e)}
             guard = 0
             while not r.generated and r.state.value != "done":
@@ -403,15 +474,22 @@ class ServingWorker:
                 guard += 1
                 if guard > 100_000:
                     self.engine.scheduler.cancel(r)
+                    rec.finish("failed",
+                               error=RuntimeError("prefill stalled"))
+                    get_request_log().commit(rec)
                     return {"ok": False,
                             "err": "prefill made no progress"}
             first = int(r.generated[0])
             # park: slot freed, pages stay referenced for kv_push
             self.engine.scheduler.unseat(r)
         ms = (time.perf_counter() - t0) * 1e3
+        rec.phase("prefill", start_ts=t0, worker=self.id)
+        rec.event("parked")
         with self._lock:
             self._prefills[rid] = {"req": r, "prompt": prompt,
-                                   "prefill_ms": ms, "ts": time.time()}
+                                   "prefill_ms": ms, "ts": time.time(),
+                                   "rec": rec}
+            self._prefills_served += 1
         n_pages = self.engine.scheduler.prompt_pages(len(prompt))
         from ..telemetry import get_telemetry
 
@@ -436,14 +514,21 @@ class ServingWorker:
                                         ent["req"].blocks, i)
                         for i in pages}
         from .remote import jsonline_rpc
+        from .tracing import sanitize_trace_id
 
         chunk = int(req.get("chunk_bytes", self.kv_chunk_bytes))
         out = push_pages(
             lambda reqs: jsonline_rpc(to, reqs,
                                       timeout=self.rpc_timeout_s),
-            rid, payloads, chunk_bytes=chunk)
+            rid, payloads, chunk_bytes=chunk,
+            trace_id=sanitize_trace_id(req.get("trace")))
         out["transfer_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
         out["ok"] = True
+        rec = ent.get("rec")
+        if rec is not None:
+            # one phase per kv_push call = one page batch on the wire
+            rec.phase("transfer_push", start_ts=t0, to=to,
+                      pages=out.get("pages"), bytes=out.get("bytes"))
         return out
 
     def _op_release(self, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -458,6 +543,13 @@ class ServingWorker:
             # land in the cached-free tier -> the next prefill of the
             # same header revives them instead of recomputing
             self.engine.scheduler.cancel(ent["req"])
+        rec = ent.get("rec")
+        if rec is not None:
+            from .tracing import get_request_log
+
+            rec.event("released")
+            rec.finish("done")
+            get_request_log().commit(rec)
         return {"ok": True}
 
     # -- decode side (adoption) ----------------------------------------------
@@ -468,10 +560,12 @@ class ServingWorker:
                     "err": f"worker {self.id} is prefill-only"}
         self._expire_reservations()
         rid = str(req["rid"])
+        trace, sampled = self._trace_of(req)
         try:
             h, need = self.frontend.adopt_begin(
                 list(req["prompt"]), int(req["max_new_tokens"]),
-                str(req.get("klass", "interactive")))
+                str(req.get("klass", "interactive")),
+                trace_id=trace, sampled=sampled)
         except ValueError as e:
             return {"ok": False, "kind": "validation", "err": str(e)}
         if h is None:
@@ -541,6 +635,12 @@ class ServingWorker:
         h = ad["handle"]
         skipped = (self.engine.scheduler.prompt_pages(len(h.prompt))
                    - len(ad["need"]))
+        if h.record is not None:
+            h.record.event(
+                "kv_received", pages=len(ad["stager"].ready),
+                bytes=sum(len(p.get("raw", b""))
+                          for p in ad["stager"].ready.values()),
+                skipped_pages=skipped)
         try:
             self.frontend.adopt_commit(
                 h, ad["first_token"],

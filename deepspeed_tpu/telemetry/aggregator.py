@@ -54,6 +54,11 @@ CLUSTER_MANIFEST = "cluster_manifest.json"
 #: with a lane (pid) per process, span timestamps shifted onto the
 #: shared store clock via each tracer's clock_sync metadata
 CLUSTER_TRACE = "cluster_trace.json"
+#: every node's serving request-record publication at collect time
+#: (ISSUE 15): the raw per-node docs `serving trace` assembles from,
+#: persisted so a post-mortem archive can replay the assembly offline —
+#: and folded into CLUSTER_TRACE as per-node request lanes
+CLUSTER_REQUESTS = "cluster_requests.json"
 _REQ_KEY = "debug/req"
 
 
@@ -585,6 +590,13 @@ def collect_cluster_archive(client: Any, peer_ids: Optional[List[str]] = None,
                            missing=missing, req_id=req_id,
                            partials=partials)
     try:
+        # request-trace lanes (ISSUE 15): persist every node's current
+        # request-record publication BEFORE the merged-trace build so
+        # one build folds bundle spans and request lanes together
+        collect_request_docs(client, archive)
+    except (OSError, ConnectionError, ValueError) as e:
+        logger.warning(f"aggregator: request-lane collect failed: {e!r}")
+    try:
         build_cluster_trace(archive)
     except Exception as e:  # the archive is still useful without it
         logger.warning(f"aggregator: cluster trace assembly failed: {e!r}")
@@ -764,6 +776,24 @@ def build_cluster_manifest(archive: str,
 # clock-aligned merged trace (ISSUE 13 tentpole)
 # ---------------------------------------------------------------------------
 
+def collect_request_docs(client: Any, archive: str) -> bool:
+    """Persist every node's serving request-record publication
+    (``telemetry/requests/<node>``) to ``<archive>/cluster_requests.
+    json``; True when any node had one.  ``build_cluster_trace`` then
+    folds them in as request lanes."""
+    from ..serving.tracing import fetch_request_docs
+
+    docs = fetch_request_docs(client)
+    if not docs:
+        return False
+    path = os.path.join(archive, CLUSTER_REQUESTS)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"nodes": docs}, fh, default=str)
+    os.replace(tmp, path)
+    return True
+
+
 def _newest_bundle_trace(node_dir: str) -> Optional[str]:
     for bundle in sorted(os.listdir(node_dir), reverse=True):
         p = os.path.join(node_dir, bundle, "trace.json")
@@ -788,10 +818,9 @@ def build_cluster_trace(archive: str, persist: bool = True
     result is what makes a store outage or a straggler legible as
     aligned slices across processes."""
     hosts_dir = os.path.join(archive, "hosts")
-    if not os.path.isdir(hosts_dir):
-        return None
     lanes: Dict[str, Dict[str, Any]] = {}
-    for node in sorted(os.listdir(hosts_dir)):
+    for node in (sorted(os.listdir(hosts_dir))
+                 if os.path.isdir(hosts_dir) else []):
         node_dir = os.path.join(hosts_dir, node)
         if not os.path.isdir(node_dir):
             continue
@@ -817,11 +846,35 @@ def build_cluster_trace(archive: str, persist: bool = True
                 off_us, (int, float)) else 0.0,
             "clock_sync": sync or None,
         }
-    if not lanes:
+    # serving request lanes (ISSUE 15): per-node request-record docs
+    # persisted by collect_request_docs — same store clock, so they
+    # share the merged timeline's base
+    req_docs: Dict[str, Dict[str, Any]] = {}
+    req_path = os.path.join(archive, CLUSTER_REQUESTS)
+    if os.path.exists(req_path):
+        try:
+            with open(req_path) as fh:
+                req_docs = {
+                    str(n): d for n, d in
+                    (json.load(fh).get("nodes") or {}).items()
+                    if isinstance(d, dict)}
+        except (OSError, ValueError) as e:
+            logger.warning(f"aggregator: unreadable {CLUSTER_REQUESTS} "
+                           f"({e!r}); request lanes skipped")
+    if not lanes and not req_docs:
         return None
     aligned_starts = [ev["ts"] + lane["offset_us"]
                       for lane in lanes.values() if lane["aligned"]
                       for ev in lane["events"]]
+    for doc in req_docs.values():
+        clock = doc.get("clock") or {}
+        if clock.get("synced") and isinstance(clock.get("offset_s"),
+                                              (int, float)):
+            aligned_starts.extend(
+                (float(r["start_ts"]) + float(clock["offset_s"])) * 1e6
+                for r in doc.get("records") or []
+                if isinstance(r, dict)
+                and isinstance(r.get("start_ts"), (int, float)))
     base_us = min(aligned_starts) if aligned_starts else 0.0
     out_events: List[Dict[str, Any]] = []
     hosts_meta: Dict[str, Any] = {}
@@ -845,6 +898,18 @@ def build_cluster_trace(archive: str, persist: bool = True
             "events": len(lane["events"]),
             "clock_sync": lane["clock_sync"],
         }
+    if req_docs:
+        from ..serving.tracing import request_trace_events
+
+        next_pid = len(lanes)
+        for node in sorted(req_docs):
+            evs, aligned = request_trace_events(
+                node, req_docs[node], next_pid, base_us=base_us)
+            out_events.extend(evs)
+            hosts_meta[f"{node} (requests)"] = {
+                "pid": next_pid, "aligned": aligned,
+                "events": len(evs) - 1, "requests": True}
+            next_pid += 1
     doc = {"traceEvents": out_events,
            "displayTimeUnit": "ms",
            "metadata": {"source": "deepspeed_tpu.telemetry.aggregator",

@@ -365,8 +365,68 @@ def cmd_collect(args: argparse.Namespace) -> int:
 # top — the live cluster view (ISSUE 13)
 # ---------------------------------------------------------------------------
 
+def _render_serving_rows(client: Any, silent_after_s: float = 30.0
+                         ) -> str:
+    """The serving-worker table for ``top --serving`` (ISSUE 15
+    satellite): registered workers (``serving/srv/*``), endpoint
+    health from heartbeat age, live load from the rollup-labeled
+    gauges each worker publishes.  Everything is already in the store
+    — this just renders it."""
+    from .aggregator import _heartbeat_view
+    from .rollup import collect_rollup
+
+    # lazy: the serving plane is optional at `top` time
+    from ..serving.worker import SRV_PREFIX
+
+    regs: Dict[str, Dict[str, Any]] = {}
+    for key in sorted(client.keys(SRV_PREFIX)):
+        v = client.get(key)
+        if isinstance(v, dict):
+            regs[key[len(SRV_PREFIX):]] = v
+    if not regs:
+        return "serving workers: none registered"
+    ids = sorted(regs)
+    rollup = collect_rollup(client, ids)
+    hb = _heartbeat_view(client, ids)
+    lines = [f"{'WORKER':<14} {'ROLE':<8} {'ENDPOINT':<22} "
+             f"{'ACTIVE':>6} {'QUEUED':>6} {'TOK/S':>8} {'REQS':>7} "
+             f"{'HB_AGE':>7} {'STATE':<8}"]
+
+    def g(doc, name):
+        snap = (doc or {}).get("snapshot") or {}
+        m = (snap.get("gauges") or {}).get(name)
+        return None if m is None else float(m.get("value", 0.0))
+
+    def c(doc, name):
+        snap = (doc or {}).get("snapshot") or {}
+        m = (snap.get("counters") or {}).get(name)
+        return None if m is None else float(m.get("value", 0.0))
+
+    from .rollup import _fmt
+
+    for wid in ids:
+        reg = regs[wid]
+        doc = rollup.node_doc(wid)
+        age = (hb.get(wid) or {}).get("age_s")
+        state = ("SILENT" if age is None or age > silent_after_s
+                 else "LIVE")
+        reqs = (c(doc, "serving/worker_requests_total")
+                or c(doc, "serving/worker_prefills_total"))
+        lines.append(
+            f"{wid:<14} {str(reg.get('role', '?')):<8} "
+            f"{str(reg.get('endpoint', '?')):<22} "
+            f"{_fmt(g(doc, 'serving/worker_active'), '{:.0f}'):>6} "
+            f"{_fmt(g(doc, 'serving/worker_queued'), '{:.0f}'):>6} "
+            f"{_fmt(g(doc, 'serving/worker_tok_s'), '{:.1f}'):>8} "
+            f"{_fmt(reqs, '{:.0f}'):>7} "
+            f"{_fmt(age, '{:.1f}'):>7} "
+            f"{state:<8}")
+    return "\n".join(lines)
+
+
 def _render_top_frame(client: Any, peers: Optional[List[str]],
-                      endpoint: str, silent_after_s: float = 30.0) -> str:
+                      endpoint: str, silent_after_s: float = 30.0,
+                      serving: bool = False) -> str:
     from .aggregator import _heartbeat_view, sealed_members
     from .rollup import collect_rollup, render_top
 
@@ -376,16 +436,23 @@ def _render_top_frame(client: Any, peers: Optional[List[str]],
         # telemetry (a gang mid-formation is still worth watching)
         peer_ids = sorted(k.rsplit("/", 1)[1]
                           for k in client.keys("telemetry/metrics/"))
-    if not peer_ids:
+    if not peer_ids and not serving:
         raise ValueError("no peers: store has no sealed round and no "
                          "telemetry publications (pass --peers)")
-    rollup = collect_rollup(client, peer_ids)
-    hb = _heartbeat_view(client, peer_ids)
-    store_info = {"endpoint": endpoint,
-                  "generation": client.get("srv/gen"),
-                  "round": client.get("rdzv/round")}
-    return render_top(rollup, hb_view=hb, store_info=store_info,
-                      silent_after_s=silent_after_s)
+    frame = ""
+    if peer_ids:
+        rollup = collect_rollup(client, peer_ids)
+        hb = _heartbeat_view(client, peer_ids)
+        store_info = {"endpoint": endpoint,
+                      "generation": client.get("srv/gen"),
+                      "round": client.get("rdzv/round")}
+        frame = render_top(rollup, hb_view=hb, store_info=store_info,
+                           silent_after_s=silent_after_s)
+    if serving:
+        block = _render_serving_rows(client,
+                                     silent_after_s=silent_after_s)
+        frame = (frame + "\n\n" + block) if frame else block
+    return frame
 
 
 def cmd_top(args: argparse.Namespace) -> int:
@@ -403,7 +470,9 @@ def cmd_top(args: argparse.Namespace) -> int:
         while True:
             try:
                 frame = _render_top_frame(client, peers, args.endpoint,
-                                          silent_after_s=args.silent_after)
+                                          silent_after_s=args.silent_after,
+                                          serving=getattr(args, "serving",
+                                                          False))
             except (ValueError, ConnectionError, OSError) as e:
                 return _fail(f"top: {e}")
             if frames:
@@ -559,6 +628,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--silent-after", type=float, default=30.0,
                    help="heartbeat age (s) past which a node renders "
                         "SILENT")
+    t.add_argument("--serving", action="store_true",
+                   help="also render registered serving workers (role, "
+                        "endpoint health, active/queued, tok/s) from "
+                        "the store")
     t.set_defaults(fn=cmd_top)
 
     from .perf.baseline import DEFAULT_BASELINE

@@ -166,6 +166,31 @@ def configure_step_stream(enabled: bool = True,
 
 
 # ---------------------------------------------------------------------------
+# aux streams (ISSUE 15): other planes ride the same publish beat
+# ---------------------------------------------------------------------------
+
+#: kind -> stream object exposing ``enabled``/``stream_id``/``pending()
+#: -> Optional[list]``/``mark_pushed(batch)`` — published under
+#: ``telemetry/<kind>/<node>`` on every push_node_telemetry beat.  The
+#: serving plane registers its request-record log here, so request
+#: traces ship over the exact transport (and degraded-mode semantics)
+#: the metrics rollup already proved out.
+_aux_streams: Dict[str, Any] = {}
+
+
+def register_aux_stream(kind: str, stream: Any) -> None:
+    _aux_streams[str(kind)] = stream
+
+
+def get_aux_stream(kind: str) -> Optional[Any]:
+    return _aux_streams.get(str(kind))
+
+
+def aux_stream_key(kind: str, node_id: str) -> str:
+    return f"telemetry/{kind}/{node_id}"
+
+
+# ---------------------------------------------------------------------------
 # publish side
 # ---------------------------------------------------------------------------
 
@@ -207,6 +232,26 @@ def push_node_telemetry(client: Any, node_id: str) -> Optional[Dict[str, Any]]:
         # the batch buffered for the next healthy beat (exactly-once is
         # the consumer's seq dedup, at-least-once is this retry)
         stream.ack(pending[-1]["seq"])
+    # aux streams (request records, …) ride the same beat: the
+    # publication is the stream's full retention window, so the store
+    # key always holds the recent history a reader can assemble from —
+    # marked pushed only after the set SUCCEEDED (degraded beats retry)
+    for kind, aux in sorted(_aux_streams.items()):
+        try:
+            batch = aux.pending() if getattr(aux, "enabled", False) \
+                else None
+        except Exception as e:
+            debug_once(f"rollup/aux-{kind}",
+                       f"aux stream {kind} pending() failed ({e!r})")
+            continue
+        if not batch:
+            continue
+        client.set(aux_stream_key(kind, node_id),
+                   {"v": ROLLUP_SCHEMA_V, "node": str(node_id),
+                    "stream": aux.stream_id,
+                    "clock": get_clock_sync().status(),
+                    "records": batch})
+        aux.mark_pushed(batch)
     return doc
 
 

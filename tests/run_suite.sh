@@ -271,6 +271,58 @@ else
   fail=1
 fi
 
+# Request-trace CLI smoke (ISSUE 15): a dry-run request pushed through
+# the rollup transport must assemble into a timeline (`serving trace
+# <id>` exit 0); an unknown id must exit 3, not crash.
+echo "=== serving trace smoke: assembled timeline / unknown id"
+trace_ok=1
+JAX_PLATFORMS=cpu python - <<'PYEOF' || trace_ok=0
+import subprocess
+import sys
+
+from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (Replica, ServingFrontend,
+                                   SyntheticEngine, get_request_log)
+from deepspeed_tpu.telemetry import get_telemetry, push_node_telemetry
+
+srv = RendezvousServer()
+try:
+    c = RendezvousClient(srv.endpoint)
+    get_telemetry().configure(enabled=True, jsonl=False, prometheus=False)
+    get_request_log().reset()
+    cc = KVCacheConfig(num_blocks=64, block_size=16, max_seq_len=256)
+    fe = ServingFrontend([Replica(SyntheticEngine(cc), 0)])
+    h = fe.submit([1, 2, 3, 4], max_new_tokens=6,
+                  trace_id="smoke-trace-01")
+    fe.run_until_idle()
+    assert h.status == "done", h.status
+    push_node_telemetry(c, "door")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "trace",
+         "smoke-trace-01", "--endpoint", srv.endpoint],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "smoke-trace-01" in out.stdout, out.stdout
+    assert "admitted" in out.stdout, out.stdout
+    unknown = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "trace",
+         "no-such-trace", "--endpoint", srv.endpoint],
+        capture_output=True, text=True, timeout=120)
+    assert unknown.returncode == 3, (unknown.returncode,
+                                     unknown.stdout + unknown.stderr)
+finally:
+    srv.shutdown()
+print("serving trace smoke: timeline assembled, unknown id exits 3")
+PYEOF
+if [ $trace_ok -eq 1 ]; then
+  echo "=== serving trace smoke passed"
+else
+  echo "=== serving trace smoke FAILED"
+  fail=1
+fi
+
 # Perf-sentinel smoke (ISSUE 5): baseline-then-check on the same run
 # must exit 0; a forced-regression fixture must exit 3.
 echo "=== perf sentinel smoke: baseline / check exit codes"

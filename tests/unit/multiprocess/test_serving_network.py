@@ -1,8 +1,10 @@
-"""Network serving chaos (ISSUE 14 acceptance): a real HTTP client
-against a real front door backed by REAL replica worker processes —
-kill -9 one mid-stream and the SSE client sees a splice-exact
-continuation while the survivor absorbs the load (merged telemetry +
-``top`` agree)."""
+"""Network serving chaos (ISSUE 14 + 15 acceptance): a real HTTP
+client against a real front door backed by REAL replica worker
+processes — kill -9 one mid-stream and the SSE client sees a
+splice-exact continuation while the survivor absorbs the load (merged
+telemetry + ``top`` agree), and ``serving trace <id>`` assembles ONE
+clock-aligned timeline whose lanes show the victim's partial decode,
+the drain, and the survivor's replay."""
 
 import http.client
 import json
@@ -20,11 +22,36 @@ from deepspeed_tpu.launcher.serving_fleet import (launch_worker_fleet,
                                                   shutdown_fleet)
 from deepspeed_tpu.serving import (FrontDoor, FrontDoorParams,
                                    NetworkFrontend, NetworkParams,
-                                   discover_endpoints)
+                                   discover_endpoints, get_request_log)
 from deepspeed_tpu.serving.cli import http_generate_stream, sse_events
 from deepspeed_tpu.serving.synthetic import synthetic_token
 
 pytestmark = pytest.mark.chaos
+
+CHAOS_TRACE = "chaos-trace-01"
+
+
+def _assemble_trace(endpoint, trace_id, want_done_nodes, timeout_s=30.0):
+    """Wait until every node in ``want_done_nodes`` has published a
+    COMMITTED record for the trace, then run the real CLI."""
+    from deepspeed_tpu.serving.tracing import fetch_request_docs
+
+    c = RendezvousClient(endpoint)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        docs = fetch_request_docs(c)
+        done_nodes = {
+            node for node, doc in docs.items()
+            for r in doc.get("records", [])
+            if r.get("trace_id") == trace_id and r.get("done")}
+        if want_done_nodes <= done_nodes:
+            break
+        time.sleep(0.25)
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "trace",
+         trace_id, "--endpoint", endpoint, "--json"],
+        capture_output=True, text=True, timeout=120)
+    return out
 
 
 @pytest.mark.timeout(300)
@@ -33,16 +60,29 @@ def test_replica_kill9_mid_stream_splices_exactly():
     fleet, door = [], None
     try:
         # workers drip 1 token per poll so a long stream is genuinely
-        # in flight when the SIGKILL lands
+        # in flight when the SIGKILL lands; the slowed pump keeps it
+        # in flight across multiple worker heartbeat beats (each beat
+        # publishes the victim's OPEN record — its partial lane)
         fleet = launch_worker_fleet(
             2, store=srv.endpoint,
-            extra_args=["--drip", "1", "--max-seq-len", "2048"])
+            extra_args=["--drip", "1", "--max-seq-len", "2048",
+                        "--step-delay-ms", "30", "--push-every", "0.3"])
         client = RendezvousClient(srv.endpoint)
         eps = discover_endpoints(client)
         assert sorted(e.id for e in eps) == sorted(w.id for w in fleet)
-        fe = NetworkFrontend(eps, net=NetworkParams())
+        fe = NetworkFrontend(eps,
+                             net=NetworkParams(poll_interval_s=0.02))
         door = FrontDoor(fe, params=FrontDoorParams(sse_heartbeat_s=0.5))
         door.start()
+        # the test process IS the front door node: enable telemetry so
+        # its request records ship over the rollup transport too
+        from deepspeed_tpu.telemetry import (get_telemetry,
+                                             maybe_sync_clock,
+                                             push_node_telemetry)
+
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        get_request_log().reset()
 
         # mixed-class requests complete over real HTTP first
         for i, klass in enumerate(("interactive", "batch",
@@ -56,14 +96,17 @@ def test_replica_kill9_mid_stream_splices_exactly():
         # the long stream: read a few tokens, then kill -9 its worker
         prompt = list(range(50, 70))
         max_new = 400
+        wall_t0 = time.monotonic()
         conn = http.client.HTTPConnection(door.host, door.port,
                                           timeout=120)
         conn.request("POST", "/v1/generate",
                      body=json.dumps({"prompt": prompt,
                                       "max_new_tokens": max_new}),
-                     headers={"Content-Type": "application/json"})
+                     headers={"Content-Type": "application/json",
+                              "X-DS-Trace": CHAOS_TRACE})
         resp = conn.getresponse()
         assert resp.status == 200
+        assert resp.getheader("X-DS-Trace") == CHAOS_TRACE
         events = sse_events(resp)
         got = []
         for event, data in events:
@@ -83,6 +126,11 @@ def test_replica_kill9_mid_stream_splices_exactly():
         assert victim_id is not None
         victim = next(w for w in fleet if w.id == victim_id)
         survivor = next(w for w in fleet if w.id != victim_id)
+        # let the victim's heartbeat publish the request's OPEN record
+        # (its partial-decode lane survives the SIGKILL in the store);
+        # the 30 ms/step pacing keeps the 400-token decode genuinely
+        # mid-flight across several 0.3 s publish beats
+        time.sleep(1.5)
         os.kill(victim.pid, signal.SIGKILL)
         os.waitpid(victim.pid, 0)
 
@@ -98,9 +146,46 @@ def test_replica_kill9_mid_stream_splices_exactly():
             else:
                 pytest.fail(f"stream errored: {data}")
         conn.close()
+        wall_ms = (time.monotonic() - wall_t0) * 1e3
         assert got == [synthetic_token(prompt, i)
                        for i in range(max_new)]
         assert done is not None and done["replays"] >= 1
+        assert done["trace_id"] == CHAOS_TRACE
+
+        # ISSUE 15 acceptance: `serving trace` assembles ONE clock-
+        # aligned timeline — the victim's partial decode, the drain,
+        # and the survivor's splice replay, phase durations consistent
+        # with the client-observed wall time
+        maybe_sync_clock(client, node_id="frontdoor")
+        push_node_telemetry(client, "frontdoor")
+        out = _assemble_trace(srv.endpoint, CHAOS_TRACE,
+                              {"frontdoor", survivor.id})
+        assert out.returncode == 0, out.stdout + out.stderr
+        tl = json.loads(out.stdout)
+        lanes = {ln["node"]: ln for ln in tl["lanes"]}
+        assert {"frontdoor", victim.id, survivor.id} <= set(lanes)
+        # every lane clock-aligned onto the store clock
+        assert tl["aligned_lanes"] == len(tl["lanes"])
+        # the victim's lane is the OPEN record its last heartbeat
+        # pushed: partial decode (some tokens, never finished)
+        vic = lanes[victim.id]
+        assert not vic["done"] and vic["tokens"] > 0
+        assert vic["tokens"] < max_new
+        # the survivor's lane replayed the request to completion
+        surv = lanes[survivor.id]
+        assert surv["done"] and surv["status"] == "done"
+        assert surv["tokens"] == max_new
+        # the door lane shows the drain and the replay, and its span
+        # matches the client-observed wall time within heartbeat slack
+        front = lanes["frontdoor"]
+        assert front["replays"] >= 1
+        names = [e["name"] for e in front["record"]["events"]]
+        assert "replica_drained" in names and "replayed" in names
+        assert front["record"]["anomaly"] == "replayed"
+        assert abs(front["span_ms"] - wall_ms) < 5000.0
+        # lane ordering on the SHARED clock: the survivor's replay
+        # lane starts after the victim's lane started
+        assert surv["start_ms"] > vic["start_ms"]
 
         # the survivor absorbs new load
         out = http_generate_stream(door.host, door.port, [7, 7, 7], 5,
@@ -164,15 +249,51 @@ def test_disaggregated_processes_end_to_end():
         fe = NetworkFrontend(eps, net=NetworkParams(disaggregate=True))
         door = FrontDoor(fe, params=FrontDoorParams())
         door.start()
+        get_request_log().reset()
         prompt = list(range(200, 248))
         out = http_generate_stream(door.host, door.port, prompt, 8,
-                                   "interactive", timeout=120)
+                                   "interactive", timeout=120,
+                                   trace="disagg-trace-01")
         assert out["tokens"] == [synthetic_token(prompt, i)
                                  for i in range(8)]
         bd = out["done"].get("ttft_breakdown_ms")
         assert bd and "prefill" in bd and "transfer" in bd
+        assert out["done"]["trace_id"] == "disagg-trace-01"
         snap = fe.snapshot()
         assert snap["counters"]["disagg_requests"] >= 1
+
+        # ISSUE 15 acceptance: the request trace attributes TTFT
+        # across prefill/transfer/decode lanes matching the exported
+        # ttft_breakdown within 5%
+        recs = get_request_log().find("disagg-trace-01")
+        assert recs, "door-side record missing"
+        rec = recs[0]
+        phases = {p["phase"]: p for p in rec["phases"]}
+        assert rec.get("breakdown", {}).get("prefill_ms") \
+            == pytest.approx(bd["prefill"], rel=0.05, abs=0.5)
+        assert phases["transfer"]["dur_ms"] \
+            == pytest.approx(bd["transfer"], rel=0.05, abs=2.0)
+        if "decode" in bd:
+            assert phases["decode_first_burst"]["dur_ms"] \
+                == pytest.approx(bd["decode"], rel=0.05, abs=2.0)
+        # the prefill WORKER's own lane ships over the rollup: its
+        # engine-side prefill phase agrees with the breakdown too
+        pre_worker = next(w for w in fleet if w.role == "prefill")
+        from deepspeed_tpu.serving.tracing import fetch_request_docs
+
+        deadline = time.monotonic() + 20
+        wrec = None
+        while wrec is None and time.monotonic() < deadline:
+            docs = fetch_request_docs(client)
+            for r in (docs.get(pre_worker.id) or {}).get("records", []):
+                if r.get("trace_id") == "disagg-trace-01":
+                    wrec = r
+            time.sleep(0.25)
+        assert wrec is not None, "prefill worker never published a lane"
+        wphases = {p["phase"]: p for p in wrec["phases"]}
+        assert "prefill" in wphases and "transfer_push" in wphases
+        assert wphases["prefill"]["dur_ms"] \
+            == pytest.approx(bd["prefill"], rel=0.05, abs=1.0)
     finally:
         if door is not None:
             door.shutdown()

@@ -20,6 +20,13 @@ def _fresh_serving_globals():
         mem.reset()
         mem.enabled = False
         clear_device_unresponsive()
+        # request-tracing globals (ISSUE 15): ring + sampling knobs
+        from deepspeed_tpu.serving.tracing import get_request_log
+
+        log = get_request_log()
+        log.configure(enabled=True, sample_rate=1.0, maxlen=256,
+                      anomaly_ttft_ms=2000.0, token_cap=512)
+        log.reset()
 
     scrub()
     yield

@@ -46,7 +46,39 @@ def test_serving_network_config_defaults():
     net = cfg.serving.network
     assert net.enabled is False and net.workers == 2
     assert net.disaggregate is False
+    assert net.access_log == ""
     assert cfg.serving.preempt_release_pages is True
+    # the tracing group (ISSUE 15): on by default, full sampling
+    t = cfg.serving.tracing
+    assert t.enabled is True and t.sample_rate == 1.0
+    assert t.ring == 256 and t.anomaly_ttft_ms == 2000.0
+
+
+def test_serving_tracing_config_round_trip():
+    from deepspeed_tpu.serving import (configure_tracing_from_config,
+                                       get_request_log)
+
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1,
+         "serving": {"tracing": {"sample_rate": 0.25, "ring": 32,
+                                 "anomaly_ttft_ms": 750.0,
+                                 "token_timings": 64},
+                     "network": {"access_log": "/tmp/x.jsonl",
+                                 "access_log_max_bytes": 1024}}},
+        world_size=1)
+    log = configure_tracing_from_config(cfg.serving.tracing)
+    try:
+        assert log is get_request_log()
+        assert log.sample_rate == 0.25 and log.maxlen == 32
+        assert log.anomaly_ttft_ms == 750.0 and log.token_cap == 64
+    finally:
+        log.configure(enabled=True, sample_rate=1.0, maxlen=256,
+                      anomaly_ttft_ms=2000.0, token_cap=512)
+    from deepspeed_tpu.serving import door_params_from_config
+
+    dp = door_params_from_config(cfg.serving.network)
+    assert dp.access_log == "/tmp/x.jsonl"
+    assert dp.access_log_max_bytes == 1024
 
 
 def test_serving_network_config_round_trip_to_params():

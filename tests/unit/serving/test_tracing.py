@@ -1,0 +1,503 @@
+"""Distributed request tracing (ISSUE 15): header accepted vs minted,
+trace id surviving replay to a second worker, bounded sampling ring
+under overload, anomaly-sampling at sample_rate=0, LatencyTracker
+exemplars behind the p99, the access log, cross-process assembly, and
+the `serving trace` / `top --serving` CLIs."""
+
+import http.client
+import json
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (FrontDoor, FrontDoorParams,
+                                   LatencyTracker, NetworkFrontend,
+                                   NetworkParams, Replica, ReplicaEndpoint,
+                                   ServingFrontend, ServingParams,
+                                   ServingWorker, SyntheticEngine,
+                                   assemble_timeline, find_trace,
+                                   get_request_log, head_sampled,
+                                   mint_trace_id, render_timeline,
+                                   sanitize_trace_id, synthetic_token,
+                                   timeline_chrome_trace)
+from deepspeed_tpu.serving.metrics import RequestRecord
+
+
+def make_frontend(replicas=1, slots=4, num_blocks=128, params=None):
+    cc = KVCacheConfig(num_blocks=num_blocks, block_size=16,
+                      max_seq_len=512)
+    return ServingFrontend(
+        [Replica(SyntheticEngine(cc, max_batch_slots=slots), i)
+         for i in range(replicas)],
+        params=params or ServingParams())
+
+
+def make_door(door_params=None, **fe_kw):
+    fe = make_frontend(**fe_kw)
+    door = FrontDoor(fe, params=door_params or FrontDoorParams())
+    door.start()
+    return door, fe
+
+
+def post(door, body, headers=None):
+    c = http.client.HTTPConnection(door.host, door.port, timeout=30)
+    try:
+        c.request("POST", "/v1/generate", body=json.dumps(body),
+                  headers={"Content-Type": "application/json",
+                           **(headers or {})})
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), r.read().decode()
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# ids + sampling primitives
+# ---------------------------------------------------------------------------
+
+def test_mint_and_sanitize():
+    a, b = mint_trace_id(), mint_trace_id()
+    assert a != b and sanitize_trace_id(a) == a
+    assert sanitize_trace_id(None) is None
+    assert sanitize_trace_id("evil\nheader") is None
+    assert sanitize_trace_id("x" * 65) is None
+    assert sanitize_trace_id("  ok-id.1  ") == "ok-id.1"
+
+
+def test_head_sampling_deterministic_and_proportional():
+    ids = [mint_trace_id() for _ in range(400)]
+    assert all(head_sampled(i, 1.0) for i in ids)
+    assert not any(head_sampled(i, 0.0) for i in ids)
+    frac = sum(head_sampled(i, 0.5) for i in ids) / len(ids)
+    assert 0.3 < frac < 0.7
+    # every process reaches the same verdict for the same id
+    assert [head_sampled(i, 0.5) for i in ids] \
+        == [head_sampled(i, 0.5) for i in ids]
+
+
+def test_latency_tracker_exemplar_names_the_tail():
+    t = LatencyTracker(max_samples=16)
+    for i in range(10):
+        t.observe(float(i), ref=f"req-{i}")
+    s = t.summary()
+    assert s["p99_exemplar"] == "req-9"
+    assert s["p99_exemplar_ms"] == 9.0
+    # ref-less observations never become exemplars
+    t2 = LatencyTracker()
+    t2.observe(5.0)
+    assert "p99_exemplar" not in t2.summary()
+
+
+# ---------------------------------------------------------------------------
+# front door: header accepted vs minted, echo on 4xx/429
+# ---------------------------------------------------------------------------
+
+def test_frontdoor_accepts_header_and_echoes_everywhere(tmp_path):
+    acc = str(tmp_path / "access.jsonl")
+    door, fe = make_door(door_params=FrontDoorParams(
+        access_log=acc, queue_token_budget=200))
+    try:
+        # accepted: the client's id rides the whole way through
+        status, hdrs, body = post(
+            door, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                   "stream": False},
+            headers={"X-DS-Trace": "edge-id-007"})
+        doc = json.loads(body)
+        assert status == 200
+        assert hdrs.get("X-DS-Trace") == "edge-id-007"
+        assert doc["trace_id"] == "edge-id-007"
+        # minted: absent header still yields a traceable id
+        status, hdrs, body = post(
+            door, {"prompt": [4, 5], "max_new_tokens": 3,
+                   "stream": False})
+        minted = json.loads(body)["trace_id"]
+        assert status == 200 and minted
+        assert hdrs.get("X-DS-Trace") == minted
+        assert sanitize_trace_id(minted) == minted
+        # a 400 echoes the id too
+        status, hdrs, _ = post(door, {"prompt": [], "max_new_tokens": 4},
+                               headers={"X-DS-Trace": "bad-req-1"})
+        assert status == 400 and hdrs.get("X-DS-Trace") == "bad-req-1"
+        # 429 backpressure: stop the pump so the queue holds tokens;
+        # the queueing request is sent WITHOUT reading its (never-
+        # arriving) response — its handler thread parks in result()
+        fe.stop()
+        parked = http.client.HTTPConnection(door.host, door.port,
+                                            timeout=30)
+        parked.request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompt": [1] * 50,
+                             "max_new_tokens": 100,
+                             "stream": False, "class": "batch"}),
+            headers={"Content-Type": "application/json",
+                     "X-DS-Trace": "will-queue"})
+        deadline = time.monotonic() + 10
+        while fe.queued_tokens("batch") == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fe.queued_tokens("batch") == 150
+        status, hdrs, _ = post(
+            door, {"prompt": [1] * 50, "max_new_tokens": 100,
+                   "class": "batch"},
+            headers={"X-DS-Trace": "shed-me"})
+        assert status == 429 and hdrs.get("X-DS-Trace") == "shed-me"
+        assert hdrs.get("Retry-After")
+        parked.close()
+        # the access log has one line per request with close reasons
+        # (lines land AFTER the reply is sent: poll briefly)
+        want = {"edge-id-007", "bad-req-1", "shed-me"}
+        deadline = time.monotonic() + 10
+        lines = []
+        while time.monotonic() < deadline:
+            lines = [json.loads(ln) for ln in open(acc)]
+            if want <= {ln.get("trace") for ln in lines}:
+                break
+            time.sleep(0.02)
+        by_trace = {ln.get("trace"): ln for ln in lines}
+        assert by_trace["edge-id-007"]["close"] == "done"
+        assert by_trace["edge-id-007"]["tokens"] == 4
+        assert by_trace["bad-req-1"]["status"] == 400
+        assert by_trace["bad-req-1"]["close"] == "validation"
+        assert by_trace["shed-me"]["status"] == 429
+        assert by_trace["shed-me"]["close"] == "shed"
+        for ln in lines:
+            assert ln["method"] == "POST" and "duration_ms" in ln
+    finally:
+        door.shutdown()
+
+
+def test_access_log_rotates_at_size_cap(tmp_path):
+    from deepspeed_tpu.serving import AccessLog
+
+    path = str(tmp_path / "acc.jsonl")
+    log = AccessLog(path, max_bytes=512)
+    for i in range(40):
+        log.write(method="POST", path="/v1/generate", status=200,
+                  trace=f"t-{i}", tokens=i)
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 512 + 256  # one line of slack
+    # both halves stay parseable JSONL
+    for p in (path, path + ".1"):
+        for ln in open(p):
+            json.loads(ln)
+
+
+# ---------------------------------------------------------------------------
+# sampling ring: bounded under overload, anomaly-forced at rate 0
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_under_overload():
+    log = get_request_log()
+    log.configure(maxlen=8)
+    log.reset()
+    fe = make_frontend()
+    for i in range(30):
+        h = fe.submit([i + 1, i + 2], max_new_tokens=2)
+        fe.run_until_idle()
+        assert h.status == "done"
+    recs = log.records()
+    assert len(recs) == 8
+    assert log.dropped == 30 - 8
+    # the window keeps the NEWEST requests
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and seqs[-1] == 30
+
+
+def test_anomaly_sampling_fires_on_preempt_at_rate_zero():
+    log = get_request_log()
+    log.configure(sample_rate=0.0)
+    log.reset()
+    fe = make_frontend(slots=1)
+    bg = fe.submit([1, 2, 3], max_new_tokens=40, klass="background")
+    for _ in range(3):
+        fe.pump()
+    assert bg.status == "running"
+    inter = fe.submit([9, 9], max_new_tokens=4, klass="interactive")
+    fe.run_until_idle()
+    assert inter.status == "done" and bg.status == "done"
+    assert fe.metrics.counters["preemptions"] >= 1
+    recs = log.records()
+    # ONLY the preempted background request was recorded
+    assert [r["trace_id"] for r in recs] == [bg.trace_id]
+    assert recs[0]["anomaly"] == "preempted"
+    assert recs[0]["preempts"] >= 1
+    assert any(e["name"] == "preempted" for e in recs[0]["events"])
+
+
+def test_anomaly_sampling_fires_on_failure_at_rate_zero():
+    log = get_request_log()
+    log.configure(sample_rate=0.0)
+    log.reset()
+    fe = make_frontend()
+    h = fe.submit([5, 6], max_new_tokens=4)
+    for rep in fe.router.replicas:
+        rep.mark_dead("test kill")
+    with pytest.raises(Exception):
+        fe.run_until_idle()
+    assert h.status == "failed"
+    recs = log.records()
+    assert [r["trace_id"] for r in recs] == [h.trace_id]
+    assert recs[0]["anomaly"] == "failed"
+
+
+# ---------------------------------------------------------------------------
+# network: the id survives a replay to a second worker
+# ---------------------------------------------------------------------------
+
+def test_trace_id_survives_replay_to_second_worker():
+    log = get_request_log()
+    log.configure(sample_rate=0.0)  # only the anomaly path records
+    log.reset()
+    cc = KVCacheConfig(num_blocks=128, block_size=16, max_seq_len=512)
+    wa = ServingWorker(SyntheticEngine(cc), "a")
+    wb = ServingWorker(SyntheticEngine(cc), "b")
+    try:
+        fe = NetworkFrontend(
+            [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+             for w in (wa, wb)], net=NetworkParams())
+        wa.frontend.stop()  # frozen: admitted work never generates
+        prompt = [9, 9, 9, 9]
+        h = fe.submit(prompt, max_new_tokens=12, trace_id="replay-me-01")
+        assert h.trace_id == "replay-me-01"
+        fe.pump()  # admits to "a" (id order) — which is frozen
+        assert h.replica_id == "a"
+        wa.shutdown()  # real dead socket
+        fe.run_until_idle()
+        assert h.replays == 1 and h.replica_id == "b"
+        assert h.result(timeout=5) == [synthetic_token(prompt, i)
+                                       for i in range(12)]
+        # the door-side record committed as anomalous, same id
+        recs = [r for r in log.records()
+                if r["trace_id"] == "replay-me-01"]
+        router_rec = [r for r in recs if r.get("replays")]
+        assert router_rec and router_rec[0]["anomaly"] == "replayed"
+        names = [e["name"] for e in router_rec[0]["events"]]
+        assert "replica_drained" in names and "replayed" in names
+        # the survivor's worker-side lane carries the SAME id: the
+        # forced `sampled` flag rode the re-submit RPC (rate is 0)
+        survivor_recs = [r for r in recs if r is not router_rec[0]]
+        assert survivor_recs, "survivor recorded no lane for the id"
+    finally:
+        wa.shutdown()
+        wb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# p99 exemplars in /v1/metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_p99_rows_link_to_traceable_request():
+    door, fe = make_door()
+    try:
+        for i in range(3):
+            status, _, _ = post(
+                door, {"prompt": [i + 1, i + 2], "max_new_tokens": 3,
+                       "stream": False},
+                headers={"X-DS-Trace": f"known-{i}"})
+            assert status == 200
+        c = http.client.HTTPConnection(door.host, door.port, timeout=10)
+        c.request("GET", "/v1/metrics")
+        m = json.loads(c.getresponse().read())
+        c.close()
+        ttft = m["classes"]["interactive"]["ttft"]
+        assert ttft["count"] == 3
+        assert ttft["p99_exemplar"] in {f"known-{i}" for i in range(3)}
+        assert ttft["p99_exemplar_ms"] >= ttft["p50_ms"] - 1e-6
+    finally:
+        door.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# assembly: clock-aligned lanes across nodes + the CLIs
+# ---------------------------------------------------------------------------
+
+def _fake_docs():
+    """Two nodes with DIFFERENT clock offsets recording one request:
+    the door submitted at its local t=100.0, the worker ran it at its
+    local t=5.0 — only the offsets make the order legible."""
+    door_rec = RequestRecord("trace-xy-1", 0, "interactive", 4, 8, True)
+    door_rec.start_ts = 100.0
+    door_rec.events = [{"name": "submitted", "ts": 100.0},
+                       {"name": "admitted", "ts": 100.010,
+                        "replica": "w1"}]
+    door_rec.end_ts = 100.100
+    door_rec.status = "done"
+    worker_rec = RequestRecord("trace-xy-1", "0.0", "interactive", 4, 8,
+                               True)
+    worker_rec.start_ts = 5.020
+    worker_rec.phases = [{"phase": "prefill", "ts": 5.020,
+                          "dur_ms": 30.0}]
+    worker_rec.end_ts = 5.090
+    worker_rec.status = "done"
+    return {
+        "door": {"stream": "s1", "clock": {"synced": True,
+                                           "offset_s": 0.0},
+                 "records": [dict(door_rec.to_dict(), seq=1,
+                                  done=True)]},
+        "w1": {"stream": "s2", "clock": {"synced": True,
+                                         "offset_s": 95.0},
+               "records": [dict(worker_rec.to_dict(), seq=1,
+                                done=True)]},
+    }
+
+
+def test_assemble_timeline_aligns_across_clock_offsets():
+    docs = _fake_docs()
+    matches = find_trace(docs, "trace-xy-1")
+    assert len(matches) == 2
+    tl = assemble_timeline(matches)
+    assert tl["trace_id"] == "trace-xy-1" and tl["aligned_lanes"] == 2
+    lanes = {ln["node"]: ln for ln in tl["lanes"]}
+    # worker local 5.020 + offset 95.0 == door 100.020: the worker
+    # lane starts 20 ms AFTER the door's submit on the shared clock
+    assert lanes["door"]["start_ms"] == 0.0
+    assert abs(lanes["w1"]["start_ms"] - 20.0) < 1.0
+    text = render_timeline(tl)
+    assert "door" in text and "w1" in text and "prefill" in text
+    # prefix match works for pasted truncated ids
+    assert len(find_trace(docs, "trace-x")) == 2
+    # chrome export: one pid per node, request + phase slices
+    doc = timeline_chrome_trace(docs, trace_id="trace-xy-1")
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert len(pids) == 2
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert any(n.startswith("request trace-xy") for n in names)
+    assert any(n.startswith("prefill") for n in names)
+
+
+def test_prefix_ambiguity_exact_wins_and_cli_refuses_merge():
+    from deepspeed_tpu.serving.tracing import distinct_trace_ids
+
+    docs = _fake_docs()
+    # a second, distinct id sharing a 6+ char prefix with the first
+    other = RequestRecord("trace-xy-2", 9, "batch", 2, 4, True)
+    other.status = "done"
+    docs["door"]["records"].append(dict(other.to_dict(), seq=2,
+                                        done=True))
+    amb = find_trace(docs, "trace-xy")
+    assert distinct_trace_ids(amb) == ["trace-xy-1", "trace-xy-2"]
+    # an EXACT id never picks up prefix cousins
+    assert distinct_trace_ids(find_trace(docs, "trace-xy-1")) \
+        == ["trace-xy-1"]
+    # the CLI refuses to merge two requests into one timeline (exit 2)
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer)
+    from deepspeed_tpu.serving.cli import main as serving_main
+    from deepspeed_tpu.serving.tracing import REQUESTS_PREFIX
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        for node, doc in docs.items():
+            c.set(REQUESTS_PREFIX + node, doc)
+        assert serving_main(["trace", "trace-xy",
+                             "--endpoint", srv.endpoint]) == 2
+        assert serving_main(["trace", "trace-xy-1",
+                             "--endpoint", srv.endpoint]) == 0
+    finally:
+        srv.shutdown()
+
+
+def test_trace_cli_assembles_from_store_and_exit_codes():
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer)
+    from deepspeed_tpu.serving.cli import main as serving_main
+    from deepspeed_tpu.telemetry import (get_telemetry,
+                                         push_node_telemetry)
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        log = get_request_log()
+        log.reset()
+        fe = make_frontend()
+        h = fe.submit([1, 2, 3], max_new_tokens=4,
+                      trace_id="cli-trace-01")
+        fe.run_until_idle()
+        assert h.status == "done"
+        push_node_telemetry(c, "door")
+        assert serving_main(["trace", "cli-trace-01",
+                             "--endpoint", srv.endpoint]) == 0
+        assert serving_main(["trace", "no-such-trace",
+                             "--endpoint", srv.endpoint]) == 3
+    finally:
+        srv.shutdown()
+
+
+def test_top_serving_renders_worker_rows(capsys):
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer)
+    from deepspeed_tpu.telemetry import get_telemetry
+    from deepspeed_tpu.telemetry.cli import main as telemetry_main
+
+    srv = RendezvousServer()
+    try:
+        c = RendezvousClient(srv.endpoint)
+        cc = KVCacheConfig(num_blocks=64, block_size=16, max_seq_len=256)
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        w = ServingWorker(SyntheticEngine(cc), "top-w1",
+                          store_endpoint=srv.endpoint,
+                          telemetry_push_every_s=0.1)
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if any(k.endswith("top-w1")
+                       for k in c.keys("telemetry/metrics/")):
+                    break
+                time.sleep(0.05)
+            rc = telemetry_main(["top", "--once", "--serving",
+                                 "--endpoint", srv.endpoint,
+                                 "--peers", "top-w1"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "top-w1" in out and "mixed" in out
+            assert "WORKER" in out and "TOK/S" in out
+        finally:
+            w.shutdown()
+    finally:
+        srv.shutdown()
+
+
+def test_collect_folds_request_lanes_into_cluster_trace(tmp_path):
+    """`telemetry collect`'s archive pieces: request docs persisted and
+    folded into cluster_trace.json as per-node request lanes."""
+    import deepspeed_tpu.serving.tracing as tracing
+    from deepspeed_tpu.telemetry.aggregator import (CLUSTER_REQUESTS,
+                                                    build_cluster_trace,
+                                                    collect_request_docs)
+
+    class FakeStore:
+        def __init__(self, docs):
+            self.docs = {tracing.REQUESTS_PREFIX + n: d
+                         for n, d in docs.items()}
+
+        def keys(self, prefix=""):
+            return [k for k in self.docs if k.startswith(prefix)]
+
+        def get(self, k):
+            return self.docs.get(k)
+
+    archive = str(tmp_path / "cluster-x")
+    os.makedirs(archive)
+    assert collect_request_docs(FakeStore(_fake_docs()), archive)
+    assert os.path.exists(os.path.join(archive, CLUSTER_REQUESTS))
+    doc = build_cluster_trace(archive)
+    assert doc is not None
+    hosts = doc["metadata"]["hosts"]
+    assert "door (requests)" in hosts and "w1 (requests)" in hosts
+    req_events = [e for e in doc["traceEvents"]
+                  if e.get("cat") == "request" and e.get("ph") == "X"]
+    assert any(e["name"].startswith("request trace-xy")
+               for e in req_events)
+    # both lanes aligned onto one base: the worker's prefill slice
+    # lands AFTER the door's submit instant on the shared clock
+    door_pid = hosts["door (requests)"]["pid"]
+    w1_pid = hosts["w1 (requests)"]["pid"]
+    door_req = min(e["ts"] for e in req_events if e["pid"] == door_pid)
+    w1_req = min(e["ts"] for e in req_events if e["pid"] == w1_pid)
+    assert w1_req > door_req
