@@ -389,6 +389,68 @@ class ServingTracingConfig(DeepSpeedConfigModel):
     token_timings: int = 512
 
 
+class ServingSLOConfig(DeepSpeedConfigModel):
+    """``serving.slo`` config group — declarative service-level
+    objectives (``deepspeed_tpu/serving/slo.py``): per-class TTFT/TPOT
+    p99 bounds, availability (1 − 429/5xx rate), and token-budget
+    saturation, evaluated continuously against the PR-13 metrics
+    rollup with fast/slow multi-window burn rates.  Alert transitions
+    become health events, ``serving_slo_*`` gauges, and flight-recorder
+    annotations."""
+
+    enabled: bool = True
+    #: per-class TTFT p99 bound (ms); 0 disables that class's objective
+    interactive_ttft_p99_ms: float = 2000.0
+    batch_ttft_p99_ms: float = 10000.0
+    background_ttft_p99_ms: float = 0.0
+    #: per-class TPOT p50 bound (ms/token); 0 disables
+    interactive_tpot_p50_ms: float = 500.0
+    #: availability objective: 1 − (429 + 5xx) / requests
+    availability_target: float = 0.999
+    #: queued-token budget saturation bound (fraction of
+    #: ``serving.network.queue_token_budget`` queued, worst class)
+    token_budget_saturation: float = 0.9
+    #: multi-window burn-rate evaluation windows (seconds) — the alert
+    #: fires only when BOTH windows burn error budget faster than
+    #: ``burn_rate_threshold`` (fast window confirms it is happening
+    #: NOW, slow window that it is sustained)
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_rate_threshold: float = 2.0
+    #: evaluation cadence (s) — each tick consumes one rollup snapshot
+    evaluate_every_s: float = 1.0
+
+
+class ServingAutoscalerConfig(DeepSpeedConfigModel):
+    """``serving.autoscaler`` config group — the rollup-driven policy
+    loop (``deepspeed_tpu/serving/autoscaler.py``): replaces dead
+    workers through the launcher, scales decode workers on queue depth
+    + token-budget saturation, scales prefill workers on TTFT prefill
+    share, and scales down only through the kill-safe drain path.
+    Every decision is a trace-id-stamped scaling event riding the
+    telemetry rollup into ``cluster_trace.json`` and debug bundles."""
+
+    enabled: bool = False
+    min_workers: int = 1
+    max_workers: int = 8
+    #: scale decode UP past this mean queued-requests-per-worker
+    queue_depth_high: float = 4.0
+    #: scale decode DOWN below this (with the fleet above min_workers)
+    queue_depth_low: float = 0.5
+    #: scale decode UP past this outstanding-token saturation (fraction
+    #: of ``serving.max_outstanding_tokens`` per worker)
+    token_saturation_high: float = 0.85
+    #: scale prefill UP past this fraction of TTFT spent in prefill
+    #: (disaggregated fleets only)
+    ttft_prefill_share_high: float = 0.6
+    #: consecutive breaching evaluations before a scaling action
+    hysteresis_ticks: int = 3
+    #: minimum seconds between scaling actions (replacements exempt —
+    #: a dead worker is replaced immediately)
+    cooldown_s: float = 30.0
+    evaluate_every_s: float = 1.0
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """``serving`` config group — the production serving plane
     (``deepspeed_tpu/serving/``): paged prefix-sharing KV cache over the
@@ -440,6 +502,13 @@ class ServingConfig(DeepSpeedConfigModel):
     #: cross-process timeline assembly)
     tracing: ServingTracingConfig = Field(
         default_factory=ServingTracingConfig)
+    #: declarative SLOs with multi-window burn-rate alerting over the
+    #: cross-process metrics rollup
+    slo: ServingSLOConfig = Field(default_factory=ServingSLOConfig)
+    #: rollup-driven fleet autoscaler (traced scaling decisions,
+    #: drain-path scale-down)
+    autoscaler: ServingAutoscalerConfig = Field(
+        default_factory=ServingAutoscalerConfig)
 
 
 class ServingNetworkConfig(DeepSpeedConfigModel):

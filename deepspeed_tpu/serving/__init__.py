@@ -30,6 +30,12 @@ from .frontend import (NoHealthyReplicaError, ServingFrontend,
                        ServingHandle, ServingParams)
 from .metrics import (CLASSES, LatencyTracker, RequestLog, RequestRecord,
                       ServingMetrics, head_sampled)
+from .autoscaler import Autoscaler, ScalingDecision
+from .replay import (read_access_log, replay_report, replayable_records,
+                     run_replay, synthesize_diurnal_log)
+from .slo import (SLOMonitor, SLOObjective, objectives_from_config,
+                  render_slo_table, sample_from_rollup,
+                  sample_from_snapshot, slo_rows_from_rollup)
 from .tracing import (REQUESTS_PREFIX, TRACE_HEADER, AccessLog,
                       assemble_timeline, configure_request_log,
                       configure_tracing_from_config, fetch_request_docs,
@@ -45,20 +51,25 @@ from .synthetic import FakeClock, SyntheticEngine, synthetic_token
 from .worker import SRV_PREFIX, ServingWorker
 
 __all__ = [
-    "AccessLog", "CLASSES", "CLASS_HEADER", "FakeClock", "FrontDoor",
-    "FrontDoorParams", "LatencyTracker", "NetworkFrontend",
+    "AccessLog", "Autoscaler", "CLASSES", "CLASS_HEADER", "FakeClock",
+    "FrontDoor", "FrontDoorParams", "LatencyTracker", "NetworkFrontend",
     "NetworkParams", "NoHealthyReplicaError", "PrefixCache",
     "REQUESTS_PREFIX", "RefcountedBlockAllocator", "Replica",
     "ReplicaEndpoint", "ReplicaRouter", "RequestLog", "RequestRecord",
-    "SRV_PREFIX", "ServingFrontend", "ServingHandle", "ServingMetrics",
+    "SLOMonitor", "SLOObjective", "SRV_PREFIX", "ScalingDecision",
+    "ServingFrontend", "ServingHandle", "ServingMetrics",
     "ServingParams", "ServingScheduler", "ServingWorker",
     "SyntheticEngine", "TRACE_HEADER", "assemble_timeline",
     "build_serving_frontend", "configure_request_log",
     "configure_tracing_from_config", "discover_endpoints",
     "door_params_from_config", "fetch_request_docs", "find_trace",
     "get_request_log", "head_sampled", "jsonline_rpc", "mint_trace_id",
-    "net_params_from_config", "params_from_config", "render_timeline",
-    "sanitize_trace_id", "synthetic_token", "timeline_chrome_trace",
+    "net_params_from_config", "objectives_from_config",
+    "params_from_config", "read_access_log", "render_slo_table",
+    "render_timeline", "replay_report", "replayable_records",
+    "run_replay", "sample_from_rollup", "sample_from_snapshot",
+    "sanitize_trace_id", "slo_rows_from_rollup", "synthesize_diurnal_log",
+    "synthetic_token", "timeline_chrome_trace",
 ]
 
 

@@ -1,0 +1,518 @@
+"""Rollup-driven fleet autoscaler — the control loop that closes the
+observability loop (ISSUE 16 tentpole c).
+
+A policy loop over the signals PR 13–15 made observable:
+
+* **replacement** — a worker whose process died (or whose telemetry
+  publication went stale on the rollup: the kill -9 case, where no EOF
+  ever reaches the router) is drained through the existing kill-safe
+  path and replaced through the launcher immediately, cooldown-exempt.
+  In-flight streams splice exactly (the PR-14 guarantee — replacement
+  rides the same ``_drain_dead`` re-queue a crash does).
+* **scale UP decode** on queue depth (queued requests per live decode
+  worker) or token-budget saturation (outstanding tokens per worker as
+  a fraction of ``serving.max_outstanding_tokens``).
+* **scale UP prefill** on TTFT prefill share (disaggregated fleets:
+  the fraction of disaggregated TTFT spent in the prefill stage).
+* **scale DOWN** only through :meth:`NetworkFrontend.remove_endpoint`
+  (drain first, SIGTERM after) and only below the low-queue watermark
+  with the fleet above ``min_workers``.
+
+Breaches must persist ``hysteresis_ticks`` consecutive evaluations, and
+non-replacement actions respect ``cooldown_s`` — a bursty queue cannot
+flap the fleet.
+
+**Every decision is a traced event**: the autoscaler opens a
+trace-id-stamped :class:`~.metrics.RequestRecord` (class
+``autoscaler``) in the process request log, so the decision rides the
+PR-13 rollup into ``cluster_requests.json`` / ``cluster_trace.json``
+and is retrievable with ``serving trace <id>`` exactly like a user
+request — the operator answers "why did we scale at 14:02" from one
+trace.  Decisions also land as flight-recorder annotations and
+``serving/autoscaler_*`` gauges/counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import debug_once, log_dist, logger, warn_once
+from .tracing import get_request_log, mint_trace_id
+
+#: dead_reason prefix for intentional scale-downs — the replacement
+#: logic must not resurrect a worker the policy removed on purpose
+SCALE_DOWN_REASON = "scale_down (autoscaler)"
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    """One decision, as returned by :meth:`Autoscaler.tick` (the
+    structured twin of the traced record)."""
+
+    action: str            # "scale_up" | "scale_down" | "replace"
+    role: str              # "mixed" | "prefill"
+    reason: str
+    trace_id: str
+    worker_id: Optional[str] = None
+    endpoint: Optional[str] = None
+    ok: bool = True
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Autoscaler:
+    """Policy loop over a :class:`~.remote.NetworkFrontend` and its
+    launched worker fleet.
+
+    ``spawn_fn(worker_id, role) -> WorkerProc`` abstracts the launcher
+    (tests inject fakes); the default spawns real
+    ``python -m deepspeed_tpu.serving worker`` processes via
+    :func:`~..launcher.serving_fleet.spawn_serving_worker`.
+    """
+
+    def __init__(self, frontend: Any, fleet: List[Any], cfg: Any,
+                 spawn_fn: Optional[Callable[..., Any]] = None,
+                 engine: str = "synthetic",
+                 store_endpoint: Optional[str] = None,
+                 worker_extra_args: Optional[List[str]] = None,
+                 max_outstanding_tokens: int = 8192,
+                 stale_ticks: int = 5,
+                 registry: Optional[Any] = None,
+                 recorder: Optional[Any] = None):
+        self.frontend = frontend
+        #: the launched worker processes, autoscaler-owned from here on
+        #: (mutated in place so the integration site's shutdown sees
+        #: spawned replacements too)
+        self.fleet = fleet
+        self.cfg = cfg
+        self.engine = str(engine)
+        self.store_endpoint = store_endpoint
+        self.worker_extra_args = list(worker_extra_args or [])
+        self.max_outstanding_tokens = int(max_outstanding_tokens)
+        #: rollup-staleness threshold: a worker whose telemetry
+        #: publication seq hasn't advanced for this many ticks is dead
+        #: even if no RPC has failed yet (the idle kill -9 case)
+        self.stale_ticks = int(stale_ticks)
+        self.registry = registry
+        self.recorder = recorder
+        self._spawn_fn = spawn_fn
+        self._spawned = 0
+        self._uid = 0
+        #: consecutive-breach counters per rule
+        self._breach: Dict[str, int] = {}
+        self._last_action_mono = 0.0
+        #: worker ids the policy removed on purpose (never resurrected)
+        self._scaled_down: set = set()
+        #: node -> (last seen publication seq, ticks unchanged)
+        self._pub_seen: Dict[str, List[int]] = {}
+        self.decisions: List[ScalingDecision] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._client: Optional[Any] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ds-serving-autoscaler")
+        self._thread.start()
+        log_dist(f"serving autoscaler started "
+                 f"(min={self.cfg.min_workers} max={self.cfg.max_workers})")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception as e:
+                logger.debug(f"autoscaler store client close: {e!r}")
+
+    def _rollup_view(self) -> Optional[Any]:
+        """The fleet's current rollup straight from the store (feeds
+        the staleness detector); None without a store or mid-outage."""
+        if not self.store_endpoint:
+            return None
+        try:
+            # hold the lock only for the handle swap — collect_rollup
+            # does network I/O and must not serialize against tick()
+            with self._lock:
+                client = self._client
+                if client is None:
+                    from ..elasticity.rendezvous import RendezvousClient
+
+                    client = RendezvousClient(self.store_endpoint,
+                                              retries=1,
+                                              backoff_s=0.05)
+                    self._client = client
+            from ..telemetry.rollup import collect_rollup
+
+            return collect_rollup(client,
+                                  [w.id for w in self.fleet])
+        except Exception as e:
+            warn_once("serving/autoscaler-rollup",
+                      f"rollup collect degraded ({e!r})")
+            return None
+
+    def _loop(self) -> None:
+        every = max(0.05, float(getattr(self.cfg, "evaluate_every_s",
+                                        1.0)))
+        while not self._stop.wait(every):
+            try:
+                self.tick(self._rollup_view())
+            except Exception as e:
+                warn_once("serving/autoscaler-tick",
+                          f"autoscaler tick failed ({e!r})")
+
+    # -- fleet plumbing ----------------------------------------------------
+
+    def _spawn(self, worker_id: str, role: str) -> Any:
+        if self._spawn_fn is not None:
+            return self._spawn_fn(worker_id, role)
+        from ..launcher.serving_fleet import spawn_serving_worker
+
+        return spawn_serving_worker(
+            worker_id, role=role, engine=self.engine,
+            store=self.store_endpoint,
+            extra_args=self.worker_extra_args or None)
+
+    def _next_worker_id(self, role: str) -> str:
+        # fresh ids always: the router's drain ledger is id-keyed
+        self._spawned += 1
+        tag = "p" if role == "prefill" else "d"
+        return f"serving-as{tag}{self._spawned}-{int(time.time()) % 100000}"
+
+    def _fleet_by_id(self) -> Dict[str, Any]:
+        return {w.id: w for w in self.fleet}
+
+    def _decode_endpoints(self) -> List[Any]:
+        return [e for e in self.frontend.endpoints if e.role != "prefill"]
+
+    def _live(self, eps: List[Any]) -> List[Any]:
+        return [e for e in eps if e.dead_reason is None]
+
+    # -- signal collection -------------------------------------------------
+
+    def observe_rollup(self, rollup: Any) -> List[str]:
+        """Fold one rollup view in; returns worker node ids whose
+        telemetry publication has been stale for ``stale_ticks``
+        consecutive observations.  THE kill -9 detector: a SIGKILLed
+        worker holds its TCP listener's backlog open (nothing fails
+        fast) but its publisher beat stops instantly."""
+        stale: List[str] = []
+        fleet_ids = set(self._fleet_by_id())
+        for nid in rollup.node_ids():
+            if nid not in fleet_ids:
+                continue
+            doc = rollup.node_doc(nid) or {}
+            seq = int(doc.get("seq", 0))
+            seen = self._pub_seen.setdefault(nid, [seq, 0])
+            if seq == seen[0]:
+                seen[1] += 1
+            else:
+                seen[0], seen[1] = seq, 0
+            if seen[1] >= self.stale_ticks:
+                stale.append(nid)
+        return stale
+
+    def _signals(self) -> Dict[str, Any]:
+        snap = {}
+        try:
+            snap = self.frontend.snapshot()
+        except Exception as e:
+            warn_once("serving/autoscaler-snap",
+                      f"frontend snapshot failed ({e!r})")
+        decode = self._decode_endpoints()
+        live = self._live(decode)
+        n = max(1, len(live))
+        queues = snap.get("queues") or {}
+        queued = sum(int(v) for v in queues.values())
+        outstanding = 0
+        for ep in live:
+            try:
+                outstanding += int(self.frontend._outstanding(ep))
+            except Exception as e:
+                debug_once("serving/autoscaler-outstanding",
+                           f"outstanding probe failed for {ep.id} "
+                           f"({e!r})")
+        prefill_share = None
+        disagg = snap.get("disagg_ttft") or {}
+        if disagg:
+            p50 = {k: float((v or {}).get("p50_ms", 0.0))
+                   for k, v in disagg.items()}
+            total = sum(p50.values())
+            if total > 0:
+                prefill_share = p50.get("prefill_ms", 0.0) / total
+        return {
+            "decode_live": len(live),
+            "decode_total": len(decode),
+            "prefill_live": len(self._live(
+                [e for e in self.frontend.endpoints
+                 if e.role == "prefill"])),
+            "queued_requests": queued,
+            "queue_depth_per_worker": queued / n,
+            "outstanding_tokens": outstanding,
+            "token_saturation": (outstanding / n
+                                 / max(1, self.max_outstanding_tokens)),
+            "ttft_prefill_share": prefill_share,
+        }
+
+    # -- decision tracing --------------------------------------------------
+
+    def _record_decision(self, action: str, role: str, reason: str,
+                         signals: Dict[str, Any]
+                         ) -> "tuple[Any, ScalingDecision]":
+        trace_id = mint_trace_id()
+        self._uid += 1
+        rlog = get_request_log()
+        # sampled=True: a scaling decision is never below the sampling
+        # floor — it must reach cluster_trace.json every time
+        rec = rlog.start(trace_id, f"autoscale-{self._uid}",
+                         "autoscaler", 0, 0, sampled=True)
+        rec.event("decision", action=action, role=role,
+                  reason=reason[:200],
+                  **{k: v for k, v in signals.items() if v is not None})
+        dec = ScalingDecision(action=action, role=role, reason=reason,
+                              trace_id=trace_id)
+        return rec, dec
+
+    def _finalize(self, rec: Any, dec: ScalingDecision) -> None:
+        rec.finish("completed" if dec.ok else "failed")
+        try:
+            get_request_log().commit(rec)
+        except Exception as e:
+            warn_once("serving/autoscaler-trace",
+                      f"decision record commit failed ({e!r})")
+        if self.recorder is not None:
+            try:
+                self.recorder.annotate("autoscaler", dec.to_dict())
+            except Exception as e:
+                logger.debug(f"autoscaler annotation failed: {e!r}")
+        reg = self.registry
+        if reg is not None:
+            try:
+                reg.counter("serving/autoscaler_decisions_total",
+                            "autoscaler scaling decisions").inc()
+                reg.counter(
+                    f"serving/autoscaler_{dec.action}_total",
+                    f"autoscaler {dec.action} decisions").inc()
+            except Exception as e:
+                logger.debug(f"autoscaler metrics failed: {e!r}")
+        with self._lock:
+            self.decisions.append(dec)
+        log_dist(f"autoscaler: {dec.action} {dec.role} "
+                 f"({'ok' if dec.ok else 'FAILED'}) trace={dec.trace_id} "
+                 f"worker={dec.worker_id} — {dec.reason}")
+
+    # -- actions -----------------------------------------------------------
+
+    def _do_scale_up(self, rec: Any, dec: ScalingDecision) -> None:
+        from .remote import ReplicaEndpoint
+
+        wid = self._next_worker_id(dec.role)
+        dec.worker_id = wid
+        try:
+            w = self._spawn(wid, dec.role)
+            rec.event("spawned", worker=wid, pid=getattr(w, "pid", None),
+                      endpoint=getattr(w, "endpoint", None))
+            self.fleet.append(w)
+            ep = ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+            self.frontend.add_endpoint(ep)
+            rec.event("endpoint_added", endpoint=w.endpoint)
+            dec.endpoint = w.endpoint
+        except Exception as e:
+            dec.ok = False
+            dec.error = repr(e)
+            rec.event("spawn_failed", error=repr(e)[:200])
+            warn_once("serving/autoscaler-spawn",
+                      f"scale-up spawn failed ({e!r})")
+
+    def _do_scale_down(self, rec: Any, dec: ScalingDecision,
+                       victim_ep: Any) -> None:
+        dec.worker_id = victim_ep.id
+        dec.endpoint = victim_ep.endpoint
+        self._scaled_down.add(victim_ep.id)
+        # drain FIRST: after remove_endpoint nothing new lands on the
+        # victim and its in-flight work re-queues splice-exact; only
+        # then is the process told to exit
+        self.frontend.remove_endpoint(victim_ep.id,
+                                      reason=SCALE_DOWN_REASON)
+        rec.event("drained", worker=victim_ep.id)
+        w = self._fleet_by_id().get(victim_ep.id)
+        if w is not None and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+                rec.event("terminated", worker=victim_ep.id, pid=w.pid)
+            except OSError as e:
+                dec.error = repr(e)
+                rec.event("terminate_failed", error=repr(e)[:120])
+
+    def _replace_dead(self, signals: Dict[str, Any],
+                      stale_nodes: List[str]) -> List[ScalingDecision]:
+        """Dead-worker replacement (cooldown-exempt).  Dead means: the
+        process exited, the router marked the endpoint dead (and not by
+        our own scale-down), or the rollup publication went stale."""
+        out: List[ScalingDecision] = []
+        fleet_by_id = self._fleet_by_id()
+        for ep in list(self.frontend.endpoints):
+            if ep.id in self._scaled_down:
+                continue
+            reason = None
+            w = fleet_by_id.get(ep.id)
+            if ep.dead_reason is not None \
+                    and not str(ep.dead_reason).startswith("scale_down"):
+                reason = f"endpoint dead: {ep.dead_reason}"
+            elif w is not None and w.proc.poll() is not None:
+                reason = f"worker process exited rc={w.proc.poll()}"
+            elif ep.id in stale_nodes:
+                reason = (f"telemetry publication stale for "
+                          f"{self.stale_ticks} ticks (rollup gap)")
+            if reason is None:
+                continue
+            # count the corpse out of the fleet and drain it (the
+            # stale-publication path may reach here before any RPC
+            # failed — remove_endpoint makes the drain immediate
+            # instead of waiting for a transport error)
+            self._scaled_down.add(ep.id)
+            self.frontend.remove_endpoint(
+                ep.id, reason=f"autoscaler replace: {reason}")
+            if w is not None and w.proc.poll() is None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            rec, dec = self._record_decision(
+                "replace", "prefill" if ep.role == "prefill" else "mixed",
+                reason, signals)
+            rec.event("dead_worker", worker=ep.id,
+                      endpoint=ep.endpoint)
+            if len(self._live(self._decode_endpoints())) \
+                    + signals.get("prefill_live", 0) \
+                    < int(self.cfg.max_workers):
+                self._do_scale_up(rec, dec)
+            else:
+                dec.ok = False
+                dec.error = "fleet at max_workers"
+            self._finalize(rec, dec)
+            out.append(dec)
+        return out
+
+    # -- the policy tick ---------------------------------------------------
+
+    def _breach_tick(self, rule: str, breached: bool) -> bool:
+        """Hysteresis: True only after ``hysteresis_ticks`` CONSECUTIVE
+        breaches (and resets the streak when it trips)."""
+        n = self._breach.get(rule, 0) + 1 if breached else 0
+        self._breach[rule] = n
+        if n >= int(self.cfg.hysteresis_ticks):
+            self._breach[rule] = 0
+            return True
+        return False
+
+    def tick(self, rollup: Optional[Any] = None) -> List[ScalingDecision]:
+        """One evaluation.  ``rollup`` (optional) feeds the staleness
+        detector; the serve/bench integration passes the view its
+        telemetry beat already collects."""
+        signals = self._signals()
+        stale = self.observe_rollup(rollup) if rollup is not None else []
+        out = self._replace_dead(signals, stale)
+        if self.registry is not None:
+            try:
+                self.registry.gauge(
+                    "serving/autoscaler_workers",
+                    "live decode workers the autoscaler sees"
+                ).set(float(signals["decode_live"]))
+                self.registry.gauge(
+                    "serving/autoscaler_queue_depth",
+                    "queued requests per live decode worker"
+                ).set(float(signals["queue_depth_per_worker"]))
+            except Exception as e:
+                logger.debug(f"autoscaler gauges failed: {e!r}")
+        now = time.monotonic()
+        # _last_action_mono == 0.0 means "no action yet": monotonic
+        # time counts from boot, so a fresh autoscaler on a young host
+        # must not start its life inside the cooldown
+        in_cooldown = (self._last_action_mono > 0.0
+                       and now - self._last_action_mono
+                       < float(self.cfg.cooldown_s))
+        n_live = signals["decode_live"] + signals["prefill_live"]
+        # scale UP decode: queue depth or token saturation
+        up_q = self._breach_tick(
+            "up_queue", signals["queue_depth_per_worker"]
+            > float(self.cfg.queue_depth_high))
+        up_t = self._breach_tick(
+            "up_tokens", signals["token_saturation"]
+            > float(self.cfg.token_saturation_high))
+        up_p = self._breach_tick(
+            "up_prefill", signals["ttft_prefill_share"] is not None
+            and signals["ttft_prefill_share"]
+            > float(self.cfg.ttft_prefill_share_high))
+        down = self._breach_tick(
+            "down_queue", signals["queue_depth_per_worker"]
+            < float(self.cfg.queue_depth_low)
+            and signals["token_saturation"] < 0.5
+            and signals["decode_live"] > 1)
+        if not in_cooldown and (up_q or up_t) \
+                and n_live < int(self.cfg.max_workers):
+            reason = (f"queue depth {signals['queue_depth_per_worker']:.2f}"
+                      f" > {self.cfg.queue_depth_high:g}/worker" if up_q
+                      else f"token saturation "
+                           f"{signals['token_saturation']:.2f} > "
+                           f"{self.cfg.token_saturation_high:g}")
+            rec, dec = self._record_decision("scale_up", "mixed", reason,
+                                             signals)
+            self._do_scale_up(rec, dec)
+            self._finalize(rec, dec)
+            self._last_action_mono = now
+            out.append(dec)
+        elif not in_cooldown and up_p \
+                and n_live < int(self.cfg.max_workers):
+            rec, dec = self._record_decision(
+                "scale_up", "prefill",
+                f"TTFT prefill share {signals['ttft_prefill_share']:.2f}"
+                f" > {self.cfg.ttft_prefill_share_high:g}", signals)
+            self._do_scale_up(rec, dec)
+            self._finalize(rec, dec)
+            self._last_action_mono = now
+            out.append(dec)
+        elif not in_cooldown and down \
+                and n_live > int(self.cfg.min_workers):
+            live = self._live(self._decode_endpoints())
+            if len(live) > 1:
+                # the youngest decode worker drains with the least
+                # affinity loss (prefix trees are warmest on veterans)
+                victim = live[-1]
+                rec, dec = self._record_decision(
+                    "scale_down", "mixed",
+                    f"queue depth {signals['queue_depth_per_worker']:.2f}"
+                    f" < {self.cfg.queue_depth_low:g}/worker with "
+                    f"{len(live)} live decode workers", signals)
+                self._do_scale_down(rec, dec, victim)
+                self._finalize(rec, dec)
+                self._last_action_mono = now
+                out.append(dec)
+        return out
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            decs = [d.to_dict() for d in self.decisions[-16:]]
+        return {"decisions": decs,
+                "total": len(self.decisions),
+                "fleet": [{"id": w.id, "role": w.role,
+                           "endpoint": w.endpoint,
+                           "alive": w.proc.poll() is None}
+                          for w in self.fleet]}

@@ -323,7 +323,91 @@ def _network_bench(args: argparse.Namespace) -> int:
         shutdown_fleet(fleet)
 
 
+def _replay_bench(args: argparse.Namespace) -> int:
+    """bench --replay: re-issue a recorded access log.  Against
+    ``--endpoint`` when given (a door someone else runs — the README
+    walkthrough), else against an ephemeral fleet + door (CI smoke)."""
+    from .replay import (read_access_log, replay_report,
+                         replayable_records, run_replay)
+
+    import threading
+
+    records = replayable_records(read_access_log(args.replay))
+    if not records:
+        print(json.dumps({"ok": False,
+                          "error": f"no replayable records in "
+                                   f"{args.replay}"}))
+        return 3
+    fleet, door = [], None
+    tick_stop = threading.Event()
+    ticker = None
+    try:
+        if args.endpoint:
+            host, _, port = args.endpoint.rpartition(":")
+            host, port = host or "127.0.0.1", int(port)
+        else:
+            from ..launcher.serving_fleet import launch_worker_fleet
+            from ..runtime.config import ServingSLOConfig
+            from . import (FrontDoor, FrontDoorParams, NetworkFrontend,
+                           NetworkParams, ReplicaEndpoint)
+
+            from ..telemetry import get_telemetry
+
+            # the burn-rate figure reads this process's registry (the
+            # pump publishes per-class TTFT gauges into it)
+            get_telemetry().configure(enabled=True, jsonl=False,
+                                      prometheus=False)
+            fleet = launch_worker_fleet(args.replicas)
+            eps = [ReplicaEndpoint(w.id, w.endpoint, role=w.role)
+                   for w in fleet]
+            fe = NetworkFrontend(eps, net=NetworkParams())
+            door = FrontDoor(fe, params=FrontDoorParams(),
+                             slo_cfg=ServingSLOConfig())
+            door.start()
+            host, port = door.host, door.port
+            # no store -> no publisher beat; tick the SLO monitor
+            # ourselves so the replay report carries the sentinel
+            # burn-rate figure
+
+            def _tick() -> None:
+                while not tick_stop.wait(0.25):
+                    door.slo_tick()
+
+            ticker = threading.Thread(target=_tick, daemon=True,
+                                      name="ds-replay-slo-tick")
+            ticker.start()
+        out = run_replay(host, port, records, speed=args.speed,
+                         max_requests=args.max_requests)
+        report = replay_report(out, speed=args.speed)
+        report["source"] = args.replay
+        if fleet:
+            report["replicas"] = len(fleet)
+        if door is not None and door.slo is not None:
+            door.slo_tick(force=True)
+            lat = [st["burn_slow"]
+                   for st in door.slo.snapshot()["objectives"]
+                   if st["kind"] == "latency"
+                   and st["burn_slow"] is not None]
+            if lat:
+                report["serving_slo_burn_rate_p99"] = round(max(lat), 4)
+        print(json.dumps(report))
+        return 0 if report["replayed"] > 0 \
+            and not report["aborted"] else 3
+    finally:
+        tick_stop.set()
+        if ticker is not None:
+            ticker.join(timeout=5.0)
+        if door is not None:
+            door.shutdown()
+        if fleet:
+            from ..launcher.serving_fleet import shutdown_fleet
+
+            shutdown_fleet(fleet)
+
+
 def bench_command(args: argparse.Namespace) -> int:
+    if getattr(args, "replay", None):
+        return _replay_bench(args)
     if getattr(args, "network", False):
         return _network_bench(args)
     if args.dry_run:
@@ -417,12 +501,17 @@ def _load_network_config(spec: Optional[str]):
     """``--ds-config``: a DeepSpeed config path or inline JSON whose
     ``serving.network`` group seeds the serve defaults (explicit CLI
     flags win).  The ``serving.tracing`` group, when present, is
-    applied to the process request log as a side input."""
+    applied to the process request log as a side input; the
+    ``serving.slo`` and ``serving.autoscaler`` groups ride back on the
+    returned network config (``_slo_cfg`` / ``_autoscaler_cfg``
+    attributes) for the door/policy-loop construction sites."""
     if not spec:
         return None
     import os
 
-    from ..runtime.config import ServingNetworkConfig, ServingTracingConfig
+    from ..runtime.config import (ServingAutoscalerConfig,
+                                  ServingNetworkConfig, ServingSLOConfig,
+                                  ServingTracingConfig)
 
     if os.path.exists(spec):
         with open(spec) as fh:
@@ -435,7 +524,16 @@ def _load_network_config(spec: Optional[str]):
 
         configure_tracing_from_config(ServingTracingConfig(**tgroup))
     group = (doc.get("serving") or {}).get("network") or {}
-    return ServingNetworkConfig(**group)
+    ncfg = ServingNetworkConfig(**group)
+    sgroup = (doc.get("serving") or {}).get("slo")
+    object.__setattr__(ncfg, "_slo_cfg",
+                       ServingSLOConfig(**sgroup)
+                       if isinstance(sgroup, dict) else None)
+    agroup = (doc.get("serving") or {}).get("autoscaler")
+    object.__setattr__(ncfg, "_autoscaler_cfg",
+                       ServingAutoscalerConfig(**agroup)
+                       if isinstance(agroup, dict) else None)
+    return ncfg
 
 
 def serve_command(args: argparse.Namespace) -> int:
@@ -519,9 +617,38 @@ def serve_command(args: argparse.Namespace) -> int:
 
         get_telemetry().configure(enabled=True, jsonl=False,
                                   prometheus=False)
+    slo_cfg = getattr(ncfg, "_slo_cfg", None) if ncfg is not None \
+        else None
+    if slo_cfg is None and getattr(args, "slo", False):
+        from ..runtime.config import ServingSLOConfig
+
+        slo_cfg = ServingSLOConfig()
     door = FrontDoor(fe, host=host, port=port, params=door_params,
-                     store_endpoint=store)
+                     store_endpoint=store, slo_cfg=slo_cfg)
     door.start()
+    autoscaler = None
+    as_cfg = getattr(ncfg, "_autoscaler_cfg", None) if ncfg is not None \
+        else None
+    if getattr(args, "autoscale", False) and as_cfg is None:
+        from ..runtime.config import ServingAutoscalerConfig
+
+        as_cfg = ServingAutoscalerConfig(enabled=True)
+    if as_cfg is not None and as_cfg.enabled:
+        if fleet:
+            from ..telemetry import get_telemetry
+            from ..telemetry.flight_recorder import get_flight_recorder
+            from .autoscaler import Autoscaler
+
+            autoscaler = Autoscaler(
+                fe, fleet, as_cfg, engine=args.engine,
+                store_endpoint=store,
+                max_outstanding_tokens=fe.params.max_outstanding_tokens,
+                registry=get_telemetry().registry,
+                recorder=get_flight_recorder())
+            autoscaler.start()
+        else:
+            print("warning: --autoscale needs a launched worker fleet "
+                  "(--workers N); ignoring", file=sys.stderr)
     try:
         if args.dry_run:
             # boot -> probe -> clean shutdown, one parseable JSON line
@@ -547,6 +674,8 @@ def serve_command(args: argparse.Namespace) -> int:
         stop.wait()
         return 0
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         door.shutdown()
         if fleet:
             from ..launcher.serving_fleet import shutdown_fleet
@@ -613,6 +742,48 @@ def trace_command(args: argparse.Namespace) -> int:
     return 0
 
 
+def slo_command(args: argparse.Namespace) -> int:
+    """Render the fleet's SLO burn-rate state from the telemetry
+    rollup in the rendezvous store.  Exit 2 when the store is
+    unreachable, 3 when no door is publishing SLO gauges yet."""
+    import sys as _sys
+
+    from ..elasticity.rendezvous import RendezvousClient
+    from ..telemetry.rollup import collect_rollup
+    from .slo import render_slo_table, slo_rows_from_rollup
+
+    if not args.endpoint:
+        print("error: slo needs --endpoint host:port "
+              "(or $DS_RDZV_ENDPOINT)", file=_sys.stderr)
+        return 2
+    client = RendezvousClient(args.endpoint, retries=1, backoff_s=0.05)
+    try:
+        peers = sorted(k.rsplit("/", 1)[1]
+                       for k in client.keys("telemetry/metrics/"))
+        rollup = collect_rollup(client, peers)
+    except (ConnectionError, OSError) as e:
+        print(f"error: store unreachable at {args.endpoint}: {e}",
+              file=_sys.stderr)
+        return 2
+    finally:
+        try:
+            client.close()
+        except (OSError, ConnectionError):
+            pass  # read-only CLI teardown; nothing to leak
+    rows = slo_rows_from_rollup(rollup)
+    if not rows:
+        nodes = ", ".join(peers) or "none publishing"
+        print(f"no SLO gauges in the rollup (nodes consulted: {nodes})"
+              f" — is a door running with serving.slo enabled?",
+              file=_sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(render_slo_table(rows))
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.serving",
@@ -629,6 +800,21 @@ def main(argv: Optional[list] = None) -> int:
     b.add_argument("--duration", type=float, default=3.0,
                    help="--network: sustained-load window (s)")
     b.add_argument("--seed", type=int, default=0)
+    b.add_argument("--replay", default=None, metavar="ACCESS_LOG",
+                   help="re-issue a recorded JSONL access log as load, "
+                        "preserving inter-arrival timing, classes, "
+                        "sizes, and trace ids; reports achieved vs "
+                        "recorded")
+    b.add_argument("--speed", type=float, default=1.0,
+                   help="--replay: time-compression factor (2.0 = "
+                        "twice as fast as recorded)")
+    b.add_argument("--endpoint", default=None,
+                   help="--replay: drive an already-running front "
+                        "door (host:port) instead of an ephemeral "
+                        "fleet")
+    b.add_argument("--max-requests", type=int, default=0,
+                   help="--replay: stop after this many records "
+                        "(0 = all)")
 
     s = sub.add_parser("serve", help="run the HTTP/SSE front door")
     s.add_argument("--dry-run", action="store_true",
@@ -661,6 +847,13 @@ def main(argv: Optional[list] = None) -> int:
     s.add_argument("--access-log", default=None,
                    help="structured JSONL access log path "
                         "(one line per request, size-cap rotated)")
+    s.add_argument("--slo", action="store_true",
+                   help="evaluate default SLO burn-rate monitors in "
+                        "the door (serving.slo config group overrides)")
+    s.add_argument("--autoscale", action="store_true",
+                   help="run the autoscaler policy loop over the "
+                        "launched worker fleet (needs --workers N; "
+                        "serving.autoscaler config group overrides)")
 
     w = sub.add_parser("worker", help="run ONE replica worker process")
     w.add_argument("--id", required=True)
@@ -709,6 +902,16 @@ def main(argv: Optional[list] = None) -> int:
                    help="also write the request lanes as a Chrome-"
                         "trace JSON (open in Perfetto)")
 
+    sl = sub.add_parser("slo", help="fleet SLO burn-rate state from "
+                                    "the telemetry rollup (exit 3 when "
+                                    "no door publishes SLO gauges)")
+    sl.add_argument("--endpoint",
+                    default=_os.environ.get("DS_RDZV_ENDPOINT"),
+                    help="rendezvous store host:port "
+                         "(default: $DS_RDZV_ENDPOINT)")
+    sl.add_argument("--json", action="store_true",
+                    help="emit the SLO rows as JSON")
+
     args = p.parse_args(argv)
     if args.cmd == "bench":
         return bench_command(args)
@@ -718,6 +921,8 @@ def main(argv: Optional[list] = None) -> int:
         return worker_command(args)
     if args.cmd == "trace":
         return trace_command(args)
+    if args.cmd == "slo":
+        return slo_command(args)
     return 2
 
 

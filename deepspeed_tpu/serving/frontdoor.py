@@ -118,17 +118,29 @@ class _DoorHandler(BaseHTTPRequestHandler):
 
     def _log_access(self, status: int, klass: Optional[str] = None,
                     tokens: int = 0, close: str = "done",
-                    t0: Optional[float] = None) -> None:
-        log = self._door().access_log
+                    t0: Optional[float] = None,
+                    ttft_ms: Optional[float] = None) -> None:
+        door = self._door()
+        if self.command == "POST" and self.path.startswith("/v1/generate"):
+            door.count_request(int(status), close)
+        log = door.access_log
         if log is None:
             return
         import time as _time
 
+        # prompt/max-new lengths and TTFT make the line a REPLAYABLE
+        # record (serving/replay.py): the load the request carried and
+        # the latency it saw, not just that it happened
+        meta = getattr(self, "_req_meta", None)
         log.write(method=self.command, path=self.path, status=int(status),
                   klass=klass, trace=getattr(self, "_trace_id", None),
                   duration_ms=(round((_time.perf_counter() - t0) * 1e3, 3)
                                if t0 is not None else None),
                   tokens=int(tokens), close=str(close),
+                  prompt_tokens=(meta[0] if meta else None),
+                  max_new_tokens=(meta[1] if meta else None),
+                  ttft_ms=(round(float(ttft_ms), 3)
+                           if ttft_ms is not None else None),
                   peer=(self.client_address[0]
                         if self.client_address else None))
 
@@ -161,6 +173,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
         import time as _time
 
         t0 = _time.perf_counter()
+        self._req_meta = None
         # accept the edge's trace id, else mint one: every request is
         # traceable, and the id is echoed on every reply either way
         self._trace_id = (sanitize_trace_id(self.headers.get(TRACE_HEADER))
@@ -235,6 +248,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
         max_new = body.get("max_new_tokens", 64)
         try:
             max_new = int(max_new)
+            self._req_meta = (len(prompt), max_new)
             door.frontend.validate(prompt, max_new)
         except (TypeError, ValueError) as e:
             self._send_json(400, {"error": str(e)})
@@ -308,7 +322,7 @@ class _DoorHandler(BaseHTTPRequestHandler):
         doc.update(self._summary(handle))
         self._send_json(200, doc)
         self._log_access(200, klass=handle.klass, tokens=len(toks),
-                         close="done", t0=t0)
+                         close="done", t0=t0, ttft_ms=handle.ttft_ms)
 
     def _stream_sse(self, handle: Any, t0: float) -> None:
         door = self._door()
@@ -358,7 +372,8 @@ class _DoorHandler(BaseHTTPRequestHandler):
                 self.wfile.flush()
                 self._log_access(200, klass=handle.klass, tokens=i,
                                  close=("error" if err is not None
-                                        else "done"), t0=t0)
+                                        else "done"), t0=t0,
+                                 ttft_ms=handle.ttft_ms)
                 return
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the client went away mid-stream: cancel so abandoned
@@ -388,9 +403,27 @@ class FrontDoor:
                  own_frontend: bool = True,
                  store_endpoint: Optional[str] = None,
                  node_id: str = "frontdoor",
-                 telemetry_push_every_s: float = 1.0):
+                 telemetry_push_every_s: float = 1.0,
+                 slo_cfg: Optional[Any] = None):
         self.frontend = frontend
         self.params = params or FrontDoorParams()
+        #: the SLO monitor (ISSUE 16) lives with the door: its registry
+        #: holds every signal the objectives read (per-class percentile
+        #: gauges, 429/5xx counters, the queued-token gauges published
+        #: each beat), and its publisher ships the resulting
+        #: ``serving/slo_*`` gauges + health events on the rollup
+        self.slo: Optional[Any] = None
+        if slo_cfg is not None and getattr(slo_cfg, "enabled", False):
+            from ..telemetry import get_telemetry
+            from ..telemetry.flight_recorder import get_flight_recorder
+            from .slo import SLOMonitor
+
+            self.slo = SLOMonitor.from_config(
+                slo_cfg, registry=get_telemetry().registry,
+                recorder=get_flight_recorder())
+            self._slo_every_s = max(
+                0.1, float(getattr(slo_cfg, "evaluate_every_s", 1.0)))
+        self._slo_last_mono = 0.0
         self.own_frontend = bool(own_frontend)
         self.mode = ("network"
                      if hasattr(frontend, "endpoints") else "local")
@@ -419,6 +452,53 @@ class FrontDoor:
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def count_request(self, status: int, close: str = "done") -> None:
+        """Availability accounting for every POST /v1/generate reply —
+        the denominators and numerators the ``availability`` SLO
+        differentiates (a stream that 200-OKed its headers but ended in
+        ``event: error`` counts as a failure too)."""
+        from ..telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.inc_counter("serving/http_requests_total",
+                        help="front-door /v1/generate requests")
+        if status >= 500 or close == "error":
+            tel.inc_counter("serving/http_5xx_total",
+                            help="front-door 5xx replies and failed "
+                                 "streams")
+
+    def slo_tick(self, now_mono: Optional[float] = None,
+                 force: bool = False) -> None:
+        """One SLO evaluation: publish the door's queued-token gauges,
+        reduce the local registry snapshot to a fleet sample, feed the
+        monitor.  Called from the publisher beat; tests call it
+        directly (no store required).  ``force`` skips the cadence gate
+        (a final end-of-run evaluation must not be dropped)."""
+        if self.slo is None:
+            return
+        import time as _time
+
+        now = _time.monotonic() if now_mono is None else now_mono
+        if not force and now - self._slo_last_mono < self._slo_every_s:
+            return
+        self._slo_last_mono = now
+        from ..telemetry import get_telemetry
+        from .slo import sample_from_snapshot
+
+        tel = get_telemetry()
+        try:
+            for c in CLASSES:
+                tel.set_gauge(
+                    f"serving/door_queued_tokens_{c}",
+                    float(self.frontend.queued_tokens(c)),
+                    help=f"tokens queued at the door, class {c}")
+        except Exception as e:
+            warn_once("serving/door-queued-gauges",
+                      f"queued-token gauge publish failed ({e!r})")
+        self.slo.observe(sample_from_snapshot(
+            tel.registry.snapshot(),
+            queue_token_budget=self.params.queue_token_budget))
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -444,9 +524,20 @@ class FrontDoor:
         client = None
         try:
             client = RendezvousClient(self.store_endpoint)
+            if self.access_log is not None:
+                # one registration, not a stream: `telemetry collect`
+                # copies the live file + its rotated `.1` segment into
+                # the archive from here (ISSUE 16 satellite)
+                import os as _os
+
+                client.set(f"telemetry/accesslog/{self.node_id}",
+                           {"node": self.node_id,
+                            "path": _os.path.abspath(
+                                self.access_log.path)})
             while not self._push_stop.wait(self.telemetry_push_every_s):
                 try:
                     maybe_sync_clock(client, node_id=self.node_id)
+                    self.slo_tick()
                     push_node_telemetry(client, self.node_id)
                 except Exception as e:  # store down: degraded, retry
                     warn_once("serving/frontdoor-push",

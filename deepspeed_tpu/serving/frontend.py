@@ -665,6 +665,9 @@ class ServingFrontend:
             if degraded and klass != "interactive":
                 if self._queues[klass]:
                     self.metrics.inc("admission_deferred_headroom")
+                    from .metrics import count_admission_reject
+
+                    count_admission_reject(self.metrics, "headroom")
                 continue
             q = self._queues[klass]
             while q:
@@ -703,14 +706,24 @@ class ServingFrontend:
                 h.record.event("resumed", replica=rep.id)
             rep.active.append(h)
             return True
+        rejected = {"slots": 0, "pages": 0, "token_budget": 0}
         for rep in self.router.route_candidates(h.prompt):
             if (rep.outstanding_tokens() + len(h.prompt)
                     + h.max_new_tokens
                     > self.params.max_outstanding_tokens):
+                rejected["token_budget"] += 1
                 continue
-            if not rep.scheduler.can_admit(
-                    h.prompt, h.max_new_tokens,
-                    reserve_pages=self._reserve_pages(rep, h.klass)):
+            reserve = self._reserve_pages(rep, h.klass)
+            if not rep.scheduler.can_admit(h.prompt, h.max_new_tokens,
+                                           reserve_pages=reserve):
+                # the pages-only re-check tells slot-blocked (more
+                # workers help) from page-blocked (more HBM helps)
+                if rep.scheduler.can_admit(h.prompt, h.max_new_tokens,
+                                           reserve_pages=reserve,
+                                           ignore_slots=True):
+                    rejected["slots"] += 1
+                else:
+                    rejected["pages"] += 1
                 continue
             h.request = rep.engine.put(h.prompt, h.max_new_tokens)
             h.request.priority = CLASSES.index(h.klass)
@@ -725,6 +738,13 @@ class ServingFrontend:
             return True
         if h.record is not None:
             h.record.note_blocked_admission()
+        if any(rejected.values()):
+            from .metrics import count_admission_reject
+
+            count_admission_reject(
+                self.metrics,
+                max(("slots", "pages", "token_budget"),
+                    key=lambda r: rejected[r]))
         return False
 
     def _preempt_for_interactive(self) -> bool:
@@ -857,6 +877,10 @@ class ServingFrontend:
                 self.metrics.snapshot,
                 lambda: {"queues": {c: len(q)
                                     for c, q in self._queues.items()}},
+                lambda: {"queued_tokens":
+                         {c: sum(len(h.prompt) + h.max_new_tokens
+                                 for h in q)
+                          for c, q in self._queues.items()}},
                 lambda: {"router": self.router.snapshot()},
                 lambda: {"prefix_hit_rate":
                          round(self._aggregate_hit_rate(), 4)},

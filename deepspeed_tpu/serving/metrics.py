@@ -94,6 +94,28 @@ class LatencyTracker:
         return out
 
 
+#: why an admission attempt bounced (ISSUE 16 satellite): no free
+#: decode slot / not enough KV pages / per-replica outstanding-token
+#: budget / HBM-headroom floor deferral.  One increment per blocked
+#: pump round, not per unique request — it is a pressure rate.
+ADMISSION_REJECT_REASONS = ("slots", "pages", "token_budget", "headroom")
+
+
+def count_admission_reject(metrics: "ServingMetrics", reason: str) -> None:
+    """One admission rejection, attributed: the local counter shows in
+    ``/v1/metrics``; the telemetry counter rides the rollup so the
+    cluster view can tell "add workers" (slots/tokens) from "add HBM"
+    (pages/headroom)."""
+    metrics.inc(f"admission_rejected_{reason}")
+    from ..telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.inc_counter(f"serving/admission_rejected_{reason}_total",
+                        help="admission attempts bounced, by blocking "
+                             "resource")
+
+
 class ServingMetrics:
     """The serving plane's numbers: per-class latency + global counters."""
 
@@ -114,6 +136,10 @@ class ServingMetrics:
             "admission_deferred_headroom": 0,
             "disagg_requests": 0,
         }
+        # seeded so a zero shows in /v1/metrics before the first
+        # rejection — an operator diffing reasons must see the absence
+        for r in ADMISSION_REJECT_REASONS:
+            self.counters[f"admission_rejected_{r}"] = 0
 
     def inc(self, name: str, v: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + v

@@ -229,6 +229,40 @@ class NetworkFrontend:
     def healthy_count(self) -> int:
         return sum(1 for e in self.endpoints if e.dead_reason is None)
 
+    def add_endpoint(self, ep: ReplicaEndpoint) -> None:
+        """Adopt a new worker endpoint live (autoscaler scale-up /
+        replacement).  The id must be FRESH: the drain ledger
+        (``_drained``) is keyed by endpoint id, so reusing a dead
+        worker's id would silently skip the new worker's future drain."""
+        with self._lock:
+            if any(e.id == ep.id for e in self.endpoints):
+                raise ValueError(
+                    f"endpoint id {ep.id!r} already known (dead ids "
+                    f"stay in the drain ledger — spawn replacements "
+                    f"under fresh ids)")
+            # the front-end owns transport knobs (see __init__)
+            ep.probe_timeout_s = self.net.probe_timeout_s
+            ep.rpc_timeout_s = self.net.rpc_timeout_s
+            self.endpoints.append(ep)
+        log_dist(f"serving: endpoint {ep.id} ({ep.role}) at "
+                 f"{ep.endpoint} joined the fleet")
+
+    def remove_endpoint(self, eid: str,
+                        reason: str = "scale_down") -> bool:
+        """Kill-safe scale-down: mark the endpoint dead so the pump's
+        existing drain path re-queues its in-flight requests splice-
+        exact — the SAME path a crashed worker takes, so scale-down
+        cannot lose tokens a crash wouldn't.  Stopping the worker
+        process is the caller's job (after this returns, nothing new
+        lands on it)."""
+        with self._lock:
+            ep = self._endpoint_by_id(str(eid))
+            if ep is None:
+                return False
+            if ep.dead_reason is None:
+                ep.mark_dead(str(reason))
+        return True
+
     def _geom(self) -> Optional[Dict[str, int]]:
         if self._geometry is None:
             for ep in self.endpoints:
@@ -596,10 +630,17 @@ class NetworkFrontend:
     def _admit_plain(self, h: ServingHandle) -> bool:
         # cheap local budget screen FIRST: a saturated fleet (the
         # normal overload state) must cost zero match RPCs per retry
+        serving = self._serving_endpoints()
         candidates = [
-            ep for ep in self._serving_endpoints()
+            ep for ep in serving
             if (self._outstanding(ep) + len(h.prompt) + h.max_new_tokens
                 <= self.params.max_outstanding_tokens)]
+        if serving and not candidates:
+            # every live worker is over its outstanding-token budget —
+            # the network plane's one locally-attributable reason
+            from .metrics import count_admission_reject
+
+            count_admission_reject(self.metrics, "token_budget")
         # then prefix affinity (one match RPC per surviving candidate)
         # -> least outstanding -> stable id: the PR-8 placement order
         scored = []
@@ -900,6 +941,9 @@ class NetworkFrontend:
         with self._lock:
             out: Dict[str, Any] = dict(self.metrics.snapshot())
             out["queues"] = {c: len(q) for c, q in self._queues.items()}
+            out["queued_tokens"] = {
+                c: sum(len(h.prompt) + h.max_new_tokens for h in q)
+                for c, q in self._queues.items()}
             out["endpoints"] = [e.snapshot() for e in self.endpoints]
             out["active"] = {eid: len(hs)
                              for eid, hs in self._active.items() if hs}

@@ -1,0 +1,477 @@
+"""SLO monitors — the consumer side of the serving telemetry (ISSUE 16).
+
+PR 13–15 made every serving signal observable (per-worker gauges in the
+cross-process rollup, 429 backpressure counters, per-class latency
+percentiles, request trace lanes); nothing *read* them.  This module
+closes that loop: declarative objectives evaluated continuously against
+the rollup with fast/slow multi-window burn rates, so a breach pages
+only when the error budget is burning NOW (fast window) and the burn is
+sustained (slow window) — the Google-SRE multi-window shape, uniform
+across objective kinds.
+
+Every objective reduces to a **bad-event fraction** per evaluation
+sample:
+
+* ``availability``   — (429 + 5xx) / requests over the window, from
+  front-door counter deltas.
+* ``ttft_<class>`` / ``tpot_<class>`` — 1.0 when the published
+  percentile gauge exceeds its bound at this sample, else 0.0 (the SLO
+  allows the percentile over its bound at most ``1 − target`` of the
+  time).
+* ``token_budget``   — 1.0 when the worst class's queued-token fraction
+  exceeds the saturation bound (the leading indicator for the 429s the
+  availability objective counts after the fact).
+
+``burn_rate(window) = mean(bad fraction over window) / (1 − target)``;
+the alert FIRES when both windows burn ≥ ``burn_rate_threshold`` and
+CLEARS when the fast window drops back under it (the slow window alone
+keeps an old incident's tail from re-paging).
+
+Alert transitions are published everywhere an operator could look:
+:class:`~..telemetry.health.HealthEvent`\\ s (kind ``slo_burn`` /
+``slo_clear``) through the registry counters + ``kind="health"`` event
+stream, flight-recorder annotations (so every debug bundle carries the
+recent alert history), and ``serving/slo_*`` gauges that ride the PR-13
+rollup into ``telemetry top --serving``, the merged Prometheus export
+(``serving_slo_*``), and the perf baseline
+(``serving_slo_burn_rate_p99``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..telemetry.health import SEV_CRITICAL, SEV_WARNING, HealthEvent
+from ..utils.logging import debug_once, logger
+from .metrics import CLASSES
+
+#: gauge-name prefix — prom_name() renders these ``serving_slo_*``
+SLO_GAUGE_PREFIX = "serving/slo_"
+
+
+@dataclasses.dataclass
+class SLOObjective:
+    """One declarative objective.
+
+    ``bad_frac(sample) -> Optional[float]`` maps a fleet sample to the
+    bad-event fraction in [0, 1] for this evaluation (None = the signal
+    is absent this tick — e.g. no requests yet — and the window simply
+    doesn't advance)."""
+
+    id: str
+    kind: str                     # "latency" | "availability" | "saturation"
+    target: float                 # compliance objective in (0, 1)
+    bad_frac: Callable[[Dict[str, Any]], Optional[float]]
+    description: str = ""
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the bad fraction the SLO tolerates."""
+        return max(1e-9, 1.0 - float(self.target))
+
+
+class _Window:
+    """Time-bounded ring of ``(ts, bad_frac, weight)`` samples."""
+
+    def __init__(self, span_s: float):
+        self.span_s = float(span_s)
+        self._ring: "collections.deque" = collections.deque()
+
+    def push(self, ts: float, bad: float, weight: float = 1.0) -> None:
+        self._ring.append((float(ts), float(bad), max(0.0, float(weight))))
+        self._trim(ts)
+
+    def _trim(self, now: float) -> None:
+        while self._ring and now - self._ring[0][0] > self.span_s:
+            self._ring.popleft()
+
+    def mean(self, now: float) -> Optional[float]:
+        """Weighted mean bad fraction over the window (None: no data)."""
+        self._trim(now)
+        wsum = sum(w for _, _, w in self._ring)
+        if wsum <= 0.0:
+            return None
+        return sum(b * w for _, b, w in self._ring) / wsum
+
+
+@dataclasses.dataclass
+class SLOState:
+    """Per-objective alert state, readable by renderers."""
+
+    objective: SLOObjective
+    burn_fast: Optional[float] = None
+    burn_slow: Optional[float] = None
+    alerting: bool = False
+    fired_ts: float = 0.0
+    cleared_ts: float = 0.0
+    transitions: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"id": self.objective.id, "kind": self.objective.kind,
+                "target": self.objective.target,
+                "burn_fast": self.burn_fast, "burn_slow": self.burn_slow,
+                "alerting": self.alerting, "transitions": self.transitions}
+
+
+# ---------------------------------------------------------------------------
+# fleet samples — ONE dict shape, two producers
+# ---------------------------------------------------------------------------
+
+def _sample_from_merged(sums: Dict[str, float], maxes: Dict[str, float],
+                        queue_token_budget: int) -> Dict[str, Any]:
+    sample: Dict[str, Any] = {"ts": time.time()}
+    sample["requests_total"] = sums.get("serving/http_requests_total")
+    sample["rejected_total"] = (
+        sums.get("serving/backpressure_429_total", 0.0)
+        + sums.get("serving/http_5xx_total", 0.0))
+    for c in CLASSES:
+        sample[f"ttft_p99_ms_{c}"] = maxes.get(f"serving/{c}_ttft_p99_ms")
+        sample[f"tpot_p50_ms_{c}"] = maxes.get(f"serving/{c}_tpot_p50_ms")
+    queued = [maxes.get(f"serving/door_queued_tokens_{c}") for c in CLASSES]
+    queued = [q for q in queued if q is not None]
+    if queued and queue_token_budget > 0:
+        sample["token_budget_frac"] = max(queued) / float(queue_token_budget)
+    return sample
+
+
+def _merge_snapshot(snap: Dict[str, Any], sums: Dict[str, float],
+                    maxes: Dict[str, float]) -> None:
+    for name, m in (snap.get("counters") or {}).items():
+        sums[name] += float(m.get("value", 0.0))
+    for name, m in (snap.get("gauges") or {}).items():
+        v = float(m.get("value", 0.0))
+        maxes[name] = max(maxes[name], v) if name in maxes else v
+
+
+def sample_from_snapshot(snap: Dict[str, Any],
+                         queue_token_budget: int = 0) -> Dict[str, Any]:
+    """The fleet sample from ONE registry snapshot — the front door's
+    local evaluation path (its registry already holds the per-class
+    percentile gauges, the 429/5xx counters, and the queued-token
+    gauges it publishes)."""
+    sums: Dict[str, float] = collections.defaultdict(float)
+    maxes: Dict[str, float] = {}
+    _merge_snapshot(snap or {}, sums, maxes)
+    return _sample_from_merged(sums, maxes, queue_token_budget)
+
+
+def sample_from_rollup(rollup: Any,
+                       queue_token_budget: int = 0) -> Dict[str, Any]:
+    """Reduce a :class:`~..telemetry.rollup.MetricsRollup` to the flat
+    fleet sample the objectives read.  Counters sum across nodes (each
+    process owns its own monotonic series); percentile and queued-token
+    gauges take the max across publishers (the worst front-end is the
+    one the SLO is about)."""
+    sums: Dict[str, float] = collections.defaultdict(float)
+    maxes: Dict[str, float] = {}
+    for nid in rollup.node_ids():
+        doc = rollup.node_doc(nid) or {}
+        _merge_snapshot(doc.get("snapshot") or {}, sums, maxes)
+    return _sample_from_merged(sums, maxes, queue_token_budget)
+
+
+# ---------------------------------------------------------------------------
+# objective construction from config
+# ---------------------------------------------------------------------------
+
+def _latency_bad(field: str, bound_ms: float
+                 ) -> Callable[[Dict[str, Any]], Optional[float]]:
+    def bad(sample: Dict[str, Any]) -> Optional[float]:
+        v = sample.get(field)
+        if v is None:
+            return None
+        return 1.0 if float(v) > bound_ms else 0.0
+    return bad
+
+
+def _availability_bad(sample: Dict[str, Any]) -> Optional[float]:
+    # counter LEVELS — SLOMonitor differentiates them into per-tick
+    # deltas before this runs; here the fields are already deltas
+    req = sample.get("_d_requests")
+    bad = sample.get("_d_rejected")
+    if not req:
+        return None
+    return min(1.0, max(0.0, float(bad or 0.0)) / float(req))
+
+
+def _saturation_bad(bound: float
+                    ) -> Callable[[Dict[str, Any]], Optional[float]]:
+    def bad(sample: Dict[str, Any]) -> Optional[float]:
+        v = sample.get("token_budget_frac")
+        if v is None:
+            return None
+        return 1.0 if float(v) > bound else 0.0
+    return bad
+
+
+def objectives_from_config(slo_cfg: Any) -> List[SLOObjective]:
+    """The declarative objective set for a ``serving.slo`` config group
+    (``ServingSLOConfig`` or anything with its fields)."""
+    target = float(getattr(slo_cfg, "availability_target", 0.999))
+    out: List[SLOObjective] = []
+    for c in CLASSES:
+        bound = float(getattr(slo_cfg, f"{c}_ttft_p99_ms", 0.0) or 0.0)
+        if bound > 0:
+            out.append(SLOObjective(
+                id=f"ttft_{c}", kind="latency", target=target,
+                bad_frac=_latency_bad(f"ttft_p99_ms_{c}", bound),
+                description=f"{c} TTFT p99 <= {bound:g} ms"))
+    tpot = float(getattr(slo_cfg, "interactive_tpot_p50_ms", 0.0) or 0.0)
+    if tpot > 0:
+        out.append(SLOObjective(
+            id="tpot_interactive", kind="latency", target=target,
+            bad_frac=_latency_bad("tpot_p50_ms_interactive", tpot),
+            description=f"interactive TPOT p50 <= {tpot:g} ms/token"))
+    out.append(SLOObjective(
+        id="availability", kind="availability", target=target,
+        bad_frac=_availability_bad,
+        description=f"1 - (429+5xx)/requests >= {target:g}"))
+    sat = float(getattr(slo_cfg, "token_budget_saturation", 0.0) or 0.0)
+    if sat > 0:
+        out.append(SLOObjective(
+            id="token_budget", kind="saturation", target=target,
+            bad_frac=_saturation_bad(sat),
+            description=f"queued-token saturation <= {sat:g}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+class SLOMonitor:
+    """Feed :meth:`observe` a fleet sample per evaluation tick; alert
+    transitions are returned AND published (registry gauges + health
+    events + flight-recorder annotations, all optional and guarded)."""
+
+    def __init__(self, objectives: List[SLOObjective],
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 300.0,
+                 burn_rate_threshold: float = 2.0,
+                 registry: Optional[Any] = None,
+                 recorder: Optional[Any] = None):
+        self.objectives = list(objectives)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_rate_threshold = float(burn_rate_threshold)
+        self.registry = registry
+        self.recorder = recorder
+        self._fast = {o.id: _Window(self.fast_window_s)
+                      for o in self.objectives}
+        self._slow = {o.id: _Window(self.slow_window_s)
+                      for o in self.objectives}
+        self.states: Dict[str, SLOState] = {
+            o.id: SLOState(objective=o) for o in self.objectives}
+        #: previous availability-counter levels for differentiation
+        self._prev_req: Optional[float] = None
+        self._prev_rej: Optional[float] = None
+        self.events_total = 0
+
+    @classmethod
+    def from_config(cls, slo_cfg: Any, registry: Optional[Any] = None,
+                    recorder: Optional[Any] = None) -> "SLOMonitor":
+        return cls(objectives_from_config(slo_cfg),
+                   fast_window_s=float(
+                       getattr(slo_cfg, "fast_window_s", 60.0)),
+                   slow_window_s=float(
+                       getattr(slo_cfg, "slow_window_s", 300.0)),
+                   burn_rate_threshold=float(
+                       getattr(slo_cfg, "burn_rate_threshold", 2.0)),
+                   registry=registry, recorder=recorder)
+
+    # -- evaluation --------------------------------------------------------
+
+    def _differentiate(self, sample: Dict[str, Any]) -> None:
+        """Turn availability counter LEVELS into per-tick deltas (the
+        windows accumulate deltas; a restarted publisher's counter reset
+        shows as a negative delta and is clamped to 'no data')."""
+        req, rej = sample.get("requests_total"), sample.get("rejected_total")
+        if req is None:
+            return
+        if self._prev_req is not None and float(req) >= self._prev_req:
+            sample["_d_requests"] = float(req) - self._prev_req
+            sample["_d_rejected"] = max(
+                0.0, float(rej or 0.0) - (self._prev_rej or 0.0))
+        self._prev_req = float(req)
+        self._prev_rej = float(rej or 0.0)
+
+    def observe(self, sample: Dict[str, Any]) -> List[HealthEvent]:
+        """One evaluation tick.  Returns the alert-transition events
+        (fire and clear) this sample caused, already published."""
+        now = float(sample.get("ts") or time.time())
+        self._differentiate(sample)
+        out: List[HealthEvent] = []
+        for obj in self.objectives:
+            st = self.states[obj.id]
+            try:
+                bad = obj.bad_frac(sample)
+            except Exception as e:  # an objective bug must not stop others
+                debug_once(f"slo/{obj.id}",
+                           f"objective {obj.id} evaluation failed ({e!r})")
+                continue
+            if bad is not None:
+                # availability windows weight by request volume so one
+                # quiet tick can't wash out a burst of errors
+                weight = float(sample.get("_d_requests", 1.0) or 1.0) \
+                    if obj.kind == "availability" else 1.0
+                self._fast[obj.id].push(now, bad, weight)
+                self._slow[obj.id].push(now, bad, weight)
+            fast = self._fast[obj.id].mean(now)
+            slow = self._slow[obj.id].mean(now)
+            st.burn_fast = None if fast is None else fast / obj.budget
+            st.burn_slow = None if slow is None else slow / obj.budget
+            thr = self.burn_rate_threshold
+            if (not st.alerting and st.burn_fast is not None
+                    and st.burn_slow is not None
+                    and st.burn_fast >= thr and st.burn_slow >= thr):
+                st.alerting = True
+                st.fired_ts = now
+                st.transitions += 1
+                sev = SEV_CRITICAL if st.burn_fast >= 2 * thr else SEV_WARNING
+                out.append(HealthEvent(
+                    "slo_burn", sev, 0,
+                    f"SLO {obj.id} burning error budget at "
+                    f"{st.burn_fast:.1f}x (fast {self.fast_window_s:g}s) / "
+                    f"{st.burn_slow:.1f}x (slow {self.slow_window_s:g}s), "
+                    f"threshold {thr:g}x — {obj.description}",
+                    st.burn_fast, thr))
+            elif st.alerting and (st.burn_fast is None
+                                  or st.burn_fast < thr):
+                st.alerting = False
+                st.cleared_ts = now
+                st.transitions += 1
+                out.append(HealthEvent(
+                    "slo_clear", SEV_WARNING, 0,
+                    f"SLO {obj.id} alert cleared after "
+                    f"{now - st.fired_ts:.1f}s (fast-window burn "
+                    f"{0.0 if st.burn_fast is None else st.burn_fast:.2f}x "
+                    f"< {thr:g}x)", st.burn_fast or 0.0, thr))
+        for ev in out:
+            self._publish(ev)
+        self._publish_gauges()
+        return out
+
+    # -- publication -------------------------------------------------------
+
+    def _publish(self, ev: HealthEvent) -> None:
+        self.events_total += 1
+        if self.recorder is not None:
+            try:
+                self.recorder.record_health(ev)
+                self.recorder.annotate("slo", ev.to_dict())
+            except Exception as e:
+                debug_once("slo/recorder",
+                           f"SLO event recording failed ({e!r})")
+        reg = self.registry
+        if reg is None:
+            return
+        try:
+            reg.counter("health/events_total",
+                        "training-health anomaly events").inc()
+            reg.counter(f"health/{ev.kind}_total",
+                        f"{ev.kind} events").inc()
+            reg.emit_event("health", ev.to_dict())
+        except Exception as e:
+            debug_once("slo/metrics",
+                       f"SLO event metrics publish failed ({e!r})")
+        logger.warning(f"[slo] {ev.message}")
+
+    def _publish_gauges(self) -> None:
+        """``serving/slo_*`` gauges — they ride push_node_telemetry into
+        the rollup, so ``telemetry top --serving``, the merged
+        Prometheus export (``serving_slo_*``), and the perf baseline
+        read alert state without talking to this process."""
+        reg = self.registry
+        if reg is None:
+            return
+        try:
+            active, worst = 0, 0.0
+            for oid, st in self.states.items():
+                if st.burn_fast is not None:
+                    reg.gauge(f"{SLO_GAUGE_PREFIX}{oid}_burn_fast",
+                              f"fast-window burn rate, {oid}"
+                              ).set(st.burn_fast)
+                if st.burn_slow is not None:
+                    reg.gauge(f"{SLO_GAUGE_PREFIX}{oid}_burn_slow",
+                              f"slow-window burn rate, {oid}"
+                              ).set(st.burn_slow)
+                    worst = max(worst, st.burn_slow)
+                reg.gauge(f"{SLO_GAUGE_PREFIX}{oid}_alert",
+                          f"1 while the {oid} SLO alert is firing"
+                          ).set(1.0 if st.alerting else 0.0)
+                active += 1 if st.alerting else 0
+            reg.gauge(f"{SLO_GAUGE_PREFIX}alerts_active",
+                      "SLO alerts currently firing").set(float(active))
+            lat = [st.burn_slow for st in self.states.values()
+                   if st.objective.kind == "latency"
+                   and st.burn_slow is not None]
+            if lat:
+                # the sentinel-gated summary metric: worst sustained
+                # latency-objective burn rate (serving_slo_burn_rate_p99)
+                reg.gauge(f"{SLO_GAUGE_PREFIX}burn_rate_p99",
+                          "worst slow-window burn rate across p99 "
+                          "latency objectives").set(max(lat))
+        except Exception as e:
+            debug_once("slo/gauges", f"SLO gauge publish failed ({e!r})")
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"threshold": self.burn_rate_threshold,
+                "fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+                "objectives": [self.states[o.id].to_dict()
+                               for o in self.objectives]}
+
+
+# ---------------------------------------------------------------------------
+# stateless render — `telemetry top --serving` and `serving slo` read
+# the PUBLISHED gauges (any process's view of the rollup), not a live
+# monitor
+# ---------------------------------------------------------------------------
+
+def slo_rows_from_rollup(rollup: Any) -> List[Dict[str, Any]]:
+    """Recover per-objective SLO state from the ``serving/slo_*`` gauges
+    riding the rollup.  Works against any node's publication (the door
+    runs the monitor); rows sort alerting-first, worst burn first."""
+    merged: Dict[str, Dict[str, float]] = {}
+    for nid in rollup.node_ids():
+        doc = rollup.node_doc(nid) or {}
+        snap = doc.get("snapshot") or {}
+        for name, m in (snap.get("gauges") or {}).items():
+            if not name.startswith(SLO_GAUGE_PREFIX):
+                continue
+            suffix = name[len(SLO_GAUGE_PREFIX):]
+            for tail in ("_burn_fast", "_burn_slow", "_alert"):
+                if suffix.endswith(tail):
+                    oid, field = suffix[:-len(tail)], tail[1:]
+                    break
+            else:
+                continue
+            row = merged.setdefault(oid, {})
+            row[field] = max(row.get(field, float("-inf")),
+                             float(m.get("value", 0.0)))
+    rows = [{"objective": oid, **vals} for oid, vals in merged.items()]
+    rows.sort(key=lambda r: (-(r.get("alert") or 0.0),
+                             -(r.get("burn_fast") or 0.0), r["objective"]))
+    return rows
+
+
+def render_slo_table(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "no SLO state published (is the front door running with " \
+               "serving.slo.enabled?)"
+    lines = [f"{'OBJECTIVE':<20} {'BURN_FAST':>10} {'BURN_SLOW':>10} "
+             f"{'STATE':<8}"]
+    for r in rows:
+        state = "FIRING" if (r.get("alert") or 0.0) >= 1.0 else "ok"
+        bf, bs = r.get("burn_fast"), r.get("burn_slow")
+        lines.append(
+            f"{r['objective']:<20} "
+            f"{'-' if bf is None else format(bf, '.2f'):>10} "
+            f"{'-' if bs is None else format(bs, '.2f'):>10} "
+            f"{state:<8}")
+    return "\n".join(lines)

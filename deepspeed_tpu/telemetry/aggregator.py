@@ -597,6 +597,12 @@ def collect_cluster_archive(client: Any, peer_ids: Optional[List[str]] = None,
     except (OSError, ConnectionError, ValueError) as e:
         logger.warning(f"aggregator: request-lane collect failed: {e!r}")
     try:
+        # front-door access logs, rotated segments included — the
+        # replayable record of what the fleet was actually asked to do
+        collect_access_logs(client, archive)
+    except (OSError, ConnectionError, ValueError) as e:
+        logger.warning(f"aggregator: access-log collect failed: {e!r}")
+    try:
         build_cluster_trace(archive)
     except Exception as e:  # the archive is still useful without it
         logger.warning(f"aggregator: cluster trace assembly failed: {e!r}")
@@ -792,6 +798,46 @@ def collect_request_docs(client: Any, archive: str) -> bool:
         json.dump({"nodes": docs}, fh, default=str)
     os.replace(tmp, path)
     return True
+
+
+ACCESSLOG_PREFIX = "telemetry/accesslog/"
+
+
+def collect_access_logs(client: Any, archive: str) -> int:
+    """Copy every registered front-door access log — the LIVE file AND
+    its size-cap-rotated ``.1`` segment — into ``<archive>/access_logs/
+    <node>/`` (ISSUE 16 satellite: the rotated segment holds the oldest
+    retained traffic, so a replay built from the archive must see it).
+    Doors register a path, not a stream (``telemetry/accesslog/
+    <node>``); a path on another host's filesystem is recorded as a
+    pointer (``remote.json``) instead of silently skipped.  Returns the
+    number of log files copied."""
+    import shutil
+
+    copied = 0
+    for key in sorted(client.keys(ACCESSLOG_PREFIX)):
+        reg = client.get(key)
+        if not isinstance(reg, dict) or not reg.get("path"):
+            continue
+        node = str(reg.get("node") or key[len(ACCESSLOG_PREFIX):])
+        src = str(reg["path"])
+        dst_dir = os.path.join(archive, "access_logs", node)
+        segments = [p for p in (src + ".1", src) if os.path.exists(p)]
+        if not segments:
+            os.makedirs(dst_dir, exist_ok=True)
+            with open(os.path.join(dst_dir, "remote.json"), "w") as fh:
+                json.dump(reg, fh)
+            continue
+        os.makedirs(dst_dir, exist_ok=True)
+        for seg in segments:
+            base = "access.log" + (".1" if seg.endswith(".1") else "")
+            try:
+                shutil.copyfile(seg, os.path.join(dst_dir, base))
+                copied += 1
+            except OSError as e:
+                logger.warning(f"aggregator: access log {seg} from "
+                               f"{node} not copied ({e!r})")
+    return copied
 
 
 def _newest_bundle_trace(node_dir: str) -> Optional[str]:

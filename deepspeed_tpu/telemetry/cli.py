@@ -383,8 +383,24 @@ def _render_serving_rows(client: Any, silent_after_s: float = 30.0
         v = client.get(key)
         if isinstance(v, dict):
             regs[key[len(SRV_PREFIX):]] = v
+
+    def _slo_block() -> str:
+        # the front door publishes serving/slo_* gauges through the
+        # same rollup (ISSUE 16) — collect over every publisher, not
+        # just registered workers, or the door's lane is invisible
+        from ..serving.slo import render_slo_table, slo_rows_from_rollup
+
+        pub = sorted(k.rsplit("/", 1)[1]
+                     for k in client.keys("telemetry/metrics/"))
+        if not pub:
+            return ""
+        rows = slo_rows_from_rollup(collect_rollup(client, pub))
+        return render_slo_table(rows) if rows else ""
+
     if not regs:
-        return "serving workers: none registered"
+        slo = _slo_block()
+        return ("serving workers: none registered"
+                + ("\n\n" + slo if slo else ""))
     ids = sorted(regs)
     rollup = collect_rollup(client, ids)
     hb = _heartbeat_view(client, ids)
@@ -421,6 +437,10 @@ def _render_serving_rows(client: Any, silent_after_s: float = 30.0
             f"{_fmt(reqs, '{:.0f}'):>7} "
             f"{_fmt(age, '{:.1f}'):>7} "
             f"{state:<8}")
+    slo = _slo_block()
+    if slo:
+        lines.append("")
+        lines.append(slo)
     return "\n".join(lines)
 
 

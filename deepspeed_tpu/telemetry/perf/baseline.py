@@ -67,6 +67,13 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     "serving_net_p99_ttft_ms": ("lower", 0.30),
     "serving_net_qps_sustained": ("higher", 0.25),
     "serving_net_prefix_hit_rate": ("higher", 0.10),
+    # SLO control plane (ISSUE 16): the worst slow-window burn rate
+    # across the latency objectives during the replay workload.  A
+    # burn < 1.0 means the error budget outlives the window, so the
+    # signal is only meaningful near/above 1.0 — wide tolerance (burn
+    # is a ratio of tail latencies, double jitter) plus an absolute
+    # floor below which changes are error-budget noise.
+    "serving_slo_burn_rate_p99": ("lower", 0.50),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -80,6 +87,9 @@ ABS_FLOORS: Dict[str, float] = {
     "serving_p99_ttft_ms": 50.0,
     # the network tail additionally rides loopback + SSE write jitter
     "serving_net_p99_ttft_ms": 75.0,
+    # a fleet comfortably inside its SLO burns < 0.25 of budget-rate;
+    # movement below that is noise, not a regression
+    "serving_slo_burn_rate_p99": 0.25,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

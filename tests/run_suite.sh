@@ -36,9 +36,10 @@ SHARDS=(
   "tests/unit/perf"
   "tests/unit/profiling"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py tests/unit/test_overlap.py"
-  "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py --ignore=tests/unit/multiprocess/test_serving_network.py"
+  "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py --ignore=tests/unit/multiprocess/test_serving_network.py --ignore=tests/unit/multiprocess/test_autoscale.py"
   "tests/unit/multiprocess/test_chaos_control_plane.py -m chaos"
   "tests/unit/multiprocess/test_serving_network.py -m chaos"
+  "tests/unit/multiprocess/test_autoscale.py -m chaos"
   "tests/unit/test_feature_round2.py tests/unit/test_feature_subsystems.py"
 )
 
@@ -320,6 +321,31 @@ if [ $trace_ok -eq 1 ]; then
   echo "=== serving trace smoke passed"
 else
   echo "=== serving trace smoke FAILED"
+  fail=1
+fi
+
+# Replay smoke (ISSUE 16): `serving bench --replay` must re-issue the
+# checked-in diurnal access log against an ephemeral real fleet and
+# emit a parseable fidelity report carrying the sentinel-gated keys
+# (including the SLO burn figure the perf baseline gates).
+echo "=== serving replay smoke: bench --replay (diurnal fixture)"
+replay_line=$(JAX_PLATFORMS=cpu python -m deepspeed_tpu.serving bench \
+    --replay tests/fixtures/serving/diurnal_access.log --speed 20 \
+    --max-requests 40 2>/dev/null | tail -1)
+if echo "$replay_line" | python -c '
+import json, sys
+
+line = json.loads(sys.stdin.read())
+assert line["replayed"] == 40, line
+assert not line["aborted"], line
+for key in ("recorded", "achieved", "diff", "within_tolerance",
+            "serving_net_qps_sustained", "serving_slo_burn_rate_p99"):
+    assert key in line, key
+assert line["achieved"]["failed"] == 0, line["achieved"]
+'; then
+  echo "=== serving replay smoke passed"
+else
+  echo "=== serving replay smoke FAILED"
   fail=1
 fi
 

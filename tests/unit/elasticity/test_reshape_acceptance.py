@@ -66,6 +66,24 @@ class Gang:
         self.agents, self.results = {}, {}
         self.losses, self.worlds = {}, {}
         self.threads = {}
+        self.snap_dirs = {}
+        #: grow tests set this to the joiner's node id: restarted
+        #: incumbents then hold their first post-reseal step until the
+        #: joiner has trained one — otherwise on a loaded box the
+        #: incumbents can sprint to TOTAL and flush snap-6 replicas
+        #: before the joiner's engine even builds, leaving it to
+        #: bootstrap finished state with nothing left to train
+        self.join_barrier = None
+
+    def _snap_committed(self, node, step):
+        """True once ``node``'s snap-<step> carries its commit marker
+        on disk — the flush is async, so a peer can be past the step
+        while the snapshot is still mid-write."""
+        from deepspeed_tpu.resilience.snapshot import SNAPSHOT_MANIFEST
+
+        d = self.snap_dirs.get(node)
+        return d is not None and os.path.exists(
+            os.path.join(d, f"snap-{step:08d}", SNAPSHOT_MANIFEST))
 
     def _worker(self, node):
         def worker(restart_count, ckpt_dir):
@@ -80,6 +98,7 @@ class Gang:
                 res.update(self.extra_resilience)
                 engine, batches = self.factory(node, resilience=res)
             engine.snapshots.attach_rendezvous(agent.rdzv)
+            self.snap_dirs[node] = engine.snapshots.snapshot_dir
             if self.on_engine is not None:
                 self.on_engine(node, restart_count, engine)
             self.worlds.setdefault(node, []).append(
@@ -88,6 +107,12 @@ class Gang:
                 path = engine.resilience.resume_if_restarted(force=True)
                 assert path is not None, \
                     f"{node} restart found no snapshot in any tier"
+            if (restart_count > 0 and self.join_barrier
+                    and node != self.join_barrier):
+                deadline = time.monotonic() + 120.0
+                while (time.monotonic() < deadline
+                       and not self.losses.get(self.join_barrier)):
+                    time.sleep(0.02)
             while engine.global_steps < TOTAL:
                 if agent.rdzv.current_round() != agent._round:
                     raise _RestartSignal("gang changed mid-run")
@@ -102,17 +127,24 @@ class Gang:
                         time.sleep(0.02)
                     raise _RestartSignal("peer set changed at the gate")
                 if (restart_count == 0 and self.faults_for.get(node)
-                        and engine.global_steps == CHAOS_AT):
+                        and engine.global_steps == CHAOS_AT - 1):
                     # the chaos step must not fire while a peer is still
                     # short of its pre-chaos snapshot (step CHAOS_AT-1):
                     # under full-suite load a slow survivor would be
                     # torn down before snap-2 exists and replay from
                     # step 0, which is a scheduling artifact — not the
-                    # resume behavior these tests assert
+                    # resume behavior these tests assert.  The fault
+                    # fires at apply(global_steps + 1) — the ENTRY of
+                    # the train_step numbered CHAOS_AT — so the wait
+                    # must sit at CHAOS_AT-1 (at == CHAOS_AT the fault
+                    # node is already gone).  The flush is ASYNC, so
+                    # passing the step is not enough — wait for each
+                    # peer's COMMITTED snap-2 marker on disk
                     deadline = time.monotonic() + 120.0
                     while time.monotonic() < deadline and not all(
                             any(s >= CHAOS_AT - 1 for _rc, s, _l
                                 in self.losses.get(p, []))
+                            and self._snap_committed(p, CHAOS_AT - 1)
                             for p in self.agents if p != node):
                         time.sleep(0.02)
                 m = engine.train_step(batches[engine.global_steps])
@@ -219,6 +251,7 @@ def test_gang_grows_4_to_5_with_bootstrap_joiner(tiny_engine_factory):
                     lambda _delay: gang.start("host-e"))
 
         gang.on_engine = on_engine
+        gang.join_barrier = "host-e"
         incumbents = ["host-a", "host-b", "host-c", "host-d"]
         for n in incumbents:
             gang.start(n)

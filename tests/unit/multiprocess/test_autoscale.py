@@ -1,0 +1,182 @@
+"""Autoscaler chaos (ISSUE 16 acceptance): replay the checked-in
+diurnal access log against a real front door + real worker processes,
+kill -9 a decode worker mid-replay — the autoscaler detects the loss,
+spawns a replacement through the launcher, every completed stream is
+splice-exact, the availability SLO fires under the shed burst and
+clears once traffic quiets, and the scaling decision is retrievable
+with `serving trace` exactly like a user request."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                 RendezvousServer)
+from deepspeed_tpu.launcher.serving_fleet import (launch_worker_fleet,
+                                                  shutdown_fleet)
+from deepspeed_tpu.runtime.config import (ServingAutoscalerConfig,
+                                          ServingSLOConfig)
+from deepspeed_tpu.serving import (Autoscaler, FrontDoor, FrontDoorParams,
+                                   NetworkFrontend, NetworkParams,
+                                   discover_endpoints, get_request_log,
+                                   read_access_log, replay_report,
+                                   replayable_records, run_replay)
+from deepspeed_tpu.serving.cli import http_generate_stream
+from deepspeed_tpu.serving.cli import main as serving_main
+from deepspeed_tpu.serving.replay import synthesize_prompt
+from deepspeed_tpu.serving.synthetic import synthetic_token
+from deepspeed_tpu.telemetry import (get_flight_recorder, get_telemetry,
+                                     push_node_telemetry)
+
+pytestmark = pytest.mark.chaos
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "..",
+                       "fixtures", "serving", "diurnal_access.log")
+WORKER_ARGS = ["--step-delay-ms", "15", "--push-every", "0.3"]
+
+
+@pytest.mark.timeout(360)
+def test_autoscaler_replaces_kill9_worker_during_replay():
+    srv = RendezvousServer()
+    fleet, door, scaler = [], None, None
+    tick_stop = threading.Event()
+    try:
+        fleet = launch_worker_fleet(2, store=srv.endpoint,
+                                    extra_args=WORKER_ARGS)
+        client = RendezvousClient(srv.endpoint)
+        fe = NetworkFrontend(discover_endpoints(client),
+                             net=NetworkParams(poll_interval_s=0.02))
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        get_request_log().reset()
+        # availability-only SLO with chaos-sized windows: the shed
+        # burst must fire it and the post-replay trickle must clear it
+        slo_cfg = ServingSLOConfig(
+            availability_target=0.9, burn_rate_threshold=2.0,
+            fast_window_s=3.0, slow_window_s=6.0, evaluate_every_s=0.2,
+            interactive_ttft_p99_ms=0.0, batch_ttft_p99_ms=0.0,
+            interactive_tpot_p50_ms=0.0, token_budget_saturation=0.0)
+        # a tight token budget so the replayed peak genuinely sheds
+        door = FrontDoor(fe, params=FrontDoorParams(
+            queue_token_budget=600), slo_cfg=slo_cfg)
+        door.start()
+        # hysteresis parked high: this test is about the cooldown-
+        # exempt replacement path, not the scaling policy
+        as_cfg = ServingAutoscalerConfig(
+            enabled=True, min_workers=1, max_workers=4,
+            hysteresis_ticks=10_000, cooldown_s=0.0,
+            evaluate_every_s=0.25)
+        scaler = Autoscaler(fe, fleet, as_cfg,
+                            store_endpoint=srv.endpoint, stale_ticks=8,
+                            worker_extra_args=WORKER_ARGS,
+                            registry=get_telemetry().registry,
+                            recorder=get_flight_recorder())
+        scaler.start()
+
+        recs = replayable_records(read_access_log(FIXTURE))
+        assert len(recs) == 200
+        recs = recs[:80]
+        out_box = {}
+        replay_thread = threading.Thread(
+            target=lambda: out_box.update(
+                run_replay(door.host, door.port, recs, speed=25.0,
+                           timeout_s=90.0)),
+            daemon=True, name="chaos-replay")
+
+        def _ticker():
+            while not tick_stop.is_set():
+                door.slo_tick(force=True)
+                tick_stop.wait(0.2)
+
+        ticker = threading.Thread(target=_ticker, daemon=True,
+                                  name="chaos-slo-tick")
+        replay_thread.start()
+        ticker.start()
+        time.sleep(1.2)                  # genuinely mid-replay
+        victim = fleet[1]
+        victim.kill9()                   # SIGKILL, no goodbye
+        replay_thread.join(timeout=240)
+        assert not replay_thread.is_alive(), "replay wedged"
+
+        # --- the autoscaler replaced the victim through the launcher
+        rep_dec = None
+        deadline = time.monotonic() + 60
+        while rep_dec is None and time.monotonic() < deadline:
+            rep_dec = next((d for d in scaler.decisions
+                            if d.action == "replace"), None)
+            time.sleep(0.2)
+        assert rep_dec is not None, "no replacement decision"
+        assert rep_dec.ok, rep_dec.error
+        assert rep_dec.worker_id != victim.id
+        replacement = next(w for w in fleet
+                           if w.id == rep_dec.worker_id)
+        assert replacement.proc.poll() is None      # alive
+        assert any(e.id == replacement.id and e.dead_reason is None
+                   for e in fe.endpoints)
+
+        # --- splice-exact streams: every completed replay result
+        # carries EXACTLY the synthetic tokens its prompt determines,
+        # including requests the dead worker's drain re-queued
+        res = out_box["results"]
+        assert res
+        ok200 = [r for r in res
+                 if r["achieved"].get("status_code") == 200]
+        shed = [r for r in res
+                if r["achieved"].get("status_code") == 429]
+        assert len(ok200) + len(shed) == len(res), \
+            [r["achieved"] for r in res
+             if r["achieved"].get("status_code") not in (200, 429)]
+        assert len(ok200) >= 10
+        assert shed, "burst never shed: SLO fire path untested"
+        for r in ok200:
+            rec = r["record"]
+            prompt = synthesize_prompt(rec["trace"], rec["klass"],
+                                       int(rec["prompt_tokens"]))
+            want = [synthetic_token(prompt, k)
+                    for k in range(int(rec["max_new_tokens"]))]
+            assert r["achieved"]["tokens"] == want, rec["trace"]
+        rep = replay_report(out_box, speed=25.0)
+        assert rep["replayed"] == 80
+        assert rep["serving_net_qps_sustained"] > 0
+
+        # --- the SLO loop: fired during the burst, clears under a
+        # quiet trickle once the shed samples age out of the window
+        avail = door.slo.states["availability"]
+        assert avail.transitions >= 1 and avail.fired_ts > 0
+        cleared = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                http_generate_stream(door.host, door.port, [1, 2, 3],
+                                     2, "interactive", timeout=30)
+            except OSError:
+                pass
+            if not avail.alerting and avail.transitions >= 2:
+                cleared = True
+                break
+            time.sleep(0.3)
+        assert cleared, (avail.alerting, avail.transitions,
+                         avail.burn_fast)
+
+        # --- the decision is a first-class trace: push this process's
+        # telemetry (request log + slo gauges ride along) and drive
+        # the real CLIs against the store
+        push_node_telemetry(client, "ctl")
+        assert serving_main(["trace", rep_dec.trace_id,
+                             "--endpoint", srv.endpoint]) == 0
+        assert serving_main(["slo", "--endpoint", srv.endpoint]) == 0
+        snap = get_telemetry().registry.snapshot()
+        cnt = snap["counters"]
+        assert cnt["serving/autoscaler_decisions_total"]["value"] >= 1
+        assert cnt["serving/autoscaler_replace_total"]["value"] >= 1
+        assert "serving/slo_availability_burn_fast" in snap["gauges"]
+    finally:
+        tick_stop.set()
+        if scaler is not None:
+            scaler.stop()
+        if door is not None:
+            door.shutdown()
+        shutdown_fleet(fleet)
+        srv.shutdown()

@@ -2,6 +2,7 @@
 replication, and the E2E chaos acceptance — kill rank 1 at step S in a
 2-host in-process gang and watch the whole loop auto-recover."""
 
+import os
 import threading
 import time
 
@@ -145,10 +146,45 @@ def test_two_host_kill_rank_auto_resume(tiny_engine_factory, monkeypatch):
                 path = engine.resilience.resume_if_restarted(force=True)
                 assert path is not None, "restart found no valid snapshot"
             while engine.global_steps < TOTAL:
+                if (faulted and restart_count == 0
+                        and engine.global_steps == KILL_AT - 1):
+                    # the kill fires at the ENTRY of step KILL_AT and
+                    # the snapshot flush is ASYNC: wait for the
+                    # committed snap-2 marker, or the restart resumes
+                    # from snap-0 — a scheduling artifact, not the
+                    # ≤ snapshot_interval loss this test asserts
+                    from deepspeed_tpu.resilience.snapshot import \
+                        SNAPSHOT_MANIFEST
+                    marker = os.path.join(
+                        engine.snapshots.snapshot_dir,
+                        f"snap-{KILL_AT - 2:08d}", SNAPSHOT_MANIFEST)
+                    deadline = time.monotonic() + 60.0
+                    while (time.monotonic() < deadline
+                           and not os.path.exists(marker)):
+                        time.sleep(0.02)
                 b = batches[engine.global_steps]
                 m = engine.train_step(b)
                 losses[node].append((restart_count, engine.global_steps,
                                      float(m["loss"])))
+            if not faulted and restart_count == 0:
+                # do not finish (and gracefully LEAVE) before the
+                # faulted peer's death has moved the round: a survivor
+                # that leaves first strands the restarted peer's
+                # re-rendezvous below min_nodes for good.  Raise the
+                # restart signal OURSELVES instead of returning — the
+                # beat thread polls the round on its own cadence and
+                # can miss a bump that lands just as the fn returns
+                from deepspeed_tpu.elasticity.elastic_agent import \
+                    _RestartSignal
+                agent = agents[node]
+                deadline = time.monotonic() + 120.0
+                while (time.monotonic() < deadline
+                       and agent.rdzv.current_round() == agent._round):
+                    time.sleep(0.02)
+                if agent.rdzv.current_round() != agent._round:
+                    raise _RestartSignal(
+                        "peer death moved the round; rejoin instead of "
+                        "leaving the restarted peer below min_nodes")
             return "done"
         return worker
 

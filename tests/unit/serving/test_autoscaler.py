@@ -1,0 +1,355 @@
+"""Rollup-driven autoscaler (ISSUE 16): hysteresis, cooldown,
+dead-worker replacement (process exit AND stale rollup publication),
+scale-down through the drain-first path, no resurrection of workers
+the policy removed on purpose, trace-id-stamped decision records, and
+the read-side snapshot."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import ServingAutoscalerConfig
+from deepspeed_tpu.serving import get_request_log
+from deepspeed_tpu.serving.autoscaler import (SCALE_DOWN_REASON,
+                                              Autoscaler)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+
+class FakeProc:
+    def __init__(self, order=None, wid=""):
+        self._rc = None
+        self._order = order
+        self._wid = wid
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self._rc = -15
+        if self._order is not None:
+            self._order.append(("terminate", self._wid))
+
+    def kill(self):
+        self._rc = -9
+
+
+class FakeWorker:
+    def __init__(self, wid, role="mixed", order=None):
+        self.id = wid
+        self.role = role
+        self.endpoint = f"127.0.0.1:90{abs(hash(wid)) % 90 + 10}"
+        self.pid = 4242
+        self.proc = FakeProc(order=order, wid=wid)
+
+
+class FakeEndpoint:
+    def __init__(self, wid, endpoint, role="mixed"):
+        self.id = wid
+        self.endpoint = endpoint
+        self.role = role
+        self.dead_reason = None
+
+
+class FakeFrontend:
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self.removed = []
+        self.queues = {}
+        self.outstanding = {}
+        self.disagg_ttft = {}
+        self.order = []
+
+    def snapshot(self):
+        return {"queues": dict(self.queues),
+                "disagg_ttft": dict(self.disagg_ttft)}
+
+    def _outstanding(self, ep):
+        return self.outstanding.get(ep.id, 0)
+
+    def add_endpoint(self, ep):
+        self.endpoints.append(ep)
+
+    def remove_endpoint(self, eid, reason=""):
+        self.removed.append((eid, reason))
+        self.order.append(("drain", eid))
+        for ep in self.endpoints:
+            if ep.id == eid:
+                try:
+                    ep.dead_reason = reason
+                except AttributeError:   # real ReplicaEndpoint
+                    ep.mark_dead(reason)
+
+
+class FakeRollup:
+    def __init__(self, docs):
+        self.docs = docs
+
+    def node_ids(self):
+        return list(self.docs)
+
+    def node_doc(self, nid):
+        return self.docs.get(nid)
+
+
+def make_scaler(n=1, cfg=None, **kw):
+    fe = FakeFrontend([])
+    fleet = []
+    for i in range(n):
+        w = FakeWorker(f"w{i}", order=fe.order)
+        fleet.append(w)
+        fe.endpoints.append(FakeEndpoint(w.id, w.endpoint))
+    cfg = cfg or ServingAutoscalerConfig(
+        enabled=True, min_workers=1, max_workers=4,
+        hysteresis_ticks=3, cooldown_s=0.0)
+    kw.setdefault("spawn_fn",
+                  lambda wid, role: FakeWorker(wid, role,
+                                               order=fe.order))
+    kw.setdefault("max_outstanding_tokens", 100)
+    return Autoscaler(fe, fleet, cfg, **kw), fe, fleet
+
+
+# ---------------------------------------------------------------------------
+# hysteresis + cooldown
+# ---------------------------------------------------------------------------
+
+def test_scale_up_needs_consecutive_breaches():
+    scaler, fe, fleet = make_scaler()
+    fe.queues = {"interactive": 10}     # depth 10/worker > high 4
+    assert scaler.tick() == []          # breach 1
+    assert scaler.tick() == []          # breach 2
+    decs = scaler.tick()                # breach 3: trips
+    assert [d.action for d in decs] == ["scale_up"]
+    assert decs[0].ok and decs[0].role == "mixed"
+    assert "queue depth" in decs[0].reason
+    assert len(fleet) == 2 and len(fe.endpoints) == 2
+    assert fleet[1].id == decs[0].worker_id
+
+
+def test_breach_streak_resets_on_recovery():
+    scaler, fe, fleet = make_scaler()
+    fe.queues = {"interactive": 10}
+    scaler.tick()
+    scaler.tick()
+    fe.queues = {}                      # breach streak broken
+    assert scaler.tick() == []
+    fe.queues = {"interactive": 10}
+    assert scaler.tick() == []          # streak restarts at 1
+    assert scaler.tick() == []
+    assert [d.action for d in scaler.tick()] == ["scale_up"]
+
+
+def test_cooldown_suppresses_policy_actions():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=8, hysteresis_ticks=1,
+                                  cooldown_s=3600.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    fe.queues = {"interactive": 50}
+    assert len(scaler.tick()) == 1      # first action lands
+    for _ in range(5):                  # then the cooldown gates
+        assert scaler.tick() == []
+    assert len(fleet) == 2
+
+
+def test_token_saturation_scales_up_decode():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    fe.outstanding = {"w0": 90}         # 90/100 > 0.85 saturation
+    decs = scaler.tick()
+    assert [d.action for d in decs] == ["scale_up"]
+    assert "token saturation" in decs[0].reason
+
+
+def test_prefill_share_scales_up_prefill_role():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    fe.disagg_ttft = {"prefill_ms": {"p50_ms": 80.0},
+                      "transfer_ms": {"p50_ms": 10.0},
+                      "decode_first_ms": {"p50_ms": 10.0}}
+    decs = scaler.tick()
+    assert [d.role for d in decs] == ["prefill"]
+    assert decs[0].action == "scale_up"
+    spawned = fleet[-1]
+    assert spawned.role == "prefill"
+
+
+def test_scale_up_respects_max_workers():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=1, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    fe.queues = {"interactive": 50}
+    assert scaler.tick() == []
+    assert len(fleet) == 1
+
+
+# ---------------------------------------------------------------------------
+# replacement: the chaos path
+# ---------------------------------------------------------------------------
+
+def test_replaces_exited_worker_cooldown_exempt():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=3,
+                                  cooldown_s=3600.0)
+    scaler, fe, fleet = make_scaler(n=2, cfg=cfg)
+    scaler._last_action_mono = 1e18     # deep inside cooldown
+    fleet[1].proc._rc = 1               # w1's process exited
+    decs = scaler.tick()
+    assert [d.action for d in decs] == ["replace"]
+    assert decs[0].ok and "exited rc=1" in decs[0].reason
+    # the corpse drained through the kill-safe path, a fresh worker in
+    assert fe.removed[0][0] == "w1"
+    assert fe.removed[0][1].startswith("autoscaler replace:")
+    assert decs[0].worker_id != "w1"
+    assert any(w.id == decs[0].worker_id for w in fleet)
+    # the dead id never resurrects on later ticks
+    assert scaler.tick() == []
+    assert scaler.tick() == []
+
+
+def test_replaces_stale_rollup_publication():
+    """THE kill -9 detector: a SIGKILLed worker's process handle (when
+    another process launched it) and RPCs may look fine for a while,
+    but its telemetry publication seq freezes on the rollup."""
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=10,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(n=2, cfg=cfg, stale_ticks=3)
+    ru = FakeRollup({"w0": {"seq": 7}, "w1": {"seq": 3}})
+    assert scaler.tick(ru) == []        # w1 unchanged: 1 stale tick
+    ru.docs["w0"]["seq"] = 8            # w0 keeps publishing
+    assert scaler.tick(ru) == []        # w1 unchanged: 2 stale ticks
+    ru.docs["w0"]["seq"] = 9
+    decs = scaler.tick(ru)              # w1 unchanged: 3 -> stale
+    assert [d.action for d in decs] == ["replace"]
+    assert "rollup gap" in decs[0].reason
+    assert decs[0].worker_id != "w1" and decs[0].ok
+    assert ("w1", "autoscaler replace: telemetry publication stale "
+            "for 3 ticks (rollup gap)") in fe.removed
+    # nodes outside the fleet never count as stale
+    assert all(n in ("w0", "w1") for n in scaler._pub_seen)
+
+
+def test_replace_fails_loudly_at_max_workers():
+    # replacing the LAST worker is always allowed (the corpse no
+    # longer counts); the error path needs survivors already at max
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=1, hysteresis_ticks=3,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(n=2, cfg=cfg)
+    fleet[1].proc._rc = -9
+    decs = scaler.tick()
+    assert [d.action for d in decs] == ["replace"]
+    assert not decs[0].ok and decs[0].error == "fleet at max_workers"
+    # and a sole dead worker DOES get replaced under max_workers=1
+    scaler2, fe2, fleet2 = make_scaler(n=1, cfg=cfg)
+    fleet2[0].proc._rc = -9
+    decs2 = scaler2.tick()
+    assert [d.ok for d in decs2] == [True]
+
+
+def test_dead_endpoint_reason_triggers_replace_but_scale_down_does_not():
+    scaler, fe, fleet = make_scaler(n=2)
+    fe.endpoints[0].dead_reason = "rpc failed: ConnectionError"
+    fe.endpoints[1].dead_reason = SCALE_DOWN_REASON
+    decs = scaler.tick()
+    assert [d.worker_id is not None for d in decs] == [True]
+    assert [d.action for d in decs] == ["replace"]
+    assert "endpoint dead" in decs[0].reason
+
+
+# ---------------------------------------------------------------------------
+# scale-down: drain first, youngest victim, no resurrection
+# ---------------------------------------------------------------------------
+
+def test_scale_down_drains_before_terminating_youngest():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(n=2, cfg=cfg)
+    # idle fleet: depth 0 < 0.5 with 2 live decode workers
+    decs = scaler.tick()
+    assert [d.action for d in decs] == ["scale_down"]
+    # the youngest decode worker is the victim
+    assert decs[0].worker_id == "w1"
+    # drain STRICTLY before terminate, and with the scale-down reason
+    # (the replacement logic keys off the prefix)
+    assert fe.order == [("drain", "w1"), ("terminate", "w1")]
+    assert fe.removed == [("w1", SCALE_DOWN_REASON)]
+    assert fleet[1].proc.poll() == -15
+    # never resurrected, never scaled below the floor
+    for _ in range(3):
+        assert scaler.tick() == []
+    assert len([e for e in fe.endpoints if e.dead_reason is None]) == 1
+
+
+# ---------------------------------------------------------------------------
+# every decision is a traced event
+# ---------------------------------------------------------------------------
+
+def test_decisions_are_trace_id_stamped_records():
+    class FakeRecorder:
+        def __init__(self):
+            self.annotations = []
+
+        def annotate(self, kind, payload):
+            self.annotations.append((kind, payload))
+
+    reg = MetricsRegistry()
+    rec = FakeRecorder()
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg, registry=reg, recorder=rec)
+    fe.queues = {"interactive": 50}
+    dec = scaler.tick()[0]
+    assert dec.trace_id
+    # the decision rides the process request log -> the rollup -> the
+    # cluster trace, retrievable like any user request
+    matches = get_request_log().find(dec.trace_id)
+    assert matches and matches[0]["klass"] == "autoscaler"
+    assert matches[0]["done"] and matches[0]["status"] == "completed"
+    names = [e["name"] for e in matches[0]["events"]]
+    assert names[:2] == ["decision", "spawned"]
+    assert "endpoint_added" in names
+    decision_ev = matches[0]["events"][0]
+    assert decision_ev["action"] == "scale_up"
+    assert "queue_depth_per_worker" in decision_ev
+    # annotations + counters land too
+    assert [k for k, _ in rec.annotations] == ["autoscaler"]
+    snap = reg.snapshot()
+    cnt = snap["counters"]
+    assert cnt["serving/autoscaler_decisions_total"]["value"] == 1
+    assert cnt["serving/autoscaler_scale_up_total"]["value"] == 1
+    g = snap["gauges"]
+    assert g["serving/autoscaler_workers"]["value"] == 1.0
+    assert g["serving/autoscaler_queue_depth"]["value"] == 50.0
+
+
+def test_snapshot_shape():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=1,
+                                  cooldown_s=0.0)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    fe.queues = {"interactive": 50}
+    scaler.tick()
+    snap = scaler.snapshot()
+    assert snap["total"] == 1 and len(snap["decisions"]) == 1
+    d = snap["decisions"][0]
+    assert d["action"] == "scale_up" and d["ok"] is True
+    assert {w["id"] for w in snap["fleet"]} == {w.id for w in fleet}
+    assert all(w["alive"] for w in snap["fleet"])
+
+
+def test_start_stop_thread_lifecycle():
+    cfg = ServingAutoscalerConfig(enabled=True, min_workers=1,
+                                  max_workers=4, hysteresis_ticks=3,
+                                  cooldown_s=0.0, evaluate_every_s=0.05)
+    scaler, fe, fleet = make_scaler(cfg=cfg)
+    scaler.start()
+    assert scaler._thread is not None
+    scaler.start()                      # idempotent
+    scaler.stop()
+    assert scaler._thread is None
+    scaler.stop()                       # idempotent

@@ -1,0 +1,320 @@
+"""Access-log traffic replay (ISSUE 16): rotation-aware reads,
+replayable filtering, deterministic prompt synthesis, fidelity-report
+math, the checked-in diurnal fixture's reproducibility, an end-to-end
+replay against an in-process front door (trace ids preserved), the
+size-cap-rotation-survives-restart guarantee, `telemetry collect`'s
+access-log archiving, and the `serving trace` exit codes driven by ids
+sourced from a replayed access log."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVCacheConfig
+from deepspeed_tpu.serving import (FrontDoor, FrontDoorParams, Replica,
+                                   ServingFrontend, ServingParams,
+                                   SyntheticEngine, get_request_log,
+                                   read_access_log, replay_report,
+                                   replayable_records, run_replay,
+                                   synthesize_diurnal_log)
+from deepspeed_tpu.serving.replay import (REPLAY_QPS_REL_TOL,
+                                          synthesize_prompt)
+from deepspeed_tpu.serving.tracing import AccessLog
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "..",
+                       "fixtures", "serving", "diurnal_access.log")
+
+
+def make_door(**door_kw):
+    cc = KVCacheConfig(num_blocks=128, block_size=16, max_seq_len=512)
+    fe = ServingFrontend([Replica(SyntheticEngine(cc, max_batch_slots=4),
+                                  0)], params=ServingParams())
+    door = FrontDoor(fe, params=FrontDoorParams(**door_kw))
+    door.start()
+    return door
+
+
+def gen_record(i, ts, klass="interactive", status=200, trace=None,
+               **over):
+    rec = {"ts": ts, "method": "POST", "path": "/v1/generate",
+           "status": status, "klass": klass,
+           "trace": trace or f"rp-trace-{i:04d}", "prompt_tokens": 8,
+           "max_new_tokens": 3, "ttft_ms": 50.0, "peer": "127.0.0.1"}
+    rec.update(over)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# read side
+# ---------------------------------------------------------------------------
+
+def test_read_access_log_spans_rotation_and_skips_malformed(tmp_path):
+    path = str(tmp_path / "access.jsonl")
+    with open(path + ".1", "w") as fh:     # rotated = strictly older
+        fh.write(json.dumps({"ts": 1.0, "seq": 0}) + "\n")
+        fh.write("{torn line the dying process left\n")
+        fh.write(json.dumps({"ts": 2.0, "seq": 1}) + "\n")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"ts": 3.0, "seq": 2}) + "\n")
+        fh.write("[1, 2, 3]\n")            # JSON but not an object
+    recs = read_access_log(path)
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+    # a missing live file still reads the rotated segment (and a fully
+    # absent log reads as empty, never raises)
+    os.unlink(path)
+    assert [r["seq"] for r in read_access_log(path)] == [0, 1]
+    assert read_access_log(str(tmp_path / "nope.jsonl")) == []
+
+
+def test_replayable_records_filters_and_sorts():
+    good_shed = gen_record(0, 9.0, status=429)
+    good = gen_record(1, 5.0, klass="batch")
+    recs = replayable_records([
+        good_shed,
+        gen_record(2, 1.0, method="GET"),            # probe
+        gen_record(3, 1.0, path="/v1/metrics"),      # not generate
+        gen_record(4, 1.0, klass="vip"),             # unknown class
+        gen_record(5, 1.0, prompt_tokens=0),         # never admitted
+        gen_record(6, 1.0, status=400),              # validation reject
+        good])
+    # chronological order across the surviving records
+    assert recs == [good, good_shed]
+
+
+# ---------------------------------------------------------------------------
+# deterministic prompts
+# ---------------------------------------------------------------------------
+
+def test_synthesize_prompt_deterministic_with_shared_class_header():
+    a = synthesize_prompt("trace-aa", "interactive", 64)
+    b = synthesize_prompt("trace-bb", "interactive", 64)
+    assert len(a) == len(b) == 64
+    assert a == synthesize_prompt("trace-aa", "interactive", 64)
+    # same class shares the 48-token header (prefix-cache traffic
+    # shape), tails diverge per trace
+    assert a[:48] == b[:48] and a[48:] != b[48:]
+    # a different class gets a different header
+    c = synthesize_prompt("trace-aa", "batch", 64)
+    assert c[:48] != a[:48]
+    # tiny prompts stay valid (no negative tail)
+    assert len(synthesize_prompt("t", "interactive", 1)) == 1
+    assert all(2 <= t < 29000 for t in a)
+
+
+# ---------------------------------------------------------------------------
+# the fidelity report
+# ---------------------------------------------------------------------------
+
+def _fake_out(n=11, speed=2.0, ach_ttft=110.0, ach_status=200):
+    results = []
+    for i in range(n):
+        results.append({
+            "record": {"klass": "interactive", "status": 200,
+                       "ttft_ms": 100.0, "ts": 1000.0 + i},
+            "achieved": {"status_code": ach_status, "ttft_ms": ach_ttft,
+                         "offset_s": i / speed}})
+    return {"results": results, "elapsed_s": (n - 1) / speed,
+            "aborted": False}
+
+
+def test_replay_report_speed_scaled_diff_within_tolerance():
+    rep = replay_report(_fake_out(), speed=2.0)
+    assert rep["replayed"] == 11 and not rep["aborted"]
+    # recorded 11 req / 10 s; at 2x the achieved 2.2 qps matches
+    assert rep["recorded"]["qps"] == pytest.approx(1.1)
+    assert rep["achieved"]["qps"] == pytest.approx(2.2)
+    assert rep["diff"]["qps_rel"] == pytest.approx(0.0)
+    assert rep["diff"]["ttft_p99_ms_interactive_rel"] == \
+        pytest.approx(0.1)
+    assert rep["diff"]["rate_429_delta"] == 0.0
+    assert rep["within_tolerance"] is True
+    assert rep["tolerances"]["qps_rel"] == REPLAY_QPS_REL_TOL
+    # the sentinel-gated keys ride the report
+    assert rep["serving_net_qps_sustained"] == pytest.approx(2.2)
+    assert rep["serving_net_p99_ttft_ms"] == pytest.approx(110.0)
+
+
+def test_replay_report_flags_ttft_and_429_drift():
+    # TTFT 3x the recorded figure: outside the 50% band
+    rep = replay_report(_fake_out(ach_ttft=300.0), speed=2.0)
+    assert rep["within_tolerance"] is False
+    # achieved sheds where the recording had none: outside 10 pp
+    rep = replay_report(_fake_out(ach_status=429), speed=2.0)
+    assert rep["achieved"]["rate_429"] == 1.0
+    assert rep["diff"]["rate_429_delta"] == 1.0
+    assert rep["within_tolerance"] is False
+    # failures are counted, never silently folded into the qps
+    rep = replay_report(_fake_out(ach_status=-1), speed=2.0)
+    assert rep["achieved"]["failed"] == 11
+
+
+def test_diurnal_fixture_reproducible(tmp_path):
+    """The checked-in replay workload is exactly what
+    synthesize_diurnal_log produces with defaults — anyone can
+    regenerate it and diff."""
+    out = str(tmp_path / "regen.log")
+    rows = synthesize_diurnal_log(out)
+    with open(out) as fh, open(FIXTURE) as fx:
+        assert fh.read() == fx.read()
+    assert len(rows) == 200
+    replayable = replayable_records(rows)
+    assert len(replayable) == 200            # every record replays
+    assert any(r["status"] == 429 for r in rows)   # bursts shed
+    assert {r["klass"] for r in rows} == {"interactive", "batch",
+                                          "background"}
+
+
+# ---------------------------------------------------------------------------
+# rotation survives a front-door restart (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_access_log_rotation_survives_restart(tmp_path):
+    path = str(tmp_path / "access.jsonl")
+    cap = 4096
+    log = AccessLog(path, max_bytes=cap)
+    for i in range(10):
+        log.write(seq=i, pad="x" * 100)
+    # the front-door process restarts: a fresh AccessLog on the same
+    # path must seed its size from the existing file, not from zero
+    log2 = AccessLog(path, max_bytes=cap)
+    for i in range(10, 60):
+        log2.write(seq=i, pad="x" * 100)
+    recs = read_access_log(path)
+    seqs = [r["seq"] for r in recs]
+    # no record double-written, order preserved across the boundary
+    assert len(seqs) == len(set(seqs))
+    assert seqs == sorted(seqs)
+    # rotation happened and kept a contiguous tail ending at the last
+    # write — nothing since the rotation point is missing
+    assert os.path.exists(path + ".1")
+    assert seqs == list(range(seqs[0], 60))
+    # the rotated segment respects the cap: pre-restart bytes counted
+    # (an unseeded size would overshoot by the pre-restart ~1.4 KiB)
+    assert os.path.getsize(path + ".1") <= cap + 200
+
+
+def test_collect_access_logs_archives_segments_and_pointers(tmp_path):
+    from deepspeed_tpu.telemetry.aggregator import (ACCESSLOG_PREFIX,
+                                                    collect_access_logs)
+
+    src = str(tmp_path / "door" / "access.jsonl")
+    os.makedirs(os.path.dirname(src))
+    for p in (src + ".1", src):
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"ts": 1.0}) + "\n")
+
+    class FakeStore:
+        def __init__(self, docs):
+            self.docs = docs
+
+        def keys(self, prefix=""):
+            return [k for k in self.docs if k.startswith(prefix)]
+
+        def get(self, k):
+            return self.docs.get(k)
+
+    store = FakeStore({
+        ACCESSLOG_PREFIX + "door-1": {"node": "door-1", "path": src},
+        ACCESSLOG_PREFIX + "door-2": {"node": "door-2",
+                                      "path": str(tmp_path / "gone")},
+        ACCESSLOG_PREFIX + "bogus": "not-a-registration"})
+    archive = str(tmp_path / "cluster-archive")
+    os.makedirs(archive)
+    assert collect_access_logs(store, archive) == 2
+    base = os.path.join(archive, "access_logs")
+    assert os.path.exists(os.path.join(base, "door-1", "access.log"))
+    assert os.path.exists(os.path.join(base, "door-1", "access.log.1"))
+    # a path on another host's filesystem becomes a pointer, not a skip
+    with open(os.path.join(base, "door-2", "remote.json")) as fh:
+        assert json.load(fh)["node"] == "door-2"
+
+
+# ---------------------------------------------------------------------------
+# end to end: replay against a live in-process door
+# ---------------------------------------------------------------------------
+
+def test_run_replay_preserves_recorded_trace_ids():
+    records = [gen_record(i, 1000.0 + 0.05 * i) for i in range(6)]
+    recs = replayable_records(records)
+    door = make_door()
+    try:
+        out = run_replay(door.host, door.port, recs, speed=10.0,
+                         timeout_s=30.0)
+    finally:
+        door.shutdown()
+    assert not out["aborted"] and len(out["results"]) == 6
+    assert all(r["achieved"]["status_code"] == 200
+               for r in out["results"])
+    # the recorded trace ids rode the X-DS-Trace header end to end:
+    # the door's request ring carries each original id
+    log = get_request_log()
+    for i in range(6):
+        matches = log.find(f"rp-trace-{i:04d}")
+        assert matches and matches[0]["klass"] == "interactive"
+    rep = replay_report(out, speed=10.0)
+    assert rep["replayed"] == 6
+    assert rep["serving_net_qps_sustained"] > 0
+    assert rep["achieved"]["rate_429"] == 0.0
+
+
+def test_run_replay_max_requests_and_stop_event():
+    import threading
+
+    records = [gen_record(i, 1000.0 + i) for i in range(50)]
+    recs = replayable_records(records)
+    door = make_door()
+    try:
+        out = run_replay(door.host, door.port, recs, speed=100.0,
+                         timeout_s=30.0, max_requests=3)
+        assert len(out["results"]) == 3 and not out["aborted"]
+        # a pre-set stop event aborts before anything is issued
+        stop = threading.Event()
+        stop.set()
+        out = run_replay(door.host, door.port, recs, speed=100.0,
+                         stop_event=stop)
+        assert out["results"] == [] and out["aborted"]
+    finally:
+        door.shutdown()
+
+
+def test_trace_cli_exit_codes_from_replayed_log(tmp_path):
+    """Replay preserves trace-id linkage end to end: ids lifted from a
+    replayed access log drive `serving trace` to the same exit codes
+    live ids do — 0 resolved, 2 ambiguous prefix, 3 unknown."""
+    from deepspeed_tpu.elasticity.rendezvous import (RendezvousClient,
+                                                     RendezvousServer)
+    from deepspeed_tpu.serving.cli import main as serving_main
+    from deepspeed_tpu.telemetry import get_telemetry, push_node_telemetry
+
+    path = str(tmp_path / "access.jsonl")
+    with open(path, "w") as fh:
+        for i, trace in enumerate(("rp-amb-000001", "rp-amb-000002")):
+            fh.write(json.dumps(gen_record(i, 1000.0 + 0.05 * i,
+                                           trace=trace)) + "\n")
+    recs = replayable_records(read_access_log(path))
+    assert [r["trace"] for r in recs] == ["rp-amb-000001",
+                                          "rp-amb-000002"]
+    srv = RendezvousServer()
+    door = make_door()
+    try:
+        get_telemetry().configure(enabled=True, jsonl=False,
+                                  prometheus=False)
+        out = run_replay(door.host, door.port, recs, speed=10.0,
+                         timeout_s=30.0)
+        assert len(out["results"]) == 2
+        c = RendezvousClient(srv.endpoint)
+        push_node_telemetry(c, "door")
+        ep = srv.endpoint
+        # the full replayed id resolves to one timeline
+        assert serving_main(["trace", "rp-amb-000001",
+                             "--endpoint", ep]) == 0
+        # a shared prefix of two replayed ids refuses to merge them
+        assert serving_main(["trace", "rp-amb-0000",
+                             "--endpoint", ep]) == 2
+        # an id the log never carried is unknown
+        assert serving_main(["trace", "rp-never-existed",
+                             "--endpoint", ep]) == 3
+    finally:
+        door.shutdown()
+        srv.shutdown()

@@ -139,3 +139,48 @@ def test_door_params_and_cli_config_seeding():
     n = net_params_from_config(ncfg)
     assert n.disaggregate is True
     assert _load_network_config(None) is None
+
+
+def test_serving_slo_autoscaler_config_round_trip():
+    """The serving.slo / serving.autoscaler groups (ISSUE 16) parse,
+    reach the monitor/objective builders, and ride `serve --ds-config`
+    through _load_network_config."""
+    cfg = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1,
+         "serving": {"slo": {"interactive_ttft_p99_ms": 800.0,
+                             "burn_rate_threshold": 3.0,
+                             "fast_window_s": 30.0},
+                     "autoscaler": {"enabled": True, "max_workers": 3,
+                                    "hysteresis_ticks": 2,
+                                    "queue_depth_high": 6.0}}},
+        world_size=1)
+    slo = cfg.serving.slo
+    assert slo.enabled is True and slo.interactive_ttft_p99_ms == 800.0
+    assert slo.burn_rate_threshold == 3.0 and slo.fast_window_s == 30.0
+    asc = cfg.serving.autoscaler
+    assert asc.enabled is True and asc.max_workers == 3
+    assert asc.hysteresis_ticks == 2 and asc.queue_depth_high == 6.0
+    # defaults: SLO monitoring on, autoscaling opt-in
+    cfg0 = DeepSpeedConfig.from_dict_or_path(
+        {"train_micro_batch_size_per_gpu": 1}, world_size=1)
+    assert cfg0.serving.slo.enabled is True
+    assert cfg0.serving.autoscaler.enabled is False
+    # the group builds real objectives: background's 0 bound skipped
+    from deepspeed_tpu.serving import SLOMonitor, objectives_from_config
+
+    ids = [o.id for o in objectives_from_config(slo)]
+    assert "ttft_interactive" in ids and "availability" in ids
+    assert "ttft_background" not in ids
+    mon = SLOMonitor.from_config(slo)
+    assert mon.fast_window_s == 30.0
+    assert mon.burn_rate_threshold == 3.0
+    # serve --ds-config path: the groups piggyback on the network cfg
+    from deepspeed_tpu.serving.cli import _load_network_config
+
+    ncfg = _load_network_config(
+        '{"serving": {"network": {"enabled": true},'
+        ' "slo": {"burn_rate_threshold": 5.0},'
+        ' "autoscaler": {"enabled": true, "min_workers": 2}}}')
+    assert ncfg._slo_cfg.burn_rate_threshold == 5.0
+    assert ncfg._autoscaler_cfg.enabled is True
+    assert ncfg._autoscaler_cfg.min_workers == 2

@@ -1,0 +1,293 @@
+"""SLO burn-rate monitors (ISSUE 16): multi-window fire/clear
+transitions, volume-weighted availability, counter-reset clamping, the
+two sample producers (local snapshot + cross-process rollup), gauge and
+health-event publication, and the stateless `serving slo` render path
+that recovers alert state from published gauges alone."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import ServingSLOConfig
+from deepspeed_tpu.serving.slo import (SLO_GAUGE_PREFIX, SLOMonitor,
+                                       SLOObjective, _Window,
+                                       objectives_from_config,
+                                       render_slo_table,
+                                       sample_from_rollup,
+                                       sample_from_snapshot,
+                                       slo_rows_from_rollup)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+
+class FakeRollup:
+    def __init__(self, docs):
+        self.docs = docs
+
+    def node_ids(self):
+        return list(self.docs)
+
+    def node_doc(self, nid):
+        return self.docs.get(nid)
+
+
+class FakeRecorder:
+    def __init__(self):
+        self.health = []
+        self.annotations = []
+
+    def record_health(self, ev):
+        self.health.append(ev)
+
+    def annotate(self, kind, payload):
+        self.annotations.append((kind, payload))
+
+
+def latency_objective(target=0.9, bound_ms=100.0):
+    def bad(sample):
+        v = sample.get("ttft_p99_ms_interactive")
+        if v is None:
+            return None
+        return 1.0 if float(v) > bound_ms else 0.0
+    return SLOObjective(id="ttft_interactive", kind="latency",
+                        target=target, bad_frac=bad,
+                        description="test objective")
+
+
+# ---------------------------------------------------------------------------
+# windows
+# ---------------------------------------------------------------------------
+
+def test_window_weighted_mean_and_trim():
+    w = _Window(10.0)
+    assert w.mean(0.0) is None
+    w.push(0.0, 1.0, weight=3.0)
+    w.push(1.0, 0.0, weight=1.0)
+    assert abs(w.mean(1.0) - 0.75) < 1e-9
+    # samples older than the span fall out
+    assert w.mean(10.5) == 0.0          # only the ts=1.0 sample left
+    assert w.mean(20.0) is None         # empty again
+    # zero-weight samples never divide by zero
+    w2 = _Window(10.0)
+    w2.push(0.0, 1.0, weight=0.0)
+    assert w2.mean(0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# fire / clear transitions
+# ---------------------------------------------------------------------------
+
+def test_monitor_fires_on_both_windows_and_clears_on_fast():
+    rec = FakeRecorder()
+    reg = MetricsRegistry()
+    mon = SLOMonitor([latency_objective()], fast_window_s=10.0,
+                     slow_window_s=30.0, burn_rate_threshold=2.0,
+                     registry=reg, recorder=rec)
+    # budget 0.1 -> bad=1.0 burns at 10x: both windows over threshold
+    evs = mon.observe({"ts": 1000.0, "ttft_p99_ms_interactive": 500.0})
+    assert [e.kind for e in evs] == ["slo_burn"]
+    assert evs[0].severity == "critical"   # 10x >= 2*threshold
+    st = mon.states["ttft_interactive"]
+    assert st.alerting and st.transitions == 1
+    assert st.burn_fast == pytest.approx(10.0)
+    assert st.burn_slow == pytest.approx(10.0)
+    # more bad ticks: already alerting, no re-fire
+    assert mon.observe({"ts": 1001.0,
+                        "ttft_p99_ms_interactive": 500.0}) == []
+    # good samples past the fast window: fast burn collapses, clears
+    evs = mon.observe({"ts": 1015.0, "ttft_p99_ms_interactive": 50.0})
+    assert [e.kind for e in evs] == ["slo_clear"]
+    assert not st.alerting and st.transitions == 2
+    assert st.burn_fast == 0.0
+    # published everywhere an operator looks
+    assert [e.kind for e in rec.health] == ["slo_burn", "slo_clear"]
+    assert {k for k, _ in rec.annotations} == {"slo"}
+    snap = reg.snapshot()
+    assert snap["counters"]["health/events_total"]["value"] == 2
+    assert snap["counters"]["health/slo_burn_total"]["value"] == 1
+    assert snap["counters"]["health/slo_clear_total"]["value"] == 1
+
+
+def test_monitor_needs_both_windows_over_threshold():
+    # slow window still diluted by old good samples -> no fire
+    mon = SLOMonitor([latency_objective()], fast_window_s=5.0,
+                     slow_window_s=1000.0, burn_rate_threshold=2.0)
+    for i in range(50):
+        mon.observe({"ts": 1000.0 + i, "ttft_p99_ms_interactive": 50.0})
+    evs = mon.observe({"ts": 1055.0, "ttft_p99_ms_interactive": 500.0})
+    st = mon.states["ttft_interactive"]
+    assert evs == [] and not st.alerting
+    assert st.burn_fast >= 2.0 > st.burn_slow
+
+
+def test_availability_weighted_by_request_volume():
+    mon = SLOMonitor(
+        [o for o in objectives_from_config(ServingSLOConfig(
+            availability_target=0.9, interactive_ttft_p99_ms=0.0,
+            batch_ttft_p99_ms=0.0, interactive_tpot_p50_ms=0.0,
+            token_budget_saturation=0.0))],
+        fast_window_s=60.0, slow_window_s=60.0)
+    assert [o.id for o in mon.objectives] == ["availability"]
+    # first sample only establishes counter levels
+    assert mon.observe({"ts": 0.0, "requests_total": 0.0,
+                        "rejected_total": 0.0}) == []
+    st = mon.states["availability"]
+    assert st.burn_fast is None
+    # a 100-request burst at 50% rejection ...
+    mon.observe({"ts": 1.0, "requests_total": 100.0,
+                 "rejected_total": 50.0})
+    burst = st.burn_fast
+    # ... is NOT washed out by one quiet single-request tick: the
+    # window weights by volume, so the mean stays ~0.5/0.1 ~ 5x
+    mon.observe({"ts": 2.0, "requests_total": 101.0,
+                 "rejected_total": 50.0})
+    assert burst == pytest.approx(5.0)
+    assert st.burn_fast > 4.5   # unweighted mean would read 2.5x
+
+
+def test_counter_reset_clamped_to_no_data():
+    mon = SLOMonitor(
+        objectives_from_config(ServingSLOConfig(
+            availability_target=0.9, interactive_ttft_p99_ms=0.0,
+            batch_ttft_p99_ms=0.0, interactive_tpot_p50_ms=0.0,
+            token_budget_saturation=0.0)),
+        fast_window_s=600.0, slow_window_s=600.0)
+    mon.observe({"ts": 0.0, "requests_total": 10.0,
+                 "rejected_total": 0.0})
+    mon.observe({"ts": 1.0, "requests_total": 20.0,
+                 "rejected_total": 10.0})
+    st = mon.states["availability"]
+    before = st.burn_fast
+    assert before is not None
+    # a restarted publisher resets its counters: the negative delta is
+    # clamped to "no data" -- the window must not advance or go negative
+    mon.observe({"ts": 2.0, "requests_total": 3.0,
+                 "rejected_total": 0.0})
+    assert st.burn_fast == before
+    # and differentiation resumes cleanly from the new level
+    mon.observe({"ts": 3.0, "requests_total": 5.0,
+                 "rejected_total": 0.0})
+    assert st.burn_fast < before
+
+
+def test_objective_exception_does_not_stop_others():
+    def boom(sample):
+        raise RuntimeError("bad objective")
+
+    broken = SLOObjective(id="broken", kind="latency", target=0.9,
+                          bad_frac=boom)
+    mon = SLOMonitor([broken, latency_objective()],
+                     fast_window_s=10.0, slow_window_s=10.0)
+    evs = mon.observe({"ts": 0.0, "ttft_p99_ms_interactive": 500.0})
+    assert [e.kind for e in evs] == ["slo_burn"]
+    assert mon.states["broken"].burn_fast is None
+
+
+# ---------------------------------------------------------------------------
+# sample producers
+# ---------------------------------------------------------------------------
+
+def _snap(requests=10.0, r429=2.0, r5xx=1.0, ttft=123.0, queued=450.0):
+    return {"counters": {
+                "serving/http_requests_total": {"value": requests},
+                "serving/backpressure_429_total": {"value": r429},
+                "serving/http_5xx_total": {"value": r5xx}},
+            "gauges": {
+                "serving/interactive_ttft_p99_ms": {"value": ttft},
+                "serving/door_queued_tokens_interactive":
+                    {"value": queued}}}
+
+
+def test_sample_from_snapshot():
+    s = sample_from_snapshot(_snap(), queue_token_budget=1000)
+    assert s["requests_total"] == 10.0
+    assert s["rejected_total"] == 3.0
+    assert s["ttft_p99_ms_interactive"] == 123.0
+    assert s["ttft_p99_ms_batch"] is None
+    assert abs(s["token_budget_frac"] - 0.45) < 1e-9
+    # without a budget the saturation signal is simply absent
+    assert "token_budget_frac" not in sample_from_snapshot(_snap())
+
+
+def test_sample_from_rollup_sums_counters_maxes_gauges():
+    ru = FakeRollup({
+        "door-a": {"snapshot": _snap(requests=10.0, ttft=100.0,
+                                     queued=100.0)},
+        "door-b": {"snapshot": _snap(requests=5.0, r429=0.0, r5xx=0.0,
+                                     ttft=400.0, queued=900.0)}})
+    s = sample_from_rollup(ru, queue_token_budget=1000)
+    assert s["requests_total"] == 15.0          # counters sum
+    assert s["rejected_total"] == 3.0
+    assert s["ttft_p99_ms_interactive"] == 400.0  # gauges max
+    assert abs(s["token_budget_frac"] - 0.9) < 1e-9
+
+
+def test_objectives_from_config_skips_zero_bounds():
+    ids = [o.id for o in objectives_from_config(ServingSLOConfig())]
+    # background bound defaults to 0 -> no objective for it
+    assert ids == ["ttft_interactive", "ttft_batch",
+                   "tpot_interactive", "availability", "token_budget"]
+    objs = objectives_from_config(ServingSLOConfig(
+        batch_ttft_p99_ms=0.0, interactive_tpot_p50_ms=0.0,
+        token_budget_saturation=0.0))
+    assert [o.id for o in objs] == ["ttft_interactive", "availability"]
+    by_id = {o.id: o for o in objs}
+    assert by_id["ttft_interactive"].kind == "latency"
+    assert by_id["availability"].kind == "availability"
+
+
+# ---------------------------------------------------------------------------
+# gauges -> rollup -> stateless render (the `serving slo` path)
+# ---------------------------------------------------------------------------
+
+def test_published_gauges_round_trip_into_slo_rows():
+    reg = MetricsRegistry()
+    mon = SLOMonitor([latency_objective()], fast_window_s=10.0,
+                     slow_window_s=10.0, burn_rate_threshold=2.0,
+                     registry=reg)
+    mon.observe({"ts": 0.0, "ttft_p99_ms_interactive": 500.0})
+    g = reg.snapshot()["gauges"]
+    assert g[f"{SLO_GAUGE_PREFIX}ttft_interactive_burn_fast"][
+        "value"] == pytest.approx(10.0)
+    assert g[f"{SLO_GAUGE_PREFIX}ttft_interactive_alert"]["value"] == 1.0
+    assert g[f"{SLO_GAUGE_PREFIX}alerts_active"]["value"] == 1.0
+    # the sentinel summary gauge: worst latency slow-window burn
+    assert g[f"{SLO_GAUGE_PREFIX}burn_rate_p99"][
+        "value"] == pytest.approx(10.0)
+    # any process holding the rollup recovers the same state
+    rows = slo_rows_from_rollup(
+        FakeRollup({"door": {"snapshot": reg.snapshot()}}))
+    assert rows[0]["objective"] == "ttft_interactive"
+    assert rows[0]["alert"] == 1.0
+    assert rows[0]["burn_fast"] == pytest.approx(10.0)
+    table = render_slo_table(rows)
+    assert "ttft_interactive" in table and "FIRING" in table
+
+
+def test_slo_rows_sort_alerting_first_and_render_empty():
+    ru = FakeRollup({"door": {"snapshot": {"gauges": {
+        f"{SLO_GAUGE_PREFIX}availability_burn_fast": {"value": 5.0},
+        f"{SLO_GAUGE_PREFIX}availability_burn_slow": {"value": 4.0},
+        f"{SLO_GAUGE_PREFIX}availability_alert": {"value": 1.0},
+        f"{SLO_GAUGE_PREFIX}ttft_interactive_burn_fast": {"value": 9.0},
+        f"{SLO_GAUGE_PREFIX}ttft_interactive_alert": {"value": 0.0},
+        # non-SLO and unknown-suffix gauges are ignored, not crashed on
+        "serving/interactive_ttft_p99_ms": {"value": 50.0},
+        f"{SLO_GAUGE_PREFIX}alerts_active": {"value": 1.0}}}}})
+    rows = slo_rows_from_rollup(ru)
+    assert [r["objective"] for r in rows] == ["availability",
+                                              "ttft_interactive"]
+    table = render_slo_table(rows)
+    assert "FIRING" in table and "ok" in table
+    assert "no SLO state published" in render_slo_table([])
+
+
+def test_monitor_snapshot_shape_and_from_config():
+    cfg = ServingSLOConfig(fast_window_s=5.0, slow_window_s=25.0,
+                           burn_rate_threshold=3.0)
+    mon = SLOMonitor.from_config(cfg)
+    assert mon.fast_window_s == 5.0 and mon.slow_window_s == 25.0
+    assert mon.burn_rate_threshold == 3.0
+    snap = mon.snapshot()
+    assert snap["threshold"] == 3.0
+    ids = [o["id"] for o in snap["objectives"]]
+    assert "ttft_interactive" in ids and "availability" in ids
+    for o in snap["objectives"]:
+        assert o["alerting"] is False and o["transitions"] == 0
