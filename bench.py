@@ -1686,6 +1686,71 @@ def _main() -> None:
         free_hbm()
         extras.setdefault("variants", {})["overlap_error"] = str(e)[:200]
 
+    _mark("anatomy")
+    # -- variant: step anatomy — trace-measured comm/compute split --------
+    # One shared profiler session over a few fenced steps of the ring
+    # all_gather_matmul (2+ devices; plain matmul fallback on one),
+    # classified into compute / exposed-collective / overlapped /
+    # host-sync buckets.  comm_fraction is sentinel-gated (lower is
+    # better); the MEASURED overlap hiding backfills the analytic
+    # overlap number when the ring variant couldn't run.
+    try:
+        _budget_check()
+        from deepspeed_tpu.telemetry.anatomy import (capture_step_anatomy,
+                                                     get_cost_ledger)
+
+        devs = jax.devices()
+        if len(devs) >= 2:
+            from jax.sharding import Mesh, PartitionSpec as Psp
+
+            from deepspeed_tpu.comm import overlap as _ovl
+            from deepspeed_tpu.utils.jax_compat import shard_map as _shmap
+
+            amesh = Mesh(np.array(devs), ("data",))
+            afn = jax.jit(_shmap(
+                lambda x, w: _ovl.all_gather_matmul(x, w, "data",
+                                                    chunks=4),
+                mesh=amesh, in_specs=(Psp("data"), Psp()),
+                out_specs=Psp(), check_vma=False))
+        else:
+            afn = jax.jit(lambda x, w: jnp.dot(
+                x, w, preferred_element_type=jnp.bfloat16))
+        xa = jnp.asarray(np.random.RandomState(2).randn(
+            2048, 2048)).astype(jnp.bfloat16)
+        wa = jnp.asarray(np.random.RandomState(3).randn(
+            2048, 2048)).astype(jnp.bfloat16)
+        try:  # roofline join needs costs for the captured program
+            get_cost_ledger().harvest("bench/anatomy_probe", 0,
+                                      afn.lower(xa, wa).compile())
+        except Exception:
+            pass
+        asum = capture_step_anatomy(afn, xa, wa, steps=3,
+                                    site="bench/anatomy_probe")
+        extras["comm_fraction"] = float(asum["comm_fraction"])
+        if (asum.get("overlap_hiding_frac") is not None
+                and "overlap_hiding_frac" not in extras):
+            extras["overlap_hiding_frac"] = round(
+                float(asum["overlap_hiding_frac"]), 3)
+        roof = (asum.get("roofline") or [{}])[0]
+        extras.setdefault("variants", {})["anatomy"] = {
+            "window_us": asum.get("window_us"),
+            "compute_us": asum.get("compute_us"),
+            "coll_exposed_us": asum.get("coll_exposed_us"),
+            "coll_overlapped_us": asum.get("coll_overlapped_us"),
+            "host_sync_us": asum.get("host_sync_us"),
+            "comm_fraction": asum.get("comm_fraction"),
+            "overlap_hiding_frac": asum.get("overlap_hiding_frac"),
+            "attributed_frac": asum.get("attributed_frac"),
+            "roofline_verdict": roof.get("verdict"),
+            "roofline_headroom": roof.get("headroom"),
+            "devices": len(devs),
+        }
+        del xa, wa
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})["anatomy_error"] = str(e)[:200]
+
     _mark("tunnel")
     # -- tunnel characterization ------------------------------------------
     # On this axon setup the chip sits behind a network tunnel.  Measured

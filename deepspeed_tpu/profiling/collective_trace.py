@@ -17,12 +17,14 @@ and the ``ds_bench`` CLI remain the measured-latency paths there.
 from __future__ import annotations
 
 import collections
+import contextlib
 import glob
 import gzip
 import json
 import os
 import tempfile
-from typing import Any, Callable, Dict, Optional, Sequence
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 
@@ -38,13 +40,80 @@ COLLECTIVE_PATTERNS = (
 )
 
 
+# ---------------------------------------------------------------------------
+# shared profiler session
+# ---------------------------------------------------------------------------
+# ``jax.profiler.trace`` sessions DO NOT NEST — opening a second one
+# raises.  Every trace consumer in this repo (the exec-order census, the
+# anatomy capture, ad-hoc ``profile_collectives``) therefore goes
+# through ONE shared session: the first opener owns the real
+# ``jax.profiler.trace`` context, nested openers reuse its output dir,
+# and work that needs the *written* trace files (they only exist after
+# the owning session closes) registers an ``on_session_close`` hook.
+
+_session_lock = threading.Lock()
+_active_session: Optional[Dict[str, Any]] = None  # {"dir": str, "post": []}
+
+
+def active_trace_session() -> Optional[str]:
+    """The output dir of the currently open shared session, or None."""
+    with _session_lock:
+        return _active_session["dir"] if _active_session else None
+
+
+def on_session_close(fn: Callable[[str], Any]) -> bool:
+    """Run ``fn(trace_dir)`` when the open shared session closes (trace
+    files are on disk by then).  Returns False — and does nothing — when
+    no session is open (caller should act immediately instead)."""
+    with _session_lock:
+        if _active_session is None:
+            return False
+        _active_session["post"].append(fn)
+        return True
+
+
+@contextlib.contextmanager
+def shared_trace_session(trace_dir: Optional[str] = None):
+    """ONE ``jax.profiler.trace`` for however many consumers are
+    stacked.  The outermost caller opens (and later closes) the real
+    profiler session; nested callers get the same dir and never open a
+    second session (which would raise).  Yields the trace output dir."""
+    global _active_session
+    with _session_lock:
+        if _active_session is not None:
+            nested_dir = _active_session["dir"]
+        else:
+            nested_dir = None
+            tmp = trace_dir or tempfile.mkdtemp(prefix="ds_anatomy_trace_")
+            _active_session = {"dir": tmp, "post": []}
+    if nested_dir is not None:
+        yield nested_dir
+        return
+    try:
+        with jax.profiler.trace(tmp):
+            yield tmp
+    finally:
+        with _session_lock:
+            posts = _active_session["post"] if _active_session else []
+            _active_session = None
+        for fn in posts:
+            try:
+                fn(tmp)
+            except Exception as e:  # a post-hook must not mask the trace
+                logger.warning(
+                    f"shared trace session: close hook failed ({e!r})")
+
+
 def parse_trace_events(trace_dir: str,
-                       patterns: Sequence[str] = COLLECTIVE_PATTERNS
+                       patterns: Optional[Sequence[str]]
+                       = COLLECTIVE_PATTERNS
                        ) -> list:
     """Individual collective op events from a ``jax.profiler.trace``
     output dir, in device-timestamp order →
     ``[{ts_us, dur_us, name, lane}, ...]``.  Only events on device/XLA
-    lanes count — host Python frames are excluded.
+    lanes count — host Python frames are excluded.  ``patterns=None``
+    keeps EVERY device-lane op (the anatomy plane's full-timeline view);
+    the default keeps collectives only.
 
     The ordering is what makes this the EXECUTION-order source: within
     one device lane, XLA runs a compiled program's thunks in a
@@ -62,6 +131,9 @@ def parse_trace_events(trace_dir: str,
         lanes = {e["pid"]: e.get("args", {}).get("name", "")
                  for e in events
                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+        threads = {(e["pid"], e.get("tid")): e.get("args", {}).get("name", "")
+                   for e in events
+                   if e.get("ph") == "M" and e.get("name") == "thread_name"}
         for e in events:
             if e.get("ph") != "X":
                 continue
@@ -71,16 +143,28 @@ def parse_trace_events(trace_dir: str,
             if not (lane.startswith("/device")
                     or lane.startswith("/host:CPU")):
                 continue
+            # the CPU tracer folds python frames into the '/host:CPU'
+            # process and marks them only by thread name — XLA ops run
+            # on the client threads, python frames on 'python'
+            if threads.get((e.get("pid"), e.get("tid"))) == "python":
+                continue
             name = e.get("name", "")
             low = name.lower()
-            if low.startswith("end:"):
-                continue  # CPU tracer emits paired end markers
-            if any(p in low for p in patterns):
+            if low.startswith("end:") or name.startswith("$"):
+                continue  # CPU tracer end markers / python source refs
+            if patterns is None or any(p in low for p in patterns):
                 out.append({"ts_us": float(e.get("ts", 0.0)),
                             "dur_us": float(e.get("dur", 0.0)),
                             "name": name, "lane": lane})
     out.sort(key=lambda ev: (ev["ts_us"], ev["name"]))
     return out
+
+
+def parse_device_events(trace_dir: str) -> List[Dict[str, Any]]:
+    """EVERY device-lane op event from a profiler trace dir, timestamp
+    ordered — the anatomy classifier's input (collectives + compute +
+    infeed/host waits, not just the collective subset)."""
+    return parse_trace_events(trace_dir, patterns=None)
 
 
 def parse_trace(trace_dir: str,
@@ -140,6 +224,36 @@ def feed_exec_census(trace_dir: str, ledger: Optional[Any] = None,
     return len(events)
 
 
+def collect_exec_census(fn: Callable[..., Any], *args,
+                        iters: int = 1,
+                        ledger: Optional[Any] = None,
+                        trace_dir: Optional[str] = None,
+                        patterns: Sequence[str] = COLLECTIVE_PATTERNS,
+                        **kwargs) -> int:
+    """Run ``fn(*args)`` under the SHARED profiler session and feed the
+    execution-order census from the resulting trace.
+
+    This is the session-safe wrapper around :func:`feed_exec_census`:
+    when another consumer (the anatomy capture) already holds the shared
+    session, no second ``jax.profiler.trace`` is opened — the steps run
+    inside the existing window and the census feed is deferred to the
+    owning session's close (the trace files exist only then).  Returns
+    the entries fed, or ``-1`` when the feed was deferred."""
+    out = fn(*args, **kwargs)  # warmup/compile outside the window
+    jax.block_until_ready(out)
+    nested = active_trace_session() is not None
+    with shared_trace_session(trace_dir) as tdir:
+        for _ in range(max(int(iters), 1)):
+            out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        if nested:
+            on_session_close(
+                lambda d: feed_exec_census(d, ledger=ledger,
+                                           patterns=patterns))
+            return -1
+    return feed_exec_census(tdir, ledger=ledger, patterns=patterns)
+
+
 def profile_collectives(fn: Callable[..., Any], *args,
                         iters: int = 3,
                         trace_dir: Optional[str] = None,
@@ -151,7 +265,7 @@ def profile_collectives(fn: Callable[..., Any], *args,
     out = fn(*args, **kwargs)  # warmup/compile outside the trace
     jax.block_until_ready(out)
     tmp = trace_dir or tempfile.mkdtemp(prefix="ds_comms_trace_")
-    with jax.profiler.trace(tmp):
+    with shared_trace_session(tmp) as tmp:
         for _ in range(iters):
             out = fn(*args, **kwargs)
         jax.block_until_ready(out)

@@ -1,3 +1,5 @@
-from .profiler import FlopsProfiler, get_model_profile
+from .profiler import (DevicePeak, FlopsProfiler, get_model_profile,
+                       peak_flops_per_chip, peak_for_device)
 
-__all__ = ["FlopsProfiler", "get_model_profile"]
+__all__ = ["DevicePeak", "FlopsProfiler", "get_model_profile",
+           "peak_flops_per_chip", "peak_for_device"]

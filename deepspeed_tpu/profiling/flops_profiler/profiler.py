@@ -14,6 +14,7 @@ Wall-clock from timed replay gives achieved FLOP/s and MFU.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -21,16 +22,25 @@ import jax
 
 from ...utils.logging import log_dist, logger
 
-#: published dense bf16 peak per chip by device kind (spec sheets)
-PEAK_BF16_BY_KIND = (
-    ("v6", 918e12),     # Trillium
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
+#: per-chip peaks by device kind (spec sheets): dense bf16 FLOP/s, HBM
+#: bandwidth (bytes/s), aggregate ICI/interconnect bandwidth (bytes/s).
+#: Substring-matched against ``device_kind`` first-match-wins, so the
+#: more specific tag ("v5p", "v6e") must precede its prefix ("v5", "v6").
+PEAK_TABLE = (
+    # (kind tag,   flops,   hbm B/s,  ici B/s)
+    ("v6e",     918e12,  1640e9,  448e9),   # Trillium
+    ("v6",      918e12,  1640e9,  448e9),
+    ("v5p",     459e12,  2765e9,  600e9),
+    ("v5e",     197e12,   819e9,  200e9),
+    ("v5 lite", 197e12,   819e9,  200e9),
+    ("v4",      275e12,  1228e9,  300e9),
+    ("v3",      123e12,   900e9,  175e9),
+    ("v2",       46e12,   700e9,   62e9),
 )
+
+#: published dense bf16 peak per chip by device kind (back-compat view
+#: of PEAK_TABLE; ``peak_for_device`` is the lookup new code uses)
+PEAK_BF16_BY_KIND = tuple((tag, flops) for tag, flops, _, _ in PEAK_TABLE)
 
 #: fallback peak per backend when the device kind is unrecognized
 DEFAULT_PEAK_FLOPS = {
@@ -39,14 +49,61 @@ DEFAULT_PEAK_FLOPS = {
     "gpu": 312e12,
 }
 
+#: (flops, hbm B/s, ici B/s) backend fallbacks for the full peak lookup
+DEFAULT_PEAKS = {
+    "tpu": (197e12, 819e9, 200e9),
+    "gpu": (312e12, 2039e9, 300e9),
+    "cpu": (1e12, 50e9, 10e9),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DevicePeak:
+    """One chip's roofline ceilings.  ``source`` is ``"spec"`` when the
+    device kind matched the spec-sheet table, ``"backend_default"`` when
+    only the backend fallback applied (CPU, unknown kinds)."""
+
+    kind: str
+    flops_per_s: float
+    hbm_bytes_per_s: float
+    ici_bytes_per_s: float
+    source: str = "spec"
+
+    @property
+    def critical_intensity(self) -> float:
+        """FLOPs/byte above which this chip is compute-bound."""
+        return self.flops_per_s / max(self.hbm_bytes_per_s, 1.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["critical_intensity"] = round(self.critical_intensity, 2)
+        return d
+
+
+def peak_for_device(device: Any = None) -> DevicePeak:
+    """THE peak lookup — the single source the MFU math, the anatomy
+    plane's roofline model, and any future bandwidth accounting share.
+    Kind-matched against the spec table, backend fallback otherwise."""
+    dev = device if device is not None else jax.devices()[0]
+    kind = getattr(dev, "device_kind", "") or ""
+    low = kind.lower()
+    for tag, flops, hbm, ici in PEAK_TABLE:
+        if tag in low:
+            return DevicePeak(kind=kind, flops_per_s=flops,
+                              hbm_bytes_per_s=hbm, ici_bytes_per_s=ici)
+    backend = (getattr(dev, "platform", None) or jax.default_backend())
+    flops, hbm, ici = DEFAULT_PEAKS.get(str(backend), DEFAULT_PEAKS["cpu"])
+    return DevicePeak(kind=kind or str(backend), flops_per_s=flops,
+                      hbm_bytes_per_s=hbm, ici_bytes_per_s=ici,
+                      source="backend_default")
+
 
 def peak_flops_per_chip() -> float:
-    """bf16 peak for THIS chip (kind-matched, backend fallback)."""
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
-    for tag, peak in PEAK_BF16_BY_KIND:
-        if tag in kind:
-            return peak
+    """bf16 peak for THIS chip — ``peak_for_device().flops_per_s``, kept
+    as the narrow helper the MFU call sites read."""
+    peak = peak_for_device()
+    if peak.source == "spec":
+        return peak.flops_per_s
     return DEFAULT_PEAK_FLOPS.get(jax.default_backend(), 1e12)
 
 

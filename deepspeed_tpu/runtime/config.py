@@ -323,6 +323,13 @@ class TelemetryPerfConfig(DeepSpeedConfigModel):
     goodput: bool = True
     #: rolling-goodput window (seconds) for the heartbeat fraction
     goodput_window_s: float = 600.0
+    #: step-anatomy plane (``telemetry/anatomy``): harvest FLOPs/bytes
+    #: rooflines from every AOT compile, enable engine.capture_anatomy
+    anatomy: bool = True
+    #: fenced steps per capture_anatomy trace window
+    anatomy_capture_steps: int = 2
+    #: programs in the roofline predicted-vs-measured join
+    anatomy_top_k: int = 5
 
 
 class TelemetryConfig(DeepSpeedConfigModel):

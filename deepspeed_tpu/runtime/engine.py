@@ -443,8 +443,10 @@ class DeepSpeedEngine:
         # residuals) are in the compile table too.
         self.compile_tracker = None
         self.goodput = None
+        self.cost_ledger = None
+        self._last_anatomy = None
+        self._anatomy_cfg = pcfg = tcfg.perf
         self._compile_dominated_frac = float(h_cfg.compile_dominated_frac)
-        pcfg = tcfg.perf
         if pcfg.enabled and tcfg.enabled:
             from ..telemetry.perf import (configure_compile_tracker,
                                           configure_goodput_ledger)
@@ -456,6 +458,17 @@ class DeepSpeedEngine:
             if pcfg.goodput:
                 self.goodput = configure_goodput_ledger(
                     enabled=True, window_s=pcfg.goodput_window_s,
+                    recorder=self.flight_recorder)
+            # anatomy plane (ISSUE 17): the cost ledger rides the
+            # compile tracker — every AOT compile is harvested for
+            # FLOPs/HBM/collective bytes + a roofline verdict at the
+            # moment the executable exists, so the steady state pays
+            # nothing
+            if pcfg.anatomy and self.compile_tracker is not None:
+                from ..telemetry.anatomy import configure_cost_ledger
+
+                self.cost_ledger = configure_cost_ledger(
+                    tracker=self.compile_tracker,
                     recorder=self.flight_recorder)
 
         # --- memory observability plane (telemetry/memory — ISSUE 7) -----
@@ -1728,6 +1741,12 @@ class DeepSpeedEngine:
                       and self.global_steps % self._mem_census_every
                       == 1 % self._mem_census_every)  # every=1 → each step
             extra.update(self.memory_ledger.step_sample(live_census=census))
+        if self._last_anatomy is not None:
+            # the capture's compact summary rides the NEXT step record
+            # once (anatomy plane) — bundles and the rollup see where
+            # the traced window's device time went
+            extra["anatomy"] = self._last_anatomy
+            self._last_anatomy = None
         if comms_logger.enabled and comms_logger.exec_counts:
             # THIS step's execution-probe activity: shard-normalized
             # cumulative totals (satellite: no more hand-dividing by
@@ -1885,7 +1904,86 @@ class DeepSpeedEngine:
             for k in ("peak_hbm_bytes", "hbm_headroom_frac"):
                 if k in sample:
                     out[k] = sample[k]
+        if self.cost_ledger is not None and "step_time_p50_ms" in out:
+            # roofline headroom (anatomy plane): 1 - predicted/measured
+            # for the step program — the tuning tie-breaker (a config
+            # near its roofline is fast BECAUSE of the hardware, not by
+            # accident of an unexplained stall going quiet this trial)
+            head = self.cost_ledger.headroom(
+                self._anatomy_site(), out["step_time_p50_ms"] * 1e3)
+            if head is not None:
+                out["roofline_headroom"] = head
         return out
+
+    def _anatomy_site(self) -> str:
+        """The tracked jit site of the CURRENT step program (offload
+        engines step through grad_step; everyone else the fused step)."""
+        if self.cost_ledger is not None:
+            for site in ("engine/train_step_fused", "engine/train_step",
+                         "engine/grad_step"):
+                if self.cost_ledger.entry_for(site):
+                    return site
+        return "engine/train_step_fused"
+
+    def capture_anatomy(self, batch, steps: Optional[int] = None,
+                        trace_dir: Optional[str] = None,
+                        feed_census: Optional[bool] = None
+                        ) -> Dict[str, Any]:
+        """Step anatomy (ISSUE 17): trace ``steps`` fenced train steps
+        under ONE shared profiler session and return the attribution
+        summary — compute / exposed-collective / overlapped-collective /
+        host-sync buckets, measured overlap hiding, and the roofline
+        predicted-vs-measured join for this engine's step program.
+
+        The exec-order census (when ``aggregation.ledger_exec_feed`` is
+        on, or ``feed_census=True``) is fed from the SAME trace — one
+        profiler window serves both consumers; nested sessions raise in
+        jax, so this is the only safe composition.  The compact summary
+        also lands on the next StepRecord's ``extra['anatomy']``, the
+        ``anatomy/*`` gauges, and the debug-bundle context.
+        """
+        from ..telemetry.anatomy import capture_step_anatomy
+        from ..telemetry.anatomy.ledger import get_cost_ledger
+
+        cfg = self._anatomy_cfg
+        n = int(steps if steps is not None
+                else cfg.anatomy_capture_steps)
+        if feed_census is None:
+            feed_census = bool(getattr(
+                self.config.telemetry.aggregation, "ledger_exec_feed",
+                False))
+        ledger = self.cost_ledger or get_cost_ledger()
+
+        def _one(b):
+            m = self.train_step(b)
+            float(m["loss"])  # the per-step fence IS the window edge
+            return m["loss"]
+
+        summary = capture_step_anatomy(
+            _one, batch, steps=n, trace_dir=trace_dir,
+            site=self._anatomy_site(), ledger=ledger,
+            top_k=int(cfg.anatomy_top_k), feed_census=feed_census)
+        if not summary.get("deferred"):
+            compact = {k: summary.get(k) for k in (
+                "window_us", "steps", "compute_us", "coll_exposed_us",
+                "coll_overlapped_us", "host_sync_us", "idle_us",
+                "comm_fraction", "overlap_hiding_frac",
+                "attributed_frac", "roofline_top")}
+            self._last_anatomy = compact
+            self.telemetry.set_gauge(
+                "anatomy/comm_fraction",
+                float(summary.get("comm_fraction") or 0.0),
+                help="exposed-collective fraction of step wall time")
+            if summary.get("overlap_hiding_frac") is not None:
+                self.telemetry.set_gauge(
+                    "anatomy/overlap_hiding_frac",
+                    float(summary["overlap_hiding_frac"]),
+                    help="collective time hidden under compute")
+            self.telemetry.set_gauge(
+                "anatomy/attributed_frac",
+                float(summary.get("attributed_frac") or 0.0),
+                help="fenced step time the trace explains")
+        return summary
 
     # ------------------------------------------------------------------
     # DeepSpeed compat surface: forward / backward / step

@@ -725,6 +725,21 @@ def build_cluster_manifest(archive: str,
             top = top_pools_of(mem)
             if top:
                 mem_compact["top_pools"] = top
+        anat = (m.get("context") or {}).get("anatomy") or {}
+        anat_compact = None
+        if anat:
+            # per-host step anatomy (ISSUE 17): the last capture's
+            # comm/overlap fractions + the cost ledger's dominant
+            # roofline verdict — enough to spot the comm-bound host
+            # without opening its bundle
+            cap = anat.get("last_capture") or {}
+            anat_compact = {k: cap.get(k) for k in (
+                "comm_fraction", "overlap_hiding_frac",
+                "attributed_frac") if cap.get(k) is not None}
+            top_v = (anat.get("cost_ledger") or {}).get("roofline_top")
+            if top_v is not None:
+                anat_compact["roofline_top"] = top_v
+            anat_compact = anat_compact or None
         hosts[node] = {
             "reason": m.get("reason"),
             "time_utc": m.get("time_utc"),
@@ -744,6 +759,7 @@ def build_cluster_manifest(archive: str,
             "compile_events": ct.get("events_total"),
             "compile_time_ms": ct.get("time_ms_total"),
             "memory": mem_compact,
+            "anatomy": anat_compact,
         }
         for op, e in (comm.get("summary") or {}).items():
             census.setdefault(op, {})[node] = float(e.get("count", 0))

@@ -1,0 +1,32 @@
+"""Step anatomy — where the device time inside the jitted step goes.
+
+Two complementary sources, joined at capture time:
+
+* the **cost ledger** (:mod:`.ledger`) — compile-time FLOPs / HBM
+  bytes / collective bytes per tracked program, harvested once from the
+  AOT executable's cost model (zero steady-state overhead), each with a
+  roofline verdict against the device peak table;
+* the **trace timeline** (:mod:`.capture` + :mod:`.classify`) — N fenced
+  steps under ONE shared profiler session, every device-lane op
+  classified into compute / exposed-collective / overlapped-collective /
+  host-sync buckets, attributing ≥90% of the fenced step time.
+
+Surfaces: ``StepRecord.extra['anatomy']``, the debug-bundle
+``context.anatomy``, per-host comm/overlap gauges in the cluster rollup
+and manifest, ``python -m deepspeed_tpu.telemetry anatomy`` for humans,
+and sentinel-gated ``comm_fraction`` / ``overlap_hiding_frac`` in bench
+artifacts.
+"""
+
+from .classify import (BUCKETS, HOST_SYNC_PATTERNS, bucket_of,
+                       classify_events, format_anatomy)
+from .ledger import (CostLedger, comm_bytes_from_hlo,
+                     configure_cost_ledger, get_cost_ledger)
+from .capture import capture_step_anatomy, probe_program
+
+__all__ = [
+    "BUCKETS", "HOST_SYNC_PATTERNS", "CostLedger", "bucket_of",
+    "capture_step_anatomy", "classify_events", "comm_bytes_from_hlo",
+    "configure_cost_ledger", "format_anatomy", "get_cost_ledger",
+    "probe_program",
+]

@@ -1,0 +1,183 @@
+"""Step anatomy capture — N fenced steps under ONE profiler session.
+
+``capture_step_anatomy(step_fn, *args)`` runs the (already compiled)
+step a few times inside a single ``jax.profiler.trace`` window via the
+shared-session plumbing (``profiling.collective_trace``), then:
+
+1. classifies every device-lane op into compute / exposed-collective /
+   overlapped-collective / host-sync buckets (:mod:`.classify`),
+2. joins the cost ledger's roofline predictions against the measured
+   per-step time for the top-K programs (predicted vs measured, and the
+   headroom between them),
+3. optionally feeds the execution-order census from the SAME trace
+   (``feed_census=True``) — never a second profiler session, and
+4. writes ``anatomy.json`` (summary + a capped event sample the CLI can
+   re-export as a Perfetto/chrome trace).
+
+Because the shared session is used, an anatomy capture can itself run
+nested inside someone else's trace window — it then classifies nothing
+live (the files don't exist yet) and defers via ``on_session_close``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ...profiling.collective_trace import (active_trace_session,
+                                           feed_exec_census,
+                                           on_session_close,
+                                           parse_device_events,
+                                           shared_trace_session)
+from ...utils.logging import logger
+from .classify import classify_events
+from .ledger import CostLedger, get_cost_ledger
+
+#: events kept in anatomy.json for the CLI's Perfetto export — the full
+#: trace stays in the session dir; this is a browsable sample
+MAX_SAVED_EVENTS = 4000
+
+
+def _roofline_join(ledger: CostLedger, window_us: float, steps: int,
+                   site: Optional[str], top_k: int
+                   ) -> List[Dict[str, Any]]:
+    """Predicted (roofline) vs measured time for the top-K programs.
+
+    Measured per-program time is only separable when the capture ran a
+    single tracked site — then measured = window/steps for that site's
+    entry; other programs report predictions only."""
+    measured_step_us = window_us / max(steps, 1) if window_us > 0 else 0.0
+    rows = []
+    top = ledger.top(top_k)
+    if site is not None and not any(e["site"] == site for e in top):
+        e = ledger.entry_for(site)
+        if e:
+            top = [e] + top[:max(top_k - 1, 0)]
+    for e in top:
+        row = {k: e[k] for k in ("site", "program", "flops", "hbm_bytes",
+                                 "comm_bytes", "arithmetic_intensity",
+                                 "predicted_us", "verdict", "provenance")}
+        if site is None or e["site"] == site:
+            row["measured_us"] = round(measured_step_us, 1)
+            row["headroom"] = ledger.headroom(
+                e["site"], measured_step_us, e["program"])
+        else:
+            row["measured_us"] = None
+            row["headroom"] = None
+        rows.append(row)
+    return rows
+
+
+def capture_step_anatomy(step_fn: Callable[..., Any], *args,
+                         steps: int = 2,
+                         trace_dir: Optional[str] = None,
+                         out_path: Optional[str] = None,
+                         top_k: int = 5,
+                         site: Optional[str] = None,
+                         ledger: Optional[CostLedger] = None,
+                         feed_census: bool = False,
+                         warmup: bool = True,
+                         **kwargs) -> Dict[str, Any]:
+    """Trace ``steps`` fenced executions of ``step_fn`` and return the
+    anatomy summary (classification + roofline join).
+
+    ``site`` names the tracked jit site being captured so its roofline
+    prediction can be compared against the measured step time.  With
+    ``feed_census`` the exec-order census is fed from the same trace —
+    the single shared profiler session serves both consumers.
+    """
+    steps = max(int(steps), 1)
+    ledger = ledger or get_cost_ledger()
+    if warmup:
+        out = step_fn(*args, **kwargs)  # compile outside the window
+        jax.block_until_ready(out)
+    nested = active_trace_session() is not None
+    with shared_trace_session(trace_dir) as tdir:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step_fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        wall_us = (time.perf_counter() - t0) * 1e6
+        if nested:
+            # someone else owns the session — the trace files won't
+            # exist until THEIR close; defer both feeds and return a
+            # placeholder (the owner's close hook finishes the job)
+            if feed_census:
+                on_session_close(lambda d: feed_exec_census(d))
+            on_session_close(
+                lambda d: _finish_capture(d, wall_us, steps, top_k, site,
+                                          ledger, out_path))
+            return {"deferred": True, "trace_dir": tdir,
+                    "wall_us": round(wall_us, 1), "steps": steps}
+    if feed_census:
+        fed = feed_exec_census(tdir)
+        logger.info(f"anatomy capture: exec census fed {fed} entries "
+                    f"from the shared trace")
+    return _finish_capture(tdir, wall_us, steps, top_k, site, ledger,
+                           out_path)
+
+
+def _finish_capture(trace_dir: str, wall_us: float, steps: int,
+                    top_k: int, site: Optional[str],
+                    ledger: CostLedger, out_path: Optional[str]
+                    ) -> Dict[str, Any]:
+    events = parse_device_events(trace_dir)
+    summary = classify_events(events, wall_us=wall_us, steps=steps,
+                              top_k=max(top_k, 5))
+    summary["trace_dir"] = trace_dir
+    summary["site"] = site
+    summary["roofline"] = _roofline_join(ledger, summary["window_us"],
+                                         steps, site, top_k)
+    summary["roofline_top"] = (summary["roofline"][0]["verdict"]
+                               if summary["roofline"] else None)
+    summary["peak"] = ledger.peak.to_dict()
+    if summary["attributed_frac"] < 0.9 and events:
+        logger.warning(
+            f"anatomy capture: trace explains only "
+            f"{summary['attributed_frac'] * 100:.1f}% of the fenced wall "
+            f"time (floor is 90%) — host-side overhead dominates or the "
+            f"backend dropped device lanes")
+    ledger.set_last_capture(
+        {k: v for k, v in summary.items() if k != "events"})
+    path = out_path or os.path.join(trace_dir, "anatomy.json")
+    try:
+        doc = dict(summary)
+        doc["events"] = events[:MAX_SAVED_EVENTS]
+        doc["events_truncated"] = max(len(events) - MAX_SAVED_EVENTS, 0)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        summary["path"] = path
+    except OSError as e:
+        logger.warning(f"anatomy capture: could not write {path} ({e!r})")
+    return summary
+
+
+def probe_program(dry_run: bool = False):
+    """A tiny self-contained program for CLI captures: matmul (+ psum
+    across devices when the mesh has more than one) — enough to light up
+    both the compute and collective lanes."""
+    import jax.numpy as jnp
+
+    n = 128 if dry_run else 1024
+    ndev = jax.local_device_count()
+    if ndev > 1:
+        mesh = jax.sharding.Mesh(jax.devices()[:ndev], ("d",))
+
+        @jax.jit
+        def fn(a, b):
+            out = a @ b
+            return jax.lax.with_sharding_constraint(
+                out, jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec()))
+    else:
+        @jax.jit
+        def fn(a, b):
+            return (a @ b).sum()
+
+    a = jnp.ones((n, n), dtype=jnp.float32)
+    b = jnp.ones((n, n), dtype=jnp.float32)
+    return fn, (a, b)

@@ -1,0 +1,208 @@
+"""Device-lane timeline classifier — where the fenced step time went.
+
+Input: the full device-lane op events of a profiler trace window
+(``profiling.collective_trace.parse_device_events``).  Output: a
+wall-time decomposition of that window into four buckets:
+
+* ``compute``          — some compute op was running (collectives may
+  be running concurrently underneath; that concurrent collective time
+  is *hidden* and lands in ``coll_overlapped`` without adding wall)
+* ``coll_exposed``     — only collectives were running: the step was
+  WAITING on the network (this is the comm-bound share of wall time)
+* ``host_sync``        — infeed/outfeed/callback ops: the device was
+  waiting on the host
+* ``idle``             — no device activity at all inside the window
+  (host-side gaps between dispatches; reported separately but counted
+  toward host-caused time in ``host_sync_us`` totals)
+
+The sweep is exact: ``compute + coll_exposed + host_sync + idle ==
+window`` by construction, so the only attribution loss vs the FENCED
+wall clock is trace coverage — ``attributed_frac`` reports it, and the
+capture's acceptance floor (≥ 90%) is asserted on exactly that number.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ...profiling.collective_trace import COLLECTIVE_PATTERNS
+
+#: op-name substrings that mean "the device is waiting on the host"
+HOST_SYNC_PATTERNS = (
+    "infeed", "outfeed", "host-callback", "host_callback", "callback",
+    "transferto", "transferfrom", "h2d", "d2h",
+)
+
+#: bucket keys in render order
+BUCKETS = ("compute", "coll_exposed", "coll_overlapped", "host_sync",
+           "idle")
+
+
+def bucket_of(name: str,
+              collective_patterns: Sequence[str] = COLLECTIVE_PATTERNS,
+              host_patterns: Sequence[str] = HOST_SYNC_PATTERNS) -> str:
+    """The activity class of one device op: ``collective`` /
+    ``host_sync`` / ``compute`` (everything else XLA ran)."""
+    low = name.lower()
+    if any(p in low for p in collective_patterns):
+        return "collective"
+    if any(p in low for p in host_patterns):
+        return "host_sync"
+    return "compute"
+
+
+def _merge_intervals(iv: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for s, e in iv[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def classify_events(events: List[Dict[str, Any]],
+                    wall_us: Optional[float] = None,
+                    steps: int = 1,
+                    top_k: int = 8) -> Dict[str, Any]:
+    """Sweep the window and decompose wall time into buckets.
+
+    ``events`` are ``{ts_us, dur_us, name, lane}`` device-lane ops (all
+    lanes — overlap is visible precisely because TPU runs collectives on
+    a separate stream/lane from compute).  ``wall_us`` is the host-fenced
+    wall time of the captured steps; when given, ``attributed_frac`` is
+    window/wall (how much of the fenced time the trace explains).
+    """
+    steps = max(int(steps), 1)
+    per_class: Dict[str, List[Tuple[float, float]]] = {
+        "compute": [], "collective": [], "host_sync": []}
+    per_op: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        dur = float(ev.get("dur_us", 0.0))
+        if dur <= 0:
+            continue
+        ts = float(ev.get("ts_us", 0.0))
+        cls = bucket_of(ev.get("name", ""))
+        per_class[cls].append((ts, ts + dur))
+        row = per_op.setdefault(ev.get("name", "?"),
+                                {"total_us": 0.0, "count": 0.0,
+                                 "class": cls})
+        row["total_us"] += dur
+        row["count"] += 1
+    merged = {c: _merge_intervals(iv) for c, iv in per_class.items()}
+    empty = not any(merged.values())
+    if empty:
+        window = 0.0
+        t0 = 0.0
+    else:
+        t0 = min(iv[0][0] for iv in merged.values() if iv)
+        t1 = max(iv[-1][1] for iv in merged.values() if iv)
+        window = t1 - t0
+
+    # elementary-segment sweep over all class boundaries
+    points = sorted({p for iv in merged.values() for s, e in iv
+                     for p in (s, e)})
+    buckets = {k: 0.0 for k in BUCKETS}
+
+    def active(ivs: List[Tuple[float, float]], lo: float, hi: float) -> bool:
+        # ivs are merged+sorted; binary search would be O(log n) but the
+        # segment count is already O(n) — linear scan with early exit
+        for s, e in ivs:
+            if s >= hi:
+                return False
+            if e > lo:
+                return True
+        return False
+
+    for lo, hi in zip(points, points[1:]):
+        if hi <= lo:
+            continue
+        seg = hi - lo
+        comp = active(merged["compute"], lo, hi)
+        coll = active(merged["collective"], lo, hi)
+        hsync = active(merged["host_sync"], lo, hi)
+        if comp:
+            buckets["compute"] += seg
+            if coll:
+                buckets["coll_overlapped"] += seg
+        elif coll:
+            buckets["coll_exposed"] += seg
+        elif hsync:
+            buckets["host_sync"] += seg
+        else:
+            buckets["idle"] += seg
+
+    coll_total = buckets["coll_exposed"] + buckets["coll_overlapped"]
+    wall = float(wall_us) if wall_us else 0.0
+    attributed = min(1.0, window / wall) if wall > 0 else (
+        1.0 if window > 0 else 0.0)
+    top = sorted(per_op.items(), key=lambda kv: -kv[1]["total_us"])
+    out: Dict[str, Any] = {
+        "window_us": round(window, 1),
+        "wall_us": round(wall, 1) if wall else None,
+        "steps": steps,
+        "lanes": len({ev.get("lane") for ev in events}),
+        "events": len(events),
+        "compute_us": round(buckets["compute"], 1),
+        "coll_exposed_us": round(buckets["coll_exposed"], 1),
+        "coll_overlapped_us": round(buckets["coll_overlapped"], 1),
+        "host_sync_us": round(buckets["host_sync"], 1),
+        "idle_us": round(buckets["idle"], 1),
+        "comm_fraction": (round(buckets["coll_exposed"] / window, 4)
+                          if window > 0 else 0.0),
+        "overlap_hiding_frac": (
+            round(buckets["coll_overlapped"] / coll_total, 4)
+            if coll_total > 0 else None),
+        "attributed_frac": round(attributed, 4),
+        "top_ops": [{"name": n, "class": r["class"],
+                     "total_us": round(r["total_us"], 1),
+                     "count": int(r["count"])}
+                    for n, r in top[:max(int(top_k), 0)]],
+    }
+    return out
+
+
+def format_anatomy(summary: Dict[str, Any]) -> str:
+    """Human rendering of one classified window (CLI ``anatomy show``)."""
+    window = float(summary.get("window_us") or 0.0)
+    steps = int(summary.get("steps") or 1)
+    lines = []
+    wall = summary.get("wall_us")
+    lines.append(
+        f"window: {window / 1e3:.3f} ms over {steps} step(s)"
+        + (f"  (fenced wall {float(wall) / 1e3:.3f} ms, "
+           f"{summary.get('attributed_frac', 0) * 100:.1f}% attributed)"
+           if wall else ""))
+    label = {"compute": "compute",
+             "coll_exposed": "collective (exposed)",
+             "coll_overlapped": "collective (overlapped, hidden)",
+             "host_sync": "host sync", "idle": "idle (host gaps)"}
+    for key in BUCKETS:
+        us = float(summary.get(f"{key}_us") or 0.0)
+        if us <= 0:
+            continue
+        # the overlapped bucket is concurrent with compute, so its
+        # percentage is "of collective time", not "of wall"
+        if key == "coll_overlapped":
+            lines.append(f"  {label[key]:<32} {us / 1e3:9.3f} ms")
+            continue
+        pct = 100.0 * us / window if window else 0.0
+        lines.append(f"  {label[key]:<32} {us / 1e3:9.3f} ms  {pct:5.1f}%")
+    cf = summary.get("comm_fraction")
+    oh = summary.get("overlap_hiding_frac")
+    lines.append(f"  comm_fraction (exposed/wall): "
+                 f"{float(cf or 0.0):.3f}")
+    if oh is not None:
+        lines.append(f"  overlap_hiding_frac: {float(oh):.3f}")
+    ops = summary.get("top_ops") or []
+    if ops:
+        lines.append("  top device ops:")
+        for r in ops:
+            lines.append(f"    {r['name']:<40} [{r['class']}] "
+                         f"{float(r['total_us']) / 1e3:8.3f} ms "
+                         f"x{int(r['count'])}")
+    return "\n".join(lines)

@@ -1,0 +1,199 @@
+"""``python -m deepspeed_tpu.telemetry anatomy {show,capture,diff}``.
+
+* ``show``    — render a saved ``anatomy.json``: bucket decomposition,
+  comm fraction, overlap hiding, roofline predicted-vs-measured for the
+  top-K programs.  ``--export-perfetto`` re-emits the capture's device
+  events as a chrome-trace JSON loadable in Perfetto/``chrome://tracing``.
+* ``capture`` — run the built-in probe program under ONE shared profiler
+  session and write ``anatomy.json`` (``--dry-run``: tiny shapes, one
+  step — the CI smoke path).  Works on whatever backend is present; on
+  CPU the roofline is marked against backend-default peaks.
+* ``diff``    — two captures: bucket deltas and the comm-fraction /
+  overlap movement between them (the "did my overlap change land"
+  question).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from .classify import BUCKETS, format_anatomy
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
+def _load_anatomy(path: str) -> Optional[Dict[str, Any]]:
+    """Accept an anatomy.json file, or a dir containing one (possibly
+    nested — capture writes into the trace dir)."""
+    if os.path.isfile(path):
+        with open(path) as f:
+            return json.load(f)
+    if os.path.isdir(path):
+        direct = os.path.join(path, "anatomy.json")
+        if os.path.isfile(direct):
+            with open(direct) as f:
+                return json.load(f)
+        for root, _dirs, files in os.walk(path):
+            if "anatomy.json" in files:
+                with open(os.path.join(root, "anatomy.json")) as f:
+                    return json.load(f)
+    return None
+
+
+def _print_roofline(summary: Dict[str, Any]) -> None:
+    rows = summary.get("roofline") or []
+    if not rows:
+        return
+    peak = summary.get("peak") or {}
+    print(f"  roofline (peak: {peak.get('kind', '?')}, "
+          f"source {peak.get('source', '?')}):")
+    print(f"    {'SITE':<28} {'VERDICT':<14} {'AI':>8} "
+          f"{'PRED_US':>10} {'MEAS_US':>10} {'HEADROOM':>9} PROV")
+    for r in rows:
+        meas = r.get("measured_us")
+        head = r.get("headroom")
+        print(f"    {r['site']:<28} {r['verdict']:<14} "
+              f"{r['arithmetic_intensity']:>8.2f} "
+              f"{r['predicted_us']:>10.1f} "
+              f"{(f'{meas:.1f}' if meas is not None else '-'):>10} "
+              f"{(f'{head:.3f}' if head is not None else '-'):>9} "
+              f"{r['provenance']}")
+
+
+def _export_perfetto(summary: Dict[str, Any], out: str) -> int:
+    events = summary.get("events") or []
+    if not events:
+        return _fail("this anatomy.json carries no event sample "
+                     "(older capture?) — nothing to export")
+    lanes = sorted({e.get("lane", "?") for e in events})
+    pid_of = {lane: i + 1 for i, lane in enumerate(lanes)}
+    te = [{"ph": "M", "name": "process_name", "pid": pid_of[lane],
+           "args": {"name": lane}} for lane in lanes]
+    for e in events:
+        te.append({"ph": "X", "pid": pid_of.get(e.get("lane", "?"), 0),
+                   "tid": 0, "ts": e["ts_us"], "dur": e["dur_us"],
+                   "name": e["name"]})
+    doc = {"traceEvents": te,
+           "displayTimeUnit": "ms",
+           "metadata": {"source": "deepspeed_tpu anatomy capture"}}
+    opener = gzip.open if out.endswith(".gz") else open
+    with opener(out, "wt") as f:
+        json.dump(doc, f)
+    trunc = summary.get("events_truncated") or 0
+    print(f"perfetto trace written: {out} ({len(events)} events"
+          + (f", {trunc} truncated from the capture" if trunc else "")
+          + ")")
+    return 0
+
+
+def cmd_anatomy(args: argparse.Namespace) -> int:
+    if args.anatomy_cmd == "show":
+        summary = _load_anatomy(args.path)
+        if summary is None:
+            return _fail(f"{args.path}: no anatomy.json found "
+                         f"(run `anatomy capture` or "
+                         f"engine.capture_anatomy first)")
+        print(f"anatomy: {args.path}")
+        print(format_anatomy(summary))
+        _print_roofline(summary)
+        if getattr(args, "export_perfetto", None):
+            return _export_perfetto(summary, args.export_perfetto)
+        return 0
+
+    if args.anatomy_cmd == "capture":
+        # import here: capture needs jax; show/diff must work anywhere
+        from .capture import capture_step_anatomy, probe_program
+        from .ledger import get_cost_ledger
+
+        fn, fargs = probe_program(dry_run=args.dry_run)
+        try:  # the probe is a plain jit, not a tracked site — harvest
+            # its AOT executable by hand so the roofline join has costs
+            get_cost_ledger().harvest("anatomy/probe", 0,
+                                      fn.lower(*fargs).compile())
+        except Exception as exc:
+            from ...utils.logging import debug_once
+
+            debug_once("anatomy/probe_harvest",
+                       f"probe AOT harvest failed (capture proceeds "
+                       f"without a roofline join): {exc!r}")
+        steps = 1 if args.dry_run else args.steps
+        summary = capture_step_anatomy(
+            fn, *fargs, steps=steps, trace_dir=args.out or None,
+            site="anatomy/probe", feed_census=args.census)
+        print(format_anatomy(summary))
+        _print_roofline(summary)
+        if summary.get("path"):
+            print(f"written: {summary['path']}")
+        return 0
+
+    # diff
+    a, b = _load_anatomy(args.a), _load_anatomy(args.b)
+    if a is None or b is None:
+        return _fail("diff needs two anatomy.json files/dirs")
+    print(f"A: {args.a}\nB: {args.b}")
+    wa = float(a.get("window_us") or 0.0)
+    wb = float(b.get("window_us") or 0.0)
+    print(f"window_us: {wa:.1f} -> {wb:.1f} ({wb - wa:+.1f})")
+    for key in BUCKETS:
+        va = float(a.get(f"{key}_us") or 0.0)
+        vb = float(b.get(f"{key}_us") or 0.0)
+        if va or vb:
+            print(f"  {key}_us: {va:.1f} -> {vb:.1f} ({vb - va:+.1f})")
+    for key in ("comm_fraction", "overlap_hiding_frac",
+                "attributed_frac"):
+        va, vb = a.get(key), b.get(key)
+        if va is None and vb is None:
+            continue
+        sa = f"{va:.3f}" if va is not None else "-"
+        sb = f"{vb:.3f}" if vb is not None else "-"
+        print(f"  {key}: {sa} -> {sb}")
+    ra = {r["site"]: r for r in a.get("roofline") or []}
+    rb = {r["site"]: r for r in b.get("roofline") or []}
+    for site in sorted(set(ra) | set(rb)):
+        va, vb = ra.get(site), rb.get(site)
+        print(f"  roofline {site}: "
+              f"{va['verdict'] if va else '-'} -> "
+              f"{vb['verdict'] if vb else '-'}")
+    return 0
+
+
+def add_anatomy_parser(sub: Any) -> None:
+    a = sub.add_parser("anatomy",
+                       help="step anatomy: roofline + comm/compute "
+                            "attribution inside the jitted step")
+    asub = a.add_subparsers(dest="anatomy_cmd", required=True)
+
+    sh = asub.add_parser("show", help="render a saved anatomy capture")
+    sh.add_argument("path", help="anatomy.json, or a dir containing one")
+    sh.add_argument("--export-perfetto", default="", metavar="OUT",
+                    help="also write the capture's device events as a "
+                         "chrome-trace JSON (.json or .json.gz) for "
+                         "Perfetto")
+    sh.set_defaults(fn=cmd_anatomy)
+
+    cp = asub.add_parser("capture",
+                         help="capture the built-in probe program on "
+                              "the current backend and write "
+                              "anatomy.json")
+    cp.add_argument("--steps", type=int, default=3)
+    cp.add_argument("--out", default="",
+                    help="trace/output dir (default: temp dir)")
+    cp.add_argument("--dry-run", action="store_true",
+                    help="tiny shapes, one step — the CI smoke path")
+    cp.add_argument("--census", action="store_true",
+                    help="also feed the exec-order census from the "
+                         "same (single) profiler session")
+    cp.set_defaults(fn=cmd_anatomy)
+
+    df = asub.add_parser("diff", help="compare two anatomy captures")
+    df.add_argument("a")
+    df.add_argument("b")
+    df.set_defaults(fn=cmd_anatomy)

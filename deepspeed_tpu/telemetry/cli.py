@@ -682,6 +682,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .memory.cli import add_mem_parser
 
     add_mem_parser(sub)
+
+    from .anatomy.cli import add_anatomy_parser
+
+    add_anatomy_parser(sub)
     return p
 
 

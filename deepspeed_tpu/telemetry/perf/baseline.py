@@ -60,6 +60,12 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     "block_sparse_speedup_s4096": ("higher", 0.10),
     "fused_adam_hbm_gbps": ("higher", 0.15),
     "overlap_hiding_frac": ("higher", 0.15),
+    # anatomy plane (ISSUE 17): the trace-measured exposed-collective
+    # share of step wall time.  LOWER is better — a rise means formerly
+    # hidden (or absent) collective time is now serializing the step.
+    # Gated one-sided like every metric: absent from an older baseline
+    # → SKIPPED, never a fail.
+    "comm_fraction": ("lower", 0.25),
     # network serving plane (ISSUE 14): the same SLO gate measured
     # through the REAL stack — HTTP/SSE front door + replica worker
     # processes.  Socket + process scheduling jitter is wider than the
@@ -90,6 +96,9 @@ ABS_FLOORS: Dict[str, float] = {
     # a fleet comfortably inside its SLO burns < 0.25 of budget-rate;
     # movement below that is noise, not a regression
     "serving_slo_burn_rate_p99": 0.25,
+    # a step whose exposed-collective share is under 5% is effectively
+    # compute-bound; scheduler jitter down there is not a regression
+    "comm_fraction": 0.05,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

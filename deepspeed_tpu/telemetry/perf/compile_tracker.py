@@ -161,6 +161,11 @@ class CompileTracker:
         self.time_ms_total = 0.0
         #: fns called with each CompileEvent (engine per-step attribution)
         self._listeners: List[Callable[[CompileEvent], Any]] = []
+        #: fns called with (site, program, compiled_executable) right
+        #: after a successful AOT compile — the anatomy plane's cost
+        #: ledger harvests ``compiled.cost_analysis()`` here, at compile
+        #: time, so the steady state pays nothing
+        self._cost_harvesters: List[Callable[[str, int, Any], Any]] = []
 
     def configure(self, enabled: Optional[bool] = None,
                   max_events: Optional[int] = None) -> "CompileTracker":
@@ -179,9 +184,25 @@ class CompileTracker:
             self.recompiles_total = 0
             self.time_ms_total = 0.0
             self._listeners = []
+            self._cost_harvesters = []
 
     def add_listener(self, fn: Callable[[CompileEvent], Any]) -> None:
         self._listeners.append(fn)
+
+    def add_cost_harvester(self, fn: Callable[[str, int, Any], Any]
+                           ) -> None:
+        """Register ``fn(site, program, compiled)`` to run after each
+        successful AOT compile (fallback-path programs have no
+        executable and are not harvested)."""
+        self._cost_harvesters.append(fn)
+
+    def harvest_cost(self, site: str, program: int, compiled: Any) -> None:
+        for fn in list(self._cost_harvesters):
+            try:
+                fn(site, program, compiled)
+            except Exception as e:  # harvest is best-effort telemetry
+                logger.warning(f"compile tracker cost harvest failed at "
+                               f"{site} ({e!r})")
 
     # -- recording ---------------------------------------------------------
 
@@ -353,6 +374,11 @@ class TrackedJit:
             fallback = True
         ev = self.tracker.record(self.site, sig, lower_ms, compile_ms,
                                  fallback=fallback)
+        if compiled is not None:
+            # compile-time cost harvest (anatomy plane): the AOT handle
+            # is in hand exactly once, here — cost_analysis() now costs
+            # the steady state nothing
+            self.tracker.harvest_cost(self.site, ev.program, compiled)
         with self._lock:
             self._programs[key] = (ev.program, compiled)
         self.tracker.note_call(self.site, ev.program)

@@ -375,6 +375,10 @@ class MetricsRollup:
                 "loss": last.get("loss"),
                 "goodput": self._gauge_value(snap, "goodput/fraction"),
                 "hbm_frac": self._gauge_value(snap, "memory/hbm_frac"),
+                "comm_fraction": self._gauge_value(
+                    snap, "anatomy/comm_fraction"),
+                "overlap_hiding_frac": self._gauge_value(
+                    snap, "anatomy/overlap_hiding_frac"),
                 "steps_streamed": st.get("count", 0),
                 "store_outages": self._counter_value(
                     snap, "elasticity/store_outages_total"),
@@ -733,8 +737,8 @@ def render_top(rollup: MetricsRollup,
     """The live cluster view as a fixed-width table."""
     rows = rollup.rows(hb_view)
     header = (f"{'NODE':<14} {'STEP':>8} {'STEP_MS':>9} {'GOODPUT':>8} "
-              f"{'HBM%':>6} {'LOSS':>10} {'HB_AGE':>7} {'OUTAGES':>8} "
-              f"{'STATE':<10}")
+              f"{'HBM%':>6} {'COMM%':>6} {'LOSS':>10} {'HB_AGE':>7} "
+              f"{'OUTAGES':>8} {'STATE':<10}")
     lines = []
     if store_info:
         lines.append(
@@ -753,11 +757,13 @@ def render_top(rollup: MetricsRollup,
         else:
             state = "LIVE"
         hbm = r.get("hbm_frac")
+        comm = r.get("comm_fraction")
         lines.append(
             f"{r['node']:<14} {_fmt(r.get('step'), '{:.0f}'):>8} "
             f"{_fmt(r.get('step_time_ewma_ms'), '{:.1f}'):>9} "
             f"{_fmt(r.get('goodput'), '{:.3f}'):>8} "
             f"{_fmt(None if hbm is None else hbm * 100.0, '{:.1f}'):>6} "
+            f"{_fmt(None if comm is None else comm * 100.0, '{:.1f}'):>6} "
             f"{_fmt(r.get('loss'), '{:.5g}'):>10} "
             f"{_fmt(age, '{:.1f}'):>7} "
             f"{_fmt(r.get('store_outages'), '{:.0f}'):>8} "

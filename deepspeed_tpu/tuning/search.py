@@ -43,6 +43,19 @@ def ranked_score(result: TrialResult, metric: str) -> Optional[float]:
     return -s if metric in LOWER_IS_BETTER else s
 
 
+def roofline_tiebreak(result: TrialResult) -> float:
+    """Secondary ranking key (anatomy plane): LOWER roofline headroom
+    wins a score tie — a candidate running near its roofline is fast
+    because of the hardware limit, not because an unexplained stall
+    happened to go quiet during its short trial.  Trials without the
+    metric rank last among ties."""
+    v = (result.metrics or {}).get("roofline_headroom")
+    try:
+        return float(v) if v is not None else float("inf")
+    except (TypeError, ValueError):
+        return float("inf")
+
+
 @dataclass
 class SearchResult:
     best: Optional[TrialResult]
@@ -143,7 +156,7 @@ class SuccessiveHalvingStrategy:
             if len(scored) <= 1:
                 break
             keep = max(1, math.ceil(len(scored) / self.eta))
-            scored.sort(key=lambda t: -t[0])
+            scored.sort(key=lambda t: (-t[0], roofline_tiebreak(t[1])))
             alive = [r.candidate for _, r in scored[:keep]]
             steps *= self.eta
             if keep == 1:
@@ -246,7 +259,12 @@ class SearchEngine:
         best_oriented = -float("inf")
         for r in final.values():
             oriented = ranked_score(r, self.metric)
-            if oriented is not None and oriented > best_oriented:
+            if oriented is None:
+                continue
+            if (oriented > best_oriented
+                    or (best is not None and oriented == best_oriented
+                        and roofline_tiebreak(r)
+                        < roofline_tiebreak(best))):
                 best, best_oriented = r, oriented
         result.best = best
         result.wall_s = time.perf_counter() - t0

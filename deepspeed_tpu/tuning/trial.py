@@ -235,7 +235,8 @@ class EngineTrialRunner(TrialRunner):
                 metrics = {k: float(v) for k, v in summary.items()
                            if k in ("tokens_per_sec", "samples_per_sec",
                                     "mfu", "step_time_p50_ms",
-                                    "peak_hbm_bytes", "hbm_headroom_frac")
+                                    "peak_hbm_bytes", "hbm_headroom_frac",
+                                    "roofline_headroom")
                            and v is not None}
                 source = str(summary.get("source", "telemetry"))
             else:  # legacy/fake engines: fenced wall-clock loop

@@ -35,6 +35,7 @@ SHARDS=(
   "tests/unit/tuning"
   "tests/unit/perf"
   "tests/unit/profiling"
+  "tests/unit/anatomy"
   "tests/unit/test_comm.py tests/unit/test_elastic_rendezvous.py tests/unit/test_mesh.py tests/unit/test_overlap.py"
   "tests/unit/multiprocess --ignore=tests/unit/multiprocess/test_chaos_control_plane.py --ignore=tests/unit/multiprocess/test_serving_network.py --ignore=tests/unit/multiprocess/test_autoscale.py"
   "tests/unit/multiprocess/test_chaos_control_plane.py -m chaos"
@@ -348,6 +349,24 @@ else
   echo "=== serving replay smoke FAILED"
   fail=1
 fi
+
+# Step-anatomy CLI smoke (ISSUE 17): a dry-run capture (tiny probe,
+# one fenced step, real profiler session) must classify its own trace
+# and `anatomy show` must render the bucket table + roofline join.
+echo "=== anatomy CLI smoke: capture --dry-run / show"
+smoke_dir=$(mktemp -d)
+anatomy_ok=1
+JAX_PLATFORMS=cpu python -m deepspeed_tpu.telemetry anatomy capture \
+    --dry-run --out "$smoke_dir/anat" >/dev/null || anatomy_ok=0
+python -m deepspeed_tpu.telemetry anatomy show "$smoke_dir/anat" \
+    | grep -q "comm_fraction" || anatomy_ok=0
+if [ $anatomy_ok -eq 1 ]; then
+  echo "=== anatomy CLI smoke passed"
+else
+  echo "=== anatomy CLI smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
 
 # Perf-sentinel smoke (ISSUE 5): baseline-then-check on the same run
 # must exit 0; a forced-regression fixture must exit 3.

@@ -1,0 +1,140 @@
+"""The anatomy classifier over synthetic chrome-trace-shaped windows
+(ISSUE 17): bucket totals, overlap fraction, and the ≥90% attribution
+floor, asserted on hand-built timelines whose answers are arithmetic."""
+
+from deepspeed_tpu.telemetry.anatomy import (BUCKETS, bucket_of,
+                                             classify_events,
+                                             format_anatomy)
+
+LANE_MAIN = "/device:TPU:0"
+LANE_COMM = "/device:TPU:0 stream:comm"
+
+
+def _ev(name, ts, dur, lane=LANE_MAIN):
+    return {"ts_us": float(ts), "dur_us": float(dur), "name": name,
+            "lane": lane}
+
+
+def test_bucket_of_classes():
+    assert bucket_of("all-reduce.3") == "collective"
+    assert bucket_of("psum.1") == "collective"
+    assert bucket_of("infeed-dequeue.2") == "host_sync"
+    assert bucket_of("fusion.19") == "compute"
+    assert bucket_of("dot.4") == "compute"
+
+
+def test_overlapped_ring_hides_collective_time():
+    # compute runs 0-100 on the main lane; the ring's all-gather runs
+    # 20-80 on the comm stream, entirely under compute -> fully hidden
+    events = [
+        _ev("fusion.1", 0, 100),
+        _ev("all-gather.5", 20, 60, lane=LANE_COMM),
+    ]
+    s = classify_events(events, wall_us=105.0)
+    assert s["window_us"] == 100.0
+    assert s["compute_us"] == 100.0
+    assert s["coll_exposed_us"] == 0.0
+    assert s["coll_overlapped_us"] == 60.0
+    assert s["comm_fraction"] == 0.0
+    assert s["overlap_hiding_frac"] == 1.0
+    assert s["attributed_frac"] >= 0.9
+
+
+def test_serialized_ring_exposes_collective_time():
+    # compute 0-60, THEN the collective 60-100: nothing is hidden —
+    # the step waited 40us on the network
+    events = [
+        _ev("fusion.1", 0, 60),
+        _ev("all-gather.5", 60, 40),
+    ]
+    s = classify_events(events, wall_us=100.0)
+    assert s["window_us"] == 100.0
+    assert s["compute_us"] == 60.0
+    assert s["coll_exposed_us"] == 40.0
+    assert s["coll_overlapped_us"] == 0.0
+    assert s["comm_fraction"] == 0.4
+    assert s["overlap_hiding_frac"] == 0.0
+    assert s["attributed_frac"] == 1.0
+
+
+def test_partial_overlap_splits_exposed_and_hidden():
+    # compute 0-100, collective 50-150: 50us hidden + 50us exposed
+    events = [
+        _ev("fusion.1", 0, 100),
+        _ev("all-reduce.2", 50, 100, lane=LANE_COMM),
+    ]
+    s = classify_events(events, wall_us=150.0)
+    assert s["coll_overlapped_us"] == 50.0
+    assert s["coll_exposed_us"] == 50.0
+    assert s["overlap_hiding_frac"] == 0.5
+    assert s["comm_fraction"] == round(50.0 / 150.0, 4)
+
+
+def test_host_sync_stall_and_idle_gap():
+    # compute 0-40, infeed wait 50-70, compute 80-100: the 40-50 and
+    # 70-80 gaps are idle (host dispatch), the infeed is a host-sync
+    events = [
+        _ev("fusion.1", 0, 40),
+        _ev("infeed-dequeue.1", 50, 20),
+        _ev("fusion.2", 80, 20),
+    ]
+    s = classify_events(events, wall_us=100.0)
+    assert s["compute_us"] == 60.0
+    assert s["host_sync_us"] == 20.0
+    assert s["idle_us"] == 20.0
+    assert s["coll_exposed_us"] == 0.0
+    assert s["attributed_frac"] == 1.0
+
+
+def test_buckets_sum_to_window_exactly():
+    events = [
+        _ev("fusion.1", 0, 37),
+        _ev("all-reduce.9", 20, 55, lane=LANE_COMM),
+        _ev("infeed.4", 80, 11),
+        _ev("dot.2", 95, 30),
+    ]
+    s = classify_events(events)
+    # overlapped is concurrent with compute — excluded from the sum
+    total = (s["compute_us"] + s["coll_exposed_us"]
+             + s["host_sync_us"] + s["idle_us"])
+    assert abs(total - s["window_us"]) < 1e-6
+
+
+def test_attribution_floor_detects_untraced_wall_time():
+    # the trace window covers 50us of a 200us fenced wall: 25%
+    events = [_ev("fusion.1", 0, 50)]
+    s = classify_events(events, wall_us=200.0)
+    assert s["attributed_frac"] == 0.25
+    assert s["attributed_frac"] < 0.9
+
+
+def test_empty_window_and_no_wall():
+    s = classify_events([])
+    assert s["window_us"] == 0.0
+    assert s["comm_fraction"] == 0.0
+    assert s["overlap_hiding_frac"] is None
+    assert s["attributed_frac"] == 0.0
+
+
+def test_top_ops_aggregated_and_capped():
+    events = [_ev(f"op.{i % 3}", i * 10, 5) for i in range(12)]
+    s = classify_events(events, top_k=2)
+    assert len(s["top_ops"]) == 2
+    assert s["top_ops"][0]["count"] == 4
+    assert s["top_ops"][0]["total_us"] == 20.0
+
+
+def test_format_anatomy_renders_every_bucket():
+    events = [
+        _ev("fusion.1", 0, 100),
+        _ev("all-reduce.2", 50, 100, lane=LANE_COMM),
+        _ev("infeed.3", 160, 20),
+    ]
+    text = format_anatomy(classify_events(events, wall_us=185.0))
+    assert "collective (exposed)" in text
+    assert "collective (overlapped, hidden)" in text
+    assert "host sync" in text
+    assert "comm_fraction" in text
+    assert "top device ops" in text
+    # render order is the canonical bucket order
+    assert list(BUCKETS)[0] == "compute"

@@ -1,0 +1,162 @@
+"""``telemetry anatomy`` CLI (show/diff/export) + the perf sentinel's
+handling of the new anatomy metrics: one-sided SKIPPED against older
+baselines, exit 3 on a forced comm_fraction regression."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry.cli import main as telemetry_main
+
+
+def _anatomy_doc(comm_fraction=0.25, overlap=0.5):
+    return {
+        "window_us": 1000.0, "wall_us": 1020.0, "steps": 2, "lanes": 2,
+        "events": 3,
+        "compute_us": 700.0, "coll_exposed_us": 250.0,
+        "coll_overlapped_us": 250.0, "host_sync_us": 30.0,
+        "idle_us": 20.0,
+        "comm_fraction": comm_fraction,
+        "overlap_hiding_frac": overlap,
+        "attributed_frac": 0.98,
+        "top_ops": [{"name": "all-gather.1", "class": "collective",
+                     "total_us": 500.0, "count": 4}],
+        "roofline": [{"site": "engine/train_step_fused", "program": 0,
+                      "flops": 1e12, "hbm_bytes": 1e9, "comm_bytes": 1e8,
+                      "arithmetic_intensity": 1000.0,
+                      "predicted_us": 400.0, "verdict": "compute-bound",
+                      "provenance": "measured", "measured_us": 500.0,
+                      "headroom": 0.2}],
+        "roofline_top": "compute-bound",
+        "peak": {"kind": "v4", "source": "spec"},
+        "events_truncated": 0,
+    }
+
+
+def _write(tmp_path, doc, name="anatomy.json"):
+    p = os.path.join(str(tmp_path), name)
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+def test_anatomy_show_renders_buckets_and_roofline(tmp_path, capsys):
+    doc = _anatomy_doc()
+    doc["events"] = [{"ts_us": 0.0, "dur_us": 10.0, "name": "dot.1",
+                      "lane": "/device:TPU:0"}]
+    p = _write(tmp_path, doc)
+    assert telemetry_main(["anatomy", "show", p]) == 0
+    out = capsys.readouterr().out
+    assert "collective (exposed)" in out
+    assert "comm_fraction" in out
+    assert "roofline" in out
+    assert "compute-bound" in out
+    assert "engine/train_step_fused" in out
+    assert "measured" in out
+
+
+def test_anatomy_show_accepts_directory(tmp_path, capsys):
+    _write(tmp_path, _anatomy_doc())
+    assert telemetry_main(["anatomy", "show", str(tmp_path)]) == 0
+    assert "comm_fraction" in capsys.readouterr().out
+
+
+def test_anatomy_show_missing_is_error(tmp_path, capsys):
+    assert telemetry_main(["anatomy", "show", str(tmp_path)]) == 2
+    assert "no anatomy.json" in capsys.readouterr().err
+
+
+def test_anatomy_show_perfetto_export(tmp_path, capsys):
+    doc = _anatomy_doc()
+    doc["events"] = [
+        {"ts_us": 0.0, "dur_us": 10.0, "name": "dot.1",
+         "lane": "/device:TPU:0"},
+        {"ts_us": 5.0, "dur_us": 8.0, "name": "all-gather.2",
+         "lane": "/device:TPU:0 stream:comm"},
+    ]
+    p = _write(tmp_path, doc)
+    out = os.path.join(str(tmp_path), "trace.json.gz")
+    assert telemetry_main(["anatomy", "show", p,
+                           "--export-perfetto", out]) == 0
+    with gzip.open(out, "rt") as f:
+        tr = json.load(f)
+    evs = tr["traceEvents"]
+    lanes = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert lanes == {"/device:TPU:0", "/device:TPU:0 stream:comm"}
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert len(xs) == 2
+    # lanes map to distinct pids so Perfetto draws separate tracks
+    assert len({e["pid"] for e in xs}) == 2
+
+
+def test_anatomy_diff_reports_fraction_movement(tmp_path, capsys):
+    pa = _write(tmp_path, _anatomy_doc(comm_fraction=0.10), "a.json")
+    pb = _write(tmp_path, _anatomy_doc(comm_fraction=0.30), "b.json")
+    assert telemetry_main(["anatomy", "diff", pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "comm_fraction: 0.100 -> 0.300" in out
+    assert "roofline engine/train_step_fused" in out
+
+
+@pytest.mark.slow
+def test_anatomy_capture_dry_run_cli_roundtrip(tmp_path, capsys):
+    out_dir = str(tmp_path / "cap")
+    assert telemetry_main(["anatomy", "capture", "--dry-run",
+                           "--out", out_dir]) == 0
+    first = capsys.readouterr().out
+    assert "window:" in first
+    assert telemetry_main(["anatomy", "show", out_dir]) == 0
+    shown = capsys.readouterr().out
+    assert "comm_fraction" in shown
+
+
+# ---------------------------------------------------------------------------
+# perf sentinel integration (satellites)
+# ---------------------------------------------------------------------------
+
+def test_perf_check_skips_anatomy_metrics_absent_from_baseline(tmp_path,
+                                                               capsys):
+    # older baseline without comm_fraction/overlap: one-sided -> the
+    # metric is SKIPPED, the check still passes on the shared metrics
+    base = os.path.join(str(tmp_path), "base.json")
+    with open(base, "w") as f:
+        json.dump({"metrics": {"tokens_per_sec": 100.0}}, f)
+    run = os.path.join(str(tmp_path), "run.json")
+    with open(run, "w") as f:
+        json.dump({"tokens_per_sec": 101.0, "comm_fraction": 0.4,
+                   "overlap_hiding_frac": 0.1}, f)
+    rc = telemetry_main(["perf", "check", run, "--baseline", base])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "not comparable" in out
+    assert "comm_fraction" in out
+    assert "overlap_hiding_frac" in out
+
+
+def test_perf_check_forced_comm_fraction_regression_exits_3(tmp_path,
+                                                            capsys):
+    base = os.path.join(str(tmp_path), "base.json")
+    with open(base, "w") as f:
+        json.dump({"metrics": {"tokens_per_sec": 100.0,
+                               "comm_fraction": 0.20}}, f)
+    run = os.path.join(str(tmp_path), "run.json")
+    with open(run, "w") as f:  # +150% exposed-collective share
+        json.dump({"tokens_per_sec": 100.0, "comm_fraction": 0.50}, f)
+    rc = telemetry_main(["perf", "check", run, "--baseline", base])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "comm_fraction" in out
+
+
+def test_perf_check_comm_fraction_abs_floor_is_noise(tmp_path, capsys):
+    # both sides under the 0.05 floor: compute-bound jitter, no gate
+    base = os.path.join(str(tmp_path), "base.json")
+    with open(base, "w") as f:
+        json.dump({"metrics": {"comm_fraction": 0.01}}, f)
+    run = os.path.join(str(tmp_path), "run.json")
+    with open(run, "w") as f:
+        json.dump({"comm_fraction": 0.04}, f)  # 4x, but absolute noise
+    rc = telemetry_main(["perf", "check", run, "--baseline", base])
+    assert rc == 0

@@ -1,0 +1,42 @@
+"""Roofline headroom as the tuning tie-breaker (ISSUE 17 satellite):
+on a score tie the candidate running closer to its roofline wins."""
+
+from deepspeed_tpu.tuning.search import ranked_score, roofline_tiebreak
+from deepspeed_tpu.tuning.trial import TrialResult
+
+
+def _r(cand, tps, headroom=None):
+    m = {"tokens_per_sec": tps}
+    if headroom is not None:
+        m["roofline_headroom"] = headroom
+    return TrialResult(candidate=cand, metrics=m, timed_steps=3)
+
+
+def test_tiebreak_prefers_lower_headroom():
+    near = _r({"mbs": 8}, 100.0, headroom=0.05)
+    stalled = _r({"mbs": 4}, 100.0, headroom=0.60)
+    assert roofline_tiebreak(near) < roofline_tiebreak(stalled)
+    # missing headroom ranks last among ties
+    assert roofline_tiebreak(_r({"mbs": 2}, 100.0)) == float("inf")
+    assert roofline_tiebreak(
+        TrialResult(candidate={}, metrics={
+            "roofline_headroom": "bogus"})) == float("inf")
+
+
+def test_tiebreak_never_overrides_the_score():
+    # headroom only breaks EXACT ties — a faster candidate with huge
+    # headroom still beats a slower one at its roofline
+    fast = _r({"a": 1}, 120.0, headroom=0.9)
+    slow = _r({"a": 2}, 100.0, headroom=0.0)
+    assert ranked_score(fast, "tokens_per_sec") > ranked_score(
+        slow, "tokens_per_sec")
+
+
+def test_sorted_ranking_uses_headroom_as_secondary_key():
+    rs = [_r({"a": 1}, 100.0, headroom=0.5),
+          _r({"a": 2}, 100.0, headroom=0.1),
+          _r({"a": 3}, 110.0, headroom=0.9)]
+    ranked = sorted(
+        rs, key=lambda r: (-ranked_score(r, "tokens_per_sec"),
+                           roofline_tiebreak(r)))
+    assert [r.candidate["a"] for r in ranked] == [3, 2, 1]
