@@ -1751,6 +1751,81 @@ def _main() -> None:
         free_hbm()
         extras.setdefault("variants", {})["anatomy_error"] = str(e)[:200]
 
+    _mark("numerics")
+    # -- variant: numerics probe overhead ---------------------------------
+    # The plane's contract (ISSUE 18) is that the sampled probes-on step
+    # variant costs (nearly) nothing: 8 scalars per probe folded into the
+    # step's own output pytree, no host callbacks.  Measured here as the
+    # fenced step-time delta of a probed value_and_grad vs the identical
+    # un-probed program, and sentinel-gated (lower, 5% abs floor) so a
+    # probe that starts forcing a host sync or breaking a fusion shows
+    # up in the trajectory.
+    try:
+        _budget_check()
+        from deepspeed_tpu.telemetry import numerics as _num
+
+        NH, NB, NL = 512, 256, 4
+        rs = np.random.RandomState(5)
+        np_ = {f"w{i}": jnp.asarray(rs.randn(NH, NH) * 0.05).astype(
+            jnp.bfloat16) for i in range(NL)}
+        nx = jnp.asarray(rs.randn(NB, NH)).astype(jnp.bfloat16)
+
+        def _nloss(p, x):
+            h = x
+            for i in range(NL):
+                h = _num.probe(f"h{i}", jnp.tanh(h @ p[f"w{i}"]))
+            return jnp.sum(jnp.square(h.astype(jnp.float32)))
+
+        def _nstep_base(p, x):
+            return jax.value_and_grad(_nloss)(p, x)
+
+        def _nstep_probed(p, x):
+            def lf(pp):
+                mark = _num.scan_mark()
+                loss = _nloss(pp, x)
+                return loss, (_num.scan_drain(mark) or {})
+
+            return jax.value_and_grad(lf, has_aux=True)(p)
+
+        f_base = jax.jit(_nstep_base)
+        f_prob = jax.jit(_nstep_probed)
+
+        def _ntime(fn, probed, iters=20, reps=3):
+            times = []
+            for _ in range(reps + 1):  # first rep is the warmup/compile
+                if probed:
+                    coll = _num.Collector(probes=True, moe=False,
+                                          tag="bench")
+                    with _num.collecting(coll):
+                        t0 = time.perf_counter()
+                        for _i in range(iters):
+                            out = fn(np_, nx)
+                        jax.block_until_ready(out)
+                        times.append(time.perf_counter() - t0)
+                else:
+                    t0 = time.perf_counter()
+                    for _i in range(iters):
+                        out = fn(np_, nx)
+                    jax.block_until_ready(out)
+                    times.append(time.perf_counter() - t0)
+            return sorted(times[1:])[len(times[1:]) // 2]
+
+        t_off = _ntime(f_base, probed=False)
+        t_on = _ntime(f_prob, probed=True)
+        frac = max(0.0, (t_on - t_off) / max(t_off, 1e-9))
+        extras["numerics_overhead_frac"] = round(frac, 4)
+        extras.setdefault("variants", {})["numerics"] = {
+            "base_s_per_20": round(t_off, 5),
+            "probed_s_per_20": round(t_on, 5),
+            "overhead_frac": round(frac, 4),
+            "probes": NL,
+        }
+        del np_, nx
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})["numerics_error"] = str(e)[:200]
+
     _mark("tunnel")
     # -- tunnel characterization ------------------------------------------
     # On this axon setup the chip sits behind a network tunnel.  Measured

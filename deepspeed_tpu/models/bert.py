@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.mesh import AXIS_SEQ, AXIS_TENSOR, DP_AXES
+from ..telemetry import numerics
 
 P = PartitionSpec
 
@@ -218,10 +219,14 @@ class BertModel:
             s = jnp.where(pad_mask[:, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(dt)
             attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
-        out = jnp.einsum("bshd,hdH->bsH", attn, lp["attn"]["wo"].astype(dt)) \
-            + lp["attn"]["bo"].astype(dt)
-        x = _layer_norm(x + out, lp["attn_ln_w"].astype(dt),
-                        lp["attn_ln_b"].astype(dt), c.layer_norm_eps)
+        out = numerics.probe(
+            "attn_out",
+            jnp.einsum("bshd,hdH->bsH", attn, lp["attn"]["wo"].astype(dt))
+            + lp["attn"]["bo"].astype(dt))
+        x = numerics.probe(
+            "resid_attn",
+            _layer_norm(x + out, lp["attn_ln_w"].astype(dt),
+                        lp["attn_ln_b"].astype(dt), c.layer_norm_eps))
 
         h = jnp.einsum("bsH,HI->bsI", x, lp["mlp"]["w_in"].astype(dt)) \
             + lp["mlp"]["b_in"].astype(dt)
@@ -229,10 +234,14 @@ class BertModel:
 
         h = maybe_quantize_activation(self, jax.nn.gelu(h, approximate=False))
         h = self._constrain(h, DP_AXES, AXIS_SEQ, AXIS_TENSOR)
-        h = jnp.einsum("bsI,IH->bsH", h, lp["mlp"]["w_out"].astype(dt)) \
-            + lp["mlp"]["b_out"].astype(dt)
-        x = _layer_norm(x + h, lp["mlp_ln_w"].astype(dt),
-                        lp["mlp_ln_b"].astype(dt), c.layer_norm_eps)
+        h = numerics.probe(
+            "mlp_out",
+            jnp.einsum("bsI,IH->bsH", h, lp["mlp"]["w_out"].astype(dt))
+            + lp["mlp"]["b_out"].astype(dt))
+        x = numerics.probe(
+            "resid_ffn",
+            _layer_norm(x + h, lp["mlp_ln_w"].astype(dt),
+                        lp["mlp_ln_b"].astype(dt), c.layer_norm_eps))
         return self._constrain(x, DP_AXES, AXIS_SEQ, None)
 
     def forward(self, params: Any, input_ids: jnp.ndarray,
@@ -255,7 +264,8 @@ class BertModel:
              + jnp.take(e["token_type"].astype(dt), token_type_ids, axis=0))
         x = _layer_norm(x, e["ln_w"].astype(dt), e["ln_b"].astype(dt),
                         c.layer_norm_eps)
-        x = self._constrain(x, DP_AXES, AXIS_SEQ, None)
+        x = numerics.probe("embed",
+                           self._constrain(x, DP_AXES, AXIS_SEQ, None))
 
         keep = self.ltd_keep
         ltd_on = (keep is not None and 0 < keep < S
@@ -297,11 +307,17 @@ class BertModel:
                     layer,
                     policy=jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable)
-            (x, _), _ = jax.lax.scan(body, (x, jnp.int32(0)),
-                                     (params["layers"], is_ltd))
+            # numerics probes stay OFF through the LTD trunk: the
+            # per-layer lax.cond routing would trap their stat tracers
+            # inside branch scopes
+            with numerics.suppressed():
+                (x, _), _ = jax.lax.scan(body, (x, jnp.int32(0)),
+                                         (params["layers"], is_ltd))
         else:
             def layer(carry, lp):
-                return self.encoder_layer(lp, carry, attention_mask), None
+                mark = numerics.scan_mark()
+                x = self.encoder_layer(lp, carry, attention_mask)
+                return x, numerics.scan_drain(mark)
 
             body = layer
             if c.remat:
@@ -309,8 +325,9 @@ class BertModel:
                     layer,
                     policy=jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable)
-            x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x,
-                                params["layers"])
+            x, ys = jax.lax.scan(lambda carry, lp: body(carry, lp), x,
+                                 params["layers"])
+            numerics.scan_collect(ys)
 
         m = params["mlm"]
         h = jax.nn.gelu(jnp.einsum("bsH,HG->bsG", x, m["w"].astype(dt))
@@ -319,7 +336,7 @@ class BertModel:
                         c.layer_norm_eps)
         logits = (jnp.einsum("bsH,VH->bsV", h, e["word"].astype(dt))
                   + m["bias"])
-        return logits.astype(jnp.float32)
+        return numerics.probe("mlm_logits", logits.astype(jnp.float32))
 
     __call__ = forward
 

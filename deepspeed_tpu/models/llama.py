@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.mesh import AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, DP_AXES
+from ..telemetry import numerics
 
 P = PartitionSpec
 
@@ -363,11 +364,14 @@ class LlamaModel:
         c = self.config
         out = self._attn_block(lp, x)
         # back to the sequence-sharded home layout
-        x = self._constrain(x + out, DP_AXES, AXIS_SEQ, None)
+        x = numerics.probe(
+            "resid_attn", self._constrain(x + out, DP_AXES, AXIS_SEQ, None))
 
         h = _rms_norm(x, lp["mlp_norm"].astype(c.dtype), c.rms_norm_eps)
         ffn_out, l_aux = self._ffn(h, lp)
-        x = self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None)
+        x = numerics.probe(
+            "resid_ffn",
+            self._constrain(x + ffn_out, DP_AXES, AXIS_SEQ, None))
         return x, l_aux
 
     def _attn_block(self, lp: Any, x: jnp.ndarray) -> jnp.ndarray:
@@ -420,7 +424,13 @@ class LlamaModel:
             # the ring path rotates kv-width blocks and expands per-visit
             kk = jnp.repeat(kk, n_rep, axis=2)
             vv = jnp.repeat(vv, n_rep, axis=2)
-        q = self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+        # probe sites live OUTSIDE the attention branch below: the
+        # ulysses path runs attn_fn under shard_map and the ring path
+        # rotates inside collectives — a probe in there would register a
+        # tracer that cannot escape the manual region
+        q = numerics.probe(
+            "attn_q", self._constrain(q, DP_AXES, AXIS_SEQ, AXIS_TENSOR,
+                                      None))
         kk = self._constrain(kk, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         vv = self._constrain(vv, DP_AXES, AXIS_SEQ, AXIS_TENSOR, None)
         if ring_active:
@@ -436,8 +446,10 @@ class LlamaModel:
             attn = ulysses_attention(attn_fn, q, kk, vv, mesh=self.mesh)
         else:
             attn = attn_fn(q, kk, vv)
-        return jnp.einsum("bshd,hdH->bsH", attn,
-                          lp["attn"]["wo"].astype(c.dtype))
+        attn = numerics.probe("attn_ctx", attn)
+        return numerics.probe(
+            "attn_out", jnp.einsum("bshd,hdH->bsH", attn,
+                                   lp["attn"]["wo"].astype(c.dtype)))
 
     def decoder_layer_manual_tp(self, lp: Any, x: jnp.ndarray
                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -524,12 +536,16 @@ class LlamaModel:
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """[B, S] token ids → (final-norm hidden [B, S, H], aux loss)."""
         c = self.config
-        x = self.embed_fwd(params, input_ids)
+        x = numerics.probe("embed", self.embed_fwd(params, input_ids))
 
         def layer(carry, lp):
             x, aux = carry
+            # numerics bracket: the body's probe stats exit the scan as
+            # its ys (stacked [L, ...] per-layer) — None when the plane
+            # is off, which leaves today's jaxpr untouched
+            mark = numerics.scan_mark()
             x, l_aux = self.decoder_layer(lp, x)
-            return (x, aux + l_aux), None
+            return (x, aux + l_aux), numerics.scan_drain(mark)
 
         body = layer
         if c.remat:
@@ -562,11 +578,15 @@ class LlamaModel:
             x = out_x.reshape(B, S, -1)
             aux = out_aux.mean()
         else:
-            (x, aux), _ = jax.lax.scan(lambda carry, lp: body(carry, lp),
-                                       (x, jnp.float32(0.0)),
-                                       params["layers"])
+            (x, aux), ys = jax.lax.scan(lambda carry, lp: body(carry, lp),
+                                        (x, jnp.float32(0.0)),
+                                        params["layers"])
+            numerics.scan_collect(ys)
 
-        x = _rms_norm(x, params["final_norm"].astype(c.dtype), c.rms_norm_eps)
+        x = numerics.probe(
+            "final_norm",
+            _rms_norm(x, params["final_norm"].astype(c.dtype),
+                      c.rms_norm_eps))
         return x, aux
 
     def _ffn(self, h: jnp.ndarray, lp: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -581,7 +601,7 @@ class LlamaModel:
         act = self._constrain(act, DP_AXES, AXIS_SEQ, AXIS_TENSOR)
         down = jnp.einsum("bsI,IH->bsH", act,
                           lp["mlp"]["w_down"].astype(c.dtype))
-        return down, jnp.float32(0.0)
+        return numerics.probe("mlp_out", down), jnp.float32(0.0)
 
     def _head(self, params: Any) -> jnp.ndarray:
         return (params["embed"].T if self.config.tie_embeddings

@@ -114,7 +114,10 @@ class MixtralModel(LlamaModel):
     def _ffn(self, h: jnp.ndarray, lp: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Routed-FFN via the shared MOELayer (one dispatch implementation
         for the whole framework) with an expert-TP-constrained SwiGLU expert."""
+        from ..telemetry import numerics
+
         moe = lp["moe"]
-        y, l_aux, _ = self._moe_layer(
+        y, l_aux, meta = self._moe_layer(
             moe["wg"], {k: moe[k] for k in ("w_gate", "w_up", "w_down")}, h)
-        return y, l_aux
+        numerics.moe_stats(meta)
+        return numerics.probe("mlp_out", y), l_aux

@@ -84,6 +84,17 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity: int,
 
     exp_counts = jnp.sum(masks[0], axis=0)  # pre-drop assignment counts
 
+    # routing telemetry from the PRE-capacity state: per-expert load share,
+    # gating entropy over the mean softmax (collapse detector — ln(E) is
+    # uniform, → 0 as the router funnels everything to one expert), and the
+    # fraction of assignments that overflowed their expert's capacity
+    load = exp_counts / jnp.maximum(jnp.float32(T), 1.0)
+    entropy = -jnp.sum(me * jnp.log(jnp.maximum(me, 1e-9)))
+    assigned = sum(jnp.sum(m) for m in masks)
+    overflowed = sum(jnp.sum(m * (pos >= C).astype(m.dtype)[:, None])
+                     for m, pos in zip(masks, positions))
+    overflow_frac = overflowed / jnp.maximum(assigned, 1.0)
+
     # capacity-filter masks BEFORE renormalizing (reference top2gating order:
     # a token whose 2nd choice is dropped keeps FULL weight on its 1st)
     if drop_tokens:
@@ -104,7 +115,9 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity: int,
         dispatch = dispatch | (contrib > 0)
 
     meta = {"l_aux": l_aux, "exp_counts": exp_counts,
-            "drop_rate": 1.0 - jnp.sum(combine > 0) / jnp.maximum(k * T, 1)}
+            "drop_rate": 1.0 - jnp.sum(combine > 0) / jnp.maximum(k * T, 1),
+            "load": load, "entropy": entropy,
+            "overflow_frac": overflow_frac}
     return combine, dispatch, l_aux, meta
 
 

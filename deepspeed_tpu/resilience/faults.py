@@ -49,6 +49,9 @@ FAULT_DOCS = {
     "kill": "alias of kill_rank",
     "nan_loss": "poison the step's batch with NaN (drives the rollback "
                 "loop)",
+    "nan_params": "NaN one layer's weights in the live param tree "
+                  "(drives the numerics plane's NaN-origin forensics: "
+                  "the report must name THIS layer); params: layer=",
     "stall": "stall the step path (watchdog food); params: seconds=",
     "corrupt_snapshot": "defeat a snapshot tier's integrity gate; "
                         "params: tier=0|1|2, buffers=all (tier 0), "
@@ -289,6 +292,10 @@ class FaultInjector:
             elif fault.kind == "nan_loss":
                 self._record(fault)
                 batch = _poison_batch(batch)
+            elif fault.kind == "nan_params":
+                self._record(fault)
+                _poison_params(engine,
+                               int(fault.params.get("layer", 0)))
             elif fault.kind == "node_join":
                 self._record(fault)
                 self._fire_node_join(
@@ -490,6 +497,45 @@ def _poison_batch(batch: Any) -> Any:
     logger.warning("fault injection: nan_loss found no floating batch "
                    "leaf to poison — fault had no effect")
     return batch
+
+
+def _poison_params(engine: Any, layer: int) -> None:
+    """NaN layer ``layer``'s slice of every stacked [L, ...] floating
+    leaf under ``params["layers"]`` — the poison enters mid-model, so
+    the numerics forensic capture must localize it to exactly this
+    layer's first probe (the NaN-injection acceptance test's setup)."""
+    import jax
+    import jax.numpy as jnp
+
+    st = getattr(engine, "state", None) if engine is not None else None
+    params = getattr(st, "params", None)
+    layers = params.get("layers") if isinstance(params, dict) else None
+    if layers is None:
+        logger.warning("fault injection: nan_params needs a live engine "
+                       "with stacked params['layers'] — fault had no "
+                       "effect")
+        return
+    poisoned = 0
+
+    def poison(leaf):
+        nonlocal poisoned
+        dt = getattr(leaf, "dtype", None)
+        if (dt is not None and jnp.issubdtype(dt, jnp.inexact)
+                and getattr(leaf, "ndim", 0) >= 1
+                and 0 <= layer < leaf.shape[0]):
+            poisoned += 1
+            return leaf.at[layer].set(jnp.float32(float("nan"))
+                                      .astype(dt))
+        return leaf
+
+    new_layers = jax.tree.map(poison, layers)
+    if not poisoned:
+        logger.warning(f"fault injection: nan_params layer={layer} "
+                       f"matched no stacked leaf — fault had no effect")
+        return
+    engine.state = st._replace(params=dict(params, layers=new_layers))
+    logger.warning(f"fault injection: NaN'd layer {layer} across "
+                   f"{poisoned} stacked param leaves")
 
 
 def corrupt_tier0_snapshot(snapshots: Any,

@@ -129,7 +129,18 @@ class RecoveryPolicy:
         if "nan_loss" in self.rollback_on:
             loss = float(metrics.get("loss", 0.0))
             if not math.isfinite(loss):
-                trigger = ("nan_loss", f"non-finite loss {loss}")
+                detail = f"non-finite loss {loss}"
+                # the numerics plane's forensic capture (run by the
+                # engine before this observe) localized the poison —
+                # the rollback NAMES the first bad layer
+                report = getattr(self.engine, "_last_nonfinite_report",
+                                 None)
+                if report is not None and getattr(report, "first_layer",
+                                                  ""):
+                    detail += (f"; first non-finite tensor: "
+                               f"'{report.report.get('first_nonfinite')}'"
+                               f" (layer {report.first_layer})")
+                trigger = ("nan_loss", detail)
         if trigger is None and health_events:
             for ev in health_events:
                 kind = getattr(ev, "kind", None)
@@ -186,10 +197,19 @@ class RecoveryPolicy:
                       "training steps lost to rollbacks (the skipped "
                       "data window)", v=max(skipped, 0))
         self._charge_goodput_recovery(failed_step, skipped, t_rollback0)
-        self._annotate("resilience_rollback", {
+        ann = {
             "trigger": kind, "detail": detail, "failed_step": failed_step,
             "restored_step": eng.global_steps,
-            "skipped_window": [eng.global_steps + 1, failed_step]})
+            "skipped_window": [eng.global_steps + 1, failed_step]}
+        report = getattr(eng, "_last_nonfinite_report", None)
+        if kind == "nan_loss" and report is not None:
+            # forensic localization rides the annotation (and was already
+            # dumped as numerics.json in the forensics bundle)
+            ann["first_nonfinite"] = report.report.get("first_nonfinite", "")
+            ann["first_layer"] = report.first_layer
+            ann["numerics_bundle"] = report.bundle_path
+            eng._last_nonfinite_report = None  # consumed by this rollback
+        self._annotate("resilience_rollback", ann)
         logger.warning(
             f"resilience: rolled back {kind} at step {failed_step} -> "
             f"step {eng.global_steps}; data window "

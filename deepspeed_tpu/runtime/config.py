@@ -307,6 +307,50 @@ class TelemetryMemoryConfig(DeepSpeedConfigModel):
     leak_frac: float = 0.05
 
 
+class TelemetryNumericsConfig(DeepSpeedConfigModel):
+    """``telemetry.numerics`` — the numerics observability plane
+    (``telemetry/numerics/``): in-graph per-layer tensor-health probes
+    (nonfinite/absmax/underflow/saturation stat vectors riding the
+    step's aux output), grad-path norms and update/param ratios, MoE
+    gate telemetry, NaN origin bisection on ``nan_loss``
+    (``numerics.json`` + ``NonFiniteOriginReport``), and the
+    ``underflow_creep``/``layer_grad_explosion``/``router_collapse``
+    health rules.  Probes are an IDENTITY when disabled — same jaxpr,
+    zero recompiles."""
+
+    enabled: bool = False
+    #: sampled-capture cadence in steps: every Nth step dispatches the
+    #: probed step program (its own jit site — compiled once); <= 0
+    #: means forensic-only (the probed program never runs unless a
+    #: non-finite loss triggers the bisection)
+    every: int = 32
+    #: run the all-probes forward bisection when a fenced loss goes
+    #: non-finite, naming the first bad layer in the health event /
+    #: rollback annotation / numerics.json
+    forensic_on_nan: bool = True
+    #: underflow_creep health rule: worst per-probe bf16-subnormal
+    #: fraction threshold and consecutive sampled captures above it
+    #: before the rule fires (suggesting a loss-scale bump); frac <= 0
+    #: disables
+    underflow_frac: float = 0.05
+    underflow_steps: int = 3
+    #: layer_grad_explosion health rule: a single layer's grad norm
+    #: exceeding ``ratio`` x the median layer grad norm (with the
+    #: median above ``floor``) names that layer; ratio <= 0 disables
+    layer_grad_ratio: float = 20.0
+    layer_grad_floor: float = 1e-8
+    #: router_collapse health rule: mean gating entropy (nats) below
+    #: this floor for ``entropy_steps`` consecutive MoE captures means
+    #: the router is sending everything to one expert; floor <= 0
+    #: disables
+    entropy_floor: float = 0.30
+    entropy_steps: int = 3
+    #: sample MoE gate telemetry (moe/* gauges) even when ``enabled``
+    #: is false — the gate stats are already computed by top_k_gating,
+    #: so publishing them costs one extra scan output, not a probe pass
+    moe_gauges: bool = True
+
+
 class TelemetryPerfConfig(DeepSpeedConfigModel):
     """``telemetry.perf`` — the performance observability plane
     (``telemetry/perf/``): compile/recompile tracking over every engine
@@ -369,6 +413,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     perf: TelemetryPerfConfig = Field(default_factory=TelemetryPerfConfig)
     memory: TelemetryMemoryConfig = Field(
         default_factory=TelemetryMemoryConfig)
+    numerics: TelemetryNumericsConfig = Field(
+        default_factory=TelemetryNumericsConfig)
 
 
 class ServingTracingConfig(DeepSpeedConfigModel):

@@ -45,6 +45,7 @@ from .precision import (DynamicLossScaler, LossScaleState, cast_tree,
                         has_overflow)
 from .zero.sharder import ZeroShardingPolicy
 from ..utils.jax_compat import shard_map as _shard_map
+from ..telemetry import numerics
 
 LossFn = Callable[[Any, Any], jnp.ndarray]  # (params, batch) -> scalar loss
 
@@ -432,6 +433,12 @@ class DeepSpeedEngine:
                 memory_pressure_steps=tcfg.memory.pressure_steps,
                 host_leak_window=tcfg.memory.leak_window,
                 host_leak_frac=tcfg.memory.leak_frac,
+                numerics_underflow_frac=tcfg.numerics.underflow_frac,
+                numerics_underflow_steps=tcfg.numerics.underflow_steps,
+                numerics_layer_grad_ratio=tcfg.numerics.layer_grad_ratio,
+                numerics_layer_grad_floor=tcfg.numerics.layer_grad_floor,
+                numerics_entropy_floor=tcfg.numerics.entropy_floor,
+                numerics_entropy_steps=tcfg.numerics.entropy_steps,
                 registry=(self.telemetry.registry if self.telemetry.enabled
                           else None),
                 recorder=self.flight_recorder)
@@ -487,6 +494,28 @@ class DeepSpeedEngine:
                 enabled=True, top_k=mem_cfg.top_k,
                 recorder=self.flight_recorder)
         self._mem_census_every = int(mem_cfg.live_census_every)
+
+        # --- numerics observability plane (telemetry/numerics — ISSUE 18) --
+        # in-graph tensor-health probes: sampled steps run a SEPARATE
+        # jitted step variant whose trace carries the probe stats in an
+        # aux output pytree (the base step's program is never touched —
+        # probes off means today's exact jaxpr), and a non-finite loss
+        # triggers the probes-on forensic re-run that NAMES the first
+        # bad layer (see _run_nonfinite_forensics)
+        ncfg = tcfg.numerics
+        self._numerics_cfg = ncfg
+        self._last_numerics: Optional[Dict[str, Any]] = None
+        self._last_nonfinite_report = None
+        self._numerics_step_fn = None
+        self._moe_step_fn = None
+        self._forensic_fwd_fn = None
+        self._numerics_context: Optional[Dict[str, Any]] = None
+        if self.flight_recorder is not None and (ncfg.enabled
+                                                 or ncfg.moe_gauges):
+            # every bundle carries the latest capture (the CLI's
+            # `numerics show` fallback when no numerics.json exists)
+            self.flight_recorder.register_context(
+                "numerics", lambda: self._numerics_context)
 
         # --- place state on the mesh, sharded per ZeRO stage -------------
         self.state = self._init_state(params)
@@ -1035,25 +1064,51 @@ class DeepSpeedEngine:
         mesh = self.mesh
 
         def microbatch_scan(compute_params, micro, scale):
-            """gas-scan of value_and_grad, fp32 accumulation."""
+            """gas-scan of value_and_grad, fp32 accumulation.
+
+            Numerics plane: when a collector is active AT TRACE TIME the
+            loss closure brackets the forward with scan_mark/scan_drain
+            and the per-micro probe stats exit value_and_grad via
+            ``has_aux`` and the gas scan via its ``ys`` (folded over the
+            gas axis after the scan closes).  When no collector is
+            active this traces today's exact jaxpr — ``ys`` is None and
+            value_and_grad has no aux."""
+            coll = numerics.active()
 
             def grad_of_micro(mb):
                 def scaled_loss(p):
                     loss = loss_fn(p, mb)
                     return (loss * scale / gas).astype(jnp.float32) if fp16 \
                         else loss / gas
-                return jax.value_and_grad(scaled_loss)(compute_params)
+
+                def scaled_loss_aux(p):
+                    mark = numerics.scan_mark()
+                    loss = loss_fn(p, mb)
+                    aux = numerics.scan_drain(mark)
+                    scaled = (loss * scale / gas).astype(jnp.float32) \
+                        if fp16 else loss / gas
+                    return scaled, (aux or {})
+
+                if coll is None:
+                    return jax.value_and_grad(scaled_loss)(compute_params), \
+                        None
+                (loss, aux), grads = jax.value_and_grad(
+                    scaled_loss_aux, has_aux=True)(compute_params)
+                return (loss, grads), (aux or None)
 
             def body(acc, mb):
                 loss_acc, grads_acc = acc
-                loss, grads = grad_of_micro(mb)
+                (loss, grads), ys = grad_of_micro(mb)
                 grads_acc = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
-                return (loss_acc + loss.astype(jnp.float32), grads_acc), None
+                return (loss_acc + loss.astype(jnp.float32), grads_acc), ys
 
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
-            return jax.lax.scan(body, (jnp.float32(0.0), zero_grads), micro)[0]
+            totals, ys = jax.lax.scan(
+                body, (jnp.float32(0.0), zero_grads), micro)
+            numerics.scan_collect(ys, combine=True)
+            return totals
 
         def compute(state: TrainState, batch):
             if self._ltd_cfg is not None and isinstance(batch, dict):
@@ -1133,8 +1188,12 @@ class DeepSpeedEngine:
                         return dist.all_gather_in_graph(
                             p, i["paxes"], axis=i["pdim"], tiled=True)
                     params_full = jax.tree.map(gather, params_shards, info)
-                    loss_sum, grads = microbatch_scan(params_full,
-                                                      micro_local, scale)
+                    # probe tracers cannot exit a shard_map body — probes
+                    # become identities here (dispatch never samples this
+                    # path; this is the trace-time guarantee)
+                    with numerics.suppressed():
+                        loss_sum, grads = microbatch_scan(params_full,
+                                                          micro_local, scale)
 
                     def reduce(g, i):
                         if i["gdim"] is None:
@@ -1185,8 +1244,9 @@ class DeepSpeedEngine:
                             p, i["paxes"], axis=i["pdim"],
                             chunks=_fit_chunks(p.shape[i["pdim"]]))
                     params_full = jax.tree.map(gather, params_shards, info)
-                    loss_sum, grads = microbatch_scan(params_full,
-                                                      micro_local, scale)
+                    with numerics.suppressed():
+                        loss_sum, grads = microbatch_scan(params_full,
+                                                          micro_local, scale)
 
                     def reduce(g, i):
                         if i["gdim"] is None:
@@ -1218,8 +1278,9 @@ class DeepSpeedEngine:
                 P = PartitionSpec
 
                 def local(params_c, micro_local, residuals):
-                    loss_sum, grads = microbatch_scan(params_c, micro_local,
-                                                      scale)
+                    with numerics.suppressed():
+                        loss_sum, grads = microbatch_scan(params_c,
+                                                          micro_local, scale)
                     if onebit:
                         res = jax.tree.map(lambda r: jnp.squeeze(r, 0),
                                            residuals)
@@ -1351,7 +1412,13 @@ class DeepSpeedEngine:
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
 
-    def _build_train_step(self, onebit: Optional[bool] = None):
+    def _build_train_step(self, onebit: Optional[bool] = None,
+                          numerics_mode: Optional[str] = None):
+        """``numerics_mode`` selects the numerics-plane step variant:
+        ``None`` is the base step (today's exact program), ``"numerics"``
+        / ``"moe"`` are the sampled-capture variants traced at their OWN
+        jit sites — turning the plane on never invalidates the base
+        step's compile cache."""
         if self.fused_adam_enabled:
             return self._build_fused_train_step(onebit)
         fp16 = self.fp16_enabled
@@ -1359,6 +1426,15 @@ class DeepSpeedEngine:
         scaler = self.loss_scaler
         tx = self.optimizer
         core = self._grad_core(onebit)
+        # forensic precondition: the probes-on re-run localizes the NaN
+        # origin by replaying the forward on the params the bad loss came
+        # from — but the state is donated, so the only copy left after
+        # the step is new_params.  Guarding the update on a non-finite
+        # loss keeps that copy equal to the pre-step params (fp16 already
+        # does this via overflow-skip; fp32 would otherwise apply the NaN
+        # grads and poison every layer, making the re-run blame layer 0).
+        guard_nonfinite = (self._numerics_cfg.enabled
+                           and self._numerics_cfg.forensic_on_nan)
 
         def step_fn(state: TrainState, batch):
             grads, mean_loss, overflow, grad_norm, new_comm = core(state,
@@ -1376,6 +1452,12 @@ class DeepSpeedEngine:
                 new_scale = scaler.update(state.loss_scale, overflow)
             else:
                 new_scale = state.loss_scale
+            if guard_nonfinite and not fp16:
+                bad = ~jnp.isfinite(mean_loss)
+                hold = lambda new, old: jax.tree.map(
+                    lambda n, o: jnp.where(bad, o, n), new, old)
+                new_params = hold(new_params, state.params)
+                new_opt_state = hold(new_opt_state, state.opt_state)
 
             new_state = TrainState(
                 params=new_params, opt_state=new_opt_state,
@@ -1390,13 +1472,27 @@ class DeepSpeedEngine:
                 "loss_scale": state.loss_scale.scale,
                 "overflow": overflow,
             }
+            coll = numerics.active()
+            if coll is not None:
+                # grad-path health sliced from THIS step's existing
+                # pytrees (no extra forward): per-module grad norms, the
+                # per-layer [L] norm vector, update/param ratios
+                if coll.want_probes:
+                    for k, v in numerics.grad_stats(
+                            grads, updates, state.params).items():
+                        coll.add(k, v)
+                aux = coll.harvest()
+                if aux:
+                    metrics = dict(metrics, numerics=aux)
             return new_state, metrics
 
         state_shardings = self._state_shardings(self.state)
         batch_sharding = NamedSharding(self.mesh, PartitionSpec(DP_AXES))
         onebit_now = self.onebit_enabled if onebit is None else bool(onebit)
+        site = ("engine/train_step" if numerics_mode is None
+                else f"engine/train_step_{numerics_mode}")
         return self._jit(
-            step_fn, "engine/train_step",
+            step_fn, site,
             # the documented recompile hazards, named so a recompile's
             # cause diff says WHICH boundary was crossed: tail-batch gas,
             # the 1-bit warmup edge, the active LTD keep bucket
@@ -1404,6 +1500,7 @@ class DeepSpeedEngine:
                 "gas": self.gradient_accumulation_steps,
                 "onebit": onebit_now,
                 "ltd_keep": getattr(self.module, "ltd_keep", None),
+                **({"numerics": numerics_mode} if numerics_mode else {}),
             },
             in_shardings=(state_shardings, batch_sharding),
             out_shardings=(state_shardings, None),
@@ -1522,10 +1619,129 @@ class DeepSpeedEngine:
                 self._ltd_fns[key] = self._build_train_step()
             self.state, metrics = self._ltd_fns[key](self.state, batch)
         else:
-            if self._train_step_fn is None:
-                self._train_step_fn = self._build_train_step()
-            self.state, metrics = self._train_step_fn(self.state, batch)
+            fn, coll = self._select_numerics_step()
+            if fn is not None:
+                # sampled numerics capture: the variant's own jit site —
+                # the base step's compile cache is untouched, and the
+                # collector is active for the trace (and harmlessly for
+                # every cached call after it)
+                with numerics.collecting(coll):
+                    self.state, metrics = fn(self.state, batch)
+            else:
+                if self._train_step_fn is None:
+                    self._train_step_fn = self._build_train_step()
+                self.state, metrics = self._train_step_fn(self.state, batch)
         return metrics
+
+    def _select_numerics_step(self):
+        """(step_fn, collector) when the numerics plane samples THIS
+        step, else (None, None).  Full captures need ``numerics.enabled``;
+        with the plane off but ``moe_gauges`` on, a MoE model still gets
+        its routing telemetry (satellite: gate stats are never discarded)
+        through the lighter ``engine/train_step_moe`` variant.  Only the
+        plain dispatch path samples — infinity/offload/1-bit-warmup/LTD
+        keep their own programs probe-free."""
+        ncfg = self._numerics_cfg
+        every = int(ncfg.every)
+        if self.fused_adam_enabled or every <= 0 \
+                or (self.global_steps + 1) % every:
+            return None, None
+        if ncfg.enabled:
+            if self._numerics_step_fn is None:
+                self._numerics_step_fn = self._build_train_step(
+                    numerics_mode="numerics")
+            return self._numerics_step_fn, numerics.Collector(
+                probes=True, moe=True, tag="sample")
+        if ncfg.moe_gauges and getattr(self.module, "_moe_layer",
+                                       None) is not None:
+            if self._moe_step_fn is None:
+                self._moe_step_fn = self._build_train_step(
+                    numerics_mode="moe")
+            return self._moe_step_fn, numerics.Collector(
+                probes=False, moe=True, tag="moe")
+        return None, None
+
+    def _ingest_numerics_capture(self, named: Dict[str, Any]) -> None:
+        """Host-side decode of a sampled capture: ``numerics/*`` and
+        ``moe/*`` gauges, the summary staged for this step's
+        ``StepRecord.extra['numerics']`` (the health rules' input), and
+        the full per-probe table into the debug-bundle context."""
+        try:
+            decoded = numerics.decode(named)
+        except Exception as e:  # telemetry must never kill the step
+            logger.error(f"numerics: capture decode failed: {e!r}")
+            return
+        summary = numerics.summarize(decoded)
+        first = numerics.first_nonfinite(decoded["probes"],
+                                         decoded["order"])
+        self._numerics_context = {
+            "step": self.global_steps, "first_nonfinite": first,
+            "summary": summary,
+            **{k: decoded[k] for k in ("probes", "order", "grads",
+                                       "update_ratio", "moe")}}
+        extra = dict(summary)
+        if first:
+            extra["first_nonfinite"] = first
+        self._last_numerics = extra
+        for key in ("underflow_frac", "saturated_frac", "zero_frac",
+                    "absmax", "nonfinite_total", "layer_grad_max"):
+            if key in summary:
+                self.telemetry.set_gauge(
+                    f"numerics/{key}", float(summary[key]),  # dslint: disable=host-sync-hot-path — decode() already pulled the capture; these are host floats
+                    help="worst-case probe stat of the last sampled "
+                         "numerics capture")
+        for src, name in (("gate_entropy", "moe/gate_entropy"),
+                          ("moe_drop_rate", "moe/drop_rate"),
+                          ("moe_overflow_frac", "moe/overflow_frac"),
+                          ("moe_load_imbalance", "moe/load_imbalance")):
+            if src in summary:
+                self.telemetry.set_gauge(
+                    name, float(summary[src]),  # dslint: disable=host-sync-hot-path — same: post-decode host floats
+                    help="MoE gate telemetry from the last sampled step")
+
+    def _numerics_forensic_capture(self, batch):
+        """Probes-on loss forward on the failed ``(params, batch)`` —
+        its own jit site, compiled only on the first failure ever."""
+        if self._forensic_fwd_fn is None:
+            loss_fn = self.loss_fn
+            dtype = self.compute_dtype
+
+            def fwd(params, b):
+                p = (cast_tree(params, dtype)
+                     if dtype != jnp.float32 else params)
+                mark = numerics.scan_mark()
+                loss = loss_fn(p, b)
+                aux = numerics.scan_drain(mark)
+                return loss, (aux or {})
+
+            self._forensic_fwd_fn = self._jit(fwd,
+                                              "engine/numerics_forensics")
+        coll = numerics.Collector(probes=True, moe=True, tag="forensic")
+        with numerics.collecting(coll):
+            loss, aux = self._forensic_fwd_fn(self.state.params, batch)
+        return loss, aux
+
+    def _run_nonfinite_forensics(self, batch, loss_val: float) -> None:
+        """Non-finite loss seen: re-run the forward with every probe on
+        and localize the first bad tensor in program order.  The report
+        is staged for the nan_loss health event and the resilience
+        rollback annotation; the bundle gets ``numerics.json``."""
+        try:
+            _, aux = self._numerics_forensic_capture(batch)
+            report = numerics.report_from_capture(
+                aux, self.global_steps, loss_val,
+                recorder=self.flight_recorder)
+        except Exception as e:  # forensics must not mask the failure
+            logger.error(f"numerics: forensic capture failed: {e!r}")
+            return
+        self._last_nonfinite_report = report
+        self._numerics_context = report.report
+        summary = dict(report.report.get("summary") or {})
+        summary["forensic"] = 1.0
+        if report.report.get("first_nonfinite"):
+            summary["first_nonfinite"] = report.report["first_nonfinite"]
+        self._last_numerics = summary
+        logger.error(f"numerics: {report}")
 
     def train_step(self, batch) -> Dict[str, Any]:
         """Run ONE full optimizer step (fwd+bwd over all microbatches + update)
@@ -1561,6 +1777,11 @@ class DeepSpeedEngine:
             with self.telemetry.span("engine/train_step",
                                      args={"step": self.global_steps}):
                 metrics = self._dispatch_train_step(batch)
+            # the sampled numerics aux rides the metrics pytree out of
+            # the jitted step — peel it off before anything float()s or
+            # iterates the metrics dict
+            numerics_aux = (metrics.pop("numerics", None)
+                            if isinstance(metrics, dict) else None)
             if fenced:
                 # breakdown/autotuning/telemetry trade throughput for
                 # truth (the reference inserts barriers the same way): a
@@ -1625,6 +1846,21 @@ class DeepSpeedEngine:
             os.replace(tmp, result_path)  # atomic: no torn reads
         self.lr_scheduler.last_step = self.global_steps
         self.last_metrics = metrics
+        if numerics_aux:
+            # one device→host pull of a few hundred floats, sampled
+            # steps only: decode, publish gauges, stage the summary for
+            # this step's record and the bundle context
+            self._ingest_numerics_capture(numerics_aux)
+        if self._numerics_cfg.enabled and self._numerics_cfg.forensic_on_nan:
+            try:
+                _lv = float(metrics["loss"])  # dslint: disable=host-sync-hot-path — NaN triage needs the scalar
+            except Exception:
+                _lv = 0.0
+            if not np.isfinite(_lv):
+                # forensic capture BEFORE the record/health/resilience
+                # consumers run, so the nan_loss event and the rollback
+                # annotation can NAME the first bad layer
+                self._run_nonfinite_forensics(batch, _lv)
         if self.watchdog is not None:
             # a completed step IS progress (the daemon started at build);
             # a compile-dominated step still notifies but contributes no
@@ -1747,6 +1983,12 @@ class DeepSpeedEngine:
             # the traced window's device time went
             extra["anatomy"] = self._last_anatomy
             self._last_anatomy = None
+        if self._last_numerics is not None:
+            # this step's sampled/forensic capture summary — the
+            # underflow_creep / layer_grad_explosion / router_collapse
+            # health rules read exactly these keys
+            extra["numerics"] = self._last_numerics
+            self._last_numerics = None
         if comms_logger.enabled and comms_logger.exec_counts:
             # THIS step's execution-probe activity: shard-normalized
             # cumulative totals (satellite: no more hand-dividing by

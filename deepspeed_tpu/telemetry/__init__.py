@@ -46,6 +46,7 @@ from .perf import (CompileTracker, GoodputLedger, configure_compile_tracker,
                    configure_goodput_ledger, get_compile_tracker,
                    get_goodput_ledger, tracked_jit)
 from .clocksync import ClockSync, get_clock_sync, maybe_sync_clock
+from .numerics import (NonFiniteOriginReport, STAT_FIELDS, tensor_stats)
 from .rollup import (MetricsRollup, StepStream, collect_rollup,
                      configure_step_stream, get_rollup, get_step_stream,
                      push_node_telemetry, render_top, rollup_tick)
@@ -78,6 +79,7 @@ __all__ = [
     "configure_step_stream", "get_rollup", "get_step_stream",
     "push_node_telemetry", "render_top", "rollup_tick",
     "ClockSync", "get_clock_sync", "maybe_sync_clock",
+    "NonFiniteOriginReport", "STAT_FIELDS", "tensor_stats",
     "HEARTBEAT_SCHEMA_V", "cap_heartbeat_payload",
 ]
 

@@ -740,6 +740,21 @@ def build_cluster_manifest(archive: str,
             if top_v is not None:
                 anat_compact["roofline_top"] = top_v
             anat_compact = anat_compact or None
+        num = (m.get("context") or {}).get("numerics") or {}
+        num_compact = None
+        if num:
+            # per-host tensor health (ISSUE 18): the last sampled (or
+            # forensic) capture's worst-case scalars + the first
+            # non-finite tensor name — the NaN-origin answer surfaces in
+            # the cluster view without opening the host bundle
+            summ = num.get("summary") or {}
+            num_compact = {k: summ.get(k) for k in (
+                "nonfinite_total", "underflow_frac", "saturated_frac",
+                "layer_grad_max", "gate_entropy_frac", "moe_drop_rate")
+                if summ.get(k) is not None}
+            if num.get("first_nonfinite"):
+                num_compact["first_nonfinite"] = num["first_nonfinite"]
+            num_compact = num_compact or None
         hosts[node] = {
             "reason": m.get("reason"),
             "time_utc": m.get("time_utc"),
@@ -760,6 +775,7 @@ def build_cluster_manifest(archive: str,
             "compile_time_ms": ct.get("time_ms_total"),
             "memory": mem_compact,
             "anatomy": anat_compact,
+            "numerics": num_compact,
         }
         for op, e in (comm.get("summary") or {}).items():
             census.setdefault(op, {})[node] = float(e.get("count", 0))

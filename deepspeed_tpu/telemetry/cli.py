@@ -686,6 +686,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .anatomy.cli import add_anatomy_parser
 
     add_anatomy_parser(sub)
+
+    from .numerics.cli import add_numerics_parser
+
+    add_numerics_parser(sub)
     return p
 
 

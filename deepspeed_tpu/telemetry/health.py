@@ -22,6 +22,15 @@ per-step record stream into structured :class:`HealthEvent`\\ s:
   retry budget (store killed / partitioned): heartbeats and replica
   publications are buffering, training continues blind — one event per
   outage streak, cleared on reconnect
+* ``underflow_creep``         — worst probe underflow fraction above
+  threshold for N consecutive sampled numerics captures (the loss scale
+  should be bumped before the gradients silently flush to zero)
+* ``layer_grad_explosion``    — ONE layer's grad norm is many times the
+  median layer's (the per-layer [L] norm vector from the numerics
+  plane); the event NAMES the layer index
+* ``router_collapse``         — MoE gating entropy at/below its floor
+  for N consecutive captures: the router is funneling every token to
+  the same expert(s)
 
 Compile-dominated steps (``extra["compile_ms"]`` at or above
 ``compile_dominated_frac`` of the step time — the CompileTracker's
@@ -92,6 +101,12 @@ class HealthMonitor:
                  host_leak_window: int = 16,
                  host_leak_frac: float = 0.05,
                  control_plane: bool = True,
+                 numerics_underflow_frac: float = 0.05,
+                 numerics_underflow_steps: int = 3,
+                 numerics_layer_grad_ratio: float = 20.0,
+                 numerics_layer_grad_floor: float = 1e-8,
+                 numerics_entropy_floor: float = 0.30,
+                 numerics_entropy_steps: int = 3,
                  registry: Optional[Any] = None,
                  recorder: Optional[Any] = None):
         self.min_points = max(2, int(min_points))
@@ -120,6 +135,16 @@ class HealthMonitor:
         #: (one event per outage streak, re-armed on reconnect)
         self.control_plane = bool(control_plane)
         self._cp_alerted = False
+        #: numerics-plane rules (read StepRecord.extra["numerics"], the
+        #: sampled-capture summary); <= 0 thresholds disable each rule
+        self.numerics_underflow_frac = float(numerics_underflow_frac)
+        self.numerics_underflow_steps = max(1, int(numerics_underflow_steps))
+        self.numerics_layer_grad_ratio = float(numerics_layer_grad_ratio)
+        self.numerics_layer_grad_floor = float(numerics_layer_grad_floor)
+        self.numerics_entropy_floor = float(numerics_entropy_floor)
+        self.numerics_entropy_steps = max(1, int(numerics_entropy_steps))
+        self._underflow_streak = 0
+        self._entropy_streak = 0
         self.registry = registry
         self.recorder = recorder
         w = max(int(window), self.min_points)
@@ -164,6 +189,8 @@ class HealthMonitor:
         self._scale_collapsed = False
         self._loss_anoms = 0
         self._gn_anoms = 0
+        self._underflow_streak = 0
+        self._entropy_streak = 0
 
     # -- detectors ---------------------------------------------------------
 
@@ -398,6 +425,72 @@ class HealthMonitor:
                     xs[-1], _median(xs) * (1.0 + self.host_leak_frac)))
                 self._live.clear()
 
+    def _check_numerics(self, rec: StepRecord,
+                        out: List[HealthEvent]) -> None:
+        """The numerics plane's three rules over the sampled-capture
+        summary riding ``extra["numerics"]`` (absent on unsampled steps
+        — the streak counters only advance on captures)."""
+        try:
+            num = rec.extra.get("numerics")
+        except AttributeError:
+            return
+        if not isinstance(num, dict):
+            return
+        uf = num.get("underflow_frac")
+        if self.numerics_underflow_frac > 0 and uf is not None:
+            if float(uf) >= self.numerics_underflow_frac:
+                self._underflow_streak += 1
+                if self._underflow_streak >= self.numerics_underflow_steps:
+                    out.append(HealthEvent(
+                        "underflow_creep", SEV_WARNING, rec.step,
+                        f"step {rec.step}: worst probe underflow fraction "
+                        f"{float(uf):.1%} for {self._underflow_streak} "
+                        f"consecutive sampled captures (threshold "
+                        f"{self.numerics_underflow_frac:.0%}) — tensor "
+                        f"tails are creeping toward the dtype flush floor; "
+                        f"bump the loss scale (fp16 init_scale) or move "
+                        f"the worst probe (`telemetry numerics top`) to "
+                        f"fp32 before the gradients silently zero",
+                        float(uf), self.numerics_underflow_frac))
+                    self._underflow_streak = 0  # re-alert per streak
+            else:
+                self._underflow_streak = 0
+        gmax = num.get("layer_grad_max")
+        gmed = num.get("layer_grad_median")
+        if (self.numerics_layer_grad_ratio > 0 and gmax is not None
+                and gmed is not None):
+            floor = self.numerics_layer_grad_floor
+            med = max(float(gmed), floor)
+            ratio = float(gmax) / med
+            if float(gmax) > floor and ratio >= self.numerics_layer_grad_ratio:
+                layer = int(num.get("layer_grad_argmax", -1))
+                out.append(HealthEvent(
+                    "layer_grad_explosion", SEV_WARNING, rec.step,
+                    f"step {rec.step}: layer {layer} grad norm "
+                    f"{float(gmax):.4g} is {ratio:.0f}x the median "
+                    f"layer's {med:.4g} — one layer is diverging ahead "
+                    f"of the global clip; check layer {layer}'s inputs "
+                    f"and its probes in the last numerics capture",
+                    ratio, self.numerics_layer_grad_ratio))
+        ent = num.get("gate_entropy_frac", num.get("gate_entropy"))
+        if self.numerics_entropy_floor > 0 and ent is not None:
+            if float(ent) <= self.numerics_entropy_floor:
+                self._entropy_streak += 1
+                if self._entropy_streak >= self.numerics_entropy_steps:
+                    out.append(HealthEvent(
+                        "router_collapse", SEV_WARNING, rec.step,
+                        f"step {rec.step}: MoE gating entropy "
+                        f"{float(ent):.2f} at/below the "
+                        f"{self.numerics_entropy_floor:.2f} floor for "
+                        f"{self._entropy_streak} consecutive captures — "
+                        f"the router is funneling tokens to the same "
+                        f"expert(s); raise the aux-loss coefficient or "
+                        f"check moe/load_imbalance",
+                        float(ent), self.numerics_entropy_floor))
+                    self._entropy_streak = 0
+            else:
+                self._entropy_streak = 0
+
     def _check_control_plane(self, rec: StepRecord,
                              out: List[HealthEvent]) -> None:
         """One ``control_plane_degraded`` event per store-outage streak:
@@ -437,6 +530,7 @@ class HealthMonitor:
         self._check_recompile_storm(rec, out)
         self._check_memory_pressure(rec, out)
         self._check_host_leak(rec, out)
+        self._check_numerics(rec, out)
         self._check_control_plane(rec, out)
         for ev in out:
             self._publish(ev)

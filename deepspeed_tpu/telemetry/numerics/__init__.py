@@ -1,0 +1,45 @@
+"""Numerics observability plane (ISSUE 18) — per-layer tensor health,
+NaN origin bisection, and MoE routing telemetry INSIDE the jitted step.
+
+Three pieces:
+
+* :mod:`.stats` — the 8-scalar per-tensor stat vector
+  (:func:`tensor_stats`) every probe folds its tensor into, computed
+  in-graph: nonfinite count, abs-max, smallest nonzero, rms, zero
+  fraction, subnormal/underflow fraction, dtype-saturation fraction,
+  size.
+* :mod:`.probe` — the :func:`probe` tag models call (IDENTITY when the
+  plane is off — same jaxpr, zero recompiles), the trace-time
+  :class:`Collector`, the :func:`scan_mark`/:func:`scan_drain`/
+  :func:`scan_collect` bracket that threads per-layer stats out of a
+  ``lax.scan``-stacked decoder as scan ``ys``, the :func:`moe_stats`
+  gate-telemetry hook, and the host-side :func:`decode`/
+  :func:`summarize` pair.
+* :mod:`.forensics` — the NaN origin bisection: on a non-finite loss
+  the engine re-runs the forward with all probes on and this module
+  turns the capture into a :class:`NonFiniteOriginReport` + a
+  ``numerics.json`` bundle side file NAMING the first bad layer.
+
+Read side: ``python -m deepspeed_tpu.telemetry numerics {show,top,diff}``
+(:mod:`.cli`).
+"""
+
+from __future__ import annotations
+
+from .forensics import (NUMERICS_JSON, NonFiniteOriginReport, build_report,
+                        report_from_capture, write_numerics_json)
+from .probe import (Collector, active, collecting, combine_stats, decode,
+                    grad_stats, moe_stats, probe, reset, scan_collect,
+                    scan_drain, scan_mark, summarize, suppressed)
+from .stats import (STAT_FIELDS, first_nonfinite, stats_to_dict,
+                    summarize_tree, tensor_stats)
+
+__all__ = [
+    "STAT_FIELDS", "tensor_stats", "stats_to_dict", "summarize_tree",
+    "first_nonfinite",
+    "Collector", "collecting", "suppressed", "active", "reset", "probe",
+    "moe_stats", "scan_mark", "scan_drain", "scan_collect",
+    "combine_stats", "grad_stats", "decode", "summarize",
+    "NUMERICS_JSON", "NonFiniteOriginReport", "build_report",
+    "write_numerics_json", "report_from_capture",
+]

@@ -1,0 +1,253 @@
+"""``python -m deepspeed_tpu.telemetry numerics {show,top,diff}``.
+
+The read side of the numerics plane:
+
+* ``numerics show <bundle>`` — the forensic verdict (first non-finite
+  layer), worst-case summary scalars, grad-path norms, and MoE gate
+  stats of one bundle (``numerics.json`` when present — a NaN-forensics
+  bundle — else the manifest's ``context.numerics`` section).
+* ``numerics top <bundle>`` — probes ranked by a chosen stat field
+  (default ``subnormal_frac``): where underflow/overflow concentrates.
+* ``numerics diff <a> <b>`` — two captures over time: per-probe deltas
+  on underflow/saturation/rms plus an UNDERFLOW CREEP verdict — exit 3
+  when the worst subnormal fraction grew beyond threshold (scriptable,
+  same contract as ``mem diff``/``perf check``).
+
+Every command works on plain directories — no store, no device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from .forensics import NUMERICS_JSON
+from .stats import STAT_FIELDS
+
+#: diff verdict: worst subnormal_frac growing past this is creep
+CREEP_GROW_ABS = 0.05
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
+def load_numerics_section(bundle: str) -> Optional[Dict[str, Any]]:
+    """Best numerics payload in a bundle dir: ``numerics.json`` (NaN
+    forensics) wins; else the manifest's ``context.numerics``."""
+    nj = os.path.join(bundle, NUMERICS_JSON)
+    if os.path.exists(nj):
+        try:
+            with open(nj) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            pass
+    manifest = os.path.join(bundle, "bundle.json")
+    if not os.path.exists(manifest):
+        return None
+    try:
+        with open(manifest) as fh:
+            ctx = (json.load(fh).get("context") or {})
+    except (OSError, ValueError):
+        return None
+    num = ctx.get("numerics")
+    return num if isinstance(num, dict) else None
+
+
+def _resolve(path: str) -> Optional[str]:
+    from ..cli import _resolve_bundle
+
+    return _resolve_bundle(path)
+
+
+# ---------------------------------------------------------------------------
+# show
+# ---------------------------------------------------------------------------
+
+def cmd_numerics_show(args: argparse.Namespace) -> int:
+    bundle = _resolve(args.bundle)
+    if bundle is None:
+        return _fail(f"{args.bundle}: not a debug bundle")
+    num = load_numerics_section(bundle)
+    if num is None:
+        return _fail(f"{bundle}: no numerics section (numerics.json or "
+                     f"manifest context.numerics)")
+    print(f"bundle: {bundle}")
+    if num.get("step") is not None:
+        print(f"  step: {num['step']}  loss: {num.get('loss', '?')}")
+    first = num.get("first_nonfinite")
+    if first:
+        st = (num.get("probes") or {}).get(first, {})
+        print(f"  FIRST NON-FINITE: {first} "
+              f"(nonfinite={st.get('nonfinite', 0):.0f}, "
+              f"absmax={st.get('absmax', 0):.3g})")
+    elif "first_nonfinite" in num:
+        print("  no non-finite probe — poison not in forward activations")
+    summary = num.get("summary") or {}
+    if summary:
+        print("  summary: " + "  ".join(
+            f"{k}={v:.4g}" for k, v in sorted(summary.items())))
+    grads = num.get("grads") or {}
+    scalars = {k: v for k, v in grads.items() if not isinstance(v, list)}
+    if scalars:
+        print("  grad norms: " + "  ".join(
+            f"{k}={v:.4g}" for k, v in sorted(scalars.items())))
+    ratios = num.get("update_ratio") or {}
+    if ratios:
+        print("  update/param ratios: " + "  ".join(
+            f"{k}={v:.3g}" for k, v in sorted(ratios.items())
+            if not isinstance(v, list)))
+    moe = num.get("moe") or {}
+    if moe:
+        def _fmt(v):
+            if isinstance(v, list):
+                flat = v if not (v and isinstance(v[0], list)) \
+                    else [x for row in v for x in row]
+                if not flat:
+                    return "[]"
+                return (f"mean={sum(flat)/len(flat):.3g} "
+                        f"min={min(flat):.3g} max={max(flat):.3g}")
+            return f"{v:.4g}"
+        print("  moe gate: " + "  ".join(
+            f"{k}({_fmt(v)})" for k, v in sorted(moe.items())))
+    probes = num.get("probes") or {}
+    if probes and args.all:
+        print(f"  probes ({len(probes)}):")
+        for name in num.get("order") or sorted(probes):
+            st = probes.get(name) or {}
+            print(f"    {name:<28} absmax={st.get('absmax', 0):>10.3g} "
+                  f"rms={st.get('rms', 0):>10.3g} "
+                  f"sub={st.get('subnormal_frac', 0):>7.2%} "
+                  f"sat={st.get('saturated_frac', 0):>7.2%} "
+                  f"nonfinite={st.get('nonfinite', 0):.0f}")
+    elif probes:
+        print(f"  probes: {len(probes)} captured (use --all to list)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# top
+# ---------------------------------------------------------------------------
+
+def cmd_numerics_top(args: argparse.Namespace) -> int:
+    bundle = _resolve(args.bundle)
+    if bundle is None:
+        return _fail(f"{args.bundle}: not a debug bundle")
+    num = load_numerics_section(bundle)
+    probes = (num or {}).get("probes") or {}
+    if not probes:
+        return _fail(f"{bundle}: no per-probe capture in the numerics "
+                     f"section")
+    field = args.field
+    ranked = sorted(probes.items(),
+                    key=lambda kv: -float(kv[1].get(field, 0.0)))
+    print(f"bundle: {bundle}")
+    print(f"  top {min(args.k, len(ranked))} probes by {field}:")
+    for name, st in ranked[:args.k]:
+        print(f"    {float(st.get(field, 0.0)):>10.4g}  {name}  "
+              f"(absmax={st.get('absmax', 0):.3g} "
+              f"rms={st.get('rms', 0):.3g})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# diff — the underflow-creep verdict
+# ---------------------------------------------------------------------------
+
+def diff_numerics(a: Dict[str, Any], b: Dict[str, Any],
+                  creep_abs: float = CREEP_GROW_ABS) -> Dict[str, Any]:
+    """Compare OLD ``a`` against NEW ``b``: per-probe subnormal /
+    saturation / rms deltas + a creep verdict when the worst subnormal
+    fraction grew by more than ``creep_abs`` (absolute)."""
+    pa, pb = a.get("probes") or {}, b.get("probes") or {}
+    findings = []
+    deltas: Dict[str, Dict[str, float]] = {}
+    for name in sorted(set(pa) & set(pb)):
+        sa, sb = pa[name], pb[name]
+        d = {f: float(sb.get(f, 0.0)) - float(sa.get(f, 0.0))
+             for f in ("subnormal_frac", "saturated_frac", "rms",
+                       "nonfinite")}
+        if any(d.values()):
+            deltas[name] = d
+        if d["subnormal_frac"] > creep_abs:
+            findings.append(
+                f"probe '{name}' subnormal_frac grew "
+                f"{sa.get('subnormal_frac', 0):.2%} -> "
+                f"{sb.get('subnormal_frac', 0):.2%}")
+        if d["nonfinite"] > 0:
+            findings.append(f"probe '{name}' went non-finite "
+                            f"({d['nonfinite']:.0f} new bad elements)")
+    wa = max((float(s.get("subnormal_frac", 0.0)) for s in pa.values()),
+             default=0.0)
+    wb = max((float(s.get("subnormal_frac", 0.0)) for s in pb.values()),
+             default=0.0)
+    creep = wb - wa > creep_abs or any("non-finite" in f for f in findings)
+    return {"creep": bool(creep or findings), "findings": findings,
+            "deltas": deltas, "worst_subnormal": (wa, wb)}
+
+
+def cmd_numerics_diff(args: argparse.Namespace) -> int:
+    a, b = _resolve(args.a), _resolve(args.b)
+    if a is None or b is None:
+        return _fail("numerics diff needs two debug bundle directories")
+    na, nb = load_numerics_section(a), load_numerics_section(b)
+    if na is None or nb is None:
+        missing = a if na is None else b
+        return _fail(f"{missing}: no numerics section")
+    result = diff_numerics(na, nb, creep_abs=args.creep_abs)
+    print(f"A (old): {a}\nB (new): {b}")
+    wa, wb = result["worst_subnormal"]
+    print(f"worst subnormal_frac: {wa:.2%} -> {wb:.2%}")
+    shown = 0
+    for name, d in sorted(result["deltas"].items(),
+                          key=lambda kv: -abs(kv[1]["subnormal_frac"])):
+        if shown >= args.k:
+            break
+        print(f"  {name:<28} dsub={d['subnormal_frac']:+.2%} "
+              f"dsat={d['saturated_frac']:+.2%} drms={d['rms']:+.3g}")
+        shown += 1
+    if result["creep"]:
+        print("CREEP VERDICT: " + ("; ".join(result["findings"])
+                                   or f"worst subnormal_frac grew "
+                                      f"{wb - wa:+.2%}"))
+        return 3
+    print(f"no underflow creep (growth within {args.creep_abs:.0%} abs)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser wiring (called from telemetry/cli.py build_parser)
+# ---------------------------------------------------------------------------
+
+def add_numerics_parser(sub: Any) -> None:
+    n = sub.add_parser("numerics",
+                       help="tensor-health forensics: show/top/diff "
+                            "bundle numerics sections (diff exits 3 on "
+                            "an underflow-creep verdict)")
+    nsub = n.add_subparsers(dest="numerics_cmd", required=True)
+    ns = nsub.add_parser("show", help="one bundle's numerics verdict "
+                                      "and summary")
+    ns.add_argument("bundle")
+    ns.add_argument("--all", action="store_true",
+                    help="list every captured probe")
+    ns.set_defaults(fn=cmd_numerics_show)
+    nt = nsub.add_parser("top", help="probes ranked by a stat field")
+    nt.add_argument("bundle")
+    nt.add_argument("-k", type=int, default=10)
+    nt.add_argument("--field", default="subnormal_frac",
+                    choices=list(STAT_FIELDS))
+    nt.set_defaults(fn=cmd_numerics_top)
+    nd = nsub.add_parser("diff", help="diff two captures; exit 3 on "
+                                      "underflow-creep verdict")
+    nd.add_argument("a", help="older bundle")
+    nd.add_argument("b", help="newer bundle")
+    nd.add_argument("-k", type=int, default=10,
+                    help="max per-probe delta rows to print")
+    nd.add_argument("--creep-abs", type=float, default=CREEP_GROW_ABS,
+                    help="absolute subnormal_frac growth that "
+                         f"constitutes creep (default {CREEP_GROW_ABS})")
+    nd.set_defaults(fn=cmd_numerics_diff)

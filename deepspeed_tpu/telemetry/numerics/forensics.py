@@ -1,0 +1,125 @@
+"""NaN origin bisection — the forensic capture after a non-finite loss.
+
+``nan_loss`` rollback (resilience, PR 4) could always say *that* the
+run diverged; this module makes the failure NAME the first bad layer.
+When the engine sees a fenced non-finite loss with the numerics plane
+enabled, it re-runs the loss forward on the SAME failed ``(state,
+batch)`` with every probe on (its own jit site,
+``engine/numerics_forensics`` — compiled once, only ever on failure),
+decodes the capture, and walks the probes in program order: the first
+one with ``nonfinite > 0`` is where the poison entered.
+
+The artifact trail mirrors the memory plane's OOM forensics
+(:mod:`..memory.oom`): a :class:`NonFiniteOriginReport` exception-style
+report object, a ``numerics.json`` side file in the debug bundle, and a
+flight-recorder annotation — so ``telemetry numerics show <bundle>``
+and the rollback annotation both read the same record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ...utils.logging import logger
+from .probe import decode, summarize
+from .stats import first_nonfinite
+
+#: side-file name inside a debug bundle (next to memory.json/bundle.json)
+NUMERICS_JSON = "numerics.json"
+
+
+class NonFiniteOriginReport(RuntimeError):
+    """A non-finite loss, localized: carries the first bad probe (layer)
+    in program order plus the full forensic capture.  Raisable like
+    :class:`~..memory.oom.HBMExhaustedError` but normally just attached
+    to the health event / rollback annotation."""
+
+    def __init__(self, message: str, first_layer: str = "",
+                 first_probe: str = "", step: int = -1,
+                 bundle_path: Optional[str] = None,
+                 report: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.first_layer = first_layer
+        self.first_probe = first_probe
+        self.step = step
+        self.bundle_path = bundle_path
+        self.report = report or {}
+        #: same contract as HBMExhaustedError: a bundle already written
+        #: for this failure suppresses the excepthook's duplicate dump
+        self.ds_bundle_path = bundle_path
+
+
+def build_report(named: Dict[str, Any], step: int,
+                 loss: float = float("nan")) -> Dict[str, Any]:
+    """Harvested forensic capture → the ``numerics.json`` document."""
+    decoded = decode(named)
+    first = first_nonfinite(decoded["probes"], decoded["order"])
+    # "layer07/attn_out" → layer "layer07", probe "attn_out"; unscanned
+    # probe names ("embed", "logits") are their own layer
+    layer, _, site = first.partition("/")
+    report = {
+        "step": int(step),
+        "loss": float(loss) if loss == loss else "nan",
+        "first_nonfinite": first,
+        "first_layer": layer,
+        "first_probe": site or layer,
+        "summary": summarize(decoded),
+        "probes": decoded["probes"],
+        "order": decoded["order"],
+        "grads": decoded["grads"],
+        "update_ratio": decoded["update_ratio"],
+        "moe": decoded["moe"],
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    return report
+
+
+def write_numerics_json(bundle_dir: str,
+                        report: Dict[str, Any]) -> Optional[str]:
+    """Drop ``numerics.json`` next to a bundle's ``bundle.json``
+    (atomic tmp+replace, best-effort — forensics must never add a
+    second failure to the first)."""
+    try:
+        os.makedirs(bundle_dir, exist_ok=True)
+        path = os.path.join(bundle_dir, NUMERICS_JSON)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        logger.error(f"numerics: failed to write {NUMERICS_JSON}: {e!r}")
+        return None
+
+
+def report_from_capture(named: Dict[str, Any], step: int, loss: float,
+                        recorder: Any = None) -> NonFiniteOriginReport:
+    """Decode a forensic capture, annotate the flight recorder, dump a
+    bundle when a recorder is armed, and return the report object."""
+    doc = build_report(named, step, loss)
+    first = doc["first_nonfinite"]
+    msg = (f"non-finite loss at step {step}: first bad tensor is "
+           f"'{first}' (nonfinite="
+           f"{doc['probes'].get(first, {}).get('nonfinite', 0):.0f})"
+           if first else
+           f"non-finite loss at step {step}: forward re-run came back "
+           f"finite — the poison is in the grad/optimizer path or the "
+           f"batch, not the forward activations")
+    bundle_path = None
+    if recorder is not None:
+        try:
+            recorder.annotate("numerics_nonfinite", {
+                "step": step, "first_nonfinite": first,
+                "first_layer": doc["first_layer"],
+                "summary": doc["summary"]})
+            bundle_path = recorder.dump(reason="nan_loss_forensics")
+            if bundle_path:
+                write_numerics_json(bundle_path, doc)
+        except Exception as e:  # diagnostics must not mask the rollback
+            logger.error(f"numerics: forensic bundle dump failed: {e!r}")
+    return NonFiniteOriginReport(
+        msg, first_layer=doc["first_layer"], first_probe=doc["first_probe"],
+        step=step, bundle_path=bundle_path, report=doc)

@@ -1,0 +1,112 @@
+"""The per-tensor stat vector — the numerics plane's unit of record.
+
+Every probe folds a tensor into EIGHT fp32 scalars (``STAT_FIELDS``),
+computed in-graph so the jitted step never round-trips to the host:
+
+``nonfinite``   count of NaN/Inf elements (the forensic localizer keys
+                on this: first layer in program order with nonfinite>0)
+``absmax``      max |x| over the finite elements (overflow watch)
+``min_nonzero`` smallest nonzero |x| among finite elements (how close
+                the tensor's tail sits to the representable floor)
+``rms``         root-mean-square of the finite elements (scale drift)
+``zero_frac``   exact-zero fraction (dead units / hard underflow)
+``subnormal_frac``  fraction of NONZERO finite elements with
+                |x| < finfo(dtype).tiny * 2**UNDERFLOW_MARGIN_BITS —
+                already-subnormal values plus values within a few
+                exponent steps of the dtype's flush floor.  The margin
+                matters because XLA (CPU and TPU) flushes true
+                subnormals to zero — by the time a probe sees the
+                tensor those are ``zero_frac``; the recoverable signal
+                is the creep TOWARD the floor.  In bf16 this is the
+                underflow creep stas00's detector hunted: gradients
+                that quietly flush before the loss scale notices.
+``saturated_frac``  fraction of finite elements with |x| >=
+                0.99 * finfo(dtype).max — one multiply from Inf.
+``size``        element count (so consumers can re-weight aggregates)
+
+All stats mask nonfinite values OUT of the other seven — a single NaN
+must show up as ``nonfinite=1``, not poison absmax/rms into NaN and
+erase the very signal the probe exists to carry.
+
+The thresholds (``tiny``/``max``) come from the tensor's OWN dtype at
+trace time, so a bf16 residual and an fp32 master grad are each judged
+against their real representable range.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+#: field order of the stat vector; index with STAT_FIELDS.index(name)
+STAT_FIELDS = ("nonfinite", "absmax", "min_nonzero", "rms", "zero_frac",
+               "subnormal_frac", "saturated_frac", "size")
+
+#: saturation margin: |x| within 1% of finfo.max counts as saturated
+SATURATION_FRAC = 0.99
+
+#: underflow margin: nonzero |x| within 2**8 of finfo.tiny counts as
+#: underflow creep (true subnormals are FTZ-flushed before we see them)
+UNDERFLOW_MARGIN_BITS = 8
+
+
+def tensor_stats(x: jnp.ndarray) -> jnp.ndarray:
+    """``[8]`` fp32 stat vector for ``x`` (any shape, any float dtype).
+
+    Pure jnp — safe inside jit/scan/checkpoint.  Integer/bool inputs are
+    cast to fp32 (their stats are still meaningful: zero fraction,
+    absmax); the subnormal/saturation thresholds then use fp32's range.
+    """
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    fi = jnp.finfo(dtype)
+    xf = x.astype(jnp.float32).reshape(-1)
+    n = xf.size
+    finite = jnp.isfinite(xf)
+    nonfinite = jnp.sum(~finite).astype(jnp.float32)
+    # |x| with nonfinite masked to 0 — keeps every reduction finite
+    a = jnp.where(finite, jnp.abs(xf), 0.0)
+    n_finite = jnp.maximum(jnp.sum(finite).astype(jnp.float32), 1.0)
+    absmax = jnp.max(a) if n else jnp.float32(0.0)
+    nz = finite & (a > 0.0)
+    n_nz = jnp.sum(nz).astype(jnp.float32)
+    min_nonzero = jnp.min(jnp.where(nz, a, jnp.inf))
+    min_nonzero = jnp.where(jnp.isfinite(min_nonzero), min_nonzero, 0.0)
+    # rms scaled by absmax so the sum of squares can't overflow fp32
+    # even for tensors sitting at the top of bf16/fp32 range
+    scale = jnp.maximum(absmax, jnp.float32(1e-30))
+    rms = scale * jnp.sqrt(
+        jnp.sum(jnp.where(finite, jnp.square(a / scale), 0.0)) / n_finite)
+    zero_frac = jnp.sum(finite & (a == 0.0)).astype(jnp.float32) / n_finite
+    tiny = jnp.float32(float(fi.tiny) * 2.0 ** UNDERFLOW_MARGIN_BITS)
+    subnormal = jnp.sum(nz & (a < tiny)).astype(jnp.float32) \
+        / jnp.maximum(n_nz, 1.0)
+    sat = jnp.sum(finite
+                  & (a >= jnp.float32(SATURATION_FRAC * float(fi.max)))
+                  ).astype(jnp.float32) / n_finite
+    return jnp.stack([nonfinite, absmax, min_nonzero, rms, zero_frac,
+                      subnormal, sat, jnp.float32(n)])
+
+
+def stats_to_dict(vec) -> Dict[str, float]:
+    """``[8]`` vector (device array / np / list) → named host floats."""
+    arr = np.asarray(vec, dtype=np.float64).reshape(-1)
+    return {name: float(arr[i]) for i, name in enumerate(STAT_FIELDS)}
+
+
+def summarize_tree(named: Dict[str, "np.ndarray"]) -> Dict[str, Dict[str, float]]:
+    """{probe name: [8] vector} → {probe name: {field: float}} — the
+    host-side decode step after the step's aux pytree lands."""
+    return {name: stats_to_dict(vec) for name, vec in named.items()}
+
+
+def first_nonfinite(per_probe: Dict[str, Dict[str, float]],
+                    order: List[str]) -> str:
+    """Name of the FIRST probe (in ``order`` = program order) whose
+    nonfinite count is > 0, or ``""`` when everything is finite."""
+    for name in order:
+        st = per_probe.get(name)
+        if st and st.get("nonfinite", 0.0) > 0.0:
+            return name
+    return ""

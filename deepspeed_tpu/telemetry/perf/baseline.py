@@ -80,6 +80,12 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     # is a ratio of tail latencies, double jitter) plus an absolute
     # floor below which changes are error-budget noise.
     "serving_slo_burn_rate_p99": ("lower", 0.50),
+    # numerics plane (ISSUE 18): fractional step-time cost of running the
+    # sampled probes-on step variant vs the base step on the same
+    # problem.  LOWER is better — the plane's whole contract is "stats
+    # ride the step for (nearly) free"; a rise means a probe started
+    # forcing a host sync or broke an XLA fusion.
+    "numerics_overhead_frac": ("lower", 0.50),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -99,6 +105,9 @@ ABS_FLOORS: Dict[str, float] = {
     # a step whose exposed-collective share is under 5% is effectively
     # compute-bound; scheduler jitter down there is not a regression
     "comm_fraction": 0.05,
+    # ISSUE 18 acceptance ceiling: probe overhead under 5% of step time
+    # is sampling noise on a tunneled chip, not a regression
+    "numerics_overhead_frac": 0.05,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

@@ -379,6 +379,10 @@ class MetricsRollup:
                     snap, "anatomy/comm_fraction"),
                 "overlap_hiding_frac": self._gauge_value(
                     snap, "anatomy/overlap_hiding_frac"),
+                "underflow_frac": self._gauge_value(
+                    snap, "numerics/underflow_frac"),
+                "gate_entropy": self._gauge_value(
+                    snap, "moe/gate_entropy"),
                 "steps_streamed": st.get("count", 0),
                 "store_outages": self._counter_value(
                     snap, "elasticity/store_outages_total"),
@@ -737,8 +741,8 @@ def render_top(rollup: MetricsRollup,
     """The live cluster view as a fixed-width table."""
     rows = rollup.rows(hb_view)
     header = (f"{'NODE':<14} {'STEP':>8} {'STEP_MS':>9} {'GOODPUT':>8} "
-              f"{'HBM%':>6} {'COMM%':>6} {'LOSS':>10} {'HB_AGE':>7} "
-              f"{'OUTAGES':>8} {'STATE':<10}")
+              f"{'HBM%':>6} {'COMM%':>6} {'UFLOW%':>6} {'LOSS':>10} "
+              f"{'HB_AGE':>7} {'OUTAGES':>8} {'STATE':<10}")
     lines = []
     if store_info:
         lines.append(
@@ -758,12 +762,14 @@ def render_top(rollup: MetricsRollup,
             state = "LIVE"
         hbm = r.get("hbm_frac")
         comm = r.get("comm_fraction")
+        uflow = r.get("underflow_frac")
         lines.append(
             f"{r['node']:<14} {_fmt(r.get('step'), '{:.0f}'):>8} "
             f"{_fmt(r.get('step_time_ewma_ms'), '{:.1f}'):>9} "
             f"{_fmt(r.get('goodput'), '{:.3f}'):>8} "
             f"{_fmt(None if hbm is None else hbm * 100.0, '{:.1f}'):>6} "
             f"{_fmt(None if comm is None else comm * 100.0, '{:.1f}'):>6} "
+            f"{_fmt(None if uflow is None else uflow * 100.0, '{:.1f}'):>6} "
             f"{_fmt(r.get('loss'), '{:.5g}'):>10} "
             f"{_fmt(age, '{:.1f}'):>7} "
             f"{_fmt(r.get('store_outages'), '{:.0f}'):>8} "

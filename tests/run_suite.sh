@@ -27,8 +27,9 @@ SHARDS=(
   "tests/unit/runtime/test_pipe_engine.py"
   "tests/unit/monitor"
   "tests/unit/analysis"
-  "tests/unit/telemetry --ignore=tests/unit/telemetry/test_memory_ledger.py --ignore=tests/unit/telemetry/test_memory_oom.py --ignore=tests/unit/telemetry/test_memory_health.py --ignore=tests/unit/telemetry/test_memory_cli.py --ignore=tests/unit/telemetry/test_memory_watchdog.py"
+  "tests/unit/telemetry --ignore=tests/unit/telemetry/test_memory_ledger.py --ignore=tests/unit/telemetry/test_memory_oom.py --ignore=tests/unit/telemetry/test_memory_health.py --ignore=tests/unit/telemetry/test_memory_cli.py --ignore=tests/unit/telemetry/test_memory_watchdog.py --ignore=tests/unit/telemetry/test_numerics_stats.py --ignore=tests/unit/telemetry/test_numerics_engine.py --ignore=tests/unit/telemetry/test_numerics_cli.py"
   "tests/unit/telemetry/test_memory_ledger.py tests/unit/telemetry/test_memory_oom.py tests/unit/telemetry/test_memory_health.py tests/unit/telemetry/test_memory_cli.py tests/unit/telemetry/test_memory_watchdog.py"
+  "tests/unit/telemetry/test_numerics_stats.py tests/unit/telemetry/test_numerics_engine.py tests/unit/telemetry/test_numerics_cli.py"
   "tests/unit/resilience"
   "tests/unit/elasticity"
   "tests/unit/serving"

@@ -47,6 +47,9 @@ def _fresh_diagnostics():
         stream.reset()
         stream.enabled = False
         reset_rollup()
+        from deepspeed_tpu.telemetry import numerics
+
+        numerics.reset()
 
     scrub()
     yield

@@ -1,0 +1,88 @@
+"""``telemetry numerics {show,top,diff}`` CLI smoke (ISSUE 18): reads a
+real forensic bundle (numerics.json) and a manifest-context fallback;
+diff's underflow-creep verdict exits 3."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry.cli import build_parser
+
+
+def _bundle(tmp_path, name, probes, first="", step=7, loss=1.0,
+            as_numerics_json=True):
+    """A minimal on-disk debug bundle carrying a numerics section."""
+    b = tmp_path / name
+    b.mkdir()
+    order = list(probes)
+    doc = {"step": step, "loss": loss, "first_nonfinite": first,
+           "first_layer": first.split("/")[0] if first else "",
+           "first_probe": first, "summary": {"nonfinite_total": 0.0},
+           "probes": probes, "order": order, "grads": {"layers": 1.0},
+           "update_ratio": {"layers": 0.01},
+           "moe": {"entropy": 1.2, "load": [0.4, 0.6]}}
+    with open(b / "bundle.json", "w") as fh:
+        json.dump({"manifest_v": 1, "reason": "test",
+                   "context": {} if as_numerics_json
+                   else {"numerics": doc}}, fh)
+    if as_numerics_json:
+        with open(b / "numerics.json", "w") as fh:
+            json.dump(doc, fh)
+    return str(b)
+
+
+def _probe(sub=0.0, sat=0.0, rms=1.0, nonfinite=0.0):
+    return {"nonfinite": nonfinite, "absmax": 2.0, "min_nonzero": 1e-3,
+            "rms": rms, "zero_frac": 0.0, "subnormal_frac": sub,
+            "saturated_frac": sat, "size": 64.0}
+
+
+def _run(argv):
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+def test_numerics_show_forensic_bundle(tmp_path, capsys):
+    b = _bundle(tmp_path, "a",
+                {"layer00/act": _probe(),
+                 "layer01/act": _probe(nonfinite=32.0)},
+                first="layer01/act", loss=float("inf"))
+    assert _run(["numerics", "show", b, "--all"]) == 0
+    out = capsys.readouterr().out
+    assert "FIRST NON-FINITE: layer01/act" in out
+    assert "layer00/act" in out and "moe gate" in out
+
+
+def test_numerics_show_manifest_context_fallback(tmp_path, capsys):
+    b = _bundle(tmp_path, "ctx", {"act": _probe(sub=0.12)},
+                as_numerics_json=False)
+    assert _run(["numerics", "show", b]) == 0
+    assert "probes: 1 captured" in capsys.readouterr().out
+
+
+def test_numerics_top_ranks_by_field(tmp_path, capsys):
+    b = _bundle(tmp_path, "t",
+                {"cold": _probe(sub=0.01), "hot": _probe(sub=0.40)})
+    assert _run(["numerics", "top", b, "-k", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "hot" in out and "cold" not in out
+
+
+def test_numerics_diff_creep_verdict_exit_3(tmp_path, capsys):
+    old = _bundle(tmp_path, "old", {"act": _probe(sub=0.01)})
+    new = _bundle(tmp_path, "new", {"act": _probe(sub=0.30)})
+    assert _run(["numerics", "diff", old, new]) == 3
+    assert "CREEP VERDICT" in capsys.readouterr().out
+    # within-threshold growth: clean exit
+    near = _bundle(tmp_path, "near", {"act": _probe(sub=0.03)})
+    assert _run(["numerics", "diff", old, near]) == 0
+
+
+def test_numerics_show_without_section_fails_cleanly(tmp_path, capsys):
+    b = tmp_path / "empty"
+    b.mkdir()
+    with open(b / "bundle.json", "w") as fh:
+        json.dump({"manifest_v": 1, "context": {}}, fh)
+    assert _run(["numerics", "show", str(b)]) == 2
+    assert "no numerics section" in capsys.readouterr().err

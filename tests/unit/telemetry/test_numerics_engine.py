@@ -1,0 +1,304 @@
+"""Numerics plane engine half (ISSUE 18): sampled in-step capture into
+StepRecord.extra + gauges, the NaN-injection acceptance (fault injector
+poisons layer k → the forensic report and numerics.json name layer k),
+the rollback annotation carrying the first bad layer, and the three
+numerics health rules."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (StepRecord, get_telemetry, load_bundle,
+                                     numerics, parse_prometheus_text)
+
+L, H = 3, 8
+
+
+def _stacked_engine(tmp_path, name, numerics_over=None, resilience=None,
+                    telemetry_over=None):
+    """Tiny engine whose model has a scanned [L] trunk with in-scan
+    probes and stacked ``params['layers']`` — the shape both the
+    per-layer grad vector and the ``nan_params`` fault key on."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    rng = np.random.default_rng(3)
+    params = {
+        "layers": {"w": jnp.asarray(
+            rng.normal(size=(L, H, H)).astype(np.float32) * 0.4)},
+        "head": jnp.asarray(rng.normal(size=(H, 1)).astype(np.float32)),
+    }
+
+    def loss_fn(p, batch):
+        x, y = batch
+
+        def body(h, w):
+            mark = numerics.scan_mark()
+            h = numerics.probe("act", jnp.tanh(h @ w))
+            return h, numerics.scan_drain(mark)
+
+        h, ys = jax.lax.scan(body, x, p["layers"]["w"])
+        numerics.scan_collect(ys)  # keep the [L] layer axis
+        out = numerics.probe("pred", h @ p["head"])
+        return jnp.mean((out - y) ** 2)
+
+    tel = {"enabled": True, "output_path": str(tmp_path / name),
+           "job_name": "job",
+           "flight_recorder": {"install_handlers": False},
+           "numerics": dict({"enabled": True, "every": 2},
+                            **(numerics_over or {}))}
+    tel.update(telemetry_over or {})
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 0,
+           "telemetry": tel}
+    if resilience is not None:
+        cfg["resilience"] = dict(
+            {"enabled": True, "snapshot_interval": 1,
+             "snapshot_dir": str(tmp_path / name / "snaps"),
+             "flush_engine": "sync",
+             "backoff_base_s": 0.0, "backoff_max_s": 0.0}, **resilience)
+    engine, *_ = dst.initialize(model=loss_fn, model_parameters=params,
+                                config=cfg, mesh=mesh)
+    x = jnp.asarray(rng.normal(size=(4, H)).astype(np.float32))
+    y = jnp.zeros((4, 1), jnp.float32)
+    return engine, (x, y)
+
+
+def test_sampled_capture_rides_step_record_and_gauges(tmp_path):
+    engine, data = _stacked_engine(tmp_path, "sample")
+    for _ in range(4):
+        engine.train_step(data)
+    # step 2 and 4 were sampled (every=2): the capture decoded into the
+    # step record extra, the gauges, and the bundle context
+    recs = list(engine.flight_recorder._steps)
+    sampled = [r for r in recs if "numerics" in r]
+    assert [r["step"] for r in sampled] == [2, 4]
+    summ = sampled[-1]["numerics"]
+    assert summ["probe_count"] == L + 1  # L scanned acts + pred
+    assert summ["nonfinite_total"] == 0.0
+    assert "layer_grad_max" in summ  # per-layer grad vector decoded
+    ctx = engine._numerics_context
+    assert ctx["step"] == 4 and ctx["first_nonfinite"] == ""
+    assert ctx["order"] == ["layer00/act", "layer01/act", "layer02/act",
+                            "pred"]
+    assert len(ctx["grads"]["per_layer"]) == L
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert "numerics_underflow_frac" in parsed
+    assert "numerics_layer_grad_max" in parsed
+
+
+def test_unsampled_steps_carry_no_capture(tmp_path):
+    engine, data = _stacked_engine(tmp_path, "off", {"every": 0})
+    for _ in range(3):
+        m = engine.train_step(data)
+        assert "numerics" not in m
+    assert all("numerics" not in r
+               for r in engine.flight_recorder._steps)
+
+
+def test_nan_injection_forensics_names_poisoned_layer(tmp_path):
+    """THE acceptance test: ``nan_params@2:layer=1`` NaNs layer 1's
+    weights in the live param tree — the forensic probes-on re-run must
+    localize the first bad tensor to layer 1, in the report object, the
+    bundle's numerics.json, and the health/rollback annotations."""
+    engine, data = _stacked_engine(
+        tmp_path, "nan",
+        resilience={"faults": ["nan_params@2:layer=1"]})
+    engine.train_step(data)
+    m = engine.train_step(data)  # poisoned step: NaN loss + rollback
+    assert m.get("rolled_back", False)
+
+    # the forensic report localized the poison: layer 0 is CLEAN, the
+    # first non-finite probe is layer 1's activation
+    ctx = engine._numerics_context
+    assert ctx["first_nonfinite"] == "layer01/act"
+    assert ctx["probes"]["layer00/act"]["nonfinite"] == 0.0
+    assert ctx["probes"]["layer01/act"]["nonfinite"] > 0.0
+
+    # numerics.json in the forensic bundle says the same
+    bundle = engine.flight_recorder.last_bundle_path
+    assert bundle is not None
+    with open(os.path.join(bundle, "numerics.json")) as fh:
+        doc = json.load(fh)
+    assert doc["first_nonfinite"] == "layer01/act"
+    assert doc["step"] == 2 and not np.isfinite(float(doc["loss"]))
+
+    # the rollback annotation carries the layer name + bundle pointer
+    # (satellite: the 3am operator reads WHERE the NaN was born, not
+    # just that a rollback happened)
+    m2 = load_bundle(engine.flight_recorder.dump("post"))["manifest"]
+    rb = next(a for a in m2["annotations"]
+              if a["kind"] == "resilience_rollback")
+    assert rb["trigger"] == "nan_loss"
+    assert rb["first_nonfinite"] == "layer01/act"
+    assert rb["numerics_bundle"]
+    # training recovered: the next step is finite
+    assert np.isfinite(float(engine.train_step(data)["loss"]))
+
+
+def test_forensics_without_resilience_keeps_report(tmp_path):
+    """Without the recovery plane the report stays staged on the engine
+    (nothing consumes it) and params were NOT poisoned by the update —
+    the non-finite guard held them so the capture stayed localizable."""
+    engine, data = _stacked_engine(tmp_path, "noguard")
+    engine.train_step(data)
+    st = engine.state
+    w = st.params["layers"]["w"].at[1].set(jnp.nan)
+    engine.state = st._replace(
+        params=dict(st.params, layers={"w": w}))
+    engine.train_step(data)
+    rep = engine._last_nonfinite_report
+    assert rep is not None
+    assert rep.first_layer == "layer01" and rep.first_probe == "act"
+    assert rep.report["first_nonfinite"] == "layer01/act"
+    # layer 0 params survived the NaN step un-NaN'd (update was held)
+    assert np.isfinite(
+        np.asarray(engine.state.params["layers"]["w"][0])).all()
+
+
+def test_disabled_plane_is_identical_program(tmp_path):
+    """numerics.enabled=False: no step variant, no collector ever
+    active, no numerics keys anywhere — and the probed model still
+    trains (probes are identities)."""
+    engine, data = _stacked_engine(tmp_path, "plane_off",
+                                   telemetry_over={"numerics": {
+                                       "enabled": False,
+                                       "moe_gauges": False}})
+    losses = [float(engine.train_step(data)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert engine._numerics_step_fn is None
+    assert engine._numerics_context is None
+    assert all("numerics" not in r for r in engine.flight_recorder._steps)
+
+
+# ---------------------------------------------------------------------------
+# health rules
+# ---------------------------------------------------------------------------
+
+def _rec(step, **extra):
+    return StepRecord(step=step, step_time_ms=100.0, device_fenced=True,
+                      samples_per_sec=10.0, tokens_per_sec=1000.0,
+                      loss=1.0, grad_norm=0.5, lr=1e-3, loss_scale=1.0,
+                      overflow=False, skipped_steps=0, comm_bytes=0,
+                      comm_ops=0, extra={"numerics": extra} if extra else {})
+
+
+def _monitor(**over):
+    from deepspeed_tpu.telemetry import HealthMonitor
+
+    kw = dict(window=16, min_points=4, numerics_underflow_steps=3,
+              numerics_entropy_steps=3)
+    kw.update(over)
+    return HealthMonitor(**kw)
+
+
+def test_underflow_creep_rule_needs_streak():
+    hm = _monitor()
+    assert hm.observe(_rec(1, underflow_frac=0.20)) == []
+    assert hm.observe(_rec(2, underflow_frac=0.20)) == []
+    events = hm.observe(_rec(3, underflow_frac=0.20))
+    assert [e.kind for e in events] == ["underflow_creep"]
+    assert events[0].severity == "warning"
+    # a healthy sample resets the streak
+    assert hm.observe(_rec(4, underflow_frac=0.0)) == []
+    assert hm.observe(_rec(5, underflow_frac=0.20)) == []
+
+
+def test_layer_grad_explosion_names_layer():
+    hm = _monitor()
+    events = hm.observe(_rec(1, layer_grad_max=50.0,
+                             layer_grad_median=0.5,
+                             layer_grad_argmax=7.0))
+    assert [e.kind for e in events] == ["layer_grad_explosion"]
+    assert "layer 7" in events[0].message
+    # balanced layers: quiet
+    assert hm.observe(_rec(2, layer_grad_max=1.0,
+                           layer_grad_median=0.5,
+                           layer_grad_argmax=0.0)) == []
+
+
+def test_router_collapse_rule_on_entropy_floor():
+    hm = _monitor()
+    for step in (1, 2):
+        assert hm.observe(_rec(step, gate_entropy_frac=0.05)) == []
+    events = hm.observe(_rec(3, gate_entropy_frac=0.05))
+    assert [e.kind for e in events] == ["router_collapse"]
+    # reset_windows clears the streaks (satellite 3)
+    hm2 = _monitor()
+    hm2.observe(_rec(1, gate_entropy_frac=0.05, underflow_frac=0.2))
+    hm2.reset_windows()
+    assert hm2._entropy_streak == 0 and hm2._underflow_streak == 0
+
+
+def test_records_without_numerics_are_quiet():
+    hm = _monitor()
+    for step in range(1, 6):
+        assert hm.observe(_rec(step)) == []
+
+
+# ---------------------------------------------------------------------------
+# MoE gate telemetry
+# ---------------------------------------------------------------------------
+
+def test_gate_meta_hot_expert_vs_balanced():
+    """A hot expert shows up as low entropy + imbalanced load + overflow
+    of the hot expert's capacity; balanced logits sit near ln(E)."""
+    from deepspeed_tpu.moe.sharded_moe import top_k_gating
+
+    T, E, C = 64, 4, 8  # tight capacity: a hot expert must overflow
+    hot = jnp.zeros((T, E)).at[:, 2].set(10.0)
+    _, _, _, meta = top_k_gating(hot, 1, C)
+    assert float(meta["entropy"]) < 0.1
+    assert float(np.max(np.asarray(meta["load"]))) > 0.9
+    assert float(meta["overflow_frac"]) > 0.5
+    assert float(meta["drop_rate"]) > 0.5
+
+    balanced = jnp.asarray(np.random.RandomState(0).randn(T, E) * 0.01,
+                           jnp.float32)
+    _, _, _, meta_b = top_k_gating(balanced, 1, 32)
+    assert float(meta_b["entropy"]) > 0.9 * np.log(E)
+    assert float(meta_b["overflow_frac"]) == 0.0
+
+
+@pytest.mark.slow
+def test_moe_engine_emits_gate_gauges_with_probes_off(tmp_path):
+    """Satellite: an MoE model emits moe/* gauges on sampled steps even
+    with the full probe plane DISABLED (moe_gauges rides alone)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models import MixtralConfig, MixtralModel
+    from deepspeed_tpu.parallel import MeshLayout
+    from deepspeed_tpu.utils import groups
+
+    mesh = groups.initialize_mesh(MeshLayout.infer(1, dp=1))
+    cfg = MixtralConfig.tiny(num_layers=2, dtype=jnp.float32)
+    model = MixtralModel(cfg, mesh=mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ds = {"train_micro_batch_size_per_gpu": 8,
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          "steps_per_print": 0,
+          "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "moe",
+                        "flight_recorder": {"install_handlers": False},
+                        "numerics": {"enabled": False, "every": 2,
+                                     "moe_gauges": True}}}
+    engine, *_ = dst.initialize(model=model, model_parameters=params,
+                                config=ds, mesh=mesh)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(8, 32)))
+    for _ in range(2):
+        engine.train_step({"input_ids": ids})
+    parsed = parse_prometheus_text(get_telemetry().prometheus_text())
+    assert "moe_gate_entropy" in parsed
+    assert "moe_load_imbalance" in parsed
+    assert parsed["moe_gate_entropy"] > 0
+    # probe plane stayed off: no per-probe capture anywhere
+    assert all("layer_grad_max" not in (r.get("numerics") or {})
+               for r in engine.flight_recorder._steps)

@@ -1,0 +1,191 @@
+"""Numerics plane unit half (ISSUE 18): the 8-scalar stat vector, the
+probe identity-when-off contract (same jaxpr, zero recompiles), the
+collector/scan bracket, and the host-side decode/summarize path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import numerics
+from deepspeed_tpu.telemetry.numerics import stats_to_dict, tensor_stats
+
+
+def _stats(x):
+    return stats_to_dict(tensor_stats(jnp.asarray(x)))
+
+
+# ---------------------------------------------------------------------------
+# the stat vector
+# ---------------------------------------------------------------------------
+
+def test_finite_tensor_basic_fields():
+    st = _stats(np.array([0.0, 1.0, -2.0, 0.5], np.float32))
+    assert st["nonfinite"] == 0
+    assert st["absmax"] == 2.0
+    assert st["min_nonzero"] == 0.5
+    assert st["size"] == 4
+    assert st["zero_frac"] == 0.25
+    np.testing.assert_allclose(st["rms"], float(np.sqrt(1.3125)), rtol=1e-5)
+
+
+def test_nonfinite_masked_out_of_other_stats():
+    """A single NaN must surface as nonfinite=1, not poison absmax/rms."""
+    st = _stats(np.array([np.nan, np.inf, -np.inf, 2.0], np.float32))
+    assert st["nonfinite"] == 3
+    assert st["absmax"] == 2.0
+    assert np.isfinite(st["rms"]) and st["rms"] > 0
+
+
+def test_underflow_creep_band_bf16():
+    """XLA (CPU and TPU) flushes TRUE subnormals to zero before any probe
+    sees them — so the detector counts nonzero values within
+    2**UNDERFLOW_MARGIN_BITS of finfo.tiny (the creep band), which a
+    crafted near-floor NORMAL value exercises."""
+    x = jnp.asarray(np.array([2e-38, 1e-36, 1.0, 0.0], np.float32)
+                    ).astype(jnp.bfloat16)
+    st = stats_to_dict(tensor_stats(x))
+    # 2e-38 and 1e-36 sit inside tiny * 2**8 ≈ 3e-36; 1.0 does not;
+    # 0.0 is zero_frac's, not the creep band's
+    assert st["subnormal_frac"] == pytest.approx(2.0 / 3.0)
+    assert st["zero_frac"] == pytest.approx(0.25)
+
+
+def test_saturation_against_own_dtype_max():
+    x = jnp.asarray(np.array([3.38e38, 1.0], np.float32)).astype(jnp.bfloat16)
+    st = stats_to_dict(tensor_stats(x))
+    assert st["saturated_frac"] == pytest.approx(0.5)
+    # a magnitude deep inside fp32's range is not saturated there
+    st32 = _stats(np.array([1e38, 1.0], np.float32))
+    assert st32["saturated_frac"] == 0.0
+
+
+def test_rms_does_not_overflow_at_dtype_top():
+    """Sum-of-squares of top-of-range bf16 values overflows fp32; the
+    absmax-scaled rms must stay finite and ≈ absmax."""
+    x = jnp.asarray(np.array([3e38, 3e38], np.float32)).astype(jnp.bfloat16)
+    st = stats_to_dict(tensor_stats(x))
+    assert np.isfinite(st["rms"])
+    assert st["rms"] == pytest.approx(st["absmax"], rel=1e-3)
+
+
+def test_integer_input_cast():
+    st = _stats(np.array([0, 3, -4], np.int32))
+    assert st["absmax"] == 4.0 and st["zero_frac"] == pytest.approx(1 / 3)
+
+
+# ---------------------------------------------------------------------------
+# identity-when-off: the zero-cost contract
+# ---------------------------------------------------------------------------
+
+def test_probe_disabled_is_same_object_and_same_jaxpr():
+    assert numerics.active() is None
+    y = jnp.ones((4,))
+    assert numerics.probe("t", y) is y
+
+    def plain(x):
+        return jnp.tanh(x) * 2.0
+
+    def probed(x):
+        return numerics.probe("t", jnp.tanh(x)) * 2.0
+
+    x = jnp.ones((8,))
+    assert str(jax.make_jaxpr(probed)(x)) == str(jax.make_jaxpr(plain)(x))
+
+
+def test_disabled_probes_zero_recompiles():
+    """The acceptance gate: a probed program with the plane off compiles
+    once and never again across repeated calls."""
+    from deepspeed_tpu.telemetry.perf import (configure_compile_tracker,
+                                              tracked_jit)
+
+    trk = configure_compile_tracker(enabled=True)
+    fn = tracked_jit(lambda x: numerics.probe("p", x * 2.0),
+                     site="test/numerics_identity", tracker=trk)
+    for i in range(5):
+        fn(jnp.ones((8,)) * i).block_until_ready()
+    assert trk.recompiles_total == 0
+    assert trk.events_total == 1
+
+
+def test_suppressed_region_is_identity():
+    coll = numerics.Collector()
+    with numerics.collecting(coll):
+        with numerics.suppressed():
+            numerics.probe("inside", jnp.ones((2,)))
+            assert coll.entries == []
+        numerics.probe("outside", jnp.ones((2,)))
+    assert [n for n, _ in coll.entries] == ["outside"]
+
+
+# ---------------------------------------------------------------------------
+# collector / scan bracket / decode
+# ---------------------------------------------------------------------------
+
+def test_collector_harvest_decode_round_trip():
+    coll = numerics.Collector(probes=True, moe=True, tag="t")
+    with numerics.collecting(coll):
+        numerics.probe("a", jnp.ones((4,)))
+        numerics.probe("b", jnp.asarray([np.inf, 1.0]))
+        numerics.moe_stats({"load": jnp.asarray([0.9, 0.1]),
+                            "entropy": jnp.float32(0.325),
+                            "drop_rate": jnp.float32(0.0)})
+    dec = numerics.decode(coll.harvest())
+    assert dec["order"] == ["a", "b"]
+    assert dec["probes"]["b"]["nonfinite"] == 1.0
+    assert numerics.first_nonfinite(dec["probes"], dec["order"]) == "b"
+    assert dec["moe"]["load"] == pytest.approx([0.9, 0.1])
+    summ = numerics.summarize(dec)
+    assert summ["nonfinite_total"] == 1.0
+    # entropy normalized against ln(E): E-independent collapse floor
+    assert summ["gate_entropy_frac"] == pytest.approx(0.325 / np.log(2),
+                                                      rel=1e-3)
+    assert summ["moe_load_imbalance"] == pytest.approx(1.8, rel=1e-3)
+
+
+def test_scan_bracket_layer_axis_survives_jit():
+    """The stacked-trunk pattern: bodies drain into index-keyed ys, the
+    stacked [L, 8] entry decodes layer-major in program order."""
+    coll = numerics.Collector()
+
+    def fn(ws, x):
+        def body(h, w):
+            mark = numerics.scan_mark()
+            h = numerics.probe("act", jnp.tanh(h @ w))
+            return h, numerics.scan_drain(mark)
+
+        h, ys = jax.lax.scan(body, x, ws)
+        numerics.scan_collect(ys)  # keep the layer axis
+        out = numerics.probe("head", jnp.sum(h))
+        c = numerics.active()
+        return out, (c.harvest() if c is not None else {})
+
+    ws = jnp.stack([jnp.eye(4) * (i + 1) for i in range(3)])
+    with numerics.collecting(coll):
+        _, aux = jax.jit(fn)(ws, jnp.ones((2, 4)))
+    dec = numerics.decode(aux)
+    assert dec["order"] == ["layer00/act", "layer01/act", "layer02/act",
+                            "head"]
+    assert all(dec["probes"][n]["nonfinite"] == 0 for n in dec["order"])
+
+
+def test_combine_stats_field_aware_fold():
+    a = tensor_stats(jnp.asarray([1.0, 0.0]))
+    b = tensor_stats(jnp.asarray([np.inf, 3.0, 4.0]))
+    c = stats_to_dict(numerics.combine_stats(jnp.stack([a, b]), "act"))
+    assert c["nonfinite"] == 1.0     # counts sum
+    assert c["absmax"] == 4.0        # extrema max
+    assert c["min_nonzero"] == 1.0   # extrema min over nonzero
+    assert c["size"] == 5.0
+
+
+def test_grad_stats_per_layer_vector():
+    grads = {"layers": {"w": jnp.ones((3, 2, 2))}, "head": jnp.ones((2,))}
+    updates = jax.tree.map(lambda g: g * 0.1, grads)
+    params = jax.tree.map(lambda g: g * 2.0, grads)
+    out = numerics.grad_stats(grads, updates, params)
+    assert {"grad/layers", "grad/per_layer", "grad/head",
+            "update_ratio/layers", "update_ratio/head"} <= set(out)
+    assert out["grad/per_layer"].shape == (3,)
+    np.testing.assert_allclose(np.asarray(out["grad/per_layer"]), 2.0)
+    np.testing.assert_allclose(float(out["update_ratio/head"]), 0.05)
