@@ -1228,6 +1228,27 @@ def _main() -> None:
         extras.setdefault("variants", {})[
             "mixtral_v2_error"] = str(e)[:200]
 
+    _mark("moe_ep")
+    # -- variant: expert-parallel training plane (ISSUE 19) ----------------
+    # The Mixtral proxy TRAINED through the config-driven ep path (expert
+    # mesh axis > 1 when the chip count allows; ep=1 reference alongside)
+    # plus the index-form-vs-dense dispatch micro-bench.  Three figures go
+    # top-level into the gated PERF_METRICS: moe_ep_tokens_per_sec,
+    # moe_dispatch_speedup, moe_drop_rate.
+    try:
+        _budget_check()
+        from deepspeed_tpu.moe.bench import run_moe_ep_bench
+
+        mo = run_moe_ep_bench(dry_run=False, steps=4, warmup=2)
+        extras.setdefault("variants", {})["moe_ep"] = mo
+        for key in ("moe_ep_tokens_per_sec", "moe_dispatch_speedup",
+                    "moe_drop_rate"):
+            extras[key] = mo[key]
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})["moe_ep_error"] = str(e)[:200]
+
     _mark("llama_v2")
     # -- variant: inference v2 ragged serving throughput -------------------
     # NOTE: over the tunnel each dispatch pays ~100 ms RTT — bursts

@@ -52,6 +52,14 @@ from .kv_cache import KVCacheConfig, init_kv_pool
 from .scheduler import RaggedScheduler, Request
 
 
+class _null_ctx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return None
+
+
 def _sample(logits: jnp.ndarray, temperature: jnp.ndarray,
             key: jax.Array) -> jnp.ndarray:
     """In-graph sampling over ``[N, V]`` fp32 logits: greedy when
@@ -70,7 +78,8 @@ class RaggedInferenceEngineV2:
                  adapter: Optional[ModelAdapterV2] = None,
                  mesh: Any = None,
                  scheduler_factory: Optional[Callable] = None,
-                 ledger_key: str = "inference_v2/kv_pool"):
+                 ledger_key: str = "inference_v2/kv_pool",
+                 moe_telemetry: bool = True):
         self.model = model
         self.adapter = adapter or make_adapter(model)
         self.config = model.config
@@ -152,6 +161,22 @@ class RaggedInferenceEngineV2:
                                     static_argnames=("kb",))
         self._decode_jits: Dict[int, Callable] = {}
         self._key = jax.random.PRNGKey(0)
+        #: MoE serving telemetry (ISSUE 19): when the model routes through
+        #: a MOELayer, the decode program additionally returns the gate's
+        #: per-expert load so the router/autoscaler can see hot experts.
+        #: One persistent moe-only collector is active at trace time; the
+        #: stats ride the program's output pytree ([L, E] load fractions
+        #: averaged over the burst), so cached calls pay one tiny extra
+        #: device→host transfer and zero recompiles.
+        from ...telemetry import numerics
+
+        self._moe_coll = (
+            numerics.Collector(probes=False, moe=True, tag="serving")
+            if moe_telemetry
+            and getattr(model, "_moe_layer", None) is not None else None)
+        #: host-side rolling per-expert load (fractions, sum≈1) and the
+        #: derived max/mean imbalance — the router's placement signal
+        self.last_moe_stats: Optional[Dict[str, Any]] = None
         log_dist(f"inference v2: pool={self.cache_config.num_blocks}"
                  f"x{self.cache_config.block_size} tokens, "
                  f"slots={max_batch_slots}, chunk={prefill_chunk}"
@@ -255,13 +280,17 @@ class RaggedInferenceEngineV2:
         kernel, samples the next token in-graph and feeds it back.  Write
         positions clamp at ``max_pos`` (a slot that hit EOS/budget inside
         the burst only scribbles its own reserved pages; the host discards
-        its surplus tokens).  Returns (token ids ``[n_steps, B]``, pool)."""
+        its surplus tokens).  Returns (token ids ``[n_steps, B]``, pool,
+        moe gate stats dict or None)."""
+        from ...telemetry import numerics
+
         ad = self.adapter
         B = tokens.shape[0]
         bs = self.cache_config.block_size
 
         def one_step(carry, key):
             tokens, kv_lens, pool = carry
+            step_mark = numerics.scan_mark()
             wp = jnp.minimum(kv_lens, max_pos)  # [B] write positions
             page_ids = tables[jnp.arange(B), wp // bs]
             offsets = wp % bs
@@ -290,21 +319,30 @@ class RaggedInferenceEngineV2:
             def layer(carry, xs):
                 x, = carry
                 lp, k_pool_l, v_pool_l = xs
+                mark = numerics.scan_mark()
                 x, k_pool_l, v_pool_l = self._layer_step(
                     lp, k_pool_l, v_pool_l, x, wp, write_fn, attend_fn)
-                return (x,), (k_pool_l, v_pool_l)
+                # MoE gate stats (moe_stats inside model._ffn) must exit
+                # the layer scan as ys — names ride the dict keys
+                stats = numerics.scan_drain(mark)
+                return (x,), (k_pool_l, v_pool_l, stats)
 
-            (x,), (ks, vs) = jax.lax.scan(
+            (x,), (ks, vs, stats) = jax.lax.scan(
                 layer, (x,), (ad.layers(params), pool["k"], pool["v"]))
+            numerics.scan_collect(stats)  # keep the per-layer axis
             x = ad.finalize(params, x)
             logits = ad.logits(params, x)  # [B, V]
             nxt = _sample(logits, temperature, key)
-            return (nxt, kv_lens + 1, {"k": ks, "v": vs}), nxt
+            step_stats = numerics.scan_drain(step_mark)
+            return (nxt, kv_lens + 1, {"k": ks, "v": vs}), (nxt, step_stats)
 
         keys = jax.random.split(key, n_steps)
-        (_, _, pool), toks = jax.lax.scan(
+        (_, _, pool), (toks, stats) = jax.lax.scan(
             one_step, (tokens, kv_lens, pool), keys)
-        return toks, pool
+        numerics.scan_collect(stats, combine=True)  # mean over the burst
+        coll = numerics.active()
+        moe_aux = coll.harvest() if coll is not None else None
+        return toks, pool, moe_aux
 
     def _decode(self, n_steps: int) -> Callable:
         fn = self._decode_jits.get(n_steps)
@@ -325,6 +363,49 @@ class RaggedInferenceEngineV2:
     def put(self, prompt: List[int], max_new_tokens: int = 32) -> Request:
         """Admit one request (reference ``engine.put`` role)."""
         return self.scheduler.add_request(prompt, max_new_tokens)
+
+    # -- MoE serving telemetry -----------------------------------------
+
+    def _ingest_moe_stats(self, moe_aux: Dict[str, Any], tel: Any) -> None:
+        """Host-side decode of the burst's gate stats: per-expert load
+        gauges + the imbalance/drop scalars the router and autoscaler
+        read.  Telemetry must never kill a decode step."""
+        from ...telemetry import numerics
+
+        try:
+            decoded = numerics.decode(moe_aux)
+            summary = numerics.summarize(decoded)
+        except Exception:  # pragma: no cover - defensive
+            return
+        load = np.asarray(decoded.get("moe", {}).get("load", []),
+                          dtype=np.float64)
+        if load.ndim > 1:  # [L, E] → mean over the layer axis
+            load = load.reshape(-1, load.shape[-1]).mean(axis=0)
+        stats = {
+            "load": load.tolist(),
+            "imbalance": float(summary.get("moe_load_imbalance", 0.0)),
+            "drop_rate": float(summary.get("moe_drop_rate", 0.0)),
+        }
+        self.last_moe_stats = stats
+        if not tel.enabled:
+            return
+        for e, frac in enumerate(stats["load"]):
+            tel.set_gauge(f"inference/moe/expert_load_e{e}", float(frac),
+                          help="per-expert token-load fraction of the "
+                               "last decode burst (hot-expert signal)")
+        tel.set_gauge("inference/moe/load_imbalance", stats["imbalance"],
+                      help="max/mean expert load of the last decode "
+                           "burst (1.0 = balanced router)")
+        tel.set_gauge("inference/moe/drop_rate", stats["drop_rate"],
+                      help="capacity-dropped token fraction of the last "
+                           "decode burst")
+
+    def moe_load_imbalance(self) -> float:
+        """Router-facing hot-expert signal: max/mean expert load of the
+        last decode burst (1.0 = balanced; 0.0 = no MoE data yet)."""
+        if not self.last_moe_stats:
+            return 0.0
+        return float(self.last_moe_stats.get("imbalance", 0.0))
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -403,13 +484,22 @@ class RaggedInferenceEngineV2:
                 kv_lens[s] = req.prefilled + len(req.generated) - 1
                 max_pos[s] = len(req.prompt) + req.max_new_tokens - 1
                 tables[s] = self.scheduler.table_row(req)
+            from ...telemetry import numerics
+
             with tel.span("inference/decode_burst",
                           args={"burst": burst, "batch": len(decode)}):
-                toks, self.pool = self._decode(burst)(
-                    self.params, self.pool, jnp.asarray(tokens),
-                    jnp.asarray(kv_lens), jnp.asarray(tables),
-                    jnp.asarray(max_pos), temp, self._next_key())
+                # the collector only matters at trace time (first call per
+                # burst length) — cached calls just return the stats the
+                # traced program already threads out
+                with numerics.collecting(self._moe_coll) \
+                        if self._moe_coll is not None else _null_ctx():
+                    toks, self.pool, moe_aux = self._decode(burst)(
+                        self.params, self.pool, jnp.asarray(tokens),
+                        jnp.asarray(kv_lens), jnp.asarray(tables),
+                        jnp.asarray(max_pos), temp, self._next_key())
                 toks = np.asarray(toks)  # [burst, B]
+            if moe_aux:
+                self._ingest_moe_stats(moe_aux, tel)
             accepted = self.scheduler.decode_burst_done(decode, toks,
                                                         eos_token_id)
             n_tokens += accepted

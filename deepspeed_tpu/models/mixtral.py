@@ -33,6 +33,9 @@ class MixtralConfig(LlamaConfig):
     top_k: int = 2
     capacity_factor: float = 2.0
     aux_loss_coef: float = 0.02
+    #: token dispatch rung for the MOELayer: auto | dense | sparse | pallas
+    #: (ops/pallas/moe_dispatch.choose_dispatch_impl) — a tuning dimension
+    moe_dispatch_impl: str = "auto"
 
     @classmethod
     def tiny(cls, **kw) -> "MixtralConfig":
@@ -68,7 +71,8 @@ class MixtralModel(LlamaModel):
             swiglu_expert_fn,
             constrain_act=lambda a: self._constrain(
                 a, AXIS_EXPERT, None, AXIS_TENSOR))
-        self._moe_layer = MOELayer(gate, expert_fn, mesh=mesh)
+        self._moe_layer = MOELayer(gate, expert_fn, mesh=mesh,
+                                   dispatch_impl=config.moe_dispatch_impl)
 
     # ------------------------------------------------------------------
 

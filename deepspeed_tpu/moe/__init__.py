@@ -5,6 +5,8 @@ Reference: ``deepspeed/moe/`` [K] — ``layer.py:MoE``, ``sharded_moe.py``
 """
 
 from .layer import MoE
-from .sharded_moe import MOELayer, TopKGate, top_k_gating
+from .sharded_moe import (GateIndices, GateMeta, MOELayer, TopKGate,
+                          top_k_gating, top_k_gating_indices)
 
-__all__ = ["MoE", "MOELayer", "TopKGate", "top_k_gating"]
+__all__ = ["MoE", "MOELayer", "TopKGate", "top_k_gating",
+           "top_k_gating_indices", "GateIndices", "GateMeta"]

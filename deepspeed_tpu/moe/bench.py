@@ -1,0 +1,250 @@
+"""MoE expert-parallel bench — the ep plane's headline numbers.
+
+Three gated figures (telemetry/perf PERF_METRICS, ISSUE 19):
+
+* ``moe_ep_tokens_per_sec`` — the Mixtral proxy trained end-to-end
+  through ``deepspeed_tpu.initialize`` with the expert mesh axis > 1:
+  expert-stacked params sharded via ``param_specs()``, ZeRO over the
+  flattened ``("expert","data")`` axes, sparse index-form dispatch.
+* ``moe_dispatch_speedup`` — the index-form dispatch/combine
+  (``ops/pallas/moe_dispatch``) vs the dense GShard ``[T,E,C]`` einsum
+  on the same routing and shapes.  The dense form is O(T·E·C) FLOPs and
+  memory; sub-1.0 means the crossover auto-dispatch regressed.
+* ``moe_drop_rate`` — capacity-dropped token fraction at the bench's
+  fixed capacity factor, read from the ``moe/drop_rate`` gauge the
+  engine publishes from gate meta (PR-18 plumbing, proven here).
+
+``--dry-run`` shrinks the proxy to a seconds-scale CPU run — the
+run_suite smoke and the ep acceptance test drive it; the fields are the
+same ones ``bench.py``'s ``moe_ep`` variant lands in the gated BENCH
+line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _pick_ep(devices: int, num_experts: int) -> int:
+    """Largest expert-axis degree the device count and expert count both
+    divide into (capped at 4 — data parallelism needs room too)."""
+    for ep in (4, 2):
+        if devices % ep == 0 and devices > ep and num_experts % ep == 0:
+            return ep
+    return 1
+
+
+def _train_tokens_per_sec(model_cfg: Any, ep: int, steps: int,
+                          warmup: int, micro: int,
+                          dispatch_impl: str) -> Dict[str, Any]:
+    """One config-driven training run: build the engine with
+    ``moe.expert_parallel_size = ep``, train, measure steady-state
+    tokens/sec and pull the gate gauges + expert shard fraction."""
+    import jax
+
+    import deepspeed_tpu as dst
+    from ..models.mixtral import MixtralModel
+    from ..telemetry import get_telemetry
+    from ..utils import groups
+
+    groups.reset_mesh()
+    ds_cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "moe": {"expert_parallel_size": ep, "dispatch_impl": dispatch_impl},
+        "steps_per_print": 0,
+        # hub on (in-memory only) + gate telemetry every step so
+        # drop/overflow land in the moe/* gauges this bench (and the
+        # rollup) reads
+        "telemetry": {"enabled": True, "jsonl": False,
+                      "numerics": {"every": 1}},
+    }
+    model = MixtralModel(model_cfg)
+    engine, *_ = dst.initialize(model=model, config=ds_cfg)
+    seq = model_cfg.max_seq_len
+    batch = engine.train_batch_size
+    rng = np.random.default_rng(11)
+
+    def one_batch():
+        ids = rng.integers(1, model_cfg.vocab_size, size=(batch, seq),
+                           dtype=np.int32)
+        return {"input_ids": ids}
+
+    losses = []
+    for _ in range(warmup):
+        losses.append(float(engine.train_step(one_batch())["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(engine.train_step(one_batch())["loss"]))
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / max(dt, 1e-9)
+
+    # expert shard fraction: per-device bytes of an expert-stacked param
+    # over its global bytes — the "params really sharded ~1/ep" proof
+    wg = engine.state.params["layers"]["moe"]["w_gate"]
+    try:
+        shard = wg.sharding.shard_shape(wg.shape)
+        frac = float(np.prod(shard) / np.prod(wg.shape))
+    except Exception:
+        frac = 1.0
+
+    drop = None
+    snap = get_telemetry().registry.snapshot()
+    g = snap.get("gauges", {}).get("moe/drop_rate")
+    if g is not None:
+        drop = float(g["value"])
+    return {"tokens_per_sec": tps, "losses": losses, "drop_rate": drop,
+            "expert_bytes_frac": frac,
+            "mesh": {k: int(v) for k, v in engine.mesh.shape.items()}}
+
+
+def _dispatch_speedup(hidden: int, experts: int, intermediate: int,
+                      tokens: int, reps: int = 5) -> float:
+    """Dense [T,E,C] einsum dispatch vs index-form sparse dispatch on
+    the same MoE block and routing — jitted, fenced, single program
+    each.  Returns t_dense / t_sparse."""
+    import jax
+    import jax.numpy as jnp
+
+    from .layer import swiglu_expert_fn
+    from .sharded_moe import MOELayer, TopKGate
+
+    rng = np.random.default_rng(3)
+    wg = jnp.asarray(rng.standard_normal((hidden, experts)),
+                     dtype=jnp.float32) * 0.02
+    ew = {
+        "w_gate": jnp.asarray(rng.standard_normal(
+            (experts, hidden, intermediate)), jnp.float32) * 0.02,
+        "w_up": jnp.asarray(rng.standard_normal(
+            (experts, hidden, intermediate)), jnp.float32) * 0.02,
+        "w_down": jnp.asarray(rng.standard_normal(
+            (experts, intermediate, hidden)), jnp.float32) * 0.02,
+    }
+    x = jnp.asarray(rng.standard_normal((1, tokens, hidden)), jnp.float32)
+
+    def timed(impl: str) -> float:
+        gate = TopKGate(num_experts=experts, k=2, capacity_factor=2.0,
+                        eval_capacity_factor=2.0, min_capacity=4)
+        layer = MOELayer(gate, swiglu_expert_fn, dispatch_impl=impl)
+        f = jax.jit(lambda w, e, t: layer(w, e, t, train=False)[0])
+        f(wg, ew, x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(wg, ew, x)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    t_dense = timed("dense")
+    t_sparse = timed("sparse")
+    return t_dense / max(t_sparse, 1e-12)
+
+
+def run_moe_ep_bench(dry_run: bool = False, ep: Optional[int] = None,
+                     steps: int = 4, warmup: int = 2,
+                     dispatch_impl: str = "sparse") -> Dict[str, Any]:
+    """The moe_ep bench: ep>1 vs ep=1 training runs + the dispatch
+    micro-bench.  Returns the JSON-able result dict whose
+    ``moe_ep_tokens_per_sec`` / ``moe_dispatch_speedup`` /
+    ``moe_drop_rate`` keys are the gated PERF_METRICS."""
+    import jax
+
+    from ..models.mixtral import MixtralConfig
+    from ..telemetry import get_telemetry
+    from ..utils import groups
+
+    hub_was_enabled = get_telemetry().enabled
+
+    if dry_run:
+        mcfg = MixtralConfig.tiny(num_layers=2, max_seq_len=128)
+        disp_shapes = dict(hidden=128, experts=4, intermediate=176,
+                           tokens=2048)
+        micro = 1
+    else:
+        # Mixtral aspect ratios scaled to a single-chip training proxy
+        mcfg = MixtralConfig(vocab_size=32000, hidden_size=1024,
+                             intermediate_size=3584, num_layers=4,
+                             num_heads=16, num_kv_heads=8, max_seq_len=1024,
+                             num_experts=8, top_k=2)
+        disp_shapes = dict(hidden=1024, experts=8, intermediate=3584,
+                           tokens=8192)
+        micro = 1
+
+    devices = jax.device_count()
+    ep = int(ep) if ep else _pick_ep(devices, mcfg.num_experts)
+    out: Dict[str, Any] = {"ep": ep, "devices": devices,
+                           "dry_run": bool(dry_run),
+                           "dispatch_impl": dispatch_impl}
+
+    ep_run = _train_tokens_per_sec(mcfg, ep, steps, warmup, micro,
+                                   dispatch_impl)
+    out["moe_ep_tokens_per_sec"] = round(ep_run["tokens_per_sec"], 1)
+    out["moe_expert_bytes_frac"] = round(ep_run["expert_bytes_frac"], 4)
+    out["moe_ep_mesh"] = ep_run["mesh"]
+    out["moe_ep_final_loss"] = round(ep_run["losses"][-1], 4)
+    if ep > 1:
+        ref = _train_tokens_per_sec(mcfg, 1, steps, warmup, micro,
+                                    dispatch_impl)
+        out["moe_ep1_tokens_per_sec"] = round(ref["tokens_per_sec"], 1)
+        out["moe_ep_speedup_vs_ep1"] = round(
+            ep_run["tokens_per_sec"] / max(ref["tokens_per_sec"], 1e-9), 3)
+        out["moe_ep1_final_loss"] = round(ref["losses"][-1], 4)
+    groups.reset_mesh()
+    if not hub_was_enabled:
+        get_telemetry().configure(enabled=False)
+
+    drop = ep_run["drop_rate"]
+    if drop is None:
+        # telemetry hub disabled: derive the same figure from a direct
+        # gate evaluation on bench-shaped random routing
+        import jax.numpy as jnp
+
+        from .sharded_moe import top_k_gating
+
+        logits = jnp.asarray(
+            np.random.default_rng(5).standard_normal(
+                (disp_shapes["tokens"], disp_shapes["experts"])),
+            jnp.float32)
+        _, _, _, meta = top_k_gating(logits, k=2, capacity=max(
+            2 * disp_shapes["tokens"] // disp_shapes["experts"], 4))
+        drop = float(meta["drop_rate"])
+    out["moe_drop_rate"] = round(float(drop), 4)
+
+    out["moe_dispatch_speedup"] = round(_dispatch_speedup(
+        **disp_shapes, reps=3 if dry_run else 5), 3)
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.moe",
+        description="MoE expert-parallel bench (ISSUE 19)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("bench", help="run the moe_ep bench; emits one "
+                                     "JSON line with the gated metrics")
+    b.add_argument("--dry-run", action="store_true",
+                   help="tiny proxy, seconds-scale (CI smoke)")
+    b.add_argument("--ep", type=int, default=0,
+                   help="expert-parallel degree (0 = auto from devices)")
+    b.add_argument("--steps", type=int, default=4)
+    b.add_argument("--dispatch-impl", default="sparse",
+                   choices=["auto", "dense", "sparse", "pallas"])
+    args = p.parse_args(argv)
+    if args.cmd == "bench":
+        result = run_moe_ep_bench(dry_run=args.dry_run,
+                                  ep=args.ep or None, steps=args.steps,
+                                  dispatch_impl=args.dispatch_impl)
+        print(json.dumps(result))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -50,10 +50,16 @@ class MoE:
                  drop_tokens: bool = True, use_rts: bool = True,
                  use_tutel: bool = False,
                  enable_expert_tensor_parallelism: bool = False,
-                 mesh: Any = None):
+                 mesh: Any = None, dispatch_impl: str = "auto"):
         if num_experts % max(ep_size, 1):
             raise ValueError(
                 f"num_experts({num_experts}) % ep_size({ep_size}) != 0")
+        if use_tutel:
+            raise ValueError(
+                "use_tutel is not supported on the TPU port: Tutel's fused "
+                "dispatch kernels are CUDA-only — the equivalent fast path "
+                "here is the Pallas sparse dispatch (dispatch_impl='pallas' "
+                "or 'auto'); pass use_tutel=False")
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.ep_size = ep_size
@@ -64,13 +70,14 @@ class MoE:
                              eval_capacity_factor=eval_capacity_factor,
                              min_capacity=min_capacity,
                              noisy_gate_policy=noisy_gate_policy,
-                             drop_tokens=drop_tokens)
+                             drop_tokens=drop_tokens,
+                             use_rts=use_rts)
         try:
             mesh = mesh if mesh is not None else groups_mod.get_mesh()
         except Exception:
             mesh = None
         self.moe_layer = MOELayer(self.gate, expert or swiglu_expert_fn,
-                                  mesh=mesh)
+                                  mesh=mesh, dispatch_impl=dispatch_impl)
 
     # ------------------------------------------------------------------
 
@@ -126,7 +133,15 @@ class MoE:
     def __call__(self, params: Any, x: jnp.ndarray, train: bool = True,
                  noise_rng: Optional[jax.Array] = None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, Any]:
-        """x: [B, S, H] → (y, l_aux, exp_counts) — reference return shape."""
+        """x: [B, S, H] → (y, l_aux, meta).
+
+        ``meta`` is the FULL gate metadata (``l_aux``, ``exp_counts``,
+        ``drop_rate``, ``load``, ``entropy``, ``overflow_frac``) so callers
+        can feed the telemetry plane without re-deriving.  Back-compat: the
+        tuple slot historically carried bare ``exp_counts`` —
+        :class:`~.sharded_moe.GateMeta.__array__` keeps
+        ``np.asarray(meta)`` meaning exactly that.
+        """
         y, l_aux, meta = self.moe_layer(params["wg"], params["experts"], x,
                                         train=train, noise_rng=noise_rng)
         if self.use_residual:
@@ -139,4 +154,4 @@ class MoE:
                 jnp.einsum("...h,hc->...c", x,
                            params["coefficient"].astype(x.dtype)), axis=-1)
             y = y * coef[..., 0:1] + dense * coef[..., 1:2]
-        return y, l_aux, meta["exp_counts"]
+        return y, l_aux, meta

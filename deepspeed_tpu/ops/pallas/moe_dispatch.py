@@ -1,0 +1,347 @@
+"""Sparse MoE token dispatch/combine — the expert-parallel data plane.
+
+Role parity: DeepSpeed's MoE dispatch is an explicit ``_AllToAll`` around a
+dense einsum (``deepspeed/moe/sharded_moe.py`` [K], GShard arXiv 2006.16668);
+the dense one-hot formulation costs O(T·E·C·H) FLOPs and materialises a
+``[T, E, C]`` mask whose useful content is k·T entries.  This module lowers
+the gating decision to INDEX form and moves tokens with gathers instead:
+
+* dispatch: ``src_idx [E, C]`` — which token fills slot c of expert e
+  (``EMPTY_SLOT`` for unfilled slots).  ``expert_in[e, c] = tokens[src]``
+  is a pure row gather, O(E·C·H) traffic and exactly the dense einsum's
+  result bit-for-bit (each slot has at most one contributing token, so the
+  dense reduction degenerates to a copy).
+* combine: ``flat_idx [T, K]`` into the flattened ``[E·C, H]`` expert
+  output (``E·C`` addresses a zero pad row for dropped assignments) plus
+  renormalized ``gates [T, K]`` — ``y[t] = Σ_k gates[t,k]·out[flat_idx[t,k]]``,
+  O(k·T·H) instead of O(T·E·C·H).
+
+Three rungs share these index semantics:
+
+* ``*_reference`` — jnp ``take``-based, fully differentiable (``take``'s
+  transpose is the scatter-add), GSPMD-friendly: this is what runs under an
+  expert-sharded mesh, where the gather IS the all-to-all boundary.
+* ``pallas_dispatch`` / ``pallas_combine`` — Pallas kernels riding
+  ``PrefetchScalarGridSpec``: the index array is scalar-prefetched to SMEM
+  and drives per-row dynamic-slice loads from a VMEM-resident token /
+  expert-output block.  Forward-only kernels with a ``custom_vjp`` whose
+  backward is the jnp reference (indices are routing decisions — integer,
+  non-differentiable — so both paths share one backward).
+* ``choose_dispatch_impl`` — the auto crossover: tiny T·E·C keeps the dense
+  einsum (fusion beats bookkeeping), sharded meshes keep the jnp sparse
+  path (``pallas_call`` does not self-partition under GSPMD), TPU +
+  unsharded goes to the kernels.
+
+Scratch accounting: the dispatch buffers ``[E, C, H]`` (+ pad rows) are
+transient per-step bytes registered in the memory ledger under
+``collective_scratch`` by the calling ``MOELayer``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: src_idx value marking an unfilled expert slot
+EMPTY_SLOT = -1
+
+#: auto crossover: dense einsum below this T·E·C volume (the [T,E,C] mask
+#: is small enough that XLA's fused einsum beats gather bookkeeping)
+DENSE_CROSSOVER_TEC = 1 << 16
+
+#: pallas combine tiles tokens in blocks of this many rows
+_COMBINE_BLOCK_T = 128
+
+
+# ---------------------------------------------------------------------------
+# index construction (shared by every sparse rung)
+# ---------------------------------------------------------------------------
+
+def routing_to_indices(expert_idx: jnp.ndarray, slot: jnp.ndarray,
+                       keep: jnp.ndarray, num_experts: int, capacity: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-choice routing ``(expert_idx [K,T], slot [K,T], keep [K,T])`` →
+    ``(src_idx [E, C], flat_idx [T, K])``.
+
+    ``src_idx[e, c]`` is the token id filling slot ``c`` of expert ``e``
+    (``EMPTY_SLOT`` if none); ``flat_idx[t, k]`` indexes the flattened
+    ``[E·C + 1, H]`` expert output, with ``E·C`` = the zero pad row for
+    dropped assignments.  Kept ``(e, c)`` pairs are unique by construction
+    (slot = cumulative position within the expert), so the scatter has no
+    collisions.
+    """
+    E, C = num_experts, capacity
+    K, T = expert_idx.shape
+    tid = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T))
+    flat_ec = jnp.where(keep, expert_idx * C + slot, E * C).astype(jnp.int32)
+    src = jnp.full((E * C + 1,), EMPTY_SLOT, jnp.int32)
+    src = src.at[flat_ec.reshape(-1)].set(tid.reshape(-1), mode="drop")
+    src_idx = src[: E * C].reshape(E, C)
+    flat_idx = flat_ec.T  # [T, K]
+    return jax.lax.stop_gradient(src_idx), jax.lax.stop_gradient(flat_idx)
+
+
+# ---------------------------------------------------------------------------
+# jnp reference rung (differentiable; runs under GSPMD meshes)
+# ---------------------------------------------------------------------------
+
+def dispatch_reference(tokens: jnp.ndarray, src_idx: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """``tokens [T, H]`` gathered into ``[E, C, H]`` expert buffers; empty
+    slots come out zero.
+
+    Deliberately clamp-and-mask instead of gathering from a ``[T+1, H]``
+    zero-padded copy: the pad row makes the gather operand's leading dim
+    indivisible by the mesh axes, and XLA's SPMD partitioner mishandles
+    the unevenly-padded gather (wrong rows on non-zero shards).  Clamped
+    in-bounds indices keep the operand evenly shardable.
+    """
+    T, H = tokens.shape
+    E, C = src_idx.shape
+    idx = jnp.clip(src_idx, 0, T - 1)
+    out = jnp.take(tokens, idx.reshape(-1), axis=0).reshape(E, C, H)
+    return out * (src_idx >= 0)[..., None].astype(tokens.dtype)
+
+
+def combine_reference(expert_out: jnp.ndarray, flat_idx: jnp.ndarray,
+                      gates: jnp.ndarray) -> jnp.ndarray:
+    """``expert_out [E, C, H]`` + ``flat_idx/gates [T, K]`` →
+    ``y [T, H] = Σ_k gates[t,k] · expert_out.flat[flat_idx[t,k]]``.
+
+    Same clamp-and-mask scheme as :func:`dispatch_reference` (dropped
+    assignments address ``E·C``, which is masked out) so the gather
+    operand stays evenly shardable under GSPMD.
+    """
+    E, C, H = expert_out.shape
+    flat = expert_out.reshape(E * C, H)
+    valid = flat_idx < E * C
+    idx = jnp.clip(flat_idx, 0, E * C - 1)
+    picked = jnp.take(flat, idx.reshape(-1), axis=0)  # [T*K, H]
+    picked = picked.reshape(*flat_idx.shape, H)
+    w = jnp.where(valid, gates, 0.0)[..., None].astype(expert_out.dtype)
+    return jnp.sum(w * picked, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernels (forward) — index-driven row gathers
+# ---------------------------------------------------------------------------
+
+def _dispatch_kernel(src_ref, tokens_ref, out_ref):
+    """grid=(E,): fill one expert's ``[1, C, H]`` buffer by gathering rows
+    of the VMEM-resident token block at scalar-prefetched indices."""
+    from jax.experimental import pallas as pl
+
+    e = pl.program_id(0)
+    C = out_ref.shape[1]
+
+    def body(c, _):
+        idx = src_ref[e, c]
+        safe = jnp.maximum(idx, 0)
+        row = pl.load(tokens_ref, (pl.dslice(safe, 1), slice(None)))
+        row = jnp.where(idx >= 0, row, jnp.zeros_like(row))
+        pl.store(out_ref, (pl.dslice(0, 1), pl.dslice(c, 1), slice(None)),
+                 row[None])
+        return _
+
+    jax.lax.fori_loop(0, C, body, 0)
+
+
+def _combine_kernel(idx_ref, out_flat_ref, gates_ref, y_ref):
+    """grid=(T/BT,): one token block's ``y[t] = Σ_k g·out[idx]`` with the
+    flattened expert output resident in VMEM (pad row at E·C)."""
+    from jax.experimental import pallas as pl
+
+    t0 = pl.program_id(0) * y_ref.shape[0]
+    BT = y_ref.shape[0]
+    K = gates_ref.shape[1]
+
+    def body(r, _):
+        acc = jnp.zeros((1, y_ref.shape[1]), jnp.float32)
+        for k in range(K):
+            idx = idx_ref[t0 + r, k]
+            row = pl.load(out_flat_ref, (pl.dslice(idx, 1), slice(None)))
+            gk = pl.load(gates_ref, (pl.dslice(r, 1), pl.dslice(k, 1)))
+            acc = acc + gk.astype(jnp.float32) * row.astype(jnp.float32)
+        pl.store(y_ref, (pl.dslice(r, 1), slice(None)),
+                 acc.astype(y_ref.dtype))
+        return _
+
+    jax.lax.fori_loop(0, BT, body, 0)
+
+
+def _pallas_dispatch_fwd(tokens: jnp.ndarray, src_idx: jnp.ndarray,
+                         interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, H = tokens.shape
+    E, C = src_idx.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(E,),
+        in_specs=[pl.BlockSpec((T, H), lambda e, src: (0, 0))],
+        out_specs=pl.BlockSpec((1, C, H), lambda e, src: (e, 0, 0)),
+    )
+    return pl.pallas_call(
+        _dispatch_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((E, C, H), tokens.dtype),
+        interpret=interpret,
+    )(src_idx, tokens)
+
+
+def _pallas_combine_fwd(expert_out: jnp.ndarray, flat_idx: jnp.ndarray,
+                        gates: jnp.ndarray, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    E, C, H = expert_out.shape
+    T, K = flat_idx.shape
+    BT = min(_COMBINE_BLOCK_T, T)
+    pad_T = (-T) % BT
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, H),
+         jnp.zeros((1, H), expert_out.dtype)], axis=0)
+    gates_p = jnp.pad(gates, ((0, pad_T), (0, 0)))
+    idx_p = jnp.pad(flat_idx, ((0, pad_T), (0, 0)),
+                    constant_values=E * C)
+    Tp = T + pad_T
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Tp // BT,),
+        in_specs=[pl.BlockSpec((E * C + 1, H), lambda i, idx: (0, 0)),
+                  pl.BlockSpec((BT, K), lambda i, idx: (i, 0))],
+        out_specs=pl.BlockSpec((BT, H), lambda i, idx: (i, 0)),
+    )
+    y = pl.pallas_call(
+        _combine_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Tp, H), expert_out.dtype),
+        interpret=interpret,
+    )(idx_p, flat, gates_p)
+    return y[:T]
+
+
+# -- custom_vjp wrappers: pallas forward, jnp-reference backward -----------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _pallas_dispatch(tokens, src_idx, interpret):
+    return _pallas_dispatch_fwd(tokens, src_idx, interpret)
+
+
+def _pallas_dispatch_vjp_fwd(tokens, src_idx, interpret):
+    return _pallas_dispatch_fwd(tokens, src_idx, interpret), \
+        (tokens.shape, src_idx)
+
+
+def _pallas_dispatch_vjp_bwd(interpret, res, g):
+    (T, H), src_idx = res
+    # transpose of the gather: scatter-add each slot's cotangent back to
+    # its source token (empty slots route to the dropped pad row)
+    idx = jnp.where(src_idx >= 0, src_idx, T).reshape(-1)
+    d_tokens = jnp.zeros((T + 1, H), g.dtype)
+    d_tokens = d_tokens.at[idx].add(g.reshape(-1, H))[:T]
+    return d_tokens, None
+
+
+_pallas_dispatch.defvjp(_pallas_dispatch_vjp_fwd, _pallas_dispatch_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _pallas_combine(expert_out, flat_idx, gates, interpret):
+    return _pallas_combine_fwd(expert_out, flat_idx, gates, interpret)
+
+
+def _pallas_combine_vjp_fwd(expert_out, flat_idx, gates, interpret):
+    y = _pallas_combine_fwd(expert_out, flat_idx, gates, interpret)
+    return y, (expert_out, flat_idx, gates)
+
+
+def _pallas_combine_vjp_bwd(interpret, res, g):
+    expert_out, flat_idx, gates, = res
+    E, C, H = expert_out.shape
+    T, K = flat_idx.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, H),
+         jnp.zeros((1, H), expert_out.dtype)], axis=0)
+    picked = jnp.take(flat, flat_idx.reshape(-1), axis=0).reshape(T, K, H)
+    d_gates = jnp.einsum("th,tkh->tk", g.astype(jnp.float32),
+                         picked.astype(jnp.float32)).astype(gates.dtype)
+    weighted = gates[..., None].astype(g.dtype) * g[:, None, :]  # [T,K,H]
+    d_flat = jnp.zeros((E * C + 1, H), g.dtype)
+    d_flat = d_flat.at[flat_idx.reshape(-1)].add(weighted.reshape(-1, H))
+    d_eo = d_flat[: E * C].reshape(E, C, H)
+    return d_eo, None, d_gates
+
+
+_pallas_combine.defvjp(_pallas_combine_vjp_fwd, _pallas_combine_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def pallas_dispatch(tokens: jnp.ndarray, src_idx: jnp.ndarray,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Pallas token dispatch: ``tokens [T, H]`` + ``src_idx [E, C]`` →
+    ``[E, C, H]``.  Off-TPU (``interpret=None``) falls back to the jnp
+    reference; ``interpret=True`` forces the kernel in interpret mode
+    (the parity harness)."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return dispatch_reference(tokens, src_idx)
+        interpret = False
+    return _pallas_dispatch(tokens, src_idx, interpret)
+
+
+def pallas_combine(expert_out: jnp.ndarray, flat_idx: jnp.ndarray,
+                   gates: jnp.ndarray,
+                   interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Pallas token combine: ``expert_out [E, C, H]`` + ``flat_idx/gates
+    [T, K]`` → ``y [T, H]``.  Fallback semantics mirror
+    :func:`pallas_dispatch`."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return combine_reference(expert_out, flat_idx, gates)
+        interpret = False
+    return _pallas_combine(expert_out, flat_idx, gates, interpret)
+
+
+def dispatch_scratch_bytes(num_experts: int, capacity: int, hidden: int,
+                           dtype=jnp.float32, k: int = 2) -> int:
+    """Analytic transient bytes of the sparse dispatch plane (expert in/out
+    buffers + pad rows + index arrays) for the memory ledger's
+    ``collective_scratch`` pool."""
+    itemsize = jnp.dtype(dtype).itemsize
+    buffers = 2 * num_experts * capacity * hidden * itemsize  # in + out
+    pad = 2 * hidden * itemsize
+    indices = (num_experts * capacity + 1) * 4 + 2 * k * 4
+    return int(buffers + pad + indices)
+
+
+def choose_dispatch_impl(impl: str, num_tokens: int, num_experts: int,
+                         capacity: int, sharded: bool = False) -> str:
+    """Resolve a requested dispatch impl (``auto``/``dense``/``sparse``/
+    ``pallas``) to a concrete one.
+
+    ``auto``: small T·E·C keeps the fused dense einsum; expert-sharded
+    meshes take the jnp sparse path (``pallas_call`` does not partition
+    itself under GSPMD — the gather is the all-to-all boundary and belongs
+    to the compiler); unsharded TPU gets the kernels.
+    """
+    if impl not in ("auto", "dense", "sparse", "pallas"):
+        raise ValueError(
+            f"unknown moe dispatch impl {impl!r} "
+            "(expected auto|dense|sparse|pallas)")
+    if impl != "auto":
+        if impl == "pallas" and sharded:
+            return "sparse"
+        return impl
+    if num_tokens * num_experts * capacity <= DENSE_CROSSOVER_TEC:
+        return "dense"
+    if sharded or jax.default_backend() != "tpu":
+        return "sparse"
+    return "pallas"

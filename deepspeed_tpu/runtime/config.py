@@ -21,7 +21,7 @@ import json
 import os
 from typing import Any, Dict, List, Literal, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..utils.logging import logger
 from .config_utils import AUTO, DeepSpeedConfigModel, is_auto
@@ -819,6 +819,43 @@ class KernelsConfig(DeepSpeedConfigModel):
     overlap_chunks: int = 4
 
 
+class MoEConfig(DeepSpeedConfigModel):
+    """``moe`` config group — the expert-parallel execution plane
+    (``deepspeed_tpu/moe/``): how many ways the ``expert`` mesh axis is
+    carved, how much slack the capacity budget gets, and which dispatch
+    implementation moves tokens.  Capacity factor / ep degree / dispatch
+    impl are tuning-plane dimensions (``tuning/space.py``); ZeRO composes
+    over the flattened ``("expert", "data")`` tuple so expert-sharded
+    params still shard their optimizer state over all data ranks."""
+
+    #: expert-parallel degree: size of the ``expert`` mesh axis.  1 keeps
+    #: the axis trivial (pre-PR-19 behavior); >1 requires
+    #: world/(tp·pp·sp) divisible by it and is mutually exclusive with
+    #: MiCS, which repurposes the expert axis as its replica axis.
+    expert_parallel_size: int = 1
+    #: token dispatch implementation: ``auto`` | ``dense`` | ``sparse`` |
+    #: ``pallas`` (``ops/pallas/moe_dispatch.choose_dispatch_impl``)
+    dispatch_impl: str = "auto"
+    #: override the model's train capacity factor (0 = keep the model's)
+    capacity_factor: float = 0.0
+    #: pad expert capacity up to the next multiple of the expert axis so
+    #: expert-axis sharding constraints never silently drop
+    pad_capacity_to_ep: bool = True
+    #: random-token-selection under capacity pressure (reference use_rts);
+    #: active only when a gating rng is threaded through the step
+    use_rts: bool = False
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.expert_parallel_size < 1:
+            raise ValueError("moe.expert_parallel_size must be >= 1")
+        if self.dispatch_impl not in ("auto", "dense", "sparse", "pallas"):
+            raise ValueError(
+                f"moe.dispatch_impl {self.dispatch_impl!r} not in "
+                "auto|dense|sparse|pallas")
+        return self
+
+
 # ---------------------------------------------------------------------------
 # top-level
 # ---------------------------------------------------------------------------
@@ -893,6 +930,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     compile: CompileConfig = Field(default_factory=CompileConfig)
     kernels: KernelsConfig = Field(default_factory=KernelsConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
     compression_training: Dict[str, Any] = Field(default_factory=dict)
     curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
 

@@ -70,6 +70,34 @@ def _resolve_config(config, config_params) -> DeepSpeedConfig:
     return DeepSpeedConfig.model_validate(payload)
 
 
+def _apply_moe_config(cfg, model: Any, mesh: Any = None) -> None:
+    """Push the ``moe.*`` config group onto the model's MOELayer/TopKGate.
+
+    Models build their MoE block at construction time (before
+    ``initialize`` sees the config), so the engine applies the dispatch /
+    capacity knobs here.  Works for any model exposing ``_moe_layer``
+    (MixtralModel) or ``moe_layer`` (the reference-shaped ``MoE`` block).
+    """
+    layer = getattr(model, "_moe_layer", None) or getattr(
+        model, "moe_layer", None)
+    if layer is None:
+        return
+    moe = cfg.moe
+    if layer.mesh is None and mesh is not None:
+        layer.mesh = mesh
+    if layer.gate.mesh is None and mesh is not None:
+        layer.gate.mesh = mesh
+    if moe.dispatch_impl != "auto":
+        layer.dispatch_impl = moe.dispatch_impl
+    gate = layer.gate
+    gate.pad_to_ep = bool(moe.pad_capacity_to_ep)
+    if moe.use_rts:
+        gate.use_rts = True
+    if moe.capacity_factor and moe.capacity_factor > 0:
+        gate.capacity_factor = float(moe.capacity_factor)
+        gate.eval_capacity_factor = float(moe.capacity_factor)
+
+
 def initialize(args: Any = None,
                model: Any = None,
                optimizer: Any = None,
@@ -95,11 +123,25 @@ def initialize(args: Any = None,
         tp = int(cfg.tensor_parallel.autotp_size or 1)
         sp = int(cfg.sequence_parallel.sp_size or 1)
         pp = int(cfg.pipeline.stages or 1)
-        ep = 1
+        ep = int(cfg.moe.expert_parallel_size or 1)
         if mpu is not None and hasattr(mpu, "get_sequence_parallel_world_size"):
             sp = int(mpu.get_sequence_parallel_world_size())
         dp = None
         mics = int(cfg.zero_optimization.mics_shard_size or -1)
+        if mics > 0 and ep > 1:
+            # MiCS repurposes the expert axis as its replica axis — it
+            # cannot coexist with a real expert-parallel degree
+            raise ValueError(
+                f"moe.expert_parallel_size={ep} is incompatible with "
+                f"mics_shard_size={mics}: MiCS uses the expert mesh axis "
+                "as its replica axis; disable one of the two")
+        if ep > 1:
+            total_dp = jax.device_count() // (tp * pp * sp)
+            if total_dp % ep:
+                raise ValueError(
+                    f"moe.expert_parallel_size={ep} must divide the DP "
+                    f"world {total_dp} (= world/(tp·pp·sp))")
+            dp = total_dp // ep
         if mics > 0:
             # MiCS: factor the DP world into (data=shard-group,
             # expert=replica-groups) so the sharder's data-axis-only
@@ -207,6 +249,9 @@ def initialize(args: Any = None,
         from ..telemetry import configure_step_stream
 
         configure_step_stream(enabled=False)
+
+    # --- MoE plane: push the moe.* group onto the model's MOELayer -------
+    _apply_moe_config(cfg, model, mesh)
 
     # --- resolve the model into a loss_fn --------------------------------
     from .pipe.module import PipelineModule  # noqa: avoid cycle at import time

@@ -522,7 +522,10 @@ class ServingFrontend:
                 rep.update_ledger()
             self.metrics.publish(
                 {c: len(q) for c, q in self._queues.items()},
-                self._aggregate_hit_rate())
+                self._aggregate_hit_rate(),
+                moe_imbalance={r.id: imb for r in self.router.replicas
+                               for imb in [r.moe_load_imbalance()]
+                               if imb > 0.0} or None)
             return n
 
     def run_until_idle(self, max_rounds: int = 100_000) -> None:

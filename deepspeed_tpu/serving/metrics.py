@@ -181,14 +181,29 @@ class ServingMetrics:
     # -- export ------------------------------------------------------------
 
     def publish(self, queue_depths: Dict[str, int],
-                prefix_hit_rate: float) -> None:
+                prefix_hit_rate: float,
+                moe_imbalance: Optional[Dict[int, float]] = None) -> None:
         """Push the current numbers as gauges/counters through the
-        telemetry hub (no-op when telemetry is off)."""
+        telemetry hub (no-op when telemetry is off).  ``moe_imbalance``
+        maps replica id → hot-expert imbalance (max/mean expert load) so
+        the autoscaler and dashboards see which replica is routing
+        skewed."""
         from ..telemetry import get_telemetry
 
         tel = get_telemetry()
         if not tel.enabled:
             return
+        if moe_imbalance:
+            for rid, imb in sorted(moe_imbalance.items()):
+                tel.set_gauge(f"serving/replica{rid}_moe_imbalance",
+                              float(imb),
+                              help="max/mean expert load of the replica's "
+                                   "recent decodes (1.0 = balanced)")
+            tel.set_gauge("serving/moe_imbalance_max",
+                          max(float(v) for v in moe_imbalance.values()),
+                          help="worst hot-expert imbalance across "
+                               "replicas — the fleet's routing-skew "
+                               "signal")
         for c in CLASSES:
             tel.set_gauge(f"serving/{c}_ttft_p50_ms",
                           self.ttft[c].percentile(50),

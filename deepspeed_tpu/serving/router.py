@@ -115,6 +115,19 @@ class Replica:
                 + req.remaining_budget
         return total
 
+    def moe_load_imbalance(self) -> float:
+        """Hot-expert signal from the engine: max/mean expert load of
+        its recent decodes (1.0 = balanced router, 0.0 = no MoE data).
+        Both the v2 engine and :class:`~.synthetic.SyntheticEngine`
+        expose the same method; other engines read as 0.0."""
+        fn = getattr(self.engine, "moe_load_imbalance", None)
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn())
+        except Exception:
+            return 0.0
+
     def update_ledger(self) -> None:
         """Refresh this replica's prefix-cache attribution.  Marked
         ``transient``: cached pages live INSIDE the already-registered
@@ -138,6 +151,17 @@ class Replica:
                "healthy": self._dead_reason is None,
                "active_requests": len(self.active),
                "outstanding_tokens": self.outstanding_tokens()}
+        imb = self.moe_load_imbalance()
+        if imb > 0.0:
+            out["moe_load_imbalance"] = imb
+            load = getattr(self.engine, "moe_expert_load", None)
+            if load is None:
+                stats = getattr(self.engine, "last_moe_stats", None) or {}
+                out["moe_expert_load"] = stats.get("load")
+            else:
+                arr = load()
+                out["moe_expert_load"] = (None if arr is None
+                                          else list(map(float, arr)))
         if self._dead_reason:
             out["dead_reason"] = self._dead_reason
         if hasattr(sched, "prefix"):
@@ -153,25 +177,40 @@ class ReplicaRouter:
     replicas."""
 
     def __init__(self, replicas: List[Replica],
-                 affinity_min_tokens: int = 16):
+                 affinity_min_tokens: int = 16,
+                 moe_imbalance_weight: float = 0.25):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
         self.affinity_min_tokens = int(affinity_min_tokens)
+        #: hot-expert penalty: a replica whose recent decodes route
+        #: max/mean = 2x (one expert doing double work — its MoE FLOPs
+        #: are bottlenecked on the hot expert's capacity) scores like it
+        #: carries ``1 + weight`` times its outstanding tokens.  0
+        #: disables MoE-aware placement.
+        self.moe_imbalance_weight = float(moe_imbalance_weight)
 
     def healthy(self) -> List[Replica]:
         return [r for r in self.replicas if r.healthy()]
 
     def route_candidates(self, prompt: List[int]) -> List[Replica]:
         """Healthy replicas in placement order (best first): max prefix
-        affinity, then least outstanding tokens, then stable id."""
+        affinity, then least *effective* load — outstanding tokens
+        inflated by the replica's hot-expert imbalance (a skewed router
+        bottlenecks on its hottest expert, so equal token counts are not
+        equal work on a MoE replica) — then stable id."""
         def score(r: Replica):
             affinity = 0
             if hasattr(r.scheduler, "match_tokens"):
                 m = r.scheduler.match_tokens(prompt)
                 if m >= self.affinity_min_tokens:
                     affinity = m
-            return (-affinity, r.outstanding_tokens(), r.id)
+            load = float(r.outstanding_tokens())
+            imb = r.moe_load_imbalance() if self.moe_imbalance_weight else 0.0
+            if imb > 1.0:
+                load = (load + 1.0) * (
+                    1.0 + self.moe_imbalance_weight * (imb - 1.0))
+            return (-affinity, load, r.id)
 
         return sorted(self.healthy(), key=score)
 

@@ -51,6 +51,16 @@ def synthetic_token(prompt: List[int], index: int) -> int:
     return (seed * 31 + index * 2654435761) % 29000 + 2
 
 
+def synthetic_expert(prompt: List[int], index: int,
+                     num_experts: int) -> int:
+    """Deterministic expert id for a generated token — the synthetic
+    twin of the real gate's argmax routing.  Prompt-dependent (like a
+    real router: different inputs excite different experts) so a
+    replica serving a skewed prompt mix develops genuinely skewed
+    expert load."""
+    return (synthetic_token(prompt, index) * 40503) % num_experts
+
+
 class SyntheticEngine:
     """Drop-in replica engine: real ServingScheduler, no device."""
 
@@ -61,7 +71,8 @@ class SyntheticEngine:
                  clock: Optional[FakeClock] = None,
                  prefill_cost_s: float = 0.004,
                  decode_cost_s: float = 0.002,
-                 step_delay_s: float = 0.0):
+                 step_delay_s: float = 0.0,
+                 num_experts: int = 0):
         self.cache_config = cache_config or KVCacheConfig(
             num_blocks=256, block_size=16, max_seq_len=1024)
         self.scheduler = ServingScheduler(
@@ -77,6 +88,12 @@ class SyntheticEngine:
         #: so chaos tests can kill -9 a replica genuinely mid-stream
         self.step_delay_s = float(step_delay_s)
         self.steps = 0
+        #: synthetic MoE routing (ISSUE 19): when > 0 every decoded token
+        #: is attributed to a deterministic expert, mirroring the real
+        #: engine's per-expert load telemetry — the router placement
+        #: tests exercise hot-expert avoidance without a device
+        self.num_experts = int(num_experts)
+        self.expert_counts = np.zeros(max(self.num_experts, 1), np.int64)
 
     # -- the engine surface the front-end drives ---------------------------
 
@@ -110,6 +127,9 @@ class SyntheticEngine:
                 for t in range(burst):
                     toks[t, req.slot] = synthetic_token(req.prompt,
                                                         base + t)
+                    if self.num_experts > 0:
+                        self.expert_counts[synthetic_expert(
+                            req.prompt, base + t, self.num_experts)] += 1
             n += self.scheduler.decode_burst_done(decode, toks,
                                                   eos_token_id)
             cost += self.decode_cost_s * burst
@@ -117,3 +137,23 @@ class SyntheticEngine:
             self._clock.advance(cost)
         self.steps += 1
         return n
+
+    # -- MoE load surface (mirrors RaggedInferenceEngineV2) -----------------
+
+    def moe_expert_load(self) -> Optional[np.ndarray]:
+        """Per-expert token-load fractions (sum 1) or ``None`` before any
+        routed token / without synthetic experts."""
+        if self.num_experts <= 0:
+            return None
+        total = self.expert_counts.sum()
+        if total <= 0:
+            return None
+        return self.expert_counts / float(total)
+
+    def moe_load_imbalance(self) -> float:
+        """max/mean expert load — 1.0 is a balanced router, 0.0 means no
+        MoE data (same contract as the real v2 engine)."""
+        load = self.moe_expert_load()
+        if load is None:
+            return 0.0
+        return float(load.max() / max(load.mean(), 1e-12))

@@ -86,6 +86,17 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     # ride the step for (nearly) free"; a rise means a probe started
     # forcing a host sync or broke an XLA fusion.
     "numerics_overhead_frac": ("lower", 0.50),
+    # expert-parallel plane (ISSUE 19): the Mixtral proxy trained with
+    # the expert mesh axis > 1.  tokens/sec gates the whole ep pipeline
+    # (sharded experts + sparse dispatch + ZeRO over (expert, data));
+    # dispatch_speedup is the index-form dispatch vs the dense [T,E,C]
+    # einsum on the same routing (sub-1.0 = the crossover auto-dispatch
+    # regressed); drop_rate is the capacity-dropped token fraction at
+    # the bench's fixed capacity factor — a rise means routing skew or
+    # a capacity/padding regression, long before loss curves show it.
+    "moe_ep_tokens_per_sec": ("higher", 0.15),
+    "moe_dispatch_speedup": ("higher", 0.15),
+    "moe_drop_rate": ("lower", 0.25),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -108,6 +119,9 @@ ABS_FLOORS: Dict[str, float] = {
     # ISSUE 18 acceptance ceiling: probe overhead under 5% of step time
     # is sampling noise on a tunneled chip, not a regression
     "numerics_overhead_frac": 0.05,
+    # a top-2 router dropping under 2% of tokens is routing jitter at
+    # the bench's capacity factor, not a capacity regression
+    "moe_drop_rate": 0.02,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

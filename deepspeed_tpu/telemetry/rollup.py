@@ -383,6 +383,8 @@ class MetricsRollup:
                     snap, "numerics/underflow_frac"),
                 "gate_entropy": self._gauge_value(
                     snap, "moe/gate_entropy"),
+                "moe_drop_rate": self._gauge_value(
+                    snap, "moe/drop_rate"),
                 "steps_streamed": st.get("count", 0),
                 "store_outages": self._counter_value(
                     snap, "elasticity/store_outages_total"),

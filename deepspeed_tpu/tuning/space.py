@@ -129,7 +129,9 @@ def default_space(max_micro_batch: int = 16,
                   include_offload: bool = False,
                   include_zero_stage: bool = True,
                   mesh_layouts: Optional[Sequence[str]] = None,
-                  include_kernels: bool = True
+                  include_kernels: bool = True,
+                  include_moe: bool = False,
+                  moe_ep_degrees: Sequence[int] = (1, 2, 4),
                   ) -> CandidateSpace:
     """The stock search space: micro-batch × grad-accumulation × remat ×
     donation (× ZeRO stage, × offload, × mesh layout when asked) × the
@@ -206,4 +208,22 @@ def default_space(max_micro_batch: int = 16,
                         "per-hop latency)",
             feasible=lambda v, cand: cand.get(
                 "kernels.overlap_collectives", False) or v == 4))
+    if include_moe:
+        # the expert-parallel plane (ISSUE 19): ep degree × capacity
+        # slack × dispatch rung.  ep rides the DS config (engine rebuilds
+        # the mesh); capacity factor and dispatch impl are model knobs
+        # (the MoE block is built with the model).
+        space.register(Dimension(
+            "moe.expert_parallel_size", list(moe_ep_degrees),
+            description="expert mesh axis degree (experts sharded "
+                        "ep-ways; ZeRO composes over (expert, data))"))
+        space.register(Dimension(
+            "model.capacity_factor", [1.0, 1.25, 2.0],
+            description="expert capacity slack: FLOPs/memory per step vs "
+                        "token drop rate under routing skew"))
+        space.register(Dimension(
+            "model.moe_dispatch_impl", ["auto", "dense", "sparse"],
+            description="token dispatch rung: fused dense einsum vs "
+                        "index-form gathers (ops/pallas/moe_dispatch.py; "
+                        "'pallas' is picked by auto on unsharded TPU)"))
     return space

@@ -255,6 +255,32 @@ else
   fail=1
 fi
 
+# MoE expert-parallel smoke (ISSUE 19): the dry-run moe bench must
+# train the tiny Mixtral proxy with the expert axis > 1 on the forced
+# 8-device CPU mesh and emit the three gated metrics — expert params
+# verifiably sharded (bytes frac == 1/ep) and ep losses matching ep=1.
+echo "=== moe CLI smoke: bench --dry-run"
+moe_line=$(XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python -m deepspeed_tpu.moe bench --dry-run \
+    2>/dev/null | tail -1)
+if echo "$moe_line" | python -c '
+import json, sys
+
+line = json.loads(sys.stdin.read())
+for key in ("moe_ep_tokens_per_sec", "moe_dispatch_speedup",
+            "moe_drop_rate"):
+    assert key in line, key
+assert line["ep"] > 1, line
+assert abs(line["moe_expert_bytes_frac"] - 1.0 / line["ep"]) < 1e-6, line
+assert abs(line["moe_ep_final_loss"] - line["moe_ep1_final_loss"]) \
+    <= 3e-3 * abs(line["moe_ep1_final_loss"]), line
+'; then
+  echo "=== moe CLI smoke passed"
+else
+  echo "=== moe CLI smoke FAILED"
+  fail=1
+fi
+
 # Front-door CLI smoke (ISSUE 14): `serve --dry-run` must boot the
 # HTTP/SSE front door over synthetic replicas, answer its own health
 # probe, and shut down cleanly — one parseable JSON line, exit 0.

@@ -387,3 +387,44 @@ def test_v2_tp_sharded_serving_matches_meshless():
     # the PAGED KERNEL path executed under TP (shard_map over kv heads),
     # not the einsum fallback (VERDICT r3 item 5)
     assert eng.last_attn_path == "pallas_tp_shard_map"
+
+
+@pytest.mark.slow
+def test_v2_mixtral_decode_exports_expert_load():
+    """ISSUE 19: MoE decode threads per-expert gate stats out of the
+    jitted burst — the router/autoscaler hot-expert signal."""
+    from deepspeed_tpu.models import MixtralConfig, MixtralModel
+
+    cfg = MixtralConfig.tiny(num_layers=2, max_seq_len=64,
+                             dtype=jnp.float32, num_experts=4, top_k=2)
+    model = MixtralModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(2))
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=64, block_size=4,
+                                   max_seq_len=64),
+        max_batch_slots=2, prefill_chunk=8)
+    prompt = np.random.RandomState(6).randint(1, 512, size=8).tolist()
+    eng2.generate([prompt], max_new_tokens=6)
+    stats = eng2.last_moe_stats
+    assert stats is not None
+    load = np.asarray(stats["load"])
+    assert load.shape == (4,)
+    np.testing.assert_allclose(load.sum(), 1.0, atol=1e-4)
+    assert eng2.moe_load_imbalance() >= 1.0
+    assert stats["drop_rate"] >= 0.0
+
+
+@pytest.mark.slow
+def test_v2_llama_has_no_moe_collector(tiny_model):
+    """Dense models: the MoE collector stays off and decode is a no-op
+    on the stats surface."""
+    model, params = tiny_model
+    eng2 = build_engine_v2(
+        model, params,
+        cache_config=KVCacheConfig(num_blocks=32, block_size=4,
+                                   max_seq_len=32),
+        max_batch_slots=2, prefill_chunk=8)
+    eng2.generate([[5, 6, 7]], max_new_tokens=4)
+    assert eng2.last_moe_stats is None
+    assert eng2.moe_load_imbalance() == 0.0

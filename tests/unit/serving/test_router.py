@@ -252,3 +252,67 @@ def test_dead_replica_snapshot_names_reason():
     entry = snap["router"]["replicas"][1]
     assert entry["healthy"] is False
     assert entry["dead_reason"] == "operator drain"
+
+
+def make_moe_cluster(n=2, num_experts=4):
+    clock = FakeClock()
+    cache = KVCacheConfig(num_blocks=256, block_size=16, max_seq_len=512)
+    reps = []
+    for i in range(n):
+        eng = SyntheticEngine(cache, max_batch_slots=4, prefill_chunk=64,
+                              prefill_batch=2, decode_burst=4, clock=clock,
+                              num_experts=num_experts)
+        reps.append(Replica(eng, i))
+    fe = ServingFrontend(reps, params=ServingParams(), clock=clock)
+    return fe, reps, clock
+
+
+def test_moe_hot_expert_steers_placement():
+    """ISSUE 19 acceptance: a replica whose engine reports hot experts
+    loses new placements to a balanced one at equal outstanding load."""
+    fe, reps, _ = make_moe_cluster(n=2)
+    # replica 0 funnels everything to one expert; replica 1 is balanced
+    reps[0].engine.expert_counts[:] = [100, 0, 0, 0]
+    reps[1].engine.expert_counts[:] = [25, 25, 25, 25]
+    assert reps[0].moe_load_imbalance() == pytest.approx(4.0)
+    assert reps[1].moe_load_imbalance() == pytest.approx(1.0)
+    # no prefix affinity, equal (zero) outstanding: without the MoE
+    # signal the tiebreak would prefer replica 0 (lowest id)
+    order = [r.id for r in fe.router.route_candidates([9, 9, 9])]
+    assert order[0] == 1
+    # the placement-score signal is surfaced in the snapshot
+    snap = reps[0].snapshot()
+    assert snap["moe_load_imbalance"] == pytest.approx(4.0)
+    np.testing.assert_allclose(snap["moe_expert_load"], [1.0, 0, 0, 0])
+
+
+def test_moe_imbalance_weight_zero_disables_signal():
+    fe, reps, _ = make_moe_cluster(n=2)
+    fe.router.moe_imbalance_weight = 0.0
+    reps[0].engine.expert_counts[:] = [100, 0, 0, 0]
+    reps[1].engine.expert_counts[:] = [25, 25, 25, 25]
+    order = [r.id for r in fe.router.route_candidates([9, 9, 9])]
+    assert order[0] == 0  # back to pure load + id tiebreak
+
+
+def test_synthetic_engine_tracks_expert_counts_during_decode():
+    fe, reps, _ = make_moe_cluster(n=1)
+    fe.submit([5, 6, 7] * 8, max_new_tokens=8)
+    fe.run_until_idle()
+    eng = reps[0].engine
+    assert eng.expert_counts.sum() > 0
+    load = eng.moe_expert_load()
+    assert load is not None and np.isclose(load.sum(), 1.0)
+    assert eng.moe_load_imbalance() >= 1.0
+    # same prompt replayed deterministically hits the same experts
+    counts = eng.expert_counts.copy()
+    fe.submit([5, 6, 7] * 8, max_new_tokens=8)
+    fe.run_until_idle()
+    assert (eng.expert_counts - counts).sum() > 0
+
+
+def test_non_moe_engine_reads_as_balanced():
+    fe, reps, _ = make_cluster(n=1)  # num_experts=0
+    assert reps[0].moe_load_imbalance() == 0.0
+    assert reps[0].engine.moe_expert_load() is None
+    assert "moe_load_imbalance" not in reps[0].snapshot()
