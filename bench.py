@@ -1847,6 +1847,69 @@ def _main() -> None:
         free_hbm()
         extras.setdefault("variants", {})["numerics_error"] = str(e)[:200]
 
+    _mark("profiler")
+    # -- variant: fleet profiler duty-cycle overhead -----------------------
+    # ISSUE 20's continuous mode ("always-on capture with a bounded
+    # overhead budget") only earns its keep if the budget holds: the same
+    # fenced step loop timed with the duty-cycled ProfilerPlane arming
+    # real jax.profiler windows (capture + parse + census + calibration)
+    # vs with no plane at all.  profiler_overhead_pct is sentinel-gated
+    # (lower, 5pt abs floor).
+    try:
+        _budget_check()
+        import shutil as _sh
+        import tempfile as _tmp
+
+        from deepspeed_tpu.telemetry.profiler import ProfilerPlane
+        from deepspeed_tpu.telemetry.profiler.calibration import (
+            default_calibration_path, get_calibration_store)
+
+        PH, PB = 512, 256
+        rs = np.random.RandomState(7)
+        pw = jnp.asarray(rs.randn(PH, PH) * 0.05).astype(jnp.bfloat16)
+        px = jnp.asarray(rs.randn(PB, PH)).astype(jnp.bfloat16)
+        pfn = jax.jit(lambda w, x: jnp.sum(jnp.square(
+            jnp.tanh(x @ w).astype(jnp.float32))))
+        float(pfn(pw, px))  # warm the compile out of both timings
+
+        def _ptime(plane, iters=60):
+            t0 = time.perf_counter()
+            out = None
+            for i in range(iters):
+                if plane is not None:
+                    plane.on_step(i)
+                out = pfn(pw, px)
+            jax.block_until_ready(out)
+            if plane is not None:
+                plane.on_step(iters)  # close a still-open window
+            return time.perf_counter() - t0
+
+        t_off = min(_ptime(None), _ptime(None))
+        pdir = _tmp.mkdtemp(prefix="bench_profiler_")
+        # duty captures calibrate too — point the factor store at a
+        # throwaway so the bench doesn't pollute the user's cache
+        get_calibration_store(os.path.join(pdir, "calibration.json"))
+        plane = ProfilerPlane("bench-duty", out_dir=pdir, ring=2,
+                              duty_cycle_pct=10.0, duty_period_steps=20)
+        plane.enable_duty_cycle()
+        t_on = min(_ptime(plane), _ptime(plane))
+        pct = max(0.0, (t_on - t_off) / max(t_off, 1e-9) * 100.0)
+        extras["profiler_overhead_pct"] = round(pct, 2)
+        extras.setdefault("variants", {})["profiler"] = {
+            "base_s_per_60": round(t_off, 5),
+            "duty_s_per_60": round(t_on, 5),
+            "overhead_pct": round(pct, 2),
+            "captures": plane._captures,
+            "duty_cycle_pct": plane.duty_cycle_pct,
+        }
+        get_calibration_store(default_calibration_path())
+        _sh.rmtree(pdir, ignore_errors=True)
+        del pw, px
+        free_hbm()
+    except Exception as e:
+        free_hbm()
+        extras.setdefault("variants", {})["profiler_error"] = str(e)[:200]
+
     _mark("tunnel")
     # -- tunnel characterization ------------------------------------------
     # On this axon setup the chip sits behind a network tunnel.  Measured

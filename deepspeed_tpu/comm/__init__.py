@@ -2,15 +2,15 @@ from .comm import (ReduceOp, all_gather, all_gather_in_graph,
                    all_gather_into_tensor, all_reduce, all_to_all_in_graph,
                    all_to_all_single, axis_index, barrier, broadcast,
                    comms_logger, get_local_rank, get_rank, get_world_size,
-                   init_distributed, is_initialized, new_group, pmax, pmean,
-                   ppermute, psum, reduce_scatter, reduce_scatter_in_graph,
-                   reduce_scatter_tensor)
+                   init_distributed, is_initialized, monitored_barrier,
+                   new_group, pmax, pmean, ppermute, psum, reduce_scatter,
+                   reduce_scatter_in_graph, reduce_scatter_tensor)
 
 __all__ = [
     "ReduceOp", "all_gather", "all_gather_in_graph", "all_gather_into_tensor",
     "all_reduce", "all_to_all_in_graph", "all_to_all_single", "axis_index",
     "barrier", "broadcast", "comms_logger", "get_local_rank", "get_rank",
-    "get_world_size", "init_distributed", "is_initialized", "new_group",
-    "pmax", "pmean", "ppermute", "psum", "reduce_scatter",
-    "reduce_scatter_in_graph", "reduce_scatter_tensor",
+    "get_world_size", "init_distributed", "is_initialized",
+    "monitored_barrier", "new_group", "pmax", "pmean", "ppermute", "psum",
+    "reduce_scatter", "reduce_scatter_in_graph", "reduce_scatter_tensor",
 ]

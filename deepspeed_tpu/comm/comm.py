@@ -30,8 +30,9 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -466,6 +467,141 @@ def barrier(group=None) -> None:
         multihost_utils.sync_global_devices("deepspeed_tpu.comm.barrier")
     else:
         jax.effects_barrier()
+
+
+#: per-tag monotonic round counters for monitored_barrier (each call on
+#: the same tag is a fresh store key, so re-used tags never cross-talk)
+_mon_barrier_seq: Dict[str, int] = {}
+_mon_barrier_lock = threading.Lock()
+
+#: the last monitored_barrier timeout, registered as flight-recorder
+#: context ``monitored_barrier`` on first failure — the watchdog's hang
+#: bundle then NAMES the ranks that never arrived
+_mon_barrier_failure: Optional[Dict[str, Any]] = None
+
+
+def _note_barrier_failure(doc: Dict[str, Any]) -> None:
+    global _mon_barrier_failure
+    first = _mon_barrier_failure is None
+    _mon_barrier_failure = doc
+    if first:
+        try:
+            from ..telemetry.flight_recorder import get_flight_recorder
+
+            get_flight_recorder().register_context(
+                "monitored_barrier", lambda: _mon_barrier_failure)
+        except Exception as e:
+            from ..utils.logging import debug_once
+
+            debug_once("comm/mon_barrier_fr",
+                       f"flight-recorder barrier context failed ({e!r})")
+
+
+def monitored_barrier(group=None, timeout: float = 30.0,
+                      tag: str = "default",
+                      world: Optional[int] = None,
+                      rank: Optional[int] = None,
+                      store: Optional[Any] = None) -> None:
+    """Barrier that, on timeout, NAMES the ranks that failed to arrive.
+
+    The reference ``monitored_barrier`` is the debugging barrier: a hang
+    inside a plain barrier says nothing; this one raises with the exact
+    missing rank set.  With a rendezvous store (``store`` arg or
+    ``DS_RDZV_ENDPOINT``), every rank appends its id under a per-round
+    key and polls until all ``world`` ranks arrived — the timeout error
+    lists whoever didn't make it, the collective ledger records the
+    round either way, and the failure doc rides the watchdog's next
+    flight-recorder bundle as context ``monitored_barrier``.  Without a
+    store, multi-process falls back to ``sync_global_devices`` under a
+    watchdog thread (a timeout is still detected, but the missing set is
+    unknowable).  ``world``/``rank`` override process discovery for
+    tests and out-of-band gangs."""
+    world = int(world if world is not None else jax.process_count())
+    rank = int(rank if rank is not None else jax.process_index())
+    with _mon_barrier_lock:
+        seq = _mon_barrier_seq.get(tag, 0) + 1
+        _mon_barrier_seq[tag] = seq
+
+    def _ledger(op: str) -> None:
+        try:
+            from ..telemetry.collective_ledger import get_collective_ledger
+
+            get_collective_ledger().record(op, 0, source="barrier")
+        except Exception as e:
+            from ..utils.logging import debug_once
+
+            debug_once("comm/mon_barrier_ledger",
+                       f"barrier ledger record failed ({e!r})")
+
+    if world <= 1 and store is None:
+        jax.effects_barrier()
+        _ledger(f"monitored_barrier:{tag}#{seq}")
+        return
+
+    if store is None:
+        endpoint = os.environ.get("DS_RDZV_ENDPOINT")
+        if endpoint:
+            from ..elasticity.rendezvous import RendezvousClient
+
+            store = RendezvousClient(endpoint)
+
+    if store is not None:
+        key = f"barrier/{tag}/{seq}"
+        arrived = set(int(r) for r in store.append(key, rank))
+        deadline = time.monotonic() + float(timeout)
+        while len(arrived) < world and time.monotonic() < deadline:
+            time.sleep(min(0.05, timeout / 20.0))
+            got = store.get(key)
+            if isinstance(got, list):
+                arrived = set(int(r) for r in got)
+        if len(arrived) >= world:
+            _ledger(f"monitored_barrier:{tag}#{seq}")
+            return
+        missing = sorted(set(range(world)) - arrived)
+        doc = {"tag": tag, "round": seq, "timeout_s": float(timeout),
+               "world": world, "rank": rank,
+               "arrived": sorted(arrived), "missing": missing,
+               "ts": time.time()}
+        _note_barrier_failure(doc)
+        _ledger(f"monitored_barrier_timeout:{tag}#{seq}:"
+                f"missing={','.join(map(str, missing))}")
+        raise RuntimeError(
+            f"monitored_barrier({tag!r} round {seq}) timed out after "
+            f"{timeout}s: ranks {missing} never arrived "
+            f"({len(arrived)}/{world} present)")
+
+    # no store: the arrival set is unknowable — run the device barrier
+    # under a watchdog thread so a hang still becomes a named timeout
+    from jax.experimental import multihost_utils
+
+    done = threading.Event()
+    err: List[BaseException] = []
+
+    def _sync() -> None:
+        try:
+            multihost_utils.sync_global_devices(
+                f"deepspeed_tpu.comm.monitored_barrier:{tag}#{seq}")
+        except BaseException as e:  # surfaced on the caller thread
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_sync, daemon=True,
+                         name=f"ds-monitored-barrier-{tag}")
+    t.start()
+    if not done.wait(float(timeout)):
+        doc = {"tag": tag, "round": seq, "timeout_s": float(timeout),
+               "world": world, "rank": rank, "arrived": None,
+               "missing": None, "ts": time.time()}
+        _note_barrier_failure(doc)
+        _ledger(f"monitored_barrier_timeout:{tag}#{seq}:missing=unknown")
+        raise RuntimeError(
+            f"monitored_barrier({tag!r} round {seq}) timed out after "
+            f"{timeout}s (no rendezvous store — set DS_RDZV_ENDPOINT "
+            f"to learn WHICH ranks were missing)")
+    if err:
+        raise err[0]
+    _ledger(f"monitored_barrier:{tag}#{seq}")
 
 
 # ---------------------------------------------------------------------------

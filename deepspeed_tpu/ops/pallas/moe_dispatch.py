@@ -52,6 +52,25 @@ EMPTY_SLOT = -1
 #: is small enough that XLA's fused einsum beats gather bookkeeping)
 DENSE_CROSSOVER_TEC = 1 << 16
 
+#: fleet-profiler calibration multiplier on the crossover (ISSUE 20):
+#: a measured compute factor > 1 means the device runs the dense einsum
+#: slower than modeled, so the sparse path wins earlier (scale < 1)
+_CROSSOVER_SCALE = 1.0
+
+
+def set_crossover_scale(scale: float) -> None:
+    """Scale the measured-once dense/sparse crossover by a calibration
+    factor (``tuning.space.apply_calibration`` drives this from the
+    persisted fleet-profiler factors).  Clamped to [0.25, 4] — a wild
+    capture must not flip every dispatch decision."""
+    global _CROSSOVER_SCALE
+    _CROSSOVER_SCALE = min(max(float(scale), 0.25), 4.0)
+
+
+def dense_crossover_tec() -> int:
+    """The calibrated T·E·C crossover the auto impl compares against."""
+    return max(int(DENSE_CROSSOVER_TEC * _CROSSOVER_SCALE), 1)
+
 #: pallas combine tiles tokens in blocks of this many rows
 _COMBINE_BLOCK_T = 128
 
@@ -340,7 +359,7 @@ def choose_dispatch_impl(impl: str, num_tokens: int, num_experts: int,
         if impl == "pallas" and sharded:
             return "sparse"
         return impl
-    if num_tokens * num_experts * capacity <= DENSE_CROSSOVER_TEC:
+    if num_tokens * num_experts * capacity <= dense_crossover_tec():
         return "dense"
     if sharded or jax.default_backend() != "tpu":
         return "sparse"

@@ -22,6 +22,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import tempfile
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -104,6 +105,58 @@ def shared_trace_session(trace_dir: Optional[str] = None):
                     f"shared trace session: close hook failed ({e!r})")
 
 
+def begin_shared_session(trace_dir: Optional[str] = None) -> Optional[str]:
+    """Open the shared profiler session WITHOUT a context manager — the
+    fleet profiler plane arms at one train step and disarms N steps
+    later, so the open and the close live in different calls.
+
+    Returns the trace output dir when THIS caller became the owner, or
+    ``None`` when a session is already open (the caller must not close
+    it — re-arm after the owner finishes instead).  Pair every non-None
+    return with :func:`end_shared_session`."""
+    global _active_session
+    with _session_lock:
+        if _active_session is not None:
+            return None
+        tmp = trace_dir or tempfile.mkdtemp(prefix="ds_fleet_trace_")
+        _active_session = {"dir": tmp, "post": []}
+    try:
+        jax.profiler.start_trace(tmp)
+    except Exception:
+        with _session_lock:
+            _active_session = None
+        raise
+    return tmp
+
+
+def end_shared_session() -> Optional[str]:
+    """Close a session opened with :func:`begin_shared_session`: stop the
+    profiler, run the registered close hooks (trace files are on disk),
+    and return the trace dir — or ``None`` when no session was open."""
+    global _active_session
+    with _session_lock:
+        if _active_session is None:
+            return None
+        tmp = _active_session["dir"]
+        posts = list(_active_session["post"])
+        _active_session = None
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        for fn in posts:
+            try:
+                fn(tmp)
+            except Exception as e:  # a post-hook must not mask the trace
+                logger.warning(
+                    f"shared trace session: close hook failed ({e!r})")
+    return tmp
+
+
+#: XLA HLO instruction names: lowercase identifier, optional dashes and
+#: dotted suffixes — nothing host-side matches this shape
+_HLO_NAME_RE = re.compile(r"[a-z][a-z0-9_.\-]*")
+
+
 def parse_trace_events(trace_dir: str,
                        patterns: Optional[Sequence[str]]
                        = COLLECTIVE_PATTERNS
@@ -131,9 +184,6 @@ def parse_trace_events(trace_dir: str,
         lanes = {e["pid"]: e.get("args", {}).get("name", "")
                  for e in events
                  if e.get("ph") == "M" and e.get("name") == "process_name"}
-        threads = {(e["pid"], e.get("tid")): e.get("args", {}).get("name", "")
-                   for e in events
-                   if e.get("ph") == "M" and e.get("name") == "thread_name"}
         for e in events:
             if e.get("ph") != "X":
                 continue
@@ -143,15 +193,21 @@ def parse_trace_events(trace_dir: str,
             if not (lane.startswith("/device")
                     or lane.startswith("/host:CPU")):
                 continue
-            # the CPU tracer folds python frames into the '/host:CPU'
-            # process and marks them only by thread name — XLA ops run
-            # on the client threads, python frames on 'python'
-            if threads.get((e.get("pid"), e.get("tid"))) == "python":
-                continue
             name = e.get("name", "")
             low = name.lower()
             if low.startswith("end:") or name.startswith("$"):
                 continue  # CPU tracer end markers / python source refs
+            # the CPU tracer folds host-side spans into the '/host:CPU'
+            # process — on SOME builds onto the very thread the XLA
+            # thunks report on, so thread names can't separate them.
+            # Shape does: XLA thunk names are lowercase HLO identifiers
+            # ('dot.4', 'multiply_add_fusion', 'all-reduce.3') while
+            # host spans carry call syntax or CamelCase
+            # ('PjitFunction(jit(f))', 'TfrtCpuExecutable::Execute',
+            # 'np.asarray(jax.Array)')
+            if lane.startswith("/host:CPU") \
+                    and not _HLO_NAME_RE.fullmatch(name):
+                continue
             if patterns is None or any(p in low for p in patterns):
                 out.append({"ts_us": float(e.get("ts", 0.0)),
                             "dur_us": float(e.get("dur", 0.0)),

@@ -376,6 +376,31 @@ class TelemetryPerfConfig(DeepSpeedConfigModel):
     anatomy_top_k: int = 5
 
 
+class TelemetryProfilerConfig(DeepSpeedConfigModel):
+    """``telemetry.profiler`` — the fleet-synchronized profiler capture
+    plane (``telemetry/profiler/``): each worker polls the rendezvous
+    store for ``telemetry profile`` capture commands, arms
+    ``jax.profiler`` for the agreed step-index window, publishes its
+    measured device lanes + calibration report back through the store,
+    and (optionally) runs a duty-cycled continuous capture.  When
+    disabled the train step never sees the plane — same jaxpr, zero
+    recompiles."""
+
+    enabled: bool = True
+    #: bounded ring of on-disk trace dirs per worker (oldest evicted)
+    ring: int = 4
+    #: steps of arming lead when proposing the shared capture window
+    lead: int = 3
+    #: duty-cycle continuous capture: percent of each period spent
+    #: tracing (0 disables); capture time is booked to the goodput
+    #: ``profiler`` bucket
+    duty_cycle_pct: float = 0.0
+    #: steps per duty-cycle period
+    duty_period_steps: int = 64
+    #: trace-dir ring location (default: a tmpdir per process)
+    out_dir: str = ""
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``telemetry`` config group — the unified telemetry subsystem
     (``deepspeed_tpu/telemetry/``): span tracer + metrics registry +
@@ -415,6 +440,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
         default_factory=TelemetryMemoryConfig)
     numerics: TelemetryNumericsConfig = Field(
         default_factory=TelemetryNumericsConfig)
+    profiler: TelemetryProfilerConfig = Field(
+        default_factory=TelemetryProfilerConfig)
 
 
 class ServingTracingConfig(DeepSpeedConfigModel):

@@ -478,6 +478,21 @@ class DeepSpeedEngine:
                     tracker=self.compile_tracker,
                     recorder=self.flight_recorder)
 
+        # --- fleet profiler capture plane (telemetry/profiler — ISSUE 20) --
+        # the plane is installed (or not) by initialize()/the serving
+        # worker; the engine only holds the reference so train_step can
+        # feed the step index (two attribute reads when no window is
+        # armed) and stamps its anatomy site for the calibration join
+        self._profiler_plane = None
+        if tcfg.enabled and tcfg.profiler.enabled:
+            from ..telemetry.profiler import get_profiler_plane
+
+            self._profiler_plane = get_profiler_plane()
+            if self._profiler_plane is not None:
+                self._profiler_plane.site = self._anatomy_site()
+                if tcfg.profiler.duty_cycle_pct > 0.0:
+                    self._profiler_plane.enable_duty_cycle()
+
         # --- memory observability plane (telemetry/memory — ISSUE 7) -----
         # per-pool byte ledger fed by the allocation sites below
         # (_init_state placement, offload, swappers, KV pool, snapshots),
@@ -1750,6 +1765,11 @@ class DeepSpeedEngine:
         process's local rows (see :meth:`_feed_batch`)."""
         self.tput_timer.start()
         t_step0 = time.perf_counter()
+        plane = self._profiler_plane
+        if plane is not None:
+            # fleet profiler window arm/disarm (ISSUE 20) — outside the
+            # jitted program; two attribute reads when nothing is armed
+            plane.on_step(self.global_steps)
         batch = self._feed_batch(batch)
         if self.snapshots is not None and self.snapshots.snapshots_taken == 0:
             # step-0 baseline: a failure inside the FIRST snapshot

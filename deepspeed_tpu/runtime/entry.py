@@ -243,6 +243,23 @@ def initialize(args: Any = None,
             enabled=(cfg.telemetry.aggregation.metrics_rollup
                      and cfg.telemetry.aggregation.step_stream),
             maxlen=cfg.telemetry.aggregation.step_stream_len)
+        # fleet-synchronized profiler capture plane (telemetry/profiler):
+        # the publisher tick polls the store for `telemetry profile`
+        # commands, the engine feeds on_step, the window's device lanes
+        # publish back through the store
+        pcfg = cfg.telemetry.profiler
+        if pcfg.enabled:
+            from ..telemetry.profiler import configure_profiler_plane
+
+            plane = configure_profiler_plane(
+                node_id=os.environ.get("DS_ELASTIC_NODE_ID",
+                                       f"node-{os.getpid()}"),
+                out_dir=pcfg.out_dir or None,
+                ring=pcfg.ring, lead=pcfg.lead,
+                duty_cycle_pct=pcfg.duty_cycle_pct,
+                duty_period_steps=pcfg.duty_period_steps)
+            if recorder is not None:
+                plane.register_bundle_context(recorder)
     else:
         # a previous initialize() may have enabled the stream — this
         # engine's config says no aggregation, so stop buffering

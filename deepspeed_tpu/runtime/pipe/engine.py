@@ -68,7 +68,17 @@ class PipelineEngine(DeepSpeedEngine):
 
         if pp > 1:
             # REAL pipeline execution: partition the spec chain into pp
-            # stage fns and run the ppermute fill/drain schedule
+            # stage fns and run the ppermute fill/drain schedule.
+            # Heterogeneous stage chains have no 1F1B here (PARITY:
+            # future work) — GPipe-through-autodiff computes identical
+            # gradients at a larger activation footprint.
+            from ...utils.logging import logger
+
+            logger.info(
+                f"pipeline engine: pp={pp} heterogeneous stage chain "
+                f"takes the GPipe fill/drain schedule (identical "
+                f"gradients to 1F1B; larger activation footprint — "
+                f"heterogeneous-stage 1F1B is future work)")
             from ...parallel.pipeline import pipeline_apply_stages
 
             bounds = module.stage_bounds(pp)

@@ -144,6 +144,62 @@ class _DoorHandler(BaseHTTPRequestHandler):
                   peer=(self.client_address[0]
                         if self.client_address else None))
 
+    # -- admin: fleet profiler capture (ISSUE 20) ----------------------------
+
+    def _handle_debug_profile(self, t0: float) -> None:
+        """``POST /debug/profile`` — the serving fleet's capture trigger.
+        Body (all optional): ``{"duration_ms": 250, "steps": 4,
+        "mode": "duration"}``.  Answers with the request id; lanes are
+        collected with ``telemetry profile`` (or ``profile report``)
+        against the same store."""
+        door = self._door()
+        if not door.store_endpoint:
+            self._send_json(503, {
+                "error": "no rendezvous store — the door was started "
+                         "without store_endpoint, so there is no command "
+                         "channel to the workers"})
+            self._log_access(503, close="no_store", t0=t0)
+            return
+        body: Dict[str, Any] = {}
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length > 0:
+                body = json.loads(self.rfile.read(length))
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            self.close_connection = True
+            self._send_json(400, {"error": f"malformed body: {e}"},
+                            headers={"Connection": "close"})
+            self._log_access(400, close="validation", t0=t0)
+            return
+        try:
+            from ..elasticity.rendezvous import RendezvousClient
+            from ..telemetry.profiler import post_capture_command
+
+            client = RendezvousClient(door.store_endpoint)
+            req = post_capture_command(
+                client,
+                steps=int(body.get("steps", 4)),
+                lead=int(body.get("lead", 3)),
+                mode=str(body.get("mode", "duration")),
+                duration_ms=float(body.get("duration_ms", 250.0)))
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            self._log_access(400, close="validation", t0=t0)
+            return
+        except (ConnectionError, OSError) as e:
+            self._send_json(503, {
+                "error": f"rendezvous store unreachable: {e}"})
+            self._log_access(503, close="store_down", t0=t0)
+            return
+        self._send_json(202, {
+            "req": req,
+            "mode": str(body.get("mode", "duration")),
+            "hint": f"collect with: telemetry profile capture "
+                    f"--endpoint {door.store_endpoint}"})
+        self._log_access(202, t0=t0)
+
     # -- GET -----------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802 (http.server convention)
@@ -178,6 +234,13 @@ class _DoorHandler(BaseHTTPRequestHandler):
         # traceable, and the id is echoed on every reply either way
         self._trace_id = (sanitize_trace_id(self.headers.get(TRACE_HEADER))
                           or mint_trace_id())
+        if self.path == "/debug/profile":
+            # fleet profiler capture (ISSUE 20): post a capture command
+            # through the rendezvous store — every serving worker's beat
+            # loop arms a duration-mode jax.profiler window and publishes
+            # its decode-burst device lanes back
+            self._handle_debug_profile(t0)
+            return
         if self.path != "/v1/generate":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             self._log_access(404, close="bad_path", t0=t0)

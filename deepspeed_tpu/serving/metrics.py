@@ -566,6 +566,12 @@ class RequestLog:
         with self._lock:
             return len(self._open)
 
+    def open_records(self) -> List["RequestRecord"]:
+        """The live (uncommitted) records — the profiler fold hook
+        stamps a captured decode-burst's device time onto these."""
+        with self._lock:
+            return list(self._open.values())
+
     def find(self, trace_id: str) -> List[Dict[str, Any]]:
         """Committed + open records for one trace id (exact match)."""
         tid = str(trace_id)

@@ -180,6 +180,23 @@ class ServingWorker:
                         {"endpoint": self.endpoint, "role": self.role,
                          "pid": os.getpid()}, journal=True)
         self._store.hb(f"rdzv/hb/{self.id}")
+        # fleet profiler plane (ISSUE 20): the beat loop polls the
+        # store's capture-command channel; a duration-mode capture runs
+        # right on the beat thread (the profiler traces the whole
+        # process, so decode bursts on the serving threads land in the
+        # window) and its measured device time folds into the open
+        # request lifecycle records
+        self._profiler_plane = None
+        try:
+            from ..telemetry.profiler import configure_profiler_plane
+
+            self._profiler_plane = configure_profiler_plane(
+                node_id=self.id)
+            self._profiler_plane.add_fold_hook(self._fold_capture)
+            self._profiler_plane.register_bundle_context()
+        except Exception as e:
+            warn_once("serving/worker-profiler",
+                      f"profiler plane unavailable ({e!r})")
         self._hb_thread = threading.Thread(
             target=self._beat_loop, args=(push_every_s,), daemon=True,
             name=f"ds-serving-worker-hb-{self.id}")
@@ -222,9 +239,27 @@ class ServingWorker:
                              "heartbeat interval")
                     last_tokens, last_mono = toks, now
                 push_node_telemetry(self._store, self.id)
+                if self._profiler_plane is not None:
+                    self._profiler_plane.poll(self._store)
             except Exception as e:  # store down: degraded, retry
                 warn_once("serving/worker-hb",
                           f"worker heartbeat degraded ({e!r})")
+
+    def _fold_capture(self, doc: Dict[str, Any]) -> None:
+        """Profiler fold hook: a finished capture's measured device time
+        lands as a ``profiler_device`` phase on every request that was
+        open during the burst — the PR-15 lifecycle record then shows
+        the decode burst's DEVICE milliseconds next to its host phases."""
+        from .tracing import get_request_log
+
+        census = doc.get("census") or {}
+        dev_ms = float(census.get("device_total_us", 0.0)) / 1e3
+        for rec in get_request_log().open_records():
+            rec.phase("profiler_device", dur_ms=dev_ms,
+                      req=int(doc.get("req", 0)),
+                      device_kind=str(doc.get("device_kind", "")),
+                      window_ms=round(
+                          float(doc.get("window_s", 0.0)) * 1e3, 3))
 
     def shutdown(self) -> None:
         self._hb_stop.set()

@@ -390,6 +390,14 @@ class BundlePublisher:
                 # beat flushes it exactly once (the rollup dedups by
                 # sequence).
                 self._maybe_push_telemetry(client)
+                # fleet profiler command channel (ISSUE 20): the same
+                # beat that answers collect requests arms/publishes
+                # capture windows — no new threads, same degraded path
+                from .profiler import get_profiler_plane
+
+                plane = get_profiler_plane()
+                if plane is not None:
+                    plane.poll(client)
                 req = int(client.get(_REQ_KEY) or 0)
                 rec = self.recorder()
                 if req > self._last_req_served:
@@ -939,11 +947,23 @@ def build_cluster_trace(archive: str, persist: bool = True
         except (OSError, ValueError) as e:
             logger.warning(f"aggregator: unreadable {CLUSTER_REQUESTS} "
                            f"({e!r}); request lanes skipped")
-    if not lanes and not req_docs:
+    # fleet profiler device lanes (ISSUE 20): per-node capture
+    # publications persisted under ``profiles/<node>/device_events.json``
+    # — MEASURED device-op spans, anchored to the store clock at capture
+    # start, merged as their own pid lanes next to the host spans
+    from .profiler.fleet import load_profiles
+
+    profiles = load_profiles(archive)
+    if not lanes and not req_docs and not profiles:
         return None
     aligned_starts = [ev["ts"] + lane["offset_us"]
                       for lane in lanes.values() if lane["aligned"]
                       for ev in lane["events"]]
+    for doc in profiles.values():
+        clock = doc.get("clock") or {}
+        if clock.get("aligned") and isinstance(clock.get("store_t0_s"),
+                                               (int, float)):
+            aligned_starts.append(float(clock["store_t0_s"]) * 1e6)
     for doc in req_docs.values():
         clock = doc.get("clock") or {}
         if clock.get("synced") and isinstance(clock.get("offset_s"),
@@ -976,10 +996,10 @@ def build_cluster_trace(archive: str, persist: bool = True
             "events": len(lane["events"]),
             "clock_sync": lane["clock_sync"],
         }
+    next_pid = len(lanes)
     if req_docs:
         from ..serving.tracing import request_trace_events
 
-        next_pid = len(lanes)
         for node in sorted(req_docs):
             evs, aligned = request_trace_events(
                 node, req_docs[node], next_pid, base_us=base_us)
@@ -988,6 +1008,44 @@ def build_cluster_trace(archive: str, persist: bool = True
                 "pid": next_pid, "aligned": aligned,
                 "events": len(evs) - 1, "requests": True}
             next_pid += 1
+    for node in sorted(profiles):
+        doc = profiles[node]
+        clock = doc.get("clock") or {}
+        aligned = bool(clock.get("aligned")
+                       and isinstance(clock.get("store_t0_s"),
+                                      (int, float)))
+        events = [e for e in (doc.get("events") or [])
+                  if isinstance(e, dict)
+                  and isinstance(e.get("ts_us"), (int, float))]
+        out_events.append({
+            "ph": "M", "name": "process_name", "pid": next_pid,
+            "args": {"name": f"{node} (device)"
+                     + ("" if aligned else " (unaligned)")}})
+        lane_names = sorted({str(e.get("lane", "")) for e in events})
+        tids = {ln: i for i, ln in enumerate(lane_names)}
+        for ln, tid in tids.items():
+            out_events.append({"ph": "M", "name": "thread_name",
+                               "pid": next_pid, "tid": tid,
+                               "args": {"name": ln or "device"}})
+        lane_min = min((float(e["ts_us"]) for e in events), default=0.0)
+        # the profiler trace's timestamps are session-local: pin the
+        # lane's first event at the capture's store-clock anchor, keep
+        # intra-lane offsets exact
+        anchor_us = (float(clock["store_t0_s"]) * 1e6 - base_us
+                     if aligned else 0.0)
+        for e in events:
+            out_events.append({
+                "ph": "X", "name": str(e.get("name", "?")),
+                "pid": next_pid, "tid": tids.get(str(e.get("lane", "")), 0),
+                "ts": round(float(e["ts_us"]) - lane_min + anchor_us, 1),
+                "dur": round(float(e.get("dur_us", 0.0)), 1),
+                "cat": "device"})
+        hosts_meta[f"{node} (device)"] = {
+            "pid": next_pid, "aligned": aligned,
+            "events": len(events), "device": True,
+            "device_kind": doc.get("device_kind"),
+            "req": doc.get("req"), "clock": clock or None}
+        next_pid += 1
     doc = {"traceEvents": out_events,
            "displayTimeUnit": "ms",
            "metadata": {"source": "deepspeed_tpu.telemetry.aggregator",

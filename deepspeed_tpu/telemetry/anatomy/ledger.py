@@ -174,6 +174,20 @@ class CostLedger:
             "provenance": provenance,
             "peak": peak.to_dict(),
         }
+        # profiler-plane calibration (ISSUE 20): when a fleet capture
+        # has measured this device kind, ground the analytic prediction
+        # in the persisted measured/modeled factors.  compute and hbm
+        # share a factor — the trace cannot split them per-op.
+        from ..profiler.calibration import calibration_scale
+
+        f_comp = calibration_scale(peak.kind, "compute")
+        f_comm = calibration_scale(peak.kind, "collective")
+        if f_comp != 1.0 or f_comm != 1.0:
+            cal_s = max(t_compute * f_comp, t_hbm * f_comp,
+                        t_comm * f_comm)
+            entry["calibrated_us"] = round(cal_s * 1e6, 3)
+            entry["calibration"] = {"compute": round(f_comp, 4),
+                                    "collective": round(f_comm, 4)}
         with self._lock:
             self._entries[f"{site}#{int(program)}"] = entry
         return entry
@@ -221,9 +235,14 @@ class CostLedger:
         positive means unexplained stall time.  None when the site is
         unknown or either time is non-positive."""
         e = self.entry_for(site, program)
-        if not e or measured_us <= 0 or e["predicted_us"] <= 0:
+        if not e or measured_us <= 0:
             return None
-        return round(1.0 - min(e["predicted_us"] / measured_us, 1.0), 4)
+        # the measurement-grounded prediction wins once a fleet capture
+        # has calibrated this device kind
+        predicted = float(e.get("calibrated_us") or e["predicted_us"])
+        if predicted <= 0:
+            return None
+        return round(1.0 - min(predicted / measured_us, 1.0), 4)
 
     # -- last anatomy capture (bundle/manifest surface) --------------------
 

@@ -690,6 +690,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .numerics.cli import add_numerics_parser
 
     add_numerics_parser(sub)
+
+    from .profiler.cli import add_profile_parser
+
+    add_profile_parser(sub)
     return p
 
 

@@ -97,6 +97,13 @@ PERF_METRICS: Dict[str, Tuple[str, float]] = {
     "moe_ep_tokens_per_sec": ("higher", 0.15),
     "moe_dispatch_speedup": ("higher", 0.15),
     "moe_drop_rate": ("lower", 0.25),
+    # fleet profiler plane (ISSUE 20): percent step-time cost of the
+    # duty-cycled continuous capture (duty-cycle on vs off over the same
+    # fenced steps).  LOWER is better — always-on capture only earns its
+    # keep with a bounded overhead budget; a rise means the trace
+    # stop/parse/census machinery started eating the step loop.  Wide
+    # tolerance: the number is a ratio of two small wall times.
+    "profiler_overhead_pct": ("lower", 0.50),
 }
 
 #: ignore regressions on metrics whose baseline is this close to zero —
@@ -122,6 +129,9 @@ ABS_FLOORS: Dict[str, float] = {
     # a top-2 router dropping under 2% of tokens is routing jitter at
     # the bench's capacity factor, not a capacity regression
     "moe_drop_rate": 0.02,
+    # capture overhead under 5% of step time is scheduler noise on a
+    # CPU-backend bench, not a profiler regression
+    "profiler_overhead_pct": 5.0,
 }
 
 DEFAULT_BASELINE = "PERF_BASELINE.json"

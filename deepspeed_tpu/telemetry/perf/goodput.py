@@ -34,7 +34,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-BUCKETS = ("productive", "compile", "stall", "recovery", "checkpoint")
+BUCKETS = ("productive", "compile", "stall", "recovery", "checkpoint",
+           "profiler")
 
 
 class GoodputLedger:

@@ -1,0 +1,35 @@
+"""Fleet-synchronized profiler capture (ISSUE 20).
+
+One command arms ``jax.profiler`` on every rank for the same step-index
+window; the measured device lanes come back through the rendezvous
+store, merge into the clock-aligned cluster timeline, and calibrate the
+anatomy roofline.  See :mod:`.orchestrator` for the store protocol,
+:mod:`.census` for the per-op measured-duration table,
+:mod:`.calibration` for the measured-vs-modeled join and the persisted
+per-device-kind factors, and :mod:`.fleet` for the rank-0 merge.
+"""
+
+from .calibration import (CalibrationStore, MISMATCH_FACTOR,
+                          apply_report_to_store, build_calibration_report,
+                          calibration_scale, default_calibration_path,
+                          get_calibration_store)
+from .census import classify_op, normalize_op, op_census, trace_census
+from .fleet import (assemble_fleet_profile, build_fleet_calibration,
+                    expected_nodes, load_profiles, persist_profiles,
+                    wait_for_publications)
+from .orchestrator import (CMD_KEY, PUB_PREFIX, ProfilerPlane,
+                           configure_profiler_plane, get_profiler_plane,
+                           post_capture_command, pub_key,
+                           reset_profiler_plane)
+
+__all__ = [
+    "CMD_KEY", "PUB_PREFIX", "MISMATCH_FACTOR",
+    "CalibrationStore", "ProfilerPlane",
+    "apply_report_to_store", "assemble_fleet_profile",
+    "build_calibration_report", "build_fleet_calibration",
+    "calibration_scale", "classify_op", "configure_profiler_plane",
+    "default_calibration_path", "expected_nodes", "get_calibration_store",
+    "get_profiler_plane", "load_profiles", "normalize_op", "op_census",
+    "persist_profiles", "post_capture_command", "pub_key",
+    "reset_profiler_plane", "trace_census", "wait_for_publications",
+]

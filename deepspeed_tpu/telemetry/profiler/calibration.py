@@ -1,0 +1,306 @@
+"""Measured-vs-modeled calibration — the trace grounds the roofline.
+
+The anatomy ledger's predictions (``telemetry/anatomy/ledger.py``) come
+from the compiler cost model divided by spec-sheet peaks — analytic
+twice over on backends without a cost model.  ROADMAP carries the debt
+explicitly: every PR-12 crossover threshold and kernel speedup is a
+measured-once constant awaiting re-verification.  This module closes the
+loop: join a capture's per-op census (``measured_ms``) against the
+ledger's per-site predictions (``modeled_ms``), flag every row where the
+model is off by more than :data:`MISMATCH_FACTOR`, and persist per
+device-kind calibration factors (EWMA, the same estimator the tuning
+memory model uses) so
+
+* subsequent :meth:`CostLedger.record` calls emit ``calibrated_us``
+  grounded in measurement, and
+* the tuning space's Pallas crossover thresholds
+  (:func:`~...tuning.space.apply_calibration`) shift with the measured
+  compute factor instead of the typed-in constant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import logger
+
+#: measured/modeled ratio beyond which (either way) a row is flagged
+MISMATCH_FACTOR = 2.0
+
+#: EWMA smoothing for factor updates (same order as the tuning memory
+#: model's calibration: new captures dominate, history damps jitter)
+EWMA_ALPHA = 0.5
+
+#: factor clamp: a degenerate capture (empty lane, one op) must not swing
+#: every subsequent prediction by orders of magnitude
+FACTOR_MIN, FACTOR_MAX = 0.05, 20.0
+
+#: roofline components a factor is kept for.  ``step`` scales the
+#: whole-program prediction; ``compute``/``collective`` scale the
+#: breakdown components the census can actually separate per-op.
+FACTOR_BUCKETS = ("step", "compute", "collective")
+
+
+def default_calibration_path() -> str:
+    """Where factors persist across runs: ``DS_CALIBRATION_PATH`` env
+    override (tests, multi-tenant hosts), else a dotfile next to the
+    telemetry logs in the user cache."""
+    env = os.environ.get("DS_CALIBRATION_PATH")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "deepspeed_tpu", "calibration.json")
+
+
+class CalibrationStore:
+    """Per-device-kind measured/modeled factors with EWMA updates.
+
+    ``factors[device_kind][bucket] = {"factor", "samples"}``.  A factor
+    of 1.0 means the analytic model matched measurement; >1 means the
+    device is measured SLOWER than modeled (predictions scale up).
+    Thread-safe; persistence is atomic-rename JSON.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_calibration_path()
+        self._lock = threading.Lock()
+        self._factors: Dict[str, Dict[str, Dict[str, float]]] = {}
+        self._loaded = False
+
+    # -- persistence -------------------------------------------------------
+
+    def load(self) -> "CalibrationStore":
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+            with self._lock:
+                self._factors = {
+                    str(k): {str(b): dict(v) for b, v in d.items()
+                             if isinstance(v, dict)}
+                    for k, d in (doc.get("factors") or {}).items()
+                    if isinstance(d, dict)}
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as e:
+            logger.warning(f"calibration: unreadable {self.path} ({e!r}); "
+                           f"starting fresh")
+        with self._lock:
+            self._loaded = True
+        return self
+
+    def save(self) -> Optional[str]:
+        with self._lock:
+            doc = {"v": 1, "factors": {k: {b: dict(v)
+                                           for b, v in d.items()}
+                                       for k, d in self._factors.items()}}
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+            return self.path
+        except OSError as e:
+            logger.warning(f"calibration: could not persist {self.path} "
+                           f"({e!r})")
+            return None
+
+    # -- factors -----------------------------------------------------------
+
+    def _ensure_loaded(self) -> None:
+        with self._lock:
+            loaded = self._loaded
+        if not loaded:
+            self.load()
+
+    def factor(self, device_kind: str, bucket: str = "step") -> float:
+        self._ensure_loaded()
+        with self._lock:
+            row = self._factors.get(str(device_kind), {}).get(str(bucket))
+            return float(row["factor"]) if row else 1.0
+
+    def factors_for(self, device_kind: str) -> Dict[str, float]:
+        self._ensure_loaded()
+        with self._lock:
+            return {b: float(v["factor"])
+                    for b, v in self._factors.get(str(device_kind),
+                                                  {}).items()}
+
+    def update(self, device_kind: str, bucket: str, ratio: float) -> float:
+        """Fold one measured/modeled ratio into the EWMA factor; returns
+        the new factor."""
+        if bucket not in FACTOR_BUCKETS:
+            raise ValueError(f"unknown calibration bucket {bucket!r} "
+                             f"(one of {FACTOR_BUCKETS})")
+        ratio = min(max(float(ratio), FACTOR_MIN), FACTOR_MAX)
+        self._ensure_loaded()
+        with self._lock:
+            dev = self._factors.setdefault(str(device_kind), {})
+            row = dev.get(bucket)
+            if row is None:
+                dev[bucket] = {"factor": ratio, "samples": 1}
+                return ratio
+            f = (1.0 - EWMA_ALPHA) * float(row["factor"]) \
+                + EWMA_ALPHA * ratio
+            f = min(max(f, FACTOR_MIN), FACTOR_MAX)
+            row["factor"] = f
+            row["samples"] = int(row.get("samples", 0)) + 1
+            return f
+
+    def to_dict(self) -> Dict[str, Any]:
+        self._ensure_loaded()
+        with self._lock:
+            return {k: {b: dict(v) for b, v in d.items()}
+                    for k, d in self._factors.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._factors = {}
+            self._loaded = True
+
+
+_store: Optional[CalibrationStore] = None
+_store_lock = threading.Lock()
+
+
+def get_calibration_store(path: Optional[str] = None) -> CalibrationStore:
+    """The process-global store; a ``path`` argument re-homes it (CLI
+    ``--calibration`` flag, test isolation)."""
+    global _store
+    with _store_lock:
+        if _store is None or (path and _store.path != path):
+            _store = CalibrationStore(path)
+        return _store
+
+
+def calibration_scale(device_kind: str, bucket: str = "step") -> float:
+    """Cheap read for prediction paths — 1.0 until a capture taught us
+    otherwise.  Never raises (a broken store file must not take down
+    ``CostLedger.record``)."""
+    try:
+        return get_calibration_store().factor(device_kind, bucket)
+    except Exception:
+        return 1.0
+
+
+# ---------------------------------------------------------------------------
+# the measured-vs-modeled join
+# ---------------------------------------------------------------------------
+
+def build_calibration_report(census: Dict[str, Any],
+                             ledger_entry: Optional[Dict[str, Any]],
+                             device_kind: str = "",
+                             node: str = "",
+                             mismatch_factor: float = MISMATCH_FACTOR
+                             ) -> Dict[str, Any]:
+    """Join one capture's per-op census against the cost ledger's
+    roofline prediction for the captured site.
+
+    The roofline models three components (compute, hbm, comm); a trace
+    separates collectives from everything else, so the join happens at
+    that granularity: the ``collective`` bucket's measured time lands
+    against the modeled ``comm`` component, everything else against
+    ``max(compute, hbm)`` (the roofline's non-comm critical path).  Each
+    op row carries its measured time plus the modeled time attributed to
+    its bucket, so the report names every op whose bucket the model
+    misses by more than ``mismatch_factor`` — per-op modeled time is the
+    bucket model scaled by the op's measured share (the trace cannot
+    re-derive the compiler cost model per op; the bucket ratio is the
+    honest resolution).
+    """
+    steps = max(int(census.get("steps", 1)), 1)
+    bucket_meas_ms = {
+        "collective": census["bucket_per_step_us"]["collective"] / 1e3,
+        "compute": (census["bucket_per_step_us"]["compute"]
+                    + census["bucket_per_step_us"]["host"]) / 1e3,
+    }
+    measured_step_ms = census.get("device_per_step_us", 0.0) / 1e3
+    rows: List[Dict[str, Any]] = []
+    modeled_step_ms = None
+    bucket_model_ms: Dict[str, float] = {}
+    if ledger_entry:
+        bd = ledger_entry.get("predicted_breakdown_us") or {}
+        modeled_step_ms = float(ledger_entry.get("predicted_us", 0.0)) / 1e3
+        bucket_model_ms = {
+            "collective": float(bd.get("comm", 0.0)) / 1e3,
+            "compute": max(float(bd.get("compute", 0.0)),
+                           float(bd.get("hbm", 0.0))) / 1e3,
+        }
+    for name, op in sorted((census.get("ops") or {}).items(),
+                           key=lambda kv: -kv[1]["total_us"]):
+        bucket = op.get("bucket", "compute")
+        join_bucket = "collective" if bucket == "collective" else "compute"
+        meas_ms = float(op["per_step_us"]) / 1e3
+        row: Dict[str, Any] = {
+            "op": name, "bucket": bucket,
+            "count": int(op["count"]),
+            "measured_ms": round(meas_ms, 4),
+            "measured_share": round(
+                meas_ms / measured_step_ms, 4) if measured_step_ms else 0.0,
+        }
+        model_ms = bucket_model_ms.get(join_bucket)
+        bucket_meas = bucket_meas_ms.get(join_bucket, 0.0)
+        if model_ms is not None and model_ms > 0.0 and bucket_meas > 0.0:
+            share = meas_ms / bucket_meas
+            row["modeled_ms"] = round(model_ms * share, 4)
+            ratio = bucket_meas / model_ms
+            row["ratio"] = round(ratio, 3)
+            row["off_by_2x"] = bool(ratio > mismatch_factor
+                                    or ratio < 1.0 / mismatch_factor)
+        else:
+            row["modeled_ms"] = None
+            row["ratio"] = None
+            row["off_by_2x"] = False
+        rows.append(row)
+    report: Dict[str, Any] = {
+        "node": node,
+        "device_kind": device_kind,
+        "site": (ledger_entry or {}).get("site"),
+        "steps": steps,
+        "measured_step_ms": round(measured_step_ms, 4),
+        "modeled_step_ms": (round(modeled_step_ms, 4)
+                            if modeled_step_ms is not None else None),
+        "provenance": (ledger_entry or {}).get("provenance"),
+        "buckets": {},
+        "ops": rows,
+        "flagged": [r["op"] for r in rows if r["off_by_2x"]],
+    }
+    for b in ("compute", "collective"):
+        model = bucket_model_ms.get(b)
+        meas = bucket_meas_ms.get(b, 0.0)
+        ratio = (meas / model) if model else None
+        report["buckets"][b] = {
+            "measured_ms": round(meas, 4),
+            "modeled_ms": round(model, 4) if model is not None else None,
+            "ratio": round(ratio, 3) if ratio else None,
+            "off_by_2x": bool(ratio and (ratio > mismatch_factor
+                                         or ratio < 1.0 / mismatch_factor)),
+        }
+    if modeled_step_ms and measured_step_ms:
+        report["step_ratio"] = round(measured_step_ms / modeled_step_ms, 3)
+    else:
+        report["step_ratio"] = None
+    return report
+
+
+def apply_report_to_store(report: Dict[str, Any],
+                          store: Optional[CalibrationStore] = None,
+                          save: bool = True) -> Dict[str, float]:
+    """Fold one calibration report's ratios into the persistent factors;
+    returns the updated ``{bucket: factor}`` view for the device kind."""
+    store = store or get_calibration_store()
+    kind = str(report.get("device_kind") or "unknown")
+    if report.get("step_ratio"):
+        store.update(kind, "step", float(report["step_ratio"]))
+    for bucket, key in (("compute", "compute"),
+                        ("collective", "collective")):
+        row = (report.get("buckets") or {}).get(bucket) or {}
+        if row.get("ratio"):
+            store.update(kind, key, float(row["ratio"]))
+    if save:
+        store.save()
+    return store.factors_for(kind)

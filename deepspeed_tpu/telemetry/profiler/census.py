@@ -1,0 +1,124 @@
+"""Per-op measured-duration census over a profiler trace's device lanes.
+
+``profiling/collective_trace.py`` parses device-lane events but its
+aggregation (:func:`~...profiling.collective_trace.parse_trace`) keeps
+collectives only.  The fleet profiler needs the WHOLE device timeline:
+every op's measured duration, normalized across recompiles (XLA suffixes
+op names with ``.<n>`` uniquifiers that change per program) and
+classified into the same compute / collective buckets the anatomy
+roofline models — that classification is what lets the calibration join
+put ``measured_ms`` next to ``modeled_ms`` per roofline component.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from ...profiling.collective_trace import (COLLECTIVE_PATTERNS,
+                                           parse_trace_events)
+
+#: XLA uniquifier suffixes: "fusion.123", "all-reduce.7.remat" — strip
+#: trailing ".<digits>" segments so the same op aggregates across
+#: programs/recompiles
+_SUFFIX_RE = re.compile(r"(\.\d+)+$")
+
+#: ops that are host<->device plumbing, not modeled by the roofline
+_HOST_PATTERNS = ("infeed", "outfeed", "transfer", "copy-start",
+                  "copy-done", "host")
+
+
+def normalize_op(name: str) -> str:
+    """Canonical op name: uniquifier suffixes stripped, lowered."""
+    return _SUFFIX_RE.sub("", str(name)).strip().lower()
+
+
+def classify_op(name: str) -> str:
+    """Roofline bucket of one device op: ``collective`` / ``host`` /
+    ``compute`` (the roofline's compute and hbm components are not
+    separable per-op from a trace — both land in ``compute``)."""
+    low = normalize_op(name)
+    if any(p in low for p in COLLECTIVE_PATTERNS):
+        return "collective"
+    if any(p in low for p in _HOST_PATTERNS):
+        return "host"
+    return "compute"
+
+
+def op_census(events: List[Dict[str, Any]], steps: int = 1,
+              dedupe_lanes: bool = True,
+              top_k: Optional[int] = None) -> Dict[str, Any]:
+    """Aggregate device-lane events into the per-op measured table.
+
+    ``events`` are ``{ts_us, dur_us, name, lane}`` rows
+    (:func:`parse_trace_events` with ``patterns=None``).  With
+    ``dedupe_lanes`` only the first device lane counts — in a
+    single-process multi-device mesh every shard's lane shows the same
+    program, and summing them would count each op ``local_device_count``
+    times (the same rationale as ``feed_exec_census``).
+
+    Returns ``{"ops": {name: {count, total_us, mean_us, per_step_us,
+    bucket}}, "steps", "lanes", "device_total_us", "window_us",
+    "bucket_us": {compute, collective, host}}``.
+    """
+    steps = max(int(steps), 1)
+    lanes = sorted({ev["lane"] for ev in events})
+    rows = events
+    if dedupe_lanes and lanes:
+        first = events[0]["lane"]
+        rows = [ev for ev in events if ev["lane"] == first]
+    ops: Dict[str, Dict[str, float]] = {}
+    bucket_us = {"compute": 0.0, "collective": 0.0, "host": 0.0}
+    t_min, t_max = None, None
+    for ev in rows:
+        dur = float(ev.get("dur_us", 0.0))
+        if dur <= 0.0:
+            continue
+        ts = float(ev.get("ts_us", 0.0))
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        name = normalize_op(ev.get("name", "?")) or "?"
+        row = ops.setdefault(name, {"count": 0.0, "total_us": 0.0,
+                                    "bucket": classify_op(name)})
+        row["count"] += 1
+        row["total_us"] += dur
+        bucket_us[row["bucket"]] += dur
+    for name, row in ops.items():
+        row["total_us"] = round(row["total_us"], 1)
+        row["mean_us"] = round(row["total_us"] / max(row["count"], 1), 2)
+        row["per_step_us"] = round(row["total_us"] / steps, 2)
+    if top_k is not None and len(ops) > top_k:
+        keep = sorted(ops.items(), key=lambda kv: -kv[1]["total_us"])
+        dropped = keep[int(top_k):]
+        ops = dict(keep[:int(top_k)])
+        if dropped:
+            # never silently truncate: the residue stays visible as one
+            # explicit remainder row so totals still reconcile
+            ops["(other)"] = {
+                "count": sum(r["count"] for _, r in dropped),
+                "total_us": round(sum(r["total_us"] for _, r in dropped), 1),
+                "mean_us": 0.0,
+                "per_step_us": round(
+                    sum(r["total_us"] for _, r in dropped) / steps, 2),
+                "bucket": "compute"}
+    total = sum(r["total_us"] for r in ops.values())
+    return {
+        "ops": ops,
+        "steps": steps,
+        "lanes": lanes,
+        "device_total_us": round(total, 1),
+        "device_per_step_us": round(total / steps, 2),
+        "window_us": (round(t_max - t_min, 1)
+                      if t_min is not None else 0.0),
+        "bucket_us": {k: round(v, 1) for k, v in bucket_us.items()},
+        "bucket_per_step_us": {k: round(v / steps, 2)
+                               for k, v in bucket_us.items()},
+    }
+
+
+def trace_census(trace_dir: str, steps: int = 1,
+                 dedupe_lanes: bool = True,
+                 top_k: Optional[int] = None) -> Dict[str, Any]:
+    """Per-op census straight from a ``jax.profiler.trace`` output dir."""
+    return op_census(parse_trace_events(trace_dir, patterns=None),
+                     steps=steps, dedupe_lanes=dedupe_lanes, top_k=top_k)

@@ -1,0 +1,153 @@
+"""``telemetry profile`` — one command, every rank.
+
+``profile --steps N`` posts a capture command through the rendezvous
+store, waits for every worker's device-lane publication, and writes the
+merged clock-aligned ``cluster_trace.json`` + ``calibration_report.
+json`` into the output archive.  ``profile report`` re-renders a saved
+archive; ``profile factors`` prints (or clears) the persisted
+per-device-kind calibration factors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from ...utils.logging import logger
+
+
+def _client(endpoint: str) -> Any:
+    if not endpoint:
+        raise SystemExit("profile: no store endpoint — pass --endpoint "
+                         "or set DS_RDZV_ENDPOINT")
+    from ...elasticity.rendezvous import RendezvousClient
+
+    return RendezvousClient(endpoint)
+
+
+def _render_report(report: dict, limit: int = 12) -> None:
+    factors = report.get("factors") or {}
+    for kind, f in sorted(factors.items()):
+        pretty = ", ".join(f"{b}={v:.2f}" for b, v in sorted(f.items()))
+        print(f"  factors[{kind}]: {pretty}")
+    flagged = report.get("flagged_ops") or []
+    if flagged:
+        print(f"  ops off by >2x ({len(flagged)}): "
+              + ", ".join(flagged[:limit])
+              + (" ..." if len(flagged) > limit else ""))
+    else:
+        print("  no op off by >2x — the roofline holds")
+    for node, rep in sorted((report.get("nodes") or {}).items()):
+        print(f"  {node}: measured {rep.get('measured_step_ms')}ms/step "
+              f"vs modeled {rep.get('modeled_step_ms')}ms "
+              f"(ratio {rep.get('step_ratio')}, "
+              f"site {rep.get('site')}, "
+              f"device {rep.get('device_kind')})")
+
+
+def cmd_profile(args: Any) -> int:
+    sub = getattr(args, "profile_cmd", "capture")
+    if sub == "capture":
+        from .fleet import assemble_fleet_profile, expected_nodes
+        from .orchestrator import post_capture_command
+
+        client = _client(args.endpoint)
+        nodes = ([n for n in args.nodes.split(",") if n]
+                 if args.nodes else expected_nodes(client))
+        mode = "duration" if args.duration_ms > 0 else "window"
+        req = post_capture_command(client, steps=args.steps,
+                                   lead=args.lead, mode=mode,
+                                   duration_ms=max(args.duration_ms, 0.0))
+        print(f"profile: posted capture #{req} "
+              f"({mode} mode, steps={args.steps}) — waiting for "
+              f"{nodes or 'any publisher'}")
+        try:
+            summary = assemble_fleet_profile(client, req, args.out,
+                                             nodes=nodes or None,
+                                             timeout_s=args.timeout)
+        except TimeoutError as e:
+            print(f"profile: {e}")
+            return 2
+        print(f"profile: merged timeline -> {summary['cluster_trace']}")
+        print(f"profile: calibration     -> "
+              f"{summary['calibration_report']}")
+        lanes = summary.get("device_lanes") or {}
+        for node in sorted(lanes):
+            print(f"  {node}: {lanes[node]} device events")
+        if summary["missing"]:
+            print(f"profile: MISSING lanes from {summary['missing']}")
+        with open(summary["calibration_report"]) as fh:
+            _render_report(json.load(fh))
+        return 0 if not summary["missing"] else 2
+    if sub == "report":
+        path = args.archive
+        if os.path.isdir(path):
+            path = os.path.join(path, "calibration_report.json")
+        with open(path) as fh:
+            report = json.load(fh)
+        print(f"calibration report: {path}")
+        _render_report(report)
+        return 0
+    if sub == "factors":
+        from .calibration import get_calibration_store
+
+        store = get_calibration_store(args.path or None)
+        if args.clear:
+            store.reset()
+            store.save()
+            print(f"factors cleared -> {store.path}")
+            return 0
+        doc = store.to_dict()
+        print(json.dumps({"path": store.path, "factors": doc}, indent=1))
+        return 0
+    logger.error(f"unknown profile subcommand {sub!r}")
+    return 2
+
+
+def add_profile_parser(sub: Any) -> None:
+    pr = sub.add_parser(
+        "profile",
+        help="fleet-synchronized profiler capture: arm jax.profiler on "
+             "every rank for one step window, merge the device lanes, "
+             "calibrate the roofline")
+    psub = pr.add_subparsers(dest="profile_cmd", required=True)
+
+    cp = psub.add_parser("capture",
+                         help="post a capture command and merge the "
+                              "fleet's device lanes")
+    cp.add_argument("--endpoint",
+                    default=os.environ.get("DS_RDZV_ENDPOINT"),
+                    help="rendezvous store host:port "
+                         "(default: $DS_RDZV_ENDPOINT)")
+    cp.add_argument("--steps", type=int, default=4,
+                    help="train steps in the capture window")
+    cp.add_argument("--lead", type=int, default=3,
+                    help="steps of arming lead (the window opens at "
+                         "max(rank step)+lead)")
+    cp.add_argument("--duration-ms", type=float, default=0.0,
+                    help="capture wall-time instead of steps (the "
+                         "serving fleet has no shared step counter)")
+    cp.add_argument("--nodes", default="",
+                    help="comma-separated node ids to wait for "
+                         "(default: the sealed gang / registered "
+                         "serving workers)")
+    cp.add_argument("--out", default="fleet_profiles/latest",
+                    help="archive dir for the merged timeline + report")
+    cp.add_argument("--timeout", type=float, default=60.0)
+    cp.set_defaults(fn=cmd_profile)
+
+    rp = psub.add_parser("report",
+                         help="re-render a saved calibration report")
+    rp.add_argument("archive",
+                    help="archive dir or calibration_report.json")
+    rp.set_defaults(fn=cmd_profile)
+
+    fa = psub.add_parser("factors",
+                         help="print or clear the persisted calibration "
+                              "factors")
+    fa.add_argument("--path", default="",
+                    help="factors file (default: $DS_CALIBRATION_PATH "
+                         "or the user cache)")
+    fa.add_argument("--clear", action="store_true")
+    fa.set_defaults(fn=cmd_profile)

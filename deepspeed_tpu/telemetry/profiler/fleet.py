@@ -1,0 +1,176 @@
+"""Rank-0 / CLI side of a fleet capture: wait for every worker's
+publication, persist the device lanes into an archive, merge them into
+the clock-aligned ``cluster_trace.json`` (next to host bundle spans when
+the archive has them), and write the fleet calibration report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import logger
+from .orchestrator import PUB_PREFIX, pub_key
+
+#: archive subdir the merged-trace builder scans for device lanes
+PROFILES_DIR = "profiles"
+CALIBRATION_REPORT = "calibration_report.json"
+FLEET_PROFILE = "fleet_profile.json"
+
+
+def expected_nodes(client: Any) -> List[str]:
+    """The capture's answer set: the sealed gang when a round exists,
+    else every serving/worker registration, else whoever has EVER
+    published a profile."""
+    from ..aggregator import sealed_members
+
+    try:
+        sealed = sealed_members(client)
+    except Exception:
+        sealed = []
+    if sealed:
+        return sealed
+    srv = [k.rsplit("/", 1)[-1] for k in client.keys("serving/srv/")]
+    if srv:
+        return sorted(srv)
+    return sorted(k[len(PUB_PREFIX):] for k in client.keys(PUB_PREFIX))
+
+
+def wait_for_publications(client: Any, req: int,
+                          nodes: Optional[List[str]] = None,
+                          timeout_s: float = 60.0,
+                          poll_s: float = 0.2) -> Dict[str, Dict[str, Any]]:
+    """Block until every expected node's ``profiler/pub/<node>`` carries
+    this request id (or the deadline passes — partial fleets are
+    reported, not hidden: missing nodes simply aren't in the result)."""
+    deadline = time.monotonic() + float(timeout_s)
+    nodes = list(nodes) if nodes else None
+    got: Dict[str, Dict[str, Any]] = {}
+    while time.monotonic() < deadline:
+        pending = (set(nodes) - set(got)) if nodes is not None else None
+        keys = ([pub_key(n) for n in sorted(pending)]
+                if pending is not None else client.keys(PUB_PREFIX))
+        for k in keys:
+            doc = client.get(k)
+            if isinstance(doc, dict) and int(doc.get("req", -1)) >= int(req):
+                got[str(doc.get("node") or k[len(PUB_PREFIX):])] = doc
+        if nodes is not None and not (set(nodes) - set(got)):
+            break
+        if nodes is None and got:
+            # no expected set: one settle poll after the first answer
+            time.sleep(max(poll_s, 0.5))
+            for k in client.keys(PUB_PREFIX):
+                doc = client.get(k)
+                if isinstance(doc, dict) \
+                        and int(doc.get("req", -1)) >= int(req):
+                    got[str(doc.get("node")
+                            or k[len(PUB_PREFIX):])] = doc
+            break
+        time.sleep(poll_s)
+    return got
+
+
+def persist_profiles(archive: str, pubs: Dict[str, Dict[str, Any]]
+                     ) -> List[str]:
+    """Write each node's publication under ``<archive>/profiles/<node>/
+    device_events.json`` — the layout ``build_cluster_trace`` merges."""
+    written = []
+    for node, doc in sorted(pubs.items()):
+        pdir = os.path.join(archive, PROFILES_DIR, node)
+        os.makedirs(pdir, exist_ok=True)
+        path = os.path.join(pdir, "device_events.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, default=str)
+        os.replace(tmp, path)
+        written.append(path)
+    return written
+
+
+def load_profiles(archive: str) -> Dict[str, Dict[str, Any]]:
+    """``{node: publication}`` back out of an archive's profiles tree."""
+    pdir = os.path.join(archive, PROFILES_DIR)
+    out: Dict[str, Dict[str, Any]] = {}
+    if not os.path.isdir(pdir):
+        return out
+    for node in sorted(os.listdir(pdir)):
+        path = os.path.join(pdir, node, "device_events.json")
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as fh:
+                out[node] = json.load(fh)
+        except (OSError, ValueError) as e:
+            logger.warning(f"fleet profile: unreadable lane for {node} "
+                           f"({e!r}); skipped")
+    return out
+
+
+def build_fleet_calibration(pubs: Dict[str, Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Merge per-node calibration reports into one fleet document: every
+    node's rows, plus the fleet-level flagged-op union and the factor
+    table per device kind."""
+    nodes = {}
+    flagged = set()
+    factors: Dict[str, Dict[str, float]] = {}
+    for node, doc in sorted(pubs.items()):
+        rep = doc.get("calibration") or {}
+        nodes[node] = rep
+        flagged.update(rep.get("flagged") or [])
+        kind = str(rep.get("device_kind") or "unknown")
+        if rep.get("factors"):
+            factors[kind] = {k: float(v)
+                             for k, v in rep["factors"].items()}
+    return {
+        "nodes": nodes,
+        "flagged_ops": sorted(flagged),
+        "factors": factors,
+        "mismatch_factor": 2.0,
+    }
+
+
+def assemble_fleet_profile(client: Any, req: int, out_dir: str,
+                           nodes: Optional[List[str]] = None,
+                           timeout_s: float = 60.0) -> Dict[str, Any]:
+    """The whole rank-0 merge: wait for the fleet's publications, write
+    the archive (device lanes + merged clock-aligned ``cluster_trace.
+    json`` + ``calibration_report.json``), return the summary."""
+    from ..aggregator import build_cluster_trace
+
+    nodes = list(nodes) if nodes else expected_nodes(client)
+    pubs = wait_for_publications(client, req, nodes or None,
+                                 timeout_s=timeout_s)
+    if not pubs:
+        raise TimeoutError(
+            f"fleet profile #{req}: no publications within {timeout_s}s "
+            f"(expected {nodes or 'any'}) — are the workers' publisher "
+            f"beats running against this store?")
+    os.makedirs(out_dir, exist_ok=True)
+    persist_profiles(out_dir, pubs)
+    trace_doc = build_cluster_trace(out_dir)
+    calib = build_fleet_calibration(pubs)
+    with open(os.path.join(out_dir, CALIBRATION_REPORT), "w") as fh:
+        json.dump(calib, fh, indent=1, default=str)
+    missing = sorted(set(nodes or []) - set(pubs))
+    summary = {
+        "req": int(req),
+        "archive": out_dir,
+        "nodes": sorted(pubs),
+        "missing": missing,
+        "cluster_trace": (os.path.join(out_dir, "cluster_trace.json")
+                          if trace_doc else None),
+        "calibration_report": os.path.join(out_dir, CALIBRATION_REPORT),
+        "flagged_ops": calib["flagged_ops"],
+        "factors": calib["factors"],
+        "device_lanes": {n: len(p.get("events") or [])
+                         for n, p in pubs.items()},
+    }
+    with open(os.path.join(out_dir, FLEET_PROFILE), "w") as fh:
+        json.dump(summary, fh, indent=1, default=str)
+    if missing:
+        logger.warning(f"fleet profile #{req}: missing lanes from "
+                       f"{missing} — merged what answered")
+    return summary

@@ -125,6 +125,42 @@ def apply_overrides(base_config: Dict[str, Any],
     return cfg
 
 
+def apply_calibration(store: Any = None,
+                      device_kind: Optional[str] = None) -> float:
+    """Ground the measured-once Pallas crossover constants in fleet
+    profiler measurement (ISSUE 20).
+
+    ROADMAP carries the debt explicitly: every PR-12 crossover threshold
+    is a constant measured once on one host.  Once a ``telemetry
+    profile`` capture has persisted a per-device-kind ``compute`` factor
+    (measured/modeled ratio), the MoE dense/sparse dispatch crossover
+    scales by ``1/factor`` — a device measured 2x slower than modeled on
+    compute flips to the sparse path at half the T·E·C volume.  Returns
+    the scale applied (1.0 when no calibration exists)."""
+    from ..telemetry.profiler.calibration import get_calibration_store
+
+    store = store or get_calibration_store()
+    if device_kind is None:
+        try:
+            import jax
+
+            d = jax.devices()[0]
+            device_kind = (getattr(d, "device_kind", "")
+                           or getattr(d, "platform", "") or "unknown")
+        except Exception:
+            device_kind = "unknown"
+    try:
+        factor = float(store.factor(device_kind, "compute"))
+    except Exception:
+        factor = 1.0
+    scale = 1.0 / factor if factor > 0 else 1.0
+    scale = min(max(scale, 0.25), 4.0)
+    from ..ops.pallas.moe_dispatch import set_crossover_scale
+
+    set_crossover_scale(scale)
+    return scale
+
+
 def default_space(max_micro_batch: int = 16,
                   include_offload: bool = False,
                   include_zero_stage: bool = True,

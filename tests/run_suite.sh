@@ -395,6 +395,76 @@ else
 fi
 rm -rf "$smoke_dir"
 
+# Fleet-profiler smoke (ISSUE 20): ONE `telemetry profile capture`
+# against a real 2-process CPU gang on the production path must merge
+# both ranks' device lanes into cluster_trace.json and write the
+# measured-vs-modeled calibration report — the operator loop end to end.
+echo "=== fleet profiler smoke: telemetry profile capture (2-proc gang)"
+smoke_dir=$(mktemp -d)
+if JAX_PLATFORMS=cpu python - "$smoke_dir" <<'PYEOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.elasticity.rendezvous import RendezvousServer
+
+out = sys.argv[1]
+repo = os.getcwd()
+worker = os.path.join(repo, "tests/unit/multiprocess/worker_profiler_gang.py")
+srv = RendezvousServer()
+procs = []
+try:
+    for node in ("sm0", "sm1"):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({"DS_RDZV_ENDPOINT": srv.endpoint,
+                    "DS_ELASTIC_NODE_ID": node,
+                    "DS_CALIBRATION_PATH": f"{out}/cal_{node}.json",
+                    "T_REPO": repo, "T_OUT": out, "T_DEADLINE_S": "120",
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": repo + os.pathsep
+                    + env.get("PYTHONPATH", "")})
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=open(f"{out}/{node}.log", "w"),
+            stderr=subprocess.STDOUT, start_new_session=True))
+    cli = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.telemetry", "profile",
+         "capture", "--endpoint", srv.endpoint, "--steps", "2",
+         "--lead", "2", "--nodes", "sm0,sm1",
+         "--out", f"{out}/archive", "--timeout", "150"],
+        env={**os.environ, "DS_CALIBRATION_PATH": f"{out}/cal_cli.json",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=240)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    trace = json.load(open(f"{out}/archive/cluster_trace.json"))
+    hosts = trace["metadata"]["hosts"]
+    for node in ("sm0", "sm1"):
+        assert hosts[f"{node} (device)"]["events"] > 0, hosts
+    rep = json.load(open(f"{out}/archive/calibration_report.json"))
+    for node in ("sm0", "sm1"):
+        assert rep["nodes"][node]["measured_step_ms"] > 0, rep
+    assert "factors[" in cli.stdout, cli.stdout
+finally:
+    for p in procs:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    srv.shutdown()
+print("fleet profiler smoke: both lanes merged, roofline calibrated")
+PYEOF
+then
+  echo "=== fleet profiler smoke passed"
+else
+  echo "=== fleet profiler smoke FAILED"
+  fail=1
+fi
+rm -rf "$smoke_dir"
+
 # Perf-sentinel smoke (ISSUE 5): baseline-then-check on the same run
 # must exit 0; a forced-regression fixture must exit 3.
 echo "=== perf sentinel smoke: baseline / check exit codes"

@@ -1,0 +1,74 @@
+"""One profiler-gang worker (ISSUE 20 acceptance): the FULL production
+path — ``dst.initialize`` with the aggregation plane on, so the
+publisher daemon polls the profiler command channel while the engine
+feeds ``on_step`` — then train until the armed window captured AND the
+publication flushed to the store."""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+sys.path.insert(0, os.environ["T_REPO"])
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import deepspeed_tpu as dst  # noqa: E402
+
+node = os.environ["DS_ELASTIC_NODE_ID"]
+out = os.environ["T_OUT"]
+
+rng = np.random.default_rng(3)
+params = {"w": jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))}
+
+
+def loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+cfg = {
+    "train_micro_batch_size_per_gpu": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "steps_per_print": 0,
+    "telemetry": {
+        "enabled": True,
+        "output_path": os.path.join(out, node),
+        "job_name": "profgang",
+        "watchdog": {"enabled": False},
+        "flight_recorder": {"install_handlers": False},
+        # the publisher daemon IS the command channel: a fast beat so
+        # the posted capture command is adopted promptly
+        "aggregation": {"enabled": True, "metrics_push_every_s": 0.2},
+        "profiler": {"lead": 2,
+                     "out_dir": os.path.join(out, node, "ring")},
+    },
+}
+
+engine, _, _, _ = dst.initialize(model=loss_fn, model_parameters=params,
+                                 config=cfg, dist_init_required=False)
+
+x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+batch = (x, jnp.zeros((8, 1), jnp.float32))
+
+from deepspeed_tpu.telemetry.profiler import get_profiler_plane  # noqa: E402
+
+plane = get_profiler_plane()
+assert plane is not None, "initialize() did not install the plane"
+
+deadline = time.time() + float(os.environ.get("T_DEADLINE_S", "120"))
+published = False
+while time.time() < deadline:
+    engine.train_step(batch)
+    time.sleep(0.05)  # leave the publisher beat room to poll/flush
+    if plane._captures >= 1 and plane._pending_pub is None:
+        published = True
+        break
+
+with open(os.path.join(out, f"{node}.done.json"), "w") as fh:
+    json.dump({"published": published, "captures": plane._captures,
+               "steps": int(engine.global_steps)}, fh)

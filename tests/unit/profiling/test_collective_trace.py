@@ -9,7 +9,8 @@ from deepspeed_tpu.profiling.collective_trace import (feed_exec_census,
                                                       parse_trace,
                                                       parse_trace_events,
                                                       profile_collectives)
-from deepspeed_tpu.telemetry.collective_ledger import CollectiveLedger
+from deepspeed_tpu.telemetry.collective_ledger import (CollectiveLedger,
+                                                       find_first_divergence)
 
 
 def _write_trace(tmp_path, events, name="t.trace.json.gz"):
@@ -128,6 +129,58 @@ def test_feed_exec_census_empty_trace_is_zero(tmp_path):
     led = CollectiveLedger(enabled=True)
     assert feed_exec_census(str(tmp_path), ledger=led) == 0
     assert led.exec_seq == 0
+
+
+def test_find_first_divergence_over_trace_fed_exec_tails(tmp_path):
+    # ISSUE 20 satellite: the offline desync analysis runs unchanged
+    # over EXEC tails harvested from profiler ring dirs — three "ranks"
+    # replay their captured device lanes, one executed a different
+    # second collective
+    good = [DEVICE_META,
+            _ev("all-gather.1", 100, 4),
+            _ev("all-reduce.3", 200, 6),
+            _ev("reduce-scatter.2", 300, 8)]
+    bad = [DEVICE_META,
+           _ev("all-gather.1", 100, 4),
+           _ev("collective-permute.9", 200, 6),  # wrong op at seq 2
+           _ev("reduce-scatter.2", 300, 8)]
+    tails = {}
+    for node, events in (("pn0", good), ("pn1", bad), ("pn2", good)):
+        led = CollectiveLedger(enabled=True)
+        assert feed_exec_census(_write_trace(tmp_path / node, events),
+                                ledger=led) == 3
+        tails[node] = led.snapshot()["exec_tail"]
+    report = find_first_divergence(tails)
+    assert report["desync"] is True
+    assert report["first_mismatch"]["seq"] == 2
+    assert report["first_mismatch"]["divergent_ranks"] == ["pn1"]
+    assert report["first_mismatch"]["signatures"]["pn1"] == \
+        "collective-permute.9:0"
+    assert report["lagging_rank"] is None  # all at seq 3
+    assert report["overlap"] == [1, 3]
+
+
+def test_trace_fed_exec_lane_never_forks_census_chain(tmp_path):
+    # two ranks whose LIVE census chains agree must keep agreeing even
+    # when only one of them feeds a profiler trace into the exec lane —
+    # the lanes are hash-isolated by construction
+    led_a = CollectiveLedger(enabled=True)
+    led_b = CollectiveLedger(enabled=True)
+    for led in (led_a, led_b):
+        led.record("all_reduce", 4096)
+        led.record("psum", 128)
+    trace = _write_trace(tmp_path, [DEVICE_META,
+                                    _ev("all-reduce.1", 100, 4),
+                                    _ev("all-gather.2", 200, 4)])
+    assert feed_exec_census(trace, ledger=led_a) == 2
+    assert led_a.tail_hash == led_b.tail_hash      # census chain intact
+    assert led_a.seq == led_b.seq == 2
+    assert led_a.exec_seq == 2 and led_b.exec_seq == 0
+    assert led_a.exec_tail_hash != led_b.exec_tail_hash
+    # and the divergence analysis over the CENSUS tails stays clean
+    report = find_first_divergence({"a": led_a.tail(), "b": led_b.tail()})
+    assert report["desync"] is False
+    assert report["first_mismatch"] is None
 
 
 def test_exec_lane_rides_ledger_snapshot(tmp_path):

@@ -50,6 +50,9 @@ def _fresh_diagnostics():
         from deepspeed_tpu.telemetry import numerics
 
         numerics.reset()
+        from deepspeed_tpu.telemetry.profiler import reset_profiler_plane
+
+        reset_profiler_plane()
 
     scrub()
     yield

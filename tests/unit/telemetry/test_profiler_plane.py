@@ -1,0 +1,561 @@
+"""Fleet-synchronized profiler capture (ISSUE 20): per-op census,
+measured-vs-modeled calibration, the store-coordinated capture
+orchestrator, and the rank-0 fleet merge."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry.profiler import (
+    CalibrationStore, ProfilerPlane, apply_report_to_store,
+    build_calibration_report, build_fleet_calibration, calibration_scale,
+    classify_op, load_profiles, normalize_op, op_census, persist_profiles,
+    post_capture_command, pub_key)
+from deepspeed_tpu.telemetry.profiler.calibration import (
+    EWMA_ALPHA, FACTOR_MAX, FACTOR_MIN)
+
+
+class FakeStore:
+    """In-process double of the RendezvousClient surface the profiler
+    plane touches (set/get/add/max/append/keys/now)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.t = 1000.0
+
+    def set(self, k, v, journal=False):
+        self.kv[k] = v
+
+    def get(self, k):
+        return self.kv.get(k)
+
+    def add(self, k, d=1):
+        self.kv[k] = int(self.kv.get(k) or 0) + d
+        return self.kv[k]
+
+    def max(self, k, v, journal=False):
+        self.kv[k] = max(int(self.kv.get(k) or 0), int(v))
+        return self.kv[k]
+
+    def append(self, k, v):
+        self.kv.setdefault(k, []).append(v)
+        return list(self.kv[k])
+
+    def keys(self, prefix):
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    def now(self):
+        return self.t
+
+
+@pytest.fixture
+def cal_store(tmp_path, monkeypatch):
+    """Re-home the process-global calibration store to a throwaway path
+    so tests never touch the user cache, restoring the default after."""
+    from deepspeed_tpu.telemetry.profiler import calibration as cal
+
+    path = str(tmp_path / "calibration.json")
+    monkeypatch.setenv("DS_CALIBRATION_PATH", path)
+    store = cal.get_calibration_store(path)
+    store.reset()
+    yield store
+    store.reset()
+    monkeypatch.delenv("DS_CALIBRATION_PATH", raising=False)
+    cal.get_calibration_store(cal.default_calibration_path()).reset()
+
+
+def _ev(name, ts, dur, lane="/device:TPU:0"):
+    return {"ts_us": float(ts), "dur_us": float(dur), "name": name,
+            "lane": lane}
+
+
+# ---------------------------------------------------------------------------
+# census
+# ---------------------------------------------------------------------------
+
+def test_normalize_and_classify_op():
+    assert normalize_op("fusion.123") == "fusion"
+    assert normalize_op("all-reduce.7.3") == "all-reduce"
+    assert normalize_op("Convolution") == "convolution"
+    assert normalize_op("dot.v2") == "dot.v2"  # only trailing digits strip
+    assert classify_op("all-reduce.3") == "collective"
+    assert classify_op("psum.1") == "collective"
+    assert classify_op("infeed.2") == "host"
+    assert classify_op("copy-start.9") == "host"
+    assert classify_op("fusion.42") == "compute"
+
+
+def test_op_census_dedupes_lanes_and_buckets():
+    # two lanes showing the SAME program: only the first counts
+    events = [
+        _ev("fusion.1", 100, 40), _ev("all-reduce.3", 150, 20),
+        _ev("infeed.1", 180, 5), _ev("fusion.2", 200, 40),
+        _ev("fusion.1", 101, 40, lane="/device:TPU:1"),
+        _ev("all-reduce.3", 151, 20, lane="/device:TPU:1"),
+    ]
+    c = op_census(events, steps=2)
+    assert c["lanes"] == ["/device:TPU:0", "/device:TPU:1"]
+    assert c["ops"]["fusion"]["count"] == 2          # one lane only
+    assert c["ops"]["fusion"]["total_us"] == 80.0
+    assert c["ops"]["fusion"]["per_step_us"] == 40.0
+    assert c["ops"]["fusion"]["bucket"] == "compute"
+    assert c["ops"]["all-reduce"]["bucket"] == "collective"
+    assert c["ops"]["infeed"]["bucket"] == "host"
+    assert c["device_total_us"] == 105.0
+    assert c["device_per_step_us"] == 52.5
+    assert c["bucket_us"] == {"compute": 80.0, "collective": 20.0,
+                              "host": 5.0}
+    assert c["window_us"] == 140.0  # 100 .. 240
+
+
+def test_op_census_top_k_keeps_explicit_remainder():
+    events = [_ev(f"op{i}.1", i * 10, 100 - i) for i in range(8)]
+    c = op_census(events, steps=1, top_k=3)
+    assert len(c["ops"]) == 4  # top 3 + "(other)"
+    other = c["ops"]["(other)"]
+    assert other["count"] == 5
+    # totals still reconcile: nothing silently truncated
+    assert c["device_total_us"] == sum(100 - i for i in range(8))
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def test_calibration_store_ewma_clamp_and_persistence(tmp_path):
+    path = str(tmp_path / "factors.json")
+    store = CalibrationStore(path)
+    assert store.factor("tpu-v4", "step") == 1.0  # unknown -> identity
+    assert store.update("tpu-v4", "step", 2.0) == 2.0  # first sample wins
+    f2 = store.update("tpu-v4", "step", 4.0)
+    assert f2 == pytest.approx((1 - EWMA_ALPHA) * 2.0 + EWMA_ALPHA * 4.0)
+    # degenerate captures are clamped, never explode the factor
+    assert store.update("tpu-v4", "compute", 1e9) <= FACTOR_MAX
+    assert store.update("tpu-v4", "collective", 1e-9) >= FACTOR_MIN
+    with pytest.raises(ValueError):
+        store.update("tpu-v4", "not-a-bucket", 1.0)
+    assert store.save() == path
+    reloaded = CalibrationStore(path)
+    assert reloaded.factor("tpu-v4", "step") == pytest.approx(f2)
+    assert set(reloaded.factors_for("tpu-v4")) == {"step", "compute",
+                                                   "collective"}
+
+
+def test_calibration_report_joins_measured_vs_modeled():
+    census = op_census([
+        _ev("fusion.1", 0, 4000),          # compute: 4ms/step
+        _ev("all-reduce.2", 4000, 1000),   # collective: 1ms/step
+    ], steps=1)
+    entry = {"site": "engine/train_step_fused", "predicted_us": 2000.0,
+             "predicted_breakdown_us": {"compute": 1000.0, "hbm": 800.0,
+                                        "comm": 900.0},
+             "provenance": "measured"}
+    rep = build_calibration_report(census, entry, device_kind="cpu",
+                                   node="n0")
+    assert rep["site"] == "engine/train_step_fused"
+    assert rep["measured_step_ms"] == pytest.approx(5.0)
+    assert rep["modeled_step_ms"] == pytest.approx(2.0)
+    assert rep["step_ratio"] == pytest.approx(2.5)
+    # compute bucket: 4ms measured vs max(compute, hbm)=1ms modeled -> 4x
+    comp = rep["buckets"]["compute"]
+    assert comp["ratio"] == pytest.approx(4.0)
+    assert comp["off_by_2x"] is True
+    # collective: 1ms vs 0.9ms -> within 2x
+    assert rep["buckets"]["collective"]["off_by_2x"] is False
+    assert rep["flagged"] == ["fusion"]
+    rows = {r["op"]: r for r in rep["ops"]}
+    assert rows["fusion"]["measured_ms"] == pytest.approx(4.0)
+    # per-op modeled = bucket model scaled by the op's measured share
+    assert rows["fusion"]["modeled_ms"] == pytest.approx(1.0)
+    assert rows["all-reduce"]["modeled_ms"] == pytest.approx(0.9)
+    # no roofline entry at all: measured rows survive, modeled is None
+    blind = build_calibration_report(census, None, device_kind="cpu")
+    assert blind["modeled_step_ms"] is None
+    assert blind["step_ratio"] is None
+    assert all(r["modeled_ms"] is None for r in blind["ops"])
+    assert blind["flagged"] == []
+
+
+def test_apply_report_grounds_cost_ledger_and_crossover(cal_store):
+    census = op_census([_ev("fusion.1", 0, 3000),
+                        _ev("all-reduce.2", 3000, 500)], steps=1)
+    entry = {"site": "s", "predicted_us": 1000.0,
+             "predicted_breakdown_us": {"compute": 1000.0, "hbm": 500.0,
+                                        "comm": 400.0}}
+    rep = build_calibration_report(census, entry, device_kind="unit-kind")
+    factors = apply_report_to_store(rep, store=cal_store)
+    assert factors["step"] == pytest.approx(3.5)       # 3.5ms vs 1ms
+    assert factors["compute"] == pytest.approx(3.0)    # 3ms vs 1ms
+    assert factors["collective"] == pytest.approx(1.25)
+    assert calibration_scale("unit-kind", "compute") == pytest.approx(3.0)
+    assert calibration_scale("other-kind", "compute") == 1.0
+
+    # the cost ledger now emits calibrated_us grounded in measurement
+    from deepspeed_tpu.telemetry.anatomy.ledger import CostLedger, DevicePeak
+
+    led = CostLedger(peak=DevicePeak(kind="unit-kind", flops_per_s=1e12,
+                                     hbm_bytes_per_s=1e11,
+                                     ici_bytes_per_s=1e10))
+    e = led.record("s", 0, flops=1e9, hbm_bytes=1e7, comm_bytes=0.0)
+    assert e["calibrated_us"] == pytest.approx(e["predicted_us"] * 3.0)
+    assert e["calibration"]["compute"] == pytest.approx(3.0)
+    # headroom prefers the measurement-grounded prediction
+    head = led.headroom("s", measured_us=e["calibrated_us"])
+    assert head == pytest.approx(0.0)
+
+    # and the tuning space shifts the Pallas crossover with the factor
+    from deepspeed_tpu.ops.pallas.moe_dispatch import (
+        DENSE_CROSSOVER_TEC, dense_crossover_tec, set_crossover_scale)
+    from deepspeed_tpu.tuning.space import apply_calibration
+
+    try:
+        scale = apply_calibration(store=cal_store, device_kind="unit-kind")
+        assert scale == pytest.approx(1.0 / 3.0)
+        assert dense_crossover_tec() == int(DENSE_CROSSOVER_TEC / 3.0)
+    finally:
+        set_crossover_scale(1.0)
+    assert dense_crossover_tec() == DENSE_CROSSOVER_TEC
+
+
+# ---------------------------------------------------------------------------
+# capture orchestrator (store protocol + step windows)
+# ---------------------------------------------------------------------------
+
+def test_poll_arms_max_merged_shared_window(tmp_path):
+    store = FakeStore()
+    a = ProfilerPlane("a", out_dir=str(tmp_path / "a"))
+    b = ProfilerPlane("b", out_dir=str(tmp_path / "b"))
+    a.on_step(10)
+    b.on_step(4)
+    a.poll(store)  # baseline beats (no command yet)
+    b.poll(store)
+    req = post_capture_command(store, steps=3, lead=2)
+    assert a.poll(store) == req  # proposes 12
+    assert b.poll(store) == req  # proposes 6; sees a's 12 -> adopts max
+    assert a._armed["start"] == 12
+    assert b._armed["start"] == 12
+    assert a._armed["steps"] == 3
+    assert store.kv[f"profiler/cmd/{req}/acks"] == 2
+    # a pending rank keeps tracking a LATER riser through its beats
+    store.max(f"profiler/cmd/{req}/start", 20)
+    assert a.poll(store) is None  # same command: no re-adopt
+    assert a._armed["start"] == 20
+
+
+def test_stale_command_is_ignored(tmp_path):
+    store = FakeStore()
+    plane = ProfilerPlane("n", out_dir=str(tmp_path))
+    plane.poll(store)
+    req = post_capture_command(store, steps=2)
+    store.t += 500.0  # the command ages past STALE_CMD_S
+    assert plane.poll(store) is None
+    assert plane._armed is None
+
+
+def test_fresh_plane_adopts_command_posted_just_before_boot(tmp_path):
+    store = FakeStore()
+    post_capture_command(store, steps=2)
+    req = post_capture_command(store, steps=2)  # newest wins
+    plane = ProfilerPlane("late", out_dir=str(tmp_path))
+    assert plane.poll(store) == req
+    assert plane._armed["req"] == req
+
+
+def test_window_begin_skipped_while_session_busy(tmp_path, monkeypatch):
+    import deepspeed_tpu.profiling.collective_trace as ct
+
+    monkeypatch.setattr(ct, "begin_shared_session", lambda d=None: None)
+    store = FakeStore()
+    plane = ProfilerPlane("n", out_dir=str(tmp_path), lead=1)
+    plane.poll(store)
+    post_capture_command(store, steps=2, lead=1)
+    plane.poll(store)
+    plane.on_step(plane._armed["start"])  # anatomy capture owns it
+    assert plane._armed is None           # dropped, not deadlocked
+    assert plane._captures == 0
+
+
+FORGED = [_ev("fusion.1", 100, 40), _ev("all-reduce.3", 150, 20),
+          _ev("infeed.7", 180, 5)]
+
+
+def _fake_session(monkeypatch):
+    import deepspeed_tpu.profiling.collective_trace as ct
+
+    monkeypatch.setattr(ct, "begin_shared_session", lambda d=None: d)
+    monkeypatch.setattr(ct, "end_shared_session", lambda: None)
+    monkeypatch.setattr(ct, "parse_trace_events",
+                        lambda d, patterns=None: list(FORGED))
+
+
+def test_duty_cycle_self_arms_and_stays_private(tmp_path, monkeypatch,
+                                                cal_store):
+    _fake_session(monkeypatch)
+    plane = ProfilerPlane("n", out_dir=str(tmp_path), ring=2,
+                          duty_cycle_pct=25.0, duty_period_steps=8)
+    plane.enable_duty_cycle()
+    for step in range(12):
+        plane.on_step(step)
+    assert plane._captures == 1
+    assert plane.last_result["req"] == 0
+    assert plane._pending_pub is None  # duty captures are NOT published
+    assert plane.last_result["census"]["ops"]["fusion"]["total_us"] == 40.0
+    # the window was duty_period * pct/100 = 2 steps
+    assert plane.last_result["steps"] == 2
+
+
+def test_command_capture_publishes_and_folds(tmp_path, monkeypatch,
+                                             cal_store):
+    _fake_session(monkeypatch)
+    booked = []
+
+    class Goodput:
+        def add(self, bucket, s):
+            booked.append((bucket, float(s)))
+
+    folded = []
+    store = FakeStore()
+    plane = ProfilerPlane("n0", out_dir=str(tmp_path), lead=1,
+                          goodput=Goodput())
+    plane.add_fold_hook(folded.append)
+    plane.poll(store)
+    req = post_capture_command(store, steps=2, lead=1)
+    plane.poll(store)
+    start = plane._armed["start"]
+    for step in range(start, start + 3):
+        plane.on_step(step)
+    assert plane._captures == 1
+    doc = plane.last_result
+    assert doc["req"] == req and doc["node"] == "n0"
+    assert doc["census"]["device_total_us"] == 65.0
+    assert doc["events"][0]["ts_us"] <= doc["events"][-1]["ts_us"]
+    assert [b for b, _ in booked] == ["profiler"]  # capture machinery only
+    assert folded and folded[0] is doc
+    # the publication flushes to the store on the next beat
+    assert plane._pending_pub is doc
+    plane.poll(store)
+    assert store.kv[pub_key("n0")]["req"] == req
+    assert plane._pending_pub is None
+    # bundle context carries summaries, never the event lanes
+    ctx = plane.context()
+    assert ctx["captures"] == 1
+    assert "events" not in ctx["last_capture"]
+    assert "census" not in ctx["last_capture"]
+
+
+def test_real_window_capture_on_cpu_backend(tmp_path, cal_store):
+    """The measured path end to end: a real ``jax.profiler`` session
+    around real jitted steps on the CPU backend — the census must carry
+    measured per-op durations and the calibration join must run against
+    a live cost-ledger entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.profiling.collective_trace import (
+        active_trace_session, end_shared_session, feed_exec_census)
+    from deepspeed_tpu.telemetry.anatomy.ledger import get_cost_ledger
+    from deepspeed_tpu.telemetry.collective_ledger import CollectiveLedger
+
+    f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()  # compile outside the window
+
+    led = get_cost_ledger()
+    led.reset()
+    try:
+        led.record("unit/profiler_site", 0, flops=1e9, hbm_bytes=1e8)
+        store = FakeStore()
+        plane = ProfilerPlane("real", out_dir=str(tmp_path / "ring"),
+                              ring=2, lead=1, site="unit/profiler_site")
+        plane.poll(store)
+        req = post_capture_command(store, steps=2, lead=1)
+        assert plane.poll(store) == req
+        for step in range(8):
+            plane.on_step(step)
+            f(x).block_until_ready()
+            if plane._captures:
+                break
+        assert plane._captures == 1
+        doc = plane.last_result
+        assert doc["census"]["ops"], "CPU trace produced no device ops"
+        assert doc["census"]["device_total_us"] > 0
+        assert doc["events"]
+        assert os.path.isdir(doc["trace_dir"])
+        # measured vs modeled joined against the live roofline entry
+        rep = doc["calibration"]
+        assert rep["site"] == "unit/profiler_site"
+        assert rep["measured_step_ms"] > 0
+        assert rep["modeled_step_ms"] is not None
+        assert rep["step_ratio"] is not None
+        assert any(r["modeled_ms"] is not None for r in rep["ops"])
+        # the factors persisted to the (re-homed) calibration store
+        assert cal_store.factor("cpu", "step") != 1.0 or \
+            rep["factors"].get("step")
+        # satellite: the capture's ring dir is a second feed_exec_census
+        # producer — the trace-fed entries land in the EXEC lane only
+        exec_led = CollectiveLedger(enabled=True)
+        exec_led.record("psum", 128)  # live census chain
+        census_hash = exec_led.tail_hash
+        fed = feed_exec_census(doc["trace_dir"], ledger=exec_led,
+                               patterns=None)
+        assert fed > 0
+        assert exec_led.exec_seq == fed
+        assert exec_led.tail_hash == census_hash  # census chain untouched
+    finally:
+        if active_trace_session():
+            end_shared_session()
+        led.reset()
+
+
+def test_idle_plane_never_touches_jit_or_sessions(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.profiling.collective_trace import \
+        active_trace_session
+
+    f = jax.jit(lambda x: (x * 2).sum())
+    x = jnp.ones((8,))
+    f(x).block_until_ready()
+    n_compiles = f._cache_size()
+    plane = ProfilerPlane("idle", out_dir=str(tmp_path))
+    for step in range(50):
+        plane.on_step(step)
+        f(x).block_until_ready()
+    assert f._cache_size() == n_compiles  # zero recompiles
+    assert active_trace_session() is None
+    assert plane._captures == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet merge (rank 0 / CLI side)
+# ---------------------------------------------------------------------------
+
+def _pub(node, req=1, aligned=True, t0=2000.0):
+    return {
+        "req": req, "node": node, "mode": "window", "start_step": 10,
+        "steps": 2, "window_s": 0.05, "trace_dir": f"/tmp/{node}",
+        "device_kind": "cpu",
+        "clock": {"aligned": aligned,
+                  "store_t0_s": t0 if aligned else None,
+                  "wall_t0_s": 1.0, "offset_s": 0.0 if aligned else None},
+        "census": op_census(list(FORGED), steps=2),
+        "calibration": {"node": node, "device_kind": "cpu",
+                        "flagged": [f"bad_op_{node}"],
+                        "factors": {"step": 2.0}},
+        "events": list(FORGED),
+        "events_truncated": 0,
+    }
+
+
+def test_persist_load_and_fleet_calibration(tmp_path):
+    pubs = {"n0": _pub("n0"), "n1": _pub("n1")}
+    written = persist_profiles(str(tmp_path), pubs)
+    assert len(written) == 2
+    back = load_profiles(str(tmp_path))
+    assert sorted(back) == ["n0", "n1"]
+    assert back["n0"]["census"]["device_total_us"] == 65.0
+    fleet = build_fleet_calibration(pubs)
+    assert fleet["flagged_ops"] == ["bad_op_n0", "bad_op_n1"]
+    assert fleet["factors"]["cpu"]["step"] == 2.0
+    assert set(fleet["nodes"]) == {"n0", "n1"}
+
+
+def test_cluster_trace_merges_aligned_device_lanes(tmp_path):
+    from deepspeed_tpu.telemetry.aggregator import build_cluster_trace
+
+    pubs = {"n0": _pub("n0", t0=2000.0),
+            "n1": _pub("n1", t0=2000.5),
+            "n2": _pub("n2", aligned=False)}
+    persist_profiles(str(tmp_path), pubs)
+    doc = build_cluster_trace(str(tmp_path))
+    assert doc is not None
+    hosts = doc["metadata"]["hosts"]
+    assert sorted(hosts) == ["n0 (device)", "n1 (device)", "n2 (device)"]
+    assert all(h["device"] for h in hosts.values())
+    assert hosts["n0 (device)"]["aligned"] is True
+    assert hosts["n2 (device)"]["aligned"] is False
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert "n0 (device)" in names
+    assert "n2 (device) (unaligned)" in names
+    # clock alignment: base is the earliest aligned anchor (n0), so n0's
+    # first span lands at 0 and n1's at +0.5s on the shared timeline
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_pid = {}
+    for e in spans:
+        by_pid.setdefault(e["pid"], []).append(e["ts"])
+    n0_min = min(by_pid[hosts["n0 (device)"]["pid"]])
+    n1_min = min(by_pid[hosts["n1 (device)"]["pid"]])
+    assert n0_min == pytest.approx(0.0, abs=0.2)
+    assert n1_min - n0_min == pytest.approx(0.5e6, rel=1e-3)
+    assert all(e["cat"] == "device" for e in spans)
+    # persisted next to the lanes
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "cluster_trace.json"))
+
+
+def test_assemble_fleet_profile_waits_merges_and_reports(tmp_path):
+    from deepspeed_tpu.telemetry.profiler.fleet import (
+        assemble_fleet_profile)
+
+    store = FakeStore()
+    store.set(pub_key("n0"), _pub("n0"))
+    store.set(pub_key("n1"), _pub("n1"))
+    out = str(tmp_path / "archive")
+    summary = assemble_fleet_profile(store, 1, out,
+                                     nodes=["n0", "n1", "ghost"],
+                                     timeout_s=0.5)
+    assert summary["nodes"] == ["n0", "n1"]
+    assert summary["missing"] == ["ghost"]
+    assert os.path.exists(summary["calibration_report"])
+    assert os.path.exists(summary["cluster_trace"])
+    assert os.path.exists(os.path.join(out, "fleet_profile.json"))
+    assert summary["device_lanes"] == {"n0": 3, "n1": 3}
+    with open(summary["calibration_report"]) as fh:
+        rep = json.load(fh)
+    assert sorted(rep["flagged_ops"]) == ["bad_op_n0", "bad_op_n1"]
+    # no publications at all: a named timeout, not a silent empty merge
+    with pytest.raises(TimeoutError):
+        assemble_fleet_profile(FakeStore(), 9, str(tmp_path / "x"),
+                               nodes=["nope"], timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_profile_cli_parser_and_report_render(tmp_path, capsys):
+    from deepspeed_tpu.telemetry.cli import build_parser
+    from deepspeed_tpu.telemetry.profiler.cli import cmd_profile
+
+    p = build_parser()
+    args = p.parse_args(["profile", "capture", "--steps", "2",
+                         "--nodes", "a,b", "--endpoint", "h:1"])
+    assert args.fn is cmd_profile
+    assert args.steps == 2 and args.nodes == "a,b"
+
+    report = {"nodes": {"n0": {"measured_step_ms": 5.0,
+                               "modeled_step_ms": 2.0, "step_ratio": 2.5,
+                               "site": "s", "device_kind": "cpu"}},
+              "flagged_ops": ["fusion"], "factors": {"cpu": {"step": 2.5}}}
+    arch = tmp_path / "arch"
+    arch.mkdir()
+    (arch / "calibration_report.json").write_text(json.dumps(report))
+    args = p.parse_args(["profile", "report", str(arch)])
+    assert args.fn(args) == 0
+    out = capsys.readouterr().out
+    assert "factors[cpu]" in out
+    assert "fusion" in out
+    assert "n0: measured 5.0ms/step vs modeled 2.0ms" in out
+
+    # factors round trip against an explicit path
+    fpath = tmp_path / "factors.json"
+    args = p.parse_args(["profile", "factors", "--path", str(fpath)])
+    assert args.fn(args) == 0
+    args = p.parse_args(["profile", "factors", "--path", str(fpath),
+                         "--clear"])
+    assert args.fn(args) == 0
+    assert json.load(open(fpath))["factors"] == {}

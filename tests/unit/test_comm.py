@@ -174,3 +174,89 @@ def test_exec_summary_per_step_normalization():
     finally:
         comms_logger.configure(enabled=False)
         comms_logger.reset()
+
+
+class _BarrierStore:
+    """FakeStore surface monitored_barrier touches (append/get)."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def append(self, k, v):
+        self.kv.setdefault(k, []).append(v)
+        return list(self.kv[k])
+
+    def get(self, k):
+        return self.kv.get(k)
+
+
+def test_monitored_barrier_single_process_noop():
+    comm.monitored_barrier(tag="solo")  # world=1: effects barrier only
+
+
+def test_monitored_barrier_store_success_and_round_isolation():
+    store = _BarrierStore()
+    store.kv["barrier/t/1"] = [1, 2]  # the other ranks already arrived
+    comm.monitored_barrier(tag="t", world=3, rank=0, store=store,
+                           timeout=2.0)
+    assert sorted(store.kv["barrier/t/1"]) == [0, 1, 2]
+    # the SAME tag's next round uses a fresh key: no cross-talk with
+    # round 1's arrivals
+    store.kv["barrier/t/2"] = [1, 2]
+    comm.monitored_barrier(tag="t", world=3, rank=0, store=store,
+                           timeout=2.0)
+    assert sorted(store.kv["barrier/t/2"]) == [0, 1, 2]
+
+
+def test_monitored_barrier_timeout_names_missing_ranks():
+    """Satellite (ISSUE 20): the debugging barrier's whole point — a
+    timeout names WHICH ranks never arrived, books the failed round on
+    the collective ledger, and parks the failure doc where the next
+    flight-recorder bundle picks it up."""
+    from deepspeed_tpu.comm import comm as comm_mod
+    from deepspeed_tpu.telemetry.collective_ledger import \
+        get_collective_ledger
+
+    led = get_collective_ledger()
+    led.reset()
+    led.enabled = True
+    try:
+        store = _BarrierStore()
+        with pytest.raises(RuntimeError) as exc:
+            comm.monitored_barrier(tag="lost", world=3, rank=0,
+                                   store=store, timeout=0.3)
+        msg = str(exc.value)
+        assert "ranks [1, 2] never arrived" in msg
+        assert "(1/3 present)" in msg
+        doc = comm_mod._mon_barrier_failure
+        assert doc["missing"] == [1, 2]
+        assert doc["arrived"] == [0]
+        assert doc["tag"] == "lost" and doc["world"] == 3
+        ops = [e["op"] for e in led.tail()]
+        assert any(op.startswith("monitored_barrier_timeout:lost#")
+                   and op.endswith("missing=1,2") for op in ops)
+        assert all(e["src"] == "barrier" for e in led.tail())
+    finally:
+        led.reset()
+        led.enabled = False
+
+
+def test_monitored_barrier_polls_for_late_arrivals():
+    import threading as _threading
+
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    store = _BarrierStore()
+    with comm_mod._mon_barrier_lock:  # peek the round this call will use
+        seq = comm_mod._mon_barrier_seq.get("late", 0) + 1
+    # "rank 1" arrives a beat AFTER rank 0 enters the barrier: the poll
+    # loop must pick it up well before the timeout
+    t = _threading.Timer(
+        0.2, lambda: store.append(f"barrier/late/{seq}", 1))
+    t.start()
+    try:
+        comm.monitored_barrier(tag="late", world=2, rank=0, store=store,
+                               timeout=5.0)
+    finally:
+        t.join(timeout=2.0)
+    assert sorted(store.kv[f"barrier/late/{seq}"]) == [0, 1]
